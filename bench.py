@@ -1,0 +1,60 @@
+"""Headline benchmark: Spark murmur3 row-hash throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md): its NVBench suite measures
+but does not commit results. vs_baseline is therefore reported against the
+north-star nominal of 1e9 rows/s for a 4-column row hash on a single
+accelerator (GPU-class row-hash throughput per BASELINE.json configs).
+"""
+
+import json
+import time
+
+NOMINAL_ROWS_PER_S = 1.0e9
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.ops import hashing as H
+
+    n = 1 << 22  # 4M rows
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(-(2**31), 2**31, n).astype(np.int32))
+    b = jnp.asarray(rng.integers(-(2**62), 2**62, n, dtype=np.int64))
+    c = jnp.asarray(rng.random(n, dtype=np.float32))
+    d = jnp.asarray(rng.random(n).astype(np.float64))
+
+    @jax.jit
+    def row_hash(a, b, c, d):
+        h = jnp.full(a.shape, np.uint32(42), dtype=jnp.uint32)
+        h = H._mm_u32(h, a.astype(jnp.uint32))
+        h = H._mm_u64(h, b.astype(jnp.uint64))
+        h = H._mm_u32(h, H._f32_bits(c, False))
+        h = H._mm_u64(h, H._f64_bits(d, False))
+        return h.astype(jnp.int32)
+
+    out = row_hash(a, b, c, d)
+    out.block_until_ready()  # compile + warm
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = row_hash(a, b, c, d)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    rows_per_s = n / dt
+    print(json.dumps({
+        "metric": "murmur3_row_hash_4col_throughput",
+        "value": round(rows_per_s / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(rows_per_s / NOMINAL_ROWS_PER_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""spark_rapids_jni_tpu: a TPU-native columnar engine with the capability
+surface of NVIDIA's spark-rapids-jni (reference: /root/reference).
+
+The reference is the native acceleration layer of the RAPIDS Accelerator for
+Apache Spark: Spark-exact columnar kernels (hashing, decimal128 arithmetic,
+string casts, JSON path evaluation, URI parsing, row<->column conversion,
+timezone/datetime rebasing, bloom filters, histograms, z-ordering), a
+GPU-memory-aware task retry scheduler, and native Parquet footer pruning.
+
+This package rebuilds that surface TPU-first:
+  * columnar/  - Column/Table representation (JAX pytrees: typed data +
+                 validity masks + offsets children), host builders.
+  * ops/       - Spark-semantics kernels as XLA/Pallas programs.
+  * mem/       - HBM reservation ledger + the Spark resource adaptor
+                 (retry-OOM state machine) implemented in native C++.
+  * parquet/   - Thrift-compact footer parse/prune (native C++ with a
+                 pure-Python fallback).
+  * parallel/  - jax.sharding mesh utilities for multi-chip columnar
+                 exchange (hash-partitioned shuffle over ICI).
+  * models/    - end-to-end query pipelines (the "flagship models"):
+                 hash-join / groupby-aggregate / sort compositions.
+
+Spark longs, xxhash64 and decimal128 limb math require 64-bit integers, so
+x64 mode is enabled at import (TPU emulates int64; hot kernels use 32-bit
+lanes internally).
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .columnar.dtype import DType, TypeId  # noqa: E402
+from .columnar.column import Column, Table  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["DType", "TypeId", "Column", "Table", "__version__"]

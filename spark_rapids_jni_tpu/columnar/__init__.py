@@ -1,0 +1,4 @@
+from .dtype import DType, TypeId
+from .column import Column, Table
+
+__all__ = ["DType", "TypeId", "Column", "Table"]

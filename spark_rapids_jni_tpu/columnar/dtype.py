@@ -1,0 +1,168 @@
+"""Column data types.
+
+Mirrors the type surface the reference operates on (cudf type ids as consumed
+by spark-rapids-jni: fixed-width numerics, bool, timestamps, strings, decimals,
+lists, structs) without copying cudf's representation. Decimal scale follows
+the cudf Java convention used throughout the reference JNI layer
+(/root/reference/src/main/cpp/src/DecimalUtilsJni.cpp): the *Java* scale is
+non-negative digits after the decimal point; internally we store it directly
+(value = unscaled * 10**-scale).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeId(enum.Enum):
+    BOOL8 = "bool8"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    TIMESTAMP_DAYS = "timestamp_days"      # int32 days since epoch
+    TIMESTAMP_SECONDS = "timestamp_s"      # int64
+    TIMESTAMP_MILLISECONDS = "timestamp_ms"  # int64
+    TIMESTAMP_MICROSECONDS = "timestamp_us"  # int64
+    STRING = "string"
+    DECIMAL32 = "decimal32"
+    DECIMAL64 = "decimal64"
+    DECIMAL128 = "decimal128"
+    LIST = "list"
+    STRUCT = "struct"
+
+
+_FIXED_WIDTH_NP = {
+    TypeId.BOOL8: np.uint8,
+    TypeId.INT8: np.int8,
+    TypeId.INT16: np.int16,
+    TypeId.INT32: np.int32,
+    TypeId.INT64: np.int64,
+    TypeId.UINT8: np.uint8,
+    TypeId.UINT16: np.uint16,
+    TypeId.UINT32: np.uint32,
+    TypeId.UINT64: np.uint64,
+    TypeId.FLOAT32: np.float32,
+    TypeId.FLOAT64: np.float64,
+    TypeId.TIMESTAMP_DAYS: np.int32,
+    TypeId.TIMESTAMP_SECONDS: np.int64,
+    TypeId.TIMESTAMP_MILLISECONDS: np.int64,
+    TypeId.TIMESTAMP_MICROSECONDS: np.int64,
+    TypeId.DECIMAL32: np.int32,
+    TypeId.DECIMAL64: np.int64,
+    # DECIMAL128 handled specially: (n, 4) uint32 little-endian limbs.
+}
+
+_SIZE_BYTES = {
+    TypeId.BOOL8: 1, TypeId.INT8: 1, TypeId.UINT8: 1,
+    TypeId.INT16: 2, TypeId.UINT16: 2,
+    TypeId.INT32: 4, TypeId.UINT32: 4, TypeId.FLOAT32: 4,
+    TypeId.TIMESTAMP_DAYS: 4, TypeId.DECIMAL32: 4,
+    TypeId.INT64: 8, TypeId.UINT64: 8, TypeId.FLOAT64: 8,
+    TypeId.TIMESTAMP_SECONDS: 8, TypeId.TIMESTAMP_MILLISECONDS: 8,
+    TypeId.TIMESTAMP_MICROSECONDS: 8, TypeId.DECIMAL64: 8,
+    TypeId.DECIMAL128: 16,
+}
+
+
+@dataclass(frozen=True)
+class DType:
+    """A column dtype: a TypeId plus decimal scale where applicable."""
+
+    id: TypeId
+    scale: int = 0  # digits after the decimal point (Java convention, >= 0)
+
+    # ---- predicates -------------------------------------------------------
+    @property
+    def is_fixed_width(self) -> bool:
+        return self.id not in (TypeId.STRING, TypeId.LIST, TypeId.STRUCT)
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.id in (TypeId.DECIMAL32, TypeId.DECIMAL64, TypeId.DECIMAL128)
+
+    @property
+    def is_nested(self) -> bool:
+        return self.id in (TypeId.LIST, TypeId.STRUCT)
+
+    @property
+    def is_timestamp(self) -> bool:
+        return self.id in (
+            TypeId.TIMESTAMP_DAYS, TypeId.TIMESTAMP_SECONDS,
+            TypeId.TIMESTAMP_MILLISECONDS, TypeId.TIMESTAMP_MICROSECONDS,
+        )
+
+    @property
+    def is_integral(self) -> bool:
+        return self.id in (
+            TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
+            TypeId.UINT8, TypeId.UINT16, TypeId.UINT32, TypeId.UINT64,
+        )
+
+    @property
+    def is_floating(self) -> bool:
+        return self.id in (TypeId.FLOAT32, TypeId.FLOAT64)
+
+    # ---- physical layout --------------------------------------------------
+    @property
+    def itemsize(self) -> int:
+        """Fixed-width element size in bytes (JCUDF layout size)."""
+        return _SIZE_BYTES[self.id]
+
+    @property
+    def np_dtype(self):
+        if self.id is TypeId.DECIMAL128:
+            return np.uint32  # limbs
+        return np.dtype(_FIXED_WIDTH_NP[self.id])
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.np_dtype)
+
+    def __repr__(self) -> str:
+        if self.is_decimal:
+            return f"DType({self.id.value}, scale={self.scale})"
+        return f"DType({self.id.value})"
+
+
+# Convenience singletons -----------------------------------------------------
+BOOL8 = DType(TypeId.BOOL8)
+INT8 = DType(TypeId.INT8)
+INT16 = DType(TypeId.INT16)
+INT32 = DType(TypeId.INT32)
+INT64 = DType(TypeId.INT64)
+UINT8 = DType(TypeId.UINT8)
+UINT16 = DType(TypeId.UINT16)
+UINT32 = DType(TypeId.UINT32)
+UINT64 = DType(TypeId.UINT64)
+FLOAT32 = DType(TypeId.FLOAT32)
+FLOAT64 = DType(TypeId.FLOAT64)
+STRING = DType(TypeId.STRING)
+TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
+TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
+TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)
+TIMESTAMP_MICROSECONDS = DType(TypeId.TIMESTAMP_MICROSECONDS)
+LIST = DType(TypeId.LIST)
+STRUCT = DType(TypeId.STRUCT)
+
+
+def decimal32(scale: int) -> DType:
+    return DType(TypeId.DECIMAL32, scale)
+
+
+def decimal64(scale: int) -> DType:
+    return DType(TypeId.DECIMAL64, scale)
+
+
+def decimal128(scale: int) -> DType:
+    return DType(TypeId.DECIMAL128, scale)

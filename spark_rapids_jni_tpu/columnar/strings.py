@@ -1,0 +1,67 @@
+"""String column densification helpers.
+
+XLA programs need static shapes; variable-length string kernels therefore run
+over a padded `uint8[n, L]` byte matrix + `int32[n]` lengths, produced here
+from the canonical (data, offsets) representation. L is rounded up to a
+bucket size so jit caches stay small.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column
+from .dtype import TypeId
+
+
+def round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+def pad_width(max_len: int, multiple: int = 8) -> int:
+    """Bucket a max string length to limit recompilation: next power of two,
+    at least `multiple`."""
+    w = max(multiple, 1 << (max(1, max_len) - 1).bit_length())
+    return round_up(w, multiple)
+
+
+def padded_bytes(col: Column, multiple: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Densify a STRING column to (uint8[n, L] zero-padded, int32[n] lengths).
+
+    L is a static python int (bucketed). Runs gathers on device; the max
+    length readback is the only host sync.
+    """
+    assert col.dtype.id is TypeId.STRING
+    n = col.size
+    offsets = jnp.asarray(col.offsets, dtype=jnp.int32)
+    lengths = offsets[1:] - offsets[:-1]
+    max_len = int(jnp.max(lengths)) if n else 0
+    L = pad_width(max_len, multiple)
+    data = col.data
+    if data.shape[0] == 0:
+        return jnp.zeros((n, L), dtype=jnp.uint8), lengths
+    pos = offsets[:-1, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_range = pos < offsets[1:, None]
+    gathered = jnp.take(data, jnp.clip(pos, 0, data.shape[0] - 1), axis=0)
+    return jnp.where(in_range, gathered, jnp.uint8(0)), lengths
+
+
+def from_padded_bytes(mat: np.ndarray, lengths: np.ndarray,
+                      validity=None) -> Column:
+    """Rebuild a STRING column from padded bytes + lengths (host path)."""
+    from . import dtype as dt
+    mat = np.asarray(mat, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n = mat.shape[0]
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    parts = [mat[i, :lengths[i]].tobytes() for i in range(n)]
+    blob = b"".join(parts)
+    data = (jnp.asarray(np.frombuffer(blob, dtype=np.uint8).copy())
+            if blob else jnp.zeros((0,), dtype=jnp.uint8))
+    vmask = None if validity is None else jnp.asarray(np.asarray(validity, dtype=bool))
+    return Column(dt.STRING, n, data=data, validity=vmask,
+                  offsets=jnp.asarray(offsets))

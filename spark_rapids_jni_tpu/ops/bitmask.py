@@ -1,0 +1,43 @@
+"""Validity bitmask utilities.
+
+Capability parity with the reference's `bitmask_bitwise_or`
+(/root/reference/src/main/cpp/src/utilities.cu:22) plus the pack/unpack
+between the engine's bool[n] masks and cudf-layout packed words (bit i of
+word w = row 32w+i, little-endian bit order) used by the JCUDF row format.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def bitmask_bitwise_or(masks: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """OR of equal-length bool masks (utilities.cu:22 takes packed words;
+    the engine's canonical mask form is bool[n])."""
+    assert masks, "need at least one mask"
+    out = masks[0]
+    for m in masks[1:]:
+        assert m.shape == out.shape, "mismatched mask lengths"
+        out = out | m
+    return out
+
+
+def pack_bool_mask(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[n] -> uint32[ceil(n/32)] packed validity words (cudf layout)."""
+    n = mask.shape[0]
+    nwords = (n + 31) // 32
+    padded = jnp.zeros((nwords * 32,), dtype=jnp.uint32).at[:n].set(
+        mask.astype(jnp.uint32))
+    bits = padded.reshape(nwords, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits << shifts[None, :], axis=1, dtype=jnp.uint32)
+
+
+def unpack_bool_mask(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint32[nwords] packed validity words -> bool[n]."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[:, None] >> shifts[None, :]) & np.uint32(1)
+    return bits.reshape(-1)[:n].astype(bool)

@@ -1,0 +1,513 @@
+"""Spark-convention row hashing: MurmurHash3_32 and XXHash64.
+
+Capability parity with the reference's `murmur_hash3_32` / `xxhash64`
+(/root/reference/src/main/cpp/src/murmur_hash.cu:187, xxhash64.cu:330,
+murmur_hash.cuh, hash.cuh) re-designed as vectorized XLA programs: instead of
+a thread-per-row functor, every mixing step runs across all rows as uint32/
+uint64 vector lanes; variable-length inputs (strings, java BigDecimal bytes)
+run over padded byte matrices with per-row masking.
+
+Spark conventions reproduced exactly:
+  * serial seed-chaining across columns; a null element passes the seed
+    through unchanged (murmur_hash.cu:40-58).
+  * sub-int integers sign-extend to 4 bytes; decimal32/64 hash as 8 bytes
+    (murmur_hash.cuh:130-196, xxhash64.cu:197-260).
+  * murmur normalizes float NaNs only; xxhash64 normalizes NaNs *and* -0.0
+    (hash.cuh:33-52).
+  * murmur's nonstandard tail handling: each trailing byte is sign-extended
+    and run through a *full* block mix (murmur_hash.cuh:72-93).
+  * decimal128 hashes the minimal two's-complement big-endian byte form of
+    java.math.BigDecimal.unscaledValue().toByteArray() (hash.cuh:63-102).
+  * murmur supports STRUCT (depth-first decomposition, parent nulls
+    superimposed) and LIST (serial chain over the row's flattened leaf
+    elements); LIST-of-STRUCT is rejected (murmur_hash.cu:117-183).
+  * xxhash64 rejects nested types entirely.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.dtype import DType, TypeId
+from ..columnar.strings import padded_bytes
+
+DEFAULT_MURMUR_SEED = 42  # Hash.java:33
+DEFAULT_XXHASH64_SEED = 42  # hash.cuh:28
+MAX_STACK_DEPTH = 8  # Hash.java:28
+
+# ---------------------------------------------------------------------------
+# murmur3 core (uint32 lanes)
+# ---------------------------------------------------------------------------
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_C3 = np.uint32(0xE6546B64)
+
+
+def _rotl32(x, r: int):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mm_block(h, k):
+    """One full murmur block mix; Spark uses the same mix for tail bytes."""
+    k = k * _C1
+    k = _rotl32(k, 15)
+    k = k * _C2
+    h = h ^ k
+    h = _rotl32(h, 13)
+    return h * np.uint32(5) + _C3
+
+
+def _mm_fmix(h, length_u32):
+    h = h ^ length_u32
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def _mm_u32(h, v_u32):
+    """Hash a 4-byte value."""
+    return _mm_fmix(_mm_block(h, v_u32), np.uint32(4))
+
+
+def _mm_u64(h, v_u64):
+    """Hash an 8-byte value (little-endian block order)."""
+    lo = (v_u64 & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (v_u64 >> np.uint64(32)).astype(jnp.uint32)
+    h = _mm_block(h, lo)
+    h = _mm_block(h, hi)
+    return _mm_fmix(h, np.uint32(8))
+
+
+def _bytes4_to_u32(b0, b1, b2, b3):
+    return (b0.astype(jnp.uint32)
+            | (b1.astype(jnp.uint32) << np.uint32(8))
+            | (b2.astype(jnp.uint32) << np.uint32(16))
+            | (b3.astype(jnp.uint32) << np.uint32(24)))
+
+
+def _mm_bytes(h, mat, lengths):
+    """Variable-length byte hashing over padded uint8[n, L] with int32[n]
+    lengths. Reproduces compute_bytes (murmur_hash.cuh:95-119)."""
+    n, L = mat.shape
+    nblocks = lengths // 4
+    if L >= 4:
+        def body(i, hh):
+            blk4 = lax.dynamic_slice_in_dim(mat, i * 4, 4, axis=1)
+            k = _bytes4_to_u32(blk4[:, 0], blk4[:, 1], blk4[:, 2], blk4[:, 3])
+            return jnp.where(i < nblocks, _mm_block(hh, k), hh)
+        h = lax.fori_loop(0, L // 4, body, h)
+    # Spark tail: each remaining byte sign-extended, full block mix.
+    smat = mat.astype(jnp.int8)
+    for i in range(min(3, L)):
+        idx = jnp.clip(nblocks * 4 + i, 0, L - 1)
+        b = jnp.take_along_axis(smat, idx[:, None], axis=1)[:, 0]
+        k = b.astype(jnp.int32).astype(jnp.uint32)
+        h = jnp.where(nblocks * 4 + i < lengths, _mm_block(h, k), h)
+    return _mm_fmix(h, lengths.astype(jnp.uint32))
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 core (uint64 lanes)
+# ---------------------------------------------------------------------------
+
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r: int):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _xx_final(h):
+    h = h ^ (h >> np.uint64(33))
+    h = h * _P2
+    h = h ^ (h >> np.uint64(29))
+    h = h * _P3
+    h = h ^ (h >> np.uint64(32))
+    return h
+
+
+def _xx_round8(h, k64):
+    k1 = k64 * _P2
+    k1 = _rotl64(k1, 31) * _P1
+    h = h ^ k1
+    return _rotl64(h, 27) * _P1 + _P4
+
+
+def _xx_round4(h, k32_u64):
+    h = h ^ (k32_u64 * _P1)
+    return _rotl64(h, 23) * _P2 + _P3
+
+
+def _xx_round1(h, b_u64):
+    h = h ^ (b_u64 * _P5)
+    return _rotl64(h, 11) * _P1
+
+
+def _xx_u32(seed, v_u64):
+    """4-byte value path (v zero-extended to u64)."""
+    h = seed + _P5 + np.uint64(4)
+    return _xx_final(_xx_round4(h, v_u64))
+
+
+def _xx_u64(seed, v_u64):
+    h = seed + _P5 + np.uint64(8)
+    return _xx_final(_xx_round8(h, v_u64))
+
+
+def _gather_u64(mat, idx):
+    """Read 8 little-endian bytes per row at per-row byte offset idx."""
+    n, L = mat.shape
+    pos = idx[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+    b = jnp.take_along_axis(mat, jnp.clip(pos, 0, L - 1), axis=1)
+    b = b.astype(jnp.uint64)
+    out = jnp.zeros((n,), dtype=jnp.uint64)
+    for i in range(8):
+        out = out | (b[:, i] << np.uint64(8 * i))
+    return out
+
+
+def _gather_u32(mat, idx):
+    n, L = mat.shape
+    pos = idx[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]
+    b = jnp.take_along_axis(mat, jnp.clip(pos, 0, L - 1), axis=1)
+    b = b.astype(jnp.uint64)
+    out = jnp.zeros((mat.shape[0],), dtype=jnp.uint64)
+    for i in range(4):
+        out = out | (b[:, i] << np.uint64(8 * i))
+    return out
+
+
+def _xx_bytes(seed, mat, lengths):
+    """Variable-length xxhash64 over padded uint8[n, L] + int32[n] lengths.
+    Reproduces compute_bytes (xxhash64.cu:109-175)."""
+    n, L = mat.shape
+    len64 = lengths.astype(jnp.uint64)
+    nstripes = lengths // 32
+
+    if L >= 32:
+        v1 = jnp.full((n,), seed + _P1 + _P2, dtype=jnp.uint64)
+        v2 = jnp.full((n,), seed + _P2, dtype=jnp.uint64)
+        v3 = jnp.full((n,), seed, dtype=jnp.uint64)
+        v4 = jnp.full((n,), seed - _P1, dtype=jnp.uint64)
+
+        def vround(v, k):
+            v = v + k * _P2
+            return _rotl64(v, 31) * _P1
+
+        def body(s, vs):
+            v1, v2, v3, v4 = vs
+            base = jnp.full((n,), s * 32, dtype=jnp.int32)
+            active = s < nstripes
+            nv1 = vround(v1, _gather_u64(mat, base))
+            nv2 = vround(v2, _gather_u64(mat, base + 8))
+            nv3 = vround(v3, _gather_u64(mat, base + 16))
+            nv4 = vround(v4, _gather_u64(mat, base + 24))
+            return (jnp.where(active, nv1, v1), jnp.where(active, nv2, v2),
+                    jnp.where(active, nv3, v3), jnp.where(active, nv4, v4))
+
+        v1, v2, v3, v4 = lax.fori_loop(0, L // 32, body, (v1, v2, v3, v4))
+
+        merged = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+                  + _rotl64(v4, 18))
+        for v in (v1, v2, v3, v4):
+            vk = _rotl64(v * _P2, 31) * _P1
+            merged = (merged ^ vk) * _P1 + _P4
+        h = jnp.where(lengths >= 32, merged, seed + _P5)
+    else:
+        h = jnp.full((n,), seed + _P5, dtype=jnp.uint64)
+
+    h = h + len64
+    offset = nstripes * 32
+
+    # up to three 8-byte chunks
+    rem32 = lengths - offset
+    n8 = rem32 // 8
+    for i in range(3):
+        if L >= 8:
+            k = _gather_u64(mat, offset + 8 * i)
+            h = jnp.where(i < n8, _xx_round8(h, k), h)
+    offset = offset + n8 * 8
+
+    # one 4-byte chunk
+    if L >= 4:
+        k = _gather_u32(mat, offset)
+        has4 = (lengths % 8) >= 4
+        h = jnp.where(has4, _xx_round4(h, k), h)
+        offset = offset + jnp.where(has4, 4, 0)
+
+    # trailing bytes
+    for i in range(min(3, L)):
+        idx = jnp.clip(offset + i, 0, L - 1)
+        b = jnp.take_along_axis(mat, idx[:, None], axis=1)[:, 0].astype(jnp.uint64)
+        h = jnp.where(offset + i < lengths, _xx_round1(h, b), h)
+
+    return _xx_final(h)
+
+
+# ---------------------------------------------------------------------------
+# java BigDecimal byte form for decimal128 (hash.cuh:63-102)
+# ---------------------------------------------------------------------------
+
+def _dec128_java_bytes(limbs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """uint32[n,4] limbs -> (uint8[n,16] big-endian minimal bytes (zero
+    padded), int32[n] lengths)."""
+    n = limbs.shape[0]
+    # little-endian byte expansion
+    le = jnp.zeros((n, 16), dtype=jnp.uint8)
+    for i in range(4):
+        limb = limbs[:, i]
+        for j in range(4):
+            le = le.at[:, 4 * i + j].set(
+                ((limb >> np.uint32(8 * j)) & np.uint32(0xFF)).astype(jnp.uint8))
+    is_neg = (limbs[:, 3] >> np.uint32(31)) != 0
+    zero_byte = jnp.where(is_neg, jnp.uint8(0xFF), jnp.uint8(0x00))
+
+    # minimal length: highest byte position where byte != zero_byte, +1; min 1
+    poss = jnp.arange(16, dtype=jnp.int32)[None, :]
+    nonzero = le != zero_byte[:, None]
+    length = jnp.max(jnp.where(nonzero, poss + 1, 0), axis=1)
+    length = jnp.maximum(length, 1)
+    # keep a sign byte if the top retained byte's sign bit mismatches
+    top = jnp.take_along_axis(le, (length - 1)[:, None], axis=1)[:, 0]
+    top_neg = (top & jnp.uint8(0x80)) != 0
+    length = jnp.where((length < 16) & (is_neg ^ top_neg), length + 1, length)
+
+    # reverse to big-endian, zero pad
+    src = jnp.clip(length[:, None] - 1 - poss, 0, 15)
+    be = jnp.take_along_axis(le, src, axis=1)
+    be = jnp.where(poss < length[:, None], be, jnp.uint8(0))
+    return be, length
+
+
+# ---------------------------------------------------------------------------
+# element dispatch
+# ---------------------------------------------------------------------------
+
+def _f32_bits(x, normalize_zero: bool):
+    qnan = np.uint32(0x7FC00000)
+    bits = lax.bitcast_convert_type(x, jnp.uint32)
+    bits = jnp.where(jnp.isnan(x), qnan, bits)
+    if normalize_zero:
+        bits = jnp.where(x == 0.0, np.uint32(0), bits)
+    return bits
+
+
+def _f64_bits(x, normalize_zero: bool):
+    qnan = np.uint64(0x7FF8000000000000)
+    bits = lax.bitcast_convert_type(x, jnp.uint64)
+    bits = jnp.where(jnp.isnan(x), qnan, bits)
+    if normalize_zero:
+        bits = jnp.where(x == 0.0, np.uint64(0), bits)
+    return bits
+
+
+def _fixed_element_words(col_dtype: DType, data, for_xxhash: bool):
+    """Return ('u32'|'u64', words) for a fixed-width element column."""
+    tid = col_dtype.id
+    if tid is TypeId.BOOL8:
+        # any nonzero byte is true (cudf element<bool> semantics)
+        return "u32", (data != 0).astype(jnp.uint32)
+    if tid in (TypeId.UINT8, TypeId.UINT16):
+        return "u32", data.astype(jnp.uint32)
+    if tid in (TypeId.INT8, TypeId.INT16):
+        return "u32", data.astype(jnp.int32).astype(jnp.uint32)
+    if tid in (TypeId.INT32, TypeId.TIMESTAMP_DAYS):
+        return "u32", data.astype(jnp.uint32)
+    if tid is TypeId.UINT32:
+        return "u32", data.astype(jnp.uint32)
+    if tid is TypeId.FLOAT32:
+        return "u32", _f32_bits(data, normalize_zero=for_xxhash)
+    if tid in (TypeId.INT64, TypeId.TIMESTAMP_SECONDS,
+               TypeId.TIMESTAMP_MILLISECONDS, TypeId.TIMESTAMP_MICROSECONDS):
+        return "u64", data.astype(jnp.uint64)
+    if tid is TypeId.UINT64:
+        return "u64", data.astype(jnp.uint64)
+    if tid is TypeId.FLOAT64:
+        return "u64", _f64_bits(data, normalize_zero=for_xxhash)
+    if tid is TypeId.DECIMAL32:
+        # hashed as 8 bytes of the sign-extended unscaled value
+        return "u64", data.astype(jnp.int64).astype(jnp.uint64)
+    if tid is TypeId.DECIMAL64:
+        return "u64", data.astype(jnp.int64).astype(jnp.uint64)
+    raise TypeError(f"unsupported hash element type {col_dtype}")
+
+
+class _HashUnit:
+    """A flattened hashable column: a leaf column + effective validity."""
+
+    def __init__(self, col: Column, valid: Optional[jnp.ndarray],
+                 list_chain: Sequence[jnp.ndarray] = ()):
+        self.col = col
+        self.valid = valid
+        self.list_chain = tuple(list_chain)  # offsets from outer to inner
+
+
+def _flatten_units(col: Column, parent_valid: Optional[jnp.ndarray],
+                   depth: int = 0) -> List[_HashUnit]:
+    if depth > MAX_STACK_DEPTH:
+        raise ValueError("max nesting depth exceeded")
+    eff = _and_valid(parent_valid, col.validity)
+    tid = col.dtype.id
+    if tid is TypeId.STRUCT:
+        units: List[_HashUnit] = []
+        for ch in col.children:
+            units.extend(_flatten_units(ch, eff, depth + 1))
+        return units
+    if tid is TypeId.LIST:
+        chain = [jnp.asarray(col.offsets, dtype=jnp.int32)]
+        cur = col.children[0]
+        while cur.dtype.id is TypeId.LIST:
+            chain.append(jnp.asarray(cur.offsets, dtype=jnp.int32))
+            cur = cur.children[0]
+        if cur.dtype.id is TypeId.STRUCT:
+            raise ValueError(
+                "Cannot compute hash of a table with a LIST of STRUCT columns.")
+        return [_HashUnit(cur, eff, chain)]
+    return [_HashUnit(col, eff)]
+
+
+def _and_valid(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _compose_chain(chain: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    starts = chain[0][:-1]
+    ends = chain[0][1:]
+    for offs in chain[1:]:
+        starts = jnp.take(offs, starts)
+        ends = jnp.take(offs, ends)
+    return starts, ends
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _normalize_input(table: Union[Table, Sequence[Column]]) -> Tuple[Column, ...]:
+    if isinstance(table, Table):
+        return table.columns
+    return tuple(table)
+
+
+def _hash_rows(columns: Tuple[Column, ...], seed: int, algo: str) -> Column:
+    """Shared driver: seed-chain `algo` across flattened column units."""
+    for_xx = algo == "xx"
+    if for_xx:
+        hdt, out_dt = jnp.uint64, dt.INT64
+        seed_v = np.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+    else:
+        hdt, out_dt = jnp.uint32, dt.INT32
+        seed_v = np.uint32(seed & 0xFFFFFFFF)
+
+    if not columns:
+        return Column(out_dt, 0, data=jnp.zeros((0,), dtype=out_dt.jnp_dtype))
+    n = columns[0].size
+    h = jnp.full((n,), seed_v, dtype=hdt)
+
+    units: List[_HashUnit] = []
+    for c in columns:
+        if for_xx and c.dtype.is_nested:
+            raise TypeError("xxhash64 does not support nested types")
+        units.extend(_flatten_units(c, None))
+
+    for u in units:
+        h = _apply_unit(h, u, for_xx)
+
+    signed = h.astype(jnp.int64 if for_xx else jnp.int32)
+    return Column(out_dt, n, data=signed)
+
+
+def _elem_hash(h, col: Column, for_xx: bool):
+    """Hash every element of `col` with per-row seeds `h` (no null handling)."""
+    tid = col.dtype.id
+    if tid is TypeId.STRING:
+        mat, lengths = padded_bytes(col)
+        return _xx_bytes(h, mat, lengths) if for_xx else _mm_bytes(h, mat, lengths)
+    if tid is TypeId.DECIMAL128:
+        be, lengths = _dec128_java_bytes(col.data)
+        return _xx_bytes(h, be, lengths) if for_xx else _mm_bytes(h, be, lengths)
+    kind, words = _fixed_element_words(col.dtype, col.data, for_xx)
+    if for_xx:
+        words = words.astype(jnp.uint64)
+        return _xx_u32(h, words) if kind == "u32" else _xx_u64(h, words)
+    return _mm_u32(h, words) if kind == "u32" else _mm_u64(h, words)
+
+
+def _apply_unit(h, u: _HashUnit, for_xx: bool):
+    col, valid = u.col, u.valid
+    if not u.list_chain:
+        nh = _elem_hash(h, col, for_xx)
+        if valid is not None:
+            nh = jnp.where(valid, nh, h)
+        return nh
+
+    # LIST unit: serial chain over the row's leaf elements (murmur only).
+    starts, ends = _compose_chain(u.list_chain)
+    seg_len = ends - starts
+    max_len = int(jnp.max(seg_len)) if seg_len.shape[0] else 0
+    leaf = col
+    leaf_valid = leaf.validity
+
+    # Pre-hash prep: for strings, precompute the padded matrix once.
+    if leaf.dtype.id is TypeId.STRING:
+        mat, lengths = padded_bytes(leaf)
+
+        def elem(hh, idx):
+            sub = jnp.take(mat, idx, axis=0)
+            ln = jnp.take(lengths, idx)
+            return _mm_bytes(hh, sub, ln)
+    elif leaf.dtype.id is TypeId.DECIMAL128:
+        be, lengths = _dec128_java_bytes(leaf.data)
+
+        def elem(hh, idx):
+            return _mm_bytes(hh, jnp.take(be, idx, axis=0), jnp.take(lengths, idx))
+    else:
+        kind, words = _fixed_element_words(leaf.dtype, leaf.data, for_xx)
+
+        def elem(hh, idx):
+            w = jnp.take(words, idx)
+            return _mm_u32(hh, w) if kind == "u32" else _mm_u64(hh, w)
+
+    m = max(1, leaf.size)
+    for j in range(max_len):
+        idx = jnp.clip(starts + j, 0, m - 1)
+        active = (starts + j) < ends
+        if valid is not None:
+            active = active & valid
+        if leaf_valid is not None:
+            active = active & jnp.take(leaf_valid, idx)
+        nh = elem(h, idx)
+        h = jnp.where(active, nh, h)
+    return h
+
+
+def murmur_hash3_32(table: Union[Table, Sequence[Column]],
+                    seed: int = DEFAULT_MURMUR_SEED) -> Column:
+    """Spark murmur3_32 row hash -> INT32 column (Hash.java:40-56)."""
+    return _hash_rows(_normalize_input(table), seed, "mm")
+
+
+def xxhash64(table: Union[Table, Sequence[Column]],
+             seed: int = DEFAULT_XXHASH64_SEED) -> Column:
+    """Spark xxhash64 row hash -> INT64 column (Hash.java:70-90)."""
+    return _hash_rows(_normalize_input(table), seed, "xx")

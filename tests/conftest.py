@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize registers the axon TPU PJRT plugin in every
+python process and pins jax to it; tests must run on a virtual 8-device CPU
+mesh instead (multi-chip shardings are validated here and by the driver via
+__graft_entry__.dryrun_multichip). This must run before any backend is
+initialized, so it happens at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,405 @@
+"""Golden Spark-semantics hash vectors.
+
+Expected values are the Spark-generated constants from the reference's test
+suite (/root/reference/src/main/cpp/tests/hash.cpp): SparkMurmurHash3Test
+(MultiValueWithSeeds :483, StringsWithSeed :682, ListValues :708,
+StructOfListValues :783) and SparkXXHash64Test (MultiValueWithSeeds :898,
+Strings :1242).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column, Table
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32, xxhash64
+
+I32_MIN, I32_MAX = -(2**31), 2**31 - 1
+I64_MIN, I64_MAX = -(2**63), 2**63 - 1
+F32_MAX = float(np.finfo(np.float32).max)
+F32_LOWEST = float(np.finfo(np.float32).min)
+F64_MAX = float(np.finfo(np.float64).max)
+F64_LOWEST = float(np.finfo(np.float64).min)
+
+STRINGS5 = [
+    "",
+    "The quick brown fox",
+    "jumps over the lazy dog.",
+    "All work and no play makes Jack a dull boy",
+    "!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~휠휡",
+]
+
+DEC128_UNSCALED5 = [
+    0,
+    100,
+    -1,
+    -999999999999999999999999999,          # -9999999999999999.99999999999
+    9999999999999999999999999999999999999,  # 99999999999999999999999999.99999999999
+]
+
+
+def hashes(col_or_cols, seed, fn):
+    cols = col_or_cols if isinstance(col_or_cols, list) else [col_or_cols]
+    return fn(cols, seed).to_pylist()
+
+
+def neg_nan(width):
+    if width == 32:
+        return np.frombuffer(np.uint32(0xFFC00000).tobytes(), dtype=np.float32)[0]
+    return np.frombuffer(np.uint64(0xFFF8000000000000).tobytes(), dtype=np.float64)[0]
+
+
+class TestSparkMurmurHash3:
+    # hash.cpp:483 MultiValueWithSeeds
+    def col_strings(self):
+        return Column.from_pylist(STRINGS5, dt.STRING)
+
+    def col_doubles(self):
+        return Column.from_pylist([0.0, -0.0, neg_nan(64), F64_LOWEST, F64_MAX],
+                                  dt.FLOAT64)
+
+    def col_timestamps(self):
+        return Column.from_pylist(
+            [0, 100, -100, I64_MIN // 1000000 + 1, I64_MAX // 1000000],
+            dt.TIMESTAMP_MILLISECONDS)
+
+    def test_strings(self):
+        assert hashes(self.col_strings(), 42, murmur_hash3_32) == [
+            142593372, 1217302703, -715697185, -2061143941, -111635966]
+
+    def test_strings_seed_314(self):
+        # hash.cpp:682 StringsWithSeed
+        assert hashes(self.col_strings(), 314, murmur_hash3_32) == [
+            1467149710, 723257560, -1620282500, -2001858707, 1588473657]
+
+    def test_doubles(self):
+        assert hashes(self.col_doubles(), 42, murmur_hash3_32) == [
+            -1670924195, -853646085, -1281358385, 1897734433, -508695674]
+
+    def test_timestamps(self):
+        # Long.MinValue/1000000 truncates toward zero in Java
+        vals = [0, 100, -100, -9223372036854, 9223372036854]
+        c = Column.from_pylist(vals, dt.TIMESTAMP_MILLISECONDS)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            -1670924195, 1114849490, 904948192, -1832979433, 1752430209]
+
+    def test_decimal64(self):
+        c = Column.from_pylist(
+            [0, 100, -100, -999999999999999999, 999999999999999999],
+            dt.decimal64(7))
+        assert hashes(c, 42, murmur_hash3_32) == [
+            -1670924195, 1114849490, 904948192, 1962370902, -1795328666]
+
+    def test_longs(self):
+        c = Column.from_pylist([0, 100, -100, I64_MIN, I64_MAX], dt.INT64)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            -1670924195, 1114849490, 904948192, -853646085, -1604625029]
+
+    def test_floats(self):
+        c = Column.from_pylist([0.0, -0.0, neg_nan(32), F32_LOWEST, F32_MAX],
+                               dt.FLOAT32)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            933211791, 723455942, -349261430, -1225560532, -338752985]
+
+    def test_dates(self):
+        # Int.MinValue/100 truncates toward zero in Java: -21474836
+        c = Column.from_pylist([0, 100, -100, -21474836, 21474836],
+                               dt.TIMESTAMP_DAYS)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            933211791, 751823303, -1080202046, -1906567553, -1503850410]
+
+    def test_decimal32(self):
+        c = Column.from_pylist([0, 100, -100, -999999999, 999999999],
+                               dt.decimal32(3))
+        assert hashes(c, 42, murmur_hash3_32) == [
+            -1670924195, 1114849490, 904948192, -1454351396, -193774131]
+
+    def test_ints(self):
+        c = Column.from_pylist([0, 100, -100, I32_MIN, I32_MAX], dt.INT32)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            933211791, 751823303, -1080202046, 723455942, 133916647]
+
+    def test_shorts(self):
+        c = Column.from_pylist([0, 100, -100, -32768, 32767], dt.INT16)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            933211791, 751823303, -1080202046, -1871935946, 1249274084]
+
+    def test_bytes(self):
+        c = Column.from_pylist([0, 100, -100, -128, 127], dt.INT8)
+        assert hashes(c, 42, murmur_hash3_32) == [
+            933211791, 751823303, -1080202046, 1110053733, 1135925485]
+
+    def test_bools(self):
+        expected = [933211791, -559580957, -559580957, -559580957, 933211791]
+        c1 = Column.from_pylist([False, True, True, True, False], dt.BOOL8)
+        assert hashes(c1, 42, murmur_hash3_32) == expected
+        c2 = Column.from_numpy(np.array([0, 1, 2, 255, 0], dtype=np.uint8),
+                               dt.BOOL8)
+        assert hashes(c2, 42, murmur_hash3_32) == expected
+
+    def test_decimal128(self):
+        c = Column.from_pylist(DEC128_UNSCALED5, dt.decimal128(11))
+        assert hashes(c, 42, murmur_hash3_32) == [
+            -783713497, -295670906, 1398487324, -52622807, -1359749815]
+
+    def _structs_col(self):
+        a = Column.from_pylist([0, 100, -100, 0x12345678, -0x76543210], dt.INT32)
+        b = Column.from_pylist(["a", "bc", "def", "ghij", "klmno"], dt.STRING)
+        x = Column.from_pylist([0.0, 100.0, -100.0, float("inf"), float("-inf")],
+                               dt.FLOAT32)
+        y = Column.from_pylist(
+            [0, 100, -100, 0x0123456789ABCDEF, -0x0123456789ABCDEF], dt.INT64)
+        c = Column.struct_of([x, y])
+        return Column.struct_of([a, b, c])
+
+    def test_structs(self):
+        assert hashes(self._structs_col(), 42, murmur_hash3_32) == [
+            -105406170, 90479889, -678041645, 1667387937, 301478567]
+
+    def test_combined(self):
+        cols = [
+            self._structs_col(),
+            self.col_strings(),
+            self.col_doubles(),
+            Column.from_pylist([0, 100, -100, -9223372036854, 9223372036854],
+                               dt.TIMESTAMP_MILLISECONDS),
+            Column.from_pylist(
+                [0, 100, -100, -999999999999999999, 999999999999999999],
+                dt.decimal64(7)),
+            Column.from_pylist([0, 100, -100, I64_MIN, I64_MAX], dt.INT64),
+            Column.from_pylist([0.0, -0.0, neg_nan(32), F32_LOWEST, F32_MAX],
+                               dt.FLOAT32),
+            Column.from_pylist([0, 100, -100, -21474836, 21474836],
+                               dt.TIMESTAMP_DAYS),
+            Column.from_pylist([0, 100, -100, -999999999, 999999999],
+                               dt.decimal32(3)),
+            Column.from_pylist([0, 100, -100, I32_MIN, I32_MAX], dt.INT32),
+            Column.from_pylist([0, 100, -100, -32768, 32767], dt.INT16),
+            Column.from_pylist([0, 100, -100, -128, 127], dt.INT8),
+            Column.from_numpy(np.array([0, 1, 2, 255, 0], dtype=np.uint8),
+                              dt.BOOL8),
+            Column.from_pylist(DEC128_UNSCALED5, dt.decimal128(11)),
+        ]
+        assert hashes(cols, 42, murmur_hash3_32) == [
+            401603227, 588162166, 552160517, 1132537411, -326043017]
+
+    def test_list_values(self):
+        # hash.cpp:708 ListValues: LIST<LIST<INT32>> with nulls
+        inner_vals = [1, 1, 2, 1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 0, 2, 3,
+                      1, 2, 3, 0, 1, 2, 3]
+        leaf = Column.from_pylist(
+            [1,
+             1, 2,
+             1, 2, 3,
+             1, 2, 3,
+             1, 2, 3,
+             1, None, 2, 3,
+             1, 2, 3, None,
+             1, 2, 3], dt.INT32)
+        inner_offsets = np.array(
+            [0, 0, 1, 3, 6, 8, 9, 10, 12, 13, 16, 18, 19, 20, 22, 22, 23],
+            dtype=np.int32)
+        inner_valid = np.ones(16, dtype=bool)
+        inner_valid[0] = False
+        inner_valid[14] = False
+        inner = Column.list_of(leaf, inner_offsets,
+                               validity=np.asarray(inner_valid))
+        outer_offsets = np.array([0, 0, 0, 1, 2, 3, 4, 6, 8, 10, 13, 16],
+                                 dtype=np.int32)
+        outer_valid = np.ones(11, dtype=bool)
+        outer_valid[0] = False
+        outer = Column.list_of(inner, outer_offsets,
+                               validity=np.asarray(outer_valid))
+        assert hashes(outer, 42, murmur_hash3_32) == [
+            42, 42, 42, -559580957, -222940379, -912918097, -912918097,
+            -912918097, -912918097, -912918097, -912918097]
+
+    def test_struct_of_list_values(self):
+        # hash.cpp:783 StructOfListValues
+        leaf1 = Column.from_pylist([0, 1, None, 1, None, 2, 3], dt.INT32)
+        col1 = Column.list_of(
+            leaf1, np.array([0, 0, 1, 3, 5, 5, 5, 7], dtype=np.int32),
+            validity=np.array([1, 1, 1, 1, 1, 0, 1], dtype=bool))
+        leaf2 = Column.from_pylist([0, None, 1, 1, 4, 5], dt.INT32)
+        col2 = Column.list_of(
+            leaf2, np.array([0, 0, 1, 1, 1, 3, 4, 6], dtype=np.int32),
+            validity=np.array([1, 1, 0, 1, 1, 1, 1], dtype=bool))
+        s = Column.struct_of([col1, col2])
+        assert hashes(s, 42, murmur_hash3_32) == [
+            42, 59727262, -559580957, -559580957, -559580957, -559580957,
+            170038658]
+
+    def test_list_of_struct_rejected(self):
+        inner = Column.struct_of([Column.from_pylist([1, 2], dt.INT32)])
+        lst = Column.list_of(inner, np.array([0, 1, 2], dtype=np.int32))
+        with pytest.raises(ValueError, match="LIST of STRUCT"):
+            murmur_hash3_32([lst], 42)
+
+
+NULLS8 = [1, 1, 1, 1, 1, 0, 1, 1]
+XSEED = 42
+
+
+def _with_nulls(vals, dtype):
+    vals = [v if NULLS8[i] else None for i, v in enumerate(vals)]
+    return Column.from_pylist(vals, dtype)
+
+
+class TestSparkXXHash64:
+    # hash.cpp:898 MultiValueWithSeeds
+    def test_strings(self):
+        c = _with_nulls(STRINGS5 + ["", "abcdefgh", "abcdefghi"], dt.STRING)
+        assert hashes(c, XSEED, xxhash64) == [
+            -7444071767201028348, -3617261401988713833, 8198945020833482635,
+            -5346617152005100141, 6614298085531227868, 42,
+            2470326616177429180, -7093207067522615973]
+
+    def test_doubles(self):
+        c = _with_nulls(
+            [0.0, -0.0, neg_nan(64), F64_LOWEST, F64_MAX, 0.0, 100.0, 200.0],
+            dt.FLOAT64)
+        assert hashes(c, XSEED, xxhash64) == [
+            -5252525462095825812, -5252525462095825812, -3127944061524951246,
+            9065082843545458248, -4222314252576420879, 42,
+            -7996023612001835843, -8838535416664833914]
+
+    def test_timestamps(self):
+        c = _with_nulls(
+            [0, 100, -100, -9223372036854, 9223372036854, 0, 200, 300],
+            dt.TIMESTAMP_MILLISECONDS)
+        assert hashes(c, XSEED, xxhash64) == [
+            -5252525462095825812, 8713583529807266080, 5675770457807661948,
+            7123048472642709644, -5141505295506489983, 42,
+            -1244884446866925109, 1772389229253425430]
+
+    def test_decimal64(self):
+        c = _with_nulls(
+            [0, 100, -100, -999999999999999999, 999999999999999999, 0, 123, 432],
+            dt.decimal64(7))
+        assert hashes(c, XSEED, xxhash64) == [
+            -5252525462095825812, 8713583529807266080, 5675770457807661948,
+            4265531446127695490, 2162198894918931945, 42,
+            -3178482946328430151, 4788666723486520022]
+
+    def test_longs(self):
+        c = _with_nulls(
+            [0, 100, -100, I64_MIN, I64_MAX, 0, 0x123456789ABCDEF,
+             -0x123456789ABCDEF], dt.INT64)
+        assert hashes(c, XSEED, xxhash64) == [
+            -5252525462095825812, 8713583529807266080, 5675770457807661948,
+            -8619748838626508300, -3246596055638297850, 42,
+            1941233597257011502, -1318946533059658749]
+
+    def test_floats(self):
+        c = _with_nulls(
+            [0.0, -0.0, neg_nan(32), F32_LOWEST, F32_MAX, 0.0,
+             float("inf"), float("-inf")], dt.FLOAT32)
+        assert hashes(c, XSEED, xxhash64) == [
+            3614696996920510707, 3614696996920510707, 2692338816207849720,
+            -8545425418825163117, -1065250890878313112, 42,
+            -5940311692336719973, -7580553461823983095]
+
+    def test_dates(self):
+        c = _with_nulls([0, 100, -100, -21474836, 21474836, 0, -200, -300],
+                        dt.TIMESTAMP_DAYS)
+        assert hashes(c, XSEED, xxhash64) == [
+            3614696996920510707, -7987742665087449293, 8990748234399402673,
+            -8442426365007754391, -1447590449373190349, 42,
+            -953008374380745918, 2895908635257747121]
+
+    def test_decimal32(self):
+        c = _with_nulls([0, 100, -100, -999999999, 999999999, 0, -200, -300],
+                        dt.decimal32(3))
+        assert hashes(c, XSEED, xxhash64) == [
+            -5252525462095825812, 8713583529807266080, 5675770457807661948,
+            8670643431269007867, 6810183316718625826, 42,
+            7277994511003214036, 6264187449999859617]
+
+    def test_ints(self):
+        c = _with_nulls([0, 100, -100, I32_MIN, I32_MAX, 0, -200, -300],
+                        dt.INT32)
+        assert hashes(c, XSEED, xxhash64) == [
+            3614696996920510707, -7987742665087449293, 8990748234399402673,
+            2073849959933241805, 1508894993788531228, 42,
+            -953008374380745918, 2895908635257747121]
+
+    def test_shorts(self):
+        c = _with_nulls([0, 100, -100, -32768, 32767, 0, -200, -300], dt.INT16)
+        assert hashes(c, XSEED, xxhash64) == [
+            3614696996920510707, -7987742665087449293, 8990748234399402673,
+            -904511417458573795, 8952525448871805501, 42,
+            -953008374380745918, 2895908635257747121]
+
+    def test_bytes(self):
+        c = _with_nulls([0, 100, -100, -128, 127, 0, -90, -80], dt.INT8)
+        assert hashes(c, XSEED, xxhash64) == [
+            3614696996920510707, -7987742665087449293, 8990748234399402673,
+            4160238337661960656, 8632298611707923906, 42,
+            -4008061843281999337, 6690883199412647955]
+
+    def test_bools(self):
+        expected = [3614696996920510707, -6698625589789238999,
+                    -6698625589789238999, -6698625589789238999,
+                    3614696996920510707, 42, 3614696996920510707,
+                    3614696996920510707]
+        c1 = _with_nulls([False, True, True, True, False, False, False, False],
+                         dt.BOOL8)
+        assert hashes(c1, XSEED, xxhash64) == expected
+        raw = np.array([0, 1, 2, 255, 0, 0, 0, 0], dtype=np.uint8)
+        c2 = Column.from_numpy(raw, dt.BOOL8,
+                               validity=np.array(NULLS8, dtype=bool))
+        assert hashes(c2, XSEED, xxhash64) == expected
+
+    def test_decimal128(self):
+        vals = DEC128_UNSCALED5 + [0, DEC128_UNSCALED5[3], DEC128_UNSCALED5[4]]
+        c = _with_nulls(vals, dt.decimal128(11))
+        assert hashes(c, XSEED, xxhash64) == [
+            -8959994473701255385, 4409375254388155230, -4006032525457443936,
+            -5423362182451591024, 7041733194569950081, 42,
+            -5423362182451591024, 7041733194569950081]
+
+    def test_combined(self):
+        cols = [
+            _with_nulls(STRINGS5 + ["", "abcdefgh", "abcdefghi"], dt.STRING),
+            _with_nulls([0.0, -0.0, neg_nan(64), F64_LOWEST, F64_MAX, 0.0,
+                         100.0, 200.0], dt.FLOAT64),
+            _with_nulls([0, 100, -100, -9223372036854, 9223372036854, 0, 200,
+                         300], dt.TIMESTAMP_MILLISECONDS),
+            _with_nulls([0, 100, -100, -999999999999999999,
+                         999999999999999999, 0, 123, 432], dt.decimal64(7)),
+            _with_nulls([0, 100, -100, I64_MIN, I64_MAX, 0, 0x123456789ABCDEF,
+                         -0x123456789ABCDEF], dt.INT64),
+            _with_nulls([0.0, -0.0, neg_nan(32), F32_LOWEST, F32_MAX, 0.0,
+                         float("inf"), float("-inf")], dt.FLOAT32),
+            _with_nulls([0, 100, -100, -21474836, 21474836, 0, -200, -300],
+                        dt.TIMESTAMP_DAYS),
+            _with_nulls([0, 100, -100, -999999999, 999999999, 0, -200, -300],
+                        dt.decimal32(3)),
+            _with_nulls([0, 100, -100, I32_MIN, I32_MAX, 0, -200, -300],
+                        dt.INT32),
+            _with_nulls([0, 100, -100, -32768, 32767, 0, -200, -300],
+                        dt.INT16),
+            _with_nulls([0, 100, -100, -128, 127, 0, -90, -80], dt.INT8),
+            Column.from_numpy(np.array([0, 1, 2, 255, 0, 0, 0, 0],
+                                       dtype=np.uint8), dt.BOOL8,
+                              validity=np.array(NULLS8, dtype=bool)),
+            _with_nulls(DEC128_UNSCALED5 + [0, DEC128_UNSCALED5[3],
+                                            DEC128_UNSCALED5[4]],
+                        dt.decimal128(11)),
+        ]
+        assert hashes(cols, XSEED, xxhash64) == [
+            541735645035655239, 9011982951766246298, 3834379147931449211,
+            -5406325166887725795, 7797509897614041972, 42,
+            -9032872913521304524, -604070008711895908]
+
+    def test_strings_with_null(self):
+        # hash.cpp:1242 Strings
+        c = Column.from_pylist([STRINGS5[0], None] + STRINGS5[1:], dt.STRING)
+        assert hashes(c, XSEED, xxhash64) == [
+            -7444071767201028348, 42, -3617261401988713833,
+            8198945020833482635, -5346617152005100141, 6614298085531227868]
+
+    def test_nested_rejected(self):
+        s = Column.struct_of([Column.from_pylist([1], dt.INT32)])
+        with pytest.raises(TypeError):
+            xxhash64([s], 42)
