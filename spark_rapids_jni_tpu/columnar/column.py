@@ -233,7 +233,8 @@ class Table:
         if self.columns:
             n = self.columns[0].size
             for c in self.columns:
-                assert c.size == n, "table columns must share row count"
+                if c.size != n:
+                    raise ValueError("table columns must share row count")
 
     def tree_flatten(self):
         return (self.columns,), None
@@ -282,7 +283,11 @@ def _to_unscaled_int(v, scale: int) -> int:
     if isinstance(v, int):
         return v  # already unscaled
     if isinstance(v, _pydecimal.Decimal):
-        return int((v * (10 ** scale)).to_integral_value(
+        # shift by adjusting the exponent directly (context-independent,
+        # exact for negative scales too, e.g. "1e2" at java scale -2)
+        sign, digits, exp = v.as_tuple()
+        shifted = _pydecimal.Decimal((sign, digits, exp + scale))
+        return int(shifted.to_integral_value(
             rounding=_pydecimal.ROUND_HALF_UP))
     if isinstance(v, str):
         return _to_unscaled_int(_pydecimal.Decimal(v), scale)
@@ -290,7 +295,11 @@ def _to_unscaled_int(v, scale: int) -> int:
 
 
 def _scaled_decimal(unscaled: int, scale: int) -> _pydecimal.Decimal:
-    return _pydecimal.Decimal(unscaled).scaleb(-scale)
+    # exact construction: scaleb() would round to the caller's context
+    # precision (default 28), silently corrupting 38-digit decimals
+    sign = 1 if unscaled < 0 else 0
+    digits = tuple(int(c) for c in str(abs(unscaled)))
+    return _pydecimal.Decimal((sign, digits, -scale))
 
 
 def _infer_dtype(np_dtype) -> DType:

@@ -17,10 +17,12 @@ import numpy as np
 def bitmask_bitwise_or(masks: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """OR of equal-length bool masks (utilities.cu:22 takes packed words;
     the engine's canonical mask form is bool[n])."""
-    assert masks, "need at least one mask"
+    if not masks:
+        raise ValueError("need at least one mask")
     out = masks[0]
     for m in masks[1:]:
-        assert m.shape == out.shape, "mismatched mask lengths"
+        if m.shape != out.shape:
+            raise ValueError("mismatched mask lengths")
         out = out | m
     return out
 

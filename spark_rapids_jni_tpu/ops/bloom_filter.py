@@ -56,13 +56,14 @@ class BloomFilter:
 
 def bloom_filter_create(num_hashes: int, num_longs: int) -> BloomFilter:
     """New empty filter (bloom_filter.cu:225)."""
-    assert num_hashes > 0 and num_longs > 0
+    if num_hashes <= 0 or num_longs <= 0:
+        raise ValueError("bloom filter needs positive num_hashes/num_longs")
     return BloomFilter(num_hashes, num_longs,
                        jnp.zeros((num_longs * 64,), dtype=bool))
 
 
-def _probe_bits(keys_i64, valid, num_hashes: int, num_bits: int):
-    """Per-key probe bit indices int32[n, num_hashes] (+ valid mask)."""
+def _probe_bits(keys_i64, num_hashes: int, num_bits: int):
+    """Per-key probe bit indices int32[n, num_hashes]."""
     h0 = jnp.zeros(keys_i64.shape, dtype=jnp.uint32)
     ku = keys_i64.astype(jnp.uint64)
     h1 = H._mm_u64(h0, ku)
@@ -80,9 +81,10 @@ def _probe_bits(keys_i64, valid, num_hashes: int, num_bits: int):
 def bloom_filter_put(bf: BloomFilter, col: Column) -> BloomFilter:
     """Insert an INT64 column's non-null values; returns the updated filter
     (functional; bloom_filter.cu:255 mutates in place)."""
-    assert col.dtype.id is dt.TypeId.INT64, "bloom filter input must be INT64"
+    if col.dtype.id is not dt.TypeId.INT64:
+        raise TypeError("bloom filter input must be INT64")
     valid = col.valid_mask()
-    idx = _probe_bits(col.data, valid, bf.num_hashes, bf.num_bits)
+    idx = _probe_bits(col.data, bf.num_hashes, bf.num_bits)
     # invalid rows scatter False (no-op under max)
     upd = jnp.broadcast_to(valid[:, None], idx.shape)
     bits = bf.bits.at[idx.reshape(-1)].max(upd.reshape(-1))
@@ -92,8 +94,9 @@ def bloom_filter_put(bf: BloomFilter, col: Column) -> BloomFilter:
 def bloom_filter_probe(col: Column, bf: BloomFilter) -> Column:
     """BOOL8 column: might-contain for each key; nulls propagate
     (bloom_filter.cu:339)."""
-    assert col.dtype.id is dt.TypeId.INT64
-    idx = _probe_bits(col.data, col.valid_mask(), bf.num_hashes, bf.num_bits)
+    if col.dtype.id is not dt.TypeId.INT64:
+        raise TypeError("bloom filter input must be INT64")
+    idx = _probe_bits(col.data, bf.num_hashes, bf.num_bits)
     hit = jnp.all(jnp.take(bf.bits, idx, axis=0), axis=1)
     return Column(dt.BOOL8, col.size, data=hit.astype(jnp.uint8),
                   validity=col.validity)
@@ -102,7 +105,8 @@ def bloom_filter_probe(col: Column, bf: BloomFilter) -> Column:
 def bloom_filter_merge(filters) -> BloomFilter:
     """OR-merge filters with identical parameters (bloom_filter.cu:277)."""
     filters = list(filters)
-    assert filters, "need at least one filter"
+    if not filters:
+        raise ValueError("need at least one filter")
     first = filters[0]
     for f in filters[1:]:
         if (f.num_hashes != first.num_hashes

@@ -1,0 +1,313 @@
+"""Spark-exact DECIMAL128 arithmetic with 256-bit intermediates.
+
+Capability parity with the reference's decimal utilities
+(/root/reference/src/main/cpp/src/decimal_utils.cu: dec128_add_sub :561,
+dec128_multiplier :657, dec128_divider :744, dec128_remainder :854; entry
+points multiply/divide/integer_divide/remainder/add/sub_decimal128
+:974-1175, declared in decimal_utils.hpp:30-64).
+
+Each op returns a Table of (overflow BOOL8, result DECIMAL128) like the
+reference, with the inputs' validity AND-ed onto both outputs. HALF_UP
+rounding, the optional interim cast to precision 38 matching the
+SPARK-40129 legacy multiply behavior, Java-definition remainder, and
+integer-divide's 128-bit overflow check are all reproduced.
+
+Scale conventions: the public API takes Java scales (this package's DType
+convention, fractional digits positive); internally the math runs on cudf
+convention (negated) so the scale algebra matches decimal_utils.cu
+line-for-line in *semantics* (the implementation itself is vectorized
+uint32-limb lane math from ops/int256, not a kernel translation).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.dtype import TypeId
+from . import int256 as i256
+
+# 10^0 .. 10^76 as uint32[77, 8] limbs (the vectorized analog of the
+# pow_ten constant switch, decimal_utils.cu:248-511)
+_POW10_NP = np.zeros((77, 8), dtype=np.uint32)
+for _e in range(77):
+    _v = 10 ** _e
+    for _i in range(8):
+        _POW10_NP[_e, _i] = (_v >> (32 * _i)) & 0xFFFFFFFF
+POW10 = jnp.asarray(_POW10_NP)
+
+
+def _pow10(exp) -> jnp.ndarray:
+    """Gather 10^exp limbs; exp may be per-row int32[n] or a scalar."""
+    return jnp.take(POW10, jnp.asarray(exp, dtype=jnp.int32), axis=0)
+
+
+def precision10(value: jnp.ndarray) -> jnp.ndarray:
+    """Smallest i with 10^i >= |value| (decimal_utils.cu:520-535).
+
+    Returns 77 where |value| > 10^76 (the reference returns -1; callers use
+    it only in overflow comparisons where 77 behaves equivalently)."""
+    a, _ = i256.abs_(value)
+    count = jnp.zeros(value.shape[0], dtype=jnp.int32)
+    for i in range(77):
+        p = jnp.broadcast_to(POW10[i], a.shape)
+        count = count + i256.lt_unsigned(p, a).astype(jnp.int32)
+    return count
+
+
+def _is_greater_than_decimal_38(a: jnp.ndarray) -> jnp.ndarray:
+    """|a| >= 10^38 (decimal_utils.cu:537-542)."""
+    absa, _ = i256.abs_(a)
+    return i256.gte_unsigned(absa, jnp.broadcast_to(POW10[38], a.shape))
+
+
+def _round_from_remainder(q, abs_r, n_neg, d_neg, abs_d):
+    """HALF_UP: increment away from zero when 2|r| >= |d|
+    (decimal_utils.cu:193-225; exact limb math replaces the reference's
+    shift-overflow special case). Takes the remainder's magnitude; the
+    rounding direction comes from the operand signs."""
+    need_inc = i256.gte_unsigned(i256.shift_left_1(abs_r), abs_d)
+    round_down = n_neg ^ d_neg
+    inc = jnp.where(need_inc,
+                    jnp.where(round_down, np.int32(-1), np.int32(1)),
+                    np.int32(0))
+    return i256.add_small(q, inc)
+
+
+def _divide_and_round(n, d):
+    """n / d with HALF_UP rounding (decimal_utils.cu:230-235)."""
+    abs_n, n_neg = i256.abs_(n)
+    abs_d, d_neg = i256.abs_(d)
+    q, r = i256.divmod_unsigned(abs_n, abs_d)
+    q = jnp.where((n_neg ^ d_neg)[:, None], i256.negate(q), q)
+    return _round_from_remainder(q, r, n_neg, d_neg, abs_d)
+
+
+def _integer_divide(n, d):
+    """Truncating division (Java DOWN rounding; decimal_utils.cu:241-246)."""
+    q, _ = i256.divmod_signed(n, d)
+    return q
+
+
+def _set_scale_and_round(data, old_scale_c: int, new_scale_c: int):
+    """Rescale between cudf scales (decimal_utils.cu:544-558)."""
+    if old_scale_c == new_scale_c:
+        return data
+    if new_scale_c < old_scale_c:
+        return i256.multiply(
+            data, _pow10(np.full(data.shape[0], old_scale_c - new_scale_c)))
+    return _divide_and_round(
+        data, _pow10(np.full(data.shape[0], new_scale_c - old_scale_c)))
+
+
+# ---------------------------------------------------------------------------
+# column-level helpers
+# ---------------------------------------------------------------------------
+
+def _check_dec128(col: Column):
+    if col.dtype.id is not TypeId.DECIMAL128:
+        raise TypeError("not a DECIMAL128 column")
+
+
+def _inputs(a: Column, b: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    _check_dec128(a)
+    _check_dec128(b)
+    if a.size != b.size:
+        raise ValueError("inputs have mismatched row counts")
+    return i256.from_i128_limbs(a.data), i256.from_i128_limbs(b.data)
+
+
+def _and_validity(a: Column, b: Column):
+    if a.validity is None and b.validity is None:
+        return None
+    return a.valid_mask() & b.valid_mask()
+
+
+def _result_table(overflow: jnp.ndarray, result_limbs: jnp.ndarray,
+                  a: Column, b: Column, result_dtype: dt.DType) -> Table:
+    validity = _and_validity(a, b)
+    over_col = Column(dt.BOOL8, a.size, data=overflow.astype(jnp.uint8),
+                      validity=validity)
+    if result_dtype.id is TypeId.INT64:
+        lo = (result_limbs[:, 0].astype(jnp.uint64)
+              | (result_limbs[:, 1].astype(jnp.uint64) << np.uint64(32)))
+        data = lo.astype(jnp.int64)
+    else:
+        data = i256.to_i128_limbs(result_limbs)
+    res_col = Column(result_dtype, a.size, data=data, validity=validity)
+    return Table((over_col, res_col))
+
+
+def _check_scale_divisor(source_scale_c: int, target_scale_c: int):
+    if target_scale_c - source_scale_c > 38:
+        raise ValueError("divisor too big")
+
+
+# ---------------------------------------------------------------------------
+# public ops (Java scales at the boundary)
+# ---------------------------------------------------------------------------
+
+def add_decimal128(a: Column, b: Column, target_scale: int) -> Table:
+    return _add_sub(a, b, target_scale, sub=False)
+
+
+def sub_decimal128(a: Column, b: Column, target_scale: int) -> Table:
+    return _add_sub(a, b, target_scale, sub=True)
+
+
+def _add_sub(a: Column, b: Column, target_scale: int, sub: bool) -> Table:
+    """decimal_utils.cu:561-654: rescale both to the finer scale, add, then
+    rescale to the target with rounding; overflow if |result| >= 10^38."""
+    a8, b8 = _inputs(a, b)
+    a_c, b_c, res_c = -a.dtype.scale, -b.dtype.scale, -target_scale
+    inter_c = min(a_c, b_c)
+    a8 = _set_scale_and_round(a8, a_c, inter_c)
+    b8 = _set_scale_and_round(b8, b_c, inter_c)
+    if sub:
+        b8 = i256.negate(b8)
+    s = i256.add(a8, b8)
+    s = _set_scale_and_round(s, inter_c, res_c)
+    overflow = _is_greater_than_decimal_38(s)
+    return _result_table(overflow, s, a, b, dt.decimal128(target_scale))
+
+
+def multiply_decimal128(a: Column, b: Column, product_scale: int,
+                        cast_interim_result: bool = True) -> Table:
+    """decimal_utils.cu:656-735 + :974-1008. cast_interim_result reproduces
+    the SPARK-40129 legacy double-rounding (on by default, matching
+    DecimalUtils.multiply128's 3-arg form)."""
+    a8, b8 = _inputs(a, b)
+    n = a.size
+    a_c, b_c, prod_c = -a.dtype.scale, -b.dtype.scale, -product_scale
+    _check_scale_divisor(a_c + b_c, prod_c)
+
+    product = i256.multiply(a8, b8)
+
+    if cast_interim_result:
+        fdp = precision10(product) - np.int32(38)
+        fdp = jnp.maximum(fdp, 0)
+        product = jnp.where(
+            (fdp > 0)[:, None],
+            _divide_and_round(product, _pow10(fdp)),
+            product)
+        mult_scale = np.int32(a_c + b_c) + fdp
+    else:
+        mult_scale = jnp.full((n,), a_c + b_c, dtype=jnp.int32)
+
+    exponent = np.int32(prod_c) - mult_scale
+    new_precision = precision10(product)
+    overflow_pre = (exponent < 0) & (new_precision - exponent > 38)
+
+    product = i256.multiply(product, _pow10(jnp.maximum(-exponent, 0)))
+    pos_e = jnp.maximum(exponent, 0)
+    product = jnp.where(
+        (pos_e > 0)[:, None],
+        _divide_and_round(product, _pow10(pos_e)),
+        product)
+
+    overflow = overflow_pre | _is_greater_than_decimal_38(product)
+    return _result_table(overflow, product, a, b, dt.decimal128(product_scale))
+
+
+def divide_decimal128(a: Column, b: Column, quotient_scale: int) -> Table:
+    return _divide(a, b, quotient_scale, is_int_div=False)
+
+
+def integer_divide_decimal128(a: Column, b: Column) -> Table:
+    """Spark's `div`: integral divide at scale 0 returning LONG; overflow is
+    judged on the 128-bit quotient (decimal_utils.cu:796-826 int path)."""
+    return _divide(a, b, 0, is_int_div=True)
+
+
+def _divide(a: Column, b: Column, quotient_scale: int, is_int_div: bool) -> Table:
+    """decimal_utils.cu:743-852."""
+    a8, b8 = _inputs(a, b)
+    n = a.size
+    a_c, b_c, quot_c = -a.dtype.scale, -b.dtype.scale, -quotient_scale
+
+    d_zero = i256.is_zero(b8)
+    # guard divisor: zero rows divide by 1, results masked below
+    one = jnp.broadcast_to(POW10[0], b8.shape)
+    d = jnp.where(d_zero[:, None], one, b8)
+
+    n_shift_exp = quot_c - (a_c - b_c)
+
+    if n_shift_exp > 0:
+        # divide first, then shift scale down with rounding
+        q1, _ = i256.divmod_signed(a8, d)
+        divisor = _pow10(np.full(n, n_shift_exp))
+        if is_int_div:
+            result = _integer_divide(q1, divisor)
+        else:
+            result = _divide_and_round(q1, divisor)
+    elif n_shift_exp < -38:
+        # two-step base-10 long division (decimal_utils.cu:796-826)
+        n2 = i256.multiply(a8, jnp.broadcast_to(POW10[38], a8.shape))
+        q1, r1 = i256.divmod_signed(n2, d)
+        remaining = _pow10(np.full(n, -n_shift_exp - 38))
+        result = i256.multiply(q1, remaining)
+        scaled_r = i256.multiply(r1, remaining)
+        q2, r2 = i256.divmod_signed(scaled_r, d)
+        result = i256.add(result, q2)
+        if not is_int_div:
+            abs_d, d_neg = i256.abs_(d)
+            abs_r2, _ = i256.abs_(r2)
+            result = _round_from_remainder(result, abs_r2,
+                                           i256.sign_neg(scaled_r), d_neg,
+                                           abs_d)
+    else:
+        nn = a8
+        if n_shift_exp < 0:
+            nn = i256.multiply(nn, _pow10(np.full(n, -n_shift_exp)))
+        if is_int_div:
+            result = _integer_divide(nn, d)
+        else:
+            result = _divide_and_round(nn, d)
+
+    overflow = _is_greater_than_decimal_38(result) | d_zero
+    result = jnp.where(d_zero[:, None], jnp.zeros_like(result), result)
+    out_dtype = dt.INT64 if is_int_div else dt.decimal128(quotient_scale)
+    return _result_table(overflow, result, a, b, out_dtype)
+
+
+def remainder_decimal128(a: Column, b: Column, remainder_scale: int) -> Table:
+    """Java-definition remainder a - (a // b)*b at the requested scale
+    (decimal_utils.cu:854-968)."""
+    a8, b8 = _inputs(a, b)
+    n = a.size
+    a_c, b_c, rem_c = -a.dtype.scale, -b.dtype.scale, -remainder_scale
+
+    d_zero = i256.is_zero(b8)
+    one = jnp.broadcast_to(POW10[0], b8.shape)
+    d = jnp.where(d_zero[:, None], one, b8)
+
+    d_shift_exp = rem_c - b_c
+    n_shift_exp = rem_c - a_c
+
+    abs_d, _ = i256.abs_(d)
+    if d_shift_exp > 0:
+        abs_d = _divide_and_round(abs_d, _pow10(np.full(n, d_shift_exp)))
+    else:
+        n_shift_exp -= d_shift_exp
+
+    abs_n, n_neg = i256.abs_(a8)
+    if n_shift_exp > 0:
+        q1, _ = i256.divmod_unsigned(abs_n, abs_d)
+        int_div = _integer_divide(q1, _pow10(np.full(n, n_shift_exp)))
+    else:
+        if n_shift_exp < 0:
+            abs_n = i256.multiply(abs_n, _pow10(np.full(n, -n_shift_exp)))
+        int_div, _ = i256.divmod_unsigned(abs_n, abs_d)
+
+    less_n = i256.multiply(int_div, abs_d)
+    if d_shift_exp < 0:
+        less_n = i256.multiply(less_n, _pow10(np.full(n, -d_shift_exp)))
+    res = i256.add(abs_n, i256.negate(less_n))
+    overflow = _is_greater_than_decimal_38(res) | d_zero
+    res = jnp.where(n_neg[:, None], i256.negate(res), res)
+    res = jnp.where(d_zero[:, None], jnp.zeros_like(res), res)
+    return _result_table(overflow, res, a, b, dt.decimal128(remainder_scale))

@@ -489,16 +489,22 @@ def _apply_unit(h, u: _HashUnit, for_xx: bool):
             return _mm_u32(hh, w) if kind == "u32" else _mm_u64(hh, w)
 
     m = max(1, leaf.size)
-    for j in range(max_len):
+    # rolled + bucketed loop: keeps the traced program small for long lists
+    # and caps jit-cache entries as max list length drifts
+    from ..columnar.strings import pad_width
+    trip = pad_width(max_len, 1) if max_len else 0
+
+    def body(j, hh):
         idx = jnp.clip(starts + j, 0, m - 1)
         active = (starts + j) < ends
         if valid is not None:
             active = active & valid
         if leaf_valid is not None:
             active = active & jnp.take(leaf_valid, idx)
-        nh = elem(h, idx)
-        h = jnp.where(active, nh, h)
-    return h
+        nh = elem(hh, idx)
+        return jnp.where(active, nh, hh)
+
+    return lax.fori_loop(0, trip, body, h)
 
 
 def murmur_hash3_32(table: Union[Table, Sequence[Column]],

@@ -1,0 +1,202 @@
+"""Vectorized 256-bit two's-complement integer math on uint32 limbs.
+
+The engine's equivalent of the reference's `chunked256` device struct
+(/root/reference/src/main/cpp/src/decimal_utils.cu:32-118) re-designed for
+the TPU vector unit: a 256-bit row value is `uint32[n, 8]` little-endian
+limbs, and every operation (add, negate, multiply, binary long division,
+compares) runs across all rows as masked lane arithmetic. 32-bit limbs are
+used (not the reference's 64-bit) so partial products fit the TPU-native
+64-bit accumulator exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NLIMBS = 8
+_LO32 = np.uint64(0xFFFFFFFF)
+
+
+def from_int_py(value: int, n: int = 1) -> jnp.ndarray:
+    """Broadcast a python int to uint32[n, 8] two's-complement limbs."""
+    v = value & ((1 << 256) - 1)
+    limbs = [(v >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)]
+    arr = np.tile(np.array(limbs, dtype=np.uint32), (n, 1))
+    return jnp.asarray(arr)
+
+
+def to_int_py(limbs) -> list:
+    """uint32[n, 8] -> list of signed python ints (host/debug path)."""
+    arr = np.asarray(limbs)
+    out = []
+    for row in arr:
+        v = 0
+        for i in range(NLIMBS):
+            v |= int(row[i]) << (32 * i)
+        if v >= (1 << 255):
+            v -= 1 << 256
+        out.append(v)
+    return out
+
+
+def from_i128_limbs(limbs4: jnp.ndarray) -> jnp.ndarray:
+    """Sign-extend uint32[n, 4] (decimal128 storage) to uint32[n, 8]."""
+    n = limbs4.shape[0]
+    sign = ((limbs4[:, 3].astype(jnp.int32) >> 31).astype(jnp.uint32))
+    ext = jnp.broadcast_to(sign[:, None], (n, 4))
+    return jnp.concatenate([limbs4, ext], axis=1)
+
+
+def to_i128_limbs(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Truncate uint32[n, 8] -> uint32[n, 4] (low 128 bits)."""
+    return limbs[:, :4]
+
+
+def sign_neg(limbs: jnp.ndarray) -> jnp.ndarray:
+    """bool[n]: True where the 256-bit value is negative."""
+    return (limbs[:, 7] >> np.uint32(31)) != 0
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b mod 2^256."""
+    acc = jnp.uint64(0)
+    outs = []
+    for i in range(NLIMBS):
+        acc = acc + a[:, i].astype(jnp.uint64) + b[:, i].astype(jnp.uint64)
+        outs.append((acc & _LO32).astype(jnp.uint32))
+        acc = acc >> np.uint64(32)
+    return jnp.stack(outs, axis=1)
+
+
+def add_small(a: jnp.ndarray, v) -> jnp.ndarray:
+    """a + v where v is int32[n] or a scalar (sign-extended)."""
+    n = a.shape[0]
+    v = jnp.broadcast_to(jnp.asarray(v, dtype=jnp.int32), (n,))
+    ext = from_i128_limbs(jnp.stack(
+        [v.astype(jnp.uint32)] + [(v >> 31).astype(jnp.uint32)] * 3, axis=1))
+    return add(a, ext)
+
+
+def negate(a: jnp.ndarray) -> jnp.ndarray:
+    return add_small(~a, 1)
+
+
+def abs_(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(|a|, was_negative)."""
+    neg = sign_neg(a)
+    return jnp.where(neg[:, None], negate(a), a), neg
+
+
+def lt_unsigned(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """bool[n]: a < b as unsigned 256-bit."""
+    lt = jnp.zeros(a.shape[0], dtype=bool)
+    decided = jnp.zeros(a.shape[0], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        ai, bi = a[:, i], b[:, i]
+        lt = jnp.where(~decided & (ai < bi), True, lt)
+        decided = decided | (ai != bi)
+    return lt
+
+
+def gte_unsigned(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return ~lt_unsigned(a, b)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=1)
+
+
+def multiply(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod 2^256 (schoolbook u32 limbs, u64 accumulators).
+
+    Mirrors the truncated 256-bit product semantics of decimal_utils.cu:127.
+    """
+    n = a.shape[0]
+    a64 = a.astype(jnp.uint64)
+    b64 = b.astype(jnp.uint64)
+    out = []
+    carry_cols = jnp.zeros((n,), dtype=jnp.uint64)  # carries into next column
+    for col in range(NLIMBS):
+        acc = carry_cols
+        hi_acc = jnp.zeros((n,), dtype=jnp.uint64)
+        for i in range(col + 1):
+            p = a64[:, i] * b64[:, col - i]
+            acc = acc + (p & _LO32)
+            hi_acc = hi_acc + (p >> np.uint64(32))
+        out.append((acc & _LO32).astype(jnp.uint32))
+        carry_cols = (acc >> np.uint64(32)) + hi_acc
+    return jnp.stack(out, axis=1)
+
+
+def shift_left_1(a: jnp.ndarray) -> jnp.ndarray:
+    """a << 1 mod 2^256."""
+    outs = []
+    carry = jnp.zeros(a.shape[0], dtype=jnp.uint32)
+    for i in range(NLIMBS):
+        outs.append((a[:, i] << np.uint32(1)) | carry)
+        carry = a[:, i] >> np.uint32(31)
+    return jnp.stack(outs, axis=1)
+
+
+def sub_unsigned(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod 2^256."""
+    acc = jnp.int64(0)
+    outs = []
+    for i in range(NLIMBS):
+        acc = acc + a[:, i].astype(jnp.int64) - b[:, i].astype(jnp.int64)
+        outs.append((acc & np.int64(0xFFFFFFFF)).astype(jnp.uint32))
+        acc = acc >> np.int64(32)  # arithmetic: borrow propagates as -1
+    return jnp.stack(outs, axis=1)
+
+
+def divmod_unsigned(n_limbs: jnp.ndarray,
+                    d_limbs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Binary long division of unsigned 256-bit n by unsigned d (d != 0).
+
+    Semantics of decimal_utils.cu:149-169 vectorized: 256 masked
+    shift-compare-subtract steps under lax.fori_loop.
+    Returns (quotient uint32[n,8], remainder uint32[n,8]).
+    """
+    rows = n_limbs.shape[0]
+
+    def body(step, state):
+        q, r = state
+        i = 255 - step
+        block = i // 32
+        bit = i % 32
+        read = (jnp.take(n_limbs, block, axis=1) >> bit.astype(jnp.uint32)) \
+            & np.uint32(1)
+        r = shift_left_1(r)
+        r = r.at[:, 0].set(r[:, 0] | read)
+        ge = gte_unsigned(r, d_limbs)
+        r = jnp.where(ge[:, None], sub_unsigned(r, d_limbs), r)
+        qbit = jnp.where(ge, np.uint32(1) << bit.astype(jnp.uint32),
+                         np.uint32(0))
+        q = q.at[:, block].set(q[:, block] | qbit)
+        return (q, r)
+
+    q0 = jnp.zeros((rows, NLIMBS), dtype=jnp.uint32)
+    r0 = jnp.zeros((rows, NLIMBS), dtype=jnp.uint32)
+    q, r = lax.fori_loop(0, 256, body, (q0, r0))
+    return q, r
+
+
+def divmod_signed(n_limbs: jnp.ndarray,
+                  d_limbs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Signed divide (truncating): quotient sign = xor of signs; remainder
+    takes n's sign (decimal_utils.cu:171-191)."""
+    abs_n, n_neg = abs_(n_limbs)
+    abs_d, d_neg = abs_(d_limbs)
+    q, r = divmod_unsigned(abs_n, abs_d)
+    q = jnp.where((n_neg ^ d_neg)[:, None], negate(q), q)
+    r = jnp.where(n_neg[:, None], negate(r), r)
+    return q, r

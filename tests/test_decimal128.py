@@ -1,0 +1,422 @@
+"""DECIMAL128 arithmetic tests.
+
+Golden vectors are the Spark-generated constants from the reference's
+DecimalUtilsTest.java (/root/reference/src/test/java/com/nvidia/spark/rapids/
+jni/DecimalUtilsTest.java); the int256 limb math is additionally fuzzed
+against exact python big-int arithmetic.
+"""
+
+import decimal
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops import decimal128 as d128
+from spark_rapids_jni_tpu.ops import int256 as i256
+
+decimal.getcontext().prec = 80
+
+
+def dec_col(values):
+    """Build a DECIMAL128 column like cudf's fromDecimals: unified java
+    scale = max fractional digits (negative for E+NN forms)."""
+    decs = [None if v is None else Decimal(v) for v in values]
+    scales = [-d.as_tuple().exponent for d in decs if d is not None]
+    scale = max(scales) if scales else 0
+    return Column.from_pylist(decs, dt.decimal128(scale))
+
+
+def check(table, expected_overflow, expected_values=None):
+    assert table[0].to_pylist() == expected_overflow
+    if expected_values is not None:
+        got = table[1].to_pylist()
+        want = [None if v is None else Decimal(v) for v in expected_values]
+        assert got == want, f"\n got: {got}\nwant: {want}"
+
+
+# ---------------------------------------------------------------------------
+# int256 limb math vs python big ints
+# ---------------------------------------------------------------------------
+
+M256 = 1 << 256
+
+
+def as_signed(v):
+    v &= M256 - 1
+    return v - M256 if v >= (1 << 255) else v
+
+
+def test_int256_add_mul_fuzz():
+    rng = np.random.default_rng(0)
+    vals_a, vals_b = [], []
+    for _ in range(64):
+        bits_a = int(rng.integers(0, 250))
+        bits_b = int(rng.integers(0, 250))
+        a = int(rng.integers(0, 2**62)) << max(0, bits_a - 62)
+        b = int(rng.integers(0, 2**62)) << max(0, bits_b - 62)
+        if rng.random() < 0.5:
+            a = -a
+        if rng.random() < 0.5:
+            b = -b
+        vals_a.append(a)
+        vals_b.append(b)
+    A = np.stack([np.frombuffer(
+        (v & (M256 - 1)).to_bytes(32, "little"), dtype=np.uint32)
+        for v in vals_a])
+    B = np.stack([np.frombuffer(
+        (v & (M256 - 1)).to_bytes(32, "little"), dtype=np.uint32)
+        for v in vals_b])
+    import jax.numpy as jnp
+    A, B = jnp.asarray(A), jnp.asarray(B)
+
+    got_add = i256.to_int_py(i256.add(A, B))
+    want_add = [as_signed(a + b) for a, b in zip(vals_a, vals_b)]
+    assert got_add == want_add
+
+    got_mul = i256.to_int_py(i256.multiply(A, B))
+    want_mul = [as_signed(a * b) for a, b in zip(vals_a, vals_b)]
+    assert got_mul == want_mul
+
+    got_neg = i256.to_int_py(i256.negate(A))
+    assert got_neg == [as_signed(-a) for a in vals_a]
+
+    got_shl = i256.to_int_py(i256.shift_left_1(A))
+    assert got_shl == [as_signed(a << 1) for a in vals_a]
+
+
+def test_int256_divmod_fuzz():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    ns, ds = [], []
+    for _ in range(32):
+        n = int(rng.integers(1, 2**62)) << int(rng.integers(0, 190))
+        d = int(rng.integers(1, 2**62)) << int(rng.integers(0, 64))
+        ns.append(n)
+        ds.append(d)
+    N = jnp.asarray(np.stack([np.frombuffer(
+        n.to_bytes(32, "little"), dtype=np.uint32) for n in ns]))
+    D = jnp.asarray(np.stack([np.frombuffer(
+        d.to_bytes(32, "little"), dtype=np.uint32) for d in ds]))
+    q, r = i256.divmod_unsigned(N, D)
+    got_q, got_r = i256.to_int_py(q), i256.to_int_py(r)
+    for gq, gr, n, d in zip(got_q, got_r, ns, ds):
+        assert gq == n // d and gr == n % d, (n, d)
+
+
+def test_precision10():
+    import jax.numpy as jnp
+    vals = [0, 1, 9, 10, 99, 10**38 - 1, 10**38, -(10**20), 10**76]
+    V = jnp.asarray(np.stack([np.frombuffer(
+        (v & (M256 - 1)).to_bytes(32, "little"), dtype=np.uint32)
+        for v in vals]))
+    got = list(np.asarray(d128.precision10(V)))
+    want = [0, 0, 1, 1, 2, 38, 38, 20, 76]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# multiply (DecimalUtilsTest.java:42-189, 572-580)
+# ---------------------------------------------------------------------------
+
+def test_multiply_simple_pos_one_by_zero():
+    t = d128.multiply_decimal128(
+        dec_col(["1.0", "10.0", "1000000000000000000000000000000000000.0"]),
+        dec_col(["1", "1", "1"]), 1)
+    check(t, [False] * 3,
+          ["1.0", "10.0", "1000000000000000000000000000000000000.0"])
+
+
+def test_multiply_simple_pos_one_by_one():
+    t = d128.multiply_decimal128(dec_col(["1.0", "3.7"]),
+                                 dec_col(["1.0", "1.5"]), 1)
+    check(t, [False, False], ["1.0", "5.6"])
+
+
+def test_multiply_zero_by_neg_one_scale():
+    t = d128.multiply_decimal128(dec_col(["1"]), dec_col(["1e1"]), 1)
+    check(t, [False], ["10.0"])
+
+
+def test_multiply_without_interim_cast():
+    t = d128.multiply_decimal128(
+        dec_col(["-8533444864753048107770677711.1312637916"]),
+        dec_col(["-12.0000000000"]), 6, cast_interim_result=False)
+    check(t, [False], ["102401338377036577293248132533.575165"])
+
+
+def test_multiply_large_pos_ten_by_ten():
+    t = d128.multiply_decimal128(
+        dec_col(["577694940161436285811555447.3103121126"]),
+        dec_col(["100.0000000000"]), 6)
+    check(t, [False], ["57769494016143628581155544731.031211"])
+
+
+def test_multiply_overflow():
+    t = d128.multiply_decimal128(
+        dec_col(["577694938495380589068894346.7625198736"]),
+        dec_col(["-1258508260891400005608241690.1564700995"]), 6)
+    check(t, [True])
+
+
+def test_multiply_neg():
+    t = d128.multiply_decimal128(dec_col(["1.0", "-1.0", "3.7"]),
+                                 dec_col(["-1.0", "-1.0", "-1.5"]), 1)
+    check(t, [False] * 3, ["-1.0", "1.0", "-5.6"])
+
+
+def test_multiply_spark_compat_interim_cast():
+    # SPARK-40129 legacy double-rounding (DecimalUtilsTest.java:164-189)
+    t = d128.multiply_decimal128(
+        dec_col(["3358377338823096511784947656.4650294583",
+                 "7161021785186010157110137546.5940777916",
+                 "9173594185998001607642838421.5479932913"]),
+        dec_col(["-12.0000000000"] * 3), 6)
+    check(t, [False] * 3,
+          ["-40300528065877158141419371877.580354",
+           "-85932261422232121885321650559.128933",
+           "-110083130231976019291714061058.575920"])
+
+
+def test_multiply_overflow_scale0():
+    t = d128.multiply_decimal128(
+        dec_col(["50000000000000000000000000000000000000"]),
+        dec_col(["2"]), 0)
+    check(t, [True])
+
+
+# ---------------------------------------------------------------------------
+# divide (DecimalUtilsTest.java:191-205, 305-418)
+# ---------------------------------------------------------------------------
+
+def test_divide_simple_pos_with_div_by_zero():
+    t = d128.divide_decimal128(
+        dec_col(["1.0", "10.0", "1.0", "1000000000000000000000000000000000000.0"]),
+        dec_col(["1", "2", "0", "5"]), 1)
+    assert t[0].to_pylist() == [False, False, True, False]
+    vals = t[1].to_pylist()
+    assert vals[0] == Decimal("1.0") and vals[1] == Decimal("5.0")
+    assert vals[2] == Decimal("0")
+    assert vals[3] == Decimal("200000000000000000000000000000000000.0")
+
+
+def test_divide_simple():
+    t = d128.divide_decimal128(dec_col(["1.0", "3.7", "99.9"]),
+                               dec_col(["1.0", "1.5", "4.5"]), 1)
+    check(t, [False] * 3, ["1.0", "2.5", "22.2"])
+
+
+def test_divide_neg():
+    t = d128.divide_decimal128(dec_col(["1.0", "-3.7", "-99.9"]),
+                               dec_col(["-1.0", "1.5", "-4.5"]), 1)
+    check(t, [False] * 3, ["-1.0", "-2.5", "22.2"])
+
+
+def test_divide_complex():
+    t = d128.divide_decimal128(
+        dec_col(["100000000000000000000000000000000"]),
+        dec_col(["3.0000000000000000000000000000000000000"]), 6)
+    check(t, [False], ["33333333333333333333333333333333.333333"])
+
+
+def test_div17():
+    t = d128.divide_decimal128(
+        dec_col(["1454.48287885760884146", "3655.54438423288356646"]),
+        dec_col(["100.00000000000000000"] * 2), 17)
+    check(t, [False, False], ["14.54482878857608841", "36.55544384232883566"])
+
+
+def test_div17_pos_scale():
+    t = d128.divide_decimal128(dec_col(["1454.48287885760884146"]),
+                               dec_col(["1e2"]), 17)
+    check(t, [False], ["14.54482878857608841"])
+
+
+def test_div21_pos_scale():
+    t = d128.divide_decimal128(
+        dec_col(["5776949401614362.858115554473103121126"]),
+        dec_col(["1e2"]), 6)
+    check(t, [False], ["57769494016143.628581"])
+
+
+def test_div21():
+    t = d128.divide_decimal128(
+        dec_col(["60250054953505368.439892586764888491018",
+                 "91910085134512953.335347579448489062875",
+                 "51312633107598808.869351260608653423886"]),
+        dec_col(["97982875273794447.385070145919990343867",
+                 "94478503341597285.814104936062234698349",
+                 "92266075543848323.800466593082956765923"]), 6)
+    check(t, [False] * 3, ["0.614904", "0.972815", "0.556138"])
+
+
+# ---------------------------------------------------------------------------
+# integer divide (DecimalUtilsTest.java:207-247)
+# ---------------------------------------------------------------------------
+
+def test_int_divide():
+    t = d128.integer_divide_decimal128(
+        dec_col(["3396191716868766147341919609.06",
+                 "-6893798181986328848375556144.67"]),
+        dec_col(["7317548469.64", "98565515088.44"]))
+    assert t[0].to_pylist() == [False, False]
+    assert t[1].dtype.id is dt.TypeId.INT64
+    assert t[1].to_pylist() == [464116053478747633, -69941278912819784]
+
+
+def test_int_divide_not_overflow():
+    # overflow judged on the 128-bit quotient, not the returned long
+    t = d128.integer_divide_decimal128(
+        dec_col(["451635271134476686911387864.48",
+                 "5313675970270560086329837153.18"]),
+        dec_col(["-961.110", "181.958"]))
+    assert t[0].to_pylist() == [False, False]
+    assert t[1].to_pylist() == [2284624887606872042, -2928582767902049472]
+
+
+def test_int_divide_by_zero_overflow():
+    t = d128.integer_divide_decimal128(
+        dec_col(["-999999999999999999999999999999999999.99",
+                 "999999999999999999999999999999999999.99"]),
+        dec_col(["0", "0"]))
+    assert t[0].to_pylist() == [True, True]
+
+
+# ---------------------------------------------------------------------------
+# remainder (DecimalUtilsTest.java:249-303)
+# ---------------------------------------------------------------------------
+
+def test_remainder1():
+    v = "2775750723350045263458396405825339066"
+    d = "4890990637589340307512622401149178814.1"
+    t = d128.remainder_decimal128(
+        dec_col([v, v, "-" + v, "-" + v]),
+        dec_col(["-" + d, d, "-" + d, d]), 1)
+    check(t, [False] * 4, [v + ".0", v + ".0", "-" + v + ".0", "-" + v + ".0"])
+
+
+def test_remainder2():
+    t = d128.remainder_decimal128(
+        dec_col(["-80968577325845461854951721352418610.13",
+                 "-80968577325845461854951721352418610.13",
+                 "-66686472768705331734321352506496901.71"]),
+        dec_col(["6749200345857154099505910298895800952.1",
+                 "-6749200345857154099505910298895800952.1",
+                 "-43880265997097383351377368851255372.5"]), 2)
+    check(t, [False] * 3,
+          ["-80968577325845461854951721352418610.13",
+           "-80968577325845461854951721352418610.13",
+           "-22806206771607948382943983655241529.21"])
+
+
+def test_remainder7():
+    t = d128.remainder_decimal128(
+        dec_col(["5776949384953805890688943467625198736"]),
+        dec_col(["-67337920196996830.354487679299"]), 7)
+    check(t, [False], ["16310460742282291.8108019"])
+
+
+def test_remainder10():
+    t = d128.remainder_decimal128(
+        dec_col(["5776949384953805890688943467625198736"]),
+        dec_col(["-6733792019699683035.4487679299"]), 10)
+    check(t, [False], ["3585222007130884413.9709383255"])
+
+
+# ---------------------------------------------------------------------------
+# add / sub (DecimalUtilsTest.java:426-647)
+# ---------------------------------------------------------------------------
+
+def test_add_overflow_scale_neg10():
+    t = d128.add_decimal128(
+        dec_col(["9191008513307131620269245301.1615457290",
+                 "-9191008513307131620269245301.1615457290"]),
+        dec_col(["9447850332473678680446404122.5624623187",
+                 "-9447850332473678680446404122.5624623187"]), 10)
+    assert t[0].to_pylist() == [True, True]
+
+
+def test_add_different_scales():
+    lhs = dec_col(["9191008513307131620269245301.1615457290",
+                   "-9191008513307131620269245301.1615457290",
+                   "577694938495380589068894346.7625198736",
+                   "-7949989536398283250841565918.6123449781",
+                   "-569260079419403643627836417.1451349695",
+                   "4268696962649098725873162852.3422176564",
+                   "948521076935839001259204571.1574829065",
+                   "-9299778357834801251892834048.0026057082",
+                   "8127384240098008972235509102.7063990819",
+                   "-1012433127481465711031073593.0625063701"])
+    rhs = dec_col(["451635271134476686911387864.48",
+                   "-9037370400215680718822505020.06",
+                   "-200173438757934601210092407.67",
+                   "3022290197578200820919308997.64",
+                   "388221337108432989001879408.73",
+                   "-9119163961520067341639997328.82",
+                   "7732813484881363300406806463.83",
+                   "5941454871287785414686091453.79",
+                   "-357209139972312354271434821.33",
+                   "-857448828702886587693936536.21"])
+    t = d128.add_decimal128(lhs, rhs, 9)
+    check(t, [False] * 10,
+          ["9642643784441608307180633165.641545729",
+           "-18228378913522812339091750321.221545729",
+           "377521499737445987858801939.092519874",
+           "-4927699338820082429922256920.972344978",
+           "-181038742310970654625957008.415134970",
+           "-4850466998870968615766834476.477782344",
+           "8681334561817202301666011034.987482907",
+           "-3358323486547015837206742594.212605708",
+           "7770175100125696617964074281.376399082",
+           "-1869881956184352298725010129.272506370"])
+
+
+def test_add_precision38_scale_minus5_with_null():
+    lhs = dec_col(["4.2701861951571908374098848594277520E+39",
+                   "-9.51477182371612065851896242097995638E+40",
+                   "-2.0167866914929483784509827485383359E+39",
+                   None])
+    rhs = dec_col(["-7.4015414116488076297669800353634627E+39",
+                   "8.26223612055178995785348949126553327E+40",
+                   "3.27796298399180383738215644697505864E+40",
+                   "-1.0688816822936864401341690563696501E+39"])
+    t = d128.add_decimal128(lhs, rhs, -5)
+    check(t, [False, False, False, None],
+          ["-3.1313552164916167923570951759357107E+39",
+           "-1.25253570316433070066547292971442311E+40",
+           "3.07628431484250899953705817212122505E+40",
+           None])
+
+
+def test_add_sub_overflow_scale0():
+    t = d128.add_decimal128(
+        dec_col(["99999999999999999999999999999999999999"]),
+        dec_col(["1"]), 0)
+    assert t[0].to_pylist() == [True]
+    t = d128.sub_decimal128(
+        dec_col(["-99999999999999999999999999999999999999"]),
+        dec_col(["1"]), 0)
+    assert t[0].to_pylist() == [True]
+
+
+def test_sub_simple():
+    t = d128.sub_decimal128(dec_col(["5.00", "1.23"]),
+                            dec_col(["1.50", "0.03"]), 2)
+    check(t, [False, False], ["3.50", "1.20"])
+
+
+def test_nulls_propagate():
+    t = d128.multiply_decimal128(
+        Column.from_pylist([Decimal("1.0"), None], dt.decimal128(1)),
+        Column.from_pylist([Decimal("2.0"), Decimal("3.0")], dt.decimal128(1)),
+        1)
+    assert t[0].to_pylist() == [False, None]
+    assert t[1].to_pylist() == [Decimal("2.0"), None]
+
+
+def test_non_decimal_rejected():
+    c = Column.from_pylist([1], dt.INT64)
+    with pytest.raises(TypeError, match="DECIMAL128"):
+        d128.multiply_decimal128(c, c, 0)
