@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,7 +43,15 @@ POW10 = jnp.asarray(_POW10_NP)
 
 
 def _pow10(exp) -> jnp.ndarray:
-    """Gather 10^exp limbs; exp may be per-row int32[n] or a scalar."""
+    """Gather 10^exp limbs; exp may be per-row int32[n] or a scalar.
+
+    Host-known exponents are range-checked (the reference's pow_ten asserts
+    on exp outside [0, 76], decimal_utils.cu:507-510); traced per-row
+    exponents are bounded by construction (precision10 <= 77)."""
+    if not isinstance(exp, jax.core.Tracer):
+        arr = np.asarray(exp)
+        if arr.size and (int(arr.min()) < 0 or int(arr.max()) > 76):
+            raise ValueError("pow10 exponent out of supported range [0, 76]")
     return jnp.take(POW10, jnp.asarray(exp, dtype=jnp.int32), axis=0)
 
 

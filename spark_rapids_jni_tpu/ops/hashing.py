@@ -37,7 +37,7 @@ from jax import lax
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
-from ..columnar.strings import padded_bytes
+from ..columnar.strings import pad_width, padded_bytes
 
 DEFAULT_MURMUR_SEED = 42  # Hash.java:33
 DEFAULT_XXHASH64_SEED = 42  # hash.cuh:28
@@ -491,7 +491,6 @@ def _apply_unit(h, u: _HashUnit, for_xx: bool):
     m = max(1, leaf.size)
     # rolled + bucketed loop: keeps the traced program small for long lists
     # and caps jit-cache entries as max list length drifts
-    from ..columnar.strings import pad_width
     trip = pad_width(max_len, 1) if max_len else 0
 
     def body(j, hh):

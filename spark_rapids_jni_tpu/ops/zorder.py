@@ -1,0 +1,143 @@
+"""Z-order bit interleaving and Hilbert curve indices (Delta OPTIMIZE
+ZORDER BY support).
+
+Capability parity with the reference's zorder.cu (interleave_bits :138,
+hilbert_index :224; transposed-index algorithm after David Moten's
+hilbert-curve / Skilling's "Programming the Hilbert curve" :66-132).
+
+TPU-first: the byte-gather device lambda becomes a whole-column bit-matrix
+transpose — expand each column to an [n, nbits] MSB-first bit matrix, stack
+bit-major x column-minor, and pack back to bytes; the Hilbert state loops
+run as masked vector ops over all rows with the (static) bit/dimension
+loops unrolled at trace time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.dtype import TypeId
+
+_UINT_FOR_SIZE = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def _as_unsigned_bits(col: Column) -> jnp.ndarray:
+    """Column values as unsigned ints of the same width; null rows -> 0."""
+    size = col.dtype.itemsize
+    if size not in _UINT_FOR_SIZE:  # DECIMAL128 and other multi-part layouts
+        raise TypeError("Only flat fixed width columns can be used")
+    target = _UINT_FOR_SIZE[size]
+    data = col.data
+    if data.dtype.kind == "f":
+        data = lax.bitcast_convert_type(data, target)
+    else:
+        data = data.astype(target)  # same-width int -> uint is a bitcast
+    if col.validity is not None:
+        data = jnp.where(col.validity, data, target(0))
+    return data
+
+
+def interleave_bits(table: Union[Table, Sequence[Column]]) -> Column:
+    """Interleave the bits of n same-typed fixed-width columns, column 0
+    most significant, into a LIST<UINT8> binary column (zorder.cu:138-222;
+    semantics of deltalake's interleaveBits)."""
+    cols = tuple(table.columns if isinstance(table, Table) else table)
+    if not cols:
+        raise ValueError("The input table must have at least one column.")
+    if any(not c.dtype.is_fixed_width for c in cols):
+        raise TypeError("Only fixed width columns can be used")
+    tid = cols[0].dtype.id
+    if any(c.dtype.id is not tid for c in cols):
+        raise TypeError("All columns of the input table must be the same type.")
+
+    n = cols[0].size
+    ncols = len(cols)
+    nbits = cols[0].dtype.itemsize * 8
+    stride = cols[0].dtype.itemsize * ncols
+
+    # [n, ncols, nbits] MSB-first bit planes
+    shifts = jnp.arange(nbits - 1, -1, -1, dtype=jnp.uint32)
+    planes = []
+    for c in cols:
+        u = _as_unsigned_bits(c).astype(jnp.uint64)
+        planes.append(((u[:, None] >> shifts[None, :].astype(jnp.uint64))
+                       & np.uint64(1)).astype(jnp.uint8))
+    bits = jnp.stack(planes, axis=2)            # [n, nbits, ncols]
+    flat = bits.reshape(n, nbits * ncols) if n else jnp.zeros(
+        (0, nbits * ncols), dtype=jnp.uint8)
+
+    # pack MSB-first into bytes
+    byte_weights = (jnp.uint8(1) << jnp.arange(7, -1, -1, dtype=jnp.uint8))
+    packed = (flat.reshape(n, stride, 8) * byte_weights[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32).astype(jnp.uint8)
+
+    child = Column(dt.UINT8, n * stride, data=packed.reshape(-1))
+    offsets = jnp.arange(n + 1, dtype=jnp.int32) * stride
+    return Column.list_of(child, offsets)
+
+
+def hilbert_index(num_bits: int, table: Union[Table, Sequence[Column]]) -> Column:
+    """d-dimensional Hilbert index of INT32 columns -> INT64
+    (zorder.cu:224-273)."""
+    cols = tuple(table.columns if isinstance(table, Table) else table)
+    ncols = len(cols)
+    if not (0 < num_bits <= 32):
+        raise ValueError("the number of bits must be >0 and <= 32.")
+    if num_bits * ncols > 64:
+        raise ValueError("we only support up to 64 bits of output right now.")
+    if ncols == 0:
+        raise ValueError("at least one column is required.")
+    if any(c.dtype.id is not TypeId.INT32 for c in cols):
+        raise TypeError("All columns of the input table must be INT32.")
+
+    n = cols[0].size
+    mask_entry = np.uint32((1 << num_bits) - 1)
+    x: List[jnp.ndarray] = [
+        (_as_unsigned_bits(c).astype(jnp.uint32) & mask_entry) for c in cols]
+
+    # inverse undo (zorder.cu:105-116)
+    q = np.uint32(1 << (num_bits - 1))
+    while q > 1:
+        p = np.uint32(q - 1)
+        for i in range(ncols):
+            cond = (x[i] & q) != 0
+            t = (x[0] ^ x[i]) & p
+            x_i_else = x[i] ^ t
+            x0_else = x[0] ^ t
+            x0_if = x[0] ^ p
+            new_x0 = jnp.where(cond, x0_if, x0_else)
+            if i == 0:
+                x[0] = new_x0
+            else:
+                x[i] = jnp.where(cond, x[i], x_i_else)
+                x[0] = new_x0
+        q = np.uint32(q >> 1)
+
+    # gray encode (zorder.cu:119-129)
+    for i in range(1, ncols):
+        x[i] = x[i] ^ x[i - 1]
+    t = jnp.zeros((n,), dtype=jnp.uint32)
+    q = np.uint32(1 << (num_bits - 1))
+    while q > 1:
+        t = jnp.where((x[ncols - 1] & q) != 0, t ^ np.uint32(q - 1), t)
+        q = np.uint32(q >> 1)
+    for i in range(ncols):
+        x[i] = x[i] ^ t
+
+    # transposed index -> single integer, MSB-first (zorder.cu:74-91)
+    b = jnp.zeros((n,), dtype=jnp.uint64)
+    b_index = num_bits * ncols - 1
+    for i in range(num_bits):
+        mask = np.uint32(1 << (num_bits - 1 - i))
+        for j in range(ncols):
+            bit = ((x[j] & mask) != 0).astype(jnp.uint64)
+            b = b | (bit << np.uint64(b_index))
+            b_index -= 1
+    return Column(dt.INT64, n, data=b.astype(jnp.int64))

@@ -1,0 +1,79 @@
+"""Datetime rebase golden tests (reference:
+src/main/cpp/tests/datetime_rebase.cpp, values generated from Spark's
+rebase functions)."""
+
+import numpy as np
+
+from spark_rapids_jni_tpu import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.datetime_rebase import (
+    rebase_gregorian_to_julian, rebase_julian_to_gregorian)
+
+
+def days(vals):
+    return Column.from_pylist(vals, dt.TIMESTAMP_DAYS)
+
+
+def micros(vals):
+    return Column.from_pylist(vals, dt.TIMESTAMP_MICROSECONDS)
+
+
+GREG_DAYS = [-719162, -354285, -141714, -141438, -141437, -141432, -141427,
+             -31463, -31453, -1, 0, 18335]
+JULIAN_DAYS = [-719164, -354280, -141704, -141428, -141427, -141427, -141427,
+               -31463, -31453, -1, 0, 18335]
+
+
+def test_rebase_days_to_julian():
+    got = rebase_gregorian_to_julian(days(GREG_DAYS)).to_pylist()
+    assert got == JULIAN_DAYS
+
+
+def test_rebase_days_to_gregorian():
+    got = rebase_julian_to_gregorian(days(JULIAN_DAYS)).to_pylist()
+    # days in the cutover gap collapse to the Gregorian start day
+    assert got == [-719162, -354285, -141714, -141438, -141427, -141427,
+                   -141427, -31463, -31453, -1, 0, 18335]
+
+
+def test_rebase_days_negative_years():
+    greg = [-1121294, -1100777, -735535]
+    julian = [-1121305, -1100787, -735537]
+    assert rebase_gregorian_to_julian(days(greg)).to_pylist() == julian
+    assert rebase_julian_to_gregorian(days(julian)).to_pylist() == greg
+
+
+GREG_MICROS = [-62135593076345679, -30610213078876544, -12244061221876544,
+               -12220243200000000, -12219639001448163, -12219292799000001,
+               -45446999900, 1, 1584178381500000]
+JULIAN_MICROS = [-62135765876345679, -30609781078876544, -12243197221876544,
+                 -12219379200000000, -12219207001448163, -12219292799000001,
+                 -45446999900, 1, 1584178381500000]
+
+
+def test_rebase_micros_to_julian():
+    got = rebase_gregorian_to_julian(micros(GREG_MICROS)).to_pylist()
+    assert got == JULIAN_MICROS
+
+
+def test_rebase_micros_to_gregorian():
+    got = rebase_julian_to_gregorian(micros(JULIAN_MICROS)).to_pylist()
+    assert got == [-62135593076345679, -30610213078876544, -12244061221876544,
+                   -12220243200000000, -12219207001448163, -12219292799000001,
+                   -45446999900, 1, 1584178381500000]
+
+
+def test_rebase_micros_negative_years():
+    greg = [-93755660276345679, -219958671476876544, -62188210676345679]
+    julian = [-93756524276345679, -219962127476876544, -62188383476345679]
+    assert rebase_gregorian_to_julian(micros(greg)).to_pylist() == julian
+    assert rebase_julian_to_gregorian(micros(julian)).to_pylist() == greg
+
+
+def test_nulls_and_types():
+    c = Column.from_pylist([0, None, 18335], dt.TIMESTAMP_DAYS)
+    out = rebase_gregorian_to_julian(c)
+    assert out.to_pylist() == [0, None, 18335]
+    import pytest
+    with pytest.raises(TypeError):
+        rebase_gregorian_to_julian(Column.from_pylist([1], dt.INT64))

@@ -1,0 +1,113 @@
+"""Timezone conversion golden tests (reference:
+src/main/cpp/tests/timezones.cpp — a 2-zone transitions table where zone 1
+resembles Asia/Shanghai history)."""
+
+import pytest
+
+from spark_rapids_jni_tpu import Column
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.ops.timezones import (
+    INT64_MIN, convert_timestamp_to_utc, convert_utc_timestamp_to_timezone,
+    load_fixed_offset_zones, make_transition_table)
+
+UTC_INSTANTS = [INT64_MIN, -1585904400, -933667200, -922093200, -908870400,
+                -888829200, -650019600, 515527200, 558464400, 684867600]
+TZ_INSTANTS = [INT64_MIN, -1585904400, -933634800, -922064400, -908838000,
+               -888796800, -649990800, 515559600, 558493200, 684896400]
+OFFSETS = [29143, 28800, 32400, 28800, 32400, 28800, 28800, 32400, 28800,
+           28800]
+
+
+@pytest.fixture(scope="module")
+def table():
+    zone0 = [(INT64_MIN, INT64_MIN, 18000)]
+    zone1 = list(zip(UTC_INSTANTS, TZ_INSTANTS, OFFSETS))
+    return make_transition_table([zone0, zone1], ["Fixed5", "TestZone"])
+
+
+TS_LOCAL = [-1262260800, -908838000, -908840700, -888800400, -888799500,
+            -888796800, 0, 1699566167, 568036800]
+TS_UTC = [-1262289600, -908870400, -908869500, -888832800, -888831900,
+          -888825600, -28800, 1699537367, 568008000]
+
+
+@pytest.mark.parametrize("unit,factor", [
+    (dt.TIMESTAMP_SECONDS, 1),
+    (dt.TIMESTAMP_MILLISECONDS, 1000),
+    (dt.TIMESTAMP_MICROSECONDS, 1000000),
+])
+def test_convert_to_utc(table, unit, factor):
+    extra = 634312 % factor  # mirrors the reference's non-round test values
+    vals = [v * factor for v in TS_LOCAL]
+    want = [v * factor for v in TS_UTC]
+    c = Column.from_pylist(vals, unit)
+    got = convert_timestamp_to_utc(c, table, 1).to_pylist()
+    assert got == want
+
+
+@pytest.mark.parametrize("unit,factor", [
+    (dt.TIMESTAMP_SECONDS, 1),
+    (dt.TIMESTAMP_MILLISECONDS, 1000),
+    (dt.TIMESTAMP_MICROSECONDS, 1000000),
+])
+def test_convert_from_utc(table, unit, factor):
+    # the reference's from-UTC input (timezones.cpp:179-187): index 6 is 0
+    src = TS_UTC[:6] + [0] + TS_UTC[7:]
+    vals = [v * factor for v in src]
+    want = [-1262260800, -908838000, -908837100, -888800400, -888799500,
+            -888796800, 28800, 1699566167, 568036800]
+    want = [v * factor for v in want]
+    c = Column.from_pylist(vals, unit)
+    got = convert_utc_timestamp_to_timezone(c, table, 1).to_pylist()
+    assert got == want
+
+
+def test_subunit_precision(table):
+    # 1699571634312 ms local -> utc keeps the .312 ms part
+    c = Column.from_pylist([1699571634312], dt.TIMESTAMP_MILLISECONDS)
+    got = convert_timestamp_to_utc(c, table, 1).to_pylist()
+    assert got == [1699542834312]
+    c = Column.from_pylist([1699542834312], dt.TIMESTAMP_MILLISECONDS)
+    got = convert_utc_timestamp_to_timezone(c, table, 1).to_pylist()
+    assert got == [1699571634312]
+
+
+def test_fixed_zone_loading():
+    table = load_fixed_offset_zones(["UTC", "Asia/Shanghai"])
+    c = Column.from_pylist([0, 1699566167], dt.TIMESTAMP_SECONDS)
+    got = convert_timestamp_to_utc(c, table, table.index_of("Asia/Shanghai"))
+    assert got.to_pylist() == [-28800, 1699537367]
+    got = convert_timestamp_to_utc(c, table, table.index_of("UTC"))
+    assert got.to_pylist() == [0, 1699566167]
+
+
+def test_historical_transitions_loaded():
+    # Asia/Kolkata is fixed-offset today but was +5:53:20 before 1945; the
+    # TZif loader must carry the full history like GpuTimeZoneDB
+    from spark_rapids_jni_tpu.ops.timezones import load_zones
+    import datetime
+    import zoneinfo
+    tb = load_zones(["Asia/Kolkata"])
+    probes = [-1577905200, -946771200, 0, 1700000000]
+    c = Column.from_pylist(probes, dt.TIMESTAMP_SECONDS)
+    got = convert_utc_timestamp_to_timezone(c, tb, 0).to_pylist()
+    tz = zoneinfo.ZoneInfo("Asia/Kolkata")
+    for p, g in zip(probes, got):
+        off = int(tz.utcoffset(datetime.datetime.fromtimestamp(
+            p, datetime.timezone.utc)).total_seconds())
+        assert g == p + off, p
+
+
+def test_dst_zone_rejected():
+    with pytest.raises(ValueError, match="recurring"):
+        load_fixed_offset_zones(["America/New_York"])
+
+
+def test_sentinel_required():
+    with pytest.raises(ValueError, match="sentinel"):
+        make_transition_table([[(0, 0, 3600)]])
+
+
+def test_nulls_propagate(table):
+    c = Column.from_pylist([0, None], dt.TIMESTAMP_SECONDS)
+    assert convert_timestamp_to_utc(c, table, 0).to_pylist() == [-18000, None]
