@@ -1,0 +1,145 @@
+"""Vectorized two's-complement 128-bit integer math on 4xuint32 limbs.
+
+Columns of DECIMAL128 store `uint32[n, 4]` little-endian limbs (see
+columnar/column.py). This module provides the small-op vocabulary the
+string→decimal cast needs inside its per-character scan: multiply by 10,
+add a small signed value, and signed comparisons against type limits —
+all as XLA vector ops over the row axis (no 128-bit scalar types needed).
+
+The wider 256-bit vocabulary used by decimal arithmetic lives in int256.py;
+this module is deliberately tiny so scan bodies stay fusible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 4
+_LO32 = np.uint64(0xFFFFFFFF)
+_MASK128 = (1 << 128) - 1
+
+
+def from_int_py(value: int, n: int) -> jnp.ndarray:
+    """Broadcast a python int to [n, 4] two's-complement limbs."""
+    v = value & _MASK128
+    limbs = np.array([(v >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)],
+                     dtype=np.uint32)
+    return jnp.broadcast_to(jnp.asarray(limbs), (n, NLIMBS))
+
+
+def limbs_const(value: int) -> np.ndarray:
+    v = value & _MASK128
+    return np.array([(v >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)],
+                    dtype=np.uint32)
+
+
+def zeros(n: int) -> jnp.ndarray:
+    return jnp.zeros((n, NLIMBS), dtype=jnp.uint32)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def is_negative(a: jnp.ndarray) -> jnp.ndarray:
+    return (a[..., NLIMBS - 1] >> np.uint32(31)) != 0
+
+
+def negate(a: jnp.ndarray) -> jnp.ndarray:
+    """Two's-complement negation (~a + 1) with carry propagation."""
+    inv = (~a).astype(jnp.uint64)
+    out = []
+    carry = jnp.ones(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(NLIMBS):
+        s = inv[..., i] + carry
+        out.append((s & _LO32).astype(jnp.uint32))
+        carry = s >> np.uint64(32)
+    return jnp.stack(out, axis=-1)
+
+
+def abs_(a: jnp.ndarray) -> jnp.ndarray:
+    neg = is_negative(a)
+    return jnp.where(neg[..., None], negate(a), a)
+
+
+def mul10(a: jnp.ndarray) -> jnp.ndarray:
+    """a * 10 mod 2**128 (works for two's-complement signed values)."""
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    ten = np.uint64(10)
+    for i in range(NLIMBS):
+        p = a[..., i].astype(jnp.uint64) * ten + carry
+        out.append((p & _LO32).astype(jnp.uint32))
+        carry = p >> np.uint64(32)
+    return jnp.stack(out, axis=-1)
+
+
+def add_small(a: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """a + d where d is an int32/int64 vector in a small range (sign-extended
+    to 128 bits before the add)."""
+    d64 = d.astype(jnp.int64)
+    ext = jnp.where(d64 < 0, _LO32, np.uint64(0))  # sign extension limb
+    dl = [(d64.astype(jnp.uint64) & _LO32),
+          ((d64.astype(jnp.uint64) >> np.uint64(32)) & _LO32),
+          ext, ext]
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(NLIMBS):
+        s = a[..., i].astype(jnp.uint64) + dl[i] + carry
+        out.append((s & _LO32).astype(jnp.uint32))
+        carry = s >> np.uint64(32)
+    return jnp.stack(out, axis=-1)
+
+
+def _flip_top(a: jnp.ndarray) -> jnp.ndarray:
+    """XOR the sign bit so signed order becomes unsigned lexicographic order."""
+    return a.at[..., NLIMBS - 1].set(a[..., NLIMBS - 1] ^ np.uint32(0x80000000))
+
+
+def lt_unsigned(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in range(NLIMBS):  # little-endian: compare from least significant
+        lt = jnp.where(a[..., i] == b[..., i], lt, a[..., i] < b[..., i])
+    return lt
+
+
+def lt_signed(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return lt_unsigned(_flip_top(a), _flip_top(b))
+
+
+def gt_signed(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return lt_signed(b, a)
+
+
+_POW10_TABLE = np.stack([limbs_const(10 ** k) for k in range(39)])  # [39, 4]
+
+
+def ndigits(a: jnp.ndarray) -> jnp.ndarray:
+    """Decimal digit count of |a| (0 for a == 0), matching the reference's
+    count_digits loop (decimal_utils-style)."""
+    mag = abs_(a)  # [n, 4]
+    count = jnp.zeros(a.shape[:-1], dtype=jnp.int32)
+    for k in range(39):
+        tbl = jnp.broadcast_to(jnp.asarray(_POW10_TABLE[k]), mag.shape)
+        gte = ~lt_unsigned(mag, tbl)
+        count = count + gte.astype(jnp.int32)
+    return count
+
+
+def to_int64(a: jnp.ndarray) -> jnp.ndarray:
+    """Truncate limbs to int64 (valid when the value fits)."""
+    lo = a[..., 0].astype(jnp.uint64) | (a[..., 1].astype(jnp.uint64) << np.uint64(32))
+    return lo.astype(jnp.int64)
+
+
+def from_int64(v: jnp.ndarray) -> jnp.ndarray:
+    """Sign-extend an int64 vector to [.., 4] limbs."""
+    v64 = v.astype(jnp.int64)
+    u = v64.astype(jnp.uint64)
+    ext = jnp.where(v64 < 0, np.uint32(0xFFFFFFFF), np.uint32(0))
+    return jnp.stack([
+        (u & _LO32).astype(jnp.uint32),
+        ((u >> np.uint64(32)) & _LO32).astype(jnp.uint32),
+        ext, ext,
+    ], axis=-1)

@@ -154,8 +154,16 @@ def load_zones(zone_ids: Sequence[str]) -> TransitionTable:
             raise ValueError(f"zone {zid} has recurring rules; unsupported "
                              "(matches GpuTimeZoneDB.java:236-240)")
         entries = [(INT64_MIN, INT64_MIN, transitions[0][1])]
+        # For the to-UTC search instant, a gap transition compares against
+        # instant + offset_after, but an overlap has two valid local ranges
+        # and must compare against instant + offset_before; the offset
+        # applied is always offset_after (GpuTimeZoneDB.java:296-316).
+        offset_before = transitions[0][1]
         for utc_instant, offset in transitions[1:]:
-            entries.append((utc_instant, utc_instant + offset, offset))
+            is_gap = offset > offset_before
+            local = utc_instant + (offset if is_gap else offset_before)
+            entries.append((utc_instant, local, offset))
+            offset_before = offset
         zones.append(entries)
     return make_transition_table(zones, zone_ids)
 

@@ -1,0 +1,369 @@
+"""Golden-vector tests for string->integer / string->decimal casts.
+
+Vectors transcribed from the reference behavioral suite
+(/root/reference/src/main/cpp/tests/cast_string.cpp) so parity is checked
+bit-for-bit: values, validity, ANSI first-error row and string.
+"""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.cast_string import (
+    CastException, string_to_decimal, string_to_float, string_to_integer)
+
+SIGNED = [dt.INT8, dt.INT16, dt.INT32, dt.INT64]
+UNSIGNED = [dt.UINT8, dt.UINT16, dt.UINT32, dt.UINT64]
+
+
+def strings(vals, validity=None):
+    if validity is not None:
+        vals = [v if ok else None for v, ok in zip(vals, validity)]
+    return Column.from_pylist(vals, dt.STRING)
+
+
+def check(col, expected):
+    assert col.to_pylist() == expected
+
+
+# ---------------------------------------------------------------------------
+# string -> integer (cast_string.cpp:44-246)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("t", SIGNED + UNSIGNED)
+def test_int_simple(t):
+    out = string_to_integer(strings(["1", "0", "42"]), t)
+    check(out, [1, 0, 42])
+
+
+ANSI_STRINGS = [
+    "", "null", "+1", "-0", "4.2",
+    "asdf", "98fe", "  00012", ".--e-37602.n", "\r\r\t\n11.12380",
+    "-.2", ".3", ".", "+1.2", "\n123\n456\n",
+    "1 2", "123", "", "1. 2", "+    7.6",
+    "  12  ", "7.6.2", "15  ", "7  2  ", " 8.2  ",
+    "3..14", "c0", "\r\r", "    ", "+\n",
+]
+ANSI_VALIDITY = [0, 0] + [1] * 28
+
+SIGNED_EXPECT = [
+    0, 0, 1, 0, 4, 0, 0, 12, 0, 11, 0, 0, 0, 1, 0,
+    0, 123, 0, 0, 0, 12, 0, 15, 0, 8, 0, 0, 0, 0, 0]
+SIGNED_VALID = [
+    0, 0, 1, 1, 1, 0, 0, 1, 0, 1, 1, 1, 1, 1, 0,
+    0, 1, 0, 0, 0, 1, 0, 1, 0, 1, 0, 0, 0, 0, 0]
+UNSIGNED_EXPECT = [
+    0, 0, 0, 0, 4, 0, 0, 12, 0, 11, 0, 0, 0, 0, 0,
+    0, 123, 0, 0, 0, 12, 0, 15, 0, 8, 0, 0, 0, 0, 0]
+UNSIGNED_VALID = [
+    0, 0, 0, 0, 1, 0, 0, 1, 0, 1, 0, 1, 1, 0, 0,
+    0, 1, 0, 0, 0, 1, 0, 1, 0, 1, 0, 0, 0, 0, 0]
+
+
+@pytest.mark.parametrize("t", SIGNED + UNSIGNED)
+def test_int_ansi_vectors(t):
+    col = strings(ANSI_STRINGS, ANSI_VALIDITY)
+    signed = t in SIGNED
+    expect_vals = SIGNED_EXPECT if signed else UNSIGNED_EXPECT
+    expect_valid = SIGNED_VALID if signed else UNSIGNED_VALID
+
+    with pytest.raises(CastException) as exc:
+        string_to_integer(col, t, ansi_mode=True)
+    if signed:
+        assert exc.value.row_number == 4
+        assert exc.value.string_with_error == "4.2"
+    else:
+        assert exc.value.row_number == 2
+        assert exc.value.string_with_error == "+1"
+
+    out = string_to_integer(col, t, ansi_mode=False)
+    check(out, [v if ok else None for v, ok in zip(expect_vals, expect_valid)])
+
+
+OVERFLOW_STRINGS = [
+    "127", "128", "-128", "-129", "255", "256", "32767", "32768", "-32768",
+    "-32769", "65525", "65536", "2147483647", "2147483648", "-2147483648",
+    "-2147483649", "4294967295", "4294967296", "-9223372036854775808",
+    "-9223372036854775809", "9223372036854775807", "9223372036854775808",
+    "18446744073709551615", "18446744073709551616"]
+
+OVERFLOW_EXPECT = {
+    "int8": ([127, 0, -128] + [0] * 21,
+             [1, 0, 1] + [0] * 21),
+    "uint8": ([127, 128, 0, 0, 255] + [0] * 19,
+              [1, 1, 0, 0, 1] + [0] * 19),
+    "int16": ([127, 128, -128, -129, 255, 256, 32767, 0, -32768] + [0] * 15,
+              [1, 1, 1, 1, 1, 1, 1, 0, 1] + [0] * 15),
+    "uint16": ([127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525] + [0] * 13,
+               [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1] + [0] * 13),
+    "int32": ([127, 128, -128, -129, 255, 256, 32767, 32768, -32768, -32769,
+               65525, 65536, 2147483647, 0, -2147483648] + [0] * 9,
+              [1] * 13 + [0, 1] + [0] * 9),
+    "uint32": ([127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525, 65536,
+                2147483647, 2147483648, 0, 0, 4294967295] + [0] * 7,
+               [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1] + [0] * 7),
+    "int64": ([127, 128, -128, -129, 255, 256, 32767, 32768, -32768, -32769,
+               65525, 65536, 2147483647, 2147483648, -2147483648, -2147483649,
+               4294967295, 4294967296, -9223372036854775808, 0,
+               9223372036854775807, 0, 0, 0],
+              [1] * 19 + [0, 1, 0, 0, 0]),
+    "uint64": ([127, 128, 0, 0, 255, 256, 32767, 32768, 0, 0, 65525, 65536,
+                2147483647, 2147483648, 0, 0, 4294967295, 4294967296, 0, 0,
+                9223372036854775807, 9223372036854775808,
+                18446744073709551615, 0],
+               [1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1, 0, 0,
+                1, 1, 1, 0]),
+}
+
+
+@pytest.mark.parametrize("t", SIGNED + UNSIGNED)
+def test_int_overflow(t):
+    out = string_to_integer(strings(OVERFLOW_STRINGS), t)
+    vals, valid = OVERFLOW_EXPECT[np.dtype(t.np_dtype).name]
+    check(out, [v if ok else None for v, ok in zip(vals, valid)])
+
+
+def test_int_empty():
+    out = string_to_integer(Column.from_pylist([], dt.STRING), dt.INT32)
+    assert out.size == 0 and out.dtype is dt.INT32
+
+
+# ---------------------------------------------------------------------------
+# string -> decimal (cast_string.cpp:253-547)
+# ---------------------------------------------------------------------------
+
+def dec(unscaled, java_scale):
+    sign = 1 if unscaled < 0 else 0
+    digits = tuple(int(c) for c in str(abs(unscaled)))
+    return decimal.Decimal((sign, digits, -java_scale))
+
+
+def check_dec(col, unscaled_vals, valid, cudf_scale):
+    expected = [dec(v, -cudf_scale) if ok else None
+                for v, ok in zip(unscaled_vals, valid)]
+    assert col.to_pylist() == expected
+
+
+def test_decimal_simple():
+    out = string_to_decimal(strings(["1", "0", "-1"]), 1, 0)
+    assert out.dtype.id is dt.TypeId.DECIMAL32
+    check_dec(out, [1, 0, -1], [1, 1, 1], 0)
+
+
+def test_decimal_overprecise():
+    out = string_to_decimal(strings(["123456", "999999", "-123456",
+                                     "-999999"]), 5, 0)
+    check_dec(out, [0, 0, 0, 0], [0, 0, 0, 0], 0)
+
+
+def test_decimal_rounding():
+    out = string_to_decimal(strings(["1.23456", "9.99999", "-1.23456",
+                                     "-9.99999"]), 5, -4)
+    check_dec(out, [12346, 0, -12346, 0], [1, 0, 1, 0], -4)
+
+
+def test_decimal_values():
+    out = string_to_decimal(strings(["1.234", "0.12345", "-1.034",
+                                     "-0.001234567890123456"]), 6, -5)
+    check_dec(out, [123400, 12345, -103400, -123], [1, 1, 1, 1], -5)
+
+
+def test_decimal_exponential():
+    out = string_to_decimal(strings(["1.234e-1", "0.12345e1", "-1.034e-2",
+                                     "-0.001234567890123456e2"]), 6, -5)
+    check_dec(out, [12340, 123450, -1034, -12346], [1, 1, 1, 1], -5)
+
+
+def test_decimal_positive_scale():
+    out = string_to_decimal(strings(["1234e-1", "12345e1", "-1234.5678",
+                                     "-0.001234567890123456e6"]), 6, 2)
+    check_dec(out, [1, 1235, -12, -12], [1, 1, 1, 1], 2)
+
+    vals = ["813847339", "043469773", "548977048", "985946604", "325679554",
+            "null", "957413342", "541903389", "150050891", "663968655",
+            "976832602", "757172936", "968693314", "106046331", "965120263",
+            "354546567", "108127101", "339513621", "980338159", "593267777"]
+    out = string_to_decimal(strings(vals), 8, 3)
+    check_dec(out,
+              [813847, 43470, 548977, 985947, 325680, 0, 957413, 541903,
+               150051, 663969, 976833, 757173, 968693, 106046, 965120,
+               354547, 108127, 339514, 980338, 593268],
+              [1, 1, 1, 1, 1, 0, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1],
+              3)
+
+
+def test_decimal_edges():
+    out = string_to_decimal(
+        strings(["123456789012345678901234567890123456.01"]), 38, -2)
+    assert out.dtype.id is dt.TypeId.DECIMAL128
+    expected = (123456789012345678 * 1000000000000000 + 901234567890123) \
+        * 100000 + 45601
+    check_dec(out, [expected], [1], -2)
+
+    out = string_to_decimal(strings(["8.483315330475049E-4"]), 15, -1)
+    check_dec(out, [0], [1], -1)
+
+    out = string_to_decimal(strings(["8.483315330475049E-2"]), 15, -1)
+    check_dec(out, [1], [1], -1)
+
+    out = string_to_decimal(strings(["-1.0E14"]), 15, -1)
+    check_dec(out, [0], [0], -1)
+
+    out = string_to_decimal(strings(["-1.0E14"]), 16, -1)
+    check_dec(out, [-1000000000000000], [1], -1)
+
+    out = string_to_decimal(strings(["8.575859E8"]), 15, -1)
+    check_dec(out, [8575859000], [1], -1)
+
+    out = string_to_decimal(strings(["10.0"]), 3, -1)
+    check_dec(out, [100], [1], -1)
+
+    out = string_to_decimal(strings(["1.7142857343"]), 9, -8)
+    check_dec(out, [171428573], [1], -8)
+
+    out = string_to_decimal(strings(["1.71428573437482136712623"]), 9, -8)
+    check_dec(out, [171428573], [1], -8)
+    out = string_to_decimal(strings(["1.71428573437482136712623"]), 9, -9)
+    check_dec(out, [0], [0], -9)
+
+    out = string_to_decimal(strings(["12.345678901"]), 9, -8)
+    check_dec(out, [0], [0], -8)
+
+    out = string_to_decimal(strings(["0.12345678901"]), 6, -6)
+    check_dec(out, [123457], [1], -6)
+
+    out = string_to_decimal(strings(["1.2345678901"]), 6, -6)
+    check_dec(out, [0], [0], -6)
+
+    out = string_to_decimal(strings(["NaN", "inf", "-inf", "0"]), 6, 0)
+    check_dec(out, [0, 0, 0, 0], [0, 0, 0, 1], 0)
+
+    out = string_to_decimal(strings(["1234567809"]), 8, 3)
+    check_dec(out, [1234568], [1], 3)
+
+    out = string_to_decimal(strings(["4347202159", "4347802159"]), 4, 6)
+    check_dec(out, [4347, 4348], [1, 1], 6)
+
+
+def test_decimal_empty():
+    out = string_to_decimal(Column.from_pylist([], dt.STRING), 8, 2)
+    assert out.size == 0
+    assert out.dtype.id is dt.TypeId.DECIMAL32
+    assert out.dtype.scale == -2
+
+
+def test_decimal_ansi_error():
+    col = strings(["1", "bad", "3"])
+    with pytest.raises(CastException) as exc:
+        string_to_decimal(col, 5, 0, ansi_mode=True)
+    assert exc.value.row_number == 1
+    assert exc.value.string_with_error == "bad"
+
+
+# ---------------------------------------------------------------------------
+# string -> float (cast_string.cpp:555-712)
+# ---------------------------------------------------------------------------
+
+FLOAT_TYPES = [dt.FLOAT32, dt.FLOAT64]
+
+
+def check_float(col, expected_vals, valid, rel=1e-15):
+    got = col.to_pylist()
+    assert len(got) == len(expected_vals)
+    for g, e, ok in zip(got, expected_vals, valid):
+        if not ok:
+            assert g is None, f"expected null, got {g}"
+        elif isinstance(e, float) and np.isnan(e):
+            assert g is not None and np.isnan(g)
+        else:
+            assert g is not None
+            assert g == pytest.approx(e, rel=rel), f"{g} != {e}"
+
+
+@pytest.mark.parametrize("t", FLOAT_TYPES)
+def test_float_simple(t):
+    vals = ["-1.8946e-10", "0001", "0000.123", "123", "123.45", "45.123",
+            "-45.123", "0.45123", "-0.45123", "999999999999999999999",
+            "99999999999999999999", "9999999999999999999",
+            "18446744073709551609", "18446744073709551610",
+            "18446744073709551619999999999999", "-18446744073709551609",
+            "-18446744073709551610", "-184467440737095516199999999999997"]
+    out = string_to_float(strings(vals), t)
+    np_t = np.dtype(t.np_dtype).type
+    expected = [float(np_t(float(v))) for v in vals]
+    rel = 1e-6 if t is dt.FLOAT32 else 1e-15
+    check_float(out, expected, [1] * len(vals), rel=rel)
+
+
+@pytest.mark.parametrize("t", FLOAT_TYPES)
+def test_float_inf_nan(t):
+    out = string_to_float(
+        strings(["NaN", "-Infinity", "inf", "Infinity", "-inf", "-nan"]), t)
+    check_float(out,
+                [float("nan"), float("-inf"), float("inf"), float("inf"),
+                 float("-inf"), 0.0],
+                [1, 1, 1, 1, 1, 0])
+
+
+@pytest.mark.parametrize("t", FLOAT_TYPES)
+def test_float_invalid(t):
+    out = string_to_float(
+        strings(["A", "null", "na7.62", "e", ".", "", "f", "E15"]), t)
+    check_float(out, [0] * 8, [0] * 8)
+
+
+@pytest.mark.parametrize("t", FLOAT_TYPES)
+def test_float_ansi(t):
+    for s in ["A", ".", "e"]:
+        with pytest.raises(CastException) as exc:
+            string_to_float(strings([s]), t, ansi_mode=True)
+        assert exc.value.row_number == 0
+    # inf with trailing garbage nulls but does NOT raise
+    # (cast_string_to_float.cu:303 sets valid=false without except)
+    out = string_to_float(strings(["infx"]), t, ansi_mode=True)
+    check_float(out, [0], [0])
+
+
+@pytest.mark.parametrize("t", FLOAT_TYPES)
+def test_float_tricky(t):
+    vals = ["7f", "\riNf", "1.3e5ef", "1.3e+7f", "9\n", "46037e\t", "8d",
+            "0\n", ".\r", "2F.", "                                    7d",
+            "                            98392.5e-1f", ".", "e",
+            "-1.6721969836937668E-304", "-2.21363921575273728E17", "0",
+            "00000000000000000000", "-0000000000000000000E0",
+            "0000000000000000000E0", "0000000000000000000000000000000017",
+            "18446744073709551609"]
+    expected = [7.0, float("inf"), 0, 13000000.0, 9.0, 0, 8.0, 0.0, 0, 0,
+                7.0, 9839.25, 0, 0, -1.6721969836937666e-304,
+                -2.21363921575273728e17, 0.0, 0.0, -0.0, 0.0, 17.0,
+                18446744073709551609.0]
+    valid = [1, 1, 0, 1, 1, 0, 1, 1, 0, 0, 1, 1, 0, 0, 1, 1, 1, 1, 1, 1, 1, 1]
+    out = string_to_float(strings(vals), t)
+    rel = 1e-6 if t is dt.FLOAT32 else 1e-15
+    check_float(out, expected, valid, rel=rel)
+
+
+def test_float_empty():
+    out = string_to_float(Column.from_pylist([], dt.STRING), dt.FLOAT64)
+    assert out.size == 0
+
+
+def test_float_truncation_exponent():
+    # correct exponent accounting where the reference warp code is off by one
+    # (20th absorbed digit, cast_string_to_float.cu:435)
+    out = string_to_float(strings(["0.01234567890123456789"]), dt.FLOAT64)
+    check_float(out, [0.01234567890123456789], [1])
+    out = string_to_float(strings(["0.00123456789012345678"]), dt.FLOAT64)
+    check_float(out, [0.00123456789012345678], [1])
+
+
+def test_int_nulls_passthrough():
+    col = strings(["5", None, "7"])
+    out = string_to_integer(col, dt.INT32)
+    check(out, [5, None, 7])
+    # nulls are not ANSI errors
+    out = string_to_integer(col, dt.INT32, ansi_mode=True)
+    check(out, [5, None, 7])

@@ -111,3 +111,36 @@ def test_sentinel_required():
 def test_nulls_propagate(table):
     c = Column.from_pylist([0, None], dt.TIMESTAMP_SECONDS)
     assert convert_timestamp_to_utc(c, table, 0).to_pylist() == [-18000, None]
+
+
+def test_overlap_transition_uses_offset_before():
+    # An overlap transition (offset decreases) has two valid local ranges;
+    # Spark compares the to-UTC search instant against
+    # instant + offset_before (GpuTimeZoneDB.java:296-316), resolving
+    # ambiguous local times to the earlier offset. Derive the expectation
+    # from the zone's own TZif data so it holds for any tzdata version.
+    import os
+    import zoneinfo
+    from spark_rapids_jni_tpu.ops.timezones import _parse_tzif, load_zones
+
+    zid = "Asia/Kathmandu"
+    path = next(os.path.join(r, zid) for r in zoneinfo.TZPATH
+                if os.path.exists(os.path.join(r, zid)))
+    transitions, _ = _parse_tzif(path)
+    overlaps = [(t, before, after)
+                for (t, after), (_, before) in zip(transitions[1:],
+                                                   transitions[:-1])
+                if after < before]
+    assert overlaps, "zone has no overlap transition in this tzdata"
+    inst, before, after = overlaps[0]
+
+    tb = load_zones([zid])
+    # a local time just inside the overlap window resolves to offset_before
+    local_in_overlap = inst + after + (before - after) // 2
+    # one past the window end uses offset_after
+    local_past = inst + before
+    c = Column.from_pylist([local_in_overlap, local_past],
+                           dt.TIMESTAMP_SECONDS)
+    got = convert_timestamp_to_utc(c, tb, 0).to_pylist()
+    assert got[0] == local_in_overlap - before
+    assert got[1] == local_past - after
