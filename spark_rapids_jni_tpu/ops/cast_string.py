@@ -661,14 +661,7 @@ def string_to_float(col: Column, out_dtype: DType,
     in_valid = col.valid_mask()
     out, valid, excp = _string_to_float_core(mat, lengths, in_valid)
     if ansi_mode:
-        errors = np.asarray(excp)
-        if errors.any():
-            row = int(np.argmax(errors))
-            offs = np.asarray(col.offsets)
-            data = np.asarray(col.data).tobytes()
-            s = data[offs[row]:offs[row + 1]].decode("utf-8",
-                                                     errors="replace")
-            raise CastException(row, s)
+        _raise_first_error(col, in_valid, ~excp)
     return Column(out_dtype, n, data=out.astype(out_dtype.np_dtype),
                   validity=valid)
 

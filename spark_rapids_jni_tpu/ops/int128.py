@@ -22,10 +22,7 @@ _MASK128 = (1 << 128) - 1
 
 def from_int_py(value: int, n: int) -> jnp.ndarray:
     """Broadcast a python int to [n, 4] two's-complement limbs."""
-    v = value & _MASK128
-    limbs = np.array([(v >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)],
-                     dtype=np.uint32)
-    return jnp.broadcast_to(jnp.asarray(limbs), (n, NLIMBS))
+    return jnp.broadcast_to(jnp.asarray(limbs_const(value)), (n, NLIMBS))
 
 
 def limbs_const(value: int) -> np.ndarray:

@@ -1,0 +1,320 @@
+"""JCUDF row <-> column conversion.
+
+Capability parity with the reference's row_conversion
+(/root/reference/src/main/cpp/src/row_conversion.cu): transpose between the
+engine's columnar layout and the Spark-shuffle-interop "JCUDF" row format.
+
+JCUDF row layout (row_conversion.cu:88-137 and RowConversion.java:44-118):
+  * fixed-width region: columns in declaration order, each aligned to its own
+    byte size; STRING columns occupy an 8-byte (uint32 offset, uint32 length)
+    pair, 4-byte aligned, with `offset` relative to the row start
+    (compute_column_information, row_conversion.cu:1324).
+  * validity: byte-aligned directly after the fixed region, bit c%8 of byte
+    c/8 set when column c is valid (copy_validity_to_rows,
+    row_conversion.cu:705).
+  * variable-width string bytes: immediately after validity (at
+    size_per_row), concatenated in string-column order
+    (copy_strings_to_rows, row_conversion.cu:813).
+  * each row padded to 8-byte alignment (JCUDF_ROW_ALIGNMENT,
+    row_conversion.cu:63); output split into LIST<INT8> batches of at most
+    2 GB (build_batches, row_conversion.cu:1458).
+
+TPU-first design: the CUDA implementation is a shared-memory tile transpose
+with memcpy_async; none of that machinery survives here. Layout metadata is
+computed host-side from the static schema; the data movement itself is a
+handful of XLA ops — byte bitcasts, static-slice writes into a dense
+[rows, size_per_row] matrix, and (for strings) one scatter/gather over the
+batch blob — which XLA fuses and tiles for the VPU on its own.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.dtype import DType, TypeId
+from ..columnar.strings import padded_bytes
+
+JCUDF_ROW_ALIGNMENT = 8
+MAX_BATCH_BYTES = (1 << 31) - 1  # LIST<INT8> offsets are int32 (2 GB limit)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass(frozen=True)
+class ColumnInfo:
+    """Static per-schema layout of the JCUDF fixed-width region."""
+
+    size_per_row: int                 # fixed-width + validity bytes
+    column_starts: Tuple[int, ...]    # per column byte offset in the row
+    column_sizes: Tuple[int, ...]     # per column byte size (8 for STRING)
+    validity_offset: int              # byte offset of the validity bytes
+    variable_width_column_starts: Tuple[int, ...]  # fixed slots of STRING cols
+
+
+def compute_column_information(dtypes: Sequence[DType]) -> ColumnInfo:
+    """Row layout from a schema (row_conversion.cu:1324)."""
+    size_per_row = 0
+    starts: List[int] = []
+    sizes: List[int] = []
+    var_starts: List[int] = []
+    for d in dtypes:
+        compound = not d.is_fixed_width
+        if compound and d.id is not TypeId.STRING:
+            raise ValueError(f"JCUDF rows support fixed-width and STRING "
+                             f"columns, not {d.id}")
+        col_size = 8 if compound else d.itemsize
+        alignment = 4 if compound else col_size
+        size_per_row = _round_up(size_per_row, alignment)
+        if compound:
+            var_starts.append(size_per_row)
+        starts.append(size_per_row)
+        sizes.append(col_size)
+        size_per_row += col_size
+    validity_offset = size_per_row
+    size_per_row += (len(dtypes) + 7) // 8
+    return ColumnInfo(size_per_row, tuple(starts), tuple(sizes),
+                      validity_offset, tuple(var_starts))
+
+
+def _column_bytes(col: Column) -> jnp.ndarray:
+    """Fixed-width column values as little-endian uint8[n, itemsize]."""
+    if col.dtype.id is TypeId.DECIMAL128:
+        # [n, 4] uint32 LE limbs -> [n, 4, 4] bytes -> [n, 16]
+        b = jax.lax.bitcast_convert_type(col.data, jnp.uint8)
+        return b.reshape(col.size, 16)
+    data = col.data
+    if data.dtype.itemsize == 1:
+        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(col.size, 1)
+    return jax.lax.bitcast_convert_type(data, jnp.uint8)
+
+
+def _bytes_to_column(mat: jnp.ndarray, d: DType,
+                     validity: Optional[jnp.ndarray]) -> Column:
+    """Inverse of _column_bytes: uint8[n, itemsize] -> Column."""
+    n = mat.shape[0]
+    if d.id is TypeId.DECIMAL128:
+        limbs = jax.lax.bitcast_convert_type(
+            mat.reshape(n, 4, 4), jnp.uint32)
+        return Column(d, n, data=limbs, validity=validity)
+    target = d.jnp_dtype
+    if target.itemsize == 1:
+        data = jax.lax.bitcast_convert_type(mat[:, 0], target)
+    else:
+        data = jax.lax.bitcast_convert_type(mat, target)
+    return Column(d, n, data=data, validity=validity)
+
+
+def _pack_row_validity(valid: jnp.ndarray) -> jnp.ndarray:
+    """bool[n, ncols] -> uint8[n, ceil(ncols/8)], bit c%8 of byte c/8."""
+    n, ncols = valid.shape
+    nbytes = (ncols + 7) // 8
+    padded = jnp.zeros((n, nbytes * 8), dtype=jnp.uint8)
+    padded = padded.at[:, :ncols].set(valid.astype(jnp.uint8))
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(padded.reshape(n, nbytes, 8) * weights[None, None, :],
+                   axis=2, dtype=jnp.uint8)
+
+
+def _u32_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.uint8)
+
+
+def _build_fixed_region(table: Table, info: ColumnInfo,
+                        var_offsets: Optional[jnp.ndarray],
+                        var_lengths: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Dense uint8[n, size_per_row] fixed-width + validity region.
+
+    var_offsets/var_lengths: int32[n, n_string_cols] row-relative offsets and
+    lengths for STRING columns (None when the table is all fixed-width).
+    """
+    n = table.num_rows
+    out = jnp.zeros((n, info.size_per_row), dtype=jnp.uint8)
+    var_idx = 0
+    for c, col in enumerate(table):
+        o = info.column_starts[c]
+        if col.dtype.id is TypeId.STRING:
+            out = out.at[:, o:o + 4].set(_u32_bytes(var_offsets[:, var_idx]))
+            out = out.at[:, o + 4:o + 8].set(_u32_bytes(var_lengths[:, var_idx]))
+            var_idx += 1
+        else:
+            out = out.at[:, o:o + info.column_sizes[c]].set(_column_bytes(col))
+    valid = jnp.stack([c.valid_mask() for c in table], axis=1)
+    out = out.at[:, info.validity_offset:].set(_pack_row_validity(valid))
+    return out
+
+
+def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
+    """Split rows into batches whose total size fits an int32-offset column
+    (build_batches, row_conversion.cu:1458). Returns boundary row indices
+    [0, ..., num_rows]."""
+    bounds = [0]
+    acc = 0
+    for i, s in enumerate(row_sizes):
+        if acc + int(s) > max_batch_bytes and acc > 0:
+            bounds.append(i)
+            acc = 0
+        acc += int(s)
+    bounds.append(len(row_sizes))
+    return bounds
+
+
+def _rows_column(blob: jnp.ndarray, row_offsets: np.ndarray) -> Column:
+    child = Column(dt.INT8, int(blob.shape[0]),
+                   data=jax.lax.bitcast_convert_type(blob, jnp.int8))
+    return Column.list_of(child, jnp.asarray(row_offsets, dtype=jnp.int32))
+
+
+def convert_to_rows(table: Table,
+                    max_batch_bytes: int = MAX_BATCH_BYTES) -> List[Column]:
+    """Columnar -> JCUDF rows (row_conversion.cu:1990).
+
+    Returns one LIST<INT8> column per <=2 GB batch; rows appear in table
+    order, batch k holding rows [bounds[k], bounds[k+1]).
+    """
+    dtypes = [c.dtype for c in table.columns]
+    info = compute_column_information(dtypes)
+    n = table.num_rows
+    string_cols = [c for c in table if c.dtype.id is TypeId.STRING]
+
+    if not string_cols:
+        row_size = _round_up(info.size_per_row, JCUDF_ROW_ALIGNMENT)
+        fixed = _build_fixed_region(table, info, None, None)
+        if row_size != info.size_per_row:
+            fixed = jnp.pad(fixed, ((0, 0), (0, row_size - info.size_per_row)))
+        bounds = _batch_boundaries(
+            np.full(n, row_size, dtype=np.int64), max_batch_bytes)
+        out = []
+        for b0, b1 in zip(bounds[:-1], bounds[1:]):
+            blob = fixed[b0:b1].reshape(-1)
+            offsets = np.arange(b1 - b0 + 1, dtype=np.int64) * row_size
+            out.append(_rows_column(blob, offsets))
+        return out
+
+    # --- variable-width path -----------------------------------------------
+    lengths = jnp.stack(
+        [(c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+         for c in string_cols], axis=1)                     # [n, nsc]
+    # row-relative variable offsets: exclusive scan over string columns
+    var_offsets = (info.size_per_row
+                   + jnp.cumsum(lengths, axis=1) - lengths)  # [n, nsc]
+    total_str = jnp.sum(lengths, axis=1)
+    row_sizes_np = np.asarray(
+        ((info.size_per_row + total_str + JCUDF_ROW_ALIGNMENT - 1)
+         // JCUDF_ROW_ALIGNMENT) * JCUDF_ROW_ALIGNMENT, dtype=np.int64)
+
+    fixed = _build_fixed_region(table, info, var_offsets, lengths)
+    padded = [padded_bytes(c) for c in string_cols]
+    bounds = _batch_boundaries(row_sizes_np, max_batch_bytes)
+
+    out = []
+    for b0, b1 in zip(bounds[:-1], bounds[1:]):
+        nb = b1 - b0
+        sizes = row_sizes_np[b0:b1]
+        row_offsets = np.zeros(nb + 1, dtype=np.int64)
+        np.cumsum(sizes, out=row_offsets[1:])
+        total = int(row_offsets[-1])
+        roff = jnp.asarray(row_offsets[:-1], dtype=jnp.int32)
+
+        blob = jnp.zeros((total,), dtype=jnp.uint8)
+        # fixed region: one scatter of [nb, size_per_row]
+        pos = roff[:, None] + jnp.arange(info.size_per_row, dtype=jnp.int32)
+        blob = blob.at[pos.reshape(-1)].set(fixed[b0:b1].reshape(-1))
+        # string data: one scatter per string column from its padded matrix
+        for s, (mat, lens) in enumerate(padded):
+            mat, lens = mat[b0:b1], lens[b0:b1]
+            L = mat.shape[1]
+            j = jnp.arange(L, dtype=jnp.int32)[None, :]
+            p = roff[:, None] + var_offsets[b0:b1, s, None] + j
+            p = jnp.where(j < lens[:, None], p, total)  # OOB -> dropped
+            blob = blob.at[p.reshape(-1)].set(mat.reshape(-1), mode="drop")
+        out.append(_rows_column(blob, row_offsets))
+    return out
+
+
+def convert_to_rows_fixed_width_optimized(
+        table: Table, max_batch_bytes: int = MAX_BATCH_BYTES) -> List[Column]:
+    """Fixed-width-only fast path (row_conversion.cu:2053). Same JCUDF
+    layout; validates the reference's documented limits (<100 columns,
+    RowConversion.java:29-33; row size <=1 KB)."""
+    if table.num_columns >= 100:
+        raise ValueError("fixed-width-optimized path supports <100 columns")
+    for c in table:
+        if not c.dtype.is_fixed_width:
+            raise ValueError("fixed-width-optimized path requires "
+                             "fixed-width columns")
+    info = compute_column_information([c.dtype for c in table.columns])
+    if _round_up(info.size_per_row, JCUDF_ROW_ALIGNMENT) > 1024:
+        raise ValueError("row size exceeds 1KB limit")
+    return convert_to_rows(table, max_batch_bytes)
+
+
+def _extract_validity(fixed: jnp.ndarray, info: ColumnInfo,
+                      ncols: int) -> jnp.ndarray:
+    """uint8[n, size_per_row] -> bool[n, ncols] validity."""
+    vbytes = fixed[:, info.validity_offset:
+                   info.validity_offset + (ncols + 7) // 8]
+    bits = (vbytes[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return bits.reshape(fixed.shape[0], -1)[:, :ncols].astype(bool)
+
+
+def convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
+    """JCUDF rows -> columnar (row_conversion.cu:2145).
+
+    `rows` is a LIST<INT8> column as produced by convert_to_rows.
+    """
+    assert rows.dtype.id is TypeId.LIST, "expected LIST<INT8> row column"
+    info = compute_column_information(dtypes)
+    n = rows.size
+    row_offsets = jnp.asarray(rows.offsets, dtype=jnp.int32)[:-1]
+    blob = jax.lax.bitcast_convert_type(rows.children[0].data, jnp.uint8)
+
+    # gather the dense fixed-width region
+    pos = row_offsets[:, None] + jnp.arange(info.size_per_row, dtype=jnp.int32)
+    fixed = blob[jnp.clip(pos, 0, max(blob.shape[0] - 1, 0))]
+    valid = _extract_validity(fixed, info, len(dtypes))
+
+    # null-mask materialization: single host sync over all columns
+    any_null = np.asarray(~jnp.all(valid, axis=0))
+
+    cols: List[Column] = []
+    for c, d in enumerate(dtypes):
+        vmask = valid[:, c] if any_null[c] else None
+        o = info.column_starts[c]
+        if d.id is TypeId.STRING:
+            off_in_row = jax.lax.bitcast_convert_type(
+                fixed[:, o:o + 4], jnp.uint32).astype(jnp.int32)
+            length = jax.lax.bitcast_convert_type(
+                fixed[:, o + 4:o + 8], jnp.uint32).astype(jnp.int32)
+            out_offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(length)])
+            total = int(out_offsets[-1])
+            # per-output-byte gather: k -> (row via searchsorted, byte within)
+            k = jnp.arange(total, dtype=jnp.int32)
+            row = jnp.searchsorted(out_offsets, k, side="right") - 1
+            src = row_offsets[row] + off_in_row[row] + (k - out_offsets[row])
+            data = blob[src] if total else jnp.zeros((0,), jnp.uint8)
+            cols.append(Column(d, n, data=data, validity=vmask,
+                               offsets=out_offsets))
+        else:
+            s = info.column_sizes[c]
+            cols.append(_bytes_to_column(fixed[:, o:o + s], d, vmask))
+    return Table(tuple(cols))
+
+
+def convert_from_rows_fixed_width_optimized(
+        rows: Column, dtypes: Sequence[DType]) -> Table:
+    """Fixed-width-only inverse (row_conversion.cu:2444)."""
+    for d in dtypes:
+        if not d.is_fixed_width:
+            raise ValueError("fixed-width-optimized path requires "
+                             "fixed-width columns")
+    return convert_from_rows(rows, dtypes)
