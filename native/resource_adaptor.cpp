@@ -1,0 +1,841 @@
+// TPU-native rebuild of the Spark resource-scheduling subsystem.
+//
+// Reference capability: spark-rapids-jni's SparkResourceAdaptorJni.cpp — an RMM
+// device_memory_resource decorator plus a per-thread/task state machine that
+// multiplexes many CPU threads (Spark tasks) onto one memory-limited
+// accelerator: block-on-OOM, priority wakeups, deadlock detection, BUFN
+// ("block until further notice") escalation to retry-OOM, and split-and-retry
+// escalation when even rollbacks cannot make progress.
+// (See reference SparkResourceAdaptorJni.cpp: thread_state enum :82-95,
+// thread_priority :136-190, pre_alloc :1236, post_alloc_success :1342,
+// post_alloc_failed :1685, block_thread_until_ready :1036,
+// check_and_update_for_bufn :1598, wake_next_highest_priority_blocked :1379,
+// task metrics :197-227.)
+//
+// TPU adaptation: XLA/PJRT allocations happen inside compiled executables, so
+// the interception point is an ahead-of-execution HBM *reservation* pool —
+// tasks reserve bytes before launching device work and release them after.
+// The state machine operates at reservation granularity; the scheduling
+// semantics (priorities, BUFN, split-and-retry) are identical in spirit.
+//
+// This is host-only C++17 with no dependencies; exposed through a C ABI that
+// the Python layer binds with ctypes. "Throwing across JNI" becomes returning
+// an error code that the Python side maps onto the OOM exception taxonomy.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// status codes returned across the C ABI (Python raises matching exceptions)
+// ---------------------------------------------------------------------------
+enum rm_status : int {
+  RM_OK                     = 0,
+  RM_RETRY_OOM              = 1,  // roll back to spillable state and retry
+  RM_SPLIT_AND_RETRY_OOM    = 2,  // split the input and retry
+  RM_CPU_RETRY_OOM          = 3,
+  RM_CPU_SPLIT_AND_RETRY_OOM= 4,
+  RM_FATAL_OOM              = 5,  // retry cap exceeded or request > pool
+  RM_INJECTED_EXCEPTION     = 6,  // forced framework exception (test injection)
+  RM_TASK_REMOVED           = 7,  // task purged while thread blocked
+  RM_INVALID                = -1, // unknown thread / bad handle / misuse
+};
+
+// Thread states; mirrors the reference's taxonomy (thread_state :82-95).
+enum thread_state : int {
+  TS_UNKNOWN      = -1,
+  TS_RUNNING      = 0,  // computing on its own
+  TS_ALLOC        = 1,  // in the middle of an allocation
+  TS_ALLOC_FREE   = 2,  // in an allocation, and a free happened meanwhile
+  TS_BLOCKED      = 3,  // waiting for memory to become available
+  TS_BUFN_THROW   = 4,  // chosen to roll back: will throw retry-OOM
+  TS_BUFN_WAIT    = 5,  // threw retry-OOM; expected to re-enter and wait
+  TS_BUFN         = 6,  // rolled back to spillable state; waiting for progress
+  TS_SPLIT_THROW  = 7,  // will throw split-and-retry-OOM
+  TS_REMOVE_THROW = 8,  // task removed out from under the thread
+};
+
+static const char* state_name(int s) {
+  switch (s) {
+    case TS_RUNNING:      return "RUNNING";
+    case TS_ALLOC:        return "ALLOC";
+    case TS_ALLOC_FREE:   return "ALLOC_FREE";
+    case TS_BLOCKED:      return "BLOCKED";
+    case TS_BUFN_THROW:   return "BUFN_THROW";
+    case TS_BUFN_WAIT:    return "BUFN_WAIT";
+    case TS_BUFN:         return "BUFN";
+    case TS_SPLIT_THROW:  return "SPLIT_THROW";
+    case TS_REMOVE_THROW: return "REMOVE_THROW";
+    default:              return "UNKNOWN";
+  }
+}
+
+// How many failed-retry loops a single thread may spin through before the
+// framework gives up with a fatal OOM (livelock guard; reference caps at 500).
+constexpr int kMaxRetryLoops = 500;
+
+using clock_t_ = std::chrono::steady_clock;
+
+static int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             clock_t_::now().time_since_epoch())
+      .count();
+}
+
+// Per-task rollup of scheduling cost, surfaced into Spark task metrics.
+struct task_metrics {
+  int64_t num_retry_oom        = 0;
+  int64_t num_split_retry_oom  = 0;
+  int64_t block_time_ns        = 0;
+  int64_t lost_compute_time_ns = 0;  // compute discarded by a thrown retry
+  int64_t max_device_reserved  = 0;  // high-water mark of this task's bytes
+
+  void add(const task_metrics& o) {
+    num_retry_oom += o.num_retry_oom;
+    num_split_retry_oom += o.num_split_retry_oom;
+    block_time_ns += o.block_time_ns;
+    lost_compute_time_ns += o.lost_compute_time_ns;
+    max_device_reserved = std::max(max_device_reserved, o.max_device_reserved);
+  }
+};
+
+// Test-injection state: force the next N allocations on a thread to fail in a
+// prescribed way, optionally after skipping a few (reference oom_state_type).
+struct oom_injection {
+  int  num_ooms   = 0;
+  int  skip_count = 0;
+  int  oom_mode   = 0;   // bit0: device ooms, bit1: host ooms
+  int  kind       = 0;   // RM_RETRY_OOM / RM_SPLIT_AND_RETRY_OOM / RM_INJECTED_EXCEPTION
+
+  bool applies(bool is_for_cpu) const {
+    if (num_ooms <= 0) return false;
+    return is_for_cpu ? (oom_mode & 2) : (oom_mode & 1);
+  }
+};
+
+struct per_thread {
+  long      thread_id = -1;
+  long      task_id   = -1;   // -1 ⇒ non-task thread (shuffle/utility)
+  bool      is_dedicated = true;  // false ⇒ pool thread serving many tasks
+  std::set<long> pool_task_ids;   // tasks a pool thread currently serves
+
+  int       state = TS_RUNNING;
+  bool      blocked_is_cpu = false;  // domain of the outstanding blocked alloc
+  int       retry_loops = 0;         // failed alloc loops since last success
+
+  // Marks for deadlock accounting on threads that are waiting on *other
+  // threads* rather than on memory (python-UDF pool protocol).
+  bool      waiting_on_pool    = false;
+  bool      submitting_to_pool = false;
+
+  int64_t   device_reserved = 0;     // bytes currently reserved by this thread
+  int64_t   block_start_ns  = 0;
+  int64_t   compute_start_ns = 0;    // set at retry-block start, for lost-time
+
+  oom_injection injection;
+  task_metrics  metrics;
+
+  std::condition_variable cv;
+
+  bool is_task_less() const { return task_id < 0 && pool_task_ids.empty(); }
+
+  // Lower tuple sorts first = higher priority. Older (lower-id) tasks win;
+  // task-less threads (shuffle) outrank every task (reference thread_priority
+  // :136-190).
+  std::pair<long, long> priority() const {
+    long t = task_id;
+    if (!is_dedicated && !pool_task_ids.empty())
+      t = *pool_task_ids.begin();
+    if (is_task_less()) t = -1;
+    return {t, thread_id};
+  }
+
+  bool counts_blocked_for_deadlock() const {
+    switch (state) {
+      case TS_BLOCKED:
+      case TS_BUFN_THROW:
+      case TS_BUFN_WAIT:
+      case TS_BUFN:
+      case TS_SPLIT_THROW:
+        return true;
+      default:
+        return waiting_on_pool || submitting_to_pool;
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// the adaptor
+// ---------------------------------------------------------------------------
+class resource_adaptor {
+ public:
+  explicit resource_adaptor(int64_t pool_bytes, const char* log_path)
+      : pool_limit_(pool_bytes) {
+    if (log_path && log_path[0]) {
+      if (!strcmp(log_path, "stderr")) log_ = stderr;
+      else if (!strcmp(log_path, "stdout")) log_ = stdout;
+      else { log_ = fopen(log_path, "w"); owns_log_ = log_ != nullptr; }
+      if (log_)
+        fprintf(log_, "time,op,current thread,op thread,op task,from state,"
+                      "to state,notes\n");
+    }
+  }
+
+  ~resource_adaptor() {
+    if (owns_log_ && log_) fclose(log_);
+  }
+
+  // ---- registration ------------------------------------------------------
+
+  int start_dedicated_task_thread(long tid, long task_id) {
+    std::lock_guard<std::mutex> g(m_);
+    per_thread& t = threads_[tid];
+    t.thread_id = tid;
+    t.task_id = task_id;
+    t.is_dedicated = true;
+    if (t.state == TS_UNKNOWN) t.state = TS_RUNNING;
+    log_op("start_dedicated", tid, tid, task_id, t.state, t.state, "");
+    return RM_OK;
+  }
+
+  int pool_thread_working_on_task(long tid, long task_id) {
+    std::lock_guard<std::mutex> g(m_);
+    per_thread& t = threads_[tid];
+    t.thread_id = tid;
+    t.is_dedicated = false;
+    t.pool_task_ids.insert(task_id);
+    if (t.state == TS_UNKNOWN) t.state = TS_RUNNING;
+    log_op("pool_working", tid, tid, task_id, t.state, t.state, "");
+    return RM_OK;
+  }
+
+  int pool_thread_finished_for_tasks(long tid, const long* task_ids, int n) {
+    std::lock_guard<std::mutex> g(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_INVALID;
+    for (int i = 0; i < n; i++) it->second.pool_task_ids.erase(task_ids[i]);
+    log_op("pool_finished", tid, tid, -1, it->second.state, it->second.state, "");
+    return RM_OK;
+  }
+
+  // Shuffle/utility thread: task-less, top priority in wakeups.
+  int start_shuffle_thread(long tid) {
+    std::lock_guard<std::mutex> g(m_);
+    per_thread& t = threads_[tid];
+    t.thread_id = tid;
+    t.task_id = -1;
+    t.is_dedicated = false;
+    if (t.state == TS_UNKNOWN) t.state = TS_RUNNING;
+    log_op("start_shuffle", tid, tid, -1, t.state, t.state, "");
+    return RM_OK;
+  }
+
+  int remove_thread_association(long tid, long task_id) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_OK;
+    per_thread& t = it->second;
+    checkpoint_metrics_locked(t);
+    if (task_id < 0 || t.task_id == task_id) t.task_id = -1;
+    t.pool_task_ids.erase(task_id);
+    if (t.is_task_less() && t.state == TS_RUNNING) {
+      log_op("remove_thread", tid, tid, task_id, t.state, t.state, "");
+      threads_.erase(it);
+    }
+    check_and_update_for_bufn_locked(lk);
+    return RM_OK;
+  }
+
+  int task_done(long task_id) {
+    std::unique_lock<std::mutex> lk(m_);
+    std::vector<long> to_erase;
+    for (auto& [tid, t] : threads_) {
+      bool member = t.task_id == task_id || t.pool_task_ids.count(task_id);
+      if (!member) continue;
+      checkpoint_metrics_locked(t);
+      t.pool_task_ids.erase(task_id);
+      if (t.task_id == task_id) t.task_id = -1;
+      if (t.task_id < 0 && t.pool_task_ids.empty()) {
+        // Threads of a finished task must unwind. Anything not plainly
+        // RUNNING (blocked, BUFN*, or mid-allocation with the lock released
+        // back to the caller) is flagged to throw task-removed at its next
+        // gate; erasing a TS_ALLOC thread here would leave its later
+        // cpu_postalloc_* calls spinning against an unknown tid.
+        if (t.state == TS_RUNNING) {
+          to_erase.push_back(tid);
+        } else {
+          transition(t, TS_REMOVE_THROW, "task_done");
+          t.cv.notify_all();
+        }
+      }
+    }
+    for (long tid : to_erase) threads_.erase(tid);
+    // A finished task releases pressure: let BUFN threads try again
+    // (reference wake_up_threads_after_task_finishes :1118-1148).
+    wake_bufn_threads_locked("task_done");
+    wake_next_highest_priority_blocked_locked(false, "task_done");
+    wake_next_highest_priority_blocked_locked(true, "task_done");
+    return RM_OK;
+  }
+
+  // ---- retry-block bracketing (for lost-compute-time metric) -------------
+
+  int start_retry_block(long tid) {
+    std::lock_guard<std::mutex> g(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_INVALID;
+    it->second.compute_start_ns = now_ns();
+    return RM_OK;
+  }
+
+  int end_retry_block(long tid) {
+    std::lock_guard<std::mutex> g(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_INVALID;
+    it->second.compute_start_ns = 0;
+    return RM_OK;
+  }
+
+  // ---- test injection ----------------------------------------------------
+
+  int force_oom(long tid, int kind, int num_ooms, int oom_mode, int skip) {
+    std::lock_guard<std::mutex> g(m_);
+    per_thread& t = threads_[tid];
+    if (t.thread_id < 0) { t.thread_id = tid; t.state = TS_RUNNING; }
+    t.injection.kind = kind;
+    t.injection.num_ooms = num_ooms;
+    t.injection.oom_mode = oom_mode;
+    t.injection.skip_count = skip;
+    return RM_OK;
+  }
+
+  // ---- device (HBM reservation) allocation path --------------------------
+
+  // Full reference do_allocate loop (:1731): pre-alloc gate (may block or
+  // "throw"), pool reservation attempt, post-alloc bookkeeping, repeat.
+  int alloc(long tid, int64_t bytes) {
+    if (bytes < 0) return RM_INVALID;
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) {
+      // Unregistered threads bypass the state machine but still use the pool.
+      if (!try_reserve_locked(nullptr, bytes)) return RM_FATAL_OOM;
+      untracked_reserved_ += bytes;
+      return RM_OK;
+    }
+    while (true) {
+      per_thread& t = threads_.at(tid);
+      int rc = pre_alloc_locked(lk, t, /*is_for_cpu=*/false);
+      if (rc != RM_OK) return rc;
+      if (try_reserve_locked(&t, bytes)) {
+        post_alloc_success_locked(t, bytes);
+        return RM_OK;
+      }
+      rc = post_alloc_failed_locked(lk, t, /*was_oom=*/true, /*cpu=*/false);
+      if (rc != RM_OK) return rc;
+    }
+  }
+
+  int dealloc(long tid, int64_t bytes) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) {
+      // Unregistered (or already-removed) threads: clamp so a stray free can
+      // never drive the pool accounting negative / past the real HBM limit.
+      int64_t f = std::min(bytes, untracked_reserved_);
+      untracked_reserved_ -= f;
+      pool_used_ -= std::min(f, pool_used_);
+    } else {
+      dealloc_core_locked(it->second, bytes);
+    }
+    // A free means blocked threads may now fit (reference do_deallocate :1790).
+    for (auto& [id, t] : threads_)
+      if (t.state == TS_ALLOC) transition(t, TS_ALLOC_FREE, "dealloc");
+    wake_next_highest_priority_blocked_locked(false, "dealloc");
+    return RM_OK;
+  }
+
+  // ---- host ("CPU off-heap") hooks: Java/Python owns the actual allocator;
+  // the state machine arbitrates (reference cpu_prealloc :808-842) ----------
+
+  int cpu_prealloc(long tid, int64_t /*bytes*/, int blocking) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_OK;
+    per_thread& t = it->second;
+    if (!blocking) {
+      // Non-blocking host allocators must never be parked: resolve throw
+      // states immediately, otherwise proceed without waiting.
+      switch (t.state) {
+        case TS_BUFN_THROW:
+          transition(t, TS_BUFN_WAIT, "throwing_retry_oom_nonblocking");
+          account_thrown_retry_locked(t, false);
+          return RM_CPU_RETRY_OOM;
+        case TS_SPLIT_THROW:
+          transition(t, TS_RUNNING, "throwing_split_nonblocking");
+          account_thrown_retry_locked(t, true);
+          return RM_CPU_SPLIT_AND_RETRY_OOM;
+        case TS_REMOVE_THROW:
+          return block_until_ready_locked(lk, t);  // returns immediately
+        default:
+          break;
+      }
+      int rc = apply_injection_locked(t, /*is_for_cpu=*/true);
+      if (rc != RM_OK) return rc;
+      if (t.state == TS_RUNNING) transition(t, TS_ALLOC, "pre_alloc");
+      return RM_OK;
+    }
+    return pre_alloc_locked(lk, t, /*is_for_cpu=*/true);
+  }
+
+  int cpu_postalloc_success(long tid, int64_t /*bytes*/) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_OK;
+    post_alloc_success_locked(it->second, 0);
+    return RM_OK;
+  }
+
+  // Returns RM_OK when the caller should loop and retry the host alloc
+  // (possibly after this call blocked); error codes unwind to the retry
+  // framework exactly like the device path.
+  int cpu_postalloc_failed(long tid, int was_oom, int blocking) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_OK;
+    if (!blocking) {
+      // Non-blocking host allocators report failure straight back.
+      per_thread& t = it->second;
+      if (t.state == TS_ALLOC || t.state == TS_ALLOC_FREE)
+        transition(t, TS_RUNNING, "cpu_postalloc_failed_nonblocking");
+      return RM_OK;
+    }
+    return post_alloc_failed_locked(lk, it->second, was_oom, /*cpu=*/true);
+  }
+
+  int cpu_dealloc(long tid, int64_t /*bytes*/) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it != threads_.end()) {
+      per_thread& t = it->second;
+      if (t.state == TS_ALLOC) transition(t, TS_ALLOC_FREE, "cpu_dealloc");
+    }
+    wake_next_highest_priority_blocked_locked(true, "cpu_dealloc");
+    return RM_OK;
+  }
+
+  // ---- voluntary gate: called by a thread after it rolled back following a
+  // retry-OOM, before it resumes work (reference blockThreadUntilReady) ------
+
+  int block_thread_until_ready(long tid) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_OK;
+    return block_until_ready_locked(lk, it->second);
+  }
+
+  // ---- pool-wait markers (multi-threaded python-UDF tasks) ----------------
+
+  int submitting_to_pool(long tid, int flag) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_INVALID;
+    it->second.submitting_to_pool = flag != 0;
+    if (flag) check_and_update_for_bufn_locked(lk);
+    return RM_OK;
+  }
+
+  int waiting_on_pool(long tid, int flag) {
+    std::unique_lock<std::mutex> lk(m_);
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return RM_INVALID;
+    it->second.waiting_on_pool = flag != 0;
+    if (flag) check_and_update_for_bufn_locked(lk);
+    return RM_OK;
+  }
+
+  // ---- watchdog (100 ms poll from a host daemon thread) -------------------
+
+  int check_and_break_deadlocks() {
+    std::unique_lock<std::mutex> lk(m_);
+    check_and_update_for_bufn_locked(lk);
+    return RM_OK;
+  }
+
+  // ---- introspection / metrics -------------------------------------------
+
+  int get_state_of(long tid) {
+    std::lock_guard<std::mutex> g(m_);
+    auto it = threads_.find(tid);
+    return it == threads_.end() ? TS_UNKNOWN : it->second.state;
+  }
+
+  int64_t get_metric(long task_id, int which, int reset) {
+    std::lock_guard<std::mutex> g(m_);
+    // Roll live thread metrics into the task accumulator first.
+    for (auto& [tid, t] : threads_)
+      if (t.task_id == task_id || t.pool_task_ids.count(task_id))
+        checkpoint_metrics_locked(t);
+    auto mit = task_metrics_.find(task_id);
+    if (mit == task_metrics_.end()) return which >= 0 && which <= 4 ? 0 : -1;
+    task_metrics& m = mit->second;
+    int64_t v = 0;
+    switch (which) {
+      case 0: v = m.num_retry_oom; if (reset) m.num_retry_oom = 0; break;
+      case 1: v = m.num_split_retry_oom; if (reset) m.num_split_retry_oom = 0; break;
+      case 2: v = m.block_time_ns; if (reset) m.block_time_ns = 0; break;
+      case 3: v = m.lost_compute_time_ns; if (reset) m.lost_compute_time_ns = 0; break;
+      case 4: v = m.max_device_reserved; if (reset) m.max_device_reserved = 0; break;
+      default: return -1;
+    }
+    // Bound the accumulator map in a process-lifetime adaptor: once a task's
+    // metrics are fully drained (the plugin resets them at task completion),
+    // drop the entry.
+    if (reset && m.num_retry_oom == 0 && m.num_split_retry_oom == 0 &&
+        m.block_time_ns == 0 && m.lost_compute_time_ns == 0 &&
+        m.max_device_reserved == 0) {
+      task_metrics_.erase(mit);
+    }
+    return v;
+  }
+
+  int64_t pool_used()  { std::lock_guard<std::mutex> g(m_); return pool_used_; }
+  int64_t pool_limit() { std::lock_guard<std::mutex> g(m_); return pool_limit_; }
+
+ private:
+  // ---- core state machine (all _locked methods require m_ held) ----------
+
+  static bool is_blocked_family(int s) {
+    return s == TS_BLOCKED || s == TS_BUFN_THROW || s == TS_BUFN_WAIT ||
+           s == TS_BUFN || s == TS_SPLIT_THROW;
+  }
+
+  void transition(per_thread& t, int to, const char* note) {
+    int from = t.state;
+    if (from == to) return;
+    // The blocked interval spans the whole blocked *family* — a thread
+    // escalated BLOCKED→BUFN_THROW→BUFN_WAIT→BUFN is blocked the entire
+    // time, so the clock starts on family entry and stops on family exit.
+    if (!is_blocked_family(from) && is_blocked_family(to)) {
+      t.block_start_ns = now_ns();
+    } else if (is_blocked_family(from) && !is_blocked_family(to)) {
+      if (t.block_start_ns) {
+        t.metrics.block_time_ns += now_ns() - t.block_start_ns;
+        t.block_start_ns = 0;
+      }
+    }
+    t.state = to;
+    log_op("transition", t.thread_id, t.thread_id, t.task_id, from, to, note);
+  }
+
+  bool try_reserve_locked(per_thread* t, int64_t bytes) {
+    if (pool_used_ + bytes > pool_limit_) return false;
+    pool_used_ += bytes;
+    if (t) {
+      t->device_reserved += bytes;
+      t->metrics.max_device_reserved =
+          std::max(t->metrics.max_device_reserved, t->device_reserved);
+    }
+    return true;
+  }
+
+  void dealloc_core_locked(per_thread& t, int64_t bytes) {
+    bytes = std::min(bytes, t.device_reserved);
+    t.device_reserved -= bytes;
+    pool_used_ -= bytes;
+  }
+
+  void account_thrown_retry_locked(per_thread& t, bool split) {
+    if (split) t.metrics.num_split_retry_oom++; else t.metrics.num_retry_oom++;
+    if (t.compute_start_ns) {
+      t.metrics.lost_compute_time_ns += now_ns() - t.compute_start_ns;
+      t.compute_start_ns = now_ns();
+    }
+  }
+
+  // Gate run before every allocation attempt (reference pre_alloc_core :1236):
+  // resolves BUFN states, applies test injection, then RUNNING→ALLOC.
+  int apply_injection_locked(per_thread& t, bool is_for_cpu) {
+    if (!t.injection.applies(is_for_cpu)) return RM_OK;
+    if (t.injection.skip_count > 0) {
+      t.injection.skip_count--;
+      return RM_OK;
+    }
+    t.injection.num_ooms--;
+    int kind = t.injection.kind;
+    if (kind == RM_RETRY_OOM) {
+      account_thrown_retry_locked(t, false);
+      return is_for_cpu ? RM_CPU_RETRY_OOM : RM_RETRY_OOM;
+    }
+    if (kind == RM_SPLIT_AND_RETRY_OOM) {
+      account_thrown_retry_locked(t, true);
+      return is_for_cpu ? RM_CPU_SPLIT_AND_RETRY_OOM : RM_SPLIT_AND_RETRY_OOM;
+    }
+    return RM_INJECTED_EXCEPTION;
+  }
+
+  int pre_alloc_locked(std::unique_lock<std::mutex>& lk, per_thread& t,
+                       bool is_for_cpu) {
+    int rc = block_until_ready_locked(lk, t);
+    if (rc != RM_OK) return rc;
+    rc = apply_injection_locked(t, is_for_cpu);
+    if (rc != RM_OK) return rc;
+    if (t.state == TS_RUNNING) transition(t, TS_ALLOC, "pre_alloc");
+    return RM_OK;
+  }
+
+  void post_alloc_success_locked(per_thread& t, int64_t /*bytes*/) {
+    if (t.state == TS_ALLOC || t.state == TS_ALLOC_FREE)
+      transition(t, TS_RUNNING, "post_alloc_success");
+    t.retry_loops = 0;
+    // If a free raced with our alloc, others may fit now (reference :1379).
+    wake_next_highest_priority_blocked_locked(false, "post_alloc_success");
+  }
+
+  // After a failed reservation: ALLOC_FREE ⇒ retry immediately (a free
+  // happened mid-alloc); otherwise block until woken or escalated
+  // (reference post_alloc_failed_core :1685).
+  int post_alloc_failed_locked(std::unique_lock<std::mutex>& lk, per_thread& t,
+                               bool was_oom, bool cpu) {
+    if (!was_oom) {
+      if (t.state == TS_ALLOC || t.state == TS_ALLOC_FREE)
+        transition(t, TS_RUNNING, "post_alloc_failed_not_oom");
+      return RM_INJECTED_EXCEPTION;
+    }
+    if (++t.retry_loops > kMaxRetryLoops) {
+      transition(t, TS_RUNNING, "retry_cap_exceeded");
+      return RM_FATAL_OOM;
+    }
+    if (t.state == TS_ALLOC_FREE) {
+      transition(t, TS_RUNNING, "alloc_free_fast_retry");
+      return RM_OK;
+    }
+    // Task purged while we were out doing the allocation: unwind instead of
+    // blocking (the state machine would otherwise never wake us).
+    if (t.state == TS_REMOVE_THROW) return block_until_ready_locked(lk, t);
+    transition(t, TS_BLOCKED, "post_alloc_failed");
+    t.blocked_is_cpu = cpu;
+    check_and_update_for_bufn_locked(lk);
+    return block_until_ready_locked(lk, t);
+  }
+
+  // Sit on the condvar while BLOCKED/BUFN; convert escalation states into
+  // returned "throws" (reference block_thread_until_ready :1036-1089).
+  int block_until_ready_locked(std::unique_lock<std::mutex>& lk, per_thread& t) {
+    while (true) {
+      switch (t.state) {
+        case TS_BLOCKED:
+        case TS_BUFN:
+          t.cv.wait(lk);
+          break;
+        case TS_BUFN_THROW:
+          transition(t, TS_BUFN_WAIT, "throwing_retry_oom");
+          account_thrown_retry_locked(t, false);
+          return t.blocked_is_cpu ? RM_CPU_RETRY_OOM : RM_RETRY_OOM;
+        case TS_BUFN_WAIT:
+          // The thread rolled back to a spillable state and re-entered: now
+          // it waits for another task to make progress.
+          transition(t, TS_BUFN, "bufn_wait_to_bufn");
+          check_and_update_for_bufn_locked(lk);
+          // Re-check: escalation may have already picked us for a split.
+          if (t.state == TS_BUFN) t.cv.wait(lk);
+          break;
+        case TS_SPLIT_THROW:
+          transition(t, TS_RUNNING, "throwing_split_and_retry_oom");
+          account_thrown_retry_locked(t, true);
+          return t.blocked_is_cpu ? RM_CPU_SPLIT_AND_RETRY_OOM
+                                  : RM_SPLIT_AND_RETRY_OOM;
+        case TS_REMOVE_THROW: {
+          transition(t, TS_RUNNING, "task_removed");
+          // The task is gone: hand its reservations back to the pool. Any
+          // later dealloc from the unwinding caller lands in the unregistered
+          // branch, which is clamped so it cannot double-free.
+          if (t.device_reserved > 0) pool_used_ -= t.device_reserved;
+          threads_.erase(t.thread_id);
+          wake_next_highest_priority_blocked_locked(false, "task_removed");
+          return RM_TASK_REMOVED;
+        }
+        default:
+          return RM_OK;
+      }
+    }
+  }
+
+  void wake_next_highest_priority_blocked_locked(bool cpu, const char* note) {
+    per_thread* best = nullptr;
+    for (auto& [tid, t] : threads_) {
+      if (t.state != TS_BLOCKED || t.blocked_is_cpu != cpu) continue;
+      if (!best || t.priority() < best->priority()) best = &t;
+    }
+    if (best) {
+      transition(*best, TS_RUNNING, note);
+      best->cv.notify_all();
+    }
+  }
+
+  void wake_bufn_threads_locked(const char* note) {
+    for (auto& [tid, t] : threads_) {
+      if (t.state == TS_BUFN) {
+        transition(t, TS_RUNNING, note);
+        t.cv.notify_all();
+      }
+    }
+  }
+
+  // Deadlock detector + escalation (reference is_in_deadlock :1506 and
+  // check_and_update_for_bufn :1598):
+  //  * all task threads blocked, some merely BLOCKED  → lowest-priority
+  //    BLOCKED thread gets BUFN_THROW (roll back & retry);
+  //  * all task threads at BUFN                        → highest-priority BUFN
+  //    thread gets SPLIT_THROW (halve input & retry).
+  void check_and_update_for_bufn_locked(std::unique_lock<std::mutex>&) {
+    bool any_task_thread = false;
+    bool all_blocked = true;
+    for (auto& [tid, t] : threads_) {
+      if (t.is_task_less()) continue;  // shuffle threads don't gate deadlock
+      any_task_thread = true;
+      if (!t.counts_blocked_for_deadlock()) { all_blocked = false; break; }
+    }
+    if (!any_task_thread || !all_blocked) return;
+
+    per_thread* lowest_blocked = nullptr;
+    per_thread* highest_bufn = nullptr;
+    bool all_bufn = true;
+    for (auto& [tid, t] : threads_) {
+      if (t.is_task_less()) continue;
+      if (t.state == TS_BLOCKED) {
+        all_bufn = false;
+        if (!lowest_blocked || t.priority() > lowest_blocked->priority())
+          lowest_blocked = &t;
+      } else if (t.state == TS_BUFN) {
+        if (!highest_bufn || t.priority() < highest_bufn->priority())
+          highest_bufn = &t;
+      } else if (t.state == TS_BUFN_THROW || t.state == TS_BUFN_WAIT ||
+                 t.state == TS_SPLIT_THROW) {
+        // escalation already in flight; let it land first
+        return;
+      } else {
+        // waiting_on_pool etc. — treated as blocked but not escalatable
+        all_bufn = false;
+      }
+    }
+    if (!all_bufn) {
+      if (lowest_blocked) {
+        transition(*lowest_blocked, TS_BUFN_THROW, "deadlock_break");
+        lowest_blocked->cv.notify_all();
+      }
+    } else if (highest_bufn) {
+      transition(*highest_bufn, TS_SPLIT_THROW, "bufn_escalate_split");
+      highest_bufn->cv.notify_all();
+    }
+  }
+
+  void checkpoint_metrics_locked(per_thread& t) {
+    long task = t.task_id;
+    if (task < 0 && !t.pool_task_ids.empty()) task = *t.pool_task_ids.begin();
+    if (task < 0) return;
+    task_metrics_[task].add(t.metrics);
+    t.metrics = task_metrics{};
+  }
+
+  void log_op(const char* op, long cur, long op_tid, long task, int from,
+              int to, const char* note) {
+    if (!log_) return;
+    fprintf(log_, "%lld,%s,%ld,%ld,%ld,%s,%s,%s\n",
+            (long long)now_ns(), op, cur, op_tid, task, state_name(from),
+            state_name(to), note);
+    fflush(log_);
+  }
+
+  std::mutex m_;
+  std::map<long, per_thread> threads_;
+  std::map<long, task_metrics> task_metrics_;
+  int64_t pool_limit_;
+  int64_t pool_used_ = 0;
+  int64_t untracked_reserved_ = 0;
+  FILE* log_ = nullptr;
+  bool owns_log_ = false;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* rm_create(long long pool_bytes, const char* log_path) {
+  return new resource_adaptor((int64_t)pool_bytes, log_path);
+}
+void rm_destroy(void* h) { delete (resource_adaptor*)h; }
+
+#define A ((resource_adaptor*)h)
+int rm_start_dedicated_task_thread(void* h, long tid, long task) {
+  return A->start_dedicated_task_thread(tid, task);
+}
+int rm_pool_thread_working_on_task(void* h, long tid, long task) {
+  return A->pool_thread_working_on_task(tid, task);
+}
+int rm_pool_thread_finished_for_tasks(void* h, long tid, const long* tasks,
+                                      int n) {
+  return A->pool_thread_finished_for_tasks(tid, tasks, n);
+}
+int rm_start_shuffle_thread(void* h, long tid) {
+  return A->start_shuffle_thread(tid);
+}
+int rm_remove_thread_association(void* h, long tid, long task) {
+  return A->remove_thread_association(tid, task);
+}
+int rm_task_done(void* h, long task) { return A->task_done(task); }
+int rm_start_retry_block(void* h, long tid) { return A->start_retry_block(tid); }
+int rm_end_retry_block(void* h, long tid) { return A->end_retry_block(tid); }
+int rm_force_oom(void* h, long tid, int kind, int num, int mode, int skip) {
+  return A->force_oom(tid, kind, num, mode, skip);
+}
+int rm_alloc(void* h, long tid, long long bytes) { return A->alloc(tid, bytes); }
+int rm_dealloc(void* h, long tid, long long bytes) {
+  return A->dealloc(tid, bytes);
+}
+int rm_cpu_prealloc(void* h, long tid, long long bytes, int blocking) {
+  return A->cpu_prealloc(tid, bytes, blocking);
+}
+int rm_cpu_postalloc_success(void* h, long tid, long long bytes) {
+  return A->cpu_postalloc_success(tid, bytes);
+}
+int rm_cpu_postalloc_failed(void* h, long tid, int was_oom, int blocking) {
+  return A->cpu_postalloc_failed(tid, was_oom, blocking);
+}
+int rm_cpu_dealloc(void* h, long tid, long long bytes) {
+  return A->cpu_dealloc(tid, bytes);
+}
+int rm_block_thread_until_ready(void* h, long tid) {
+  return A->block_thread_until_ready(tid);
+}
+int rm_submitting_to_pool(void* h, long tid, int flag) {
+  return A->submitting_to_pool(tid, flag);
+}
+int rm_waiting_on_pool(void* h, long tid, int flag) {
+  return A->waiting_on_pool(tid, flag);
+}
+int rm_check_and_break_deadlocks(void* h) { return A->check_and_break_deadlocks(); }
+int rm_get_state_of(void* h, long tid) { return A->get_state_of(tid); }
+long long rm_get_metric(void* h, long task, int which, int reset) {
+  return A->get_metric(task, which, reset);
+}
+long long rm_pool_used(void* h) { return A->pool_used(); }
+long long rm_pool_limit(void* h) { return A->pool_limit(); }
+#undef A
+
+}  // extern "C"

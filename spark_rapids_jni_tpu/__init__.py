@@ -11,7 +11,7 @@ This package rebuilds that surface TPU-first:
   * columnar/  - Column/Table representation (JAX pytrees: typed data +
                  validity masks + offsets children), host builders.
   * ops/       - Spark-semantics kernels as XLA/Pallas programs.
-  * mem/       - HBM reservation ledger + the Spark resource adaptor
+  * memory/    - HBM reservation ledger + the Spark resource adaptor
                  (retry-OOM state machine) implemented in native C++.
   * parquet/   - Thrift-compact footer parse/prune (native C++ with a
                  pure-Python fallback).

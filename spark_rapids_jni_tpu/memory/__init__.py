@@ -1,0 +1,44 @@
+"""Memory arbitration subsystem: the TPU-native rebuild of the reference's
+SparkResourceAdaptor / RmmSpark retry-OOM scheduler (SURVEY.md §5.3).
+
+Public surface:
+
+* :class:`RmmSpark` — static facade (thread/task registration, HBM
+  reservations, CPU alloc hooks, OOM injection, metrics).
+* :class:`SparkResourceAdaptor` — handle owner + deadlock watchdog.
+* :class:`ThreadState` — thread-state enum mirror.
+* the OOM exception taxonomy (``TpuRetryOOM``, ``TpuSplitAndRetryOOM``,
+  ``CpuRetryOOM``, ``CpuSplitAndRetryOOM``, ``TpuOOM``, ...).
+* :func:`with_retry` — convenience retry loop implementing the contract the
+  exceptions encode (roll back / split) for framework-internal callers.
+"""
+
+from .exceptions import (
+    CpuRetryOOM,
+    CpuSplitAndRetryOOM,
+    OffHeapOOM,
+    RetryStateException,
+    TaskRemovedException,
+    TpuOOM,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+)
+from .retry import with_retry
+from .rmm_spark import OOM_MODE_CPU, OOM_MODE_TPU, RmmSpark, SparkResourceAdaptor, ThreadState
+
+__all__ = [
+    "CpuRetryOOM",
+    "CpuSplitAndRetryOOM",
+    "OffHeapOOM",
+    "OOM_MODE_CPU",
+    "OOM_MODE_TPU",
+    "RetryStateException",
+    "RmmSpark",
+    "SparkResourceAdaptor",
+    "TaskRemovedException",
+    "ThreadState",
+    "TpuOOM",
+    "TpuRetryOOM",
+    "TpuSplitAndRetryOOM",
+    "with_retry",
+]

@@ -1,0 +1,93 @@
+"""ctypes loader for the native resource adaptor (libsparkrm.so).
+
+The reference ships its native layer inside the jar and loads it via
+NativeDepsLoader (reference: ParquetFooter.java:28-30). Here the shared
+library is built from ``native/resource_adaptor.cpp`` with g++ on first use
+and cached next to the package; the C ABI replaces the JNI shim layer
+(reference layer L3, SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_ROOT = os.path.dirname(_HERE)
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+_SRC = os.path.join(_REPO_ROOT, "native", "resource_adaptor.cpp")
+_SO = os.path.join(_PKG_ROOT, "_native", "libsparkrm.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+        "-o", _SO, _SRC, "-lpthread",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"failed to build {_SO} from {_SRC}:\n{proc.stderr}")
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    return os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library and declare signatures."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _stale():
+            _build()
+        lib = ctypes.CDLL(_SO)
+
+        c = ctypes
+        lib.rm_create.restype = c.c_void_p
+        lib.rm_create.argtypes = [c.c_longlong, c.c_char_p]
+        lib.rm_destroy.restype = None
+        lib.rm_destroy.argtypes = [c.c_void_p]
+
+        def fn(name, restype, *argtypes):
+            f = getattr(lib, name)
+            f.restype = restype
+            f.argtypes = list(argtypes)
+
+        H, L, LL, I = c.c_void_p, c.c_long, c.c_longlong, c.c_int
+        fn("rm_start_dedicated_task_thread", I, H, L, L)
+        fn("rm_pool_thread_working_on_task", I, H, L, L)
+        fn("rm_pool_thread_finished_for_tasks", I, H, L,
+           c.POINTER(c.c_long), I)
+        fn("rm_start_shuffle_thread", I, H, L)
+        fn("rm_remove_thread_association", I, H, L, L)
+        fn("rm_task_done", I, H, L)
+        fn("rm_start_retry_block", I, H, L)
+        fn("rm_end_retry_block", I, H, L)
+        fn("rm_force_oom", I, H, L, I, I, I, I)
+        fn("rm_alloc", I, H, L, LL)
+        fn("rm_dealloc", I, H, L, LL)
+        fn("rm_cpu_prealloc", I, H, L, LL, I)
+        fn("rm_cpu_postalloc_success", I, H, L, LL)
+        fn("rm_cpu_postalloc_failed", I, H, L, I, I)
+        fn("rm_cpu_dealloc", I, H, L, LL)
+        fn("rm_block_thread_until_ready", I, H, L)
+        fn("rm_submitting_to_pool", I, H, L, I)
+        fn("rm_waiting_on_pool", I, H, L, I)
+        fn("rm_check_and_break_deadlocks", I, H)
+        fn("rm_get_state_of", I, H, L)
+        fn("rm_get_metric", LL, H, L, I, I)
+        fn("rm_pool_used", LL, H)
+        fn("rm_pool_limit", LL, H)
+
+        _lib = lib
+        return _lib

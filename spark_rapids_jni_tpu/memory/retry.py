@@ -1,0 +1,89 @@
+"""Retry-loop helper implementing the OOM-exception contract.
+
+The reference leaves the retry loop to the spark-rapids plugin
+(RmmRapidsRetryIterator); the JNI layer only defines the exceptions and the
+state machine. This helper is the minimal in-framework equivalent so tests
+and internal callers can exercise the full roll-back / split protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, TypeVar
+
+from .exceptions import (
+    CpuRetryOOM,
+    CpuSplitAndRetryOOM,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+)
+from .rmm_spark import RmmSpark
+
+T = TypeVar("T")
+A = TypeVar("A")
+
+
+def with_retry(
+    attempt: Callable[[A], T],
+    arg: A,
+    split: Callable[[A], List[A]] = None,
+    rollback: Callable[[], None] = None,
+    max_retries: int = 100,
+) -> List[T]:
+    """Run ``attempt(arg)`` under the retry-OOM protocol.
+
+    * On ``TpuRetryOOM``/``CpuRetryOOM``: call ``rollback()`` (release
+      spillable state), ``block_thread_until_ready()``, and retry.
+    * On ``TpuSplitAndRetryOOM``/``CpuSplitAndRetryOOM``: call ``split(arg)``
+      to divide the input, then process each piece under the same protocol.
+
+    Returns the list of results (one per final piece).
+    """
+    pending: List[A] = [arg]
+    out: List[T] = []
+    retries = 0
+
+    def bump():
+        nonlocal retries
+        retries += 1
+        if retries > max_retries:
+            raise TpuRetryOOM(f"gave up after {max_retries} retries")
+
+    def do_split():
+        if split is None:
+            raise
+        pieces = split(pending[0])
+        if not pieces or len(pieces) < 2:
+            raise
+        pending[0:1] = list(pieces)
+
+    RmmSpark.start_retry_block()
+    try:
+        while pending:
+            try:
+                out.append(attempt(pending[0]))
+                pending.pop(0)
+            except (TpuRetryOOM, CpuRetryOOM):
+                bump()
+                if rollback is not None:
+                    rollback()
+                # Re-entering the gate may itself escalate: the machine hands
+                # a BUFN thread SplitAndRetryOOM (or another RetryOOM) from
+                # block_thread_until_ready, not only from alloc.
+                while True:
+                    try:
+                        RmmSpark.block_thread_until_ready()
+                        break
+                    except (TpuSplitAndRetryOOM, CpuSplitAndRetryOOM):
+                        bump()
+                        do_split()
+                        break
+                    except (TpuRetryOOM, CpuRetryOOM):
+                        bump()
+                        if rollback is not None:
+                            rollback()
+            except (TpuSplitAndRetryOOM, CpuSplitAndRetryOOM):
+                bump()
+                do_split()
+        return out
+    finally:
+        RmmSpark.end_retry_block()

@@ -1,0 +1,403 @@
+"""RmmSpark-equivalent facade over the native resource adaptor.
+
+Reference surface: RmmSpark.java (static facade: thread/task registration
+:131-236, retry-block bracketing :242-274, blockThreadUntilReady :417-428,
+OOM injection :435-515, per-task metrics :533-590, CPU alloc hooks :601-664)
+plus SparkResourceAdaptor.java (owns the native handle and a 100 ms watchdog
+daemon calling checkAndBreakDeadlocks, :35-79).
+
+TPU adaptation: the "RMM pool" is an HBM *reservation* budget. Device work is
+bracketed by ``alloc(bytes)`` / ``dealloc(bytes)`` reservations taken before
+XLA executables launch (allocations inside compiled programs cannot be
+intercepted per-op the way RMM intercepts cudaMalloc; see SURVEY.md §7
+hard-part 4). The state machine, priorities, BUFN and split-and-retry
+escalation behave as in the reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import weakref
+from typing import Dict, Optional
+
+from . import native
+from .exceptions import (
+    RM_INJECTED_EXCEPTION,
+    RM_OK,
+    RM_RETRY_OOM,
+    RM_SPLIT_AND_RETRY_OOM,
+    raise_for_status,
+)
+
+
+class ThreadState:
+    """Java mirror: RmmSparkThreadState.java:23-34."""
+    UNKNOWN = -1
+    RUNNING = 0
+    ALLOC = 1
+    ALLOC_FREE = 2
+    BLOCKED = 3
+    BUFN_THROW = 4
+    BUFN_WAIT = 5
+    BUFN = 6
+    SPLIT_THROW = 7
+    REMOVE_THROW = 8
+
+    _NAMES = {
+        -1: "UNKNOWN", 0: "RUNNING", 1: "ALLOC", 2: "ALLOC_FREE",
+        3: "BLOCKED", 4: "BUFN_THROW", 5: "BUFN_WAIT", 6: "BUFN",
+        7: "SPLIT_THROW", 8: "REMOVE_THROW",
+    }
+
+    @classmethod
+    def name(cls, v: int) -> str:
+        return cls._NAMES.get(v, "UNKNOWN")
+
+
+# metric selectors shared with the native side (rm_get_metric)
+_METRIC_RETRY = 0
+_METRIC_SPLIT_RETRY = 1
+_METRIC_BLOCK_TIME = 2
+_METRIC_LOST_TIME = 3
+_METRIC_MAX_RESERVED = 4
+
+# oom_mode bits for injection
+OOM_MODE_TPU = 1
+OOM_MODE_CPU = 2
+
+
+class SparkResourceAdaptor:
+    """Owns the native adaptor handle and the deadlock watchdog daemon.
+
+    Reference: SparkResourceAdaptor.java:35-79 — the watchdog polls
+    checkAndBreakDeadlocks every 100 ms (system property
+    ``ai.rapids.cudf.spark.rmmWatchdogPollingPeriod``); here the period is the
+    ``watchdog_period_s`` constructor arg.
+    """
+
+    def __init__(self, pool_bytes: int, log_loc: Optional[str] = None,
+                 watchdog_period_s: float = 0.1):
+        self._lib = native.load()
+        loc = (log_loc or "").encode()
+        self._handle = self._lib.rm_create(pool_bytes, loc)
+        if not self._handle:
+            raise RuntimeError("failed to create native resource adaptor")
+        self._closed = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watch, args=(watchdog_period_s,),
+            name="rmm-spark-watchdog", daemon=True)
+        self._watchdog.start()
+
+    def _watch(self, period: float) -> None:
+        while not self._closed.wait(period):
+            h = self._handle
+            if h:
+                self._lib.rm_check_and_break_deadlocks(h)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._watchdog.join(timeout=2.0)
+        h, self._handle = self._handle, None
+        if h:
+            self._lib.rm_destroy(h)
+
+    # -- thin checked wrappers ------------------------------------------------
+
+    def _ck(self, code: int, what: str) -> None:
+        raise_for_status(code, what)
+
+    def start_dedicated_task_thread(self, tid: int, task_id: int) -> None:
+        self._ck(self._lib.rm_start_dedicated_task_thread(
+            self._handle, tid, task_id), "start_dedicated_task_thread")
+
+    def pool_thread_working_on_task(self, tid: int, task_id: int) -> None:
+        self._ck(self._lib.rm_pool_thread_working_on_task(
+            self._handle, tid, task_id), "pool_thread_working_on_task")
+
+    def pool_thread_finished_for_tasks(self, tid: int, task_ids) -> None:
+        arr = (ctypes.c_long * len(task_ids))(*task_ids)
+        self._ck(self._lib.rm_pool_thread_finished_for_tasks(
+            self._handle, tid, arr, len(task_ids)),
+            "pool_thread_finished_for_tasks")
+
+    def start_shuffle_thread(self, tid: int) -> None:
+        self._ck(self._lib.rm_start_shuffle_thread(self._handle, tid),
+                 "start_shuffle_thread")
+
+    def remove_thread_association(self, tid: int, task_id: int = -1) -> None:
+        self._ck(self._lib.rm_remove_thread_association(
+            self._handle, tid, task_id), "remove_thread_association")
+
+    def task_done(self, task_id: int) -> None:
+        self._ck(self._lib.rm_task_done(self._handle, task_id), "task_done")
+
+    def alloc(self, tid: int, nbytes: int) -> None:
+        self._ck(self._lib.rm_alloc(self._handle, tid, nbytes),
+                 f"device reservation of {nbytes} bytes")
+
+    def dealloc(self, tid: int, nbytes: int) -> None:
+        self._ck(self._lib.rm_dealloc(self._handle, tid, nbytes), "dealloc")
+
+    def block_thread_until_ready(self, tid: int) -> None:
+        self._ck(self._lib.rm_block_thread_until_ready(self._handle, tid),
+                 "block_thread_until_ready")
+
+    def get_state_of(self, tid: int) -> int:
+        return self._lib.rm_get_state_of(self._handle, tid)
+
+    def pool_used(self) -> int:
+        return self._lib.rm_pool_used(self._handle)
+
+
+class RmmSpark:
+    """Static facade (reference RmmSpark.java). One process-wide adaptor."""
+
+    _adaptor: Optional[SparkResourceAdaptor] = None
+    _lock = threading.Lock()
+    _tid_counter = 0
+    _tid_map: Dict[int, tuple] = {}  # ident -> (weakref to Thread, tid)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def set_event_handler(cls, pool_bytes: int,
+                          log_loc: Optional[str] = None,
+                          watchdog_period_s: float = 0.1) -> None:
+        """Install the adaptor (reference RmmSpark.setEventHandler :59-116)."""
+        with cls._lock:
+            if cls._adaptor is not None:
+                raise RuntimeError("event handler already installed")
+            cls._adaptor = SparkResourceAdaptor(
+                pool_bytes, log_loc, watchdog_period_s)
+
+    @classmethod
+    def clear_event_handler(cls) -> None:
+        with cls._lock:
+            if cls._adaptor is not None:
+                cls._adaptor.close()
+                cls._adaptor = None
+            cls._tid_map.clear()
+
+    @classmethod
+    def _adp(cls) -> SparkResourceAdaptor:
+        a = cls._adaptor
+        if a is None:
+            raise RuntimeError("RmmSpark event handler is not installed")
+        return a
+
+    @classmethod
+    def get_current_thread_id(cls) -> int:
+        """Stable small id for the current python thread (the reference uses
+        the native OS thread id, RmmSpark.getCurrentThreadId).
+
+        CPython reuses ``get_ident`` values after thread death, so entries are
+        keyed to the current ``Thread`` object (held weakly): a fresh thread
+        that inherits a dead thread's ident gets a fresh id rather than the
+        dead thread's native state.
+        """
+        ident = threading.get_ident()
+        cur = threading.current_thread()
+        with cls._lock:
+            entry = cls._tid_map.get(ident)
+            if entry is not None:
+                ref, tid = entry
+                if ref() is cur:
+                    return tid
+            cls._tid_counter += 1
+            tid = cls._tid_counter
+            cls._tid_map[ident] = (weakref.ref(cur), tid)
+            # Opportunistically drop entries whose threads died.
+            dead = [k for k, (r, _) in cls._tid_map.items() if r() is None]
+            for k in dead:
+                del cls._tid_map[k]
+            return tid
+
+    # -- registration (reference RmmSpark.java:131-236) ----------------------
+
+    @classmethod
+    def current_thread_is_dedicated_to_task(cls, task_id: int) -> None:
+        cls._adp().start_dedicated_task_thread(
+            cls.get_current_thread_id(), task_id)
+
+    @classmethod
+    def shuffle_thread_working_on_tasks(cls, task_ids) -> None:
+        tid = cls.get_current_thread_id()
+        cls._adp().start_shuffle_thread(tid)
+        for t in task_ids:
+            cls._adp().pool_thread_working_on_task(tid, t)
+
+    @classmethod
+    def pool_thread_working_on_task(cls, task_id: int) -> None:
+        cls._adp().pool_thread_working_on_task(
+            cls.get_current_thread_id(), task_id)
+
+    @classmethod
+    def pool_thread_finished_for_tasks(cls, task_ids) -> None:
+        cls._adp().pool_thread_finished_for_tasks(
+            cls.get_current_thread_id(), list(task_ids))
+
+    @classmethod
+    def remove_current_thread_association(cls, task_id: int = -1) -> None:
+        cls._adp().remove_thread_association(
+            cls.get_current_thread_id(), task_id)
+
+    @classmethod
+    def task_done(cls, task_id: int) -> None:
+        cls._adp().task_done(task_id)
+
+    # -- device reservations -------------------------------------------------
+
+    @classmethod
+    def alloc(cls, nbytes: int) -> None:
+        cls._adp().alloc(cls.get_current_thread_id(), nbytes)
+
+    @classmethod
+    def dealloc(cls, nbytes: int) -> None:
+        cls._adp().dealloc(cls.get_current_thread_id(), nbytes)
+
+    @classmethod
+    def block_thread_until_ready(cls) -> None:
+        """Reference RmmSpark.blockThreadUntilReady :417-428 — called after a
+        retry-OOM rollback, before resuming work."""
+        cls._adp().block_thread_until_ready(cls.get_current_thread_id())
+
+    # -- retry-block bracketing (reference :242-274) -------------------------
+
+    @classmethod
+    def start_retry_block(cls, tid: Optional[int] = None) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_start_retry_block(
+            a._handle, tid if tid is not None else cls.get_current_thread_id()),
+            "start_retry_block")
+
+    @classmethod
+    def end_retry_block(cls, tid: Optional[int] = None) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_end_retry_block(
+            a._handle, tid if tid is not None else cls.get_current_thread_id()),
+            "end_retry_block")
+
+    # -- pool-wait markers (python-UDF protocol, reference :632-650) ---------
+
+    @classmethod
+    def submitting_to_pool(cls, flag: bool = True) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_submitting_to_pool(
+            a._handle, cls.get_current_thread_id(), int(flag)),
+            "submitting_to_pool")
+
+    @classmethod
+    def waiting_on_pool(cls, flag: bool = True) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_waiting_on_pool(
+            a._handle, cls.get_current_thread_id(), int(flag)),
+            "waiting_on_pool")
+
+    @classmethod
+    def done_waiting(cls) -> None:
+        a = cls._adp()
+        tid = cls.get_current_thread_id()
+        raise_for_status(a._lib.rm_submitting_to_pool(a._handle, tid, 0),
+                         "done_waiting")
+        raise_for_status(a._lib.rm_waiting_on_pool(a._handle, tid, 0),
+                         "done_waiting")
+
+    # -- CPU off-heap hooks (reference RmmSpark.java:601-664) ----------------
+
+    @classmethod
+    def pre_cpu_alloc(cls, nbytes: int, blocking: bool = True) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_cpu_prealloc(
+            a._handle, cls.get_current_thread_id(), nbytes, int(blocking)),
+            "pre_cpu_alloc")
+
+    @classmethod
+    def post_cpu_alloc_success(cls, nbytes: int) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_cpu_postalloc_success(
+            a._handle, cls.get_current_thread_id(), nbytes),
+            "post_cpu_alloc_success")
+
+    @classmethod
+    def post_cpu_alloc_failed(cls, was_oom: bool = True,
+                              blocking: bool = True) -> None:
+        """Raises the mapped OOM if the state machine escalates; returns when
+        the caller should simply retry the host allocation."""
+        a = cls._adp()
+        raise_for_status(a._lib.rm_cpu_postalloc_failed(
+            a._handle, cls.get_current_thread_id(), int(was_oom),
+            int(blocking)), "post_cpu_alloc_failed")
+
+    @classmethod
+    def cpu_dealloc(cls, nbytes: int) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_cpu_dealloc(
+            a._handle, cls.get_current_thread_id(), nbytes), "cpu_dealloc")
+
+    # -- OOM / exception injection (reference :435-515) ----------------------
+
+    @classmethod
+    def force_retry_oom(cls, tid: int, num_ooms: int = 1,
+                        oom_mode: int = OOM_MODE_TPU, skip: int = 0) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_force_oom(
+            a._handle, tid, RM_RETRY_OOM, num_ooms, oom_mode, skip),
+            "force_retry_oom")
+
+    @classmethod
+    def force_split_and_retry_oom(cls, tid: int, num_ooms: int = 1,
+                                  oom_mode: int = OOM_MODE_TPU,
+                                  skip: int = 0) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_force_oom(
+            a._handle, tid, RM_SPLIT_AND_RETRY_OOM, num_ooms, oom_mode, skip),
+            "force_split_and_retry_oom")
+
+    @classmethod
+    def force_exception(cls, tid: int, num: int = 1,
+                        oom_mode: int = OOM_MODE_TPU, skip: int = 0) -> None:
+        a = cls._adp()
+        raise_for_status(a._lib.rm_force_oom(
+            a._handle, tid, RM_INJECTED_EXCEPTION, num, oom_mode, skip),
+            "force_exception")
+
+    # -- state / metrics (reference :533-590) --------------------------------
+
+    @classmethod
+    def get_state_of(cls, tid: int) -> int:
+        return cls._adp().get_state_of(tid)
+
+    @classmethod
+    def _metric(cls, task_id: int, which: int, reset: bool) -> int:
+        a = cls._adp()
+        return a._lib.rm_get_metric(a._handle, task_id, which, int(reset))
+
+    @classmethod
+    def get_and_reset_num_retry(cls, task_id: int) -> int:
+        return cls._metric(task_id, _METRIC_RETRY, True)
+
+    @classmethod
+    def get_and_reset_num_split_retry(cls, task_id: int) -> int:
+        return cls._metric(task_id, _METRIC_SPLIT_RETRY, True)
+
+    @classmethod
+    def get_and_reset_block_time_ns(cls, task_id: int) -> int:
+        return cls._metric(task_id, _METRIC_BLOCK_TIME, True)
+
+    @classmethod
+    def get_and_reset_compute_time_lost_to_retry_ns(cls, task_id: int) -> int:
+        return cls._metric(task_id, _METRIC_LOST_TIME, True)
+
+    @classmethod
+    def get_and_reset_max_device_reserved(cls, task_id: int) -> int:
+        return cls._metric(task_id, _METRIC_MAX_RESERVED, True)
+
+    @classmethod
+    def pool_used(cls) -> int:
+        return cls._adp().pool_used()
+
+    @classmethod
+    def check_and_break_deadlocks(cls) -> None:
+        a = cls._adp()
+        a._lib.rm_check_and_break_deadlocks(a._handle)

@@ -1,0 +1,447 @@
+"""Tier-3 concurrency tests for the retry-OOM resource scheduler.
+
+Ports the reference's test strategy (SURVEY.md §4 tier 3): RmmSparkTest.java's
+``TaskThread`` actor harness — a queue of operations per simulated Spark task
+thread, driven deterministically — asserting state-machine transitions via
+``get_state_of``, OOM injection, BUFN/split escalation, metrics, and the CPU
+off-heap hook protocol (LimitingOffHeapAllocForTests.java:33-79).
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.memory import (
+    CpuRetryOOM,
+    OOM_MODE_CPU,
+    RetryStateException,
+    RmmSpark,
+    TaskRemovedException,
+    ThreadState,
+    TpuOOM,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    with_retry,
+)
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def adaptor():
+    RmmSpark.set_event_handler(pool_bytes=100 * MB, watchdog_period_s=0.05)
+    try:
+        yield
+    finally:
+        RmmSpark.clear_event_handler()
+
+
+class TaskThread:
+    """Actor harness: a thread executing closures from a queue, reporting
+    results/exceptions through per-op futures (reference
+    RmmSparkTest.java:64-300)."""
+
+    def __init__(self, name):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+        self.tid = self.do(RmmSpark.get_current_thread_id).result()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                fut["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 - relayed to the test
+                fut["error"] = e
+            finally:
+                fut["event"].set()
+
+    def do(self, fn, *args):
+        fut = {"event": threading.Event(), "value": None, "error": None}
+        self._q.put(((lambda: fn(*args)), fut))
+
+        class _F:
+            def result(self, timeout=10.0):
+                if not fut["event"].wait(timeout):
+                    raise TimeoutError(f"op did not finish within {timeout}s")
+                if fut["error"] is not None:
+                    raise fut["error"]
+                return fut["value"]
+
+            def done(self):
+                return fut["event"].is_set()
+
+        return _F()
+
+    def stop(self):
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
+def wait_for_state(tid, state, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if RmmSpark.get_state_of(tid) == state:
+            return
+        time.sleep(0.002)
+    raise AssertionError(
+        f"thread {tid} never reached {ThreadState.name(state)}; at "
+        f"{ThreadState.name(RmmSpark.get_state_of(tid))}")
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_register_and_state(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        assert RmmSpark.get_state_of(t.tid) == ThreadState.RUNNING
+        t.do(RmmSpark.task_done, 1).result()
+        assert RmmSpark.get_state_of(t.tid) == ThreadState.UNKNOWN
+    finally:
+        t.stop()
+
+
+def test_alloc_dealloc_accounting(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        t.do(RmmSpark.alloc, 10 * MB).result()
+        assert RmmSpark.pool_used() == 10 * MB
+        t.do(RmmSpark.alloc, 5 * MB).result()
+        assert RmmSpark.pool_used() == 15 * MB
+        t.do(RmmSpark.dealloc, 15 * MB).result()
+        assert RmmSpark.pool_used() == 0
+        assert RmmSpark.get_and_reset_max_device_reserved(1) == 15 * MB
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_block_and_wake_on_free(adaptor):
+    a, b = TaskThread("a"), TaskThread("b")
+    try:
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        b.do(RmmSpark.current_thread_is_dedicated_to_task, 2).result()
+        a.do(RmmSpark.alloc, 90 * MB).result()
+        # b cannot fit; with task 1 still runnable this is not a deadlock,
+        # so b just blocks.
+        fut = b.do(RmmSpark.alloc, 50 * MB)
+        wait_for_state(b.tid, ThreadState.BLOCKED)
+        assert not fut.done()
+        a.do(RmmSpark.dealloc, 90 * MB).result()
+        fut.result()  # woken and satisfied
+        assert RmmSpark.pool_used() == 50 * MB
+        b.do(RmmSpark.dealloc, 50 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+        b.do(RmmSpark.task_done, 2).result()
+        blocked_ns = RmmSpark.get_and_reset_block_time_ns(2)
+        assert blocked_ns > 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_single_task_escalates_retry_then_split(adaptor):
+    """A lone task that can never fit must get RetryOOM (roll back), and if
+    rolling back doesn't help, SplitAndRetryOOM (reference
+    check_and_update_for_bufn :1598-1672)."""
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        t.do(RmmSpark.alloc, 80 * MB).result()
+        with pytest.raises(TpuRetryOOM):
+            t.do(RmmSpark.alloc, 50 * MB).result()
+        assert RmmSpark.get_state_of(t.tid) == ThreadState.BUFN_WAIT
+        # The thread "rolls back to a spillable state" (here: nothing to
+        # spill) and re-enters; with every task at BUFN the machine must
+        # escalate to split-and-retry.
+        with pytest.raises(TpuSplitAndRetryOOM):
+            t.do(RmmSpark.block_thread_until_ready).result()
+        # Halved input now fits.
+        t.do(RmmSpark.alloc, 20 * MB).result()
+        t.do(RmmSpark.dealloc, 100 * MB).result()
+        assert RmmSpark.get_and_reset_num_retry(1) == 1
+        assert RmmSpark.get_and_reset_num_split_retry(1) == 1
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_lower_priority_task_rolls_back_first(adaptor):
+    """Older task (lower id) wins: when both tasks deadlock, the younger task
+    is chosen for BUFN_THROW (reference thread_priority :136-190)."""
+    a, b = TaskThread("a"), TaskThread("b")
+    try:
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        b.do(RmmSpark.current_thread_is_dedicated_to_task, 2).result()
+        a.do(RmmSpark.alloc, 60 * MB).result()
+        b.do(RmmSpark.alloc, 30 * MB).result()
+        fut_a = a.do(RmmSpark.alloc, 35 * MB)  # blocks: 95+35 > 100
+        wait_for_state(a.tid, ThreadState.BLOCKED)
+        # Now b also blocks -> deadlock -> the LOWER priority (task 2) thread
+        # must be the one escalated to roll back.
+        with pytest.raises(TpuRetryOOM):
+            b.do(RmmSpark.alloc, 20 * MB).result()
+        # b rolls back: releases its memory, which lets a proceed.
+        b.do(RmmSpark.dealloc, 30 * MB).result()
+        fut_a.result()
+        assert RmmSpark.get_state_of(a.tid) == ThreadState.RUNNING
+        a.do(RmmSpark.dealloc, 95 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+        b.do(RmmSpark.task_done, 2).result()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_force_retry_oom_injection(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        RmmSpark.force_retry_oom(t.tid, num_ooms=2, skip=1)
+        t.do(RmmSpark.alloc, MB).result()  # skipped
+        with pytest.raises(TpuRetryOOM):
+            t.do(RmmSpark.alloc, MB).result()
+        with pytest.raises(TpuRetryOOM):
+            t.do(RmmSpark.alloc, MB).result()
+        t.do(RmmSpark.alloc, MB).result()  # injection exhausted
+        t.do(RmmSpark.dealloc, 2 * MB).result()
+        assert RmmSpark.get_and_reset_num_retry(1) == 2
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_force_split_and_exception_injection(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        RmmSpark.force_split_and_retry_oom(t.tid, num_ooms=1)
+        with pytest.raises(TpuSplitAndRetryOOM):
+            t.do(RmmSpark.alloc, MB).result()
+        RmmSpark.force_exception(t.tid, num=1)
+        with pytest.raises(RetryStateException):
+            t.do(RmmSpark.alloc, MB).result()
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_task_done_unblocks_other_task(adaptor):
+    a, b = TaskThread("a"), TaskThread("b")
+    try:
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        b.do(RmmSpark.current_thread_is_dedicated_to_task, 2).result()
+        a.do(RmmSpark.alloc, 90 * MB).result()
+        fut = b.do(RmmSpark.alloc, 50 * MB)
+        wait_for_state(b.tid, ThreadState.BLOCKED)
+        # Task 1 finishing releases nothing by itself (reservations are
+        # per-thread), so free first, then finish.
+        a.do(RmmSpark.dealloc, 90 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+        fut.result()
+        b.do(RmmSpark.dealloc, 50 * MB).result()
+        b.do(RmmSpark.task_done, 2).result()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_blocked_thread_unwinds_when_task_removed(adaptor):
+    a, b = TaskThread("a"), TaskThread("b")
+    try:
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        b.do(RmmSpark.current_thread_is_dedicated_to_task, 2).result()
+        a.do(RmmSpark.alloc, 90 * MB).result()
+        fut = b.do(RmmSpark.alloc, 50 * MB)
+        wait_for_state(b.tid, ThreadState.BLOCKED)
+        RmmSpark.task_done(2)  # purge task 2 while its thread is blocked
+        with pytest.raises(TaskRemovedException):
+            fut.result()
+        a.do(RmmSpark.dealloc, 90 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_fatal_oom_when_request_exceeds_pool_unregistered(adaptor):
+    # Unregistered threads bypass the state machine: too-big request is fatal.
+    with pytest.raises(TpuOOM):
+        RmmSpark.alloc(200 * MB)
+
+
+def test_with_retry_protocol(adaptor):
+    """End-to-end: the retry helper reacts to RetryOOM by rolling back and to
+    SplitAndRetryOOM by halving, like the plugin's RmmRapidsRetryIterator."""
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+
+        held = []
+
+        def rollback():
+            while held:
+                RmmSpark.dealloc(held.pop())
+
+        def attempt(nbytes):
+            RmmSpark.alloc(nbytes)
+            held.append(nbytes)
+            return nbytes
+
+        def split(nbytes):
+            return [nbytes // 2, nbytes - nbytes // 2]
+
+        def run():
+            return with_retry(attempt, 80 * MB, split=split, rollback=rollback)
+
+        # Plenty of room: single piece.
+        assert t.do(run).result() == [80 * MB]
+        t.do(rollback).result()
+
+        # Injected retry then success.
+        RmmSpark.force_retry_oom(t.tid, num_ooms=1)
+        assert t.do(run).result() == [80 * MB]
+        t.do(rollback).result()
+
+        # Injected split: halves processed separately.
+        RmmSpark.force_split_and_retry_oom(t.tid, num_ooms=1)
+        assert t.do(run).result() == [40 * MB, 40 * MB]
+        t.do(rollback).result()
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+class LimitingHostAlloc:
+    """Host off-heap allocator with a hard cap, exercising the CPU hook
+    protocol (reference LimitingOffHeapAllocForTests.java:33-79)."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self.used = 0
+        self.lock = threading.Lock()
+
+    def alloc(self, nbytes):
+        while True:
+            RmmSpark.pre_cpu_alloc(nbytes, blocking=True)
+            with self.lock:
+                ok = self.used + nbytes <= self.limit
+                if ok:
+                    self.used += nbytes
+            if ok:
+                RmmSpark.post_cpu_alloc_success(nbytes)
+                return
+            # Raises CpuRetryOOM/CpuSplitAndRetryOOM on escalation; plain
+            # return means "retry the host alloc".
+            RmmSpark.post_cpu_alloc_failed(was_oom=True, blocking=True)
+
+    def free(self, nbytes):
+        with self.lock:
+            self.used -= nbytes
+        RmmSpark.cpu_dealloc(nbytes)
+
+
+def test_cpu_hooks_block_and_wake(adaptor):
+    host = LimitingHostAlloc(10 * MB)
+    a, b = TaskThread("a"), TaskThread("b")
+    try:
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        b.do(RmmSpark.current_thread_is_dedicated_to_task, 2).result()
+        a.do(host.alloc, 8 * MB).result()
+        fut = b.do(host.alloc, 5 * MB)
+        wait_for_state(b.tid, ThreadState.BLOCKED)
+        a.do(host.free, 8 * MB).result()
+        fut.result()
+        assert host.used == 5 * MB
+        b.do(host.free, 5 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+        b.do(RmmSpark.task_done, 2).result()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cpu_single_task_escalates(adaptor):
+    host = LimitingHostAlloc(10 * MB)
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        t.do(host.alloc, 8 * MB).result()
+        with pytest.raises(CpuRetryOOM):
+            t.do(host.alloc, 5 * MB).result()
+        t.do(host.free, 8 * MB).result()
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_cpu_injection(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        RmmSpark.force_retry_oom(t.tid, num_ooms=1, oom_mode=OOM_MODE_CPU)
+        with pytest.raises(CpuRetryOOM):
+            t.do(RmmSpark.pre_cpu_alloc, MB, True).result()
+        # device-side injection must NOT fire for cpu mode
+        t.do(RmmSpark.alloc, MB).result()
+        t.do(RmmSpark.dealloc, MB).result()
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
+
+
+def test_shuffle_thread_outranks_tasks(adaptor):
+    # Task thread c stays runnable throughout so no deadlock escalation fires;
+    # this isolates the wake-priority ordering (task-less shuffle first).
+    s, a, c = TaskThread("shuffle"), TaskThread("a"), TaskThread("c")
+    try:
+        s.do(RmmSpark.shuffle_thread_working_on_tasks, []).result()
+        a.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        c.do(RmmSpark.current_thread_is_dedicated_to_task, 3).result()
+        c.do(RmmSpark.alloc, 90 * MB).result()
+        fut_a = a.do(RmmSpark.alloc, 50 * MB)
+        wait_for_state(a.tid, ThreadState.BLOCKED)
+        fut_s = s.do(RmmSpark.alloc, 40 * MB)
+        wait_for_state(s.tid, ThreadState.BLOCKED)
+        # Free 60MB: the shuffle thread (higher priority) is woken first and
+        # fits its 40MB (used 30+40=70); a is then woken but 50MB cannot fit
+        # in the remaining 30MB, so it blocks again.
+        c.do(RmmSpark.dealloc, 60 * MB).result()
+        fut_s.result(timeout=5.0)
+        wait_for_state(a.tid, ThreadState.BLOCKED)
+        assert not fut_a.done()
+        s.do(RmmSpark.dealloc, 40 * MB).result()
+        fut_a.result()
+        a.do(RmmSpark.dealloc, 50 * MB).result()
+        c.do(RmmSpark.dealloc, 30 * MB).result()
+        a.do(RmmSpark.task_done, 1).result()
+        c.do(RmmSpark.task_done, 3).result()
+    finally:
+        s.stop()
+        a.stop()
+        c.stop()
+
+
+def test_metrics_lost_compute_time(adaptor):
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+        t.do(RmmSpark.start_retry_block).result()
+        time.sleep(0.01)
+        RmmSpark.force_retry_oom(t.tid, num_ooms=1)
+        with pytest.raises(TpuRetryOOM):
+            t.do(RmmSpark.alloc, MB).result()
+        t.do(RmmSpark.end_retry_block).result()
+        assert RmmSpark.get_and_reset_compute_time_lost_to_retry_ns(1) > 0
+        t.do(RmmSpark.task_done, 1).result()
+    finally:
+        t.stop()
