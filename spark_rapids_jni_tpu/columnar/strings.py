@@ -49,6 +49,17 @@ def padded_bytes(col: Column, multiple: int = 8) -> Tuple[jnp.ndarray, jnp.ndarr
     return jnp.where(in_range, gathered, jnp.uint8(0)), lengths
 
 
+def pack_byte_rows(parts, validity=None) -> Column:
+    """Build a STRING column from a python list of bytes objects (host path
+    for formatting ops whose output assembly is not vectorized)."""
+    lengths = np.array([len(p) for p in parts], dtype=np.int64)
+    width = max(1, int(lengths.max()) if len(parts) else 1)
+    mat = np.zeros((len(parts), width), dtype=np.uint8)
+    for i, p in enumerate(parts):
+        mat[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+    return from_padded_bytes(mat, lengths, validity)
+
+
 def from_padded_bytes(mat: np.ndarray, lengths: np.ndarray,
                       validity=None) -> Column:
     """Rebuild a STRING column from padded bytes + lengths (host path)."""

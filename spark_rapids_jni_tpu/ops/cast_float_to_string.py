@@ -1,0 +1,630 @@
+"""Java-compatible float/double → string (Spark `cast(x as string)`).
+
+Reference capability: cast_float_to_string.cu (126 LoC) + ftos_converter.cuh
+(1489 LoC) — a device port of the Ryu shortest-representation algorithm
+(tables at ftos_converter.cuh:48-457, digit emission :478-950) so that GPU
+output is byte-identical to JVM `Double.toString` / `Float.toString`.
+
+TPU-first design: Ryu is branchy per-row on a GPU, but every branch is
+fixed-width u64 integer math, so here the whole algorithm is *vectorized* —
+masks replace branches, the digit-strip loop becomes a bounded
+``lax.fori_loop`` over lanes, and the 128-bit multiplies are emulated with
+32-bit limb products (cf. ops/int128.py). The device core returns
+(digits:u64, e10:i32, flags) per row; final ASCII assembly (Java formatting
+rules: plain decimal for 1e-3 <= |x| < 1e7, else ``d.dddE±e`` scientific,
+"Infinity"/"NaN"/"-0.0") is cheap vectorized numpy on host.
+
+Ryu reference: Ulf Adams, "Ryū: fast float-to-string conversion" (PLDI'18);
+the table-generation formulas below follow the public algorithm description,
+re-derived for a vector machine rather than ported from the reference's CUDA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.strings import from_padded_bytes, pack_byte_rows
+
+# ---------------------------------------------------------------------------
+# table generation (host, python bignums, once at import)
+# ---------------------------------------------------------------------------
+
+_D_POW5_INV_BITS = 125
+_D_POW5_BITS = 125
+_F_POW5_INV_BITS = 59
+_F_POW5_BITS = 61
+
+
+def _pow5bits(e: int) -> int:
+    # number of bits of 5^e
+    return ((e * 1217359) >> 19) + 1
+
+
+def _log10_pow2(e: int) -> int:
+    return (e * 78913) >> 18
+
+
+def _log10_pow5(e: int) -> int:
+    return (e * 732923) >> 20
+
+
+def _gen_double_tables():
+    inv = np.zeros((292, 2), dtype=np.uint64)  # (hi, lo)
+    for q in range(292):
+        k = _D_POW5_INV_BITS + _pow5bits(q) - 1
+        v = (1 << k) // (5 ** q) + 1
+        inv[q, 0] = (v >> 64) & 0xFFFFFFFFFFFFFFFF
+        inv[q, 1] = v & 0xFFFFFFFFFFFFFFFF
+    pw = np.zeros((326, 2), dtype=np.uint64)
+    for i in range(326):
+        shift = _D_POW5_BITS - _pow5bits(i)
+        v = (5 ** i) << shift if shift >= 0 else (5 ** i) >> (-shift)
+        pw[i, 0] = (v >> 64) & 0xFFFFFFFFFFFFFFFF
+        pw[i, 1] = v & 0xFFFFFFFFFFFFFFFF
+    return inv, pw
+
+
+def _gen_float_tables():
+    inv = np.zeros(31, dtype=np.uint64)
+    for q in range(31):
+        k = _F_POW5_INV_BITS + _pow5bits(q) - 1
+        inv[q] = (1 << k) // (5 ** q) + 1
+    pw = np.zeros(48, dtype=np.uint64)
+    for i in range(48):
+        shift = _F_POW5_BITS - _pow5bits(i)
+        v = (5 ** i) << shift if shift >= 0 else (5 ** i) >> (-shift)
+        pw[i] = v
+    return inv, pw
+
+
+_D_INV_TABLE, _D_POW_TABLE = _gen_double_tables()
+_F_INV_TABLE, _F_POW_TABLE = _gen_float_tables()
+
+_U64 = jnp.uint64
+_I32 = jnp.int32
+
+
+def _u64(x):
+    return jnp.asarray(x, dtype=jnp.uint64)
+
+
+# ---------------------------------------------------------------------------
+# 64/128-bit helpers (vectorized)
+# ---------------------------------------------------------------------------
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _umul128(a, b):
+    """u64 × u64 → (hi, lo) via 32-bit limb products."""
+    a_lo = a & _M32
+    a_hi = a >> np.uint64(32)
+    b_lo = b & _M32
+    b_hi = b >> np.uint64(32)
+    ll = a_lo * b_lo
+    hl = a_hi * b_lo
+    lh = a_lo * b_hi
+    hh = a_hi * b_hi
+    cross = (ll >> np.uint64(32)) + (hl & _M32) + lh
+    lo = (cross << np.uint64(32)) | (ll & _M32)
+    hi = hh + (hl >> np.uint64(32)) + (cross >> np.uint64(32))
+    return hi, lo
+
+
+def _shr128(hi, lo, s):
+    """(hi:lo) >> s for 0 <= s < 64 (per-lane variable shift)."""
+    s = s.astype(jnp.uint64)
+    plain = (lo >> s) | jnp.where(
+        s == 0, _u64(0), hi << (np.uint64(64) - jnp.maximum(s, _u64(1))))
+    return plain
+
+
+def _mul_shift64(m, mul_hi, mul_lo, j):
+    """(m × mul) >> j for 128-bit mul and 64 <= j < 128 (Ryu mulShift64)."""
+    b0_hi, _b0_lo = _umul128(m, mul_lo)
+    b2_hi, b2_lo = _umul128(m, mul_hi)
+    s_lo = b0_hi + b2_lo
+    carry = (s_lo < b2_lo).astype(jnp.uint64)
+    s_hi = b2_hi + carry
+    return _shr128(s_hi, s_lo, (j - _I32(64)).astype(jnp.uint64))
+
+
+def _pow5_factor_ge(value, p, max_iter):
+    """True where value is divisible by 5^p (p >= 0, small)."""
+    count = jnp.zeros_like(value, dtype=jnp.int32)
+    v = value
+
+    def body(_, state):
+        v, count = state
+        divisible = (v % np.uint64(5)) == 0
+        v = jnp.where(divisible, v // np.uint64(5), v)
+        count = count + divisible.astype(jnp.int32)
+        return v, count
+
+    v, count = jax.lax.fori_loop(0, max_iter, body, (v, count))
+    return count >= p
+
+
+def _multiple_of_pow2(value, p):
+    mask = jnp.where(p >= 64, ~_u64(0),
+                     (_u64(1) << jnp.minimum(p, 63).astype(jnp.uint64)) - _u64(1))
+    return (value & mask) == 0
+
+
+# ---------------------------------------------------------------------------
+# d2s core (double)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit)
+def _ryu_d2s_core(bits):
+    """bits:u64[n] → (digits:u64, e10:i32, sign:bool, is_nan, is_inf, is_zero).
+
+    value = digits × 10^e10 (digits has no trailing zeros beyond Ryu's
+    shortest form)."""
+    sign = (bits >> np.uint64(63)) != 0
+    ieee_m = bits & np.uint64((1 << 52) - 1)
+    ieee_e = ((bits >> np.uint64(52)) & np.uint64(0x7FF)).astype(jnp.int32)
+
+    is_nan = (ieee_e == 0x7FF) & (ieee_m != 0)
+    is_inf = (ieee_e == 0x7FF) & (ieee_m == 0)
+    is_zero = (ieee_e == 0) & (ieee_m == 0)
+
+    subnormal = ieee_e == 0
+    e2 = jnp.where(subnormal, _I32(1 - 1023 - 52 - 2), ieee_e - 1023 - 52 - 2)
+    m2 = jnp.where(subnormal, ieee_m, ieee_m | np.uint64(1 << 52))
+    even = (m2 & _u64(1)) == 0
+    accept = even
+
+    mv = _u64(4) * m2
+    mm_shift = ((ieee_m != 0) | (ieee_e <= 1)).astype(jnp.uint64)
+    mp = mv + _u64(2)
+    mm = mv - _u64(1) - mm_shift
+
+    # --- base-10 conversion via pow5 / inverse pow5 tables ---
+    pos = e2 >= 0
+    # positive-exponent branch (q indexes the inverse table)
+    q_pos = jnp.maximum(
+        _I32(0),
+        ((e2 * 78913) >> 18) - (e2 > 3).astype(jnp.int32))
+    # negative-exponent branch
+    neg_e2 = -e2
+    q_neg = jnp.maximum(
+        _I32(0), ((neg_e2 * 732923) >> 20) - (neg_e2 > 1).astype(jnp.int32))
+
+    pow5bits_q_pos = ((q_pos * 1217359) >> 19) + 1
+    k_pos = _I32(_D_POW5_INV_BITS) + pow5bits_q_pos - 1
+    j_pos = -e2 + q_pos + k_pos
+
+    i_neg = neg_e2 - q_neg
+    pow5bits_i_neg = ((i_neg * 1217359) >> 19) + 1
+    k_neg = pow5bits_i_neg - _I32(_D_POW5_BITS)
+    j_neg = q_neg - k_neg
+
+    inv_tab = jnp.asarray(_D_INV_TABLE)
+    pow_tab = jnp.asarray(_D_POW_TABLE)
+    idx_pos = jnp.clip(q_pos, 0, inv_tab.shape[0] - 1)
+    idx_neg = jnp.clip(i_neg, 0, pow_tab.shape[0] - 1)
+    mul_hi = jnp.where(pos, inv_tab[idx_pos, 0], pow_tab[idx_neg, 0])
+    mul_lo = jnp.where(pos, inv_tab[idx_pos, 1], pow_tab[idx_neg, 1])
+    j = jnp.where(pos, j_pos, j_neg)
+    q = jnp.where(pos, q_pos, q_neg)
+    e10 = jnp.where(pos, q_pos, q_neg + e2)
+
+    vr = _mul_shift64(mv, mul_hi, mul_lo, j)
+    vp = _mul_shift64(mp, mul_hi, mul_lo, j)
+    vm = _mul_shift64(mm, mul_hi, mul_lo, j)
+
+    # trailing-zero bookkeeping (Ryu steps 3b)
+    vr_trail = jnp.zeros_like(even)
+    vm_trail = jnp.zeros_like(even)
+    # e2 >= 0, q <= 21
+    small_q = pos & (q <= 21)
+    mv_div5 = (mv % _u64(5)) == 0
+    c1 = small_q & mv_div5
+    vr_trail = jnp.where(c1, _pow5_factor_ge(mv, q, 23), vr_trail)
+    c2 = small_q & ~mv_div5 & accept
+    vm_trail = jnp.where(c2, _pow5_factor_ge(mm, q, 23), vm_trail)
+    c3 = small_q & ~mv_div5 & ~accept
+    vp = vp - jnp.where(c3 & _pow5_factor_ge(mp, q, 23), _u64(1), _u64(0))
+    # e2 < 0, q <= 1
+    neg_q1 = ~pos & (q <= 1)
+    vr_trail = jnp.where(neg_q1, jnp.ones_like(vr_trail), vr_trail)
+    vm_trail = jnp.where(neg_q1 & accept, mm_shift == _u64(1), vm_trail)
+    vp = vp - jnp.where(neg_q1 & ~accept, _u64(1), _u64(0))
+    # e2 < 0, 1 < q < 63
+    neg_q63 = ~pos & (q > 1) & (q < 63)
+    vr_trail = jnp.where(neg_q63, _multiple_of_pow2(mv, q), vr_trail)
+
+    # --- shortest-digit search: bounded masked loop (max 17 removals) ---
+    def strip_body(_, state):
+        vr, vp, vm, vm_trail, vr_trail, last, removed = state
+        active = (vp // _u64(10)) > (vm // _u64(10))
+        vm_trail = jnp.where(active, vm_trail & ((vm % _u64(10)) == 0), vm_trail)
+        vr_trail = jnp.where(active, vr_trail & (last == 0), vr_trail)
+        last = jnp.where(active, (vr % _u64(10)).astype(jnp.int32), last)
+        vr = jnp.where(active, vr // _u64(10), vr)
+        vp = jnp.where(active, vp // _u64(10), vp)
+        vm = jnp.where(active, vm // _u64(10), vm)
+        removed = removed + active.astype(jnp.int32)
+        return vr, vp, vm, vm_trail, vr_trail, last, removed
+
+    last = jnp.zeros_like(e10)
+    removed = jnp.zeros_like(e10)
+    vr, vp, vm, vm_trail, vr_trail, last, removed = jax.lax.fori_loop(
+        0, 20, strip_body, (vr, vp, vm, vm_trail, vr_trail, last, removed))
+
+    # extra stripping while vm has trailing zeros (general path)
+    def strip2_body(_, state):
+        vr, vp, vm, vr_trail, last, removed, active0 = state
+        active = active0 & ((vm % _u64(10)) == 0)
+        vr_trail = jnp.where(active, vr_trail & (last == 0), vr_trail)
+        last = jnp.where(active, (vr % _u64(10)).astype(jnp.int32), last)
+        vr = jnp.where(active, vr // _u64(10), vr)
+        vp = jnp.where(active, vp // _u64(10), vp)
+        vm = jnp.where(active, vm // _u64(10), vm)
+        removed = removed + active.astype(jnp.int32)
+        return vr, vp, vm, vr_trail, last, removed, active
+
+    vr, vp, vm, vr_trail, last, removed, _ = jax.lax.fori_loop(
+        0, 20, strip2_body, (vr, vp, vm, vr_trail, last, removed, vm_trail))
+
+    # round-to-even tweak: ...50 exactly with even vr rounds down
+    last = jnp.where(vr_trail & (last == 5) & ((vr % _u64(2)) == 0),
+                     _I32(4), last)
+    round_up = ((vr == vm) & ~(accept & vm_trail)) | (last >= 5)
+    digits = vr + jnp.where(round_up, _u64(1), _u64(0))
+    e10 = e10 + removed
+
+    digits = jnp.where(is_zero | is_nan | is_inf, _u64(0), digits)
+    e10 = jnp.where(is_zero | is_nan | is_inf, _I32(0), e10)
+    return digits, e10, sign, is_nan, is_inf, is_zero
+
+
+# ---------------------------------------------------------------------------
+# f2s core (float32)
+# ---------------------------------------------------------------------------
+
+def _mul_shift32(m, factor, shift):
+    """(m × factor) >> shift, m < 2^35, factor u64, 32 < shift < 96."""
+    factor_lo = factor & _M32
+    factor_hi = factor >> np.uint64(32)
+    bits0 = m * factor_lo
+    bits1 = m * factor_hi
+    total = (bits0 >> np.uint64(32)) + bits1
+    return total >> (shift.astype(jnp.uint64) - np.uint64(32))
+
+
+@functools.partial(jax.jit)
+def _ryu_f2s_core(bits):
+    """bits:u32[n] → same tuple as d2s but with float shortest digits."""
+    bits = bits.astype(jnp.uint32)
+    sign = (bits >> np.uint32(31)) != 0
+    ieee_m = (bits & np.uint32((1 << 23) - 1)).astype(jnp.uint64)
+    ieee_e = ((bits >> np.uint32(23)) & np.uint32(0xFF)).astype(jnp.int32)
+
+    is_nan = (ieee_e == 0xFF) & (ieee_m != 0)
+    is_inf = (ieee_e == 0xFF) & (ieee_m == 0)
+    is_zero = (ieee_e == 0) & (ieee_m == 0)
+
+    subnormal = ieee_e == 0
+    e2 = jnp.where(subnormal, _I32(1 - 127 - 23 - 2), ieee_e - 127 - 23 - 2)
+    m2 = jnp.where(subnormal, ieee_m, ieee_m | np.uint64(1 << 23))
+    even = (m2 & _u64(1)) == 0
+    accept = even
+
+    mv = _u64(4) * m2
+    mm_shift = ((ieee_m != 0) | (ieee_e <= 1)).astype(jnp.uint64)
+    mp = mv + _u64(2)
+    mm = mv - _u64(1) - mm_shift
+
+    pos = e2 >= 0
+    q_pos = ((e2 * 78913) >> 18).astype(jnp.int32)
+    q_pos = jnp.maximum(q_pos, 0)
+    neg_e2 = -e2
+    q_neg = jnp.maximum(((neg_e2 * 732923) >> 20).astype(jnp.int32), 0)
+
+    pow5bits_q = ((q_pos * 1217359) >> 19) + 1
+    k_pos = _I32(_F_POW5_INV_BITS) + pow5bits_q - 1
+    j_pos = -e2 + q_pos + k_pos
+
+    i_neg = neg_e2 - q_neg
+    pow5bits_i = ((i_neg * 1217359) >> 19) + 1
+    k_neg = pow5bits_i - _I32(_F_POW5_BITS)
+    j_neg = q_neg - k_neg
+
+    inv_tab = jnp.asarray(_F_INV_TABLE)
+    pow_tab = jnp.asarray(_F_POW_TABLE)
+    idx_pos = jnp.clip(q_pos, 0, inv_tab.shape[0] - 1)
+    idx_neg = jnp.clip(i_neg, 0, pow_tab.shape[0] - 1)
+    factor = jnp.where(pos, inv_tab[idx_pos], pow_tab[idx_neg])
+    j = jnp.where(pos, j_pos, j_neg)
+    q = jnp.where(pos, q_pos, q_neg)
+    e10 = jnp.where(pos, q_pos, q_neg + e2)
+
+    vr = _mul_shift32(mv, factor, j)
+    vp = _mul_shift32(mp, factor, j)
+    vm = _mul_shift32(mm, factor, j)
+
+    # early last-removed-digit for the rare boundary case (f2s-only trick)
+    need_early = (q != 0) & (((vp - _u64(1)) // _u64(10)) <= vm // _u64(10))
+    # positive: one-lower inverse entry
+    qm1 = jnp.clip(q_pos - 1, 0, inv_tab.shape[0] - 1)
+    pow5bits_qm1 = ((qm1 * 1217359) >> 19) + 1
+    l_pos = _I32(_F_POW5_INV_BITS) + pow5bits_qm1 - 1
+    # shift clamped into mulShift32's valid range; out-of-range lanes are
+    # masked out by need_early below
+    sh_pos = jnp.clip(-e2 + q_pos - 1 + l_pos, 33, 95)
+    early_pos = (_mul_shift32(mv, inv_tab[qm1], sh_pos)
+                 % _u64(10)).astype(jnp.int32)
+    # negative: one-higher pow entry
+    ip1 = jnp.clip(i_neg + 1, 0, pow_tab.shape[0] - 1)
+    pow5bits_ip1 = ((ip1 * 1217359) >> 19) + 1
+    j2 = jnp.clip(q_neg - 1 - (pow5bits_ip1 - _I32(_F_POW5_BITS)), 33, 95)
+    early_neg = (_mul_shift32(mv, pow_tab[ip1], j2) % _u64(10)).astype(jnp.int32)
+    last0 = jnp.where(need_early, jnp.where(pos, early_pos, early_neg), _I32(0))
+
+    vr_trail = jnp.zeros_like(even)
+    vm_trail = jnp.zeros_like(even)
+    small_q = pos & (q <= 9)
+    mv_div5 = (mv % _u64(5)) == 0
+    c1 = small_q & mv_div5
+    vr_trail = jnp.where(c1, _pow5_factor_ge(mv, q, 11), vr_trail)
+    c2 = small_q & ~mv_div5 & accept
+    vm_trail = jnp.where(c2, _pow5_factor_ge(mm, q, 11), vm_trail)
+    c3 = small_q & ~mv_div5 & ~accept
+    vp = vp - jnp.where(c3 & _pow5_factor_ge(mp, q, 11), _u64(1), _u64(0))
+    neg_q1 = ~pos & (q <= 1)
+    vr_trail = jnp.where(neg_q1, jnp.ones_like(vr_trail), vr_trail)
+    vm_trail = jnp.where(neg_q1 & accept, mm_shift == _u64(1), vm_trail)
+    vp = vp - jnp.where(neg_q1 & ~accept, _u64(1), _u64(0))
+    neg_q31 = ~pos & (q > 1) & (q < 31)
+    vr_trail = jnp.where(neg_q31, _multiple_of_pow2(mv, q - 1), vr_trail)
+
+    def strip_body(_, state):
+        vr, vp, vm, vm_trail, vr_trail, last, removed = state
+        active = (vp // _u64(10)) > (vm // _u64(10))
+        vm_trail = jnp.where(active, vm_trail & ((vm % _u64(10)) == 0), vm_trail)
+        vr_trail = jnp.where(active, vr_trail & (last == 0), vr_trail)
+        last = jnp.where(active, (vr % _u64(10)).astype(jnp.int32), last)
+        vr = jnp.where(active, vr // _u64(10), vr)
+        vp = jnp.where(active, vp // _u64(10), vp)
+        vm = jnp.where(active, vm // _u64(10), vm)
+        removed = removed + active.astype(jnp.int32)
+        return vr, vp, vm, vm_trail, vr_trail, last, removed
+
+    removed = jnp.zeros_like(e10)
+    vr, vp, vm, vm_trail, vr_trail, last, removed = jax.lax.fori_loop(
+        0, 11, strip_body, (vr, vp, vm, vm_trail, vr_trail, last0, removed))
+
+    def strip2_body(_, state):
+        vr, vp, vm, vr_trail, last, removed, active0 = state
+        active = active0 & ((vm % _u64(10)) == 0)
+        vr_trail = jnp.where(active, vr_trail & (last == 0), vr_trail)
+        last = jnp.where(active, (vr % _u64(10)).astype(jnp.int32), last)
+        vr = jnp.where(active, vr // _u64(10), vr)
+        vp = jnp.where(active, vp // _u64(10), vp)
+        vm = jnp.where(active, vm // _u64(10), vm)
+        removed = removed + active.astype(jnp.int32)
+        return vr, vp, vm, vr_trail, last, removed, active
+
+    vr, vp, vm, vr_trail, last, removed, _ = jax.lax.fori_loop(
+        0, 11, strip2_body, (vr, vp, vm, vr_trail, last, removed, vm_trail))
+
+    last = jnp.where(vr_trail & (last == 5) & ((vr % _u64(2)) == 0),
+                     _I32(4), last)
+    round_up = ((vr == vm) & ~(accept & vm_trail)) | (last >= 5)
+    digits = vr + jnp.where(round_up, _u64(1), _u64(0))
+    e10 = e10 + removed
+
+    digits = jnp.where(is_zero | is_nan | is_inf, _u64(0), digits)
+    e10 = jnp.where(is_zero | is_nan | is_inf, _I32(0), e10)
+    return digits, e10, sign, is_nan, is_inf, is_zero
+
+
+# ---------------------------------------------------------------------------
+# Java formatting (host assembly over the device core's outputs)
+# ---------------------------------------------------------------------------
+
+_MAX_DIGITS = 17  # longest double shortest-repr
+_W = 28           # '-' + digits/zeros/point + 'E-xxx' upper bound
+
+
+def _digit_chars(digits: np.ndarray):
+    """digits:u64[n] → (right-aligned ascii matrix (n,17), k:(n,) digit
+    counts)."""
+    n = digits.shape[0]
+    pows = (10 ** np.arange(_MAX_DIGITS - 1, -1, -1, dtype=np.uint64))
+    dmat = ((digits[:, None] // pows[None, :]) % np.uint64(10)).astype(np.uint8)
+    nz = dmat != 0
+    first = np.where(nz.any(axis=1), nz.argmax(axis=1), _MAX_DIGITS - 1)
+    k = (_MAX_DIGITS - first).astype(np.int64)
+    return dmat + np.uint8(ord("0")), k
+
+
+def _format_java(digits, e10, sign, is_nan, is_inf, is_zero):
+    """Assemble Java toString bytes from Ryu digits — vectorized numpy.
+
+    Java rules (JLS Double.toString): plain decimal when 10^-3 <= |x| < 10^7,
+    else computerized scientific ``d.dddE[-]e``; at least one digit on each
+    side of '.'; specials are "NaN", "Infinity", "-Infinity"; zeros keep
+    their sign ("0.0"/"-0.0").
+
+    Returns (byte matrix u8[n, W], lengths i64[n]).
+    """
+    digits = np.asarray(digits)
+    e10 = np.asarray(e10).astype(np.int64)
+    sign = np.asarray(sign)
+    is_nan = np.asarray(is_nan)
+    is_inf = np.asarray(is_inf)
+    is_zero = np.asarray(is_zero)
+    n = digits.shape[0]
+
+    dmat, k = _digit_chars(digits)
+    adj = e10 + k - 1
+
+    # digit lookup: dig(J) = J-th most-significant digit char, J in [0,k)
+    def dig(J):
+        idx = np.clip(_MAX_DIGITS - k[:, None] + J, 0, _MAX_DIGITS - 1)
+        return np.take_along_axis(dmat, idx, axis=1)
+
+    J = np.arange(_W, dtype=np.int64)[None, :] - sign[:, None].astype(np.int64)
+    DJ = dig(np.clip(J, 0, _W - 1))
+    DJm1 = dig(np.clip(J - 1, 0, _W - 1))
+
+    kc = k[:, None]
+    adjc = adj[:, None]
+    ZERO, POINT, PAD = np.uint8(ord("0")), np.uint8(ord(".")), np.uint8(0)
+    E, DASH = np.uint8(ord("E")), np.uint8(ord("-"))
+
+    # --- plain, adj >= k-1: digits, pad zeros to adj, ".0"
+    p1 = np.where(J < kc, DJ,
+         np.where(J <= adjc, ZERO,
+         np.where(J == adjc + 1, POINT,
+         np.where(J == adjc + 2, ZERO, PAD))))
+    len1 = adj + 3
+    # --- plain, 0 <= adj < k-1: point inserted after adj+1 digits
+    p2 = np.where(J <= adjc, DJ,
+         np.where(J == adjc + 1, POINT,
+         np.where(J <= kc, DJm1, PAD)))
+    len2 = k + 1
+    # --- plain, adj < 0: "0." + zeros + digits
+    z = np.maximum(-adj - 1, 0)
+    zc = z[:, None]
+    p3 = np.where(J == 0, ZERO,
+         np.where(J == 1, POINT,
+         np.where(J < 2 + zc, ZERO,
+         np.where(J < 2 + zc + kc, dig(np.clip(J - 2 - zc, 0, _W - 1)), PAD))))
+    len3 = 2 + z + k
+
+    # --- scientific: d '.' rest 'E' [-] expdigits; rest = "0" when k == 1
+    a = np.abs(adj)
+    endig = np.where(a >= 100, 3, np.where(a >= 10, 2, 1))
+    eneg = adj < 0
+    m = np.where(k > 1, k + 1, 3)          # position of 'E'
+    mc = m[:, None]
+    # exponent char at output offset t past 'E' (t from 0)
+    T = J - mc - 1
+    dposc = T - eneg[:, None].astype(np.int64)
+    epow = 10 ** np.clip(endig[:, None] - 1 - dposc, 0, 3)
+    echar = (np.uint8(ord("0"))
+             + ((a[:, None] // epow) % 10).astype(np.uint8))
+    evalid = (dposc >= 0) & (dposc < endig[:, None])
+    epart = np.where((T == 0) & eneg[:, None], DASH,
+            np.where(evalid, echar, PAD))
+    ps = np.where(J == 0, dig(np.zeros_like(J)),
+         np.where(J == 1, POINT,
+         np.where((J == 2) & (kc == 1), ZERO,
+         np.where((J > 1) & (J < kc + 1), DJm1,
+         np.where(J == mc, E, epart)))))
+    lens = m + 1 + eneg.astype(np.int64) + endig
+
+    plain = (adj >= -3) & (adj < 7)
+    body = np.where((plain & (adj >= k - 1))[:, None], p1,
+           np.where((plain & (adj >= 0))[:, None], p2,
+           np.where(plain[:, None], p3, ps)))
+    lengths = np.where(plain & (adj >= k - 1), len1,
+              np.where(plain & (adj >= 0), len2,
+              np.where(plain, len3, lens)))
+
+    # sign slot: J == -1 exactly at output position 0 on negative rows
+    out = np.where(J == -1, DASH, body)
+    lengths = lengths + sign.astype(np.int64)
+
+    # specials override whole rows
+    def _override(mask, text):
+        if not mask.any():
+            return
+        b = np.frombuffer(text, dtype=np.uint8)
+        rows = np.where(mask)[0]
+        out[rows, :] = 0
+        out[rows, :len(b)] = b
+        lengths[rows] = len(b)
+
+    _override(is_nan, b"NaN")
+    _override(is_inf & ~sign, b"Infinity")
+    _override(is_inf & sign, b"-Infinity")
+    _override(is_zero & ~sign, b"0.0")
+    _override(is_zero & sign, b"-0.0")
+    return out, lengths
+
+
+def _f64_bits(data):
+    """f64[n] → u64[n] bit pattern. Taken as a host view: the TPU X64
+    rewriter has no lowering for bitcast-convert on ANY 64-bit element type
+    (u64[n,2] = bitcast(f64) is rejected), while u64 *arithmetic* rewrites
+    fine — so the view happens on host (free reinterpret) and the heavy core
+    stays on device."""
+    return jnp.asarray(np.asarray(data, dtype=np.float64).view(np.uint64))
+
+
+def _ryu_core_for(col: Column):
+    if col.dtype.id is dt.TypeId.FLOAT64:
+        return _ryu_d2s_core(_f64_bits(col.data))
+    if col.dtype.id is dt.TypeId.FLOAT32:
+        bits = jnp.asarray(
+            np.asarray(col.data, dtype=np.float32).view(np.uint32))
+        return _ryu_f2s_core(bits)
+    raise TypeError(f"float→string: unsupported dtype {col.dtype}")
+
+
+def float_to_string(col: Column) -> Column:
+    """Spark `cast(float/double as string)` with Java toString semantics.
+
+    Reference entry: float_to_string (cast_float_to_string.cu:109)."""
+    mat, lengths = _format_java(*_ryu_core_for(col))
+    validity = None if col.validity is None else np.asarray(col.validity)
+    return from_padded_bytes(mat, lengths, validity)
+
+
+def format_number(col: Column, d: int) -> Column:
+    """Spark `format_number(x, d)`: fixed ``d`` decimals, ',' thousands
+    grouping, HALF_EVEN rounding of the shortest decimal form (Java
+    DecimalFormat semantics). Row assembly is per-row host code: grouping and
+    fixed-scale rounding are display formatting, off the query hot path.
+    Reference entry: format_float (format_float.cu:111)."""
+    digits, e10, sign, is_nan, is_inf, is_zero = _ryu_core_for(col)
+    digits = np.asarray(digits)
+    e10 = np.asarray(e10)
+    sign = np.asarray(sign)
+    is_nan = np.asarray(is_nan)
+    is_inf = np.asarray(is_inf)
+    is_zero = np.asarray(is_zero)
+    parts = []
+    for i in range(digits.shape[0]):
+        if is_nan[i]:
+            parts.append(b"NaN")
+            continue
+        if is_inf[i]:
+            parts.append(b"-\xe2\x88\x9e" if sign[i] else b"\xe2\x88\x9e")
+            continue
+        if is_zero[i]:
+            scaled = 0
+        else:
+            # round digits x 10^e10 at d decimals, HALF_EVEN
+            v = int(digits[i])
+            e = int(e10[i])
+            shift = e + d
+            if shift >= 0:
+                scaled = v * (10 ** shift)
+            else:
+                q, r = divmod(v, 10 ** (-shift))
+                half = 5 * 10 ** (-shift - 1)
+                if r > half or (r == half and (q & 1)):
+                    q += 1
+                scaled = q
+        int_part, frac_part = divmod(scaled, 10 ** d) if d > 0 else (scaled, 0)
+        s_int = f"{int_part:,d}"
+        body = s_int + (f".{frac_part:0{d}d}" if d > 0 else "")
+        # DecimalFormat signs from the *input* (incl. -0.0 and negatives that
+        # round to zero), not from the rounded result.
+        if sign[i]:
+            body = "-" + body
+        parts.append(body.encode())
+    validity = None if col.validity is None else np.asarray(col.validity)
+    return pack_byte_rows(parts, validity)

@@ -1,0 +1,72 @@
+"""Spark non-ANSI decimal → string.
+
+Reference capability: cast_decimal_to_string.cu (230 LoC), entry
+`decimal_to_non_ansi_string` (:210) — Spark's `cast(dec as string)` follows
+`java.math.BigDecimal.toString`: plain notation while ``scale >= 0`` and the
+adjusted exponent ``>= -6``; otherwise scientific ``d.dddE±adj`` with an
+explicit '+' on positive exponents.
+
+TPU note: the unscaled→digit conversion is divide-by-10 limb arithmetic with
+data-dependent output lengths — a poor fit for the MXU and a metadata-sized
+workload in practice (decimal columns print during EXPLAIN/collect, not in
+query inner loops), so this runs on host over the materialized limbs. The
+dense compute stays in decimal128.py's XLA kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.strings import pack_byte_rows
+
+
+def _unscaled_ints(col: Column) -> np.ndarray:
+    arr = np.asarray(col.data)
+    if col.dtype.id is dt.TypeId.DECIMAL128:
+        # uint32[n, 4] little-endian limbs, two's complement
+        v = (arr.astype(object) * [1 << 0, 1 << 32, 1 << 64, 1 << 96]).sum(axis=1)
+        neg = v >= (1 << 127)
+        return np.where(neg, v - (1 << 128), v)
+    return arr.astype(object)
+
+
+def decimal_to_string(col: Column) -> Column:
+    """BigDecimal.toString semantics for DECIMAL32/64/128 columns."""
+    if not col.dtype.is_decimal:
+        raise TypeError(f"decimal_to_string: not a decimal column: {col.dtype}")
+    scale = col.dtype.scale
+    unscaled = _unscaled_ints(col)
+    n = col.size
+    valid = (np.ones(n, dtype=bool) if col.validity is None
+             else np.asarray(col.validity))
+    parts = []
+    for i in range(n):
+        if not valid[i]:
+            parts.append(b"")
+            continue
+        u = int(unscaled[i])
+        neg = u < 0
+        digits = str(-u if neg else u)
+        k = len(digits)
+        adjusted = (k - 1) - scale
+        if scale >= 0 and adjusted >= -6:
+            # plain notation
+            if scale == 0:
+                body = digits
+            elif k > scale:
+                body = digits[:k - scale] + "." + digits[k - scale:]
+            else:
+                body = "0." + "0" * (scale - k) + digits
+        else:
+            # scientific: d.dddE±adj (E+ for non-negative adjusted exponent)
+            if u == 0:
+                body = "0E" + ("+" if adjusted >= 0 else "") + str(adjusted)
+            else:
+                rest = digits[1:]
+                body = digits[0] + ("." + rest if rest else "")
+                body += "E" + ("+" if adjusted >= 0 else "") + str(adjusted)
+        parts.append(("-" + body if neg else body).encode())
+    validity = None if col.validity is None else valid
+    return pack_byte_rows(parts, validity)

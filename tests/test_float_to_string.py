@@ -1,0 +1,210 @@
+"""Tests for float/double→string (Ryu, Java toString), format_number, and
+decimal→string.
+
+Mirrors the reference's behavioral-spec tier (SURVEY.md §4 tier 2): golden
+values follow JVM semantics. For shortest-representation digits the oracle is
+CPython's/numpy's shortest round-trip repr (the same unique shortest
+correctly-rounded digits Java emits), reformatted under Java's layout rules;
+format_number and decimal goldens are hand-checked against
+java.text.DecimalFormat / java.math.BigDecimal behavior.
+"""
+
+import math
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.cast_float_to_string import (
+    float_to_string,
+    format_number,
+)
+from spark_rapids_jni_tpu.ops.decimal_to_string import decimal_to_string
+
+
+def _java_layout(digits, adj, neg):
+    if -3 <= adj < 7:
+        k = len(digits)
+        if adj >= k - 1:
+            body = digits + "0" * (adj - (k - 1)) + ".0"
+        elif adj >= 0:
+            body = digits[:adj + 1] + "." + digits[adj + 1:]
+        else:
+            body = "0." + "0" * (-adj - 1) + digits
+    else:
+        rest = digits[1:] if len(digits) > 1 else "0"
+        body = f"{digits[0]}.{rest}E{adj}"
+    return "-" + body if neg else body
+
+
+def java_double_str(x):
+    x = float(x)
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0:
+        return "-0.0" if math.copysign(1, x) < 0 else "0.0"
+    d = Decimal(repr(abs(x)))
+    t = d.as_tuple()
+    digits = "".join(map(str, t.digits)).rstrip("0") or "0"
+    return _java_layout(digits, d.adjusted(), x < 0)
+
+
+def java_float_str(x):
+    xf = np.float32(x)
+    if math.isnan(xf):
+        return "NaN"
+    if math.isinf(xf):
+        return "Infinity" if xf > 0 else "-Infinity"
+    if xf == 0:
+        return "-0.0" if math.copysign(1, float(xf)) < 0 else "0.0"
+    s = np.format_float_scientific(abs(xf), unique=True, trim="-")
+    mant, ex = s.split("e")
+    d = Decimal(mant)
+    t = d.as_tuple()
+    digits = "".join(map(str, t.digits)).rstrip("0") or "0"
+    return _java_layout(digits, d.adjusted() + int(ex), float(xf) < 0)
+
+
+DOUBLE_EDGE = [
+    0.0, -0.0, 1.0, -1.0, 10.0, 1e6, 9999999.0, 1e7, 1.5e7,
+    0.001, 0.0001, -0.0005, 123.456, 2.0e-3,
+    float("inf"), float("-inf"), float("nan"),
+    5e-324, -5e-324,                     # min subnormal
+    1.7976931348623157e308,              # max double
+    2.2250738585072014e-308,             # min normal
+    4.9406564584124654e-324,
+    1.0e22, 1.0e23,                      # classic shortest-repr stress
+    9.007199254740992e15, 9.007199254740993e15,
+    2.6843549e7, 1.23456789e-290,
+]
+
+
+def test_double_to_string_edges():
+    col = Column.from_pylist(DOUBLE_EDGE, dt.FLOAT64)
+    got = float_to_string(col).to_pylist()
+    assert got == [java_double_str(v) for v in DOUBLE_EDGE]
+
+
+def test_double_to_string_random_sweep():
+    rng = np.random.default_rng(7)
+    vals = np.concatenate([
+        rng.standard_normal(500),
+        rng.standard_normal(300) * 1e300,
+        rng.standard_normal(300) * 1e-300,
+        rng.uniform(-1e7, 1e7, 500),
+        rng.integers(-10**15, 10**15, 200).astype(np.float64),
+    ])
+    # random bit patterns catch table/boundary bugs unreachable from uniforms
+    bits = rng.integers(0, 1 << 63, 300, dtype=np.int64)
+    vals = np.concatenate([vals, bits.view(np.float64)])
+    vals = [float(v) for v in vals]
+    col = Column.from_pylist(vals, dt.FLOAT64)
+    got = float_to_string(col).to_pylist()
+    exp = [java_double_str(v) for v in vals]
+    bad = [(v, g, e) for v, g, e in zip(vals, got, exp) if g != e]
+    assert not bad, bad[:5]
+
+
+FLOAT_EDGE = [
+    0.0, -0.0, 1.0, -1.0, 0.1, 9999999.0, 1e7,
+    0.001, 0.0001, 123.456,
+    3.4028235e38, 1.4e-45, 1.17549435e-38,
+    float("inf"), float("-inf"), float("nan"),
+]
+
+
+def test_float_to_string_edges():
+    col = Column.from_pylist(FLOAT_EDGE, dt.FLOAT32)
+    got = float_to_string(col).to_pylist()
+    assert got == [java_float_str(v) for v in FLOAT_EDGE]
+
+
+def test_float_to_string_random_sweep():
+    rng = np.random.default_rng(11)
+    vals = np.concatenate([
+        rng.standard_normal(500).astype(np.float32),
+        (rng.standard_normal(300) * 1e38).astype(np.float32),
+        (rng.standard_normal(300) * 1e-38).astype(np.float32),
+        rng.uniform(-1e7, 1e7, 500).astype(np.float32),
+    ])
+    bits = rng.integers(0, 1 << 31, 300, dtype=np.int32)
+    vals = np.concatenate([vals, bits.view(np.float32)])
+    pyvals = [float(v) for v in vals]
+    col = Column.from_pylist(pyvals, dt.FLOAT32)
+    got = float_to_string(col).to_pylist()
+    exp = [java_float_str(v) for v in vals]
+    bad = [(v, g, e) for v, g, e in zip(pyvals, got, exp) if g != e]
+    assert not bad, bad[:5]
+
+
+def test_float_to_string_nulls():
+    col = Column.from_pylist([1.5, None, -2.5, None], dt.FLOAT64)
+    got = float_to_string(col).to_pylist()
+    assert got == ["1.5", None, "-2.5", None]
+
+
+# ---------------------------------------------------------------------------
+# format_number (Spark: java.text.DecimalFormat "#,###,###,##0.###", HALF_EVEN)
+# ---------------------------------------------------------------------------
+
+FORMAT_CASES = [
+    (12332.123456, 4, "12,332.1235"),
+    (12332.123456, 0, "12,332"),
+    (-1234.567, 2, "-1,234.57"),
+    (0.5, 2, "0.50"),
+    (2.5, 0, "2"),       # HALF_EVEN: ties to even
+    (3.5, 0, "4"),
+    (1234567.891, 2, "1,234,567.89"),
+    (0.0, 3, "0.000"),
+    (-0.0, 2, "-0.00"),  # DecimalFormat signs from the input, even for zero
+    (-0.4, 0, "-0"),     # negatives that round to zero keep the sign
+    (1e9, 1, "1,000,000,000.0"),
+]
+
+
+@pytest.mark.parametrize("value,d,expected", FORMAT_CASES)
+def test_format_number(value, d, expected):
+    col = Column.from_pylist([value], dt.FLOAT64)
+    assert format_number(col, d).to_pylist() == [expected]
+
+
+# ---------------------------------------------------------------------------
+# decimal → string (java.math.BigDecimal.toString)
+# ---------------------------------------------------------------------------
+
+DEC_CASES = [
+    # (unscaled, scale, expected) — BigDecimal(BigInteger(unscaled), scale)
+    (123456, 2, "1234.56"),
+    (-123456, 2, "-1234.56"),
+    (5, 0, "5"),
+    (0, 0, "0"),
+    (0, 2, "0.00"),
+    (1, 7, "1E-7"),            # adjusted -7 < -6 -> scientific
+    (123, 8, "0.00000123"),   # adjusted exactly -6 -> still plain
+    (123, 9, "1.23E-7"),
+    (1, 6, "0.000001"),        # adjusted -6 -> still plain
+    (5, -3, "5E+3"),           # negative scale -> scientific with E+
+    (0, -2, "0E+2"),
+    (19, -1, "1.9E+2"),
+    (10**37, 0, "1" + "0" * 37),
+    (-(10**37) + 1, 38, "-0.0" + "9" * 37),
+    (10**38 - 1, 0, "9" * 38),
+]
+
+
+@pytest.mark.parametrize("unscaled,scale,expected", DEC_CASES)
+def test_decimal128_to_string(unscaled, scale, expected):
+    # string constructor is exact; scaleb would round at context precision
+    value = Decimal(f"{unscaled}E{-scale}")
+    col = Column.from_pylist([value], dt.decimal128(scale))
+    assert decimal_to_string(col).to_pylist() == [expected]
+
+
+def test_decimal64_to_string_and_nulls():
+    col = Column.from_pylist(
+        [Decimal("12.34"), None, Decimal("-0.07")], dt.decimal64(2))
+    assert decimal_to_string(col).to_pylist() == ["12.34", None, "-0.07"]

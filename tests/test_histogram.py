@@ -1,0 +1,119 @@
+"""Tests for histogram build + interpolated percentile.
+
+Mirrors the reference's HistogramTest / percentile semantics (SURVEY.md §2.1
+Histogram row): golden values follow Spark's Percentile.getPercentile —
+position = p×(total−1) over the frequency-expanded sorted values with linear
+interpolation.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.histogram import (
+    create_histogram_if_valid,
+    percentile_from_histogram,
+)
+
+
+def spark_percentile(pairs, p):
+    """Reference model of org.apache.spark Percentile.getPercentile."""
+    pairs = sorted(pairs)
+    total = sum(f for _, f in pairs)
+    if total == 0:
+        return None
+    pos = p * (total - 1)
+    lo, hi = math.floor(pos), math.ceil(pos)
+
+    def item(i):
+        c = 0
+        for v, f in pairs:
+            c += f
+            if c > i:
+                return v
+        return pairs[-1][0]
+
+    vl, vh = item(lo), item(hi)
+    return vl + (vh - vl) * (pos - lo)
+
+
+def make_histograms(rows):
+    """rows: list of [(value, freq), ...] → LIST<STRUCT<f64,i64>> column."""
+    offsets = np.zeros(len(rows) + 1, dtype=np.int32)
+    vals, freqs = [], []
+    for i, r in enumerate(rows):
+        offsets[i + 1] = offsets[i] + len(r)
+        for v, f in r:
+            vals.append(v)
+            freqs.append(f)
+    child = Column.struct_of([
+        Column.from_pylist(vals, dt.FLOAT64),
+        Column.from_pylist(freqs, dt.INT64),
+    ])
+    import jax.numpy as jnp
+    return Column.list_of(child, jnp.asarray(offsets))
+
+
+def test_create_histogram_drops_invalid_rows():
+    values = Column.from_pylist([1.0, None, 3.0, 4.0, 5.0], dt.FLOAT64)
+    freqs = Column.from_pylist([2, 3, 0, None, 7], dt.INT64)
+    hist = create_histogram_if_valid(values, freqs, output_as_lists=True)
+    assert hist.to_pylist() == [[(1.0, 2)], [], [], [], [(5.0, 7)]]
+
+
+def test_create_histogram_flat():
+    values = Column.from_pylist([1.0, 2.0, 3.0], dt.FLOAT64)
+    freqs = Column.from_pylist([1, 0, 2], dt.INT64)
+    hist = create_histogram_if_valid(values, freqs, output_as_lists=False)
+    assert hist.to_pylist() == [[(1.0, 1), (3.0, 2)]]
+
+
+def test_create_histogram_negative_freq_raises():
+    values = Column.from_pylist([1.0], dt.FLOAT64)
+    freqs = Column.from_pylist([-2], dt.INT64)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(values, freqs, output_as_lists=True)
+
+
+@pytest.mark.parametrize("p", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_percentile_single_histogram(p):
+    pairs = [(10.0, 1), (20.0, 3), (5.0, 2), (40.0, 1)]
+    hist = make_histograms([pairs])
+    got = percentile_from_histogram(hist, [p], output_as_list=False)
+    assert got.to_pylist()[0] == pytest.approx(spark_percentile(pairs, p))
+
+
+def test_percentile_multi_rows_multi_pcts():
+    rows = [
+        [(1.0, 5)],
+        [(3.0, 1), (1.0, 1), (2.0, 1)],
+        [],                                  # empty -> null
+        [(-7.5, 2), (0.0, 1), (12.25, 4), (3.5, 3)],
+    ]
+    pcts = [0.1, 0.5, 0.99]
+    hist = make_histograms(rows)
+    got = percentile_from_histogram(hist, pcts, output_as_list=True)
+    out = got.to_pylist()
+    assert out[2] is None or out[2] == []
+    for i, r in enumerate(rows):
+        if not r:
+            continue
+        expected = [spark_percentile(r, p) for p in pcts]
+        assert out[i] == pytest.approx(expected)
+
+
+def test_percentile_random_against_model():
+    rng = np.random.default_rng(3)
+    rows = []
+    for _ in range(50):
+        k = int(rng.integers(1, 20))
+        rows.append([(float(rng.normal()), int(rng.integers(1, 10)))
+                     for _ in range(k)])
+    pcts = [0.0, 0.123, 0.5, 0.875, 1.0]
+    hist = make_histograms(rows)
+    got = percentile_from_histogram(hist, pcts, output_as_list=True).to_pylist()
+    for r, g in zip(rows, got):
+        assert g == pytest.approx([spark_percentile(r, p) for p in pcts])
