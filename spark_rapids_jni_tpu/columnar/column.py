@@ -42,6 +42,14 @@ class Column:
       validity: bool[n] mask or None (= all valid).
       offsets:  int32[n+1] for STRING / LIST, else None.
       children: child Columns for LIST (1) / STRUCT (n).
+
+    FLOAT64 columns store ``data`` as **uint64 bit patterns**, not f64: TPU
+    f64 device storage is lossy (float32-pair emulation truncates the
+    mantissa to ~49 bits and flushes |x| outside float32's exponent range to
+    zero — see docs/TPU_NUMERICS.md), while integer transfers are exact.
+    Ops that need numeric values view the bits (``host_values()`` on host,
+    or accept double-double precision on device); ops that need exact bytes
+    (hashing, row conversion, casts) use the bits directly.
     """
 
     dtype: DType
@@ -92,7 +100,10 @@ class Column:
         """Build a fixed-width column from a host numpy array."""
         if dtype is None:
             dtype = _infer_dtype(arr.dtype)
-        data = jnp.asarray(arr.astype(dtype.np_dtype, copy=False))
+        host = arr.astype(dtype.np_dtype, copy=False)
+        if dtype.id is TypeId.FLOAT64:
+            host = host.view(np.uint64)  # exact bit-pattern storage
+        data = jnp.asarray(host)
         vmask = None if validity is None else jnp.asarray(validity.astype(bool))
         return Column(dtype, int(arr.shape[0]), data=data, validity=vmask)
 
@@ -144,6 +155,8 @@ class Column:
         for i, v in enumerate(values):
             if v is not None:
                 arr[i] = v
+        if dtype.id is TypeId.FLOAT64:
+            arr = arr.view(np.uint64)  # exact bit-pattern storage
         return Column(dtype, n, data=jnp.asarray(arr), validity=vmask)
 
     @staticmethod
@@ -215,10 +228,18 @@ class Column:
                 for i in range(self.size)
             ]
 
-        arr = np.asarray(self.data)
+        arr = self.host_values()
         if tid is TypeId.BOOL8:
             return [bool(arr[i]) if valid[i] else None for i in range(self.size)]
         return [arr[i].item() if valid[i] else None for i in range(self.size)]
+
+    def host_values(self) -> np.ndarray:
+        """Host numpy view of fixed-width values; FLOAT64 bit storage is
+        viewed back to float64 (see class docstring)."""
+        arr = np.asarray(self.data)
+        if self.dtype.id is TypeId.FLOAT64 and arr.dtype != np.float64:
+            arr = arr.view(np.float64)
+        return arr
 
 
 @jax.tree_util.register_pytree_node_class

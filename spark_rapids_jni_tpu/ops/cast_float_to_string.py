@@ -555,12 +555,13 @@ def _format_java(digits, e10, sign, is_nan, is_inf, is_zero):
 
 
 def _f64_bits(data):
-    """f64[n] → u64[n] bit pattern. Taken as a host view: the TPU X64
-    rewriter has no lowering for bitcast-convert on ANY 64-bit element type
-    (u64[n,2] = bitcast(f64) is rejected), while u64 *arithmetic* rewrites
-    fine — so the view happens on host (free reinterpret) and the heavy core
-    stays on device."""
-    return jnp.asarray(np.asarray(data, dtype=np.float64).view(np.uint64))
+    """FLOAT64 column data → u64[n] bit pattern. Columns store bits already
+    (docs/TPU_NUMERICS.md: f64 device storage is lossy and 64-bit
+    bitcast-convert doesn't compile); a raw f64 array is viewed on host."""
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.view(np.uint64)
+    return jnp.asarray(arr)
 
 
 def _ryu_core_for(col: Column):

@@ -655,6 +655,9 @@ def string_to_float(col: Column, out_dtype: DType,
     assert out_dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64)
     n = col.size
     if n == 0:
+        if out_dtype.id is TypeId.FLOAT64:
+            return Column(out_dtype, 0,
+                          data=jnp.zeros((0,), dtype=jnp.uint64))
         return Column(out_dtype, 0,
                       data=jnp.zeros((0,), dtype=out_dtype.np_dtype))
     mat, lengths = padded_bytes(col)
@@ -662,6 +665,14 @@ def string_to_float(col: Column, out_dtype: DType,
     out, valid, excp = _string_to_float_core(mat, lengths, in_valid)
     if ansi_mode:
         _raise_first_error(col, in_valid, ~excp)
+    if out_dtype.id is TypeId.FLOAT64:
+        # Repack into FLOAT64 bit-pattern storage. Note this snapshots the
+        # core's f64 output: exact on CPU; on TPU the parse itself runs at
+        # double-double precision (docs/TPU_NUMERICS.md §1), so exactness
+        # there needs a bits-emitting core (integer mantissa assembly) —
+        # future work.
+        return Column.from_numpy(np.asarray(out).astype(np.float64),
+                                 out_dtype, validity=np.asarray(valid))
     return Column(out_dtype, n, data=out.astype(out_dtype.np_dtype),
                   validity=valid)
 

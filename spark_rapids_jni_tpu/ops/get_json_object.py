@@ -1,0 +1,203 @@
+"""Spark `get_json_object(col, path)` — ctypes wrapper over the native PDA.
+
+Reference surface: JSONUtils.getJsonObject (JSONUtils.java:47-52) with
+PathInstructionJni streams of {SUBSCRIPT, WILDCARD, KEY, INDEX, NAMED}
+(get_json_object.hpp:36). The evaluator implements Spark's twelve
+evaluatePath cases; see native/get_json_object.cpp for the algorithm notes
+and the reasons this kernel runs on host.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from enum import IntEnum
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_ROOT = os.path.dirname(_HERE)
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+_SRC = os.path.join(_REPO_ROOT, "native", "get_json_object.cpp")
+_SO = os.path.join(_PKG_ROOT, "_native", "libsparkjson.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class PathInstructionType(IntEnum):
+    """Mirrors the reference's path_instruction_type (get_json_object.hpp:36)."""
+    SUBSCRIPT = 0
+    WILDCARD = 1
+    KEY = 2
+    INDEX = 3
+    NAMED = 4
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            proc = subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+                 "-o", _SO, _SRC, "-lpthread"],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"failed to build {_SO}:\n{proc.stderr}")
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        lib.gjo_eval.restype = c.c_int
+        lib.gjo_eval.argtypes = [
+            c.POINTER(c.c_uint8), c.POINTER(c.c_int64), c.POINTER(c.c_uint8),
+            c.c_long, c.POINTER(c.c_uint8), c.c_long,
+            c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.POINTER(c.c_int64)),
+            c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int64),
+        ]
+        lib.gjo_free.restype = None
+        lib.gjo_free.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def parse_path(path: str) -> Optional[List[Tuple[PathInstructionType, str, int]]]:
+    """Spark JsonPathParser: ``$`` then ``.name`` / ``['name']`` / ``[n]`` /
+    ``[*]`` / ``.*`` — returns None for invalid paths (whole result null)."""
+    if not path or path[0] != "$":
+        return None
+    out: List[Tuple[PathInstructionType, str, int]] = []
+    i = 1
+    T = PathInstructionType
+    while i < len(path):
+        c = path[i]
+        if c == ".":
+            i += 1
+            j = i
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            name = path[i:j]
+            if not name:
+                return None
+            if name == "*":
+                out.append((T.KEY, "", 0))
+                out.append((T.WILDCARD, "", 0))
+            else:
+                out.append((T.KEY, "", 0))
+                out.append((T.NAMED, name, 0))
+            i = j
+        elif c == "[":
+            # quoted names may contain ']' — scan to the closing "']"
+            if i + 1 < len(path) and path[i + 1] == "'":
+                j = path.find("']", i + 1)
+                if j < 0:
+                    return None
+                j += 1  # position of ']'
+            else:
+                j = path.find("]", i)
+                if j < 0:
+                    return None
+            inner = path[i + 1:j]
+            if inner == "*":
+                out.append((T.SUBSCRIPT, "", 0))
+                out.append((T.WILDCARD, "", 0))
+            elif inner.startswith("'") and inner.endswith("'") and len(inner) >= 2:
+                out.append((T.KEY, "", 0))
+                out.append((T.NAMED, inner[1:-1], 0))
+            else:
+                try:
+                    idx = int(inner)
+                except ValueError:
+                    return None
+                if idx < 0:
+                    return None
+                out.append((T.SUBSCRIPT, "", 0))
+                out.append((T.INDEX, "", idx))
+            i = j + 1
+        else:
+            return None
+    return out
+
+
+def _encode_ops(ops: Sequence[Tuple[PathInstructionType, str, int]]) -> bytes:
+    buf = bytearray()
+    for t, name, idx in ops:
+        nb = name.encode("utf-8")
+        buf += struct.pack("<Bqi", int(t), idx, len(nb))
+        buf += nb
+    return bytes(buf)
+
+
+def get_json_object_with_instructions(
+        col: Column,
+        ops: Sequence[Tuple[PathInstructionType, str, int]]) -> Column:
+    """Evaluate a pre-parsed instruction stream (JNI-parity entry)."""
+    assert col.dtype.id is dt.TypeId.STRING
+    lib = _load()
+    c = ctypes
+    n = col.size
+    data = np.ascontiguousarray(np.asarray(col.data), dtype=np.uint8)
+    offsets = np.ascontiguousarray(
+        np.asarray(col.offsets), dtype=np.int64)
+    if col.validity is not None:
+        valid = np.ascontiguousarray(
+            np.asarray(col.validity).astype(np.uint8))
+        valid_p = valid.ctypes.data_as(c.POINTER(c.c_uint8))
+    else:
+        valid = None
+        valid_p = None
+    opsbuf = np.frombuffer(_encode_ops(ops), dtype=np.uint8) \
+        if ops else np.zeros(0, dtype=np.uint8)
+    opsbuf = np.ascontiguousarray(opsbuf)
+
+    out_data = c.POINTER(c.c_uint8)()
+    out_offs = c.POINTER(c.c_int64)()
+    out_valid = c.POINTER(c.c_uint8)()
+    out_total = c.c_int64()
+    rc = lib.gjo_eval(
+        data.ctypes.data_as(c.POINTER(c.c_uint8)),
+        offsets.ctypes.data_as(c.POINTER(c.c_int64)),
+        valid_p, n,
+        opsbuf.ctypes.data_as(c.POINTER(c.c_uint8)), len(opsbuf),
+        c.byref(out_data), c.byref(out_offs), c.byref(out_valid),
+        c.byref(out_total))
+    if rc != 0:
+        raise RuntimeError(f"get_json_object native error {rc}")
+    try:
+        total = out_total.value
+        blob = np.ctypeslib.as_array(out_data, shape=(max(total, 1),))[
+            :total].copy()
+        offs = np.ctypeslib.as_array(out_offs, shape=(n + 1,)).copy()
+        vmask = np.ctypeslib.as_array(out_valid, shape=(max(n, 1),))[
+            :n].astype(bool).copy()
+    finally:
+        lib.gjo_free(out_data)
+        lib.gjo_free(out_offs)
+        lib.gjo_free(out_valid)
+
+    # the native kernel already emits the STRING column layout verbatim
+    import jax.numpy as jnp
+    return Column(dt.STRING, n,
+                  data=jnp.asarray(blob),
+                  validity=jnp.asarray(vmask) if n else None,
+                  offsets=jnp.asarray(offs.astype(np.int32)))
+
+
+def get_json_object(col: Column, path: str) -> Column:
+    """Spark `get_json_object(col, path)`; invalid path → all-null column."""
+    ops = parse_path(path)
+    if ops is None:
+        return Column(dt.STRING, col.size,
+                      data=np.zeros(0, dtype=np.uint8),
+                      validity=np.zeros(col.size, dtype=bool),
+                      offsets=np.zeros(col.size + 1, dtype=np.int32))
+    return get_json_object_with_instructions(col, ops)

@@ -308,12 +308,22 @@ def _f32_bits(x, normalize_zero: bool):
     return bits
 
 
-def _f64_bits(x, normalize_zero: bool):
+def _f64_bits(bits, normalize_zero: bool):
+    """NaN/zero normalization over FLOAT64 *bit-pattern* storage (Column
+    stores f64 as uint64 bits; 64-bit bitcast doesn't compile on TPU and f64
+    device storage is lossy — docs/TPU_NUMERICS.md). Pure integer ops."""
+    if jnp.issubdtype(bits.dtype, jnp.floating):
+        raise TypeError(
+            "FLOAT64 column carries raw f64 data; the bit-pattern storage "
+            "invariant (Column docstring / docs/TPU_NUMERICS.md) was "
+            "violated by its producer")
+    bits = bits.astype(jnp.uint64)
     qnan = np.uint64(0x7FF8000000000000)
-    bits = lax.bitcast_convert_type(x, jnp.uint64)
-    bits = jnp.where(jnp.isnan(x), qnan, bits)
+    abs_bits = bits & np.uint64(0x7FFFFFFFFFFFFFFF)
+    is_nan = abs_bits > np.uint64(0x7FF0000000000000)
+    bits = jnp.where(is_nan, qnan, bits)
     if normalize_zero:
-        bits = jnp.where(x == 0.0, np.uint64(0), bits)
+        bits = jnp.where(abs_bits == 0, np.uint64(0), bits)
     return bits
 
 

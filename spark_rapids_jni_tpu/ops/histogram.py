@@ -122,7 +122,7 @@ def percentile_from_histogram(histograms: Column,
     idx = base + np.arange(L, dtype=np.int64)[None, :]
     in_range = idx < offsets[1:, None]
     idx = np.clip(idx, 0, max(0, values_child.size - 1))
-    vals_flat = np.asarray(values_child.data).astype(np.float64)
+    vals_flat = values_child.host_values().astype(np.float64)
     freqs_flat = np.asarray(freqs_child.data).astype(np.int64)
     if values_child.size == 0:
         vals = np.full((n, L), np.inf)
@@ -144,9 +144,8 @@ def percentile_from_histogram(histograms: Column,
         counts = np.where(has_data, m, 0).astype(np.int32)
         loffs = np.zeros(n + 1, dtype=np.int32)
         np.cumsum(counts, out=loffs[1:])
-        child = Column(dt.FLOAT64, int(loffs[-1]),
-                       data=jnp.asarray(out[has_data].reshape(-1)))
+        child = Column.from_numpy(out[has_data].reshape(-1), dt.FLOAT64)
         return Column.list_of(child, jnp.asarray(loffs),
                               validity=jnp.asarray(has_data))
-    return Column(dt.FLOAT64, n, data=jnp.asarray(out[:, 0]),
-                  validity=jnp.asarray(has_data))
+    return Column.from_numpy(np.ascontiguousarray(out[:, 0]), dt.FLOAT64,
+                             validity=has_data)

@@ -85,6 +85,24 @@ def compute_column_information(dtypes: Sequence[DType]) -> ColumnInfo:
                       validity_offset, tuple(var_starts))
 
 
+def _split64_bytes(u: jnp.ndarray) -> jnp.ndarray:
+    """u64[n] -> little-endian uint8[n, 8] without a 64-bit bitcast (the TPU
+    X64 rewriter has no lowering for bitcast-convert on 64-bit element
+    types — docs/TPU_NUMERICS.md §3)."""
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> np.uint64(32)).astype(jnp.uint32)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(lo, jnp.uint8),
+         jax.lax.bitcast_convert_type(hi, jnp.uint8)], axis=1)
+
+
+def _join64_bytes(mat: jnp.ndarray) -> jnp.ndarray:
+    """little-endian uint8[n, 8] -> u64[n] (inverse of _split64_bytes)."""
+    lo = jax.lax.bitcast_convert_type(mat[:, :4], jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(mat[:, 4:], jnp.uint32)
+    return lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << np.uint64(32))
+
+
 def _column_bytes(col: Column) -> jnp.ndarray:
     """Fixed-width column values as little-endian uint8[n, itemsize]."""
     if col.dtype.id is TypeId.DECIMAL128:
@@ -94,6 +112,9 @@ def _column_bytes(col: Column) -> jnp.ndarray:
     data = col.data
     if data.dtype.itemsize == 1:
         return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(col.size, 1)
+    if data.dtype.itemsize == 8:
+        # int64/uint64 value-cast preserves bits; FLOAT64 is stored as bits
+        return _split64_bytes(data.astype(jnp.uint64))
     return jax.lax.bitcast_convert_type(data, jnp.uint8)
 
 
@@ -105,6 +126,11 @@ def _bytes_to_column(mat: jnp.ndarray, d: DType,
         limbs = jax.lax.bitcast_convert_type(
             mat.reshape(n, 4, 4), jnp.uint32)
         return Column(d, n, data=limbs, validity=validity)
+    if d.itemsize == 8:
+        u = _join64_bytes(mat)
+        # FLOAT64 keeps bit-pattern storage; int64 flavors value-cast back
+        data = u if d.id is TypeId.FLOAT64 else u.astype(d.jnp_dtype)
+        return Column(d, n, data=data, validity=validity)
     target = d.jnp_dtype
     if target.itemsize == 1:
         data = jax.lax.bitcast_convert_type(mat[:, 0], target)

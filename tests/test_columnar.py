@@ -65,13 +65,29 @@ def test_column_is_pytree():
     mapped = jax.tree_util.tree_map(lambda x: x, c)
     assert mapped.to_pylist() == c.to_pylist()
 
+    ci = Column.from_pylist([1, None, 2], dt.INT64)
+
     @jax.jit
     def double_data(column):
         from dataclasses import replace
         return replace(column, data=column.data * 2)
 
-    out = double_data(c)
-    assert out.to_pylist() == [3.0, None, 5.0]
+    out = double_data(ci)
+    assert out.to_pylist() == [2, None, 4]
+
+
+def test_float64_bit_pattern_storage():
+    """FLOAT64 columns store uint64 bits so device storage is exact even for
+    values outside float32's exponent range (docs/TPU_NUMERICS.md §1)."""
+    import numpy as np
+    vals = [1.23e-300, 5e-324, 1.7976931348623157e308, 0.30471707975443135,
+            -0.0, None]
+    c = Column.from_pylist(vals, dt.FLOAT64)
+    assert np.asarray(c.data).dtype == np.uint64
+    got = c.to_pylist()
+    assert got[:4] == vals[:4]
+    assert str(got[4]) == "-0.0" and got[5] is None
+    assert c.host_values().dtype == np.float64
 
 
 def test_table_pytree():

@@ -1,0 +1,136 @@
+"""Tests for get_json_object — Spark/Hive JSONPath semantics.
+
+Vectors follow the reference's behavioral spec (GetJsonObjectTest.java,
+SURVEY.md §4 tier 2): the twelve evaluatePath cases, Hive's
+single-match-unwrap and double-wildcard flattening, string unescaping on raw
+emission, Spark parser tolerances (single quotes), and null contracts.
+"""
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    get_json_object,
+    parse_path,
+)
+
+
+def run(js, path):
+    col = Column.from_pylist([js], dt.STRING)
+    return get_json_object(col, path).to_pylist()[0]
+
+
+BASIC = [
+    ('{"k": "v"}', "$.k", "v"),
+    ('{"k1":{"k2":"v2"}}', "$.k1.k2", "v2"),
+    # depth-10 key chain
+    ('{"k1":{"k2":{"k3":{"k4":{"k5":{"k6":{"k7":{"k8":{"k9":{"k10":"v10"}}}}}}}}}}',
+     "$.k1.k2.k3.k4.k5.k6.k7.k8.k9.k10", "v10"),
+    # bracket-quoted names
+    ('{"a b": 1}', "$['a b']", "1"),
+    # number / literal extraction keeps source text
+    ('{"a": 1.5}', "$.a", "1.5"),
+    ('{"a": 15}', "$.a", "15"),
+    ('{"a": true}', "$.a", "true"),
+    ('{"a": false}', "$.a", "false"),
+    # null value -> null result (evaluatePath case 10)
+    ('{"a": null}', "$.a", None),
+    # missing key -> null
+    ('{"a": 1}', "$.b", None),
+    # whole doc, compact regeneration
+    ('{ "a" : { "b" : [1, 2 , 3] } }', "$", '{"a":{"b":[1,2,3]}}'),
+]
+
+
+@pytest.mark.parametrize("js,path,exp", BASIC)
+def test_basic(js, path, exp):
+    assert run(js, path) == exp
+
+
+INDEX_WILDCARD = [
+    ("[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]",
+     "$[1]", "[10,[11],[121,122,123],13]"),
+    ("[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]",
+     "$[1][2]", "[121,122,123]"),
+    ("[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]",
+     "$[1][2][0]", "121"),
+    ("[1, 2, 3]", "$[5]", None),
+    # Hive double-wildcard flattening
+    ("[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]",
+     "$[*][*]", "[11,12,21,221,2221,22221,22222,31,32]"),
+    # single wildcard: multi keeps array, single unwraps
+    ("[1, [21, 22], 3]", "$[*]", "[1,[21,22],3]"),
+    ("[1]", "$[*]", "1"),
+    # $[*][*].k over mixed nesting: only the depth-matching row survives
+    ("[  [[[ {'k': 'v1'} ], {'k': 'v2'}]], [[{'k': 'v3'}], {'k': 'v4'}], {'k': 'v5'}  ]",
+     "$[*][*].k", '["v5"]'),
+    # wildcard over object values: evaluatePath case 4 stops after the first
+    # dirty match (Spark semantics — Hive would return [1,2])
+    ('{"a": 1, "b": 2}', "$.*", "1"),
+]
+
+
+@pytest.mark.parametrize("js,path,exp", INDEX_WILDCARD)
+def test_index_and_wildcard(js, path, exp):
+    assert run(js, path) == exp
+
+
+def test_unescape_on_raw_emission():
+    # Baidu case: \/ unescapes when a string is emitted raw
+    js = '{"url":"http:\\/\\/nadURdeo2.baRdu.cox\\/5fa.xT3"}'
+    assert run(js, "$.url") == "http://nadURdeo2.baRdu.cox/5fa.xT3"
+
+
+def test_escapes_preserved_inside_structures():
+    js = '{"a": {"s": "x\\ny"}}'
+    assert run(js, "$.a") == '{"s":"x\\ny"}'
+    assert run(js, "$.a.s") == "x\ny"
+
+
+def test_unicode_escapes():
+    assert run('{"a": "\\u0041\\u00e9"}', "$.a") == "Aé"
+    assert run('{"a": "\\ud83d\\ude00"}', "$.a") == "😀"
+
+
+def test_single_quotes_tolerance():
+    assert run("{'k': 'v'}", "$.k") == "v"
+    assert run("{'k': [1, 2]}", "$.k[1]") == "2"
+
+
+def test_invalid_json_is_null():
+    for js in ["invalid", "{", "[1, 2", '{"a": }', '{"a": 1,}', "[1 2]",
+               '{"a": 01}', ""]:
+        assert run(js, "$.a") is None, js
+
+
+def test_invalid_path_is_null():
+    for path in ["", "a.b", "$[", "$[x]", "$[-1]", "$."]:
+        assert run('{"a": 1}', path) is None, path
+
+
+def test_path_parse_shapes():
+    assert parse_path("$") == []
+    assert parse_path("$.a[1][*].b") is not None
+    assert parse_path("$..a") is None
+
+
+def test_nulls_and_batch():
+    col = Column.from_pylist(
+        ['{"a": 1}', None, '{"a": "x"}', "bad"], dt.STRING)
+    assert get_json_object(col, "$.a").to_pylist() == ["1", None, "x", None]
+
+
+def test_deep_nesting_limit():
+    ok = "[" * 60 + "1" + "]" * 60
+    assert run(ok, "$") is not None
+    too_deep = "[" * 70 + "1" + "]" * 70
+    assert run(too_deep, "$") is None
+
+
+def test_index_then_wildcard():
+    js = "[ {'k': [0, 1, 2]}, {'k': [10, 11, 12]}, {'k': [20, 21, 22]}  ]"
+    # $[1].k[*] — index, key, then wildcard (quoted downstream of index+wild)
+    assert run(js, "$[1].k[*]") == "[10,11,12]"
+    # $[*].k[*] — per reference path6/7 composition
+    assert run(js, "$[*].k[*]") == "[[0,1,2],[10,11,12],[20,21,22]]"
