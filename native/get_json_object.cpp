@@ -725,4 +725,132 @@ int gjo_eval(const uint8_t* data, const int64_t* offsets,
 
 void gjo_free(void* p) { free(p); }
 
+// ---------------------------------------------------------------------------
+// from_json → raw map: top-level key/value pairs of each JSON object row as
+// LIST<STRUCT<STRING,STRING>>. Reference capability: map_utils.cu:649
+// `from_json` (tokenize, classify top-level nodes, substring out keys and
+// values). Keys and string values are unescaped; nested object/array values
+// keep their raw source span verbatim (interior whitespace preserved), other
+// scalars keep their literal text — matching MapUtilsTest expectations.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct map_row {
+  std::vector<std::string> keys;
+  std::vector<std::string> vals;
+  bool valid = false;
+};
+
+static void map_rows(const uint8_t* data, const int64_t* offsets,
+                     const uint8_t* valid_in, long row_begin, long row_end,
+                     map_row* results) {
+  for (long r = row_begin; r < row_end; r++) {
+    if (valid_in && !valid_in[r]) continue;
+    const char* s = (const char*)data + offsets[r];
+    size_t len = (size_t)(offsets[r + 1] - offsets[r]);
+    parser p(s, len);
+    if (p.next_token() != tok::START_OBJECT) continue;
+    map_row row;
+    bool ok = true;
+    while (true) {
+      tok t = p.next_token();
+      if (t == tok::END_OBJECT) break;
+      if (t != tok::FIELD_NAME) { ok = false; break; }
+      std::string key;
+      unescape(p.buf + p.tstart, p.tend - p.tstart, key);
+      p.skip_ws();
+      size_t vstart = p.pos;
+      t = p.next_token();
+      if (t == tok::ERROR_) { ok = false; break; }
+      std::string val;
+      if (t == tok::VALUE_STRING) {
+        unescape(p.buf + p.tstart, p.tend - p.tstart, val);
+      } else if (t == tok::START_OBJECT || t == tok::START_ARRAY) {
+        if (!p.try_skip_children()) { ok = false; break; }
+        val.assign(s + vstart, p.pos - vstart);
+      } else {
+        // number / true / false / null: literal source text
+        val.assign(s + vstart, p.pos - vstart);
+      }
+      row.keys.push_back(std::move(key));
+      row.vals.push_back(std::move(val));
+    }
+    if (!ok) continue;
+    // remainder must be clean
+    while (p.cur != tok::SUCCESS) {
+      if (p.next_token() == tok::ERROR_) { ok = false; break; }
+    }
+    if (!ok) continue;
+    row.valid = true;
+    results[r] = std::move(row);
+  }
+}
+
+}  // namespace
+
+// Outputs (malloc'd, free with gjo_free): list offsets [n+1], row validity
+// [n], key blob + offsets [n_pairs+1], value blob + offsets [n_pairs+1].
+int fjm_eval(const uint8_t* data, const int64_t* offsets,
+             const uint8_t* valid_in, long n_rows,
+             int64_t** list_offs, uint8_t** row_valid,
+             uint8_t** key_data, int64_t** key_offs,
+             uint8_t** val_data, int64_t** val_offs,
+             int64_t* n_pairs_out, int64_t* key_total_out,
+             int64_t* val_total_out) {
+  std::vector<map_row> results(n_rows);
+  unsigned hw = std::thread::hardware_concurrency();
+  long nthreads = std::max(1L, std::min((long)(hw ? hw : 1), n_rows / 4096 + 1));
+  if (nthreads <= 1) {
+    map_rows(data, offsets, valid_in, 0, n_rows, results.data());
+  } else {
+    std::vector<std::thread> ts;
+    long chunk = (n_rows + nthreads - 1) / nthreads;
+    for (long t = 0; t < nthreads; t++) {
+      long b = t * chunk, e = std::min(n_rows, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back(map_rows, data, offsets, valid_in, b, e, results.data());
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  int64_t n_pairs = 0, ktotal = 0, vtotal = 0;
+  for (auto& r : results) {
+    n_pairs += (int64_t)r.keys.size();
+    for (auto& k : r.keys) ktotal += (int64_t)k.size();
+    for (auto& v : r.vals) vtotal += (int64_t)v.size();
+  }
+  *list_offs = (int64_t*)malloc(sizeof(int64_t) * (n_rows + 1));
+  *row_valid = (uint8_t*)malloc(n_rows ? n_rows : 1);
+  *key_offs = (int64_t*)malloc(sizeof(int64_t) * (n_pairs + 1));
+  *val_offs = (int64_t*)malloc(sizeof(int64_t) * (n_pairs + 1));
+  *key_data = (uint8_t*)malloc(ktotal ? ktotal : 1);
+  *val_data = (uint8_t*)malloc(vtotal ? vtotal : 1);
+  if (!*list_offs || !*row_valid || !*key_offs || !*val_offs || !*key_data ||
+      !*val_data)
+    return -2;
+  int64_t pair = 0, ko = 0, vo = 0;
+  (*list_offs)[0] = 0;
+  (*key_offs)[0] = 0;
+  (*val_offs)[0] = 0;
+  for (long r = 0; r < n_rows; r++) {
+    auto& row = results[r];
+    for (size_t i = 0; i < row.keys.size(); i++) {
+      memcpy(*key_data + ko, row.keys[i].data(), row.keys[i].size());
+      ko += (int64_t)row.keys[i].size();
+      memcpy(*val_data + vo, row.vals[i].data(), row.vals[i].size());
+      vo += (int64_t)row.vals[i].size();
+      pair++;
+      (*key_offs)[pair] = ko;
+      (*val_offs)[pair] = vo;
+    }
+    (*list_offs)[r + 1] = pair;
+    (*row_valid)[r] = row.valid ? 1 : 0;
+  }
+  *n_pairs_out = n_pairs;
+  *key_total_out = ktotal;
+  *val_total_out = vtotal;
+  return 0;
+}
+
 }  // extern "C"

@@ -1,0 +1,142 @@
+"""Groupby-aggregate (libcudf-surface capability).
+
+The reference gets groupby from vendored libcudf. TPU-first design:
+*sort-based segmented aggregation* — the XLA-native shape for grouping:
+
+  1. ``sort_order`` on the key columns (null keys form their own group,
+     Spark semantics).
+  2. Segment boundaries = sorted keys differ from their predecessor
+     (vectorized compare, no hashing collisions to resolve).
+  3. ``jax.ops.segment_*`` reductions over the sorted value columns
+     (num_segments read back once — the only host sync).
+
+Aggregations: sum / count / min / max / mean with Spark null semantics
+(nulls ignored; all-null group → null result; count counts non-nulls).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from .sort import gather, sort_order
+
+
+def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
+    """bool[n]: sorted row equals previous sorted row on this key column."""
+    idx, pidx = order[1:], order[:-1]
+    valid = col.valid_mask()
+    v_cur = jnp.take(valid, idx)
+    v_prev = jnp.take(valid, pidx)
+    if col.dtype.id is dt.TypeId.STRING:
+        data = np.asarray(col.data)
+        offs = np.asarray(col.offsets)
+        oh = np.asarray(order)
+        eq = np.empty(len(oh) - 1, dtype=bool)
+        for k in range(1, len(oh)):
+            i, j = oh[k], oh[k - 1]
+            eq[k - 1] = (data[offs[i]:offs[i + 1]].tobytes()
+                         == data[offs[j]:offs[j + 1]].tobytes())
+        same_val = jnp.asarray(eq)
+    elif col.dtype.id is dt.TypeId.DECIMAL128:
+        same_val = jnp.all(jnp.take(col.data, idx, axis=0)
+                           == jnp.take(col.data, pidx, axis=0), axis=1)
+    else:
+        same_val = jnp.take(col.data, idx) == jnp.take(col.data, pidx)
+    return (v_cur & v_prev & same_val) | (~v_cur & ~v_prev)
+
+
+def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
+    """(numeric device array, is_float) for aggregation."""
+    if col.dtype.id is dt.TypeId.FLOAT64:
+        host = col.host_values()  # bits → f64 view
+        return jnp.asarray(host), True
+    if col.dtype.id is dt.TypeId.FLOAT32:
+        return col.data.astype(jnp.float32), True
+    return col.data.astype(jnp.int64), False
+
+
+def groupby_aggregate(
+        table: Table, key_indices: Sequence[int],
+        aggs: Sequence[Tuple[int, str]]) -> Table:
+    """Group by key columns and aggregate.
+
+    ``aggs``: (column_index, op) with op in {sum, count, min, max, mean}.
+    Returns a Table of [unique keys..., one column per agg] in group-sorted
+    order.
+    """
+    keys = [table.columns[i] for i in key_indices]
+    order = sort_order(keys)
+
+    if keys[0].size == 0:
+        out_cols: List[Column] = [gather(k, order) for k in keys]
+        for ci, op in aggs:
+            od = dt.INT64 if op == "count" else table.columns[ci].dtype
+            out_cols.append(Column(od, 0, data=jnp.zeros((0,), dtype=jnp.int64)))
+        return Table(tuple(out_cols))
+
+    same = jnp.ones(keys[0].size - 1, dtype=bool) \
+        if keys[0].size > 1 else jnp.zeros(0, dtype=bool)
+    for k in keys:
+        same = same & _keys_equal_prev(k, order)
+    boundary = jnp.concatenate([jnp.ones(1, dtype=jnp.int32),
+                                (~same).astype(jnp.int32)])
+    seg_ids = jnp.cumsum(boundary) - 1
+    num_segments = int(seg_ids[-1]) + 1
+
+    # representative row of each group (first sorted row)
+    first_in_seg = jnp.asarray(np.flatnonzero(np.asarray(boundary)))
+    rep_rows = jnp.take(order, first_in_seg)
+
+    out_cols = [gather(k, rep_rows) for k in keys]
+
+    for ci, op in aggs:
+        vcol = table.columns[ci]
+        valid = jnp.take(vcol.valid_mask(), order)
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_ids,
+                                  num_segments=num_segments)
+        if op == "count":
+            out_cols.append(Column(dt.INT64, num_segments, data=cnt))
+            continue
+        vals, is_float = _agg_values(vcol)
+        vals = jnp.take(vals, order)
+        any_valid = cnt > 0
+        if op in ("sum", "mean"):
+            z = jnp.where(valid, vals, jnp.zeros_like(vals))
+            s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments)
+            if op == "mean":
+                m = s / jnp.maximum(cnt, 1).astype(s.dtype)
+                out_cols.append(Column.from_numpy(
+                    np.asarray(m, dtype=np.float64), dt.FLOAT64,
+                    validity=np.asarray(any_valid)))
+                continue
+            res = s
+        elif op == "min":
+            big = (jnp.asarray(np.inf, vals.dtype) if is_float
+                   else jnp.iinfo(jnp.int64).max)
+            z = jnp.where(valid, vals, big)
+            res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments)
+        elif op == "max":
+            small = (jnp.asarray(-np.inf, vals.dtype) if is_float
+                     else jnp.iinfo(jnp.int64).min)
+            z = jnp.where(valid, vals, small)
+            res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments)
+        else:
+            raise ValueError(f"unknown aggregation {op}")
+        if vcol.dtype.id is dt.TypeId.FLOAT64:
+            out_cols.append(Column.from_numpy(
+                np.asarray(res, dtype=np.float64), dt.FLOAT64,
+                validity=np.asarray(any_valid)))
+        else:
+            out_dtype = vcol.dtype if op in ("min", "max") else dt.INT64
+            out_cols.append(Column(out_dtype, num_segments,
+                                   data=res.astype(out_dtype.jnp_dtype)
+                                   if out_dtype.id is not dt.TypeId.FLOAT64
+                                   else res,
+                                   validity=any_valid))
+    return Table(tuple(out_cols))

@@ -1,0 +1,88 @@
+"""Spark `from_json` raw-map extraction: JSON object rows →
+LIST<STRUCT<STRING,STRING>>.
+
+Reference surface: MapUtils.extractRawMapFromJsonString (MapUtils.java:47-53)
+backed by map_utils.cu:649 `from_json`. Keys and string values are unescaped;
+nested object/array values keep their raw source span; other scalars keep
+their literal text. Null or non-object/invalid rows become null rows (the
+reference's tokenizer errors the whole batch on invalid JSON; per-row null is
+the strictly-more-useful contract and matches Spark's permissive mode).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from .get_json_object import _load
+
+
+def _declare(lib):
+    if getattr(lib, "_fjm_declared", False):
+        return lib
+    c = ctypes
+    lib.fjm_eval.restype = c.c_int
+    P8, P64 = c.POINTER(c.c_uint8), c.POINTER(c.c_int64)
+    lib.fjm_eval.argtypes = [
+        P8, P64, P8, c.c_long,
+        c.POINTER(P64), c.POINTER(P8),
+        c.POINTER(P8), c.POINTER(P64),
+        c.POINTER(P8), c.POINTER(P64),
+        P64, P64, P64,
+    ]
+    lib._fjm_declared = True
+    return lib
+
+
+def extract_raw_map_from_json_string(col: Column) -> Column:
+    """LIST<STRUCT<key STRING, value STRING>> of each row's top-level pairs."""
+    assert col.dtype.id is dt.TypeId.STRING
+    lib = _declare(_load())
+    c = ctypes
+    n = col.size
+    data = np.ascontiguousarray(np.asarray(col.data), dtype=np.uint8)
+    offsets = np.ascontiguousarray(np.asarray(col.offsets), dtype=np.int64)
+    if col.validity is not None:
+        valid = np.ascontiguousarray(np.asarray(col.validity).astype(np.uint8))
+        valid_p = valid.ctypes.data_as(c.POINTER(c.c_uint8))
+    else:
+        valid_p = None
+
+    P8, P64 = c.POINTER(c.c_uint8), c.POINTER(c.c_int64)
+    lo, rv = P64(), P8()
+    kd, ko, vd, vo = P8(), P64(), P8(), P64()
+    npairs = c.c_int64()
+    ktot = c.c_int64()
+    vtot = c.c_int64()
+    rc = lib.fjm_eval(
+        data.ctypes.data_as(P8), offsets.ctypes.data_as(P64), valid_p, n,
+        c.byref(lo), c.byref(rv), c.byref(kd), c.byref(ko), c.byref(vd),
+        c.byref(vo), c.byref(npairs), c.byref(ktot), c.byref(vtot))
+    if rc != 0:
+        raise RuntimeError(f"from_json native error {rc}")
+    try:
+        m = npairs.value
+        list_offs = np.ctypeslib.as_array(lo, shape=(n + 1,)).copy()
+        row_valid = np.ctypeslib.as_array(rv, shape=(max(n, 1),))[:n] \
+            .astype(bool).copy()
+        key_offs = np.ctypeslib.as_array(ko, shape=(m + 1,)).copy()
+        val_offs = np.ctypeslib.as_array(vo, shape=(m + 1,)).copy()
+        key_blob = np.ctypeslib.as_array(
+            kd, shape=(max(ktot.value, 1),))[:ktot.value].copy()
+        val_blob = np.ctypeslib.as_array(
+            vd, shape=(max(vtot.value, 1),))[:vtot.value].copy()
+    finally:
+        for p in (lo, rv, kd, ko, vd, vo):
+            lib.gjo_free(p)
+
+    keys = Column(dt.STRING, m, data=jnp.asarray(key_blob),
+                  offsets=jnp.asarray(key_offs.astype(np.int32)))
+    vals = Column(dt.STRING, m, data=jnp.asarray(val_blob),
+                  offsets=jnp.asarray(val_offs.astype(np.int32)))
+    struct = Column.struct_of([keys, vals])
+    return Column.list_of(struct, jnp.asarray(list_offs.astype(np.int32)),
+                          validity=jnp.asarray(row_valid))

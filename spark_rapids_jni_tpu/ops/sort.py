@@ -1,0 +1,148 @@
+"""Multi-key table sort (libcudf-surface `sort_by_key` capability).
+
+The reference vendors this from libcudf (SURVEY.md §7 phase-3 item 10: the
+GpuExec operators need sort/join/groupby from the vendored layer, not this
+repo's src). TPU-first design: every key column is lowered to one or more
+*unsigned monotone lanes* (order-preserving integer transforms — sign-bit
+flip for signed ints, IEEE total-order transform for the FLOAT64 bit
+storage, padded byte planes for strings), then a single `jnp.lexsort` runs
+on device. Descending = bitwise complement of the lane; null placement is a
+dedicated higher-priority lane. XLA's sort network does the heavy lifting —
+no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.strings import padded_bytes
+
+
+def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
+    """Order-preserving unsigned lane(s) for one column, most-significant
+    lane FIRST. Null rows may hold arbitrary values (masked by the null
+    lane)."""
+    tid = col.dtype.id
+    data = col.data
+    if tid is dt.TypeId.STRING:
+        mat, lengths = padded_bytes(col)
+        # 0-padding sorts shorter strings first, matching byte-wise order
+        # (strings containing NUL bytes tie with their prefixes; documented).
+        return [mat[:, i] for i in range(mat.shape[1])]
+    if tid is dt.TypeId.FLOAT64:
+        # bit-pattern storage → IEEE total order: negative values get all
+        # bits flipped, positives get the sign bit set.
+        bits = data.astype(jnp.uint64)
+        neg = (bits >> np.uint64(63)) != 0
+        key = jnp.where(neg, ~bits, bits | np.uint64(1 << 63))
+        return [key]
+    if tid is dt.TypeId.FLOAT32:
+        import jax
+        bits = jax.lax.bitcast_convert_type(
+            data.astype(jnp.float32), jnp.uint32)
+        neg = (bits >> np.uint32(31)) != 0
+        key = jnp.where(neg, ~bits, bits | np.uint32(1 << 31))
+        return [key]
+    if col.dtype.is_decimal and tid is not dt.TypeId.DECIMAL128:
+        data = data.astype(jnp.int64)
+        return [data.astype(jnp.uint64) ^ np.uint64(1 << 63)]
+    if tid is dt.TypeId.DECIMAL128:
+        # [n,4] u32 limbs little-endian two's complement: flip top sign bit,
+        # lanes most-significant first
+        limbs = data
+        top = limbs[:, 3] ^ np.uint32(1 << 31)
+        return [top, limbs[:, 2], limbs[:, 1], limbs[:, 0]]
+    if col.dtype.np_dtype is not None and np.issubdtype(col.dtype.np_dtype,
+                                                        np.signedinteger):
+        wide = data.astype(jnp.int64)
+        return [wide.astype(jnp.uint64) ^ np.uint64(1 << 63)]
+    # unsigned ints / bool / timestamps handled above as signed
+    if col.dtype.is_timestamp:
+        wide = data.astype(jnp.int64)
+        return [wide.astype(jnp.uint64) ^ np.uint64(1 << 63)]
+    return [data.astype(jnp.uint64)]
+
+
+def sort_order(keys: Sequence[Column],
+               ascending: Optional[Sequence[bool]] = None,
+               nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
+    """Stable order indices sorting by ``keys[0]`` (primary) then rest.
+
+    Defaults follow Spark SQL: ascending with NULLS FIRST (descending keys
+    default to NULLS LAST via the caller's flags).
+    """
+    n = keys[0].size
+    if ascending is None:
+        ascending = [True] * len(keys)
+    if nulls_first is None:
+        nulls_first = [asc for asc in ascending]
+    lanes: List[jnp.ndarray] = []
+    # lexsort: LAST array is the primary key → append minor keys first
+    for col, asc, nf in reversed(list(zip(keys, ascending, nulls_first))):
+        value_lanes = _monotone_unsigned(col)
+        if not asc:
+            value_lanes = [~v if v.dtype != jnp.bool_ else ~v
+                           for v in value_lanes]
+        # minor→major within the column, then the null lane on top
+        lanes.extend(reversed(value_lanes))
+        if col.validity is not None:
+            nl = jnp.where(col.validity,
+                           jnp.uint8(1 if nf else 0),
+                           jnp.uint8(0 if nf else 1))
+            lanes.append(nl)
+    if not lanes:
+        return jnp.arange(n, dtype=jnp.int32)
+    return jnp.lexsort(tuple(lanes)).astype(jnp.int32)
+
+
+def gather(col: Column, idx: jnp.ndarray) -> Column:
+    """Row gather of any column type (host path for nested/strings)."""
+    tid = col.dtype.id
+    m = int(idx.shape[0])
+    validity = None
+    if col.validity is not None:
+        validity = jnp.take(col.validity, idx)
+    if tid is dt.TypeId.STRING:
+        idx_h = np.asarray(idx)
+        data = np.asarray(col.data)
+        offs = np.asarray(col.offsets)
+        lens = (offs[1:] - offs[:-1])[idx_h]
+        new_offs = np.zeros(m + 1, dtype=np.int64)
+        np.cumsum(lens, out=new_offs[1:])
+        out = np.zeros(int(new_offs[-1]), dtype=np.uint8)
+        for i, j in enumerate(idx_h):
+            out[new_offs[i]:new_offs[i + 1]] = data[offs[j]:offs[j + 1]]
+        return Column(col.dtype, m, data=jnp.asarray(out),
+                      validity=validity,
+                      offsets=jnp.asarray(new_offs.astype(np.int32)))
+    if tid is dt.TypeId.LIST:
+        idx_h = np.asarray(idx)
+        offs = np.asarray(col.offsets)
+        lens = (offs[1:] - offs[:-1])[idx_h]
+        new_offs = np.zeros(m + 1, dtype=np.int32)
+        np.cumsum(lens, out=new_offs[1:])
+        child_idx = np.concatenate([
+            np.arange(offs[j], offs[j + 1]) for j in idx_h
+        ]) if m else np.zeros(0, dtype=np.int64)
+        child = gather(col.children[0], jnp.asarray(child_idx.astype(np.int32)))
+        return Column(col.dtype, m, validity=validity,
+                      offsets=jnp.asarray(new_offs),
+                      children=(child,))
+    if tid is dt.TypeId.STRUCT:
+        children = tuple(gather(c, idx) for c in col.children)
+        return Column(col.dtype, m, validity=validity, children=children)
+    return Column(col.dtype, m, data=jnp.take(col.data, idx, axis=0),
+                  validity=validity)
+
+
+def sort_table(table: Table, key_indices: Sequence[int],
+               ascending: Optional[Sequence[bool]] = None,
+               nulls_first: Optional[Sequence[bool]] = None) -> Table:
+    keys = [table.columns[i] for i in key_indices]
+    order = sort_order(keys, ascending, nulls_first)
+    return Table(tuple(gather(c, order) for c in table.columns))

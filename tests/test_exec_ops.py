@@ -1,0 +1,201 @@
+"""Tests for the libcudf-surface execution ops: sort, joins, groupby.
+
+Oracle: plain python/numpy models with Spark semantics (stable multi-key
+sort with NULLS FIRST, null-safe join equality under nulls_equal, null keys
+grouping together, aggs ignoring nulls).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import (
+    full_join,
+    inner_join,
+    left_anti_join,
+    left_join,
+    left_semi_join,
+)
+from spark_rapids_jni_tpu.ops.sort import gather, sort_order, sort_table
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def test_sort_single_int_key_with_nulls():
+    col = Column.from_pylist([3, None, 1, 2, None, -5], dt.INT64)
+    order = np.asarray(sort_order([col]))
+    got = [col.to_pylist()[i] for i in order]
+    assert got == [None, None, -5, 1, 2, 3]  # NULLS FIRST asc
+
+
+def test_sort_descending_nulls_last():
+    col = Column.from_pylist([3, None, 1], dt.INT64)
+    order = np.asarray(sort_order([col], ascending=[False]))
+    got = [col.to_pylist()[i] for i in order]
+    assert got == [3, 1, None]
+
+
+def test_sort_multi_key_stability():
+    a = Column.from_pylist([1, 2, 1, 2, 1], dt.INT32)
+    b = Column.from_pylist(["b", "x", "a", "y", "a"], dt.STRING)
+    t = sort_table(Table((a, b)), [0, 1])
+    assert t.columns[0].to_pylist() == [1, 1, 1, 2, 2]
+    assert t.columns[1].to_pylist() == ["a", "a", "b", "x", "y"]
+
+
+def test_sort_float64_total_order():
+    vals = [1.5, -2.0, float("nan"), 0.0, -0.0, float("inf"),
+            float("-inf"), 1e-300]
+    col = Column.from_pylist(vals, dt.FLOAT64)
+    order = np.asarray(sort_order([col]))
+    got = [vals[i] for i in order]
+    # IEEE total order: -inf < -2 < -0.0 < 0.0 < 1e-300 < 1.5 < inf < nan
+    assert got[0] == float("-inf") and got[1] == -2.0
+    assert str(got[2]) == "-0.0" and str(got[3]) == "0.0"
+    assert got[4] == 1e-300 and got[5] == 1.5 and got[6] == float("inf")
+    assert np.isnan(got[7])
+
+
+def test_sort_strings():
+    col = Column.from_pylist(["pear", "apple", None, "app", "banana"],
+                             dt.STRING)
+    order = np.asarray(sort_order([col]))
+    got = [col.to_pylist()[i] for i in order]
+    assert got == [None, "app", "apple", "banana", "pear"]
+
+
+def test_sort_random_against_numpy():
+    rng = np.random.default_rng(5)
+    a = rng.integers(-100, 100, 300)
+    b = rng.integers(0, 5, 300)
+    ca = Column.from_numpy(a, dt.INT64)
+    cb = Column.from_numpy(b, dt.INT32)
+    order = np.asarray(sort_order([cb, ca]))
+    expect = np.lexsort((a, b))
+    assert (order == expect).all()
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def _pairs(l_idx, r_idx):
+    return sorted(zip(l_idx.tolist(), r_idx.tolist()))
+
+
+def test_inner_join_basic():
+    lk = [Column.from_pylist([1, 2, 3, 2], dt.INT64)]
+    rk = [Column.from_pylist([2, 4, 1, 2], dt.INT64)]
+    l, r = inner_join(lk, rk)
+    assert _pairs(l, r) == [(0, 2), (1, 0), (1, 3), (3, 0), (3, 3)]
+
+
+def test_inner_join_multi_key_and_strings():
+    lk = [Column.from_pylist([1, 1, 2], dt.INT32),
+          Column.from_pylist(["a", "b", "a"], dt.STRING)]
+    rk = [Column.from_pylist([1, 2, 1], dt.INT32),
+          Column.from_pylist(["b", "a", "z"], dt.STRING)]
+    l, r = inner_join(lk, rk)
+    assert _pairs(l, r) == [(1, 0), (2, 1)]
+
+
+def test_join_null_keys():
+    lk = [Column.from_pylist([1, None, 2], dt.INT64)]
+    rk = [Column.from_pylist([None, 2], dt.INT64)]
+    l, r = inner_join(lk, rk)
+    assert _pairs(l, r) == [(2, 1)]
+    l, r = inner_join(lk, rk, nulls_equal=True)
+    assert _pairs(l, r) == [(1, 0), (2, 1)]
+
+
+def test_left_join_and_semi_anti():
+    lk = [Column.from_pylist([1, 5, 2], dt.INT64)]
+    rk = [Column.from_pylist([2, 1], dt.INT64)]
+    l, r = left_join(lk, rk)
+    assert _pairs(l, r) == [(0, 1), (1, -1), (2, 0)]
+    assert left_semi_join(lk, rk).tolist() == [0, 2]
+    assert left_anti_join(lk, rk).tolist() == [1]
+
+
+def test_full_join():
+    lk = [Column.from_pylist([1, 5], dt.INT64)]
+    rk = [Column.from_pylist([1, 7], dt.INT64)]
+    l, r = full_join(lk, rk)
+    assert _pairs(l, r) == [(-1, 1), (0, 0), (1, -1)]
+
+
+def test_join_random_against_model():
+    rng = np.random.default_rng(9)
+    lv = rng.integers(0, 50, 400)
+    rv = rng.integers(0, 50, 300)
+    lk = [Column.from_numpy(lv, dt.INT64)]
+    rk = [Column.from_numpy(rv, dt.INT64)]
+    l, r = inner_join(lk, rk)
+    got = set(zip(l.tolist(), r.tolist()))
+    expect = {(i, j) for i in range(len(lv)) for j in np.flatnonzero(
+        rv == lv[i]).tolist()}
+    assert got == expect
+
+
+# ---------------------------------------------------------------------------
+# groupby
+# ---------------------------------------------------------------------------
+
+def test_groupby_basic_aggs():
+    k = Column.from_pylist([1, 2, 1, 2, 1], dt.INT64)
+    v = Column.from_pylist([10, 20, 30, None, 50], dt.INT64)
+    t = Table((k, v))
+    out = groupby_aggregate(t, [0], [(1, "sum"), (1, "count"), (1, "min"),
+                                     (1, "max"), (1, "mean")])
+    assert out.columns[0].to_pylist() == [1, 2]
+    assert out.columns[1].to_pylist() == [90, 20]       # sum
+    assert out.columns[2].to_pylist() == [3, 1]         # count non-null
+    assert out.columns[3].to_pylist() == [10, 20]       # min
+    assert out.columns[4].to_pylist() == [50, 20]       # max
+    assert out.columns[5].to_pylist() == [30.0, 20.0]   # mean
+
+
+def test_groupby_null_keys_form_a_group():
+    k = Column.from_pylist([None, 1, None, 1], dt.INT64)
+    v = Column.from_pylist([1, 2, 3, 4], dt.INT64)
+    out = groupby_aggregate(Table((k, v)), [0], [(1, "sum")])
+    assert out.columns[0].to_pylist() == [None, 1]
+    assert out.columns[1].to_pylist() == [4, 6]
+
+
+def test_groupby_all_null_group_sum_is_null():
+    k = Column.from_pylist([1, 1, 2], dt.INT64)
+    v = Column.from_pylist([None, None, 5], dt.INT64)
+    out = groupby_aggregate(Table((k, v)), [0], [(1, "sum"), (1, "count")])
+    assert out.columns[1].to_pylist() == [None, 5]
+    assert out.columns[2].to_pylist() == [0, 1]
+
+
+def test_groupby_multi_key_strings_and_floats():
+    k1 = Column.from_pylist(["a", "b", "a", "a"], dt.STRING)
+    k2 = Column.from_pylist([1, 1, 2, 1], dt.INT32)
+    v = Column.from_pylist([1.5, 2.5, 3.5, 4.5], dt.FLOAT64)
+    out = groupby_aggregate(Table((k1, k2, v)), [0, 1], [(2, "sum")])
+    assert out.columns[0].to_pylist() == ["a", "a", "b"]
+    assert out.columns[1].to_pylist() == [1, 2, 1]
+    assert out.columns[2].to_pylist() == [6.0, 3.5, 2.5]
+
+
+def test_groupby_random_against_model():
+    rng = np.random.default_rng(13)
+    keys = rng.integers(0, 20, 500)
+    vals = rng.integers(-100, 100, 500)
+    k = Column.from_numpy(keys, dt.INT64)
+    v = Column.from_numpy(vals, dt.INT64)
+    out = groupby_aggregate(Table((k, v)), [0], [(1, "sum"), (1, "count")])
+    got_keys = out.columns[0].to_pylist()
+    assert got_keys == sorted(set(keys.tolist()))
+    for gk, gs, gc in zip(got_keys, out.columns[1].to_pylist(),
+                          out.columns[2].to_pylist()):
+        mask = keys == gk
+        assert gs == int(vals[mask].sum())
+        assert gc == int(mask.sum())

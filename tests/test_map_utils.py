@@ -1,0 +1,52 @@
+"""Tests for from_json raw-map extraction (reference MapUtilsTest vectors)."""
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.map_utils import extract_raw_map_from_json_string
+
+
+def run(rows):
+    col = Column.from_pylist(rows, dt.STRING)
+    return extract_raw_map_from_json_string(col).to_pylist()
+
+
+def test_simple_input():
+    j1 = ('{"Zipcode" : 704 , "ZipCodeType" : "STANDARD" , '
+          '"City" : "PARC PARQUE" , "State" : "PR"}')
+    j2 = "{}"
+    j3 = ('{"category": "reference", "index": [4,{},null,{"a":[{ }, {}] } ], '
+          '"author": "Nigel Rees", "title": "{}[], <=semantic-symbols-string", '
+          '"price": 8.95}')
+    out = run([j1, j2, None, j3])
+    assert out[0] == [("Zipcode", "704"), ("ZipCodeType", "STANDARD"),
+                      ("City", "PARC PARQUE"), ("State", "PR")]
+    assert out[1] == []
+    assert out[2] is None
+    assert out[3] == [("category", "reference"),
+                      ("index", '[4,{},null,{"a":[{ }, {}] } ]'),
+                      ("author", "Nigel Rees"),
+                      ("title", "{}[], <=semantic-symbols-string"),
+                      ("price", "8.95")]
+
+
+def test_utf8_and_escapes():
+    j = ('{"Zipcóde" : 704 , "ZípCodeTypé" : "\U00029e3d" , '
+         '"City" : "\U0001f3f3"}')
+    out = run([j])
+    assert out[0] == [("Zipcóde", "704"),
+                      ("ZípCodeTypé", "\U00029e3d"),
+                      ("City", "\U0001f3f3")]
+    # escaped key/value forms decode
+    out = run(['{"a\\nb": "x\\/y"}'])
+    assert out[0] == [("a\nb", "x/y")]
+
+
+def test_invalid_and_non_object_rows():
+    out = run(["[1,2]", "not json", '{"a": 1', '{"a": true}'])
+    assert out[0] is None and out[1] is None and out[2] is None
+    assert out[3] == [("a", "true")]
+
+
+def test_null_and_nested_values():
+    out = run(['{"a": null, "b": {"c": [1, 2]}}'])
+    assert out[0] == [("a", "null"), ("b", '{"c": [1, 2]}')]
