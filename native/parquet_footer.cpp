@@ -1,0 +1,667 @@
+// Native Parquet footer parse / prune / re-serialize.
+//
+// Reference capability: NativeParquetJni.cpp (830 LoC) — deserialize the
+// footer with thrift TCompactProtocol (:639-668), prune the schema tree
+// against a depth-first flattened Spark schema with case-insensitive option
+// (column_pruner :109-551, Tag VALUE/STRUCT/LIST/MAP :102), select row
+// groups whose midpoint falls in the task's split (:584-637), gather the
+// kept column chunks (:671), and re-serialize to a PAR1-framed buffer the
+// chunked reader consumes (ParquetFooter.java:106-112).
+//
+// This rebuild avoids the Apache Thrift + generated-parquet dependency with
+// a generic thrift-compact DOM: structs parse into fieldid→value maps that
+// round-trip unknown fields untouched, so the footer survives re-encode even
+// for fields this code never models. Pure host C++ (the reference's is too —
+// "No GPU work at all", SURVEY.md §3.4); exposed over a C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// thrift compact protocol: generic value tree
+// ---------------------------------------------------------------------------
+
+enum ttype : uint8_t {
+  T_STOP = 0, T_TRUE = 1, T_FALSE = 2, T_BYTE = 3, T_I16 = 4, T_I32 = 5,
+  T_I64 = 6, T_DOUBLE = 7, T_BINARY = 8, T_LIST = 9, T_SET = 10, T_MAP = 11,
+  T_STRUCT = 12,
+};
+
+struct tvalue {
+  uint8_t type = T_STOP;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0;
+  std::string bin;
+  uint8_t elem_type = T_STOP;              // for LIST/SET
+  std::vector<tvalue> list;                // LIST/SET elements
+  std::map<int16_t, tvalue> fields;        // STRUCT fields (ordered by id)
+  // MAP support (unused by parquet footers but kept for round-trip safety)
+  uint8_t key_type = T_STOP, val_type = T_STOP;
+  std::vector<std::pair<tvalue, tvalue>> kvs;
+};
+
+struct reader {
+  const uint8_t* p;
+  size_t len;
+  size_t pos = 0;
+
+  uint8_t u8() {
+    if (pos >= len) throw std::runtime_error("thrift: truncated");
+    return p[pos++];
+  }
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = u8();
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) throw std::runtime_error("thrift: varint overflow");
+    }
+    return v;
+  }
+  int64_t zigzag() {
+    uint64_t v = varint();
+    return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+  }
+
+  tvalue read_value(uint8_t t) {
+    tvalue v;
+    v.type = t;
+    switch (t) {
+      case T_TRUE: v.b = true; break;
+      case T_FALSE: v.b = false; break;
+      case T_BYTE: v.i = (int8_t)u8(); break;
+      case T_I16:
+      case T_I32:
+      case T_I64: v.i = zigzag(); break;
+      case T_DOUBLE: {
+        if (pos + 8 > len) throw std::runtime_error("thrift: truncated");
+        memcpy(&v.d, p + pos, 8);
+        pos += 8;
+        break;
+      }
+      case T_BINARY: {
+        uint64_t n = varint();
+        if (pos + n > len) throw std::runtime_error("thrift: truncated str");
+        v.bin.assign((const char*)p + pos, n);
+        pos += n;
+        break;
+      }
+      case T_LIST:
+      case T_SET: {
+        uint8_t head = u8();
+        uint8_t et = head & 0x0F;
+        uint64_t n = head >> 4;
+        if (n == 15) n = varint();
+        v.elem_type = et;
+        v.list.reserve(n);
+        for (uint64_t i = 0; i < n; i++) {
+          if (et == T_TRUE || et == T_FALSE) {
+            tvalue e;
+            e.type = et;
+            e.b = u8() == 1;
+            v.list.push_back(std::move(e));
+          } else {
+            v.list.push_back(read_value(et));
+          }
+        }
+        break;
+      }
+      case T_MAP: {
+        uint64_t n = varint();
+        if (n > 0) {
+          uint8_t kv = u8();
+          v.key_type = kv >> 4;
+          v.val_type = kv & 0x0F;
+          for (uint64_t i = 0; i < n; i++) {
+            tvalue k = read_value(v.key_type);
+            tvalue vv = read_value(v.val_type);
+            v.kvs.emplace_back(std::move(k), std::move(vv));
+          }
+        }
+        break;
+      }
+      case T_STRUCT: {
+        int16_t last_id = 0;
+        while (true) {
+          uint8_t head = u8();
+          if (head == T_STOP) break;
+          uint8_t ft = head & 0x0F;
+          int16_t delta = head >> 4;
+          int16_t fid = delta ? (int16_t)(last_id + delta)
+                              : (int16_t)zigzag();
+          last_id = fid;
+          v.fields.emplace(fid, read_value(ft));
+        }
+        break;
+      }
+      default:
+        throw std::runtime_error("thrift: unknown type " + std::to_string(t));
+    }
+    return v;
+  }
+};
+
+struct writer {
+  std::string out;
+
+  void u8(uint8_t b) { out.push_back((char)b); }
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      u8((uint8_t)(v | 0x80));
+      v >>= 7;
+    }
+    u8((uint8_t)v);
+  }
+  void zigzag(int64_t v) { varint(((uint64_t)v << 1) ^ (uint64_t)(v >> 63)); }
+
+  void write_value(const tvalue& v) {
+    switch (v.type) {
+      case T_TRUE:
+      case T_FALSE: break;  // encoded in the field/elem header for structs
+      case T_BYTE: u8((uint8_t)v.i); break;
+      case T_I16:
+      case T_I32:
+      case T_I64: zigzag(v.i); break;
+      case T_DOUBLE: {
+        char tmp[8];
+        memcpy(tmp, &v.d, 8);
+        out.append(tmp, 8);
+        break;
+      }
+      case T_BINARY:
+        varint(v.bin.size());
+        out += v.bin;
+        break;
+      case T_LIST:
+      case T_SET: {
+        size_t n = v.list.size();
+        uint8_t et = v.elem_type ? v.elem_type : T_STRUCT;
+        if (n < 15) u8((uint8_t)((n << 4) | et));
+        else {
+          u8((uint8_t)(0xF0 | et));
+          varint(n);
+        }
+        for (auto& e : v.list) {
+          if (et == T_TRUE || et == T_FALSE) u8(e.b ? 1 : 2);
+          else write_value(e);
+        }
+        break;
+      }
+      case T_MAP: {
+        varint(v.kvs.size());
+        if (!v.kvs.empty()) {
+          u8((uint8_t)((v.key_type << 4) | v.val_type));
+          for (auto& [k, vv] : v.kvs) {
+            write_value(k);
+            write_value(vv);
+          }
+        }
+        break;
+      }
+      case T_STRUCT: {
+        int16_t last_id = 0;
+        for (auto& [fid, fv] : v.fields) {
+          uint8_t ft = fv.type;
+          if (ft == T_TRUE || ft == T_FALSE) ft = fv.b ? T_TRUE : T_FALSE;
+          int32_t delta = fid - last_id;
+          if (delta > 0 && delta <= 15) {
+            u8((uint8_t)((delta << 4) | ft));
+          } else {
+            u8(ft);
+            zigzag(fid);
+          }
+          last_id = fid;
+          write_value(fv);
+        }
+        u8(T_STOP);
+        break;
+      }
+      default: throw std::runtime_error("thrift: cannot write type");
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// parquet field ids (parquet.thrift)
+// ---------------------------------------------------------------------------
+// FileMetaData: 1 version, 2 schema, 3 num_rows, 4 row_groups,
+//               7 column_orders (one per leaf column)
+constexpr int16_t FMD_SCHEMA = 2, FMD_NUM_ROWS = 3, FMD_ROW_GROUPS = 4,
+                  FMD_COLUMN_ORDERS = 7;
+// SchemaElement: 1 type, 3 repetition_type, 4 name, 5 num_children,
+//                6 converted_type
+constexpr int16_t SE_TYPE = 1, SE_REP = 3, SE_NAME = 4, SE_NUM_CHILDREN = 5,
+                  SE_CONVERTED = 6;
+// RowGroup: 1 columns, 3 num_rows, 5 file_offset, 6 total_compressed_size
+constexpr int16_t RG_COLUMNS = 1, RG_NUM_ROWS = 3, RG_FILE_OFFSET = 5,
+                  RG_TOTAL_COMPRESSED = 6;
+// ColumnChunk: 3 meta_data; ColumnMetaData: 7 total_compressed_size,
+// 9 data_page_offset, 11 dictionary_page_offset
+constexpr int16_t CC_META = 3, CMD_TOTAL_COMPRESSED = 7, CMD_DATA_PAGE = 9,
+                  CMD_DICT_PAGE = 11;
+
+constexpr int REP_REPEATED = 2;
+constexpr int CONVERTED_MAP = 1, CONVERTED_MAP_KEY_VALUE = 2;
+
+static const tvalue* get(const tvalue& s, int16_t id) {
+  auto it = s.fields.find(id);
+  return it == s.fields.end() ? nullptr : &it->second;
+}
+
+static bool is_leaf(const tvalue& se) { return get(se, SE_TYPE) != nullptr; }
+static int num_children_of(const tvalue& se) {
+  auto* c = get(se, SE_NUM_CHILDREN);
+  return c ? (int)c->i : 0;
+}
+
+static std::string lower_ascii(const std::string& s) {
+  std::string out = s;
+  for (auto& c : out)
+    if (c >= 'A' && c <= 'Z') c += 32;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// column pruner (reference column_pruner :109-551)
+// ---------------------------------------------------------------------------
+
+enum class Tag { VALUE = 0, STRUCT = 1, LIST = 2, MAP = 3 };
+
+struct pruner {
+  std::map<std::string, pruner> children;
+  Tag tag = Tag::STRUCT;
+
+  // Build from depth-first flattened (names, num_children, tags); the root
+  // is implicit with parent_num_children entries.
+  void add_depth_first(const std::vector<std::string>& names,
+                       const std::vector<int>& num_children,
+                       const std::vector<int>& tags, int parent_children,
+                       size_t& idx) {
+    for (int c = 0; c < parent_children; c++) {
+      const std::string& name = names.at(idx);
+      int nc = num_children.at(idx);
+      Tag t = (Tag)tags.at(idx);
+      idx++;
+      pruner child;
+      child.tag = t;
+      child.add_depth_first(names, num_children, tags, nc, idx);
+      children.emplace(name, std::move(child));
+    }
+  }
+
+  struct maps {
+    std::vector<int> schema_map;
+    std::vector<int> schema_num_children;
+    std::vector<int> chunk_map;
+  };
+
+  static void skip(const std::vector<const tvalue*>& schema, size_t& si,
+                   size_t& ci) {
+    int to_skip = 1;
+    while (to_skip > 0 && si < schema.size()) {
+      const tvalue& item = *schema[si];
+      if (is_leaf(item)) ++ci;
+      to_skip += num_children_of(item);
+      --to_skip;
+      ++si;
+    }
+  }
+
+  void filter_value(const std::vector<const tvalue*>& schema, size_t& si,
+                    size_t& ci, maps& m) const {
+    const tvalue& item = *schema.at(si);
+    if (!is_leaf(item))
+      throw std::runtime_error("expected a leaf value in the schema");
+    if (num_children_of(item) != 0)
+      throw std::runtime_error("leaf value with children");
+    m.schema_map.push_back((int)si);
+    m.schema_num_children.push_back(0);
+    ++si;
+    m.chunk_map.push_back((int)ci);
+    ++ci;
+  }
+
+  void filter_struct(const std::vector<const tvalue*>& schema,
+                     bool ignore_case, size_t& si, size_t& ci, maps& m) const {
+    const tvalue& item = *schema.at(si);
+    if (is_leaf(item))
+      throw std::runtime_error("expected a struct, found a leaf");
+    int nc = num_children_of(item);
+    m.schema_map.push_back((int)si);
+    int our_nc_idx = (int)m.schema_num_children.size();
+    m.schema_num_children.push_back(0);
+    ++si;
+    for (int c = 0; c < nc && si < schema.size(); c++) {
+      auto* name_f = get(*schema[si], SE_NAME);
+      std::string name = name_f ? name_f->bin : "";
+      if (ignore_case) name = lower_ascii(name);
+      auto found = children.find(name);
+      if (found != children.end()) {
+        ++m.schema_num_children[our_nc_idx];
+        found->second.filter(schema, ignore_case, si, ci, m);
+      } else {
+        skip(schema, si, ci);
+      }
+    }
+  }
+
+  void filter_list(const std::vector<const tvalue*>& schema, bool ignore_case,
+                   size_t& si, size_t& ci, maps& m) const {
+    const pruner& element = children.at("element");
+    const tvalue& item = *schema.at(si);
+    auto* name_f = get(item, SE_NAME);
+    std::string list_name = name_f ? name_f->bin : "";
+    bool group = !is_leaf(item);
+    auto rep_of = [](const tvalue& e) {
+      auto* r = get(e, SE_REP);
+      return r ? (int)r->i : -1;
+    };
+    if (!group) {
+      if (rep_of(item) != REP_REPEATED)
+        throw std::runtime_error("expected repeating list item");
+      return filter_value(schema, si, ci, m);
+    }
+    int nc = num_children_of(item);
+    if (nc > 1) {
+      if (rep_of(item) != REP_REPEATED)
+        throw std::runtime_error("expected repeating list item");
+      return element.filter(schema, ignore_case, si, ci, m);
+    }
+    if (nc != 1) throw std::runtime_error("non-standard list group");
+
+    m.schema_map.push_back((int)si);
+    m.schema_num_children.push_back(1);
+    ++si;
+
+    const tvalue& rep_item = *schema.at(si);
+    if (rep_of(rep_item) != REP_REPEATED)
+      throw std::runtime_error("non-repeating list child");
+    bool rep_group = !is_leaf(rep_item);
+    int rep_nc = num_children_of(rep_item);
+    auto* rn = get(rep_item, SE_NAME);
+    std::string rep_name = rn ? rn->bin : "";
+    if (rep_group && rep_nc == 1 && rep_name != "array" &&
+        rep_name != list_name + "_tuple") {
+      // standard 3-level list
+      m.schema_map.push_back((int)si);
+      m.schema_num_children.push_back(1);
+      ++si;
+      element.filter(schema, ignore_case, si, ci, m);
+    } else {
+      // legacy 2-level list
+      element.filter(schema, ignore_case, si, ci, m);
+    }
+  }
+
+  void filter_map(const std::vector<const tvalue*>& schema, bool ignore_case,
+                  size_t& si, size_t& ci, maps& m) const {
+    const pruner& key_p = children.at("key");
+    const pruner& value_p = children.at("value");
+    const tvalue& item = *schema.at(si);
+    if (is_leaf(item))
+      throw std::runtime_error("expected a map group, found a value");
+    auto* conv = get(item, SE_CONVERTED);
+    if (!conv || (conv->i != CONVERTED_MAP && conv->i != CONVERTED_MAP_KEY_VALUE))
+      throw std::runtime_error("expected a MAP converted type");
+    if (num_children_of(item) != 1)
+      throw std::runtime_error("non-standard outer map group");
+    m.schema_map.push_back((int)si);
+    m.schema_num_children.push_back(1);
+    ++si;
+
+    const tvalue& rep_item = *schema.at(si);
+    auto* r = get(rep_item, SE_REP);
+    if (!r || r->i != REP_REPEATED)
+      throw std::runtime_error("non-repeating map child");
+    int rep_nc = num_children_of(rep_item);
+    if (rep_nc != 1 && rep_nc != 2)
+      throw std::runtime_error("map with wrong number of children");
+    m.schema_map.push_back((int)si);
+    m.schema_num_children.push_back(rep_nc);
+    ++si;
+    key_p.filter(schema, ignore_case, si, ci, m);
+    if (rep_nc == 2) value_p.filter(schema, ignore_case, si, ci, m);
+  }
+
+  void filter(const std::vector<const tvalue*>& schema, bool ignore_case,
+              size_t& si, size_t& ci, maps& m) const {
+    switch (tag) {
+      case Tag::VALUE: return filter_value(schema, si, ci, m);
+      case Tag::STRUCT: return filter_struct(schema, ignore_case, si, ci, m);
+      case Tag::LIST: return filter_list(schema, ignore_case, si, ci, m);
+      case Tag::MAP: return filter_map(schema, ignore_case, si, ci, m);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// row-group split filtering (reference filter_groups :584-637)
+// ---------------------------------------------------------------------------
+
+static int64_t chunk_offset(const tvalue& column_chunk) {
+  auto* md = get(column_chunk, CC_META);
+  if (!md) return 0;
+  auto* dp = get(*md, CMD_DATA_PAGE);
+  int64_t off = dp ? dp->i : 0;
+  auto* dict = get(*md, CMD_DICT_PAGE);
+  if (dict && off > dict->i) off = dict->i;
+  return off;
+}
+
+static std::vector<tvalue> filter_groups(const tvalue& meta,
+                                         int64_t part_offset,
+                                         int64_t part_length) {
+  std::vector<tvalue> kept;
+  auto* rgs = get(meta, FMD_ROW_GROUPS);
+  if (!rgs) return kept;
+  int64_t pre_start = 0, pre_size = 0;
+  bool first_has_md = true;
+  if (!rgs->list.empty()) {
+    auto* cols = get(rgs->list[0], RG_COLUMNS);
+    if (cols && !cols->list.empty())
+      first_has_md = get(cols->list[0], CC_META) != nullptr;
+  }
+  for (auto& rg : rgs->list) {
+    auto* cols = get(rg, RG_COLUMNS);
+    if (!cols || cols->list.empty()) continue;
+    int64_t start;
+    if (first_has_md) {
+      start = chunk_offset(cols->list[0]);
+    } else {
+      auto* fo = get(rg, RG_FILE_OFFSET);
+      start = fo ? fo->i : 0;
+      bool invalid = (pre_start == 0 && start != 4) ||
+                     (start < pre_start + pre_size);
+      if (invalid) start = pre_start == 0 ? 4 : pre_start + pre_size;
+      pre_start = start;
+      auto* tcs0 = get(rg, RG_TOTAL_COMPRESSED);
+      pre_size = tcs0 ? tcs0->i : 0;
+    }
+    int64_t total = 0;
+    auto* tcs = get(rg, RG_TOTAL_COMPRESSED);
+    if (tcs) {
+      total = tcs->i;
+    } else {
+      for (auto& cc : cols->list) {
+        auto* md = get(cc, CC_META);
+        if (md) {
+          auto* c = get(*md, CMD_TOTAL_COMPRESSED);
+          if (c) total += c->i;
+        }
+      }
+    }
+    int64_t mid = start + total / 2;
+    if (mid >= part_offset && mid < part_offset + part_length)
+      kept.push_back(rg);
+  }
+  return kept;
+}
+
+// ---------------------------------------------------------------------------
+// footer handle
+// ---------------------------------------------------------------------------
+
+struct footer {
+  tvalue meta;  // FileMetaData struct
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse + filter. Returns handle or nullptr (err_out gets a malloc'd
+// message). names/num_children/tags describe the Spark schema depth-first
+// (root excluded; parent_num_children = root child count).
+void* pqf_read_and_filter(const uint8_t* buf, long len,
+                          long long part_offset, long long part_length,
+                          const char** names, const int* num_children,
+                          const int* tags, int n_entries,
+                          int parent_num_children, int ignore_case,
+                          char** err_out) {
+  try {
+    reader rd{buf, (size_t)len};
+    tvalue meta = rd.read_value(T_STRUCT);
+
+    // build pruner
+    pruner root;
+    std::vector<std::string> nm(n_entries);
+    std::vector<int> nc(num_children, num_children + n_entries);
+    std::vector<int> tg(tags, tags + n_entries);
+    for (int i = 0; i < n_entries; i++)
+      nm[i] = ignore_case ? lower_ascii(names[i]) : std::string(names[i]);
+    size_t idx = 0;
+    root.add_depth_first(nm, nc, tg, parent_num_children, idx);
+
+    // flatten schema element pointers
+    auto* schema_f = get(meta, FMD_SCHEMA);
+    if (!schema_f) throw std::runtime_error("footer has no schema");
+    std::vector<const tvalue*> schema;
+    schema.reserve(schema_f->list.size());
+    for (auto& se : schema_f->list) schema.push_back(&se);
+
+    pruner::maps m;
+    size_t si = 0, ci = 0;
+    // the root schema element is handled like the reference: process as a
+    // struct whose children are matched against the pruner root
+    root.filter_struct(schema, ignore_case != 0, si, ci, m);
+
+    // rebuild schema list
+    tvalue new_schema;
+    new_schema.type = T_LIST;
+    new_schema.elem_type = T_STRUCT;
+    for (size_t k = 0; k < m.schema_map.size(); k++) {
+      tvalue se = *schema[m.schema_map[k]];
+      if (!is_leaf(se)) {
+        tvalue ncv;
+        ncv.type = T_I32;
+        ncv.i = m.schema_num_children[k];
+        se.fields[SE_NUM_CHILDREN] = ncv;
+      }
+      new_schema.list.push_back(std::move(se));
+    }
+
+    // filter row groups by split, then gather kept chunks
+    std::vector<tvalue> groups = filter_groups(meta, part_offset, part_length);
+    int64_t num_rows = 0;
+    tvalue new_groups;
+    new_groups.type = T_LIST;
+    new_groups.elem_type = T_STRUCT;
+    for (auto& rg : groups) {
+      tvalue g = rg;
+      auto* cols = get(g, RG_COLUMNS);
+      if (cols) {
+        tvalue new_cols;
+        new_cols.type = T_LIST;
+        new_cols.elem_type = T_STRUCT;
+        for (int chunk_idx : m.chunk_map) {
+          if (chunk_idx < (int)cols->list.size())
+            new_cols.list.push_back(cols->list[chunk_idx]);
+        }
+        g.fields[RG_COLUMNS] = std::move(new_cols);
+      }
+      auto* nr = get(g, RG_NUM_ROWS);
+      if (nr) num_rows += nr->i;
+      new_groups.list.push_back(std::move(g));
+    }
+
+    footer* f = new footer();
+    f->meta = std::move(meta);
+    // column_orders holds one entry per leaf column: gather kept leaves
+    auto co_it = f->meta.fields.find(FMD_COLUMN_ORDERS);
+    if (co_it != f->meta.fields.end()) {
+      tvalue new_co;
+      new_co.type = T_LIST;
+      new_co.elem_type = co_it->second.elem_type;
+      for (int chunk_idx : m.chunk_map) {
+        if (chunk_idx < (int)co_it->second.list.size())
+          new_co.list.push_back(co_it->second.list[chunk_idx]);
+      }
+      co_it->second = std::move(new_co);
+    }
+    f->meta.fields[FMD_SCHEMA] = std::move(new_schema);
+    f->meta.fields[FMD_ROW_GROUPS] = std::move(new_groups);
+    tvalue nrv;
+    nrv.type = T_I64;
+    nrv.i = num_rows;
+    f->meta.fields[FMD_NUM_ROWS] = nrv;
+    return f;
+  } catch (std::exception& e) {
+    if (err_out) *err_out = strdup(e.what());
+    return nullptr;
+  }
+}
+
+long long pqf_num_rows(void* h) {
+  auto* f = (footer*)h;
+  auto* nr = get(f->meta, FMD_NUM_ROWS);
+  return nr ? nr->i : 0;
+}
+
+int pqf_num_columns(void* h) {
+  // number of top-level children of the (pruned) root schema element
+  auto* f = (footer*)h;
+  auto* schema = get(f->meta, FMD_SCHEMA);
+  if (!schema || schema->list.empty()) return 0;
+  return num_children_of(schema->list[0]);
+}
+
+// Serialize to a PAR1-framed footer-only file image:
+// "PAR1" + thrift + u32 footer_len + "PAR1" (ParquetFooter.java:106-112).
+int pqf_serialize(void* h, uint8_t** out, long long* out_len) {
+  try {
+    auto* f = (footer*)h;
+    writer w;
+    w.write_value(f->meta);
+    std::string& t = w.out;
+    size_t total = 4 + t.size() + 4 + 4;
+    uint8_t* buf = (uint8_t*)malloc(total);
+    if (!buf) return -2;
+    memcpy(buf, "PAR1", 4);
+    memcpy(buf + 4, t.data(), t.size());
+    uint32_t flen = (uint32_t)t.size();
+    memcpy(buf + 4 + t.size(), &flen, 4);
+    memcpy(buf + 4 + t.size() + 4, "PAR1", 4);
+    *out = buf;
+    *out_len = (long long)total;
+    return 0;
+  } catch (std::exception&) {
+    return -1;
+  }
+}
+
+void pqf_close(void* h) { delete (footer*)h; }
+void pqf_free(void* p) { free(p); }
+
+}  // extern "C"

@@ -1,0 +1,16 @@
+"""Parquet footer subsystem (host metadata path).
+
+Public surface mirrors the reference's ParquetFooter.java: a schema
+description DSL (StructBuilder/Value/List/Map), `read_and_filter`, row/column
+counts, and `serialize_thrift_file` producing a PAR1-framed buffer for the
+chunked reader.
+"""
+
+from .footer import (
+    FooterSchema,
+    ParquetFooter,
+    SchemaBuilder,
+    read_and_filter,
+)
+
+__all__ = ["FooterSchema", "ParquetFooter", "SchemaBuilder", "read_and_filter"]
