@@ -1,0 +1,35 @@
+"""Fault injection for the TPU runtime (reference: faultinj/faultinj.cu).
+
+The reference ships ``libcufaultinj.so``: a CUPTI callback that matches CUDA
+API calls by name / callback id / ``*`` with probability + count settings
+from a JSON config (hot-reloadable), then injects traps, device asserts, or
+substituted return codes (faultinj/README.md:61-170).
+
+TPU equivalent: XLA/PJRT has no CUPTI, but the framework's device-entry
+points are known functions — the injector wraps them at install time and
+consults the same JSON schema (``FAULT_INJECTOR_CONFIG_PATH``) before each
+call. injectionType 0/1 raise device-style errors; type 2 raises
+``InjectedApiError(substituteReturnCode)``.
+"""
+
+from .injector import (
+    DeviceAssertError,
+    DeviceTrapError,
+    FaultInjector,
+    InjectedApiError,
+    fault_point,
+    get_injector,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "DeviceAssertError",
+    "DeviceTrapError",
+    "FaultInjector",
+    "InjectedApiError",
+    "fault_point",
+    "get_injector",
+    "install",
+    "uninstall",
+]

@@ -1,0 +1,203 @@
+"""Fault injector core.
+
+JSON config schema mirrors the reference exactly (faultinj/README.md:61-170):
+
+```json
+{
+  "logLevel": 1,
+  "dynamic": true,
+  "xlaRuntimeFaults": {
+    "murmur_hash3_32": {"percent": 50, "injectionType": 0,
+                         "interceptionCount": 10},
+    "*": {"percent": 1, "injectionType": 2, "substituteReturnCode": 999,
+           "interceptionCount": 1000}
+  }
+}
+```
+
+``cudaRuntimeFaults``/``cudaDriverFaults`` sections are accepted as aliases
+so reference configs can be reused verbatim. injectionType: 0 = device trap,
+1 = device assert, 2 = substitute return code. ``interceptionCount`` bounds
+how many consecutive matched calls are sampled; ``percent`` is the
+per-sample probability. ``dynamic: true`` re-reads the config when its
+mtime changes (the reference uses an inotify thread; polling on call entry
+is equivalent for a shim).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+_SECTION_KEYS = ("xlaRuntimeFaults", "cudaRuntimeFaults", "cudaDriverFaults")
+
+
+class DeviceTrapError(RuntimeError):
+    """injectionType 0 — analog of a PTX trap killing the context."""
+
+
+class DeviceAssertError(RuntimeError):
+    """injectionType 1 — analog of a device-side assert."""
+
+
+class InjectedApiError(RuntimeError):
+    """injectionType 2 — API returned a substituted error code."""
+
+    def __init__(self, code: int, api: str):
+        super().__init__(f"injected error code {code} from {api}")
+        self.code = code
+        self.api = api
+
+
+class _Rule:
+    def __init__(self, cfg: dict):
+        self.percent = float(cfg.get("percent", 0))
+        self.injection_type = int(cfg.get("injectionType", 0))
+        self.count_remaining = int(cfg.get("interceptionCount", 0))
+        self.substitute = int(cfg.get("substituteReturnCode", 0))
+
+    def maybe_fire(self, api: str, rng: random.Random):
+        if self.count_remaining <= 0:
+            return
+        self.count_remaining -= 1
+        if rng.uniform(0, 100) >= self.percent:
+            return
+        if self.injection_type == 0:
+            raise DeviceTrapError(f"injected trap at {api}")
+        if self.injection_type == 1:
+            raise DeviceAssertError(f"injected device assert at {api}")
+        raise InjectedApiError(self.substitute, api)
+
+
+class FaultInjector:
+    def __init__(self, config_path: Optional[str] = None, seed: int = None):
+        self._path = config_path or os.environ.get(
+            "FAULT_INJECTOR_CONFIG_PATH")
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._rules: Dict[str, _Rule] = {}
+        self._dynamic = False
+        self._mtime = 0.0
+        self._last_check = 0.0
+        self._patched = []
+        if self._path:
+            self._load()
+
+    # -- config ---------------------------------------------------------
+
+    def _load(self):
+        try:
+            with open(self._path) as f:
+                cfg = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        rules: Dict[str, _Rule] = {}
+        for section in _SECTION_KEYS:
+            for name, rule_cfg in (cfg.get(section) or {}).items():
+                rules[name] = _Rule(rule_cfg)
+        with self._lock:
+            self._rules = rules
+            self._dynamic = bool(cfg.get("dynamic", False))
+            try:
+                self._mtime = os.path.getmtime(self._path)
+            except OSError:
+                self._mtime = 0.0
+
+    def _maybe_reload(self):
+        if not self._dynamic or not self._path:
+            return
+        now = time.monotonic()
+        if now - self._last_check < 0.05:
+            return
+        self._last_check = now
+        try:
+            m = os.path.getmtime(self._path)
+        except OSError:
+            return
+        if m != self._mtime:
+            self._load()
+
+    # -- interception ---------------------------------------------------
+
+    def check(self, api: str):
+        """Consult the rules for one API call (may raise)."""
+        self._maybe_reload()
+        with self._lock:
+            rule = self._rules.get(api) or self._rules.get("*")
+            if rule is None:
+                return
+            rule.maybe_fire(api, self._rng)
+
+    def wrap(self, fn, api: str):
+        def wrapper(*a, **kw):
+            self.check(api)
+            return fn(*a, **kw)
+        wrapper.__name__ = getattr(fn, "__name__", api)
+        wrapper.__wrapped_for_faultinj__ = fn
+        return wrapper
+
+    # -- framework instrumentation --------------------------------------
+
+    # device-entry points patched at install; name → (module path, attr)
+    _TARGETS = [
+        ("spark_rapids_jni_tpu.ops.hashing", "murmur_hash3_32"),
+        ("spark_rapids_jni_tpu.ops.hashing", "xxhash64"),
+        ("spark_rapids_jni_tpu.ops.row_conversion", "convert_to_rows"),
+        ("spark_rapids_jni_tpu.ops.row_conversion", "convert_from_rows"),
+        ("spark_rapids_jni_tpu.ops.cast_float_to_string", "float_to_string"),
+        ("spark_rapids_jni_tpu.ops.get_json_object", "get_json_object"),
+        ("spark_rapids_jni_tpu.ops.sort", "sort_order"),
+    ]
+
+    def install(self):
+        """Wrap the framework's device-entry functions (the CUPTI-subscribe
+        analog). Idempotent; ``uninstall`` restores originals."""
+        import importlib
+        for mod_name, attr in self._TARGETS:
+            try:
+                mod = importlib.import_module(mod_name)
+            except ImportError:
+                continue
+            fn = getattr(mod, attr, None)
+            if fn is None or hasattr(fn, "__wrapped_for_faultinj__"):
+                continue
+            setattr(mod, attr, self.wrap(fn, attr))
+            self._patched.append((mod, attr, fn))
+
+    def uninstall(self):
+        for mod, attr, fn in self._patched:
+            setattr(mod, attr, fn)
+        self._patched.clear()
+
+
+_global: Optional[FaultInjector] = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _global
+
+
+def install(config_path: Optional[str] = None, seed: int = None) -> FaultInjector:
+    global _global
+    if _global is not None:
+        _global.uninstall()
+    _global = FaultInjector(config_path, seed)
+    _global.install()
+    return _global
+
+
+def uninstall():
+    global _global
+    if _global is not None:
+        _global.uninstall()
+        _global = None
+
+
+def fault_point(api: str):
+    """Explicit checkpoint for code paths not covered by install()."""
+    if _global is not None:
+        _global.check(api)

@@ -1,0 +1,113 @@
+"""Tests for the fault-injection shim (reference faultinj config semantics)."""
+
+import json
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.faultinj import (
+    DeviceAssertError,
+    DeviceTrapError,
+    InjectedApiError,
+    fault_point,
+    install,
+    uninstall,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    uninstall()
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def test_named_rule_fires_on_patched_entry(tmp_path):
+    path = write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "murmur_hash3_32": {"percent": 100, "injectionType": 0,
+                                "interceptionCount": 100},
+        }})
+    install(path, seed=0)
+    from spark_rapids_jni_tpu.ops import hashing
+    col = Column.from_pylist([1, 2], dt.INT32)
+    with pytest.raises(DeviceTrapError):
+        hashing.murmur_hash3_32(Table((col,)))
+    # un-matched entry unaffected
+    hashing.xxhash64(Table((col,)))
+
+
+def test_wildcard_and_substitute_code(tmp_path):
+    path = write_cfg(tmp_path, {
+        "cudaRuntimeFaults": {   # reference-section alias accepted
+            "*": {"percent": 100, "injectionType": 2,
+                  "substituteReturnCode": 999, "interceptionCount": 10},
+        }})
+    install(path, seed=0)
+    with pytest.raises(InjectedApiError) as ei:
+        fault_point("anything_at_all")
+    assert ei.value.code == 999
+
+
+def test_interception_count_exhausts(tmp_path):
+    path = write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "op": {"percent": 100, "injectionType": 1,
+                   "interceptionCount": 2}}})
+    install(path, seed=0)
+    for _ in range(2):
+        with pytest.raises(DeviceAssertError):
+            fault_point("op")
+    fault_point("op")  # budget exhausted -> no injection
+
+
+def test_percent_zero_never_fires(tmp_path):
+    path = write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "*": {"percent": 0, "injectionType": 0,
+                  "interceptionCount": 1000}}})
+    install(path, seed=0)
+    for _ in range(100):
+        fault_point("op")
+
+
+def test_dynamic_reload(tmp_path):
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "op": {"percent": 0, "injectionType": 0,
+                   "interceptionCount": 1000}}}))
+    install(str(p), seed=0)
+    fault_point("op")  # percent 0: no fire
+    time.sleep(0.06)
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "op": {"percent": 100, "injectionType": 0,
+                   "interceptionCount": 1000}}}))
+    # ensure mtime changes even on coarse filesystems
+    import os
+    os.utime(p, (time.time(), time.time() + 1))
+    time.sleep(0.06)
+    with pytest.raises(DeviceTrapError):
+        fault_point("op")
+
+
+def test_uninstall_restores(tmp_path):
+    path = write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "murmur_hash3_32": {"percent": 100, "injectionType": 0,
+                                "interceptionCount": 100}}})
+    install(path, seed=0)
+    uninstall()
+    from spark_rapids_jni_tpu.ops import hashing
+    col = Column.from_pylist([1, 2], dt.INT32)
+    hashing.murmur_hash3_32(Table((col,)))  # no injection after uninstall
