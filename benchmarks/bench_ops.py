@@ -1,0 +1,158 @@
+"""Microbenchmark suite mirroring the reference's NVBench axes.
+
+Reference (benchmarks/CMakeLists.txt + SURVEY.md §5.1): row_conversion
+(1M/4M rows × fixed-only / string-mix), bloom_filter build+probe,
+cast_string_to_float, parse_uri. Each benchmark prints ONE JSON line:
+{"bench", "config", "rows", "seconds", "rows_per_s", "gb_per_s"}.
+
+Run: ``python benchmarks/bench_ops.py [--rows N] [--bench NAME]``
+(on the default backend — the axon TPU when tunneled, CPU otherwise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ensure_backend():
+    import jax
+    try:
+        jax.devices()
+    except RuntimeError as e:
+        print(f"bench: accelerator unavailable ({e}); using cpu",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
+def _time(fn, warmup=1, iters=3):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn()) if hasattr(fn(), "block_until_ready") \
+            else fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+        try:
+            jax.block_until_ready(r)
+        except Exception:
+            pass
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_row_conversion(rows: int, with_strings: bool):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        convert_from_rows,
+        convert_to_rows,
+    )
+    rng = np.random.default_rng(0)
+    cols = [
+        Column.from_numpy(rng.integers(-2**31, 2**31, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, 100, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.standard_normal(rows), dt.FLOAT64),
+        Column.from_numpy(rng.integers(0, 2, rows).astype(np.uint8), dt.BOOL8),
+    ]
+    nbytes = rows * (8 + 4 + 8 + 1)
+    if with_strings:
+        strs = [f"string-{i % 1000:04d}" for i in range(rows)]
+        cols.append(Column.from_pylist(strs, dt.STRING))
+        nbytes += rows * 11
+    t = Table(tuple(cols))
+    dtypes = [c.dtype for c in t.columns]
+
+    batches = convert_to_rows(t)
+    sec = _time(lambda: convert_to_rows(t))
+    back = convert_from_rows(batches[0], dtypes)
+    assert back.columns[0].size == rows
+    return sec, nbytes
+
+
+def bench_bloom_filter(rows: int):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    rng = np.random.default_rng(0)
+    keys = Column.from_numpy(rng.integers(0, 1 << 40, rows), dt.INT64)
+    filt = bf.bloom_filter_create(num_hashes=3, num_longs=max(64, rows // 16))
+    filt = bf.bloom_filter_put(filt, keys)
+    sec = _time(lambda: bf.bloom_filter_probe(keys, filt))
+    return sec, rows * 8
+
+
+def bench_cast_string_to_float(rows: int):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_float
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal(rows) * 10.0 ** rng.integers(-5, 6, rows)
+    strs = [f"{v:.6f}" for v in vals]
+    col = Column.from_pylist(strs, dt.STRING)
+    nbytes = sum(len(s) for s in strs)
+    sec = _time(lambda: string_to_float(col, dt.FLOAT64))
+    return sec, nbytes
+
+
+def bench_parse_uri(rows: int):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.parse_uri import parse_uri_to_host
+    urls = [f"https://host{i % 97}.example.com:8080/path/p{i}?q={i}&r=2"
+            for i in range(rows)]
+    col = Column.from_pylist(urls, dt.STRING)
+    nbytes = sum(len(u) for u in urls)
+    sec = _time(lambda: parse_uri_to_host(col))
+    return sec, nbytes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--bench", default="all",
+                    choices=["all", "row_conversion", "bloom_filter",
+                             "cast_string_to_float", "parse_uri"])
+    args = ap.parse_args()
+    _ensure_backend()
+
+    runs = []
+    if args.bench in ("all", "row_conversion"):
+        runs.append(("row_conversion", "fixed",
+                     lambda: bench_row_conversion(args.rows, False)))
+        runs.append(("row_conversion", "strings",
+                     lambda: bench_row_conversion(
+                         min(args.rows, 1_000_000), True)))
+    if args.bench in ("all", "bloom_filter"):
+        runs.append(("bloom_filter", "build+probe",
+                     lambda: bench_bloom_filter(args.rows)))
+    if args.bench in ("all", "cast_string_to_float"):
+        runs.append(("cast_string_to_float", "mixed",
+                     lambda: bench_cast_string_to_float(
+                         min(args.rows, 500_000))))
+    if args.bench in ("all", "parse_uri"):
+        runs.append(("parse_uri", "host",
+                     lambda: bench_parse_uri(min(args.rows, 200_000))))
+
+    for name, config, fn in runs:
+        sec, nbytes = fn()
+        print(json.dumps({
+            "bench": name,
+            "config": config,
+            "rows": args.rows,
+            "seconds": round(sec, 6),
+            "rows_per_s": round(args.rows / sec, 1),
+            "gb_per_s": round(nbytes / sec / 1e9, 4),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

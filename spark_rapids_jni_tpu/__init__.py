@@ -10,15 +10,16 @@ GPU-memory-aware task retry scheduler, and native Parquet footer pruning.
 This package rebuilds that surface TPU-first:
   * columnar/  - Column/Table representation (JAX pytrees: typed data +
                  validity masks + offsets children), host builders.
-  * ops/       - Spark-semantics kernels as XLA/Pallas programs.
+  * ops/       - Spark-semantics kernels as XLA programs, plus the
+                 execution-layer ops (sort / hash-join / groupby) the
+                 query operators need.
   * memory/    - HBM reservation ledger + the Spark resource adaptor
                  (retry-OOM state machine) implemented in native C++.
-  * parquet/   - Thrift-compact footer parse/prune (native C++ with a
-                 pure-Python fallback).
-  * parallel/  - jax.sharding mesh utilities for multi-chip columnar
-                 exchange (hash-partitioned shuffle over ICI).
-  * models/    - end-to-end query pipelines (the "flagship models"):
-                 hash-join / groupby-aggregate / sort compositions.
+  * parquet/   - Thrift-compact footer parse/prune (native C++).
+  * faultinj/  - fault-injection shim (reference JSON config schema).
+  * utils/     - tracing (xprof spans, the NVTX analog).
+Multi-chip columnar exchange lives in __graft_entry__.dryrun_multichip
+(hash-partitioned all_to_all over a jax.sharding Mesh).
 
 Spark longs, xxhash64 and decimal128 limb math require 64-bit integers, so
 x64 mode is enabled at import (TPU emulates int64; hot kernels use 32-bit

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: tracing (xprof spans — the NVTX-range analog)."""
+
+from .tracing import func_range, start_trace, stop_trace, trace_range, tracing_enabled
+
+__all__ = ["func_range", "start_trace", "stop_trace", "trace_range",
+           "tracing_enabled"]

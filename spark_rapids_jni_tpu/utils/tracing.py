@@ -1,0 +1,63 @@
+"""Trace annotations (reference: NVTX ranges, SURVEY.md §5.1).
+
+The reference wraps JNI entry points in ``CUDF_FUNC_RANGE()`` NVTX ranges,
+toggled by ``ai.rapids.cudf.nvtx.enabled``. TPU equivalent: XLA's profiler
+(xprof) consumes ``jax.profiler.TraceAnnotation`` spans; this module provides
+the same always-cheap-when-off discipline behind the
+``SPARK_RAPIDS_TPU_TRACE`` env var.
+
+Usage::
+
+    @func_range()               # span named after the function
+    def convert_to_rows(...): ...
+
+    with trace_range("shuffle-pack"):
+        ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("SPARK_RAPIDS_TPU_TRACE", "0") not in ("0", "", "false")
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    if not tracing_enabled():
+        yield
+        return
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def func_range(name: str = None):
+    """Decorator: wrap the call in a named xprof span (CUDF_FUNC_RANGE)."""
+    def deco(fn):
+        span = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not tracing_enabled():
+                return fn(*a, **kw)
+            import jax
+            with jax.profiler.TraceAnnotation(span):
+                return fn(*a, **kw)
+        return wrapper
+    return deco
+
+
+def start_trace(log_dir: str):
+    """Begin an xprof capture (pairs with stop_trace)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    import jax
+    jax.profiler.stop_trace()
