@@ -43,25 +43,29 @@ def main():
     a = jnp.asarray(rng.integers(-(2**31), 2**31, n).astype(np.int32))
     b = jnp.asarray(rng.integers(-(2**62), 2**62, n, dtype=np.int64))
     c = jnp.asarray(rng.random(n, dtype=np.float32))
-    d = jnp.asarray(rng.random(n).astype(np.float64))
+    # FLOAT64 storage invariant: columns carry uint64 *bit patterns*, not raw
+    # f64 (Column docstring / docs/TPU_NUMERICS.md) — ship bits to _f64_bits
+    d = jnp.asarray(rng.random(n).view(np.uint64))
 
     @jax.jit
-    def row_hash(a, b, c, d):
-        h = jnp.full(a.shape, np.uint32(42), dtype=jnp.uint32)
+    def row_hash(seed, a, b, c, d):
+        h = jnp.full(a.shape, np.uint32(42), dtype=jnp.uint32) + seed
         h = H._mm_u32(h, a.astype(jnp.uint32))
         h = H._mm_u64(h, b.astype(jnp.uint64))
         h = H._mm_u32(h, H._f32_bits(c, False))
         h = H._mm_u64(h, H._f64_bits(d, False))
         return h.astype(jnp.int32)
 
-    out = row_hash(a, b, c, d)
+    out = row_hash(jnp.uint32(0), a, b, c, d)
     out.block_until_ready()  # compile + warm
 
-    iters = 20
+    # vary an input each iteration and block per iteration: with identical
+    # args the runtime elides re-execution and reports impossible throughput
+    iters = 30
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = row_hash(a, b, c, d)
-    out.block_until_ready()
+    for i in range(iters):
+        out = row_hash(jnp.uint32(i + 1), a, b, c, d)
+        out.block_until_ready()
     dt = (time.perf_counter() - t0) / iters
 
     rows_per_s = n / dt

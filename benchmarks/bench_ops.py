@@ -36,15 +36,11 @@ def _ensure_backend():
 def _time(fn, warmup=1, iters=3):
     import jax
     for _ in range(warmup):
-        jax.block_until_ready(fn()) if hasattr(fn(), "block_until_ready") \
-            else fn()
+        jax.block_until_ready(fn())
     t0 = time.perf_counter()
     for _ in range(iters):
         r = fn()
-        try:
-            jax.block_until_ready(r)
-        except Exception:
-            pass
+    jax.block_until_ready(r)
     return (time.perf_counter() - t0) / iters
 
 

@@ -93,7 +93,8 @@ struct reader {
       }
       case T_BINARY: {
         uint64_t n = varint();
-        if (pos + n > len) throw std::runtime_error("thrift: truncated str");
+        // overflow-proof form: n is attacker-controlled, pos + n can wrap
+        if (n > len - pos) throw std::runtime_error("thrift: truncated str");
         v.bin.assign((const char*)p + pos, n);
         pos += n;
         break;
@@ -105,7 +106,8 @@ struct reader {
         uint64_t n = head >> 4;
         if (n == 15) n = varint();
         v.elem_type = et;
-        v.list.reserve(n);
+        // each element consumes >=1 byte, so bound reserve by remaining input
+        v.list.reserve(std::min(n, (uint64_t)(len - pos)));
         for (uint64_t i = 0; i < n; i++) {
           if (et == T_TRUE || et == T_FALSE) {
             tvalue e;
@@ -120,13 +122,27 @@ struct reader {
       }
       case T_MAP: {
         uint64_t n = varint();
+        // every entry consumes >=1 byte (bools read a byte below), so a
+        // count beyond the remaining input is malformed — reject before
+        // looping on an attacker-controlled size
+        if (n > len - pos) throw std::runtime_error("thrift: map too large");
         if (n > 0) {
           uint8_t kv = u8();
           v.key_type = kv >> 4;
           v.val_type = kv & 0x0F;
+          auto read_entry = [&](uint8_t t) {
+            // compact protocol encodes bool map elements as one byte
+            if (t == T_TRUE || t == T_FALSE) {
+              tvalue e;
+              e.type = t;
+              e.b = u8() == 1;
+              return e;
+            }
+            return read_value(t);
+          };
           for (uint64_t i = 0; i < n; i++) {
-            tvalue k = read_value(v.key_type);
-            tvalue vv = read_value(v.val_type);
+            tvalue k = read_entry(v.key_type);
+            tvalue vv = read_entry(v.val_type);
             v.kvs.emplace_back(std::move(k), std::move(vv));
           }
         }

@@ -97,7 +97,11 @@ class SparkResourceAdaptor:
 
     def close(self) -> None:
         self._closed.set()
-        self._watchdog.join(timeout=2.0)
+        # join without a timeout: the wait-loop exits promptly once _closed is
+        # set, and destroying the handle while the watchdog may still be inside
+        # rm_check_and_break_deadlocks would be a use-after-free
+        if self._watchdog is not threading.current_thread():
+            self._watchdog.join()
         h, self._handle = self._handle, None
         if h:
             self._lib.rm_destroy(h)

@@ -52,13 +52,27 @@ def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
 
 
 def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
-    """(numeric device array, is_float) for aggregation."""
+    """(numeric device array, is_float) for aggregation. Floats accumulate in
+    f64: Spark promotes float to double before summing."""
     if col.dtype.id is dt.TypeId.FLOAT64:
         host = col.host_values()  # bits → f64 view
         return jnp.asarray(host), True
     if col.dtype.id is dt.TypeId.FLOAT32:
-        return col.data.astype(jnp.float32), True
+        return col.data.astype(jnp.float64), True
     return col.data.astype(jnp.int64), False
+
+
+def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
+    """Result dtype of an aggregation, identical for empty and non-empty
+    inputs (Spark: sum(float/double)→double, sum(int)→long, mean→double)."""
+    if op == "count":
+        return dt.INT64
+    if op == "mean":
+        return dt.FLOAT64
+    if op == "sum":
+        return dt.FLOAT64 if vdtype.id in (dt.TypeId.FLOAT32,
+                                           dt.TypeId.FLOAT64) else dt.INT64
+    return vdtype  # min / max keep the input type
 
 
 def groupby_aggregate(
@@ -76,8 +90,9 @@ def groupby_aggregate(
     if keys[0].size == 0:
         out_cols: List[Column] = [gather(k, order) for k in keys]
         for ci, op in aggs:
-            od = dt.INT64 if op == "count" else table.columns[ci].dtype
-            out_cols.append(Column(od, 0, data=jnp.zeros((0,), dtype=jnp.int64)))
+            od = _agg_out_dtype(table.columns[ci].dtype, op)
+            out_cols.append(Column.from_numpy(
+                np.zeros((0,), dtype=od.np_dtype), od))
         return Table(tuple(out_cols))
 
     same = jnp.ones(keys[0].size - 1, dtype=bool) \
@@ -128,15 +143,13 @@ def groupby_aggregate(
             res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments)
         else:
             raise ValueError(f"unknown aggregation {op}")
-        if vcol.dtype.id is dt.TypeId.FLOAT64:
+        out_dtype = _agg_out_dtype(vcol.dtype, op)
+        if out_dtype.id is dt.TypeId.FLOAT64:
             out_cols.append(Column.from_numpy(
                 np.asarray(res, dtype=np.float64), dt.FLOAT64,
                 validity=np.asarray(any_valid)))
         else:
-            out_dtype = vcol.dtype if op in ("min", "max") else dt.INT64
             out_cols.append(Column(out_dtype, num_segments,
-                                   data=res.astype(out_dtype.jnp_dtype)
-                                   if out_dtype.id is not dt.TypeId.FLOAT64
-                                   else res,
+                                   data=res.astype(out_dtype.jnp_dtype),
                                    validity=any_valid))
     return Table(tuple(out_cols))

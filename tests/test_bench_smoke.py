@@ -1,0 +1,45 @@
+"""Smoke tests pinning the driver benchmark entry points.
+
+Round-1 regression (VERDICT weak #1): commit b8a44fd changed the FLOAT64
+storage invariant to uint64 bit patterns but ``bench.py`` still shipped raw
+f64, so the driver's chip run crashed (BENCH_r01.json rc=1) and no perf
+evidence was captured. These tests import and execute the same code paths the
+driver runs, on whatever backend the test session uses, so an invariant
+change can never silently break the bench again.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+
+def test_f64_bits_rejects_raw_floats():
+    """Pin the invariant that broke bench.py in round 1: _f64_bits must take
+    uint64 bit patterns and reject raw f64 loudly (not silently mis-hash)."""
+    import jax.numpy as jnp
+    import pytest
+    from spark_rapids_jni_tpu.ops import hashing as H
+
+    bits = jnp.asarray(np.array([1.5, -0.0, np.nan]).view(np.uint64))
+    out = np.asarray(H._f64_bits(bits, False))
+    assert out.dtype == np.uint64
+    # canonical NaN normalization
+    assert out[2] == 0x7FF8000000000000
+
+    with pytest.raises(TypeError):
+        H._f64_bits(jnp.asarray(np.array([1.5])), False)
+
+
+def test_bench_py_emits_json_line():
+    """Run the actual bench.py script end-to-end (tiny iteration count is not
+    configurable, so keep this as the one slow-ish smoke)."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd=__file__.rsplit("/", 2)[0], timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0
