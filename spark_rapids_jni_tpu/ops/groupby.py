@@ -24,30 +24,29 @@ import numpy as np
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
+from ..columnar.strings import padded_bytes
+from .hashing import spark_key_values
 from .sort import gather, sort_order
 
 
 def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
-    """bool[n]: sorted row equals previous sorted row on this key column."""
+    """bool[n]: sorted row equals previous sorted row on this key column.
+    Fully device-resident (padded-byte-matrix compare for strings)."""
     idx, pidx = order[1:], order[:-1]
     valid = col.valid_mask()
     v_cur = jnp.take(valid, idx)
     v_prev = jnp.take(valid, pidx)
     if col.dtype.id is dt.TypeId.STRING:
-        data = np.asarray(col.data)
-        offs = np.asarray(col.offsets)
-        oh = np.asarray(order)
-        eq = np.empty(len(oh) - 1, dtype=bool)
-        for k in range(1, len(oh)):
-            i, j = oh[k], oh[k - 1]
-            eq[k - 1] = (data[offs[i]:offs[i + 1]].tobytes()
-                         == data[offs[j]:offs[j + 1]].tobytes())
-        same_val = jnp.asarray(eq)
+        mat, lengths = padded_bytes(col)
+        same_val = (jnp.all(jnp.take(mat, idx, axis=0)
+                            == jnp.take(mat, pidx, axis=0), axis=1)
+                    & (jnp.take(lengths, idx) == jnp.take(lengths, pidx)))
     elif col.dtype.id is dt.TypeId.DECIMAL128:
         same_val = jnp.all(jnp.take(col.data, idx, axis=0)
                            == jnp.take(col.data, pidx, axis=0), axis=1)
     else:
-        same_val = jnp.take(col.data, idx) == jnp.take(col.data, pidx)
+        vals = spark_key_values(col)
+        same_val = jnp.take(vals, idx) == jnp.take(vals, pidx)
     return (v_cur & v_prev & same_val) | (~v_cur & ~v_prev)
 
 

@@ -327,6 +327,18 @@ def _f64_bits(bits, normalize_zero: bool):
     return bits
 
 
+def spark_key_values(col: Column) -> jnp.ndarray:
+    """Comparable device representation of a join/group key column: float
+    bits normalized (canonical NaN, -0.0→0.0) so equality agrees with the
+    row hash and the sort order — Spark treats all NaNs as equal and
+    -0.0 == 0.0 for join/group keys. Non-float columns pass through."""
+    if col.dtype.id is TypeId.FLOAT64:
+        return _f64_bits(col.data, normalize_zero=True)
+    if col.dtype.id is TypeId.FLOAT32:
+        return _f32_bits(col.data.astype(jnp.float32), normalize_zero=True)
+    return col.data
+
+
 def _fixed_element_words(col_dtype: DType, data, for_xxhash: bool):
     """Return ('u32'|'u64', words) for a fixed-width element column."""
     tid = col_dtype.id

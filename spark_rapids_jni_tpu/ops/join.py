@@ -10,9 +10,13 @@ but sort + searchsorted do:
   2. Sort the right side's hashes (XLA sort network).
   3. Per left row, binary-search the run of equal hashes
      (``searchsorted`` left/right) — vectorized, no loops.
-  4. Expand candidate pairs (host: output size is data-dependent; gather
-     maps are host-bound artifacts exactly as in the reference's JNI
-     contract) and verify true key equality to kill hash collisions.
+  4. Expand candidate pairs on device (``jnp.repeat`` with a static total —
+     the single data-dependent size readback is the gather-map length,
+     matching the reference's JNI contract where gather maps are the
+     product) and verify true key equality vectorized to kill collisions:
+     strings as padded-byte-matrix compares, floats over normalized bits
+     (canonical NaN, -0.0→0.0 — Spark key equality; agrees with the row
+     hash and the sort order).
 
 Null join keys match only under ``nulls_equal`` (Spark's <=> null-safe
 equality; cudf null_equality::EQUAL).
@@ -27,77 +31,88 @@ import numpy as np
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
-from .hashing import xxhash64
+from ..columnar.strings import padded_bytes
+from .hashing import spark_key_values, xxhash64
 
 
-def _row_hash(cols: Sequence[Column]) -> np.ndarray:
-    h = xxhash64(Table(tuple(cols)))
-    return np.asarray(h.data).astype(np.uint64)
+def _row_hash(cols: Sequence[Column]) -> jnp.ndarray:
+    return xxhash64(Table(tuple(cols))).data.astype(jnp.uint64)
 
 
-def _any_null(cols: Sequence[Column]) -> np.ndarray:
+def _any_null(cols: Sequence[Column]) -> jnp.ndarray:
     n = cols[0].size
-    out = np.zeros(n, dtype=bool)
+    out = jnp.zeros(n, dtype=bool)
     for c in cols:
         if c.validity is not None:
-            out |= ~np.asarray(c.validity)
+            out = out | ~c.validity
     return out
 
 
-def _col_equal(lc: Column, l_idx: np.ndarray, rc: Column, r_idx: np.ndarray,
-               nulls_equal: bool) -> np.ndarray:
-    lv = (np.ones(lc.size, dtype=bool) if lc.validity is None
-          else np.asarray(lc.validity))[l_idx]
-    rv = (np.ones(rc.size, dtype=bool) if rc.validity is None
-          else np.asarray(rc.validity))[r_idx]
+def _col_equal(lc: Column, l_idx: jnp.ndarray, rc: Column, r_idx: jnp.ndarray,
+               nulls_equal: bool) -> jnp.ndarray:
+    """Vectorized device equality of candidate row pairs on one key column."""
+    lv = jnp.take(lc.valid_mask(), l_idx)
+    rv = jnp.take(rc.valid_mask(), r_idx)
     if lc.dtype.id is dt.TypeId.STRING:
-        ld, lo = np.asarray(lc.data), np.asarray(lc.offsets)
-        rd, ro = np.asarray(rc.data), np.asarray(rc.offsets)
-        vals = np.empty(len(l_idx), dtype=bool)
-        for k, (i, j) in enumerate(zip(l_idx, r_idx)):
-            vals[k] = (ld[lo[i]:lo[i + 1]].tobytes()
-                       == rd[ro[j]:ro[j + 1]].tobytes())
+        lmat, llen = padded_bytes(lc)
+        rmat, rlen = padded_bytes(rc)
+        W = max(lmat.shape[1], rmat.shape[1])
+        if lmat.shape[1] < W:
+            lmat = jnp.pad(lmat, ((0, 0), (0, W - lmat.shape[1])))
+        if rmat.shape[1] < W:
+            rmat = jnp.pad(rmat, ((0, 0), (0, W - rmat.shape[1])))
+        vals = (jnp.all(jnp.take(lmat, l_idx, axis=0)
+                        == jnp.take(rmat, r_idx, axis=0), axis=1)
+                & (jnp.take(llen, l_idx) == jnp.take(rlen, r_idx)))
     elif lc.dtype.id is dt.TypeId.DECIMAL128:
-        vals = (np.asarray(lc.data)[l_idx] == np.asarray(rc.data)[r_idx]) \
-            .all(axis=1)
+        vals = jnp.all(jnp.take(lc.data, l_idx, axis=0)
+                       == jnp.take(rc.data, r_idx, axis=0), axis=1)
     else:
-        vals = np.asarray(lc.data)[l_idx] == np.asarray(rc.data)[r_idx]
-    both_valid = lv & rv
-    eq = both_valid & vals
+        vals = (jnp.take(spark_key_values(lc), l_idx)
+                == jnp.take(spark_key_values(rc), r_idx))
+    eq = lv & rv & vals
     if nulls_equal:
-        eq |= ~lv & ~rv
+        eq = eq | (~lv & ~rv)
     return eq
 
 
 def _candidates(left_keys, right_keys, nulls_equal):
-    """(l_idx, r_idx) candidate pairs with equal row hash, verified exact."""
+    """(l_idx, r_idx) candidate pairs with equal row hash, verified exact.
+    Device-resident; the only host syncs are the two data-dependent output
+    sizes (candidate count, then verified-match count)."""
     hl = _row_hash(left_keys)
     hr = _row_hash(right_keys)
-    ln = _any_null(left_keys)
-    rn = _any_null(right_keys)
+    nl, nr = hl.shape[0], hr.shape[0]
     if not nulls_equal:
         # poison null-key hashes so they can never meet
-        hl = np.where(ln, np.uint64(0x0BAD0BAD0BAD0BAD) ^ np.arange(
-            len(hl), dtype=np.uint64), hl)
-        hr = np.where(rn, np.uint64(0x1BAD1BAD1BAD1BAD) ^ np.arange(
-            len(hr), dtype=np.uint64) + np.uint64(1 << 63), hr)
+        ln = _any_null(left_keys)
+        rn = _any_null(right_keys)
+        hl = jnp.where(ln, np.uint64(0x0BAD0BAD0BAD0BAD)
+                       ^ jnp.arange(nl, dtype=jnp.uint64), hl)
+        hr = jnp.where(rn, np.uint64(0x1BAD1BAD1BAD1BAD)
+                       ^ (jnp.arange(nr, dtype=jnp.uint64)
+                          + np.uint64(1 << 63)), hr)
 
-    order = np.asarray(jnp.argsort(jnp.asarray(hr)))
-    hr_sorted = hr[order]
-    lo = np.searchsorted(hr_sorted, hl, side="left")
-    hi = np.searchsorted(hr_sorted, hl, side="right")
-    cnt = hi - lo
-    total = int(cnt.sum())
-    l_idx = np.repeat(np.arange(len(hl)), cnt)
-    within = np.arange(total) - np.repeat(np.cumsum(cnt) - cnt, cnt)
-    r_idx = order[np.repeat(lo, cnt) + within]
+    order = jnp.argsort(hr)
+    hr_sorted = jnp.take(hr, order)
+    lo = jnp.searchsorted(hr_sorted, hl, side="left")
+    hi = jnp.searchsorted(hr_sorted, hl, side="right")
+    cnt = (hi - lo).astype(jnp.int32)
+    total = int(jnp.sum(cnt))  # host sync #1: candidate-pair count
+    if total == 0:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
+                       total_repeat_length=total)
+    start = jnp.cumsum(cnt) - cnt
+    within = jnp.arange(total, dtype=jnp.int32) - jnp.take(start, l_idx)
+    r_idx = jnp.take(order, jnp.take(lo, l_idx) + within)
 
-    keep = np.ones(total, dtype=bool)
+    keep = jnp.ones(total, dtype=bool)
     for lc, rc in zip(left_keys, right_keys):
-        if not keep.any():
-            break
-        keep &= _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
-    return l_idx[keep], r_idx[keep]
+        keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
+    keep_h = np.asarray(keep)  # host sync #2: verified-match compaction
+    return (np.asarray(l_idx)[keep_h].astype(np.int64),
+            np.asarray(r_idx)[keep_h].astype(np.int64))
 
 
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],

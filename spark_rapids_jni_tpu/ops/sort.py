@@ -21,6 +21,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
+from .hashing import _f32_bits, _f64_bits
 
 
 def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
@@ -35,16 +36,16 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
         # (strings containing NUL bytes tie with their prefixes; documented).
         return [mat[:, i] for i in range(mat.shape[1])]
     if tid is dt.TypeId.FLOAT64:
-        # bit-pattern storage → IEEE total order: negative values get all
-        # bits flipped, positives get the sign bit set.
-        bits = data.astype(jnp.uint64)
+        # bit-pattern storage → Spark order: normalize first (all NaNs equal
+        # and sort as one value above +inf; -0.0 ties 0.0 — matching the row
+        # hash in ops/hashing), then the IEEE total-order transform (negative
+        # values get all bits flipped, positives get the sign bit set).
+        bits = _f64_bits(data, normalize_zero=True)
         neg = (bits >> np.uint64(63)) != 0
         key = jnp.where(neg, ~bits, bits | np.uint64(1 << 63))
         return [key]
     if tid is dt.TypeId.FLOAT32:
-        import jax
-        bits = jax.lax.bitcast_convert_type(
-            data.astype(jnp.float32), jnp.uint32)
+        bits = _f32_bits(data.astype(jnp.float32), normalize_zero=True)
         neg = (bits >> np.uint32(31)) != 0
         key = jnp.where(neg, ~bits, bits | np.uint32(1 << 31))
         return [key]
@@ -100,38 +101,46 @@ def sort_order(keys: Sequence[Column],
     return jnp.lexsort(tuple(lanes)).astype(jnp.int32)
 
 
+def _segment_element_indices(offs: jnp.ndarray, idx: jnp.ndarray):
+    """Device flat-element gather plan for offset-based columns: for rows
+    ``idx``, return (element source indices, new offsets). The only host
+    sync is the output-size readback (data-dependent shape)."""
+    lens = offs[1:] - offs[:-1]
+    lens_g = jnp.take(lens, idx)
+    new_offs = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                                jnp.cumsum(lens_g).astype(jnp.int32)])
+    total = int(new_offs[-1]) if idx.shape[0] else 0
+    if total == 0:
+        return jnp.zeros((0,), dtype=jnp.int32), new_offs
+    row_of_el = jnp.repeat(jnp.arange(idx.shape[0], dtype=jnp.int32), lens_g,
+                           total_repeat_length=total)
+    el_in_row = (jnp.arange(total, dtype=jnp.int32)
+                 - jnp.take(new_offs, row_of_el))
+    src_start = jnp.take(offs, jnp.take(idx, row_of_el))
+    return src_start + el_in_row, new_offs
+
+
 def gather(col: Column, idx: jnp.ndarray) -> Column:
-    """Row gather of any column type (host path for nested/strings)."""
+    """Row gather of any column type — device-resident (flat-byte gather for
+    strings/lists; only output sizing syncs to host)."""
     tid = col.dtype.id
+    idx = jnp.asarray(idx)
     m = int(idx.shape[0])
     validity = None
     if col.validity is not None:
         validity = jnp.take(col.validity, idx)
     if tid is dt.TypeId.STRING:
-        idx_h = np.asarray(idx)
-        data = np.asarray(col.data)
-        offs = np.asarray(col.offsets)
-        lens = (offs[1:] - offs[:-1])[idx_h]
-        new_offs = np.zeros(m + 1, dtype=np.int64)
-        np.cumsum(lens, out=new_offs[1:])
-        out = np.zeros(int(new_offs[-1]), dtype=np.uint8)
-        for i, j in enumerate(idx_h):
-            out[new_offs[i]:new_offs[i + 1]] = data[offs[j]:offs[j + 1]]
-        return Column(col.dtype, m, data=jnp.asarray(out),
-                      validity=validity,
-                      offsets=jnp.asarray(new_offs.astype(np.int32)))
+        offs = jnp.asarray(col.offsets, dtype=jnp.int32)
+        src, new_offs = _segment_element_indices(offs, idx)
+        data = (jnp.take(col.data, src) if src.shape[0]
+                else jnp.zeros((0,), dtype=jnp.uint8))
+        return Column(col.dtype, m, data=data, validity=validity,
+                      offsets=new_offs)
     if tid is dt.TypeId.LIST:
-        idx_h = np.asarray(idx)
-        offs = np.asarray(col.offsets)
-        lens = (offs[1:] - offs[:-1])[idx_h]
-        new_offs = np.zeros(m + 1, dtype=np.int32)
-        np.cumsum(lens, out=new_offs[1:])
-        child_idx = np.concatenate([
-            np.arange(offs[j], offs[j + 1]) for j in idx_h
-        ]) if m else np.zeros(0, dtype=np.int64)
-        child = gather(col.children[0], jnp.asarray(child_idx.astype(np.int32)))
-        return Column(col.dtype, m, validity=validity,
-                      offsets=jnp.asarray(new_offs),
+        offs = jnp.asarray(col.offsets, dtype=jnp.int32)
+        src, new_offs = _segment_element_indices(offs, idx)
+        child = gather(col.children[0], src)
+        return Column(col.dtype, m, validity=validity, offsets=new_offs,
                       children=(child,))
     if tid is dt.TypeId.STRUCT:
         children = tuple(gather(c, idx) for c in col.children)

@@ -47,17 +47,31 @@ def test_sort_multi_key_stability():
     assert t.columns[1].to_pylist() == ["a", "a", "b", "x", "y"]
 
 
-def test_sort_float64_total_order():
+def test_sort_float64_spark_order():
     vals = [1.5, -2.0, float("nan"), 0.0, -0.0, float("inf"),
             float("-inf"), 1e-300]
     col = Column.from_pylist(vals, dt.FLOAT64)
     order = np.asarray(sort_order([col]))
     got = [vals[i] for i in order]
-    # IEEE total order: -inf < -2 < -0.0 < 0.0 < 1e-300 < 1.5 < inf < nan
+    # Spark order: -inf < -2 < (-0.0 == 0.0) < 1e-300 < 1.5 < inf < nan;
+    # zeros tie, so the stable sort keeps input order (0.0 before -0.0)
     assert got[0] == float("-inf") and got[1] == -2.0
-    assert str(got[2]) == "-0.0" and str(got[3]) == "0.0"
+    assert str(got[2]) == "0.0" and str(got[3]) == "-0.0"
     assert got[4] == 1e-300 and got[5] == 1.5 and got[6] == float("inf")
     assert np.isnan(got[7])
+
+
+def test_sort_float64_nans_group_together():
+    # distinct NaN payloads and -NaN must sort adjacent (Spark: one NaN value)
+    import struct
+    neg_nan = struct.unpack("<d", struct.pack("<Q", 0xFFF8000000000001))[0]
+    payload_nan = struct.unpack("<d", struct.pack("<Q", 0x7FF8000000000042))[0]
+    vals = [neg_nan, 2.0, payload_nan, float("inf"), float("nan")]
+    col = Column.from_pylist(vals, dt.FLOAT64)
+    order = np.asarray(sort_order([col]))
+    got = [vals[i] for i in order]
+    assert got[0] == 2.0 and got[1] == float("inf")
+    assert all(np.isnan(v) for v in got[2:])
 
 
 def test_sort_strings():
@@ -199,3 +213,34 @@ def test_groupby_random_against_model():
         mask = keys == gk
         assert gs == int(vals[mask].sum())
         assert gc == int(mask.sum())
+
+
+def test_join_float_keys_spark_equality():
+    # Spark key semantics: -0.0 == 0.0 and NaN == NaN (ADVICE r1 medium)
+    l = Column.from_pylist([0.0, float("nan"), 1.5], dt.FLOAT64)
+    r = Column.from_pylist([-0.0, float("nan"), 2.5], dt.FLOAT64)
+    li, ri = inner_join([l], [r])
+    pairs = sorted(zip(li.tolist(), ri.tolist()))
+    assert pairs == [(0, 0), (1, 1)]
+
+
+def test_groupby_float_keys_spark_equality():
+    import struct
+    payload_nan = struct.unpack("<d", struct.pack("<Q", 0x7FF8000000000042))[0]
+    k = Column.from_pylist([0.0, -0.0, float("nan"), payload_nan], dt.FLOAT64)
+    v = Column.from_pylist([1, 2, 4, 8], dt.INT64)
+    out = groupby_aggregate(Table((k, v)), [0], [(1, "sum")])
+    assert out.columns[1].to_pylist() == [3, 12]  # zeros merge; NaNs merge
+
+
+def test_groupby_float32_sum_yields_double():
+    k = Column.from_pylist([1, 1, 2], dt.INT32)
+    v = Column.from_numpy(np.array([0.5, 0.25, 1.5], np.float32), dt.FLOAT32)
+    out = groupby_aggregate(Table((k, v)), [0], [(1, "sum")])
+    assert out.columns[1].dtype.id is dt.TypeId.FLOAT64
+    assert out.columns[1].to_pylist() == [0.75, 1.5]
+    # empty input must produce the same result dtype (schema stability)
+    empty = groupby_aggregate(
+        Table((Column.from_pylist([], dt.INT32),
+               Column.from_pylist([], dt.FLOAT32))), [0], [(1, "sum")])
+    assert empty.columns[1].dtype.id is dt.TypeId.FLOAT64
