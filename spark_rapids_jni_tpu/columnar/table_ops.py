@@ -1,0 +1,95 @@
+"""Table-level utilities: concat, slice, and gather-map application.
+
+Capability parity with the cudf table algebra the reference consumes for
+free (`cudf::gather`, `cudf::concatenate`, `cudf::slice` — vendored layer,
+SURVEY.md §7 item 10): join gather maps and groupby results need to be
+applied to payload columns without each caller reinventing it.
+
+TPU-first: fixed-width paths are pure device ops; STRING/LIST use the
+flat-byte gather plan from ops/sort (device take, sizing-only host sync).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column, Table
+
+
+def gather_column(col: Column, idx, out_of_bounds_null: bool = False) -> Column:
+    """Gather rows by index. With ``out_of_bounds_null`` (cudf
+    out_of_bounds_policy::NULLIFY), index -1 produces a null row — the
+    contract outer-join gather maps rely on."""
+    from ..ops.sort import gather  # late import: ops depends on columnar
+
+    idx = jnp.asarray(idx)
+    if not out_of_bounds_null:
+        return gather(col, idx)
+    safe = jnp.clip(idx, 0, max(col.size - 1, 0))
+    out = gather(col, safe)
+    miss = (idx < 0) | (idx >= col.size)  # any index outside [0, n) nullifies
+    return out.with_validity(out.valid_mask() & ~miss)
+
+
+def gather_table(table: Table, idx, out_of_bounds_null: bool = False) -> Table:
+    return Table(tuple(gather_column(c, idx, out_of_bounds_null)
+                       for c in table.columns))
+
+
+def _concat_validity(cols: Sequence[Column]):
+    if all(c.validity is None for c in cols):
+        return None
+    return jnp.concatenate([c.valid_mask() for c in cols])
+
+
+def concat_columns(cols: Sequence[Column]) -> Column:
+    """Concatenate equal-dtype columns rowwise."""
+    cols = [c for c in cols]
+    assert cols, "concat of zero columns"
+    d = cols[0].dtype
+    for c in cols[1:]:
+        if c.dtype.id is not d.id:
+            raise TypeError(f"concat dtype mismatch: {c.dtype} vs {d}")
+    n = sum(c.size for c in cols)
+    validity = _concat_validity(cols)
+    tid = d.id
+    if tid is dt.TypeId.STRING or tid is dt.TypeId.LIST:
+        offs = [np.asarray(c.offsets, dtype=np.int64) for c in cols]
+        bases = np.cumsum([0] + [o[-1] for o in offs[:-1]])
+        new_offs = np.concatenate(
+            [np.zeros(1, np.int64)] + [o[1:] + b for o, b in zip(offs, bases)])
+        if tid is dt.TypeId.STRING:
+            datas = [c.data for c in cols if c.data.shape[0]]
+            data = (jnp.concatenate(datas) if datas
+                    else jnp.zeros((0,), dtype=jnp.uint8))
+            return Column(d, n, data=data, validity=validity,
+                          offsets=jnp.asarray(new_offs.astype(np.int32)))
+        child = concat_columns([c.children[0] for c in cols])
+        return Column(d, n, validity=validity,
+                      offsets=jnp.asarray(new_offs.astype(np.int32)),
+                      children=(child,))
+    if tid is dt.TypeId.STRUCT:
+        children = tuple(
+            concat_columns([c.children[i] for c in cols])
+            for i in range(len(cols[0].children)))
+        return Column(d, n, validity=validity, children=children)
+    data = jnp.concatenate([c.data for c in cols], axis=0)
+    return Column(d, n, data=data, validity=validity)
+
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    tables = [t for t in tables]
+    assert tables, "concat of zero tables"
+    ncols = tables[0].num_columns
+    return Table(tuple(concat_columns([t.columns[i] for t in tables])
+                       for i in range(ncols)))
+
+
+def slice_table(table: Table, start: int, end: int) -> Table:
+    """Row slice [start, end) of every column."""
+    idx = jnp.arange(start, end, dtype=jnp.int32)
+    return gather_table(table, idx)

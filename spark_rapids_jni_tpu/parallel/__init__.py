@@ -1,0 +1,22 @@
+"""Multi-chip columnar parallelism over a jax.sharding.Mesh.
+
+The reference's distributed story is Spark shuffle (SURVEY.md §2.3 item 5 /
+§5.8: no NCCL/MPI in-repo; the exchange layer is the JVM's). The TPU-native
+rebuild carries the exchange itself: hash-partition columnar shuffles ride
+ICI as XLA `all_to_all` collectives inside `shard_map`, with static slot
+shapes (XLA needs static shapes; capacity = the per-device row count).
+"""
+
+from .exchange import hash_partition_exchange
+from .distributed import (
+    distributed_groupby,
+    distributed_inner_join,
+    distributed_sort,
+)
+
+__all__ = [
+    "hash_partition_exchange",
+    "distributed_groupby",
+    "distributed_inner_join",
+    "distributed_sort",
+]

@@ -1,0 +1,115 @@
+"""Distributed execution ops: groupby / join / sort over a device mesh.
+
+Each op is the classic two-phase shape: a hash (or range) partition exchange
+rides ICI via `parallel.exchange`, then the *single-device package ops*
+(ops/groupby, ops/join, ops/sort) run on each local partition — the same
+code path the single-chip engine uses, so multi-chip correctness is the
+exchange plus proven kernels, not a second implementation.
+
+The reference delegates this layer to Spark itself (shuffle + per-task cudf
+calls, SURVEY.md §2.3); here it is in-framework because on TPU the exchange
+is an XLA collective, not an external shuffle service.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..columnar.column import Column, Table
+from ..columnar import dtype as dt
+from ..columnar.table_ops import concat_tables
+from ..ops.groupby import groupby_aggregate
+from ..ops.join import inner_join
+from ..ops.sort import sort_order, sort_table
+from .exchange import hash_partition_exchange
+
+
+def distributed_groupby(table: Table, key_indices: Sequence[int],
+                        aggs: Sequence[Tuple[int, str]],
+                        mesh: Mesh) -> Table:
+    """Groupby-aggregate across the mesh: hash-partition by key so every
+    group is wholly on one device, local groupby per partition, concat."""
+    parts = hash_partition_exchange(table, key_indices, mesh)
+    outs = [groupby_aggregate(p, key_indices, aggs) for p in parts
+            if p.num_rows]
+    if not outs:
+        return groupby_aggregate(table, key_indices, aggs)  # empty schema
+    return concat_tables(outs)
+
+
+def _with_row_ids(cols: Sequence[Column]) -> Table:
+    n = cols[0].size if cols else 0
+    rid = Column(dt.INT64, n, data=jnp.arange(n, dtype=jnp.int64))
+    return Table(tuple(cols) + (rid,))
+
+
+def distributed_inner_join(
+        left_keys: Sequence[Column], right_keys: Sequence[Column],
+        mesh: Mesh, nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Inner-join gather maps (global row indices) computed co-partitioned:
+    both sides shuffle by key hash, local joins produce local maps, and the
+    carried original row ids translate them back to global indices."""
+    nk = len(left_keys)
+    key_idx = list(range(nk))
+    lparts = hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh)
+    rparts = hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh)
+    l_out: List[np.ndarray] = []
+    r_out: List[np.ndarray] = []
+    for lp, rp in zip(lparts, rparts):
+        if lp.num_rows == 0 or rp.num_rows == 0:
+            continue
+        li, ri = inner_join(list(lp.columns[:nk]), list(rp.columns[:nk]),
+                            nulls_equal=nulls_equal)
+        l_out.append(np.asarray(lp.columns[nk].data)[li])
+        r_out.append(np.asarray(rp.columns[nk].data)[ri])
+    if not l_out:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    return np.concatenate(l_out), np.concatenate(r_out)
+
+
+def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
+                     samples_per_part: int = 64) -> Table:
+    """Sample-sort across the mesh: sample keys to pick nd-1 splitters,
+    range-partition (partition p holds keys in [splitter[p-1], splitter[p])),
+    local sort per partition, concat in partition order = total order."""
+    nd = mesh.devices.size
+    n = table.num_rows
+    keys = [table.columns[i] for i in key_indices]
+    if n == 0 or nd == 1:
+        return sort_table(table, key_indices)
+
+    # sample rows, sort them with the real comparator, take even splitters
+    rng = np.random.default_rng(0)
+    m = min(n, samples_per_part * nd)
+    sample_idx = jnp.asarray(
+        np.sort(rng.choice(n, size=m, replace=False)).astype(np.int32))
+    from ..columnar.table_ops import concat_columns
+    from ..ops.sort import gather
+    sampled = [gather(k, sample_idx) for k in keys]
+    sorder = np.asarray(sort_order(sampled))
+    splitter_rows = jnp.asarray(
+        np.array([sorder[(j * m) // nd] for j in range(1, nd)],
+                 dtype=np.int32))
+
+    # destination = number of splitters sorting strictly before the row;
+    # one merged stable sort ranks all rows against all splitters with the
+    # exact ops/sort comparator (splitters appended last, so equal rows
+    # precede their splitter and share a partition)
+    merged = [concat_columns([k, gather(s, splitter_rows)])
+              for k, s in zip(keys, sampled)]
+    order = np.asarray(sort_order(merged))
+    pos = np.empty(n + nd - 1, dtype=np.int64)
+    pos[order] = np.arange(n + nd - 1)
+    splitter_pos = np.sort(pos[n:])
+    dest = np.searchsorted(splitter_pos, pos[:n]).astype(np.int32)
+
+    parts = hash_partition_exchange(table, key_indices, mesh,
+                                    dest=jnp.asarray(dest))
+    outs = [sort_table(p, key_indices) for p in parts if p.num_rows]
+    if not outs:
+        return sort_table(table, key_indices)
+    return concat_tables(outs)

@@ -1,0 +1,173 @@
+"""Hash-partition columnar exchange: the TPU-native shuffle.
+
+Design (TPU-first, not a port — the reference has no in-repo exchange; Spark
+shuffle + JCUDF rows fill this role there, SURVEY.md §5.8):
+
+  1. Row route = Spark murmur3 of the key columns (ops/hashing) mod the mesh
+     size, so partitioning agrees with Spark's HashPartitioner convention of
+     hashing the same bytes (route quality, not a wire contract).
+  2. Every column is lowered to fixed-shape device buffers (fixed-width
+     values, validity masks, padded string bytes + lengths) — XLA collectives
+     need static shapes.
+  3. Inside `shard_map`, each device slot-packs its rows into a
+     `[n_devices, rows_per_device]` grid keyed by (destination, rank within
+     destination) and one `lax.all_to_all` per buffer rides ICI. Slot
+     capacity is statically safe: a source holds only `rows_per_device` rows.
+  4. Receivers flatten their `n_devices * rows_per_device` landing zone; a
+     shipped occupancy mask marks live rows. The only host syncs are the
+     final per-partition compactions (data-dependent sizes), mirroring the
+     repo-wide "sizing on host, data on device" doctrine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.strings import from_padded_bytes, padded_bytes
+from ..ops.hashing import murmur_hash3_32
+
+def _mesh_axis(mesh: Mesh) -> str:
+    assert len(mesh.axis_names) == 1, "exchange needs a 1-D mesh"
+    return mesh.axis_names[0]
+
+
+# jitted exchange programs cached by (mesh, per_dev, buffer signature): a
+# fresh jit(shard_map(...)) per call would recompile every same-shape shuffle
+_EXCHANGE_CACHE: dict = {}
+
+
+def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
+    """Lower a column to fixed-shape [n, ...] buffers + rebuild metadata."""
+    tid = col.dtype.id
+    valid = col.valid_mask()
+    if tid is dt.TypeId.STRING:
+        mat, lengths = padded_bytes(col)
+        return [mat, lengths.astype(jnp.int32), valid], {
+            "kind": "string", "dtype": col.dtype}
+    if tid in (dt.TypeId.LIST, dt.TypeId.STRUCT):
+        raise NotImplementedError(
+            "nested columns are not yet exchangeable; flatten first")
+    return [col.data, valid], {"kind": "fixed", "dtype": col.dtype}
+
+
+def _col_from_buffers(bufs: Sequence[np.ndarray], meta: dict,
+                      keep: np.ndarray) -> Column:
+    """Rebuild a column from received (host) buffers compacted by ``keep``."""
+    if meta["kind"] == "string":
+        mat, lengths, valid = bufs
+        mat, lengths, valid = mat[keep], lengths[keep], valid[keep]
+        return from_padded_bytes(mat, lengths,
+                                 validity=None if valid.all() else valid)
+    data, valid = bufs
+    data, valid = data[keep], valid[keep]
+    col = Column(meta["dtype"], int(data.shape[0]), data=jnp.asarray(data))
+    if not valid.all():
+        col = col.with_validity(jnp.asarray(valid))
+    return col
+
+
+def partition_ids(table: Table, key_indices: Sequence[int],
+                  num_partitions: int) -> jnp.ndarray:
+    """Destination partition per row: murmur3(keys) mod n (device op)."""
+    h = murmur_hash3_32(Table(tuple(table.columns[i] for i in key_indices)))
+    return (h.data.astype(jnp.uint32) % np.uint32(num_partitions)) \
+        .astype(jnp.int32)
+
+
+def hash_partition_exchange(
+        table: Table, key_indices: Sequence[int], mesh: Mesh,
+        dest: Optional[jnp.ndarray] = None) -> List[Table]:
+    """Shuffle ``table`` across ``mesh`` so equal keys land on one device.
+
+    Returns the per-device partitions as local Tables (schema preserved).
+    ``dest`` overrides the murmur route (e.g. range partitioning for sort).
+    """
+    nd = mesh.devices.size
+    n = table.num_rows
+    if dest is None:
+        dest = partition_ids(table, key_indices, nd)
+
+    # pad rows to a multiple of nd so the row axis shards evenly; padded
+    # rows carry live=False and are dropped on receive
+    per_dev = -(-max(n, 1) // nd)
+    n_pad = per_dev * nd
+    live = jnp.arange(n_pad) < n
+
+    def _pad(a: jnp.ndarray) -> jnp.ndarray:
+        if a.shape[0] == n_pad:
+            return a
+        pad = [(0, n_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, pad)
+
+    buffers: List[jnp.ndarray] = [_pad(dest), live]
+    metas = []
+    spans: List[Tuple[int, int]] = []
+    for col in table.columns:
+        bufs, meta = _col_to_buffers(col)
+        spans.append((len(buffers), len(buffers) + len(bufs)))
+        buffers.extend(_pad(b) for b in bufs)
+        metas.append(meta)
+
+    axis = _mesh_axis(mesh)
+    sharding = NamedSharding(mesh, P(axis))
+    buffers = [jax.device_put(b, sharding) for b in buffers]
+
+    sig = (mesh, per_dev,
+           tuple((b.shape[1:], str(b.dtype)) for b in buffers))
+    program = _EXCHANGE_CACHE.get(sig)
+    if program is None:
+        def local(dest_l, live_l, *bufs_l):
+            # stable sort by destination → slot grid [nd, per_dev]
+            order = jnp.argsort(dest_l)
+            d_s = jnp.take(dest_l, order)
+            counts = jnp.bincount(dest_l, length=nd)
+            starts = jnp.cumsum(counts) - counts
+            rank = (jnp.arange(per_dev)
+                    - jnp.take(starts, d_s)).astype(jnp.int32)
+            occ = jnp.zeros((nd, per_dev), dtype=bool)
+            occ = occ.at[d_s, rank].set(jnp.take(live_l, order))
+            received = [lax.all_to_all(occ, axis, 0, 0).reshape(nd * per_dev)]
+            for b in bufs_l:
+                slot = jnp.zeros((nd, per_dev) + b.shape[1:], dtype=b.dtype)
+                slot = slot.at[d_s, rank].set(jnp.take(b, order, axis=0))
+                received.append(
+                    lax.all_to_all(slot, axis, 0, 0)
+                    .reshape((nd * per_dev,) + b.shape[1:]))
+            return tuple(received)
+
+        program = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=tuple(P(axis) for _ in buffers),
+            out_specs=tuple(P(axis) for _ in range(len(buffers) - 1)),
+        ))
+        _EXCHANGE_CACHE[sig] = program
+
+    shuffled = program(*buffers)
+
+    # host compaction: split the [nd * nd * per_dev] landing zones into the
+    # nd local partitions and drop unoccupied slots (data-dependent sizes)
+    host = [np.asarray(b) for b in shuffled]
+    occ_all = host[0]
+    zone = nd * per_dev  # rows landing on one device
+    parts: List[Table] = []
+    for p in range(nd):
+        keep = occ_all[p * zone:(p + 1) * zone]
+        cols = []
+        for (lo, hi), meta in zip(spans, metas):
+            bufs = [h[p * zone:(p + 1) * zone] for h in host[lo - 1:hi - 1]]
+            cols.append(_col_from_buffers(bufs, meta, keep))
+        parts.append(Table(tuple(cols)))
+    return parts
