@@ -181,13 +181,63 @@ def bench_sort(rows: int):
     return sec, rows * 8
 
 
+def bench_parquet_decode(rows: int):
+    """BASELINE configs[3]-shaped: chunked decode of a lineitem-like file
+    (ints, FLBA decimals, date32, low-card + comment strings, snappy)."""
+    import datetime
+    import decimal
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.parquet import ParquetReader
+
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "l_orderkey": pa.array(rng.integers(1, 6_000_000, rows)),
+        "l_partkey": pa.array(rng.integers(1, 200_000, rows)),
+        "l_quantity": pa.array(
+            [decimal.Decimal(int(v)) / 100 for v in
+             rng.integers(100, 5100, rows)], type=pa.decimal128(12, 2)),
+        "l_extendedprice": pa.array(
+            [decimal.Decimal(int(v)) / 100 for v in
+             rng.integers(90000, 10500000, rows)], type=pa.decimal128(12, 2)),
+        "l_shipdate": pa.array(
+            [datetime.date(1992, 1, 1) + datetime.timedelta(days=int(d))
+             for d in rng.integers(0, 2500, rows)]),
+        "l_returnflag": pa.array(
+            np.array(["A", "N", "R"])[rng.integers(0, 3, rows)]),
+        "l_comment": pa.array(
+            [f"comment {i % 4096} " + "filler " * (i % 5)
+             for i in range(rows)]),
+    })
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "lineitem.parquet")
+        pq.write_table(t, path, compression="snappy",
+                       row_group_size=max(rows // 8, 1024))
+        nbytes = os.path.getsize(path)
+
+        def run():
+            import jax
+            with ParquetReader(path) as r:
+                out = None
+                for chunk in r.iter_chunks(byte_budget=64 << 20):
+                    out = chunk
+                jax.block_until_ready([c.data for c in out
+                                       if c.data is not None])
+
+        sec = _time(run, warmup=1, iters=3)
+    return sec, nbytes
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--bench", default="all",
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
-                             "join", "sort"])
+                             "join", "sort", "parquet_decode"])
     args = ap.parse_args()
     _ensure_backend()
 
@@ -218,6 +268,10 @@ def main():
     if args.bench in ("all", "sort"):
         runs.append(("sort", "int64", args.rows,
                      lambda: bench_sort(args.rows)))
+    if args.bench in ("all", "parquet_decode"):
+        prows = min(args.rows, 1_000_000)
+        runs.append(("parquet_decode", "lineitem-shaped snappy", prows,
+                     lambda: bench_parquet_decode(prows)))
 
     for name, config, rows, fn in runs:
         sec, nbytes = fn()

@@ -1,0 +1,766 @@
+// Chunked Parquet page decode — host-side column-chunk → dense column buffers.
+//
+// Reference capability: the pruned footer produced by the footer path
+// (NativeParquetJni.cpp:689, ParquetFooter.java:204-221) is handed to the
+// chunked Parquet reader, which decodes page data into device columns
+// (BASELINE config[3]: lineitem SF100 → HBM). This rebuild decodes on host
+// (TPUs have no device-side byte-wrangling path worth taking for varint/RLE
+// page formats) into Column-shaped buffers — dense values + bool validity +
+// int32 offsets — which the Python side ships to HBM with one transfer per
+// buffer. Bounded host memory: the caller feeds one column chunk's byte
+// range at a time (pqd_chunk_range → pread → pqd_decode_chunk).
+//
+// Format coverage:
+//   * page headers: thrift-compact PageHeader (v1 + v2 data pages, dict pages)
+//   * codecs: UNCOMPRESSED, SNAPPY (independent re-implementation of the
+//     published snappy format spec)
+//   * encodings: PLAIN, PLAIN_DICTIONARY / RLE_DICTIONARY, RLE (bool),
+//     bit-packed/RLE hybrid definition levels
+//   * physical types: BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY,
+//     FIXED_LEN_BYTE_ARRAY (decimals → 16-byte little-endian limb values)
+//   * flat columns (max_rep == 0); nested decode is rejected with a clear
+//     error (the Python reader gates on schema)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "thrift_compact.hpp"
+
+namespace {
+
+using namespace tcompact;
+
+// ---- parquet.thrift field ids ----------------------------------------------
+// FileMetaData
+constexpr int16_t FMD_SCHEMA = 2, FMD_NUM_ROWS = 3, FMD_ROW_GROUPS = 4;
+// SchemaElement
+constexpr int16_t SE_TYPE = 1, SE_TYPE_LENGTH = 2, SE_REP = 3, SE_NAME = 4,
+                  SE_NUM_CHILDREN = 5, SE_CONVERTED = 6, SE_SCALE = 7,
+                  SE_PRECISION = 8;
+// RowGroup
+constexpr int16_t RG_COLUMNS = 1, RG_NUM_ROWS = 3;
+// ColumnChunk / ColumnMetaData
+constexpr int16_t CC_META = 3;
+constexpr int16_t CMD_TYPE = 1, CMD_CODEC = 4, CMD_NUM_VALUES = 5,
+                  CMD_TOTAL_COMPRESSED = 7, CMD_DATA_PAGE = 9,
+                  CMD_DICT_PAGE = 11;
+// PageHeader
+constexpr int16_t PH_TYPE = 1, PH_UNCOMP_SIZE = 2, PH_COMP_SIZE = 3,
+                  PH_DATA_V1 = 5, PH_DICT = 7, PH_DATA_V2 = 8;
+// DataPageHeader (v1)
+constexpr int16_t DPH_NUM_VALUES = 1, DPH_ENCODING = 2;
+// DictionaryPageHeader
+constexpr int16_t DICT_NUM_VALUES = 1;
+// DataPageHeaderV2
+constexpr int16_t DP2_NUM_VALUES = 1, DP2_NUM_NULLS = 2, DP2_ENCODING = 4,
+                  DP2_DEF_BYTES = 5, DP2_REP_BYTES = 6, DP2_IS_COMPRESSED = 7;
+
+// enums
+enum page_type { PAGE_DATA = 0, PAGE_INDEX = 1, PAGE_DICT = 2, PAGE_DATA_V2 = 3 };
+enum phys_type {
+  PT_BOOLEAN = 0, PT_INT32 = 1, PT_INT64 = 2, PT_INT96 = 3, PT_FLOAT = 4,
+  PT_DOUBLE = 5, PT_BYTE_ARRAY = 6, PT_FLBA = 7,
+};
+enum encoding {
+  ENC_PLAIN = 0, ENC_PLAIN_DICT = 2, ENC_RLE = 3, ENC_RLE_DICT = 8,
+};
+enum codec { CODEC_NONE = 0, CODEC_SNAPPY = 1 };
+constexpr int REP_OPTIONAL = 1, REP_REPEATED = 2;
+
+static int64_t i_of(const tvalue& s, int16_t id, int64_t dflt = 0) {
+  auto* f = get(s, id);
+  return f ? f->i : dflt;
+}
+
+// ---- snappy decompression ---------------------------------------------------
+// Independent implementation of the snappy raw format: LE-varint uncompressed
+// length, then a tag stream of literals and back-references (format spec:
+// github.com/google/snappy/format_description.txt).
+static void snappy_decompress(const uint8_t* in, size_t in_len,
+                              std::vector<uint8_t>& out, size_t expect) {
+  size_t pos = 0;
+  uint64_t out_len = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= in_len) throw std::runtime_error("snappy: truncated header");
+    uint8_t b = in[pos++];
+    out_len |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 35) throw std::runtime_error("snappy: bad length varint");
+  }
+  if (out_len != expect)
+    throw std::runtime_error("snappy: length mismatch vs page header");
+  out.clear();
+  out.reserve(out_len);
+  while (pos < in_len) {
+    uint8_t tag = in[pos++];
+    switch (tag & 3) {
+      case 0: {  // literal
+        uint64_t n = tag >> 2;
+        if (n >= 60) {
+          int extra = (int)(n - 59);
+          if (pos + extra > in_len)
+            throw std::runtime_error("snappy: truncated literal length");
+          n = 0;
+          for (int i = 0; i < extra; i++) n |= (uint64_t)in[pos++] << (8 * i);
+        }
+        n += 1;
+        if (n > in_len - pos) throw std::runtime_error("snappy: truncated literal");
+        out.insert(out.end(), in + pos, in + pos + n);
+        pos += n;
+        break;
+      }
+      case 1: {  // copy, 1-byte offset
+        if (pos >= in_len) throw std::runtime_error("snappy: truncated copy1");
+        size_t n = 4 + ((tag >> 2) & 0x7);
+        size_t off = ((size_t)(tag >> 5) << 8) | in[pos++];
+        if (off == 0 || off > out.size())
+          throw std::runtime_error("snappy: bad offset");
+        for (size_t i = 0; i < n; i++) out.push_back(out[out.size() - off]);
+        break;
+      }
+      case 2: {  // copy, 2-byte offset
+        if (pos + 2 > in_len) throw std::runtime_error("snappy: truncated copy2");
+        size_t n = 1 + (tag >> 2);
+        size_t off = (size_t)in[pos] | ((size_t)in[pos + 1] << 8);
+        pos += 2;
+        if (off == 0 || off > out.size())
+          throw std::runtime_error("snappy: bad offset");
+        for (size_t i = 0; i < n; i++) out.push_back(out[out.size() - off]);
+        break;
+      }
+      case 3: {  // copy, 4-byte offset
+        if (pos + 4 > in_len) throw std::runtime_error("snappy: truncated copy4");
+        size_t n = 1 + (tag >> 2);
+        size_t off = 0;
+        for (int i = 0; i < 4; i++) off |= (size_t)in[pos++] << (8 * i);
+        if (off == 0 || off > out.size())
+          throw std::runtime_error("snappy: bad offset");
+        for (size_t i = 0; i < n; i++) out.push_back(out[out.size() - off]);
+        break;
+      }
+    }
+    if (out.size() > out_len) throw std::runtime_error("snappy: output overrun");
+  }
+  if (out.size() != out_len) throw std::runtime_error("snappy: short output");
+}
+
+// ---- RLE / bit-packed hybrid ------------------------------------------------
+struct hybrid_reader {
+  const uint8_t* p;
+  size_t len;
+  size_t pos = 0;
+  int bit_width;
+
+  hybrid_reader(const uint8_t* p_, size_t len_, int bw) : p(p_), len(len_),
+                                                          bit_width(bw) {}
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      if (pos >= len) throw std::runtime_error("rle: truncated varint");
+      uint8_t b = p[pos++];
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) throw std::runtime_error("rle: varint overflow");
+    }
+    return v;
+  }
+
+  // Decode exactly n values into out (int32; levels and dict indices both fit)
+  void decode(int64_t n, std::vector<int32_t>& out) {
+    out.reserve(out.size() + n);
+    int64_t remaining = n;
+    while (remaining > 0) {
+      uint64_t header = varint();
+      if ((header & 1) == 0) {
+        // RLE run: count then one fixed-width little-endian value
+        int64_t count = (int64_t)(header >> 1);
+        if (count <= 0) throw std::runtime_error("rle: zero-length run");
+        int nbytes = (bit_width + 7) / 8;
+        if (pos + nbytes > len) throw std::runtime_error("rle: truncated run");
+        int32_t v = 0;
+        for (int i = 0; i < nbytes; i++) v |= (int32_t)p[pos++] << (8 * i);
+        count = std::min<int64_t>(count, remaining);
+        out.insert(out.end(), (size_t)count, v);
+        remaining -= count;
+      } else {
+        // bit-packed: header>>1 groups of 8 values, LSB-first within bytes
+        int64_t groups = (int64_t)(header >> 1);
+        if (groups <= 0) throw std::runtime_error("rle: zero groups");
+        int64_t count = groups * 8;
+        size_t nbytes = (size_t)(groups * bit_width);
+        if (nbytes > len - pos)
+          throw std::runtime_error("rle: truncated bit-pack");
+        uint32_t mask = bit_width >= 32 ? 0xFFFFFFFFu
+                                        : ((1u << bit_width) - 1);
+        int64_t take = std::min(count, remaining);
+        size_t run_start = pos;
+        for (int64_t i = 0; i < take; i++) {
+          size_t bit = (size_t)i * bit_width;
+          size_t byte = bit / 8;
+          int shift = (int)(bit % 8);
+          int need = (shift + bit_width + 7) / 8;  // <= 5 bytes for bw <= 32
+          uint64_t v = 0;
+          for (int k = 0; k < need; k++)
+            v |= (uint64_t)p[run_start + byte + k] << (8 * k);
+          out.push_back((int32_t)((v >> shift) & mask));
+        }
+        pos = run_start + nbytes;  // runs are padded to whole groups
+        remaining -= take;
+      }
+    }
+  }
+};
+
+static int bits_needed(int max_level) {
+  int r = 0;
+  while ((1 << r) - 1 < max_level) r++;
+  return r;
+}
+
+// ---- leaf schema info -------------------------------------------------------
+struct leaf_info {
+  std::string path;       // dotted
+  int physical = 0;
+  int type_length = 0;
+  int converted = -1;     // -1 = absent
+  int scale = 0, precision = 0;
+  int max_def = 0, max_rep = 0;
+};
+
+struct decode_handle {
+  tvalue meta;
+  std::vector<leaf_info> leaves;
+};
+
+static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
+                        int nchildren, const std::string& prefix, int def,
+                        int rep, std::vector<leaf_info>& out) {
+  for (int c = 0; c < nchildren; c++) {
+    if (idx >= schema.size()) throw std::runtime_error("schema: truncated tree");
+    const tvalue& se = *schema[idx++];
+    auto* name_f = get(se, SE_NAME);
+    std::string name = name_f ? name_f->bin : "";
+    std::string path = prefix.empty() ? name : prefix + "." + name;
+    int r = (int)i_of(se, SE_REP, 0);
+    int d2 = def + (r == REP_OPTIONAL || r == REP_REPEATED ? 1 : 0);
+    int r2 = rep + (r == REP_REPEATED ? 1 : 0);
+    int nc = (int)i_of(se, SE_NUM_CHILDREN, 0);
+    if (nc == 0) {
+      leaf_info li;
+      li.path = path;
+      li.physical = (int)i_of(se, SE_TYPE, -1);
+      li.type_length = (int)i_of(se, SE_TYPE_LENGTH, 0);
+      auto* conv = get(se, SE_CONVERTED);
+      li.converted = conv ? (int)conv->i : -1;
+      li.scale = (int)i_of(se, SE_SCALE, 0);
+      li.precision = (int)i_of(se, SE_PRECISION, 0);
+      li.max_def = d2;
+      li.max_rep = r2;
+      out.push_back(std::move(li));
+    } else {
+      walk_schema(schema, idx, nc, path, d2, r2, out);
+    }
+  }
+}
+
+// ---- chunk decode -----------------------------------------------------------
+struct dict_store {
+  // fixed-width: elem_size-strided bytes; byte_array: offsets + blob
+  std::vector<uint8_t> fixed;
+  std::vector<int32_t> offsets{0};
+  std::vector<uint8_t> blob;
+  int64_t count = 0;
+};
+
+struct column_out {
+  std::vector<uint8_t> values;
+  std::vector<int32_t> offsets{0};
+  std::vector<uint8_t> validity;
+  int64_t rows = 0;
+  int64_t nulls = 0;
+};
+
+static size_t plain_elem_size(int physical, int type_length) {
+  switch (physical) {
+    case PT_INT32: case PT_FLOAT: return 4;
+    case PT_INT64: case PT_DOUBLE: return 8;
+    case PT_INT96: return 12;
+    case PT_FLBA: return (size_t)type_length;
+    default: return 0;
+  }
+}
+
+// FLBA decimal: big-endian two's complement (type_length bytes) → 16-byte
+// little-endian limb value (matches the DECIMAL128 column layout).
+static void flba_decimal_to_le128(const uint8_t* src, int n, uint8_t out[16]) {
+  uint8_t fill = (src[0] & 0x80) ? 0xFF : 0x00;
+  memset(out, fill, 16);
+  for (int i = 0; i < n && i < 16; i++) out[i] = src[n - 1 - i];
+}
+
+struct chunk_decoder {
+  const leaf_info& leaf;
+  int codec;
+  int64_t num_values;       // total values incl. nulls, from ColumnMetaData
+  dict_store dict;
+  bool dict_is_set = false;
+  column_out out;
+  bool emit_decimal128;     // FLBA/decimal → 16-byte values
+
+  chunk_decoder(const leaf_info& l, int codec_, int64_t nv)
+      : leaf(l), codec(codec_), num_values(nv) {
+    emit_decimal128 = leaf.physical == PT_FLBA;
+    out.validity.reserve(nv);
+  }
+
+  // decompress page payload according to codec
+  void decompress(const uint8_t* src, size_t comp, size_t uncomp,
+                  std::vector<uint8_t>& buf, const uint8_t*& data,
+                  size_t& data_len) {
+    // note: no comp==uncomp shortcut — parquet has no "stored" fallback, a
+    // snappy page can coincidentally compress to exactly its input size
+    if (codec == CODEC_NONE) {
+      data = src;
+      data_len = comp;
+      return;
+    }
+    if (codec != CODEC_SNAPPY)
+      throw std::runtime_error("unsupported codec " + std::to_string(codec));
+    snappy_decompress(src, comp, buf, uncomp);
+    data = buf.data();
+    data_len = buf.size();
+  }
+
+  void load_dictionary(const uint8_t* data, size_t len, int64_t count) {
+    dict_is_set = true;
+    dict.count = count;
+    if (leaf.physical == PT_BYTE_ARRAY) {
+      size_t pos = 0;
+      dict.offsets.assign(1, 0);
+      for (int64_t i = 0; i < count; i++) {
+        if (pos + 4 > len) throw std::runtime_error("dict: truncated length");
+        uint32_t n;
+        memcpy(&n, data + pos, 4);
+        pos += 4;
+        if (n > len - pos) throw std::runtime_error("dict: truncated bytes");
+        dict.blob.insert(dict.blob.end(), data + pos, data + pos + n);
+        pos += n;
+        dict.offsets.push_back((int32_t)dict.blob.size());
+      }
+    } else {
+      size_t es = plain_elem_size(leaf.physical, leaf.type_length);
+      if (es == 0) throw std::runtime_error("dict: bad physical type");
+      if ((size_t)count * es > len) throw std::runtime_error("dict: truncated");
+      dict.fixed.assign(data, data + (size_t)count * es);
+    }
+  }
+
+  // Decode def levels (v1 layout: u32 length + hybrid). Returns defs.
+  void read_def_levels_v1(const uint8_t*& data, size_t& len, int64_t n,
+                          std::vector<int32_t>& defs) {
+    if (leaf.max_def == 0) {
+      defs.assign((size_t)n, 0);
+      return;
+    }
+    if (len < 4) throw std::runtime_error("page: truncated def-level length");
+    uint32_t nbytes;
+    memcpy(&nbytes, data, 4);
+    data += 4;
+    len -= 4;
+    if (nbytes > len) throw std::runtime_error("page: truncated def levels");
+    hybrid_reader hr(data, nbytes, bits_needed(leaf.max_def));
+    hr.decode(n, defs);
+    data += nbytes;
+    len -= nbytes;
+  }
+
+  // Append n decoded values (with defs) from `data` using `enc`.
+  void decode_values(const uint8_t* data, size_t len, int enc,
+                     const std::vector<int32_t>& defs) {
+    int64_t n = (int64_t)defs.size();
+    int64_t n_valid = 0;
+    for (int32_t d : defs) n_valid += (d == leaf.max_def);
+    bool has_nulls = n_valid != n;
+
+    // validity (always tracked; Python drops it if chunk ends null-free)
+    for (int32_t d : defs) out.validity.push_back(d == leaf.max_def ? 1 : 0);
+    out.nulls += n - n_valid;
+    out.rows += n;
+
+    if (enc == ENC_PLAIN_DICT || enc == ENC_RLE_DICT) {
+      if (!dict_is_set) throw std::runtime_error("dict-encoded page, no dict");
+      if (len < 1) throw std::runtime_error("page: missing dict bit width");
+      int bw = data[0];
+      hybrid_reader hr(data + 1, len - 1, bw);
+      std::vector<int32_t> idx;
+      hr.decode(n_valid, idx);
+      for (int32_t id : idx)
+        if (id < 0 || id >= dict.count)
+          throw std::runtime_error("dict index out of range");
+      gather_from_dict(idx, defs, has_nulls);
+      return;
+    }
+    if (enc == ENC_PLAIN) {
+      append_plain(data, len, defs, n_valid);
+      return;
+    }
+    if (enc == ENC_RLE && leaf.physical == PT_BOOLEAN) {
+      // v2 boolean pages: u32 length + hybrid of 1-bit values
+      if (len < 4) throw std::runtime_error("page: truncated bool rle");
+      uint32_t nbytes;
+      memcpy(&nbytes, data, 4);
+      if (nbytes > len - 4) throw std::runtime_error("page: truncated bool rle");
+      hybrid_reader hr(data + 4, nbytes, 1);
+      std::vector<int32_t> vals;
+      hr.decode(n_valid, vals);
+      scatter_fixed_i32(vals, defs, 1);
+      return;
+    }
+    throw std::runtime_error("unsupported encoding " + std::to_string(enc));
+  }
+
+  void gather_from_dict(const std::vector<int32_t>& idx,
+                        const std::vector<int32_t>& defs, bool) {
+    if (leaf.physical == PT_BYTE_ARRAY) {
+      size_t vi = 0;
+      for (int32_t d : defs) {
+        if (d == leaf.max_def) {
+          int32_t id = idx[vi++];
+          int32_t b0 = dict.offsets[id], b1 = dict.offsets[id + 1];
+          out.values.insert(out.values.end(), dict.blob.data() + b0,
+                            dict.blob.data() + b1);
+        }
+        out.offsets.push_back((int32_t)out.values.size());
+      }
+    } else {
+      size_t es = plain_elem_size(leaf.physical, leaf.type_length);
+      size_t oes = emit_decimal128 ? 16 : es;
+      size_t vi = 0;
+      size_t base = out.values.size();
+      out.values.resize(base + defs.size() * oes, 0);
+      uint8_t* dst = out.values.data() + base;
+      for (size_t i = 0; i < defs.size(); i++) {
+        if (defs[i] == leaf.max_def) {
+          const uint8_t* src = dict.fixed.data() + (size_t)idx[vi++] * es;
+          if (emit_decimal128)
+            flba_decimal_to_le128(src, (int)es, dst + i * oes);
+          else
+            memcpy(dst + i * oes, src, es);
+        }
+      }
+    }
+  }
+
+  void append_plain(const uint8_t* data, size_t len,
+                    const std::vector<int32_t>& defs, int64_t n_valid) {
+    if (leaf.physical == PT_BYTE_ARRAY) {
+      size_t pos = 0;
+      for (int32_t d : defs) {
+        if (d == leaf.max_def) {
+          if (pos + 4 > len) throw std::runtime_error("plain: truncated length");
+          uint32_t nb;
+          memcpy(&nb, data + pos, 4);
+          pos += 4;
+          if (nb > len - pos) throw std::runtime_error("plain: truncated bytes");
+          out.values.insert(out.values.end(), data + pos, data + pos + nb);
+          pos += nb;
+        }
+        out.offsets.push_back((int32_t)out.values.size());
+      }
+      return;
+    }
+    if (leaf.physical == PT_BOOLEAN) {
+      // bit-packed LSB-first, one bit per non-null value
+      std::vector<int32_t> vals;
+      vals.reserve(n_valid);
+      for (int64_t i = 0; i < n_valid; i++) {
+        size_t byte = (size_t)(i / 8);
+        if (byte >= len) throw std::runtime_error("plain: truncated bools");
+        vals.push_back((data[byte] >> (i % 8)) & 1);
+      }
+      scatter_fixed_i32(vals, defs, 1);
+      return;
+    }
+    size_t es = plain_elem_size(leaf.physical, leaf.type_length);
+    if (es == 0) throw std::runtime_error("plain: bad physical type");
+    if ((size_t)n_valid * es > len) throw std::runtime_error("plain: truncated");
+    size_t oes = emit_decimal128 ? 16 : es;
+    size_t base = out.values.size();
+    out.values.resize(base + defs.size() * oes, 0);
+    uint8_t* dst = out.values.data() + base;
+    size_t vi = 0;
+    for (size_t i = 0; i < defs.size(); i++) {
+      if (defs[i] == leaf.max_def) {
+        const uint8_t* src = data + (vi++) * es;
+        if (emit_decimal128)
+          flba_decimal_to_le128(src, (int)es, dst + i * oes);
+        else
+          memcpy(dst + i * oes, src, es);
+      }
+    }
+  }
+
+  // scatter int32 values (bools) into uint8 output with nulls zero-filled
+  void scatter_fixed_i32(const std::vector<int32_t>& vals,
+                         const std::vector<int32_t>& defs, size_t) {
+    size_t base = out.values.size();
+    out.values.resize(base + defs.size(), 0);
+    size_t vi = 0;
+    for (size_t i = 0; i < defs.size(); i++)
+      if (defs[i] == leaf.max_def)
+        out.values[base + i] = (uint8_t)vals[vi++];
+  }
+
+  // ---- page walk ----------------------------------------------------------
+  void decode_chunk(const uint8_t* buf, size_t len) {
+    if (leaf.max_rep != 0)
+      throw std::runtime_error("nested (repeated) columns not supported");
+    size_t pos = 0;
+    int64_t seen = 0;
+    while (seen < num_values) {
+      if (pos >= len) throw std::runtime_error("chunk: ran out of pages");
+      reader rd{buf + pos, len - pos};
+      tvalue ph = rd.read_value(T_STRUCT);
+      pos += rd.pos;
+      int ptype = (int)i_of(ph, PH_TYPE, -1);
+      int64_t comp = i_of(ph, PH_COMP_SIZE, 0);
+      int64_t uncomp = i_of(ph, PH_UNCOMP_SIZE, 0);
+      if (comp < 0 || (size_t)comp > len - pos)
+        throw std::runtime_error("page: truncated payload");
+      const uint8_t* payload = buf + pos;
+      pos += (size_t)comp;
+
+      if (ptype == PAGE_DICT) {
+        auto* dh = get(ph, PH_DICT);
+        if (!dh) throw std::runtime_error("dict page without header");
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        decompress(payload, (size_t)comp, (size_t)uncomp, dbuf, data, dlen);
+        load_dictionary(data, dlen, i_of(*dh, DICT_NUM_VALUES, 0));
+        continue;
+      }
+      if (ptype == PAGE_DATA) {
+        auto* dh = get(ph, PH_DATA_V1);
+        if (!dh) throw std::runtime_error("data page without header");
+        int64_t n = i_of(*dh, DPH_NUM_VALUES, 0);
+        int enc = (int)i_of(*dh, DPH_ENCODING, ENC_PLAIN);
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        decompress(payload, (size_t)comp, (size_t)uncomp, dbuf, data, dlen);
+        std::vector<int32_t> defs;
+        const uint8_t* dp = data;
+        size_t dl = dlen;
+        read_def_levels_v1(dp, dl, n, defs);
+        if (leaf.max_def == 0) defs.assign((size_t)n, 0);
+        decode_values(dp, dl, enc, defs);
+        seen += n;
+        continue;
+      }
+      if (ptype == PAGE_DATA_V2) {
+        auto* dh = get(ph, PH_DATA_V2);
+        if (!dh) throw std::runtime_error("v2 page without header");
+        int64_t n = i_of(*dh, DP2_NUM_VALUES, 0);
+        int enc = (int)i_of(*dh, DP2_ENCODING, ENC_PLAIN);
+        int64_t def_bytes = i_of(*dh, DP2_DEF_BYTES, 0);
+        int64_t rep_bytes = i_of(*dh, DP2_REP_BYTES, 0);
+        auto* icf = get(*dh, DP2_IS_COMPRESSED);
+        bool is_comp = icf ? icf->b : true;
+        if (rep_bytes != 0)
+          throw std::runtime_error("nested v2 pages not supported");
+        if (def_bytes > comp) throw std::runtime_error("v2: bad level bytes");
+        // levels are stored uncompressed ahead of the (possibly compressed)
+        // values section
+        std::vector<int32_t> defs;
+        if (leaf.max_def > 0 && def_bytes > 0) {
+          hybrid_reader hr(payload, (size_t)def_bytes, bits_needed(leaf.max_def));
+          hr.decode(n, defs);
+        } else {
+          defs.assign((size_t)n, 0);
+        }
+        const uint8_t* vsrc = payload + def_bytes;
+        size_t vcomp = (size_t)(comp - def_bytes);
+        size_t vuncomp = (size_t)(uncomp - def_bytes);
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        if (is_comp) {
+          decompress(vsrc, vcomp, vuncomp, dbuf, data, dlen);
+        } else {
+          data = vsrc;
+          dlen = vcomp;
+        }
+        decode_values(data, dlen, enc, defs);
+        seen += n;
+        continue;
+      }
+      // index or unknown pages: skip payload (already advanced)
+    }
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+typedef struct {
+  char* path;          // dotted column path (malloc'd)
+  int physical;        // parquet physical type enum
+  int type_length;     // FLBA width
+  int converted;       // ConvertedType or -1
+  int scale, precision;
+  int max_def, max_rep;
+} pqd_leaf_t;
+
+typedef struct {
+  uint8_t* values;
+  long long values_bytes;
+  int32_t* offsets;     // [rows+1] for BYTE_ARRAY, else NULL
+  uint8_t* validity;    // bool[rows] or NULL when null_count == 0
+  long long rows;
+  long long null_count;
+} pqd_out_t;
+
+// Parse raw thrift FileMetaData (no PAR1 framing). Caller buffer may be freed
+// after this returns.
+void* pqd_open(const uint8_t* footer, long long len, char** err_out) {
+  try {
+    reader rd{footer, (size_t)len};
+    auto h = std::make_unique<decode_handle>();
+    h->meta = rd.read_value(T_STRUCT);
+    auto* schema_f = get(h->meta, FMD_SCHEMA);
+    if (!schema_f || schema_f->list.empty())
+      throw std::runtime_error("footer has no schema");
+    std::vector<const tvalue*> schema;
+    for (auto& se : schema_f->list) schema.push_back(&se);
+    size_t idx = 1;  // skip root
+    int root_children = (int)i_of(*schema[0], SE_NUM_CHILDREN, 0);
+    walk_schema(schema, idx, root_children, "", 0, 0, h->leaves);
+    return h.release();
+  } catch (std::exception& e) {
+    if (err_out) *err_out = strdup(e.what());
+    return nullptr;
+  }
+}
+
+int pqd_num_row_groups(void* hp) {
+  auto* h = (decode_handle*)hp;
+  auto* rgs = get(h->meta, FMD_ROW_GROUPS);
+  return rgs ? (int)rgs->list.size() : 0;
+}
+
+long long pqd_rg_num_rows(void* hp, int rg) {
+  auto* h = (decode_handle*)hp;
+  auto* rgs = get(h->meta, FMD_ROW_GROUPS);
+  if (!rgs || rg < 0 || rg >= (int)rgs->list.size()) return -1;
+  return i_of(rgs->list[rg], RG_NUM_ROWS, 0);
+}
+
+int pqd_num_leaves(void* hp) {
+  return (int)((decode_handle*)hp)->leaves.size();
+}
+
+int pqd_leaf_info(void* hp, int leaf, pqd_leaf_t* out) {
+  auto* h = (decode_handle*)hp;
+  if (leaf < 0 || leaf >= (int)h->leaves.size()) return -1;
+  const leaf_info& li = h->leaves[leaf];
+  out->path = strdup(li.path.c_str());
+  out->physical = li.physical;
+  out->type_length = li.type_length;
+  out->converted = li.converted;
+  out->scale = li.scale;
+  out->precision = li.precision;
+  out->max_def = li.max_def;
+  out->max_rep = li.max_rep;
+  return 0;
+}
+
+// Byte range of (row group, leaf)'s column chunk in the file, plus its
+// metadata num_values and codec.
+int pqd_chunk_range(void* hp, int rg, int leaf, long long* offset,
+                    long long* length, long long* num_values, int* codec_out) {
+  auto* h = (decode_handle*)hp;
+  auto* rgs = get(h->meta, FMD_ROW_GROUPS);
+  if (!rgs || rg < 0 || rg >= (int)rgs->list.size()) return -1;
+  auto* cols = get(rgs->list[rg], RG_COLUMNS);
+  if (!cols || leaf < 0 || leaf >= (int)cols->list.size()) return -2;
+  auto* md = get(cols->list[leaf], CC_META);
+  if (!md) return -3;
+  long long data_off = i_of(*md, CMD_DATA_PAGE, 0);
+  auto* dict_f = get(*md, CMD_DICT_PAGE);
+  long long start = data_off;
+  if (dict_f && dict_f->i > 0 && dict_f->i < start) start = dict_f->i;
+  *offset = start;
+  *length = i_of(*md, CMD_TOTAL_COMPRESSED, 0);
+  *num_values = i_of(*md, CMD_NUM_VALUES, 0);
+  *codec_out = (int)i_of(*md, CMD_CODEC, 0);
+  return 0;
+}
+
+// Decode one column chunk from its raw file bytes.
+int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
+                     long long len, pqd_out_t* out, char** err_out) {
+  auto* h = (decode_handle*)hp;
+  try {
+    if (leaf < 0 || leaf >= (int)h->leaves.size())
+      throw std::runtime_error("leaf index out of range");
+    long long off, chunk_len, nv;
+    int codec;
+    int rc = pqd_chunk_range(hp, rg, leaf, &off, &chunk_len, &nv, &codec);
+    if (rc != 0) throw std::runtime_error("bad row group / leaf");
+    if (len < chunk_len) throw std::runtime_error("short chunk buffer");
+    chunk_decoder dec(h->leaves[leaf], codec, nv);
+    dec.decode_chunk(bytes, (size_t)chunk_len);
+
+    out->rows = dec.out.rows;
+    out->null_count = dec.out.nulls;
+    out->values_bytes = (long long)dec.out.values.size();
+    out->values = (uint8_t*)malloc(dec.out.values.size() ? dec.out.values.size() : 1);
+    memcpy(out->values, dec.out.values.data(), dec.out.values.size());
+    if (h->leaves[leaf].physical == PT_BYTE_ARRAY) {
+      out->offsets = (int32_t*)malloc(dec.out.offsets.size() * 4);
+      memcpy(out->offsets, dec.out.offsets.data(), dec.out.offsets.size() * 4);
+    } else {
+      out->offsets = nullptr;
+    }
+    if (dec.out.nulls > 0) {
+      out->validity = (uint8_t*)malloc(dec.out.validity.size());
+      memcpy(out->validity, dec.out.validity.data(), dec.out.validity.size());
+    } else {
+      out->validity = nullptr;
+    }
+    return 0;
+  } catch (std::exception& e) {
+    if (err_out) *err_out = strdup(e.what());
+    return -1;
+  }
+}
+
+void pqd_free_out(pqd_out_t* out) {
+  free(out->values);
+  free(out->offsets);
+  free(out->validity);
+  out->values = nullptr;
+  out->offsets = nullptr;
+  out->validity = nullptr;
+}
+
+void pqd_free(void* p) { free(p); }
+void pqd_close(void* hp) { delete (decode_handle*)hp; }
+
+}  // extern "C"

@@ -1,0 +1,245 @@
+// Generic thrift-compact-protocol DOM shared by the Parquet footer tooling
+// (parquet_footer.cpp) and the page decoder (parquet_decode.cpp).
+//
+// Reference capability: the reference links Apache Thrift + thrift-generated
+// parquet types (NativeParquetJni.cpp:639-668). This rebuild instead parses
+// into a generic fieldid→value tree that round-trips unknown fields, so no
+// generated code or thrift runtime is needed.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tcompact {
+
+enum ttype : uint8_t {
+  T_STOP = 0, T_TRUE = 1, T_FALSE = 2, T_BYTE = 3, T_I16 = 4, T_I32 = 5,
+  T_I64 = 6, T_DOUBLE = 7, T_BINARY = 8, T_LIST = 9, T_SET = 10, T_MAP = 11,
+  T_STRUCT = 12,
+};
+
+struct tvalue {
+  uint8_t type = T_STOP;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0;
+  std::string bin;
+  uint8_t elem_type = T_STOP;              // for LIST/SET
+  std::vector<tvalue> list;                // LIST/SET elements
+  std::map<int16_t, tvalue> fields;        // STRUCT fields (ordered by id)
+  // MAP support (unused by parquet footers but kept for round-trip safety)
+  uint8_t key_type = T_STOP, val_type = T_STOP;
+  std::vector<std::pair<tvalue, tvalue>> kvs;
+};
+
+struct reader {
+  const uint8_t* p;
+  size_t len;
+  size_t pos = 0;
+
+  uint8_t u8() {
+    if (pos >= len) throw std::runtime_error("thrift: truncated");
+    return p[pos++];
+  }
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = u8();
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) throw std::runtime_error("thrift: varint overflow");
+    }
+    return v;
+  }
+  int64_t zigzag() {
+    uint64_t v = varint();
+    return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+  }
+
+  tvalue read_value(uint8_t t) {
+    tvalue v;
+    v.type = t;
+    switch (t) {
+      case T_TRUE: v.b = true; break;
+      case T_FALSE: v.b = false; break;
+      case T_BYTE: v.i = (int8_t)u8(); break;
+      case T_I16:
+      case T_I32:
+      case T_I64: v.i = zigzag(); break;
+      case T_DOUBLE: {
+        if (pos + 8 > len) throw std::runtime_error("thrift: truncated");
+        memcpy(&v.d, p + pos, 8);
+        pos += 8;
+        break;
+      }
+      case T_BINARY: {
+        uint64_t n = varint();
+        // overflow-proof form: n is attacker-controlled, pos + n can wrap
+        if (n > len - pos) throw std::runtime_error("thrift: truncated str");
+        v.bin.assign((const char*)p + pos, n);
+        pos += n;
+        break;
+      }
+      case T_LIST:
+      case T_SET: {
+        uint8_t head = u8();
+        uint8_t et = head & 0x0F;
+        uint64_t n = head >> 4;
+        if (n == 15) n = varint();
+        v.elem_type = et;
+        // each element consumes >=1 byte, so bound reserve by remaining input
+        v.list.reserve(std::min(n, (uint64_t)(len - pos)));
+        for (uint64_t i = 0; i < n; i++) {
+          if (et == T_TRUE || et == T_FALSE) {
+            tvalue e;
+            e.type = et;
+            e.b = u8() == 1;
+            v.list.push_back(std::move(e));
+          } else {
+            v.list.push_back(read_value(et));
+          }
+        }
+        break;
+      }
+      case T_MAP: {
+        uint64_t n = varint();
+        // every entry consumes >=1 byte (bools read a byte below), so a
+        // count beyond the remaining input is malformed — reject before
+        // looping on an attacker-controlled size
+        if (n > len - pos) throw std::runtime_error("thrift: map too large");
+        if (n > 0) {
+          uint8_t kv = u8();
+          v.key_type = kv >> 4;
+          v.val_type = kv & 0x0F;
+          auto read_entry = [&](uint8_t t2) {
+            // compact protocol encodes bool map elements as one byte
+            if (t2 == T_TRUE || t2 == T_FALSE) {
+              tvalue e;
+              e.type = t2;
+              e.b = u8() == 1;
+              return e;
+            }
+            return read_value(t2);
+          };
+          for (uint64_t i = 0; i < n; i++) {
+            tvalue k = read_entry(v.key_type);
+            tvalue vv = read_entry(v.val_type);
+            v.kvs.emplace_back(std::move(k), std::move(vv));
+          }
+        }
+        break;
+      }
+      case T_STRUCT: {
+        int16_t last_id = 0;
+        while (true) {
+          uint8_t head = u8();
+          if (head == T_STOP) break;
+          uint8_t ft = head & 0x0F;
+          int16_t delta = head >> 4;
+          int16_t fid = delta ? (int16_t)(last_id + delta)
+                              : (int16_t)zigzag();
+          last_id = fid;
+          v.fields.emplace(fid, read_value(ft));
+        }
+        break;
+      }
+      default:
+        throw std::runtime_error("thrift: unknown type " + std::to_string(t));
+    }
+    return v;
+  }
+};
+
+struct writer {
+  std::string out;
+
+  void u8(uint8_t b) { out.push_back((char)b); }
+  void varint(uint64_t v) {
+    while (v >= 0x80) {
+      u8((uint8_t)(v | 0x80));
+      v >>= 7;
+    }
+    u8((uint8_t)v);
+  }
+  void zigzag(int64_t v) { varint(((uint64_t)v << 1) ^ (uint64_t)(v >> 63)); }
+
+  void write_value(const tvalue& v) {
+    switch (v.type) {
+      case T_TRUE:
+      case T_FALSE: break;  // encoded in the field/elem header for structs
+      case T_BYTE: u8((uint8_t)v.i); break;
+      case T_I16:
+      case T_I32:
+      case T_I64: zigzag(v.i); break;
+      case T_DOUBLE: {
+        char tmp[8];
+        memcpy(tmp, &v.d, 8);
+        out.append(tmp, 8);
+        break;
+      }
+      case T_BINARY:
+        varint(v.bin.size());
+        out += v.bin;
+        break;
+      case T_LIST:
+      case T_SET: {
+        size_t n = v.list.size();
+        uint8_t et = v.elem_type ? v.elem_type : T_STRUCT;
+        if (n < 15) u8((uint8_t)((n << 4) | et));
+        else {
+          u8((uint8_t)(0xF0 | et));
+          varint(n);
+        }
+        for (auto& e : v.list) {
+          if (et == T_TRUE || et == T_FALSE) u8(e.b ? 1 : 2);
+          else write_value(e);
+        }
+        break;
+      }
+      case T_MAP: {
+        varint(v.kvs.size());
+        if (!v.kvs.empty()) {
+          u8((uint8_t)((v.key_type << 4) | v.val_type));
+          for (auto& [k, vv] : v.kvs) {
+            write_value(k);
+            write_value(vv);
+          }
+        }
+        break;
+      }
+      case T_STRUCT: {
+        int16_t last_id = 0;
+        for (auto& [fid, fv] : v.fields) {
+          uint8_t ft = fv.type;
+          if (ft == T_TRUE || ft == T_FALSE) ft = fv.b ? T_TRUE : T_FALSE;
+          int32_t delta = fid - last_id;
+          if (delta > 0 && delta <= 15) {
+            u8((uint8_t)((delta << 4) | ft));
+          } else {
+            u8(ft);
+            zigzag(fid);
+          }
+          last_id = fid;
+          write_value(fv);
+        }
+        u8(T_STOP);
+        break;
+      }
+      default: throw std::runtime_error("thrift: cannot write type");
+    }
+  }
+};
+
+inline const tvalue* get(const tvalue& s, int16_t id) {
+  auto it = s.fields.find(id);
+  return it == s.fields.end() ? nullptr : &it->second;
+}
+
+}  // namespace tcompact

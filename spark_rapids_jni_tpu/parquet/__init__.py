@@ -12,5 +12,7 @@ from .footer import (
     SchemaBuilder,
     read_and_filter,
 )
+from .reader import ParquetReader, read_parquet
 
-__all__ = ["FooterSchema", "ParquetFooter", "SchemaBuilder", "read_and_filter"]
+__all__ = ["FooterSchema", "ParquetFooter", "SchemaBuilder", "read_and_filter",
+           "ParquetReader", "read_parquet"]

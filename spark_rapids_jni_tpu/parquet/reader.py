@@ -1,0 +1,416 @@
+"""Chunked Parquet reader: page decode into device-resident Column batches.
+
+Reference capability: the pruned footer (ParquetFooter.java:204-221,
+NativeParquetJni.cpp:689) feeds cudf's chunked Parquet reader, which decodes
+page data into GPU columns (BASELINE config[3]: lineitem SF100 → HBM). This
+rebuild splits the work TPU-first:
+
+  * native/parquet_decode.cpp decodes pages on host (thrift page headers,
+    snappy, RLE/bit-packed levels, PLAIN + dictionary encodings) into dense
+    Column-shaped buffers — the byte-wrangling has no profitable TPU mapping;
+  * this module streams one chunk of row groups at a time (bounded host
+    memory), ships each buffer to HBM with a single transfer, and yields
+    `Table` batches whose columns are immediately usable by every `ops/`
+    kernel.
+
+Decode validation is against pyarrow in tests/test_parquet_decode.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.dtype import DType, TypeId
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG_ROOT = os.path.dirname(_HERE)
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+_SRC = os.path.join(_REPO_ROOT, "native", "parquet_decode.cpp")
+_HDR = os.path.join(_REPO_ROOT, "native", "thrift_compact.hpp")
+_SO = os.path.join(_PKG_ROOT, "_native", "libsparkpqd.so")
+
+_lock = threading.Lock()
+_lib = None
+
+# parquet physical types
+_PT_BOOLEAN, _PT_INT32, _PT_INT64, _PT_INT96 = 0, 1, 2, 3
+_PT_FLOAT, _PT_DOUBLE, _PT_BYTE_ARRAY, _PT_FLBA = 4, 5, 6, 7
+# parquet converted types (subset used for mapping)
+_CT_UTF8, _CT_DECIMAL, _CT_DATE = 0, 5, 6
+_CT_TIMESTAMP_MILLIS, _CT_TIMESTAMP_MICROS = 9, 10
+_CT_UINT_8, _CT_UINT_16, _CT_UINT_32, _CT_UINT_64 = 11, 12, 13, 14
+_CT_INT_8, _CT_INT_16, _CT_INT_32, _CT_INT_64 = 15, 16, 17, 18
+
+
+class _LeafC(ctypes.Structure):
+    _fields_ = [
+        ("path", ctypes.c_char_p),
+        ("physical", ctypes.c_int),
+        ("type_length", ctypes.c_int),
+        ("converted", ctypes.c_int),
+        ("scale", ctypes.c_int),
+        ("precision", ctypes.c_int),
+        ("max_def", ctypes.c_int),
+        ("max_rep", ctypes.c_int),
+    ]
+
+
+class _OutC(ctypes.Structure):
+    _fields_ = [
+        ("values", ctypes.POINTER(ctypes.c_uint8)),
+        ("values_bytes", ctypes.c_longlong),
+        ("offsets", ctypes.POINTER(ctypes.c_int32)),
+        ("validity", ctypes.POINTER(ctypes.c_uint8)),
+        ("rows", ctypes.c_longlong),
+        ("null_count", ctypes.c_longlong),
+    ]
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+                 or os.path.getmtime(_HDR) > os.path.getmtime(_SO))
+        if stale:
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            proc = subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+                 "-o", _SO, _SRC],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"failed to build {_SO}:\n{proc.stderr}")
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        lib.pqd_open.restype = c.c_void_p
+        lib.pqd_open.argtypes = [c.POINTER(c.c_uint8), c.c_longlong,
+                                 c.POINTER(c.c_char_p)]
+        lib.pqd_num_row_groups.restype = c.c_int
+        lib.pqd_num_row_groups.argtypes = [c.c_void_p]
+        lib.pqd_rg_num_rows.restype = c.c_longlong
+        lib.pqd_rg_num_rows.argtypes = [c.c_void_p, c.c_int]
+        lib.pqd_num_leaves.restype = c.c_int
+        lib.pqd_num_leaves.argtypes = [c.c_void_p]
+        lib.pqd_leaf_info.restype = c.c_int
+        lib.pqd_leaf_info.argtypes = [c.c_void_p, c.c_int, c.POINTER(_LeafC)]
+        lib.pqd_chunk_range.restype = c.c_int
+        lib.pqd_chunk_range.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_longlong),
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+            c.POINTER(c.c_int)]
+        lib.pqd_decode_chunk.restype = c.c_int
+        lib.pqd_decode_chunk.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_uint8), c.c_longlong,
+            c.POINTER(_OutC), c.POINTER(c.c_char_p)]
+        lib.pqd_free_out.restype = None
+        lib.pqd_free_out.argtypes = [c.POINTER(_OutC)]
+        lib.pqd_free.restype = None
+        lib.pqd_free.argtypes = [c.c_void_p]
+        lib.pqd_close.restype = None
+        lib.pqd_close.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+@dataclass
+class LeafSchema:
+    """One flat leaf column of the file schema."""
+
+    index: int
+    name: str          # dotted path
+    dtype: DType
+    physical: int
+    type_length: int
+    max_def: int
+    max_rep: int
+
+
+def _map_dtype(physical: int, converted: int, scale: int,
+               precision: int) -> DType:
+    """Parquet (physical, converted) → engine DType (Spark read semantics)."""
+    if physical == _PT_BOOLEAN:
+        return dt.BOOL8
+    if physical == _PT_INT32:
+        if converted == _CT_DECIMAL:
+            return DType(TypeId.DECIMAL32, scale)
+        if converted == _CT_DATE:
+            return dt.TIMESTAMP_DAYS
+        if converted == _CT_INT_8:
+            return dt.INT8
+        if converted == _CT_INT_16:
+            return dt.INT16
+        if converted == _CT_UINT_8:
+            return dt.UINT8
+        if converted == _CT_UINT_16:
+            return dt.UINT16
+        if converted == _CT_UINT_32:
+            return dt.UINT32
+        return dt.INT32
+    if physical == _PT_INT64:
+        if converted == _CT_DECIMAL:
+            return DType(TypeId.DECIMAL64, scale)
+        if converted == _CT_TIMESTAMP_MICROS:
+            return dt.TIMESTAMP_MICROSECONDS
+        if converted == _CT_TIMESTAMP_MILLIS:
+            return dt.TIMESTAMP_MILLISECONDS
+        if converted == _CT_UINT_64:
+            return dt.UINT64
+        return dt.INT64
+    if physical == _PT_FLOAT:
+        return dt.FLOAT32
+    if physical == _PT_DOUBLE:
+        return dt.FLOAT64
+    if physical == _PT_BYTE_ARRAY:
+        return dt.STRING
+    if physical == _PT_FLBA:
+        if converted == _CT_DECIMAL:
+            return DType(TypeId.DECIMAL128, scale)
+        raise ValueError("FIXED_LEN_BYTE_ARRAY without DECIMAL is unsupported")
+    raise ValueError(f"unsupported parquet physical type {physical}")
+
+
+def _read_footer_bytes(f) -> bytes:
+    """Strip PAR1 framing: [data]["PAR1"... footer u32len "PAR1"]."""
+    f.seek(0, os.SEEK_END)
+    size = f.tell()
+    if size < 12:
+        raise ValueError("not a parquet file (too small)")
+    f.seek(size - 8)
+    tail = f.read(8)
+    if tail[4:] != b"PAR1":
+        raise ValueError("not a parquet file (bad magic)")
+    flen = int.from_bytes(tail[:4], "little")
+    if flen > size - 12:
+        raise ValueError("corrupt parquet footer length")
+    f.seek(size - 8 - flen)
+    return f.read(flen)
+
+
+class ParquetReader:
+    """Chunked reader over one parquet file.
+
+    Streams row-group batches under a byte budget: per chunk it decodes each
+    selected column's chunk natively and ships the resulting buffers to the
+    device as a `Table`. Host memory stays bounded by the largest chunk.
+    """
+
+    def __init__(self, path: str, columns: Optional[Sequence[str]] = None):
+        self._path = path
+        self._lib = _load()
+        with open(path, "rb") as f:
+            footer = _read_footer_bytes(f)
+        buf = np.frombuffer(footer, dtype=np.uint8)
+        err = ctypes.c_char_p()
+        h = self._lib.pqd_open(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+            ctypes.byref(err))
+        if not h:
+            msg = err.value.decode() if err.value else "unknown error"
+            self._lib.pqd_free(err)
+            raise RuntimeError(f"parquet open failed: {msg}")
+        self._h = h
+        self._leaves = self._read_schema()
+        if columns is not None:
+            by_name = {l.name: l for l in self._leaves}
+            missing = [c for c in columns if c not in by_name]
+            if missing:
+                raise KeyError(f"columns not in file: {missing}")
+            self._selected = [by_name[c] for c in columns]
+        else:
+            self._selected = list(self._leaves)
+        for leaf in self._selected:
+            if leaf.max_rep != 0:
+                raise ValueError(
+                    f"column {leaf.name!r} is nested (repeated); "
+                    "nested decode is not supported")
+
+    def _read_schema(self) -> List[LeafSchema]:
+        out = []
+        n = self._lib.pqd_num_leaves(self._h)
+        for i in range(n):
+            info = _LeafC()
+            rc = self._lib.pqd_leaf_info(self._h, i, ctypes.byref(info))
+            if rc != 0:
+                raise RuntimeError(f"leaf_info({i}) failed")
+            name = info.path.decode()
+            dtype = _map_dtype(info.physical, info.converted, info.scale,
+                               info.precision)
+            out.append(LeafSchema(i, name, dtype, info.physical,
+                                  info.type_length, info.max_def,
+                                  info.max_rep))
+        return out
+
+    # ---- info -------------------------------------------------------------
+    @property
+    def schema(self) -> List[Tuple[str, DType]]:
+        return [(l.name, l.dtype) for l in self._selected]
+
+    @property
+    def num_row_groups(self) -> int:
+        return self._lib.pqd_num_row_groups(self._h)
+
+    def num_rows(self) -> int:
+        return sum(self._lib.pqd_rg_num_rows(self._h, g)
+                   for g in range(self.num_row_groups))
+
+    def _chunk_range(self, rg: int, leaf: int):
+        c = ctypes
+        off = c.c_longlong()
+        ln = c.c_longlong()
+        nv = c.c_longlong()
+        codec = c.c_int()
+        rc = self._lib.pqd_chunk_range(self._h, rg, leaf, c.byref(off),
+                                       c.byref(ln), c.byref(nv),
+                                       c.byref(codec))
+        if rc != 0:
+            raise RuntimeError(f"chunk_range({rg},{leaf}) failed ({rc})")
+        return off.value, ln.value, nv.value, codec.value
+
+    def _rg_bytes(self, rg: int) -> int:
+        return sum(self._chunk_range(rg, l.index)[1] for l in self._selected)
+
+    # ---- decode -----------------------------------------------------------
+    def _decode_leaf(self, f, rg: int, leaf: LeafSchema):
+        """Decode one (row group, leaf) into host numpy buffers."""
+        off, length, _, _ = self._chunk_range(rg, leaf.index)
+        f.seek(off)
+        raw = f.read(length)
+        buf = np.frombuffer(raw, dtype=np.uint8)
+        out = _OutC()
+        err = ctypes.c_char_p()
+        rc = self._lib.pqd_decode_chunk(
+            self._h, rg, leaf.index,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+            ctypes.byref(out), ctypes.byref(err))
+        if rc != 0:
+            msg = err.value.decode() if err.value else "unknown error"
+            self._lib.pqd_free(err)
+            raise RuntimeError(f"decode {leaf.name!r} rg={rg} failed: {msg}")
+        try:
+            rows = out.rows
+            values = np.ctypeslib.as_array(out.values,
+                                           shape=(out.values_bytes,)).copy()
+            offsets = None
+            if leaf.physical == _PT_BYTE_ARRAY:
+                offsets = np.ctypeslib.as_array(out.offsets,
+                                                shape=(rows + 1,)).copy()
+            validity = None
+            if out.null_count > 0:
+                validity = np.ctypeslib.as_array(out.validity,
+                                                 shape=(rows,)).copy()
+            return rows, values, offsets, validity
+        finally:
+            self._lib.pqd_free_out(ctypes.byref(out))
+
+    @staticmethod
+    def _to_column(leaf: LeafSchema, rows: int, values: np.ndarray,
+                   offsets: Optional[np.ndarray],
+                   validity: Optional[np.ndarray]) -> Column:
+        """Host buffers → device Column (one transfer per buffer)."""
+        dtype = leaf.dtype
+        vmask = None if validity is None else jnp.asarray(
+            validity.astype(bool))
+        if dtype.id is TypeId.STRING:
+            data = jnp.asarray(values) if values.size else jnp.zeros(
+                (0,), dtype=jnp.uint8)
+            return Column(dtype, rows, data=data, validity=vmask,
+                          offsets=jnp.asarray(offsets))
+        if dtype.id is TypeId.DECIMAL128:
+            limbs = values.view(np.uint32).reshape(rows, 4)
+            return Column(dtype, rows, data=jnp.asarray(limbs),
+                          validity=vmask)
+        if dtype.id is TypeId.FLOAT64:
+            # FLOAT64 columns store uint64 bit patterns (exact TPU transfer)
+            bits = values.view(np.uint64)
+            return Column(dtype, rows, data=jnp.asarray(bits),
+                          validity=vmask)
+        host = values.view(dtype.np_dtype)
+        return Column(dtype, rows, data=jnp.asarray(host), validity=vmask)
+
+    def iter_chunks(self, byte_budget: int = 128 << 20) -> Iterator[Table]:
+        """Yield one device Table per chunk of row groups.
+
+        A chunk is the longest run of consecutive row groups whose summed
+        compressed column-chunk bytes stay within ``byte_budget`` (always at
+        least one row group, mirroring the reference chunked reader's
+        at-least-one-row-group guarantee).
+        """
+        n_rg = self.num_row_groups
+        rg = 0
+        while rg < n_rg:
+            group = [rg]
+            used = self._rg_bytes(rg)
+            rg += 1
+            while rg < n_rg:
+                nxt = self._rg_bytes(rg)
+                if used + nxt > byte_budget:
+                    break
+                group.append(rg)
+                used += nxt
+                rg += 1
+            yield self._read_groups(group)
+
+    def _read_groups(self, groups: Sequence[int]) -> Table:
+        cols = []
+        with open(self._path, "rb") as f:
+            for leaf in self._selected:
+                parts = [self._decode_leaf(f, g, leaf) for g in groups]
+                cols.append(self._concat_parts(leaf, parts))
+        return Table(tuple(cols))
+
+    @classmethod
+    def _concat_parts(cls, leaf: LeafSchema, parts) -> Column:
+        if len(parts) == 1:
+            rows, values, offsets, validity = parts[0]
+            return cls._to_column(leaf, rows, values, offsets, validity)
+        rows = sum(p[0] for p in parts)
+        values = np.concatenate([p[1] for p in parts])
+        offsets = None
+        if leaf.physical == _PT_BYTE_ARRAY:
+            offsets = np.zeros(rows + 1, dtype=np.int32)
+            base = 0
+            pos = 0
+            for p in parts:
+                offsets[pos + 1:pos + 1 + p[0]] = p[2][1:] + base
+                base += p[2][-1]
+                pos += p[0]
+        validity = None
+        if any(p[3] is not None for p in parts):
+            validity = np.concatenate([
+                p[3] if p[3] is not None else np.ones(p[0], dtype=np.uint8)
+                for p in parts])
+        return cls._to_column(leaf, rows, values, offsets, validity)
+
+    def read_all(self) -> Table:
+        """Decode the whole file into one Table (host memory scales with the
+        file; use iter_chunks for bounded-memory streaming)."""
+        return self._read_groups(list(range(self.num_row_groups)))
+
+    def close(self):
+        if self._h:
+            self._lib.pqd_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_parquet(path: str, columns: Optional[Sequence[str]] = None) -> Table:
+    """One-shot convenience: decode an entire file to a device Table."""
+    with ParquetReader(path, columns=columns) as r:
+        return r.read_all()

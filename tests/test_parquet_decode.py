@@ -1,0 +1,227 @@
+"""Chunked Parquet page decode vs pyarrow ground truth.
+
+Covers the decode matrix the reference's chunked reader handles for flat
+columns (BASELINE config[3] shape): snappy + uncompressed codecs, dictionary
++ plain encodings, data page v1 + v2, nulls via def levels, multiple row
+groups, column projection, and a lineitem-shaped end-to-end file including
+FLBA decimals and date32.
+"""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.columnar.dtype import TypeId
+from spark_rapids_jni_tpu.parquet import ParquetReader, read_parquet
+
+
+def _roundtrip(table: pa.Table, tmp_path, name="f.parquet", **write_kwargs):
+    path = str(tmp_path / name)
+    pq.write_table(table, path, **write_kwargs)
+    return path
+
+
+def _assert_matches(col, arrow_col):
+    got = col.to_pylist()
+    want = arrow_col.to_pylist()
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        if w is None:
+            assert g is None
+        elif isinstance(w, float):
+            if np.isnan(w):
+                assert np.isnan(g)
+            else:
+                assert g == w
+        elif isinstance(w, datetime.datetime):
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=w.tzinfo)
+            micros = round((w - epoch).total_seconds() * 1e6)
+            assert g == micros
+        elif isinstance(w, datetime.date):
+            days = (w - datetime.date(1970, 1, 1)).days
+            assert g == days
+        else:
+            assert g == w, (g, w)
+
+
+def _check_file(path, table, columns=None):
+    cols = columns or table.column_names
+    out = read_parquet(path, columns=columns)
+    assert out.num_columns == len(cols)
+    for i, name in enumerate(cols):
+        _assert_matches(out[i], table.column(name))
+    return out
+
+
+RNG = np.random.default_rng(42)
+
+
+def _mixed_table(n=1000, nulls=True):
+    def mask():
+        return RNG.random(n) < 0.15 if nulls else np.zeros(n, dtype=bool)
+
+    i32 = pa.array(RNG.integers(-2**31, 2**31, n, dtype=np.int64)
+                   .astype(np.int32), mask=mask())
+    i64 = pa.array(RNG.integers(-2**62, 2**62, n), mask=mask())
+    f32 = pa.array(RNG.standard_normal(n).astype(np.float32), mask=mask())
+    f64 = pa.array(RNG.standard_normal(n), mask=mask())
+    b = pa.array(RNG.random(n) < 0.5, mask=mask())
+    words = np.array(["", "a", "spark", "tpu", "columnar", "ß-utf8",
+                      "longer string payload " * 3])
+    s = pa.array(words[RNG.integers(0, len(words), n)], mask=mask())
+    return pa.table({"i32": i32, "i64": i64, "f32": f32, "f64": f64,
+                     "b": b, "s": s})
+
+
+@pytest.mark.parametrize("compression", ["snappy", "none"])
+@pytest.mark.parametrize("dictionary", [True, False])
+def test_mixed_types_roundtrip(tmp_path, compression, dictionary):
+    t = _mixed_table()
+    path = _roundtrip(t, tmp_path, compression=compression,
+                      use_dictionary=dictionary)
+    _check_file(path, t)
+
+
+def test_no_nulls_has_no_validity(tmp_path):
+    t = _mixed_table(nulls=False)
+    path = _roundtrip(t, tmp_path)
+    out = _check_file(path, t)
+    for col in out:
+        assert col.validity is None
+
+
+def test_data_page_v2(tmp_path):
+    t = _mixed_table()
+    path = _roundtrip(t, tmp_path, data_page_version="2.0")
+    _check_file(path, t)
+
+
+def test_multiple_row_groups_and_chunking(tmp_path):
+    t = _mixed_table(n=5000)
+    path = _roundtrip(t, tmp_path, row_group_size=512)
+    with ParquetReader(path) as r:
+        assert r.num_row_groups == 10
+        assert r.num_rows() == 5000
+        # tiny budget → one row group per chunk; huge → one chunk
+        small = list(r.iter_chunks(byte_budget=1))
+        assert len(small) == 10
+        assert sum(c.num_rows for c in small) == 5000
+        big = list(r.iter_chunks(byte_budget=1 << 30))
+        assert len(big) == 1
+        assert big[0].num_rows == 5000
+    _check_file(path, t)
+
+
+def test_column_projection(tmp_path):
+    t = _mixed_table()
+    path = _roundtrip(t, tmp_path)
+    out = _check_file(path, t, columns=["s", "i64"])
+    assert out[0].dtype.id is TypeId.STRING
+    assert out[1].dtype.id is TypeId.INT64
+
+
+def test_decimal_flba(tmp_path):
+    vals = [decimal.Decimal("12345.67"), None, decimal.Decimal("-0.01"),
+            decimal.Decimal("99999999.99"), decimal.Decimal("0.00")]
+    t = pa.table({"d": pa.array(vals, type=pa.decimal128(12, 2))})
+    path = _roundtrip(t, tmp_path)
+    out = read_parquet(path)
+    assert out[0].dtype.id is TypeId.DECIMAL128
+    assert out[0].dtype.scale == 2
+    assert out[0].to_pylist() == vals
+
+
+def test_decimal_int32_int64(tmp_path):
+    d32 = pa.array([decimal.Decimal("1.5"), decimal.Decimal("-2.25")],
+                   type=pa.decimal128(7, 2))
+    t = pa.table({"d": d32})
+    # force INT32/INT64 storage via arrow's writer option
+    path = str(tmp_path / "d.parquet")
+    pq.write_table(t, path, store_decimal_as_integer=True)
+    out = read_parquet(path)
+    assert out[0].dtype.id in (TypeId.DECIMAL32, TypeId.DECIMAL64)
+    assert out[0].dtype.scale == 2
+    assert [str(v) for v in out[0].to_pylist()] == ["1.50", "-2.25"]
+
+
+def test_date_and_timestamp(tmp_path):
+    dates = pa.array([datetime.date(1970, 1, 2), None,
+                      datetime.date(2024, 2, 29)])
+    ts = pa.array([datetime.datetime(2001, 2, 3, 4, 5, 6, 789012), None,
+                   datetime.datetime(1969, 12, 31, 23, 59, 59)],
+                  type=pa.timestamp("us"))
+    t = pa.table({"d": dates, "ts": ts})
+    path = _roundtrip(t, tmp_path)
+    out = read_parquet(path)
+    assert out[0].dtype.id is TypeId.TIMESTAMP_DAYS
+    assert out[1].dtype.id is TypeId.TIMESTAMP_MICROSECONDS
+    _check_file(path, t)
+
+
+def test_all_null_column(tmp_path):
+    t = pa.table({"x": pa.array([None] * 37, type=pa.int64()),
+                  "s": pa.array([None] * 37, type=pa.string())})
+    path = _roundtrip(t, tmp_path)
+    out = read_parquet(path)
+    assert out[0].null_count() == 37
+    assert out[1].null_count() == 37
+    assert out[0].to_pylist() == [None] * 37
+
+
+def test_large_dictionary_fallback(tmp_path):
+    # high-cardinality strings overflow the dict page → writer falls back to
+    # PLAIN mid-column; decoder must handle dict + plain pages in one chunk
+    n = 20000
+    vals = [f"unique-string-value-{i:08d}-{'x' * 40}" for i in range(n)]
+    t = pa.table({"s": pa.array(vals)})
+    path = _roundtrip(t, tmp_path, dictionary_pagesize_limit=4096,
+                      data_page_size=8192)
+    _check_file(path, t)
+
+
+def test_nested_rejected(tmp_path):
+    t = pa.table({"l": pa.array([[1, 2], [3]], type=pa.list_(pa.int64()))})
+    path = _roundtrip(t, tmp_path)
+    with pytest.raises(ValueError, match="nested"):
+        ParquetReader(path)
+    # projection away from the nested column still works
+    t2 = pa.table({"l": pa.array([[1], [2]], type=pa.list_(pa.int64())),
+                   "x": pa.array([7, 8], type=pa.int64())})
+    path2 = _roundtrip(t2, tmp_path, name="g.parquet")
+    out = read_parquet(path2, columns=["x"])
+    assert out[0].to_pylist() == [7, 8]
+
+
+def test_lineitem_shaped_end_to_end(tmp_path):
+    """A lineitem-shaped file (BASELINE config[3] in miniature): ints,
+    decimals, dates, strings, snappy, several row groups."""
+    n = 8192
+    t = pa.table({
+        "l_orderkey": pa.array(RNG.integers(1, 6_000_000, n)),
+        "l_partkey": pa.array(RNG.integers(1, 200_000, n)),
+        "l_quantity": pa.array(
+            [decimal.Decimal(int(v)) / 100 for v in
+             RNG.integers(100, 5100, n)], type=pa.decimal128(12, 2)),
+        "l_extendedprice": pa.array(
+            [decimal.Decimal(int(v)) / 100 for v in
+             RNG.integers(90000, 10500000, n)], type=pa.decimal128(12, 2)),
+        "l_shipdate": pa.array(
+            [datetime.date(1992, 1, 1) + datetime.timedelta(days=int(d))
+             for d in RNG.integers(0, 2500, n)]),
+        "l_returnflag": pa.array(
+            np.array(["A", "N", "R"])[RNG.integers(0, 3, n)]),
+        "l_comment": pa.array(
+            [f"comment {i} " + "filler " * int(RNG.integers(0, 5))
+             for i in range(n)]),
+    })
+    path = _roundtrip(t, tmp_path, compression="snappy", row_group_size=2048)
+    with ParquetReader(path) as r:
+        total = 0
+        for chunk in r.iter_chunks(byte_budget=64 << 10):
+            total += chunk.num_rows
+        assert total == n
+    _check_file(path, t)
