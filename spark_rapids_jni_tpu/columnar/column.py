@@ -84,6 +84,23 @@ class Column:
             return 0
         return int(self.size - jnp.sum(self.validity.astype(jnp.int32)))
 
+    def device_nbytes(self) -> int:
+        """Device footprint in bytes (data + validity + offsets + children).
+
+        Used by the reservation brackets (memory/reservation.py) to estimate
+        op working sets before launch.
+        """
+        n = 0
+        if self.data is not None:
+            n += self.data.size * self.data.dtype.itemsize
+        if self.validity is not None:
+            n += self.validity.size * self.validity.dtype.itemsize
+        if self.offsets is not None:
+            n += self.offsets.size * self.offsets.dtype.itemsize
+        for c in self.children:
+            n += c.device_nbytes()
+        return int(n)
+
     def valid_mask(self) -> jnp.ndarray:
         """Always-materialized bool[n] validity mask."""
         if self.validity is not None:
@@ -271,6 +288,9 @@ class Table:
     @property
     def num_columns(self) -> int:
         return len(self.columns)
+
+    def device_nbytes(self) -> int:
+        return sum(c.device_nbytes() for c in self.columns)
 
     def __getitem__(self, i: int) -> Column:
         return self.columns[i]

@@ -1,0 +1,81 @@
+"""Reservation bracketing: estimate → reserve → launch → release.
+
+Reference contract: every cudf/RMM allocation the reference's kernels make
+flows through the SparkResourceAdaptor do_allocate loop
+(SparkResourceAdaptorJni.cpp:1731), so the retry/BUFN/split machinery governs
+real memory pressure. XLA allocations cannot be intercepted per-buffer the
+way RMM intercepts cudaMalloc, so the TPU adaptation brackets each device op
+with an HBM *reservation* for its peak transient working set: the op
+estimates its footprint, reserves it through RmmSpark (which may block the
+thread, throw TpuRetryOOM, or escalate to TpuSplitAndRetryOOM exactly like
+the reference's adaptor), launches, and releases on return.
+
+Ops call ``device_reservation(nbytes)``. The bracket is active only when an
+RmmSpark event handler is installed AND the calling thread is associated with
+a task (reference parity: unregistered threads bypass the adaptor,
+SparkResourceAdaptorJni.cpp pre_alloc returns early for unknown threads) —
+so library users who never touch RmmSpark pay one dict lookup, nothing more.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+
+from .rmm_spark import RmmSpark, ThreadState
+
+# Per-thread depth: a reservation taken inside another reservation's bracket
+# (op composed of ops, e.g. sort_table inside groupby) must not double-count
+# its parent's estimate; inner brackets are no-ops.
+_tls = threading.local()
+
+
+def reservations_active() -> bool:
+    """True when the calling thread's device work is governed by RmmSpark."""
+    if RmmSpark._adaptor is None:
+        return False
+    state = RmmSpark.get_state_of(RmmSpark.get_current_thread_id())
+    return state != ThreadState.UNKNOWN
+
+
+@contextmanager
+def device_reservation(nbytes: int):
+    """Reserve ``nbytes`` of HBM around a device-op launch.
+
+    Yields True when a reservation was actually taken. Raises the OOM
+    taxonomy (TpuRetryOOM / TpuSplitAndRetryOOM / TpuOOM) from the reserve
+    step when the scheduler demands rollback/split — callers running under
+    ``memory.retry.with_retry`` get the full retry protocol.
+    """
+    depth = getattr(_tls, "depth", 0)
+    if nbytes <= 0 or depth > 0 or not reservations_active():
+        _tls.depth = depth + 1
+        try:
+            yield False
+        finally:
+            _tls.depth = depth
+        return
+    RmmSpark.alloc(nbytes)
+    _tls.depth = depth + 1
+    try:
+        yield True
+    finally:
+        _tls.depth = depth
+        RmmSpark.dealloc(nbytes)
+
+
+def release_barrier(result, took: bool):
+    """Synchronize before a reservation release.
+
+    JAX dispatch is asynchronous: an op returns while its XLA computation is
+    still queued, so releasing the reservation at Python-return time would
+    let the next op launch against HBM the previous one still occupies.
+    When a reservation was actually taken (``took``), block until the
+    result's device buffers exist so the release reflects real occupancy.
+    Columns/Tables are pytrees, so ``block_until_ready`` traverses them.
+    """
+    if took:
+        jax.block_until_ready(result)
+    return result

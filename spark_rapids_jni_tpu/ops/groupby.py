@@ -25,6 +25,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
+from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values
 from .sort import gather, sort_order
 
@@ -83,6 +84,15 @@ def groupby_aggregate(
     Returns a Table of [unique keys..., one column per agg] in group-sorted
     order.
     """
+    # peak ≈ input + sorted/gathered intermediates (reservation bracketing)
+    with device_reservation(2 * table.device_nbytes()) as took:
+        return release_barrier(
+            _groupby_aggregate(table, key_indices, aggs), took)
+
+
+def _groupby_aggregate(
+        table: Table, key_indices: Sequence[int],
+        aggs: Sequence[Tuple[int, str]]) -> Table:
     keys = [table.columns[i] for i in key_indices]
     order = sort_order(keys)
 

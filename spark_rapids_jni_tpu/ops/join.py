@@ -32,6 +32,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
+from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values, xxhash64
 
 
@@ -80,6 +81,46 @@ def _candidates(left_keys, right_keys, nulls_equal):
     """(l_idx, r_idx) candidate pairs with equal row hash, verified exact.
     Device-resident; the only host syncs are the two data-dependent output
     sizes (candidate count, then verified-match count)."""
+    in_bytes = sum(c.device_nbytes() for c in left_keys) \
+        + sum(c.device_nbytes() for c in right_keys)
+    with device_reservation(2 * in_bytes) as took:
+        total, state = _candidate_counts(left_keys, right_keys, nulls_equal)
+        release_barrier(state, took)
+    if total == 0:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    # expansion working set is data-dependent: re-bracket now that the
+    # candidate-pair count is known (phase-1 arrays stay live → included);
+    # per-pair cost covers the index/verify columns (24 B) plus the padded
+    # byte rows _col_equal gathers per candidate for wide keys
+    per_pair = 24
+    for lc, rc in zip(left_keys, right_keys):
+        per_pair += _verify_width(lc) + _verify_width(rc)
+    with device_reservation(2 * in_bytes + total * per_pair) as took:
+        out = _expand_and_verify(left_keys, right_keys, nulls_equal, total,
+                                 state)
+        release_barrier(state, took)  # out is host numpy; state backs it
+        return out
+
+
+def _verify_width(col: Column) -> int:
+    """Bytes _col_equal materializes per candidate pair for one key column:
+    the gathered padded-byte row for STRING, limb row for DECIMAL128, one
+    element otherwise."""
+    tid = col.dtype.id
+    if tid is dt.TypeId.STRING:
+        if col.size == 0:
+            return 1
+        # the padded matrix width _col_equal will gather per pair; memoized,
+        # so densifying here is work the verify phase reuses
+        return int(padded_bytes(col)[0].shape[1])
+    if tid is dt.TypeId.DECIMAL128:
+        return 16
+    return col.dtype.itemsize if col.dtype.is_fixed_width else 8
+
+
+def _candidate_counts(left_keys, right_keys, nulls_equal):
+    """Phase 1: row hashes + sorted-hash range counts. Host-syncs the
+    candidate-pair total (sync #1) so phase 2 can reserve for it."""
     hl = _row_hash(left_keys)
     hr = _row_hash(right_keys)
     nl, nr = hl.shape[0], hr.shape[0]
@@ -99,8 +140,13 @@ def _candidates(left_keys, right_keys, nulls_equal):
     hi = jnp.searchsorted(hr_sorted, hl, side="right")
     cnt = (hi - lo).astype(jnp.int32)
     total = int(jnp.sum(cnt))  # host sync #1: candidate-pair count
-    if total == 0:
-        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    return total, (order, lo, cnt, nl)
+
+
+def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
+    """Phase 2: expand candidate pairs on device and verify exact equality.
+    Host-syncs only the verified-match compaction (sync #2)."""
+    order, lo, cnt, nl = state
     l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
                        total_repeat_length=total)
     start = jnp.cumsum(cnt) - cnt

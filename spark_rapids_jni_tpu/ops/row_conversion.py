@@ -40,6 +40,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..columnar.strings import padded_bytes
+from ..memory.reservation import device_reservation, release_barrier
 
 JCUDF_ROW_ALIGNMENT = 8
 MAX_BATCH_BYTES = (1 << 31) - 1  # LIST<INT8> offsets are int32 (2 GB limit)
@@ -211,6 +212,16 @@ def convert_to_rows(table: Table,
     n = table.num_rows
     string_cols = [c for c in table if c.dtype.id is TypeId.STRING]
 
+    # peak ≈ input + padded string matrices + output row blobs (reservation
+    # bracketing; see memory/reservation.py)
+    est = 2 * table.device_nbytes() + n * info.size_per_row
+    with device_reservation(est) as took:
+        out = _convert_to_rows(table, max_batch_bytes, info, n, string_cols)
+        return release_barrier(out, took)
+
+
+def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
+
     if not string_cols:
         row_size = _round_up(info.size_per_row, JCUDF_ROW_ALIGNMENT)
         fixed = _build_fixed_region(table, info, None, None)
@@ -298,6 +309,11 @@ def convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
     `rows` is a LIST<INT8> column as produced by convert_to_rows.
     """
     assert rows.dtype.id is TypeId.LIST, "expected LIST<INT8> row column"
+    with device_reservation(2 * rows.device_nbytes()) as took:
+        return release_barrier(_convert_from_rows(rows, dtypes), took)
+
+
+def _convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
     info = compute_column_information(dtypes)
     n = rows.size
     row_offsets = jnp.asarray(rows.offsets, dtype=jnp.int32)[:-1]

@@ -21,6 +21,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
+from ..memory.reservation import device_reservation, release_barrier
 from .hashing import _f32_bits, _f64_bits
 
 
@@ -152,6 +153,10 @@ def gather(col: Column, idx: jnp.ndarray) -> Column:
 def sort_table(table: Table, key_indices: Sequence[int],
                ascending: Optional[Sequence[bool]] = None,
                nulls_first: Optional[Sequence[bool]] = None) -> Table:
-    keys = [table.columns[i] for i in key_indices]
-    order = sort_order(keys, ascending, nulls_first)
-    return Table(tuple(gather(c, order) for c in table.columns))
+    # peak working set ≈ input + gathered output (reservation bracketing,
+    # reference contract: SparkResourceAdaptorJni.cpp:1731 do_allocate loop)
+    with device_reservation(2 * table.device_nbytes()) as took:
+        keys = [table.columns[i] for i in key_indices]
+        order = sort_order(keys, ascending, nulls_first)
+        out = Table(tuple(gather(c, order) for c in table.columns))
+        return release_barrier(out, took)

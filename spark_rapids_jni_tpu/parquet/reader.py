@@ -31,6 +31,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
+from ..memory.reservation import device_reservation, release_barrier
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _PKG_ROOT = os.path.dirname(_HERE)
@@ -363,11 +364,22 @@ class ParquetReader:
             yield self._read_groups(group)
 
     def _read_groups(self, groups: Sequence[int]) -> Table:
+        # per-leaf streaming: decode one leaf's host buffers, reserve exactly
+        # their size, ship, release — host peak stays one leaf, and the HBM
+        # reservation is exact (decoded bytes, not an estimate)
         cols = []
         with open(self._path, "rb") as f:
             for leaf in self._selected:
                 parts = [self._decode_leaf(f, g, leaf) for g in groups]
-                cols.append(self._concat_parts(leaf, parts))
+                est = sum(
+                    p[1].nbytes
+                    + (p[2].nbytes if p[2] is not None else 0)
+                    + (p[3].nbytes if p[3] is not None else 0)
+                    for p in parts)
+                with device_reservation(est) as took:
+                    col = self._concat_parts(leaf, parts)
+                    release_barrier(col, took)
+                cols.append(col)
         return Table(tuple(cols))
 
     @classmethod
