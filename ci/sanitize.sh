@@ -1,0 +1,44 @@
+#!/bin/bash
+# Sanitizer CI tier (reference: pom.xml:217-263 runs the Java suite under
+# NVIDIA Compute Sanitizer; SURVEY.md maps this to TSan/ASan on host code).
+#
+#   1. TSan: resource adaptor state machine stressed from many threads
+#      (ci/tsan_stress.cpp compiled together with resource_adaptor.cpp).
+#   2. ASan+UBSan: footer/page/JSON parsers fuzzed with mutated inputs
+#      (ci/asan_fuzz.cpp compiled with the three parser sources).
+#   3. Optional (SRJT_TSAN_PYTEST=1): the python resource-adaptor suites run
+#      with the TSan-built .so preloaded — slower, pulls python/JAX into the
+#      TSan runtime, but exercises the exact ctypes call patterns.
+#
+# Usage: ci/sanitize.sh [fuzz_rounds]   (default 2000)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROUNDS="${1:-2000}"
+BUILD=.sanitize-build
+mkdir -p "$BUILD"
+
+echo "== TSan: resource adaptor stress =="
+g++ -std=c++17 -Og -g -fsanitize=thread -fPIE \
+    -o "$BUILD/tsan_stress" ci/tsan_stress.cpp native/resource_adaptor.cpp \
+    -lpthread
+TSAN_OPTIONS="halt_on_error=1 exitcode=66" "$BUILD/tsan_stress"
+
+echo "== ASan+UBSan: parser fuzz ($ROUNDS rounds) =="
+g++ -std=c++17 -Og -g -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -o "$BUILD/asan_fuzz" ci/asan_fuzz.cpp native/parquet_footer.cpp \
+    native/parquet_decode.cpp native/get_json_object.cpp -lpthread
+ASAN_OPTIONS="detect_leaks=1" "$BUILD/asan_fuzz" "$ROUNDS"
+
+if [[ "${SRJT_TSAN_PYTEST:-0}" == "1" ]]; then
+  echo "== TSan: python resource-adaptor suites (preloaded runtime) =="
+  g++ -std=c++17 -Og -g -fsanitize=thread -fPIC -shared \
+      -o "$BUILD/libsparkrm_tsan.so" native/resource_adaptor.cpp -lpthread
+  LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" \
+  SRJT_NATIVE_SO_OVERRIDE="$PWD/$BUILD/libsparkrm_tsan.so" \
+  TSAN_OPTIONS="exitcode=66 report_signal_unsafe=0" \
+    python -m pytest tests/test_resource_adaptor.py \
+                     tests/test_rmm_monte_carlo.py -q
+fi
+
+echo "sanitize: all clean"

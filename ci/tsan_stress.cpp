@@ -1,0 +1,179 @@
+// ThreadSanitizer stress harness for the native resource adaptor.
+//
+// Reference capability: the reference runs its whole Java test suite under
+// NVIDIA Compute Sanitizer (pom.xml:217-263, CONTRIBUTING.md:240-271).
+// SURVEY.md maps that tier to TSan/ASan on the host-native code; the
+// resource adaptor (native/resource_adaptor.cpp) is the hand-rolled
+// condvar/state-machine core that most needs race coverage.
+//
+// This binary compiles resource_adaptor.cpp TOGETHER with this driver under
+// -fsanitize=thread (every access instrumented, no Python/JAX noise) and
+// hammers the C ABI from many threads at once:
+//   * dedicated task threads running the alloc → (retry | split | success)
+//     → dealloc protocol with random sizes against an undersized pool
+//   * shuffle threads attached to several tasks
+//   * a watchdog thread breaking deadlocks at high frequency (the python
+//     facade's daemon, memory/rmm_spark.py:92)
+//   * a metrics-reader thread polling every getter concurrently
+//   * OOM/exception injection sprinkled in (force_oom)
+// Exit code 0 with no TSan report = clean run (ci/sanitize.sh sets
+// TSAN_OPTIONS=halt_on_error=1,exitcode=66).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rm_create(long long pool_bytes, const char* log_path);
+void rm_destroy(void* h);
+int rm_start_dedicated_task_thread(void* h, long tid, long task);
+int rm_pool_thread_working_on_task(void* h, long tid, long task);
+int rm_pool_thread_finished_for_tasks(void* h, long tid, const long* tasks,
+                                      int n);
+int rm_start_shuffle_thread(void* h, long tid);
+int rm_remove_thread_association(void* h, long tid, long task);
+int rm_task_done(void* h, long task);
+int rm_start_retry_block(void* h, long tid);
+int rm_end_retry_block(void* h, long tid);
+int rm_force_oom(void* h, long tid, int kind, int num, int mode, int skip);
+int rm_alloc(void* h, long tid, long long bytes);
+int rm_dealloc(void* h, long tid, long long bytes);
+int rm_block_thread_until_ready(void* h, long tid);
+int rm_check_and_break_deadlocks(void* h);
+int rm_get_state_of(void* h, long tid);
+long long rm_get_metric(void* h, long task, int which, int reset);
+long long rm_pool_used(void* h);
+long long rm_pool_limit(void* h);
+}
+
+namespace {
+
+// status codes (native/resource_adaptor.cpp rm_status)
+constexpr int OK = 0, RETRY = 1, SPLIT = 2, CPU_RETRY = 3, CPU_SPLIT = 4,
+              FATAL = 5, INJECTED = 6, REMOVED = 7;
+
+constexpr long long POOL = 4 << 20;   // undersized on purpose
+constexpr int N_TASK_THREADS = 8;
+constexpr int N_TASKS = 4;
+constexpr int ROUNDS = 60;
+
+std::atomic<long> failures{0};
+std::atomic<bool> stop{false};
+
+void task_worker(void* h, long tid, long task, unsigned seed) {
+  if (rm_start_dedicated_task_thread(h, tid, task) != OK) {
+    failures++;
+    return;
+  }
+  for (int round = 0; round < ROUNDS; round++) {
+    long long bytes = (long long)(rand_r(&seed) % (POOL / 2)) + 4096;
+    if (rand_r(&seed) % 16 == 0)
+      rm_force_oom(h, tid, rand_r(&seed) % 2, 1, 1, rand_r(&seed) % 2);
+    rm_start_retry_block(h, tid);
+    long long held = 0;
+    for (int attempt = 0; attempt < 50; attempt++) {
+      int rc = rm_alloc(h, tid, bytes);
+      if (rc == OK) {
+        held = bytes;
+        break;
+      }
+      if (rc == INJECTED) continue;  // injected framework exception: retry
+      if (rc == RETRY || rc == CPU_RETRY) {
+        int brc = rm_block_thread_until_ready(h, tid);
+        if (brc == SPLIT || brc == CPU_SPLIT) bytes = bytes / 2 + 1;
+        continue;
+      }
+      if (rc == SPLIT || rc == CPU_SPLIT) {
+        bytes = bytes / 2 + 1;
+        continue;
+      }
+      if (rc == FATAL || rc == REMOVED) break;
+      failures++;  // unexpected status
+      break;
+    }
+    rm_end_retry_block(h, tid);
+    if (held > 0) {
+      std::this_thread::yield();
+      rm_dealloc(h, tid, held);
+    }
+  }
+  rm_remove_thread_association(h, tid, task);
+}
+
+void shuffle_worker(void* h, long tid, unsigned seed) {
+  if (rm_start_shuffle_thread(h, tid) != OK) {
+    failures++;
+    return;
+  }
+  for (long t = 0; t < N_TASKS; t++) rm_pool_thread_working_on_task(h, tid, t);
+  for (int round = 0; round < ROUNDS; round++) {
+    long long bytes = (long long)(rand_r(&seed) % (POOL / 8)) + 1024;
+    int rc = rm_alloc(h, tid, bytes);
+    if (rc == OK) {
+      std::this_thread::yield();
+      rm_dealloc(h, tid, bytes);
+    } else if (rc == RETRY || rc == CPU_RETRY) {
+      rm_block_thread_until_ready(h, tid);
+    }
+  }
+  long tasks[N_TASKS];
+  for (long t = 0; t < N_TASKS; t++) tasks[t] = t;
+  rm_pool_thread_finished_for_tasks(h, tid, tasks, N_TASKS);
+  rm_remove_thread_association(h, tid, -1);
+}
+
+void watchdog(void* h) {
+  while (!stop.load(std::memory_order_acquire)) {
+    rm_check_and_break_deadlocks(h);
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+void metrics_reader(void* h) {
+  while (!stop.load(std::memory_order_acquire)) {
+    rm_pool_used(h);
+    rm_pool_limit(h);
+    for (long task = 0; task < N_TASKS; task++)
+      for (int m = 0; m < 5; m++) rm_get_metric(h, task, m, 0);
+    for (long tid = 0; tid < N_TASK_THREADS + 2; tid++) rm_get_state_of(h, tid);
+    std::this_thread::yield();
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* h = rm_create(POOL, "");
+  if (!h) {
+    fprintf(stderr, "rm_create failed\n");
+    return 1;
+  }
+  std::thread wd(watchdog, h);
+  std::thread mr(metrics_reader, h);
+  std::vector<std::thread> workers;
+  for (long i = 0; i < N_TASK_THREADS; i++)
+    workers.emplace_back(task_worker, h, i, (long)(i % N_TASKS), (unsigned)i);
+  workers.emplace_back(shuffle_worker, h, (long)N_TASK_THREADS, 1234u);
+  workers.emplace_back(shuffle_worker, h, (long)(N_TASK_THREADS + 1), 5678u);
+  for (auto& w : workers) w.join();
+  for (long t = 0; t < N_TASKS; t++) rm_task_done(h, t);
+  stop.store(true, std::memory_order_release);
+  wd.join();
+  mr.join();
+  long long leaked = rm_pool_used(h);
+  rm_destroy(h);
+  if (failures.load() != 0) {
+    fprintf(stderr, "tsan_stress: %ld protocol failures\n", failures.load());
+    return 2;
+  }
+  if (leaked != 0) {
+    fprintf(stderr, "tsan_stress: pool leak %lld bytes\n", leaked);
+    return 3;
+  }
+  printf("tsan_stress: ok\n");
+  return 0;
+}

@@ -43,14 +43,22 @@ def _stale() -> bool:
 
 
 def load() -> ctypes.CDLL:
-    """Load (building if needed) the native library and declare signatures."""
+    """Load (building if needed) the native library and declare signatures.
+
+    ``SRJT_NATIVE_SO_OVERRIDE`` loads a prebuilt library instead (the
+    sanitizer tier points this at a TSan-instrumented build, ci/sanitize.sh).
+    """
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if _stale():
-            _build()
-        lib = ctypes.CDLL(_SO)
+        override = os.environ.get("SRJT_NATIVE_SO_OVERRIDE")
+        if override:
+            lib = ctypes.CDLL(override)
+        else:
+            if _stale():
+                _build()
+            lib = ctypes.CDLL(_SO)
 
         c = ctypes
         lib.rm_create.restype = c.c_void_p
