@@ -1,0 +1,16 @@
+#!/bin/bash
+# CI memory-pressure soak — analog of the reference's ci/fuzz-test.sh:10-12
+# (RmmSparkMonteCarlo --taskMaxMiB=2048 --gpuMiB=3072 --skewed
+#  --allocMode=ASYNC). The pool is a reservation ledger, so GiB-scale sizes
+# cost nothing physical; the soak value is minutes of real thread
+# interleavings through alloc/block/BUFN/split under skewed demand.
+#
+# Usage: ci/fuzz-test.sh [numSeconds]   (default 120)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SECONDS_TO_RUN="${1:-120}"
+exec python -m spark_rapids_jni_tpu.memory.monte_carlo \
+    --taskMaxMiB=2048 --gpuMiB=3072 --skewed --allocMode=ASYNC \
+    --parallelism=8 --shuffleThreads=2 --maxTaskAllocs=200 \
+    --numSeconds="$SECONDS_TO_RUN"

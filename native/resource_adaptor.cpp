@@ -86,6 +86,22 @@ static const char* state_name(int s) {
 // framework gives up with a fatal OOM (livelock guard; reference caps at 500).
 constexpr int kMaxRetryLoops = 500;
 
+// Consecutive free-raced-with-alloc fast retries allowed before a thread
+// must park on the condvar (prevents shuffle-churn frees from spinning an
+// oversized request through the retry cap without ever blocking).
+constexpr int kMaxFastRetries = 8;
+
+// Free events with blocked threads present but none fitting the available
+// bytes before the starvation valve wakes the best thread anyway (see
+// wake_next_highest_priority_blocked_locked).
+constexpr int kFutileFreeBudget = 64;
+
+// Valve (courtesy) wakes a single thread may consume before the framework
+// declares it unsatisfiable — the fatal backstop for requests that keep
+// losing to churn (64 frees per courtesy wake * 10000 ≈ far beyond any
+// live workload, but finite).
+constexpr int kMaxCourtesyWakes = 10000;
+
 using clock_t_ = std::chrono::steady_clock;
 
 static int64_t now_ns() {
@@ -133,7 +149,11 @@ struct per_thread {
 
   int       state = TS_RUNNING;
   bool      blocked_is_cpu = false;  // domain of the outstanding blocked alloc
-  int       retry_loops = 0;         // failed alloc loops since last success
+  int       retry_loops = 0;         // blocked-and-rewoken loops since success
+  int       fast_retries = 0;        // consecutive ALLOC_FREE fast retries
+  int64_t   pending_bytes = 0;       // size of the outstanding device alloc
+  bool      courtesy_wake = false;   // woken by the starvation valve (no fit)
+  int       courtesy_wakes = 0;      // valve wakes since last success
 
   // Marks for deadlock accounting on threads that are waiting on *other
   // threads* rather than on memory (python-UDF pool protocol).
@@ -242,6 +262,22 @@ class resource_adaptor {
     return RM_OK;
   }
 
+  // Erase a thread's record, returning any bytes it still has reserved to
+  // the pool (a thread can be torn down between an alloc and its dealloc —
+  // e.g. a pool thread erased by task_done mid-window; its later dealloc
+  // lands in the clamped unregistered branch, so no double-free).
+  void erase_thread_locked(long tid) {
+    auto it = threads_.find(tid);
+    if (it == threads_.end()) return;
+    if (it->second.device_reserved > 0) {
+      pool_used_ -= it->second.device_reserved;
+      threads_.erase(it);
+      wake_next_highest_priority_blocked_locked(false, "erase_thread");
+    } else {
+      threads_.erase(it);
+    }
+  }
+
   int remove_thread_association(long tid, long task_id) {
     std::unique_lock<std::mutex> lk(m_);
     auto it = threads_.find(tid);
@@ -252,7 +288,7 @@ class resource_adaptor {
     t.pool_task_ids.erase(task_id);
     if (t.is_task_less() && t.state == TS_RUNNING) {
       log_op("remove_thread", tid, tid, task_id, t.state, t.state, "");
-      threads_.erase(it);
+      erase_thread_locked(tid);
     }
     check_and_update_for_bufn_locked(lk);
     return RM_OK;
@@ -281,7 +317,7 @@ class resource_adaptor {
         }
       }
     }
-    for (long tid : to_erase) threads_.erase(tid);
+    for (long tid : to_erase) erase_thread_locked(tid);
     // A finished task releases pressure: let BUFN threads try again
     // (reference wake_up_threads_after_task_finishes :1118-1148).
     wake_bufn_threads_locked("task_done");
@@ -335,16 +371,37 @@ class resource_adaptor {
       untracked_reserved_ += bytes;
       return RM_OK;
     }
+    // A request beyond the whole pool can never fit, even alone: the only
+    // remedy is splitting the input, so escalate immediately instead of
+    // parking behind the size-aware waker (blind wakes used to surface this
+    // as a retry-cap fatal OOM after ~500 futile cycles — split is both
+    // faster and recoverable).
+    if (bytes > pool_limit_) {
+      per_thread& t = it->second;
+      log_op("alloc_over_limit", tid, tid, t.task_id, t.state, t.state,
+             "split_and_retry");
+      account_thrown_retry_locked(t, true);
+      return RM_SPLIT_AND_RETRY_OOM;
+    }
+    // Clears pending_bytes iff the thread record still exists — a task-
+    // removed unwind (TS_REMOVE_THROW gate) erases the map node, and writing
+    // through the old reference would be a use-after-free.
+    auto clear_pending = [&]() {
+      auto it2 = threads_.find(tid);
+      if (it2 != threads_.end()) it2->second.pending_bytes = 0;
+    };
     while (true) {
       per_thread& t = threads_.at(tid);
+      t.pending_bytes = bytes;  // lets the waker skip threads that can't fit
       int rc = pre_alloc_locked(lk, t, /*is_for_cpu=*/false);
-      if (rc != RM_OK) return rc;
+      if (rc != RM_OK) { clear_pending(); return rc; }
       if (try_reserve_locked(&t, bytes)) {
         post_alloc_success_locked(t, bytes);
+        t.pending_bytes = 0;
         return RM_OK;
       }
       rc = post_alloc_failed_locked(lk, t, /*was_oom=*/true, /*cpu=*/false);
-      if (rc != RM_OK) return rc;
+      if (rc != RM_OK) { clear_pending(); return rc; }
     }
   }
 
@@ -600,6 +657,9 @@ class resource_adaptor {
     if (t.state == TS_ALLOC || t.state == TS_ALLOC_FREE)
       transition(t, TS_RUNNING, "post_alloc_success");
     t.retry_loops = 0;
+    t.fast_retries = 0;
+    t.courtesy_wake = false;
+    t.courtesy_wakes = 0;
     // If a free raced with our alloc, others may fit now (reference :1379).
     wake_next_highest_priority_blocked_locked(false, "post_alloc_success");
   }
@@ -614,13 +674,31 @@ class resource_adaptor {
         transition(t, TS_RUNNING, "post_alloc_failed_not_oom");
       return RM_INJECTED_EXCEPTION;
     }
-    if (++t.retry_loops > kMaxRetryLoops) {
-      transition(t, TS_RUNNING, "retry_cap_exceeded");
-      return RM_FATAL_OOM;
-    }
-    if (t.state == TS_ALLOC_FREE) {
+    // A free raced with this alloc: retry immediately — but only a bounded
+    // number of times in a row. Under high-frequency small frees (shuffle
+    // churn) an oversized request would otherwise spin here forever without
+    // ever parking, and a spin cap alone would misread that livelock as a
+    // fatal OOM. After the burst budget, fall through and block normally.
+    if (t.state == TS_ALLOC_FREE && t.fast_retries < kMaxFastRetries) {
+      t.fast_retries++;
       transition(t, TS_RUNNING, "alloc_free_fast_retry");
       return RM_OK;
+    }
+    t.fast_retries = 0;
+    // A courtesy wake from the starvation valve was known not to fit; the
+    // ensuing failure says little about livelock, so it burns a separate,
+    // much larger budget (otherwise churn-heavy workloads march a parked
+    // big request to a spurious fatal OOM at kMaxRetryLoops — while a
+    // cap-exempt wake with no backstop could never go fatal at all).
+    if (t.courtesy_wake) {
+      t.courtesy_wake = false;
+      if (++t.courtesy_wakes > kMaxCourtesyWakes) {
+        transition(t, TS_RUNNING, "courtesy_cap_exceeded");
+        return RM_FATAL_OOM;
+      }
+    } else if (++t.retry_loops > kMaxRetryLoops) {
+      transition(t, TS_RUNNING, "retry_cap_exceeded");
+      return RM_FATAL_OOM;
     }
     // Task purged while we were out doing the allocation: unwind instead of
     // blocking (the state machine would otherwise never wake us).
@@ -659,12 +737,9 @@ class resource_adaptor {
                                   : RM_SPLIT_AND_RETRY_OOM;
         case TS_REMOVE_THROW: {
           transition(t, TS_RUNNING, "task_removed");
-          // The task is gone: hand its reservations back to the pool. Any
-          // later dealloc from the unwinding caller lands in the unregistered
-          // branch, which is clamped so it cannot double-free.
-          if (t.device_reserved > 0) pool_used_ -= t.device_reserved;
-          threads_.erase(t.thread_id);
-          wake_next_highest_priority_blocked_locked(false, "task_removed");
+          // The task is gone: hand its reservations back to the pool (see
+          // erase_thread_locked for the double-free clamp rationale).
+          erase_thread_locked(t.thread_id);
           return RM_TASK_REMOVED;
         }
         default:
@@ -674,16 +749,45 @@ class resource_adaptor {
   }
 
   void wake_next_highest_priority_blocked_locked(bool cpu, const char* note) {
+    // Size-aware wake: only hand the pool to the highest-priority blocked
+    // thread whose outstanding request actually fits the available bytes.
+    // A blind wake-highest policy lets high-frequency small frees wake an
+    // oversized request hundreds of times per second; each futile
+    // wake→fail→re-block cycle burns its retry budget toward a spurious
+    // fatal OOM. Threads that can never fit stay parked until the BUFN
+    // watchdog escalates them to split (the correct remedy). pending_bytes
+    // is 0 for host-domain blocks (the CPU pool is caller-owned), which
+    // always "fit".
+    int64_t available = pool_limit_ - pool_used_;
     per_thread* best = nullptr;
+    per_thread* best_any = nullptr;  // ignoring fit, for the starvation valve
     for (auto& [tid, t] : threads_) {
       if (t.state != TS_BLOCKED || t.blocked_is_cpu != cpu) continue;
+      if (!best_any || t.priority() < best_any->priority()) best_any = &t;
+      if (!cpu && t.pending_bytes > available) continue;
       if (!best || t.priority() < best->priority()) best = &t;
     }
+    // Starvation valve: if frees keep arriving but never enough for any
+    // parked request (e.g. shuffle churn under a huge blocked alloc), the
+    // system is live so the BUFN watchdog won't escalate — yet the big
+    // request would park forever. Every kFutileFreeBudget-th such event,
+    // wake the best thread anyway so it re-runs the alloc loop (these
+    // courtesy wakes burn their own slow kMaxCourtesyWakes budget toward a
+    // fatal backstop rather than the fast retry cap).
+    if (!best && best_any) {
+      if (++futile_wakes_ >= kFutileFreeBudget) {
+        futile_wakes_ = 0;
+        best = best_any;
+        best->courtesy_wake = true;  // this wake doesn't count toward the cap
+      }
+    }
     if (best) {
+      futile_wakes_ = 0;
       transition(*best, TS_RUNNING, note);
       best->cv.notify_all();
     }
   }
+  int futile_wakes_ = 0;
 
   void wake_bufn_threads_locked(const char* note) {
     for (auto& [tid, t] : threads_) {
@@ -701,10 +805,27 @@ class resource_adaptor {
   //  * all task threads at BUFN                        → highest-priority BUFN
   //    thread gets SPLIT_THROW (halve input & retry).
   void check_and_update_for_bufn_locked(std::unique_lock<std::mutex>&) {
+    // Only *dedicated* task threads gate the deadlock check. A pool/shuffle
+    // thread serving many tasks can churn small transfers forever without
+    // unblocking anyone's big request — treating its RUNNING state as
+    // progress would postpone BUFN escalation indefinitely (observed as a
+    // livelock under shuffle churn). Pool threads are passengers: when the
+    // dedicated threads escalate and roll back, blocked pool threads unblock
+    // with them.
+    // When no dedicated threads exist at all (pool-thread-only workload),
+    // the pool threads must gate and escalate themselves or a blocked set
+    // of them would hang forever.
+    bool has_dedicated = false;
+    for (auto& [tid, t] : threads_)
+      if (!t.is_task_less() && t.is_dedicated) { has_dedicated = true; break; }
+    auto gates = [&](const per_thread& t) {
+      return !t.is_task_less() && (t.is_dedicated || !has_dedicated);
+    };
+
     bool any_task_thread = false;
     bool all_blocked = true;
     for (auto& [tid, t] : threads_) {
-      if (t.is_task_less()) continue;  // shuffle threads don't gate deadlock
+      if (!gates(t)) continue;
       any_task_thread = true;
       if (!t.counts_blocked_for_deadlock()) { all_blocked = false; break; }
     }
@@ -714,7 +835,7 @@ class resource_adaptor {
     per_thread* highest_bufn = nullptr;
     bool all_bufn = true;
     for (auto& [tid, t] : threads_) {
-      if (t.is_task_less()) continue;
+      if (!gates(t)) continue;
       if (t.state == TS_BLOCKED) {
         all_bufn = false;
         if (!lowest_blocked || t.priority() > lowest_blocked->priority())
