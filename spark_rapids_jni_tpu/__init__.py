@@ -35,4 +35,17 @@ from .columnar.column import Column, Table  # noqa: E402
 
 __version__ = "0.1.0"
 
-__all__ = ["DType", "TypeId", "Column", "Table", "__version__"]
+
+def build_info() -> dict:
+    """Build provenance stamped by ``make native`` (reference analog:
+    build-info resource, pom.xml:469-496). Returns version-only when the
+    native libs were built ad hoc at import rather than via the Makefile."""
+    try:
+        from . import _build_info as b
+        return {"version": b.version, "git_sha": b.git_sha,
+                "built_utc": b.built_utc}
+    except ImportError:
+        return {"version": __version__, "git_sha": None, "built_utc": None}
+
+
+__all__ = ["DType", "TypeId", "Column", "Table", "__version__", "build_info"]
