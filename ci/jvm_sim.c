@@ -1,0 +1,214 @@
+/*
+ * JVM-integration round-trip demo (docs/JVM_INTEGRATION.md).
+ *
+ * A plain-C process standing in for a Spark executor's JNI layer: it loads
+ * the engine's shared libraries with dlopen/dlsym exactly as a JVM loads a
+ * native library, passes handles around as int64 (the jlong model — never
+ * dereferenced client-side), and verifies correct bytes come back from
+ * three subsystems:
+ *
+ *   1. resource adaptor: create -> register -> alloc/dealloc -> metrics ->
+ *      destroy through the rm_* ABI (the control plane a Spark executor
+ *      drives per reference RmmSpark.java:59-116)
+ *   2. parquet footer: read_and_filter on real footer bytes (argv), prune to
+ *      one column, re-serialize and check the PAR1 framing + row count
+ *   3. get_json_object: evaluate $.k over a JSON column and compare the
+ *      exact output bytes
+ *
+ * Usage: jvm_sim <libsparkrm.so> <libsparkpq.so> <libsparkjson.so>
+ *                <parquet_file> <expected_rows> <keep_column>
+ * Exit 0 = every byte matched.
+ */
+
+#include <dlfcn.h>
+#include <inttypes.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define DIE(...) do { fprintf(stderr, "jvm_sim: " __VA_ARGS__); \
+                      fprintf(stderr, "\n"); exit(1); } while (0)
+
+typedef int64_t jlong;  /* the JNI handle model */
+
+static void* must_sym(void* lib, const char* name) {
+  void* s = dlsym(lib, name);
+  if (!s) DIE("missing symbol %s", name);
+  return s;
+}
+
+/* ---- 1. resource adaptor ------------------------------------------------ */
+static void drive_rmm(const char* path) {
+  void* lib = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!lib) DIE("dlopen %s: %s", path, dlerror());
+
+  jlong (*create)(long long, const char*) =
+      (jlong (*)(long long, const char*))must_sym(lib, "rm_create");
+  void (*destroy)(jlong) = (void (*)(jlong))must_sym(lib, "rm_destroy");
+  int (*start_task)(jlong, long, long) =
+      (int (*)(jlong, long, long))must_sym(lib, "rm_start_dedicated_task_thread");
+  int (*alloc)(jlong, long, long long) =
+      (int (*)(jlong, long, long long))must_sym(lib, "rm_alloc");
+  int (*dealloc)(jlong, long, long long) =
+      (int (*)(jlong, long, long long))must_sym(lib, "rm_dealloc");
+  int (*remove_assoc)(jlong, long, long) =
+      (int (*)(jlong, long, long))must_sym(lib, "rm_remove_thread_association");
+  int (*task_done)(jlong, long) = (int (*)(jlong, long))must_sym(lib, "rm_task_done");
+  long long (*pool_used)(jlong) = (long long (*)(jlong))must_sym(lib, "rm_pool_used");
+  long long (*pool_limit)(jlong) = (long long (*)(jlong))must_sym(lib, "rm_pool_limit");
+  long long (*metric)(jlong, long, int, int) =
+      (long long (*)(jlong, long, int, int))must_sym(lib, "rm_get_metric");
+
+  jlong h = create(8LL << 20, "");
+  if (!h) DIE("rm_create failed");
+  if (pool_limit(h) != (8LL << 20)) DIE("pool_limit mismatch");
+  if (start_task(h, /*tid=*/42, /*task=*/7) != 0) DIE("register failed");
+  if (alloc(h, 42, 1 << 20) != 0) DIE("alloc failed");
+  if (pool_used(h) != (1 << 20)) DIE("pool_used mismatch after alloc");
+  if (dealloc(h, 42, 1 << 20) != 0) DIE("dealloc failed");
+  if (pool_used(h) != 0) DIE("pool_used mismatch after dealloc");
+  /* metric 4 = max device reserved: the high-water mark must be the 1 MiB */
+  if (metric(h, 7, 4, 1) != (1 << 20)) DIE("max-reserved metric mismatch");
+  if (remove_assoc(h, 42, 7) != 0) DIE("remove failed");
+  if (task_done(h, 7) != 0) DIE("task_done failed");
+  destroy(h);
+  printf("jvm_sim: rmm control plane ok\n");
+}
+
+/* ---- 2. parquet footer -------------------------------------------------- */
+static void drive_footer(const char* path, const char* pq_file,
+                         long long expected_rows, const char* keep_col) {
+  void* lib = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!lib) DIE("dlopen %s: %s", path, dlerror());
+
+  jlong (*read_filter)(const uint8_t*, long, long long, long long,
+                       const char**, const int*, const int*, int, int, int,
+                       char**) =
+      (jlong (*)(const uint8_t*, long, long long, long long, const char**,
+                 const int*, const int*, int, int, int, char**))
+          must_sym(lib, "pqf_read_and_filter");
+  long long (*num_rows)(jlong) = (long long (*)(jlong))must_sym(lib, "pqf_num_rows");
+  int (*num_cols)(jlong) = (int (*)(jlong))must_sym(lib, "pqf_num_columns");
+  int (*serialize)(jlong, uint8_t**, long long*) =
+      (int (*)(jlong, uint8_t**, long long*))must_sym(lib, "pqf_serialize");
+  void (*close)(jlong) = (void (*)(jlong))must_sym(lib, "pqf_close");
+  void (*freep)(void*) = (void (*)(void*))must_sym(lib, "pqf_free");
+
+  /* read the file tail: u32 footer_len + "PAR1" */
+  FILE* f = fopen(pq_file, "rb");
+  if (!f) DIE("open %s failed", pq_file);
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  if (size < 12) DIE("not a parquet file");
+  uint8_t tail[8];
+  fseek(f, size - 8, SEEK_SET);
+  if (fread(tail, 1, 8, f) != 8) DIE("short read");
+  if (memcmp(tail + 4, "PAR1", 4) != 0) DIE("bad magic");
+  uint32_t flen;
+  memcpy(&flen, tail, 4);
+  uint8_t* footer = (uint8_t*)malloc(flen);
+  fseek(f, size - 8 - (long)flen, SEEK_SET);
+  if (fread(footer, 1, flen, f) != flen) DIE("short footer read");
+  fclose(f);
+
+  const char* names[1] = {keep_col};
+  int nchildren[1] = {0};
+  int tags[1] = {0};
+  char* err = NULL;
+  jlong h = read_filter(footer, (long)flen, 0, 1LL << 40, names, nchildren,
+                        tags, 1, 1, 0, &err);
+  free(footer);
+  if (!h) DIE("read_and_filter: %s", err ? err : "?");
+  if (num_rows(h) != expected_rows)
+    DIE("rows: got %lld want %lld", num_rows(h), expected_rows);
+  if (num_cols(h) != 1) DIE("pruned column count: got %d want 1", num_cols(h));
+
+  uint8_t* out = NULL;
+  long long out_len = 0;
+  if (serialize(h, &out, &out_len) != 0) DIE("serialize failed");
+  if (out_len < 12 || memcmp(out, "PAR1", 4) != 0 ||
+      memcmp(out + out_len - 4, "PAR1", 4) != 0)
+    DIE("re-serialized footer is not PAR1-framed");
+  uint32_t inner_len;
+  memcpy(&inner_len, out + out_len - 8, 4);
+  if ((long long)inner_len != out_len - 12) DIE("framing length mismatch");
+  freep(out);
+  close(h);
+  printf("jvm_sim: parquet footer round-trip ok (%lld rows)\n", expected_rows);
+}
+
+/* ---- 3. get_json_object ------------------------------------------------- */
+static void drive_json(const char* path) {
+  void* lib = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!lib) DIE("dlopen %s: %s", path, dlerror());
+
+  int (*eval)(const uint8_t*, const int64_t*, const uint8_t*, long,
+              const uint8_t*, long, uint8_t**, int64_t**, uint8_t**,
+              int64_t*) =
+      (int (*)(const uint8_t*, const int64_t*, const uint8_t*, long,
+               const uint8_t*, long, uint8_t**, int64_t**, uint8_t**,
+               int64_t*))must_sym(lib, "gjo_eval");
+  void (*freep)(void*) = (void (*)(void*))must_sym(lib, "gjo_free");
+
+  const char* rows[3] = {
+      "{\"k\": \"v0\"}", "{\"x\": 1}", "{\"k\": [1, 2]}",
+  };
+  uint8_t data[256];
+  int64_t offsets[4] = {0};
+  for (int i = 0; i < 3; i++) {
+    size_t n = strlen(rows[i]);
+    memcpy(data + offsets[i], rows[i], n);
+    offsets[i + 1] = offsets[i] + (int64_t)n;
+  }
+  /* ops for $.k — two instructions (the engine's PathInstructionJni
+     stream): KEY (no name) then NAMED("k"); each is u8 type, i64 index,
+     i32 name_len, name bytes */
+  uint8_t ops[13 + 14];
+  int64_t idx = -1;
+  int32_t nl0 = 0, nl1 = 1;
+  ops[0] = 2; /* KEY */
+  memcpy(ops + 1, &idx, 8);
+  memcpy(ops + 9, &nl0, 4);
+  ops[13] = 4; /* NAMED */
+  memcpy(ops + 14, &idx, 8);
+  memcpy(ops + 22, &nl1, 4);
+  ops[26] = 'k';
+
+  uint8_t* out_data = NULL;
+  int64_t* out_offsets = NULL;
+  uint8_t* out_valid = NULL;
+  int64_t total = 0;
+  if (eval(data, offsets, NULL, 3, ops, sizeof(ops), &out_data, &out_offsets,
+           &out_valid, &total) != 0)
+    DIE("gjo_eval failed");
+  /* Spark semantics: $.k of row0 -> v0 (unquoted), row1 -> null,
+     row2 -> [1,2] raw */
+  const char* want[3] = {"v0", NULL, "[1,2]"};
+  for (int i = 0; i < 3; i++) {
+    if (want[i] == NULL) {
+      if (out_valid[i]) DIE("row %d: expected null", i);
+      continue;
+    }
+    if (!out_valid[i]) DIE("row %d: unexpectedly null", i);
+    int64_t b0 = out_offsets[i], b1 = out_offsets[i + 1];
+    if ((int64_t)strlen(want[i]) != b1 - b0 ||
+        memcmp(out_data + b0, want[i], (size_t)(b1 - b0)) != 0)
+      DIE("row %d: got '%.*s' want '%s'", i, (int)(b1 - b0), out_data + b0,
+          want[i]);
+  }
+  freep(out_data);
+  freep(out_offsets);
+  freep(out_valid);
+  printf("jvm_sim: get_json_object bytes ok\n");
+}
+
+int main(int argc, char** argv) {
+  if (argc != 7)
+    DIE("usage: jvm_sim <librm> <libpq> <libjson> <parquet> <rows> <col>");
+  drive_rmm(argv[1]);
+  drive_footer(argv[2], argv[4], atoll(argv[5]), argv[6]);
+  drive_json(argv[3]);
+  printf("jvm_sim: all round-trips ok\n");
+  return 0;
+}

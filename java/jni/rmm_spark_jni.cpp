@@ -1,0 +1,222 @@
+// JNI shim: com.sparkrapids.tpu.RmmSparkJni -> the rm_* C ABI
+// (native/resource_adaptor.cpp). Mechanical marshalling only — handles pass
+// as jlong, status codes return unchanged (the Java side maps them to the
+// exception taxonomy, RetryOOM.throwForStatus). Mirrors the capability of
+// the reference's SparkResourceAdaptorJni.cpp:1803-2171 at ~1/20 the code
+// because the native core already speaks a C ABI.
+//
+// Build (requires a JDK; this repo's CI image has none — see
+// docs/JVM_INTEGRATION.md "What is proven here"):
+//   g++ -std=c++17 -O2 -fPIC -shared -I$JAVA_HOME/include \
+//       -I$JAVA_HOME/include/linux -o libsparkrm_jni.so \
+//       java/jni/rmm_spark_jni.cpp native/resource_adaptor.cpp -lpthread
+
+#include <jni.h>
+
+#include <string>
+
+extern "C" {
+void* rm_create(long long pool_bytes, const char* log_path);
+void rm_destroy(void* h);
+int rm_start_dedicated_task_thread(void* h, long tid, long task);
+int rm_pool_thread_working_on_task(void* h, long tid, long task);
+int rm_pool_thread_finished_for_tasks(void* h, long tid, const long* tasks,
+                                      int n);
+int rm_start_shuffle_thread(void* h, long tid);
+int rm_remove_thread_association(void* h, long tid, long task);
+int rm_task_done(void* h, long task);
+int rm_start_retry_block(void* h, long tid);
+int rm_end_retry_block(void* h, long tid);
+int rm_force_oom(void* h, long tid, int kind, int num, int mode, int skip);
+int rm_alloc(void* h, long tid, long long bytes);
+int rm_dealloc(void* h, long tid, long long bytes);
+int rm_block_thread_until_ready(void* h, long tid);
+int rm_cpu_prealloc(void* h, long tid, long long bytes, int blocking);
+int rm_cpu_postalloc_success(void* h, long tid, long long bytes);
+int rm_cpu_postalloc_failed(void* h, long tid, int was_oom, int blocking);
+int rm_cpu_dealloc(void* h, long tid, long long bytes);
+int rm_submitting_to_pool(void* h, long tid, int flag);
+int rm_waiting_on_pool(void* h, long tid, int flag);
+int rm_check_and_break_deadlocks(void* h);
+int rm_get_state_of(void* h, long tid);
+long long rm_get_metric(void* h, long task, int which, int reset);
+long long rm_pool_used(void* h);
+long long rm_pool_limit(void* h);
+}
+
+namespace {
+inline void* H(jlong handle) { return reinterpret_cast<void*>(handle); }
+}
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_create(JNIEnv* env, jclass,
+                                            jlong pool_bytes, jstring log_loc) {
+  const char* loc = log_loc ? env->GetStringUTFChars(log_loc, nullptr) : "";
+  void* h = rm_create(pool_bytes, loc);
+  if (log_loc) env->ReleaseStringUTFChars(log_loc, loc);
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT void JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_destroy(JNIEnv*, jclass, jlong h) {
+  rm_destroy(H(h));
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_startDedicatedTaskThread(
+    JNIEnv*, jclass, jlong h, jlong tid, jlong task) {
+  return rm_start_dedicated_task_thread(H(h), (long)tid, (long)task);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_poolThreadWorkingOnTask(
+    JNIEnv*, jclass, jlong h, jlong tid, jlong task) {
+  return rm_pool_thread_working_on_task(H(h), (long)tid, (long)task);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_poolThreadFinishedForTasks(
+    JNIEnv* env, jclass, jlong h, jlong tid, jlongArray task_ids) {
+  if (task_ids == nullptr) {
+    env->ThrowNew(env->FindClass("java/lang/NullPointerException"),
+                  "taskIds must not be null");
+    return -1;
+  }
+  jsize n = env->GetArrayLength(task_ids);
+  jlong* ids = env->GetLongArrayElements(task_ids, nullptr);
+  // jlong is 64-bit; the C ABI takes C longs (64-bit on linux64)
+  int rc = rm_pool_thread_finished_for_tasks(
+      H(h), (long)tid, reinterpret_cast<const long*>(ids), (int)n);
+  env->ReleaseLongArrayElements(task_ids, ids, JNI_ABORT);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_startShuffleThread(JNIEnv*, jclass,
+                                                        jlong h, jlong tid) {
+  return rm_start_shuffle_thread(H(h), (long)tid);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_removeThreadAssociation(
+    JNIEnv*, jclass, jlong h, jlong tid, jlong task) {
+  return rm_remove_thread_association(H(h), (long)tid, (long)task);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_taskDone(JNIEnv*, jclass, jlong h,
+                                              jlong task) {
+  return rm_task_done(H(h), (long)task);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_startRetryBlock(JNIEnv*, jclass, jlong h,
+                                                     jlong tid) {
+  return rm_start_retry_block(H(h), (long)tid);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_endRetryBlock(JNIEnv*, jclass, jlong h,
+                                                   jlong tid) {
+  return rm_end_retry_block(H(h), (long)tid);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_forceOom(JNIEnv*, jclass, jlong h,
+                                              jlong tid, jint kind, jint num,
+                                              jint mode, jint skip) {
+  return rm_force_oom(H(h), (long)tid, kind, num, mode, skip);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_alloc(JNIEnv*, jclass, jlong h, jlong tid,
+                                           jlong bytes) {
+  return rm_alloc(H(h), (long)tid, bytes);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_dealloc(JNIEnv*, jclass, jlong h,
+                                             jlong tid, jlong bytes) {
+  return rm_dealloc(H(h), (long)tid, bytes);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_blockThreadUntilReady(JNIEnv*, jclass,
+                                                           jlong h, jlong tid) {
+  return rm_block_thread_until_ready(H(h), (long)tid);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_cpuPrealloc(JNIEnv*, jclass, jlong h,
+                                                 jlong tid, jlong bytes,
+                                                 jboolean blocking) {
+  return rm_cpu_prealloc(H(h), (long)tid, bytes, blocking ? 1 : 0);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_cpuPostallocSuccess(JNIEnv*, jclass,
+                                                         jlong h, jlong tid,
+                                                         jlong bytes) {
+  return rm_cpu_postalloc_success(H(h), (long)tid, bytes);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_cpuPostallocFailed(JNIEnv*, jclass,
+                                                        jlong h, jlong tid,
+                                                        jboolean was_oom,
+                                                        jboolean blocking) {
+  return rm_cpu_postalloc_failed(H(h), (long)tid, was_oom ? 1 : 0,
+                                 blocking ? 1 : 0);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_cpuDealloc(JNIEnv*, jclass, jlong h,
+                                                jlong tid, jlong bytes) {
+  return rm_cpu_dealloc(H(h), (long)tid, bytes);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_submittingToPool(JNIEnv*, jclass, jlong h,
+                                                      jlong tid,
+                                                      jboolean flag) {
+  return rm_submitting_to_pool(H(h), (long)tid, flag ? 1 : 0);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_waitingOnPool(JNIEnv*, jclass, jlong h,
+                                                   jlong tid, jboolean flag) {
+  return rm_waiting_on_pool(H(h), (long)tid, flag ? 1 : 0);
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_checkAndBreakDeadlocks(JNIEnv*, jclass,
+                                                            jlong h) {
+  return rm_check_and_break_deadlocks(H(h));
+}
+
+JNIEXPORT jint JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_getStateOf(JNIEnv*, jclass, jlong h,
+                                                jlong tid) {
+  return rm_get_state_of(H(h), (long)tid);
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_getMetric(JNIEnv*, jclass, jlong h,
+                                               jlong task, jint which,
+                                               jboolean reset) {
+  return rm_get_metric(H(h), (long)task, which, reset ? 1 : 0);
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_poolUsed(JNIEnv*, jclass, jlong h) {
+  return rm_pool_used(H(h));
+}
+
+JNIEXPORT jlong JNICALL
+Java_com_sparkrapids_tpu_RmmSparkJni_poolLimit(JNIEnv*, jclass, jlong h) {
+  return rm_pool_limit(H(h));
+}
+
+}  // extern "C"

@@ -1,0 +1,63 @@
+/*
+ * OOM exception taxonomy + status-code mapping for the JVM facade.
+ * Capability parity with the reference's GpuOOM/GpuRetryOOM/
+ * GpuSplitAndRetryOOM/CpuRetryOOM/CpuSplitAndRetryOOM classes; status codes
+ * are the rm_status enum shared with native/resource_adaptor.cpp and with
+ * the python twin (memory/exceptions.py — the three front ends share one
+ * contract, including the inheritance shape: the *retryable* exceptions
+ * extend the fatal base, never the reverse, so `catch (TpuOOM)` means
+ * "any device OOM" while retry loops catch the leaf types only).
+ */
+package com.sparkrapids.tpu;
+
+public final class RetryOOM {
+  private RetryOOM() {}
+
+  /** Fatal device-memory OOM — not retryable. */
+  public static class TpuOOM extends RuntimeException {
+    public TpuOOM(String msg) { super(msg); }
+  }
+
+  /** Roll back to a spillable state and retry (device domain). */
+  public static final class TpuRetryOOM extends TpuOOM {
+    public TpuRetryOOM(String msg) { super(msg); }
+  }
+
+  /** Split the input and retry (device domain). */
+  public static final class TpuSplitAndRetryOOM extends TpuOOM {
+    public TpuSplitAndRetryOOM(String msg) { super(msg); }
+  }
+
+  /** Base for host off-heap OOMs. */
+  public static class OffHeapOOM extends RuntimeException {
+    public OffHeapOOM(String msg) { super(msg); }
+  }
+
+  public static final class CpuRetryOOM extends OffHeapOOM {
+    public CpuRetryOOM(String msg) { super(msg); }
+  }
+
+  public static final class CpuSplitAndRetryOOM extends OffHeapOOM {
+    public CpuSplitAndRetryOOM(String msg) { super(msg); }
+  }
+
+  /** The task was purged while one of its threads was blocked. */
+  public static final class TaskRemoved extends RuntimeException {
+    public TaskRemoved(String msg) { super(msg); }
+  }
+
+  /** rm_status → exception (RM_OK = 0 returns normally). */
+  static void throwForStatus(int status, String context) {
+    switch (status) {
+      case 0: return;
+      case 1: throw new TpuRetryOOM(context);
+      case 2: throw new TpuSplitAndRetryOOM(context);
+      case 3: throw new CpuRetryOOM(context);
+      case 4: throw new CpuSplitAndRetryOOM(context);
+      case 5: throw new TpuOOM(context);
+      case 6: throw new IllegalStateException("injected exception: " + context);
+      case 7: throw new TaskRemoved(context);
+      default: throw new IllegalStateException("status " + status + ": " + context);
+    }
+  }
+}

@@ -1,0 +1,158 @@
+/*
+ * Static facade over the resource adaptor — the entry point a Spark
+ * executor uses. Capability parity with the reference's RmmSpark.java
+ * (thread/task registration :131-236, retry-block bracketing :242-274,
+ * blockThreadUntilReady :417-428, per-task metrics :533-590); the python
+ * twin with identical semantics is memory/rmm_spark.py::RmmSpark.
+ *
+ * The thread id passed down is the JVM thread id (the reference uses the
+ * native OS thread id; any process-unique long works — the state machine
+ * only needs identity).
+ */
+package com.sparkrapids.tpu;
+
+public final class RmmSpark {
+  private static SparkResourceAdaptor adaptor;
+
+  // metric selectors shared with the native side (rm_get_metric)
+  private static final int METRIC_RETRY = 0;
+  private static final int METRIC_SPLIT_RETRY = 1;
+  private static final int METRIC_BLOCK_TIME = 2;
+  private static final int METRIC_LOST_TIME = 3;
+  private static final int METRIC_MAX_RESERVED = 4;
+
+  private RmmSpark() {}
+
+  public static synchronized void setEventHandler(long poolBytes, String logLoc) {
+    if (adaptor != null) {
+      throw new IllegalStateException("event handler already installed");
+    }
+    adaptor = new SparkResourceAdaptor(poolBytes, logLoc, 100);
+  }
+
+  public static synchronized void clearEventHandler() {
+    if (adaptor != null) {
+      adaptor.close();
+      adaptor = null;
+    }
+  }
+
+  private static synchronized SparkResourceAdaptor adp() {
+    if (adaptor == null) {
+      throw new IllegalStateException("RmmSpark event handler is not installed");
+    }
+    return adaptor;
+  }
+
+  public static long getCurrentThreadId() {
+    return Thread.currentThread().getId();
+  }
+
+  private static void check(int status, String what) {
+    RetryOOM.throwForStatus(status, what);
+  }
+
+  // -- registration ---------------------------------------------------------
+
+  public static void currentThreadIsDedicatedToTask(long taskId) {
+    check(RmmSparkJni.startDedicatedTaskThread(
+        adp().getHandle(), getCurrentThreadId(), taskId), "register");
+  }
+
+  public static void shuffleThreadWorkingOnTasks(long[] taskIds) {
+    long h = adp().getHandle();
+    long tid = getCurrentThreadId();
+    check(RmmSparkJni.startShuffleThread(h, tid), "startShuffleThread");
+    for (long t : taskIds) {
+      check(RmmSparkJni.poolThreadWorkingOnTask(h, tid, t), "poolThreadWorking");
+    }
+  }
+
+  public static void poolThreadFinishedForTasks(long[] taskIds) {
+    check(RmmSparkJni.poolThreadFinishedForTasks(
+        adp().getHandle(), getCurrentThreadId(), taskIds), "poolThreadFinished");
+  }
+
+  public static void removeCurrentThreadAssociation(long taskId) {
+    check(RmmSparkJni.removeThreadAssociation(
+        adp().getHandle(), getCurrentThreadId(), taskId), "removeAssociation");
+  }
+
+  public static void taskDone(long taskId) {
+    check(RmmSparkJni.taskDone(adp().getHandle(), taskId), "taskDone");
+  }
+
+  // -- device reservations --------------------------------------------------
+
+  public static void alloc(long bytes) {
+    check(RmmSparkJni.alloc(adp().getHandle(), getCurrentThreadId(), bytes),
+        "device reservation of " + bytes + " bytes");
+  }
+
+  public static void dealloc(long bytes) {
+    check(RmmSparkJni.dealloc(adp().getHandle(), getCurrentThreadId(), bytes),
+        "dealloc");
+  }
+
+  public static void blockThreadUntilReady() {
+    check(RmmSparkJni.blockThreadUntilReady(
+        adp().getHandle(), getCurrentThreadId()), "blockThreadUntilReady");
+  }
+
+  public static void startRetryBlock() {
+    check(RmmSparkJni.startRetryBlock(
+        adp().getHandle(), getCurrentThreadId()), "startRetryBlock");
+  }
+
+  public static void endRetryBlock() {
+    check(RmmSparkJni.endRetryBlock(
+        adp().getHandle(), getCurrentThreadId()), "endRetryBlock");
+  }
+
+  // -- pool-wait markers (python twin: rmm_spark.py submitting/waiting) -----
+  // Mark cross-thread dependencies (dedicated thread handing work to a pool
+  // and waiting on it) so checkAndBreakDeadlocks can see the cycle.
+
+  public static void submittingToPool() {
+    check(RmmSparkJni.submittingToPool(
+        adp().getHandle(), getCurrentThreadId(), true), "submittingToPool");
+  }
+
+  public static void waitingOnPool() {
+    check(RmmSparkJni.waitingOnPool(
+        adp().getHandle(), getCurrentThreadId(), true), "waitingOnPool");
+  }
+
+  public static void doneWaiting() {
+    long h = adp().getHandle();
+    long tid = getCurrentThreadId();
+    check(RmmSparkJni.submittingToPool(h, tid, false), "doneWaiting");
+    check(RmmSparkJni.waitingOnPool(h, tid, false), "doneWaiting");
+  }
+
+  // -- metrics --------------------------------------------------------------
+
+  public static long getAndResetNumRetry(long taskId) {
+    return RmmSparkJni.getMetric(adp().getHandle(), taskId, METRIC_RETRY, true);
+  }
+
+  public static long getAndResetNumSplitRetry(long taskId) {
+    return RmmSparkJni.getMetric(adp().getHandle(), taskId, METRIC_SPLIT_RETRY, true);
+  }
+
+  public static long getAndResetBlockTimeNs(long taskId) {
+    return RmmSparkJni.getMetric(adp().getHandle(), taskId, METRIC_BLOCK_TIME, true);
+  }
+
+  public static long getAndResetComputeTimeLostToRetryNs(long taskId) {
+    return RmmSparkJni.getMetric(adp().getHandle(), taskId, METRIC_LOST_TIME, true);
+  }
+
+  public static long getAndResetMaxDeviceReserved(long taskId) {
+    return RmmSparkJni.getMetric(adp().getHandle(), taskId, METRIC_MAX_RESERVED, true);
+  }
+
+  public static long poolUsed() {
+    return RmmSparkJni.poolUsed(adp().getHandle());
+  }
+}

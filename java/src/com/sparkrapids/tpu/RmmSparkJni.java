@@ -1,0 +1,51 @@
+/*
+ * JNI binding declarations for the native resource adaptor C ABI
+ * (native/resource_adaptor.cpp). Capability parity with the reference's
+ * SparkResourceAdaptorJni surface (reference: RmmSpark.java:59-116 handle
+ * model); the implementation lives in java/jni/rmm_spark_jni.cpp.
+ *
+ * Status-code contract: every call returns an int from the rm_status enum;
+ * RmmSpark maps non-zero codes to the exception taxonomy. Handles are
+ * jlongs wrapping the native pointer, never dereferenced on the JVM side.
+ */
+package com.sparkrapids.tpu;
+
+final class RmmSparkJni {
+  static {
+    System.loadLibrary("sparkrm_jni");
+  }
+
+  private RmmSparkJni() {}
+
+  static native long create(long poolBytes, String logLoc);
+  static native void destroy(long handle);
+
+  static native int startDedicatedTaskThread(long handle, long tid, long taskId);
+  static native int poolThreadWorkingOnTask(long handle, long tid, long taskId);
+  static native int poolThreadFinishedForTasks(long handle, long tid, long[] taskIds);
+  static native int startShuffleThread(long handle, long tid);
+  static native int removeThreadAssociation(long handle, long tid, long taskId);
+  static native int taskDone(long handle, long taskId);
+
+  static native int startRetryBlock(long handle, long tid);
+  static native int endRetryBlock(long handle, long tid);
+  static native int forceOom(long handle, long tid, int kind, int num, int mode, int skip);
+
+  static native int alloc(long handle, long tid, long bytes);
+  static native int dealloc(long handle, long tid, long bytes);
+  static native int blockThreadUntilReady(long handle, long tid);
+
+  static native int cpuPrealloc(long handle, long tid, long bytes, boolean blocking);
+  static native int cpuPostallocSuccess(long handle, long tid, long bytes);
+  static native int cpuPostallocFailed(long handle, long tid, boolean wasOom, boolean blocking);
+  static native int cpuDealloc(long handle, long tid, long bytes);
+
+  static native int submittingToPool(long handle, long tid, boolean flag);
+  static native int waitingOnPool(long handle, long tid, boolean flag);
+
+  static native int checkAndBreakDeadlocks(long handle);
+  static native int getStateOf(long handle, long tid);
+  static native long getMetric(long handle, long taskId, int which, boolean reset);
+  static native long poolUsed(long handle);
+  static native long poolLimit(long handle);
+}

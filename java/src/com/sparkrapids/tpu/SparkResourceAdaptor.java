@@ -1,0 +1,73 @@
+/*
+ * Owns the native resource-adaptor handle and the deadlock-watchdog daemon.
+ * Capability parity with the reference's SparkResourceAdaptor.java:35-79
+ * (100 ms watchdog polling checkAndBreakDeadlocks); the python twin is
+ * memory/rmm_spark.py::SparkResourceAdaptor — both front the same C ABI.
+ */
+package com.sparkrapids.tpu;
+
+public final class SparkResourceAdaptor implements AutoCloseable {
+  private volatile long handle;
+  private final Thread watchdog;
+  private volatile boolean closed;
+
+  public SparkResourceAdaptor(long poolBytes, String logLoc, long watchdogMillis) {
+    handle = RmmSparkJni.create(poolBytes, logLoc == null ? "" : logLoc);
+    if (handle == 0) {
+      throw new IllegalStateException("failed to create native resource adaptor");
+    }
+    watchdog = new Thread(() -> {
+      while (!closed) {
+        long h = handle;
+        if (h != 0) {
+          RmmSparkJni.checkAndBreakDeadlocks(h);
+        }
+        try {
+          Thread.sleep(watchdogMillis);
+        } catch (InterruptedException e) {
+          Thread.currentThread().interrupt();
+          return;
+        }
+      }
+    }, "rmm-spark-watchdog");
+    watchdog.setDaemon(true);
+    watchdog.start();
+  }
+
+  long getHandle() {
+    long h = handle;
+    if (h == 0) {
+      throw new IllegalStateException("resource adaptor is closed");
+    }
+    return h;
+  }
+
+  /**
+   * Lifecycle contract (same as the reference and the python twin): the
+   * caller must quiesce every task before closing — taskDone()/
+   * removeCurrentThreadAssociation() for all registered threads, so no
+   * thread is blocked inside a native call when the handle is destroyed.
+   * close() guards the one native caller it owns (the watchdog); it cannot
+   * see foreign threads parked in rm_block_thread_until_ready, and
+   * destroying under them would be a use-after-free.
+   */
+  @Override
+  public synchronized void close() {
+    // join the watchdog fully before destroying the handle: destroying while
+    // it may still be inside checkAndBreakDeadlocks would be a use-after-free
+    closed = true;
+    if (Thread.currentThread() != watchdog) {
+      watchdog.interrupt();
+      try {
+        watchdog.join();
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+      }
+    }
+    long h = handle;
+    handle = 0;
+    if (h != 0) {
+      RmmSparkJni.destroy(h);
+    }
+  }
+}

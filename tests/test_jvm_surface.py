@@ -1,0 +1,105 @@
+"""JVM integration surface (docs/JVM_INTEGRATION.md).
+
+Round-trip proof for VERDICT item #6: a non-Python host process — a plain-C
+stand-in for a Spark executor's JNI layer — dlopens the engine's shared
+libraries, drives them through jlong-shaped handles, and checks exact bytes
+for the resource adaptor control plane, the Parquet footer round-trip, and
+a get_json_object evaluation. Also sanity-checks that the committed Java
+facade and JNI shim stay in sync with the C ABI they bind.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "spark_rapids_jni_tpu", "_native")
+
+
+def _ensure_native():
+    # the loaders build on first use; force all three we need
+    from spark_rapids_jni_tpu.memory import native as rm
+    from spark_rapids_jni_tpu.ops import get_json_object as gjo
+    from spark_rapids_jni_tpu.parquet import footer
+
+    rm.load()
+    footer._load()
+    gjo._load()
+    return (os.path.join(NATIVE, "libsparkrm.so"),
+            os.path.join(NATIVE, "libsparkpq.so"),
+            os.path.join(NATIVE, "libsparkjson.so"))
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_jvm_sim_round_trips(tmp_path):
+    librm, libpq, libjson = _ensure_native()
+
+    # a parquet file the "executor" will push through the footer path
+    t = pa.table({
+        "a": pa.array(np.arange(1234, dtype=np.int64)),
+        "b": pa.array([f"s{i}" for i in range(1234)]),
+    })
+    pq_file = str(tmp_path / "exec.parquet")
+    pq.write_table(t, pq_file)
+
+    exe = str(tmp_path / "jvm_sim")
+    build = subprocess.run(
+        ["gcc", "-O2", "-o", exe, os.path.join(REPO, "ci", "jvm_sim.c"),
+         "-ldl"],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    run = subprocess.run(
+        [exe, librm, libpq, libjson, pq_file, "1234", "b"],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, f"{run.stdout}\n{run.stderr}"
+    assert "rmm control plane ok" in run.stdout
+    assert "parquet footer round-trip ok (1234 rows)" in run.stdout
+    assert "get_json_object bytes ok" in run.stdout
+    assert "all round-trips ok" in run.stdout
+
+
+def _native_methods(java_src: str):
+    return set(re.findall(r"static native \w+(?:\[\])? (\w+)\(", java_src))
+
+
+def _jni_impls(cpp_src: str):
+    return set(re.findall(r"Java_com_sparkrapids_tpu_RmmSparkJni_(\w+)\(",
+                          cpp_src))
+
+
+def test_java_facade_and_jni_shim_in_sync():
+    """Every `static native` method declared by RmmSparkJni.java must have a
+    JNI implementation, and vice versa (the build would catch this with a
+    JDK; without one this keeps the committed sources honest)."""
+    with open(os.path.join(REPO, "java", "src", "com", "sparkrapids", "tpu",
+                           "RmmSparkJni.java")) as f:
+        declared = _native_methods(f.read())
+    with open(os.path.join(REPO, "java", "jni", "rmm_spark_jni.cpp")) as f:
+        implemented = _jni_impls(f.read())
+    assert declared, "no native methods found in RmmSparkJni.java"
+    assert declared == implemented, (
+        f"missing impls: {declared - implemented}; "
+        f"orphan impls: {implemented - declared}")
+
+
+def test_jni_shim_binds_real_abi_symbols():
+    """Every rm_* symbol the JNI shim declares must exist in the built
+    resource-adaptor library (ABI drift guard)."""
+    import ctypes
+
+    librm, _, _ = _ensure_native()
+    lib = ctypes.CDLL(librm)
+    with open(os.path.join(REPO, "java", "jni", "rmm_spark_jni.cpp")) as f:
+        src = f.read()
+    externs = set(re.findall(r"^(?:int|void\*?|long long) (rm_\w+)\(", src,
+                             re.M))
+    assert externs, "no extern rm_* declarations found in the shim"
+    for sym in externs:
+        assert hasattr(lib, sym), f"shim binds {sym} but the .so lacks it"
