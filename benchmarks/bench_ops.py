@@ -75,14 +75,24 @@ def bench_row_conversion(rows: int, with_strings: bool):
                               dt.BOOL8),
         ]
         if with_strings:
-            strs = [f"string-{(i + s) % 1000:04d}" for i in range(rows)]
-            cols.append(Column.from_pylist(strs, dt.STRING))
+            # realistic string data: bounded cardinality, normal lengths,
+            # short runs (utils/datagen — uniform data overstates throughput)
+            from spark_rapids_jni_tpu.utils.datagen import (
+                ColumnProfile, Dist, generate_column)
+            cols.append(generate_column(rows, ColumnProfile(
+                dt.STRING, string_len=Dist("normal", 0, 32),
+                cardinality=1000, null_frequency=None), seed=s))
         tables.append(Table(tuple(cols)))
-    nbytes = rows * (8 + 4 + 8 + 1) + (rows * 11 if with_strings else 0)
+    str_bytes = (int(tables[0].columns[-1].data.size)
+                 if with_strings else 0)
+    nbytes = rows * (8 + 4 + 8 + 1) + str_bytes
     dtypes = [c.dtype for c in tables[0].columns]
 
     batches = convert_to_rows(tables[0])
-    sec = _time(lambda i: convert_to_rows(tables[i % _NVARIANTS]))
+    # warm every variant: datagen variants have distinct buffer shapes, so a
+    # single warmup would leave variant 1's compile inside the timed loop
+    sec = _time(lambda i: convert_to_rows(tables[i % _NVARIANTS]),
+                warmup=_NVARIANTS)
     back = convert_from_rows(batches[0], dtypes)
     assert back.columns[0].size == rows
     return sec, nbytes
@@ -99,7 +109,8 @@ def bench_bloom_filter(rows: int):
     ]
     filt = bf.bloom_filter_create(num_hashes=3, num_longs=max(64, rows // 16))
     filt = bf.bloom_filter_put(filt, keysets[0])
-    sec = _time(lambda i: bf.bloom_filter_probe(keysets[i % _NVARIANTS], filt))
+    sec = _time(lambda i: bf.bloom_filter_probe(keysets[i % _NVARIANTS], filt),
+                warmup=_NVARIANTS)
     return sec, rows * 8
 
 
@@ -114,7 +125,8 @@ def bench_cast_string_to_float(rows: int):
         strs = [f"{v:.6f}" for v in vals]
         cols.append(Column.from_pylist(strs, dt.STRING))
         nbytes = sum(len(x) for x in strs)
-    sec = _time(lambda i: string_to_float(cols[i % _NVARIANTS], dt.FLOAT64))
+    sec = _time(lambda i: string_to_float(cols[i % _NVARIANTS], dt.FLOAT64),
+                warmup=_NVARIANTS)
     return sec, nbytes
 
 
@@ -136,15 +148,21 @@ def bench_groupby(rows: int):
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column, Table
     from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.utils.datagen import (
+        ColumnProfile, Dist, generate_column)
     tables = []
     for s in range(_NVARIANTS):
-        rng = np.random.default_rng(s)
-        k = Column.from_numpy(
-            rng.integers(0, max(2, rows // 100), rows), dt.INT64)
-        v = Column.from_numpy(rng.integers(-1000, 1000, rows), dt.INT64)
+        k = generate_column(rows, ColumnProfile(
+            dt.INT64, dist=Dist("geometric", 0, max(2, rows // 100)),
+            cardinality=max(2, rows // 100), avg_run_length=4,
+            null_frequency=None), seed=s)
+        v = generate_column(rows, ColumnProfile(
+            dt.INT64, dist=Dist("uniform", -1000, 1000), cardinality=0,
+            avg_run_length=1, null_frequency=None), seed=100 + s)
         tables.append(Table((k, v)))
     sec = _time(lambda i: groupby_aggregate(
-        tables[i % _NVARIANTS], [0], [(1, "sum"), (1, "count"), (1, "mean")]))
+        tables[i % _NVARIANTS], [0], [(1, "sum"), (1, "count"), (1, "mean")]),
+        warmup=_NVARIANTS)
     return sec, rows * 16
 
 
@@ -163,7 +181,8 @@ def bench_join(rows: int):
             rng.permutation(np.arange(nr + nr // 3, dtype=np.int64))[:nr],
             dt.INT64)
         sides.append(([lk], [rk]))
-    sec = _time(lambda i: inner_join(*sides[i % _NVARIANTS]))
+    sec = _time(lambda i: inner_join(*sides[i % _NVARIANTS]),
+                warmup=_NVARIANTS)
     return sec, rows * 8 + nr * 8
 
 
@@ -177,7 +196,8 @@ def bench_sort(rows: int):
                                               dtype=np.int64), dt.INT64),))
         for s in range(_NVARIANTS)
     ]
-    sec = _time(lambda i: sort_table(tables[i % _NVARIANTS], [0]))
+    sec = _time(lambda i: sort_table(tables[i % _NVARIANTS], [0]),
+                warmup=_NVARIANTS)
     return sec, rows * 8
 
 

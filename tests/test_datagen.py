@@ -1,0 +1,129 @@
+"""Distribution-controlled benchmark data generator
+(utils/datagen.py — analog of the reference generate_input.cu profiles)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.dtype import DType, TypeId
+from spark_rapids_jni_tpu.utils.datagen import (
+    GEOMETRIC,
+    NORMAL,
+    UNIFORM,
+    ColumnProfile,
+    Dist,
+    generate_column,
+    generate_table,
+)
+
+N = 4000
+
+
+def test_seed_determinism():
+    p = ColumnProfile(dt.INT64)
+    a = generate_column(N, p, seed=42)
+    b = generate_column(N, p, seed=42)
+    c = generate_column(N, p, seed=43)
+    assert a.to_pylist() == b.to_pylist()
+    assert a.to_pylist() != c.to_pylist()
+
+
+def test_null_frequency():
+    col = generate_column(N, ColumnProfile(dt.INT32, null_frequency=0.25),
+                          seed=1)
+    frac = col.null_count() / N
+    assert 0.18 < frac < 0.32
+    col2 = generate_column(N, ColumnProfile(dt.INT32, null_frequency=None),
+                           seed=1)
+    assert col2.null_count() == 0
+
+
+def test_cardinality_bounds_distinct_values():
+    col = generate_column(
+        N, ColumnProfile(dt.INT64, cardinality=17, null_frequency=None),
+        seed=2)
+    distinct = set(col.to_pylist())
+    assert len(distinct) <= 17
+    unbounded = generate_column(
+        N, ColumnProfile(dt.INT64, cardinality=0, null_frequency=None,
+                         avg_run_length=1), seed=2)
+    assert len(set(unbounded.to_pylist())) > 1000
+
+
+def test_avg_run_length_creates_runs():
+    col = generate_column(
+        N, ColumnProfile(dt.INT64, avg_run_length=8, null_frequency=None,
+                         cardinality=0), seed=3)
+    vals = np.array(col.to_pylist())
+    runs = 1 + int(np.count_nonzero(vals[1:] != vals[:-1]))
+    observed_arl = N / runs
+    assert 4 < observed_arl < 16
+    norun = generate_column(
+        N, ColumnProfile(dt.INT64, avg_run_length=1, null_frequency=None,
+                         cardinality=0), seed=3)
+    v2 = np.array(norun.to_pylist())
+    assert N / (1 + int(np.count_nonzero(v2[1:] != v2[:-1]))) < 1.1
+
+
+def test_distributions_shape():
+    lo, hi = 0, 1000
+    geo = generate_column(
+        N, ColumnProfile(dt.INT32, dist=Dist(GEOMETRIC, lo, hi),
+                         null_frequency=None, cardinality=0,
+                         avg_run_length=1), seed=4)
+    uni = generate_column(
+        N, ColumnProfile(dt.INT32, dist=Dist(UNIFORM, lo, hi),
+                         null_frequency=None, cardinality=0,
+                         avg_run_length=1), seed=4)
+    g = np.array(geo.to_pylist())
+    u = np.array(uni.to_pylist())
+    assert g.min() >= lo and g.max() <= hi
+    assert u.min() >= lo and u.max() <= hi
+    # geometric concentrates near the lower bound
+    assert np.median(g) < np.median(u) / 2
+    nrm = np.array(generate_column(
+        N, ColumnProfile(dt.FLOAT64, dist=Dist(NORMAL, -100, 100),
+                         null_frequency=None, cardinality=0,
+                         avg_run_length=1), seed=4).to_pylist())
+    assert abs(np.mean(nrm)) < 10
+    assert (np.abs(nrm) <= 100).all()
+
+
+def test_string_profile():
+    col = generate_column(
+        N, ColumnProfile(dt.STRING, string_len=Dist(NORMAL, 4, 20),
+                         cardinality=50, null_frequency=0.1), seed=5)
+    vals = [v for v in col.to_pylist() if v is not None]
+    assert len(set(vals)) <= 50
+    lengths = np.array([len(v) for v in vals])
+    assert lengths.min() >= 4 and lengths.max() <= 20
+    assert col.null_count() > 0
+
+
+def test_bool_probability():
+    col = generate_column(
+        N, ColumnProfile(dt.BOOL8, bool_probability=0.9,
+                         null_frequency=None, avg_run_length=1), seed=6)
+    frac = sum(1 for v in col.to_pylist() if v) / N
+    assert frac > 0.8
+
+
+@pytest.mark.parametrize("dtype", [
+    dt.INT8, dt.INT16, dt.INT32, dt.INT64, dt.UINT32, dt.UINT64,
+    dt.FLOAT32, dt.FLOAT64, dt.TIMESTAMP_DAYS, dt.TIMESTAMP_MICROSECONDS,
+    DType(TypeId.DECIMAL64, 2), DType(TypeId.DECIMAL128, 4),
+])
+def test_dtype_coverage(dtype):
+    col = generate_column(500, ColumnProfile(dtype), seed=7)
+    assert col.size == 500
+    assert col.dtype == dtype
+    vals = col.to_pylist()
+    assert any(v is not None for v in vals)
+
+
+def test_generate_table_columns_differ():
+    t = generate_table(100, [ColumnProfile(dt.INT64, null_frequency=None),
+                             ColumnProfile(dt.INT64, null_frequency=None)],
+                       seed=9)
+    assert t.num_columns == 2
+    assert t[0].to_pylist() != t[1].to_pylist()
