@@ -13,10 +13,12 @@ from .distributed import (
     distributed_inner_join,
     distributed_sort,
 )
+from .task_executor import TaskExecutor
 
 __all__ = [
     "hash_partition_exchange",
     "distributed_groupby",
     "distributed_inner_join",
     "distributed_sort",
+    "TaskExecutor",
 ]
