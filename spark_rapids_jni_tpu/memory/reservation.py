@@ -34,7 +34,7 @@ _tls = threading.local()
 
 def reservations_active() -> bool:
     """True when the calling thread's device work is governed by RmmSpark."""
-    if RmmSpark._adaptor is None:
+    if not RmmSpark.is_installed():
         return False
     state = RmmSpark.get_state_of(RmmSpark.get_current_thread_id())
     return state != ThreadState.UNKNOWN

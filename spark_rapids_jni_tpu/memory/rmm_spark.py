@@ -176,6 +176,13 @@ class RmmSpark:
                 pool_bytes, log_loc, watchdog_period_s)
 
     @classmethod
+    def is_installed(cls) -> bool:
+        """True when an event handler (adaptor) is installed — the public
+        predicate for optional-governance callers (reservation brackets,
+        TaskExecutor)."""
+        return cls._adaptor is not None
+
+    @classmethod
     def clear_event_handler(cls) -> None:
         with cls._lock:
             if cls._adaptor is not None:

@@ -17,6 +17,7 @@ is what the reference's native tests do.
 
 from __future__ import annotations
 
+import threading as _threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Sequence, Tuple
 
@@ -170,6 +171,116 @@ def load_zones(zone_ids: Sequence[str]) -> TransitionTable:
 
 # kept for callers that only need the modern fixed offset
 load_fixed_offset_zones = load_zones
+
+
+class TimeZoneDB:
+    """Lazy transition-table cache with async loading.
+
+    Protocol parity with GpuTimeZoneDB.java:88-176: ``cache_async`` kicks a
+    daemon loader thread (no-op if a load is already in flight or shutdown
+    was ever called); ``cache`` blocks — waiting on an in-flight async load
+    instead of loading twice; ``shutdown`` waits for any in-flight load and
+    permanently disables the cache. ``table_for`` is the consumer entry:
+    cache hit → no lock contention, miss → blocking load.
+
+    The cache is keyed by the sorted zone-id tuple (the reference caches one
+    whole-database table; here the loadable universe is call-defined because
+    DST-rule zones are rejected, GpuTimeZoneDB.java:236-240).
+    """
+
+    _cond = _threading.Condition()
+    _loading_keys: set = set()          # keys with a load in flight
+    _shutdown = False
+    _tables: Dict[Tuple[str, ...], TransitionTable] = {}
+
+    @classmethod
+    def _load_and_publish(cls, key: Tuple[str, ...], swallow: bool = False):
+        try:
+            table = load_zones(list(key))
+            with cls._cond:
+                cls._tables[key] = table
+        except Exception:
+            if not swallow:
+                raise
+            # async loader: log and die quietly (GpuTimeZoneDB logs at :107)
+            import logging
+            logging.getLogger(__name__).exception(
+                "timezone transition cache load failed for %s", key)
+        finally:
+            with cls._cond:
+                cls._loading_keys.discard(key)
+                cls._cond.notify_all()
+
+    @classmethod
+    def cache_async(cls, zone_ids: Sequence[str]) -> None:
+        """GpuTimeZoneDB.cacheDatabaseAsync:88-122. The in-flight guard is
+        per key (the reference has a single whole-database key; here keys
+        are call-defined, so loads of distinct keys proceed concurrently
+        and are never silently dropped)."""
+        key = tuple(sorted(zone_ids))
+        with cls._cond:
+            if cls._shutdown or key in cls._loading_keys \
+                    or key in cls._tables:
+                return
+            cls._loading_keys.add(key)
+        t = _threading.Thread(target=cls._load_and_publish, args=(key, True),
+                              name="tpu-timezone-database-0", daemon=True)
+        t.start()
+
+    @classmethod
+    def cache(cls, zone_ids: Sequence[str]) -> None:
+        """GpuTimeZoneDB.cacheDatabase:124-156 — blocking; joins an
+        in-flight load of the same key rather than loading twice."""
+        key = tuple(sorted(zone_ids))
+        with cls._cond:
+            while key in cls._loading_keys:
+                cls._cond.wait()
+            if cls._shutdown:
+                raise RuntimeError("TimeZoneDB was shut down")
+            if key in cls._tables:
+                return
+            cls._loading_keys.add(key)
+        cls._load_and_publish(key)
+
+    @classmethod
+    def table_for(cls, zone_ids: Sequence[str]) -> TransitionTable:
+        """Consumer entry: cached table or lazy blocking load."""
+        key = tuple(sorted(zone_ids))
+        with cls._cond:
+            t = cls._tables.get(key)
+        if t is not None:
+            return t
+        cls.cache(zone_ids)
+        with cls._cond:
+            t = cls._tables.get(key)
+            if t is None:
+                # a concurrent shutdown() cleared the cache between the load
+                # and this read
+                raise RuntimeError("TimeZoneDB was shut down")
+            return t
+
+    @classmethod
+    def is_loaded(cls, zone_ids: Sequence[str]) -> bool:
+        with cls._cond:
+            return tuple(sorted(zone_ids)) in cls._tables
+
+    @classmethod
+    def shutdown(cls) -> None:
+        """GpuTimeZoneDB.shutdown:158-176 — wait for in-flight loads, then
+        disable and drop the cache permanently."""
+        with cls._cond:
+            cls._shutdown = True
+            while cls._loading_keys:
+                cls._cond.wait()
+            cls._tables.clear()
+            cls._cond.notify_all()
+
+    @classmethod
+    def _reset_for_tests(cls) -> None:
+        with cls._cond:
+            cls._shutdown = False
+            cls._loading_keys.clear()
+            cls._tables.clear()
 
 
 def _convert(col: Column, table: TransitionTable, tz_index: int,

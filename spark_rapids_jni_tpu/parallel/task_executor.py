@@ -119,7 +119,7 @@ class TaskExecutor:
                 raise RuntimeError("TaskExecutor is closed")
             w = self._workers.get(task_id)
             if w is None:
-                register = RmmSpark._adaptor is not None
+                register = RmmSpark.is_installed()
                 w = _TaskWorker(task_id, register)
                 self._workers[task_id] = w
             # enqueue under the lock: a concurrent task_done()/close() could
@@ -139,8 +139,7 @@ class TaskExecutor:
             if w is None:
                 return
             w.stop()
-        if w.join(timeout) and self._mark_done \
-                and RmmSpark._adaptor is not None:
+        if w.join(timeout) and self._mark_done and RmmSpark.is_installed():
             try:
                 RmmSpark.task_done(task_id)
             except RuntimeError:
@@ -154,8 +153,7 @@ class TaskExecutor:
             for w in workers.values():
                 w.stop()
         for task_id, w in workers.items():
-            if w.join(timeout) and self._mark_done \
-                    and RmmSpark._adaptor is not None:
+            if w.join(timeout) and self._mark_done and RmmSpark.is_installed():
                 try:
                     RmmSpark.task_done(task_id)
                 except RuntimeError:

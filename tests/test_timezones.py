@@ -144,3 +144,81 @@ def test_overlap_transition_uses_offset_before():
     got = convert_timestamp_to_utc(c, tb, 0).to_pylist()
     assert got[0] == local_in_overlap - before
     assert got[1] == local_past - after
+
+
+class TestTimeZoneDBCache:
+    """Lazy cache + async load protocol (GpuTimeZoneDB.java:88-176)."""
+
+    def setup_method(self):
+        from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+        TimeZoneDB._reset_for_tests()
+
+    teardown_method = setup_method
+
+    def test_blocking_cache_and_hit(self):
+        from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+        zones = ["Asia/Kolkata"]
+        assert not TimeZoneDB.is_loaded(zones)
+        TimeZoneDB.cache(zones)
+        assert TimeZoneDB.is_loaded(zones)
+        t1 = TimeZoneDB.table_for(zones)
+        t2 = TimeZoneDB.table_for(zones)
+        assert t1 is t2  # cache hit returns the same table, no reload
+
+    def test_async_load_then_consume(self):
+        import time
+
+        from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+        zones = ["Asia/Kolkata"]
+        TimeZoneDB.cache_async(zones)
+        deadline = time.monotonic() + 10
+        while not TimeZoneDB.is_loaded(zones):
+            assert time.monotonic() < deadline, "async load never finished"
+            time.sleep(0.005)
+        assert TimeZoneDB.table_for(zones).num_zones == 1
+
+    def test_concurrent_blocking_waits_for_inflight(self):
+        import threading
+
+        from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+        zones = ["Asia/Kolkata"]
+        errs = []
+
+        def worker():
+            try:
+                TimeZoneDB.cache(zones)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        assert TimeZoneDB.is_loaded(zones)
+
+    def test_shutdown_disables_cache(self):
+        import pytest
+
+        from spark_rapids_jni_tpu.ops.timezones import TimeZoneDB
+        TimeZoneDB.cache(["Asia/Kolkata"])
+        TimeZoneDB.shutdown()
+        assert not TimeZoneDB.is_loaded(["Asia/Kolkata"])  # dropped
+        with pytest.raises(RuntimeError, match="shut down"):
+            TimeZoneDB.cache(["Asia/Kolkata"])
+        # async after shutdown is a silent no-op (reference :90-93)
+        TimeZoneDB.cache_async(["Asia/Kolkata"])
+        assert not TimeZoneDB.is_loaded(["Asia/Kolkata"])
+
+    def test_conversion_through_cached_table(self):
+        from spark_rapids_jni_tpu.ops.timezones import (
+            TimeZoneDB,
+            convert_utc_timestamp_to_timezone,
+        )
+        from spark_rapids_jni_tpu.columnar import dtype as dt
+        from spark_rapids_jni_tpu.columnar.column import Column
+        table = TimeZoneDB.table_for(["Asia/Kolkata"])
+        col = Column.from_pylist([1_600_000_000], dt.TIMESTAMP_SECONDS)
+        out = convert_utc_timestamp_to_timezone(col, table, 0)
+        assert out.to_pylist() == [1_600_000_000 + 19800]  # +05:30
