@@ -383,25 +383,23 @@ class resource_adaptor {
       account_thrown_retry_locked(t, true);
       return RM_SPLIT_AND_RETRY_OOM;
     }
-    // Clears pending_bytes iff the thread record still exists — a task-
-    // removed unwind (TS_REMOVE_THROW gate) erases the map node, and writing
-    // through the old reference would be a use-after-free.
-    auto clear_pending = [&]() {
-      auto it2 = threads_.find(tid);
-      if (it2 != threads_.end()) it2->second.pending_bytes = 0;
-    };
     while (true) {
       per_thread& t = threads_.at(tid);
-      t.pending_bytes = bytes;  // lets the waker skip threads that can't fit
+      t.pending_bytes = bytes;  // lets wakers skip threads that can't fit
       int rc = pre_alloc_locked(lk, t, /*is_for_cpu=*/false);
-      if (rc != RM_OK) { clear_pending(); return rc; }
+      // On error returns pending_bytes is deliberately left in place (no
+      // write — the TS_REMOVE_THROW gate may have erased the record, and a
+      // write-through would be a use-after-free): a thread unwinding with
+      // RetryOOM re-enters via block_thread_until_ready, where the size
+      // lets the BUFN waker know whether freed memory fits it.
+      if (rc != RM_OK) return rc;
       if (try_reserve_locked(&t, bytes)) {
         post_alloc_success_locked(t, bytes);
         t.pending_bytes = 0;
         return RM_OK;
       }
       rc = post_alloc_failed_locked(lk, t, /*was_oom=*/true, /*cpu=*/false);
-      if (rc != RM_OK) { clear_pending(); return rc; }
+      if (rc != RM_OK) return rc;
     }
   }
 
@@ -421,6 +419,11 @@ class resource_adaptor {
     for (auto& [id, t] : threads_)
       if (t.state == TS_ALLOC) transition(t, TS_ALLOC_FREE, "dealloc");
     wake_next_highest_priority_blocked_locked(false, "dealloc");
+    // BUFN threads hold nothing and wait for "progress"; freed memory IS
+    // progress (a lone task that rolled back everything would otherwise sit
+    // in BUFN over an empty pool until the watchdog force-splits it). Wake
+    // the best BUFN thread whose remembered request now fits.
+    wake_bufn_that_fits_locked("dealloc");
     return RM_OK;
   }
 
@@ -723,8 +726,16 @@ class resource_adaptor {
           account_thrown_retry_locked(t, false);
           return t.blocked_is_cpu ? RM_CPU_RETRY_OOM : RM_RETRY_OOM;
         case TS_BUFN_WAIT:
-          // The thread rolled back to a spillable state and re-entered: now
-          // it waits for another task to make progress.
+          // The thread rolled back to a spillable state and re-entered. Its
+          // own rollback may already have freed enough (the frees land
+          // before the park, so no waker can catch them): if the remembered
+          // request now fits, resume instead of waiting.
+          if (!t.blocked_is_cpu && t.pending_bytes > 0 &&
+              t.pending_bytes <= pool_limit_ - pool_used_) {
+            transition(t, TS_RUNNING, "bufn_wait_fits");
+            return RM_OK;
+          }
+          // Otherwise wait for another task to make progress.
           transition(t, TS_BUFN, "bufn_wait_to_bufn");
           check_and_update_for_bufn_locked(lk);
           // Re-check: escalation may have already picked us for a split.
@@ -788,6 +799,21 @@ class resource_adaptor {
     }
   }
   int futile_wakes_ = 0;
+
+  void wake_bufn_that_fits_locked(const char* note) {
+    int64_t available = pool_limit_ - pool_used_;
+    per_thread* best = nullptr;
+    for (auto& [tid, t] : threads_) {
+      if (t.state != TS_BUFN) continue;
+      if (t.blocked_is_cpu) continue;  // device frees can't help a host block
+      if (t.pending_bytes > available) continue;  // 0 (unknown) always fits
+      if (!best || t.priority() < best->priority()) best = &t;
+    }
+    if (best) {
+      transition(*best, TS_RUNNING, note);
+      best->cv.notify_all();
+    }
+  }
 
   void wake_bufn_threads_locked(const char* note) {
     for (auto& [tid, t] : threads_) {

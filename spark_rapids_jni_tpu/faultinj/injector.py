@@ -75,8 +75,8 @@ class _Rule:
 
 class FaultInjector:
     def __init__(self, config_path: Optional[str] = None, seed: int = None):
-        self._path = config_path or os.environ.get(
-            "FAULT_INJECTOR_CONFIG_PATH")
+        from ..utils import config as _config
+        self._path = config_path or _config.get("faultinj.config") or None
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._rules: Dict[str, _Rule] = {}

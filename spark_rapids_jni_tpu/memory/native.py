@@ -52,7 +52,8 @@ def load() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        override = os.environ.get("SRJT_NATIVE_SO_OVERRIDE")
+        from ..utils import config
+        override = config.get("native.so_override")
         if override:
             lib = ctypes.CDLL(override)
         else:

@@ -77,7 +77,10 @@ class SparkResourceAdaptor:
     """
 
     def __init__(self, pool_bytes: int, log_loc: Optional[str] = None,
-                 watchdog_period_s: float = 0.1):
+                 watchdog_period_s: Optional[float] = None):
+        if watchdog_period_s is None:
+            from ..utils import config
+            watchdog_period_s = float(config.get("rmm.watchdog_period_s"))
         self._lib = native.load()
         loc = (log_loc or "").encode()
         self._handle = self._lib.rm_create(pool_bytes, loc)
@@ -165,10 +168,17 @@ class RmmSpark:
     # -- lifecycle -----------------------------------------------------------
 
     @classmethod
-    def set_event_handler(cls, pool_bytes: int,
+    def set_event_handler(cls, pool_bytes: Optional[int] = None,
                           log_loc: Optional[str] = None,
-                          watchdog_period_s: float = 0.1) -> None:
-        """Install the adaptor (reference RmmSpark.setEventHandler :59-116)."""
+                          watchdog_period_s: Optional[float] = None) -> None:
+        """Install the adaptor (reference RmmSpark.setEventHandler :59-116).
+        ``pool_bytes`` defaults to the ``rmm.pool_bytes`` config flag."""
+        if pool_bytes is None:
+            from ..utils import config
+            pool_bytes = int(config.get("rmm.pool_bytes"))
+            if pool_bytes <= 0:
+                raise ValueError(
+                    "pool_bytes not given and rmm.pool_bytes config unset")
         with cls._lock:
             if cls._adaptor is not None:
                 raise RuntimeError("event handler already installed")

@@ -1,0 +1,117 @@
+"""Central config/flag system.
+
+Capability parity with the reference's flag surface: JVM system properties
+(``ai.rapids.cudf.nvtx.enabled``, ``ai.rapids.cudf.spark.rmmWatchdogPollingPeriod``,
+RmmSpark pool knobs) plus build-time options (pom.xml profiles). One typed
+registry, each entry resolving programmatic override → environment variable
+→ default, so every tunable in the engine is discoverable in one place and
+tests can scope overrides without mutating the process environment.
+
+Usage::
+
+    from spark_rapids_jni_tpu.utils import config
+    config.get("trace.enabled")            # -> bool
+    with config.override("parquet.chunk_byte_budget", 1 << 20):
+        ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    return s not in ("0", "", "false", "False", "no")
+
+
+@dataclass(frozen=True)
+class _Entry:
+    key: str
+    env: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+
+_REGISTRY: Dict[str, _Entry] = {}
+_overrides: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _register(key: str, env: str, default: Any, parse, doc: str):
+    _REGISTRY[key] = _Entry(key, env, default, parse, doc)
+
+
+# ---- the flag surface (one line per tunable; reference analog in doc) ------
+_register("trace.enabled", "SPARK_RAPIDS_TPU_TRACE", False, _parse_bool,
+          "xprof trace annotations on ops (ref: ai.rapids.cudf.nvtx.enabled)")
+_register("rmm.watchdog_period_s", "SRJT_RMM_WATCHDOG_PERIOD_S", 0.1, float,
+          "deadlock watchdog poll period "
+          "(ref: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod, 100ms)")
+_register("rmm.pool_bytes", "SRJT_RMM_POOL_BYTES", 0, int,
+          "default HBM reservation pool size; 0 = caller must pass one")
+_register("parquet.chunk_byte_budget", "SRJT_PARQUET_CHUNK_BYTES", 128 << 20,
+          int, "row-group batching budget for the chunked reader")
+_register("native.so_override", "SRJT_NATIVE_SO_OVERRIDE", "", str,
+          "load a prebuilt resource-adaptor .so instead of building "
+          "(sanitizer tier, ci/sanitize.sh)")
+_register("faultinj.config", "FAULT_INJECTOR_CONFIG_PATH", "", str,
+          "fault-injection JSON config path (ref: cufaultinj LD_PRELOAD arg)")
+_register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
+          "input variants cycled by benchmarks to defeat identical-args "
+          "elision")
+
+
+def get(key: str) -> Any:
+    """Resolve: programmatic override → environment → default."""
+    e = _REGISTRY[key]
+    with _lock:
+        if key in _overrides:
+            return _overrides[key]
+    raw = os.environ.get(e.env)
+    if raw is not None:
+        return e.parse(raw)
+    return e.default
+
+
+def set(key: str, value: Any) -> None:  # noqa: A001 - mirrors JVM setProperty
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown config key {key!r}")
+    with _lock:
+        _overrides[key] = value
+
+
+def unset(key: str) -> None:
+    with _lock:
+        _overrides.pop(key, None)
+
+
+@contextlib.contextmanager
+def override(key: str, value: Any):
+    """Scoped override (tests)."""
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown config key {key!r}")
+    with _lock:
+        had = key in _overrides
+        old = _overrides.get(key)
+        _overrides[key] = value
+    try:
+        yield
+    finally:
+        with _lock:
+            if had:
+                _overrides[key] = old
+            else:
+                _overrides.pop(key, None)
+
+
+def describe() -> Dict[str, Dict[str, Any]]:
+    """The whole flag surface with current values (discoverability)."""
+    return {
+        k: {"env": e.env, "default": e.default, "value": get(k), "doc": e.doc}
+        for k, e in sorted(_REGISTRY.items())
+    }

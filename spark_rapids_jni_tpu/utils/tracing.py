@@ -19,11 +19,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 
 
 def tracing_enabled() -> bool:
-    return os.environ.get("SPARK_RAPIDS_TPU_TRACE", "0") not in ("0", "", "false")
+    from . import config
+    return bool(config.get("trace.enabled"))
 
 
 @contextlib.contextmanager

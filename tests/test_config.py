@@ -1,0 +1,76 @@
+"""Config/flag system (utils/config.py — analog of the reference's
+ai.rapids.cudf.* system properties surface)."""
+
+import os
+
+import pytest
+
+from spark_rapids_jni_tpu.utils import config
+
+
+def test_default_and_env_resolution(monkeypatch):
+    monkeypatch.delenv("SRJT_PARQUET_CHUNK_BYTES", raising=False)
+    assert config.get("parquet.chunk_byte_budget") == 128 << 20
+    monkeypatch.setenv("SRJT_PARQUET_CHUNK_BYTES", "4096")
+    assert config.get("parquet.chunk_byte_budget") == 4096
+
+
+def test_programmatic_override_beats_env(monkeypatch):
+    monkeypatch.setenv("SRJT_RMM_WATCHDOG_PERIOD_S", "0.5")
+    config.set("rmm.watchdog_period_s", 0.01)
+    try:
+        assert config.get("rmm.watchdog_period_s") == 0.01
+    finally:
+        config.unset("rmm.watchdog_period_s")
+    assert config.get("rmm.watchdog_period_s") == 0.5
+
+
+def test_scoped_override_restores():
+    base = config.get("bench.variants")
+    with config.override("bench.variants", 7):
+        assert config.get("bench.variants") == 7
+    assert config.get("bench.variants") == base
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        config.set("no.such.key", 1)
+    with pytest.raises(KeyError):
+        with config.override("no.such.key", 1):
+            pass
+
+
+def test_bool_parsing(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_TRACE", "1")
+    assert config.get("trace.enabled") is True
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_TRACE", "false")
+    assert config.get("trace.enabled") is False
+
+
+def test_describe_covers_all_flags():
+    d = config.describe()
+    assert "trace.enabled" in d and "rmm.watchdog_period_s" in d
+    for k, info in d.items():
+        assert info["doc"], f"{k} has no doc"
+        assert info["env"].isupper()
+
+
+def test_consumers_resolve_through_config(monkeypatch):
+    # tracing
+    from spark_rapids_jni_tpu.utils.tracing import tracing_enabled
+    with config.override("trace.enabled", True):
+        assert tracing_enabled()
+    # chunked reader default budget
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.parquet import ParquetReader
+    t = pa.table({"x": pa.array(np.arange(2000, dtype=np.int64))})
+    path = "/tmp/cfg_budget.parquet"
+    pq.write_table(t, path, row_group_size=100)
+    with config.override("parquet.chunk_byte_budget", 1):
+        with ParquetReader(path) as r:
+            chunks = list(r.iter_chunks())
+    assert len(chunks) == 20  # one row group per chunk under a 1-byte budget
+    os.remove(path)

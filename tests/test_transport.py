@@ -1,0 +1,105 @@
+"""Host↔device transport + spillable buffers (memory/transport.py —
+VERDICT r1 rows 3/37: spillable-buffer model and explicit transfer layer)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.memory.exceptions import TpuRetryOOM
+from spark_rapids_jni_tpu.memory.retry import with_retry
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.memory.transport import (
+    SpillableTable,
+    SpillStore,
+    to_device,
+    to_host,
+)
+from spark_rapids_jni_tpu.ops.sort import sort_table
+
+MB = 1 << 20
+
+
+def _table(rows=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table((
+        Column.from_numpy(rng.integers(0, 100, rows), dt.INT64),
+        Column.from_numpy(rng.standard_normal(rows), dt.FLOAT64),
+        Column.from_pylist([None if i % 7 == 0 else f"s{i % 50}"
+                            for i in range(rows)], dt.STRING),
+    ))
+
+
+def test_round_trip_is_exact():
+    t = _table()
+    back = to_device(to_host(t))
+    for orig, rt in zip(t.columns, back.columns):
+        assert orig.to_pylist() == rt.to_pylist()
+
+
+def test_float64_bits_survive_round_trip():
+    vals = [0.5, -0.0, float("nan"), float("inf"), 1e-320]  # subnormal too
+    c = Column.from_pylist(vals, dt.FLOAT64)
+    rt = to_device(to_host(c))
+    assert np.asarray(rt.data).tolist() == np.asarray(c.data).tolist()
+
+
+def test_spill_and_promote():
+    st = SpillableTable(_table())
+    dev_bytes = st.device_nbytes
+    assert dev_bytes > 0 and not st.is_spilled
+    freed = st.spill()
+    assert freed == dev_bytes
+    assert st.is_spilled and st.device_nbytes == 0
+    assert st.spill() == 0  # idempotent
+    t = st.get()  # promotes
+    assert not st.is_spilled
+    assert t.columns[0].to_pylist() == _table().columns[0].to_pylist()
+    # promoted data is usable by device ops
+    assert sort_table(t, [0]).num_rows == t.num_rows
+
+
+def test_spill_store_spills_oldest_first():
+    store = SpillStore()
+    a = store.register(_table(seed=1))
+    b = store.register(_table(seed=2))
+    need = a.device_nbytes  # one table's worth
+    freed = store.spill_to_fit(need)
+    assert freed >= need
+    assert a.is_spilled and not b.is_spilled  # oldest spilled first
+    assert store.spill_all() > 0
+    assert b.is_spilled
+    assert store.device_bytes() == 0
+
+
+def test_rollback_spills_and_retry_succeeds():
+    """The TpuRetryOOM contract end-to-end: a task holding spillable state
+    retries after its rollback released HBM reservations."""
+    RmmSpark.set_event_handler(pool_bytes=4 * MB, watchdog_period_s=0.01)
+    try:
+        RmmSpark.current_thread_is_dedicated_to_task(1)
+        store = SpillStore()
+        held = []
+
+        def attempt(nbytes):
+            RmmSpark.alloc(nbytes)
+            held.append(nbytes)
+            return nbytes
+
+        def rollback():
+            store.spill_all()
+            while held:
+                RmmSpark.dealloc(held.pop())
+
+        # hold 3 MB, then ask for 3 MB more: must roll back to fit
+        st = store.register(_table())
+        with_retry(attempt, 3 * MB, rollback=rollback)
+        res = with_retry(attempt, 3 * MB, rollback=rollback)
+        assert res == [3 * MB]
+        assert st.is_spilled  # the rollback actually spilled
+        rollback()
+        assert RmmSpark.pool_used() == 0
+    finally:
+        RmmSpark.remove_current_thread_association()
+        RmmSpark.task_done(1)
+        RmmSpark.clear_event_handler()
