@@ -53,7 +53,16 @@ def _time(fn, warmup=1, iters=3):
     return (time.perf_counter() - t0) / iters
 
 
-_NVARIANTS = 2  # input variants cycled to defeat identical-args elision
+# Input variants cycled to defeat identical-args elision; refreshed from the
+# ``bench.variants`` config flag at main() so env/overrides set before the
+# run take effect (clamped to >= 1 — zero variants would index nothing).
+_NVARIANTS = 2
+
+
+def _refresh_variants() -> None:
+    global _NVARIANTS
+    from spark_rapids_jni_tpu.utils import config
+    _NVARIANTS = max(1, int(config.get("bench.variants")))
 
 
 def bench_row_conversion(rows: int, with_strings: bool):
@@ -259,6 +268,7 @@ def main():
                              "cast_string_to_float", "parse_uri", "groupby",
                              "join", "sort", "parquet_decode"])
     args = ap.parse_args()
+    _refresh_variants()
     _ensure_backend()
 
     runs = []

@@ -9,6 +9,7 @@ change can never silently break the bench again.
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -34,10 +35,17 @@ def test_f64_bits_rejects_raw_floats():
 
 def test_bench_py_emits_json_line():
     """Run the actual bench.py script end-to-end (tiny iteration count is not
-    configurable, so keep this as the one slow-ish smoke)."""
+    configurable, so keep this as the one slow-ish smoke). Pinned to CPU so
+    the suite's greenness never depends on TPU-tunnel health — the invariant
+    this guards (bench.py must run against the live column layout) is
+    backend-independent; the driver runs the TPU version."""
+    # PYTHONPATH cleared as well: the container's sitecustomize (reached via
+    # PYTHONPATH) registers the axon TPU plugin, which can hang on a dead
+    # tunnel even when JAX_PLATFORMS=cpu
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
     proc = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,
-        cwd=__file__.rsplit("/", 2)[0], timeout=600)
+        cwd=__file__.rsplit("/", 2)[0], timeout=600, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = proc.stdout.strip().splitlines()[-1]
     rec = json.loads(line)
