@@ -147,6 +147,7 @@ struct per_thread {
   bool      is_dedicated = true;  // false ⇒ pool thread serving many tasks
   std::set<long> pool_task_ids;   // tasks a pool thread currently serves
 
+  bool      is_shuffle = false;      // registered via start_shuffle_thread
   int       state = TS_RUNNING;
   bool      blocked_is_cpu = false;  // domain of the outstanding blocked alloc
   int       retry_loops = 0;         // blocked-and-rewoken loops since success
@@ -173,8 +174,11 @@ struct per_thread {
 
   // Lower tuple sorts first = higher priority. Older (lower-id) tasks win;
   // task-less threads (shuffle) outrank every task (reference thread_priority
-  // :136-190).
+  // :136-190). Shuffle threads keep top priority even while attached to
+  // tasks (reference: is_for_shuffle threads keep task_id -1, only
+  // non-shuffle pool threads take their lowest attached task's priority).
   std::pair<long, long> priority() const {
+    if (is_shuffle) return {-1, thread_id};
     long t = task_id;
     if (!is_dedicated && !pool_task_ids.empty())
       t = *pool_task_ids.begin();
@@ -225,6 +229,7 @@ class resource_adaptor {
     t.thread_id = tid;
     t.task_id = task_id;
     t.is_dedicated = true;
+    t.is_shuffle = false;  // a reused record must not keep shuffle priority
     if (t.state == TS_UNKNOWN) t.state = TS_RUNNING;
     log_op("start_dedicated", tid, tid, task_id, t.state, t.state, "");
     return RM_OK;
@@ -257,6 +262,7 @@ class resource_adaptor {
     t.thread_id = tid;
     t.task_id = -1;
     t.is_dedicated = false;
+    t.is_shuffle = true;
     if (t.state == TS_UNKNOWN) t.state = TS_RUNNING;
     log_op("start_shuffle", tid, tid, -1, t.state, t.state, "");
     return RM_OK;

@@ -416,9 +416,13 @@ class ParquetReader:
                 admit()
             while futures:
                 done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                # ship every completed leaf (dropping its host buffers)
+                # BEFORE admitting replacements, so resident decoded bytes
+                # never exceed ~workers leaves
                 for fut in done:
                     i, leaf = futures.pop(fut)
                     cols[i] = ship(leaf, fut.result())
+                for _ in range(len(done)):
                     admit()
         return Table(tuple(cols))
 

@@ -445,3 +445,45 @@ def test_metrics_lost_compute_time(adaptor):
         t.do(RmmSpark.task_done, 1).result()
     finally:
         t.stop()
+
+
+def test_shuffle_thread_outranks_tasks_in_wakeups():
+    """Reference parity (SparkResourceAdaptorJni.cpp:136-146): a shuffle
+    thread keeps top wake priority even while attached to tasks, so when a
+    free makes room for exactly one waiter, the shuffle thread wins over an
+    older dedicated task thread."""
+    RmmSpark.set_event_handler(pool_bytes=8 * MB, watchdog_period_s=10.0)
+    holder, shuffle, task = TaskThread("holder"), TaskThread("shuf"), \
+        TaskThread("task")
+    try:
+        # the dedicated waiter is on an OLDER task (1) than any task the
+        # shuffle thread serves ([2, 3]): without the is_shuffle rule the
+        # shuffle thread's priority would be its lowest attached task (2)
+        # and the dedicated thread would win — so this test discriminates
+        # the shuffle-outranks-all behavior, not mere task ordering
+        holder.do(RmmSpark.current_thread_is_dedicated_to_task, 4).result()
+        shuffle.do(RmmSpark.shuffle_thread_working_on_tasks, [2, 3]).result()
+        task.do(RmmSpark.current_thread_is_dedicated_to_task, 1).result()
+
+        holder.do(RmmSpark.alloc, 6 * MB).result()
+        # both waiters want 5 MB; only 2 MB free -> both block
+        f_shuffle = shuffle.do(RmmSpark.alloc, 5 * MB)
+        wait_for_state(shuffle.tid, ThreadState.BLOCKED)
+        f_task = task.do(RmmSpark.alloc, 5 * MB)
+        wait_for_state(task.tid, ThreadState.BLOCKED)
+
+        # free 6 MB: 8 MB available fits exactly one 5 MB waiter; the wake
+        # policy must pick the shuffle thread over the dedicated task thread
+        holder.do(RmmSpark.dealloc, 6 * MB).result()
+        assert f_shuffle.result(5.0) is None  # alloc returned
+        # 3 MB remain < 5 MB; the dedicated thread must still be waiting
+        assert RmmSpark.get_state_of(task.tid) == ThreadState.BLOCKED
+        assert not f_task.done()
+        shuffle.do(RmmSpark.dealloc, 5 * MB).result()
+        assert f_task.result(5.0) is None
+        task.do(RmmSpark.dealloc, 5 * MB).result()
+        assert RmmSpark.pool_used() == 0
+    finally:
+        for t in (holder, shuffle, task):
+            t.stop()
+        RmmSpark.clear_event_handler()
