@@ -67,6 +67,15 @@ int gjo_eval(const uint8_t* data, const int64_t* offsets,
              long ops_len, uint8_t** out_data, int64_t** out_offsets,
              uint8_t** out_valid, int64_t* out_total);
 void gjo_free(void* p);
+
+// parse_uri.cpp
+int puri_parse(const uint8_t* data, const int64_t* offsets,
+               const uint8_t* valid_in, long n_rows, int part,
+               const uint8_t* key_data, const int64_t* key_offsets,
+               const uint8_t* key_valid, int key_broadcast,
+               uint8_t** out_data, int64_t** out_offsets,
+               uint8_t** out_valid, int64_t* out_total);
+void puri_free(void* p);
 }
 
 namespace {
@@ -268,6 +277,74 @@ void fuzz_gjo() {
   }
 }
 
+void fuzz_parse_uri() {
+  static const char* frags[] = {
+      "http", "://", ":", "/", "//", "?", "#", "@", "%41", "%z", "%",
+      "[", "]", "::", "a.b.com", "1.2.3.4", "[::1%eth0]", "-x-", "k=v&r=",
+      "\xc3\xa9", "\xe2\x80\xa8", "\x7f", "\xff", "\xc0\xaf", " ", "~",
+  };
+  std::vector<std::string> rows;
+  for (int i = 0; i < 64; i++) {
+    std::string s;
+    int n = (int)(rnd() % 10);
+    for (int k = 0; k < n; k++)
+      s += frags[rnd() % (sizeof(frags) / sizeof(frags[0]))];
+    // raw byte mutations on top of the fragment soup
+    if (!s.empty() && rnd() % 3 == 0) s[rnd() % s.size()] = (char)(rnd() & 0xFF);
+    rows.push_back(std::move(s));
+  }
+  std::string data;
+  std::vector<int64_t> offsets{0};
+  for (auto& r : rows) {
+    data += r;
+    offsets.push_back((int64_t)data.size());
+  }
+  // row validity mask (some rows null) — exercises the null-skip path
+  std::vector<uint8_t> valid(rows.size());
+  for (auto& v : valid) v = (uint8_t)(rnd() % 4 != 0);
+
+  // per-row key column (key_broadcast=0) with its own nulls, plus the
+  // single-literal broadcast form — both index paths fuzzed
+  std::string key_blob;
+  std::vector<int64_t> key_offs{0};
+  std::vector<uint8_t> key_valid(rows.size());
+  static const char* keys[] = {"k", "q", "", "absent", "=", "&"};
+  for (size_t r = 0; r < rows.size(); r++) {
+    key_blob += keys[rnd() % (sizeof(keys) / sizeof(keys[0]))];
+    key_offs.push_back((int64_t)key_blob.size());
+    key_valid[r] = (uint8_t)(rnd() % 5 != 0);
+  }
+  int64_t lit_offs[2] = {0, 1};
+  const char* lit = "k";
+
+  for (int part = 0; part <= 2; part++) {
+    for (int key_mode = 0; key_mode < 3; key_mode++) {  // none/literal/column
+      if (part != 2 && key_mode != 0) continue;
+      uint8_t* out_data = nullptr;
+      int64_t* out_offsets = nullptr;
+      uint8_t* out_valid = nullptr;
+      int64_t total = 0;
+      const uint8_t* kd = key_mode == 1 ? (const uint8_t*)lit
+                          : key_mode == 2 ? (const uint8_t*)key_blob.data()
+                                          : nullptr;
+      const int64_t* ko = key_mode == 1 ? lit_offs
+                          : key_mode == 2 ? key_offs.data()
+                                          : nullptr;
+      const uint8_t* kv = key_mode == 2 ? key_valid.data() : nullptr;
+      int rc = puri_parse((const uint8_t*)data.data(), offsets.data(),
+                          rnd() % 2 ? valid.data() : nullptr,
+                          (long)rows.size(), part, kd, ko, kv,
+                          key_mode == 1 ? 1 : 0, &out_data, &out_offsets,
+                          &out_valid, &total);
+      if (rc == 0) {
+        puri_free(out_data);
+        puri_free(out_offsets);
+        puri_free(out_valid);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -313,6 +390,7 @@ int main(int argc, char** argv) {
     fuzz_footer(f);
     fuzz_decode(f, mutate(std::string(256, '\x5a')));
     fuzz_gjo();
+    fuzz_parse_uri();
   }
   printf("asan_fuzz: ok (%d rounds)\n", rounds);
   return 0;

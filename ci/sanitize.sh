@@ -4,8 +4,8 @@
 #
 #   1. TSan: resource adaptor state machine stressed from many threads
 #      (ci/tsan_stress.cpp compiled together with resource_adaptor.cpp).
-#   2. ASan+UBSan: footer/page/JSON parsers fuzzed with mutated inputs
-#      (ci/asan_fuzz.cpp compiled with the three parser sources).
+#   2. ASan+UBSan: footer/page/JSON/URL parsers fuzzed with mutated inputs
+#      (ci/asan_fuzz.cpp compiled with all four parser sources).
 #   3. Optional (SRJT_TSAN_PYTEST=1): the python resource-adaptor suites run
 #      with the TSan-built .so preloaded — slower, pulls python/JAX into the
 #      TSan runtime, but exercises the exact ctypes call patterns.
@@ -27,7 +27,8 @@ TSAN_OPTIONS="halt_on_error=1 exitcode=66" "$BUILD/tsan_stress"
 echo "== ASan+UBSan: parser fuzz ($ROUNDS rounds) =="
 g++ -std=c++17 -Og -g -fsanitize=address,undefined -fno-sanitize-recover=all \
     -o "$BUILD/asan_fuzz" ci/asan_fuzz.cpp native/parquet_footer.cpp \
-    native/parquet_decode.cpp native/get_json_object.cpp -lpthread
+    native/parquet_decode.cpp native/get_json_object.cpp \
+    native/parse_uri.cpp -lpthread
 ASAN_OPTIONS="detect_leaks=1" "$BUILD/asan_fuzz" "$ROUNDS"
 
 if [[ "${SRJT_TSAN_PYTEST:-0}" == "1" ]]; then

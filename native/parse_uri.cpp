@@ -1,0 +1,537 @@
+// Native parse_url tier — row-parallel host implementation.
+//
+// Reference capability: parse_uri.cu (1006 LoC of device code) — per-row
+// RFC-3986-style validation with the VALID/INVALID/FATAL trichotomy, entries
+// parse_uri_to_protocol/host/query(+key) (:877-:995), behavior pinned to
+// java.net.URI. This is a C++ port of this repo's own host implementation
+// (spark_rapids_jni_tpu/ops/parse_uri.py — same chunk validators, IPv6/IPv4/
+// domain machines, authority split); the python module remains the oracle
+// its tests compare against. Row-parallel with std::thread like
+// native/get_json_object.cpp; URL parsing is branch-heavy byte chasing with
+// no MXU fit, so the host tier IS the design (SURVEY §7.8), now at native
+// speed.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- character classes ------------------------------------------------------
+struct char_tables {
+  bool alpha[256] = {};
+  bool digit[256] = {};
+  bool alnum[256] = {};
+  bool hex[256] = {};
+  bool query_ok[256] = {};
+  bool auth_ok[256] = {};
+  bool path_ok[256] = {};
+  bool opaque_ok[256] = {};
+
+  char_tables() {
+    for (int c = 'a'; c <= 'z'; c++) alpha[c] = true;
+    for (int c = 'A'; c <= 'Z'; c++) alpha[c] = true;
+    for (int c = '0'; c <= '9'; c++) digit[c] = true;
+    for (int c = 0; c < 256; c++) alnum[c] = alpha[c] || digit[c];
+    for (int c = 0; c < 256; c++) hex[c] = digit[c];
+    for (const char* p = "abcdefABCDEF"; *p; p++) hex[(uint8_t)*p] = true;
+
+    auto base = [&](bool* t, const char* extra) {
+      for (int c = 0; c < 256; c++) t[c] = alpha[c];
+      for (const char* p = extra; *p; p++) t[(uint8_t)*p] = true;
+    };
+    auto rng = [&](bool* t, int lo, int hi, const char* excl) {
+      for (int c = lo; c <= hi; c++) {
+        bool ex = false;
+        for (const char* p = excl; *p; p++)
+          if (c == (uint8_t)*p) ex = true;
+        if (!ex) t[c] = true;
+      }
+    };
+    // query: alpha + !"$=_~ + [&-;] + [?-]] minus backslash
+    base(query_ok, "!\"$=_~");
+    rng(query_ok, '&', ';', "");
+    rng(query_ok, '?', ']', "\\");
+    // authority: alpha + !$=~ + [&-;] minus / + [@-_] minus ^ and backslash
+    base(auth_ok, "!$=~");
+    rng(auth_ok, '&', ';', "/");
+    rng(auth_ok, '@', '_', "^\\");
+    // path: alpha + !$=_~ + [&-;] + [@-Z]
+    base(path_ok, "!$=_~");
+    rng(path_ok, '&', ';', "");
+    rng(path_ok, '@', 'Z', "");
+    // opaque/fragment: alpha + !$=_~ + [&-;] + [?-]] minus backslash
+    base(opaque_ok, "!$=_~");
+    rng(opaque_ok, '&', ';', "");
+    rng(opaque_ok, '?', ']', "\\");
+  }
+};
+const char_tables T;
+
+// unicode whitespace/control code points rejected inside any chunk
+// (parse_uri.py _BAD_UNICODE)
+static bool bad_unicode(uint32_t cp) {
+  if (cp >= 0x80 && cp <= 0xA0) return true;
+  if (cp >= 0x2000 && cp <= 0x200A) return true;
+  switch (cp) {
+    case 0x1680: case 0x2028: case 0x202F: case 0x205F: case 0x3000:
+      return true;
+    default:
+      return false;
+  }
+}
+
+struct view {
+  const uint8_t* p = nullptr;
+  size_t n = 0;
+  const uint8_t* begin() const { return p; }
+  const uint8_t* end() const { return p + n; }
+  uint8_t operator[](size_t i) const { return p[i]; }
+  bool empty() const { return n == 0; }
+  view sub(size_t from, size_t len = SIZE_MAX) const {
+    if (from > n) from = n;
+    size_t m = n - from;
+    if (len < m) m = len;
+    return {p + from, m};
+  }
+  long find(uint8_t c, size_t from = 0) const {
+    for (size_t i = from; i < n; i++)
+      if (p[i] == c) return (long)i;
+    return -1;
+  }
+  long rfind(uint8_t c) const {
+    for (size_t i = n; i > 0; i--)
+      if (p[i - 1] == c) return (long)(i - 1);
+    return -1;
+  }
+  bool contains(uint8_t c) const { return find(c) >= 0; }
+};
+
+// strict UTF-8 decode of one sequence starting at i; matches python's
+// decoder: rejects stray continuations, overlongs, surrogates, > U+10FFFF
+static bool utf8_one(const view& b, size_t i, size_t& width, uint32_t& cp) {
+  uint8_t c = b[i];
+  if (c >= 0xF0) {
+    if (c > 0xF4) return false;
+    width = 4;
+  } else if (c >= 0xE0) {
+    width = 3;
+  } else if (c >= 0xC2) {  // C0/C1 are always-overlong
+    width = 2;
+  } else {
+    return false;  // stray continuation or C0/C1
+  }
+  if (i + width > b.n) return false;
+  cp = c & (0xFF >> (width + 1));
+  for (size_t k = 1; k < width; k++) {
+    uint8_t cc = b[i + k];
+    if ((cc & 0xC0) != 0x80) return false;
+    cp = (cp << 6) | (cc & 0x3F);
+  }
+  if (width == 3 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
+    return false;
+  if (width == 4 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
+  return true;
+}
+
+static bool validate_chunk(const view& b, const bool* allowed,
+                           bool allow_raw_percent = false) {
+  size_t i = 0;
+  while (i < b.n) {
+    uint8_t c = b[i];
+    if (c == '%' && !allow_raw_percent) {
+      if (i + 2 >= b.n || !T.hex[b[i + 1]] || !T.hex[b[i + 2]]) return false;
+      i += 3;
+      continue;
+    }
+    if (c >= 0x80) {
+      size_t width;
+      uint32_t cp;
+      if (!utf8_one(b, i, width, cp)) return false;
+      if (bad_unicode(cp)) return false;
+      i += width;
+      continue;
+    }
+    if (!allowed[c] && !(allow_raw_percent && c == '%')) return false;
+    i++;
+  }
+  return true;
+}
+
+static bool validate_scheme(const view& b) {
+  if (b.empty() || !T.alpha[b[0]]) return false;
+  for (size_t i = 1; i < b.n; i++) {
+    uint8_t c = b[i];
+    if (!T.alnum[c] && c != '+' && c != '-' && c != '.') return false;
+  }
+  return true;
+}
+
+static bool validate_ipv6(const view& b) {
+  if (b.n < 2) return false;
+  bool double_colon = false, group_has_hex = false;
+  int colons = 0, periods = 0, percents = 0, open_br = 0, close_br = 0;
+  int group_val = 0, group_chars = 0;
+  uint8_t prev = 0;
+  for (size_t i = 0; i < b.n; i++) {
+    uint8_t c = b[i];
+    if (c == '[') {
+      if (++open_br > 1) return false;
+    } else if (c == ']') {
+      if (++close_br > 1) return false;
+      if (periods > 0 && (group_has_hex || group_val > 255)) return false;
+    } else if (c == ':') {
+      colons++;
+      if (prev == ':') {
+        if (double_colon) return false;
+        double_colon = true;
+      }
+      group_val = group_chars = 0;
+      group_has_hex = false;
+      if (colons > 8 || (colons == 8 && !double_colon)) return false;
+      if (periods > 0 || percents > 0) return false;
+    } else if (c == '.') {
+      periods++;
+      if (percents > 0 || periods > 3 || group_has_hex || group_val > 255)
+        return false;
+      if (colons != 6 && !double_colon) return false;
+      if (colons >= 8) return false;
+      group_val = group_chars = 0;
+      group_has_hex = false;
+    } else if (c == '%') {
+      percents++;
+      if (percents > 1) return false;
+      if (periods > 0 && (group_has_hex || group_val > 255)) return false;
+      group_val = group_chars = 0;
+      group_has_hex = false;
+    } else {
+      if (percents == 0) {  // inside the zone-id anything goes
+        if (group_chars > 3) return false;
+        group_chars++;
+        group_val *= 10;
+        if ((c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F')) {
+          group_val += 10 + (c | 0x20) - 'a';
+          group_has_hex = true;
+        } else if (T.digit[c]) {
+          group_val += c - '0';
+        } else {
+          return false;
+        }
+      }
+    }
+    prev = c;
+  }
+  return true;
+}
+
+static bool validate_ipv4(const view& b) {
+  int octet = 0, chars = 0, dots = 0;
+  for (size_t i = 0; i < b.n; i++) {
+    uint8_t c = b[i];
+    if (!T.digit[c] && (i == 0 || c != '.')) return false;
+    if (c == '.') {
+      if (chars == 0) return false;
+      octet = chars = 0;
+      dots++;
+      continue;
+    }
+    chars++;
+    octet = octet * 10 + (c - '0');
+    if (octet > 255) return false;
+  }
+  return chars > 0 && dots == 3;
+}
+
+static bool validate_domain(const view& b) {
+  bool last_dash = false, last_dot = false, numeric_start = false;
+  int chars_in_label = 0;
+  for (size_t i = 0; i < b.n; i++) {
+    uint8_t c = b[i];
+    if (!T.alnum[c] && c != '-' && c != '.') return false;
+    numeric_start = last_dot && T.digit[c];
+    if (c == '-') {
+      if (last_dot || i == 0 || i == b.n - 1) return false;
+      last_dash = true;
+      last_dot = false;
+    } else if (c == '.') {
+      if (last_dash || last_dot || chars_in_label == 0) return false;
+      last_dot = true;
+      last_dash = false;
+      chars_in_label = 0;
+    } else {
+      last_dot = last_dash = false;
+      chars_in_label++;
+    }
+  }
+  return !numeric_start;
+}
+
+enum { FATAL = 0, INVALID = 1, VALID = 2 };
+
+static int validate_host(const view& b) {
+  if (b.empty()) return INVALID;
+  if (b[0] == '[') {
+    if (b[b.n - 1] != ']' || !validate_ipv6(b)) return FATAL;
+    return VALID;
+  }
+  if (b.contains('[') || b.contains(']')) return FATAL;
+  long last_dot = b.rfind('.');
+  bool looks_ipv4 = last_dot >= 0 && (size_t)last_dot != b.n - 1 &&
+                    T.digit[b[last_dot + 1]];
+  if (!looks_ipv4) {
+    if (validate_domain(b)) return VALID;
+  } else if (validate_ipv4(b)) {
+    return VALID;
+  }
+  return INVALID;
+}
+
+struct parts {
+  bool fatal = false;
+  bool has_scheme = false, has_host = false, has_query = false;
+  view scheme, host, query;
+};
+
+// Single-row parse — line-for-line port of parse_uri.py::_parse_one (itself
+// following the reference validate_uri flow, parse_uri.cu:536-746).
+static parts parse_one(view b) {
+  parts p;
+  size_t orig_start = 0;
+
+  long hash_pos = b.find('#');
+  if (hash_pos >= 0) {
+    if (!validate_chunk(b.sub(hash_pos + 1), T.opaque_ok)) {
+      p.fatal = true;
+      return p;
+    }
+    b = b.sub(0, hash_pos);
+  }
+
+  long colon = b.find(':');
+  long slash = b.find('/');
+  if (colon >= 0 && (slash < 0 || colon < slash)) {
+    view scheme = b.sub(0, colon);
+    if (!validate_scheme(scheme)) {
+      p.fatal = true;
+      return p;
+    }
+    p.has_scheme = true;
+    p.scheme = scheme;
+    b = b.sub(colon + 1);
+    orig_start = colon + 1;
+  }
+
+  if (b.empty()) {
+    p.fatal = true;
+    p.has_scheme = false;
+    return p;
+  }
+
+  bool hierarchical = b[0] == '/' || orig_start == 0;
+  if (!hierarchical) {
+    if (!validate_chunk(b, T.opaque_ok)) {
+      p.fatal = true;
+      p.has_scheme = false;
+    }
+    return p;
+  }
+
+  long question = b.find('?');
+  if (question >= 0) {
+    view query = b.sub(question + 1);
+    if (!validate_chunk(query, T.query_ok)) {
+      p.fatal = true;
+      p.has_scheme = false;
+      return p;
+    }
+    p.has_query = true;
+    p.query = query;
+    b = b.sub(0, question);
+  }
+
+  view path = b;
+  if (b.n >= 2 && b[0] == '/' && b[1] == '/') {
+    view rest = b.sub(2);
+    long next_slash = rest.find('/');
+    view authority = next_slash < 0 ? rest : rest.sub(0, next_slash);
+    path = next_slash < 0 ? view{} : rest.sub(next_slash);
+
+    if (!authority.empty()) {
+      bool ipv6ish = authority.n > 2 && authority[0] == '[';
+      if (!validate_chunk(authority, T.auth_ok, ipv6ish)) {
+        p.fatal = true;
+        p.has_scheme = p.has_query = false;
+        return p;
+      }
+      long amp = authority.find('@');
+      if (amp >= 0) {
+        view userinfo = authority.sub(0, amp);
+        if (userinfo.contains('[') || userinfo.contains(']')) {
+          p.fatal = true;
+          p.has_scheme = p.has_query = false;
+          return p;
+        }
+      }
+      view hostport = amp >= 0 ? authority.sub(amp + 1) : authority;
+      long close_br = hostport.rfind(']');
+      long last_colon = hostport.rfind(':');
+      view host = (last_colon > 0 && last_colon > close_br)
+                      ? hostport.sub(0, last_colon)
+                      : hostport;
+      int v = validate_host(host);
+      if (v == FATAL) {
+        p.fatal = true;
+        p.has_scheme = p.has_query = false;
+        return p;
+      }
+      if (v == VALID) {
+        p.has_host = true;
+        p.host = host;
+      }
+    }
+  }
+
+  if (!validate_chunk(path, T.path_ok)) {
+    p.fatal = true;
+    p.has_scheme = p.has_host = p.has_query = false;
+  }
+  return p;
+}
+
+// value of `key=...` among '&'-separated params (parse_uri.py
+// _find_query_part); returns false when absent
+static bool find_query_part(const view& q, const view& key, view& out) {
+  size_t start = 0;
+  while (start <= q.n) {
+    long amp = q.find('&', start);
+    size_t end = amp < 0 ? q.n : (size_t)amp;
+    view pair = q.sub(start, end - start);
+    long eq = pair.find('=');
+    if (eq >= 0 && (size_t)eq == key.n &&
+        memcmp(pair.p, key.p, key.n) == 0) {
+      out = pair.sub(eq + 1);
+      return true;
+    }
+    if (amp < 0) break;
+    start = end + 1;
+  }
+  return false;
+}
+
+enum { PART_PROTOCOL = 0, PART_HOST = 1, PART_QUERY = 2 };
+
+struct row_out {
+  bool valid = false;
+  view v;
+};
+
+static void parse_rows(const uint8_t* data, const int64_t* offsets,
+                       const uint8_t* valid_in, int part,
+                       const uint8_t* key_data, const int64_t* key_offsets,
+                       const uint8_t* key_valid, int key_broadcast,
+                       long begin, long end, row_out* out) {
+  for (long r = begin; r < end; r++) {
+    if (valid_in && !valid_in[r]) continue;
+    view b{data + offsets[r], (size_t)(offsets[r + 1] - offsets[r])};
+    parts p = parse_one(b);
+    row_out& o = out[r];
+    switch (part) {
+      case PART_PROTOCOL:
+        if (p.has_scheme) { o.valid = true; o.v = p.scheme; }
+        break;
+      case PART_HOST:
+        if (p.has_host) { o.valid = true; o.v = p.host; }
+        break;
+      case PART_QUERY:
+        if (!p.has_query) break;
+        if (key_data == nullptr) {
+          o.valid = true;
+          o.v = p.query;
+          break;
+        }
+        {
+          long kr = key_broadcast ? 0 : r;
+          if (key_valid && !key_valid[kr]) break;
+          view key{key_data + key_offsets[kr],
+                   (size_t)(key_offsets[kr + 1] - key_offsets[kr])};
+          view val;
+          if (find_query_part(p.query, key, val)) {
+            o.valid = true;
+            o.v = val;
+          }
+        }
+        break;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a string column. part: 0=PROTOCOL, 1=HOST, 2=QUERY. For QUERY with a
+// key, pass key_* buffers (key_broadcast=1 ⇒ single literal key at row 0).
+// Outputs are malloc'd; free with puri_free.
+int puri_parse(const uint8_t* data, const int64_t* offsets,
+               const uint8_t* valid_in, long n_rows, int part,
+               const uint8_t* key_data, const int64_t* key_offsets,
+               const uint8_t* key_valid, int key_broadcast,
+               uint8_t** out_data, int64_t** out_offsets,
+               uint8_t** out_valid, int64_t* out_total) {
+  if (part < PART_PROTOCOL || part > PART_QUERY) return -1;
+  std::vector<row_out> rows((size_t)n_rows);
+  unsigned hw = std::thread::hardware_concurrency();
+  long nthreads =
+      std::max(1L, std::min((long)(hw ? hw : 1), n_rows / 4096 + 1));
+  if (nthreads <= 1) {
+    parse_rows(data, offsets, valid_in, part, key_data, key_offsets,
+               key_valid, key_broadcast, 0, n_rows, rows.data());
+  } else {
+    std::vector<std::thread> ts;
+    long chunk = (n_rows + nthreads - 1) / nthreads;
+    for (long t = 0; t < nthreads; t++) {
+      long b = t * chunk, e = std::min(n_rows, b + chunk);
+      if (b >= e) break;
+      ts.emplace_back(parse_rows, data, offsets, valid_in, part, key_data,
+                      key_offsets, key_valid, key_broadcast, b, e,
+                      rows.data());
+    }
+    for (auto& th : ts) th.join();
+  }
+
+  int64_t total = 0;
+  for (auto& r : rows) total += r.valid ? (int64_t)r.v.n : 0;
+  *out_offsets = (int64_t*)malloc(sizeof(int64_t) * (n_rows + 1));
+  *out_valid = (uint8_t*)malloc(n_rows ? n_rows : 1);
+  *out_data = (uint8_t*)malloc(total ? total : 1);
+  if (!*out_offsets || !*out_valid || !*out_data) {
+    // free partial allocations: the caller raises without calling puri_free
+    free(*out_offsets);
+    free(*out_valid);
+    free(*out_data);
+    *out_offsets = nullptr;
+    *out_valid = nullptr;
+    *out_data = nullptr;
+    return -2;
+  }
+  int64_t off = 0;
+  (*out_offsets)[0] = 0;
+  for (long r = 0; r < n_rows; r++) {
+    const row_out& o = rows[r];
+    if (o.valid && o.v.n) {
+      memcpy(*out_data + off, o.v.p, o.v.n);
+      off += (int64_t)o.v.n;
+    }
+    (*out_offsets)[r + 1] = off;
+    (*out_valid)[r] = o.valid ? 1 : 0;
+  }
+  *out_total = total;
+  return 0;
+}
+
+void puri_free(void* p) { free(p); }
+
+}  // extern "C"

@@ -1,0 +1,29 @@
+"""ctypes loader for the native parse_uri tier (native/parse_uri.cpp)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from ..utils.nativeload import load_native
+
+_lib = None
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = load_native("parse_uri.cpp", "libsparkpuri.so", link=["-lpthread"])
+    c = ctypes
+    u8p, i64p = c.POINTER(c.c_uint8), c.POINTER(c.c_int64)
+    lib.puri_parse.restype = c.c_int
+    lib.puri_parse.argtypes = [
+        u8p, i64p, u8p, c.c_long, c.c_int,
+        u8p, i64p, u8p, c.c_int,
+        c.POINTER(u8p), c.POINTER(i64p), c.POINTER(u8p),
+        c.POINTER(c.c_int64),
+    ]
+    lib.puri_free.restype = None
+    lib.puri_free.argtypes = [c.c_void_p]
+    _lib = lib
+    return _lib

@@ -10,9 +10,7 @@ and the reasons this kernel runs on host.
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
-import subprocess
 import threading
 from enum import IntEnum
 from typing import List, Optional, Sequence, Tuple
@@ -21,12 +19,6 @@ import numpy as np
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column
-
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_PKG_ROOT = os.path.dirname(_HERE)
-_REPO_ROOT = os.path.dirname(_PKG_ROOT)
-_SRC = os.path.join(_REPO_ROOT, "native", "get_json_object.cpp")
-_SO = os.path.join(_PKG_ROOT, "_native", "libsparkjson.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -46,16 +38,9 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            os.makedirs(os.path.dirname(_SO), exist_ok=True)
-            proc = subprocess.run(
-                ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
-                 "-o", _SO, _SRC, "-lpthread"],
-                capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise RuntimeError(f"failed to build {_SO}:\n{proc.stderr}")
-        lib = ctypes.CDLL(_SO)
+        from ..utils.nativeload import load_native
+        lib = load_native("get_json_object.cpp", "libsparkjson.so",
+                          link=["-lpthread"])
         c = ctypes
         lib.gjo_eval.restype = c.c_int
         lib.gjo_eval.argtypes = [

@@ -372,20 +372,111 @@ def _emit(parts: List[Optional[bytes]]) -> Column:
                           validity)
 
 
+# ---------------------------------------------------------------------------
+# native fast path (native/parse_uri.cpp): same algorithms in row-parallel
+# C++; this python implementation is the oracle the native tier is tested
+# against (tests/test_parse_uri.py::test_native_matches_python_oracle)
+# ---------------------------------------------------------------------------
+
+_PART_PROTOCOL, _PART_HOST, _PART_QUERY = 0, 1, 2
+
+
+def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
+                  key_literal: Optional[bytes] = None) -> Column:
+    import ctypes
+
+    from . import _parse_uri_native as nat
+
+    lib = nat.load()
+    c = ctypes
+    data = np.ascontiguousarray(np.asarray(col.data))
+    offs = np.ascontiguousarray(np.asarray(col.offsets, dtype=np.int64))
+    valid = None if col.validity is None else np.ascontiguousarray(
+        np.asarray(col.validity).astype(np.uint8))
+
+    key_data = key_offs = key_valid = None
+    key_broadcast = 0
+    if key_literal is not None:
+        key_data = np.frombuffer(key_literal, dtype=np.uint8).copy() \
+            if key_literal else np.zeros(1, dtype=np.uint8)
+        key_offs = np.array([0, len(key_literal)], dtype=np.int64)
+        key_broadcast = 1
+    elif key_col is not None:
+        key_data = np.ascontiguousarray(np.asarray(key_col.data))
+        if key_data.size == 0:
+            key_data = np.zeros(1, dtype=np.uint8)
+        key_offs = np.ascontiguousarray(
+            np.asarray(key_col.offsets, dtype=np.int64))
+        key_valid = None if key_col.validity is None else \
+            np.ascontiguousarray(
+                np.asarray(key_col.validity).astype(np.uint8))
+
+    u8p = c.POINTER(c.c_uint8)
+    i64p = c.POINTER(c.c_int64)
+    out_data = u8p()
+    out_offs = i64p()
+    out_valid = u8p()
+    total = c.c_int64()
+    if data.size == 0:
+        data = np.zeros(1, dtype=np.uint8)
+    rc = lib.puri_parse(
+        data.ctypes.data_as(u8p), offs.ctypes.data_as(i64p),
+        valid.ctypes.data_as(u8p) if valid is not None else None,
+        col.size, part,
+        key_data.ctypes.data_as(u8p) if key_data is not None else None,
+        key_offs.ctypes.data_as(i64p) if key_offs is not None else None,
+        key_valid.ctypes.data_as(u8p) if key_valid is not None else None,
+        key_broadcast,
+        c.byref(out_data), c.byref(out_offs), c.byref(out_valid),
+        c.byref(total))
+    if rc != 0:
+        raise RuntimeError(f"parse_uri native tier failed ({rc})")
+    try:
+        n = col.size
+        offsets = np.ctypeslib.as_array(out_offs, shape=(n + 1,)).copy()
+        validity = np.ctypeslib.as_array(out_valid, shape=(n,)).copy() \
+            .astype(bool) if n else np.zeros(0, dtype=bool)
+        blob = (np.ctypeslib.as_array(out_data, shape=(total.value,)).copy()
+                if total.value else np.zeros(0, dtype=np.uint8))
+    finally:
+        lib.puri_free(out_data)
+        lib.puri_free(out_offs)
+        lib.puri_free(out_valid)
+
+    import jax.numpy as jnp
+    vmask = None if bool(validity.all()) else jnp.asarray(validity)
+    return Column(dt.STRING, n, data=jnp.asarray(blob), validity=vmask,
+                  offsets=jnp.asarray(offsets.astype(np.int32)))
+
+
 def parse_uri_to_protocol(col: Column) -> Column:
     """Spark `parse_url(url, 'PROTOCOL')` (reference :957)."""
-    return _emit([None if b is None else _parse_one(b).scheme
-                  for b in _row_bytes(col)])
+    return _native_parse(col, _PART_PROTOCOL)
 
 
 def parse_uri_to_host(col: Column) -> Column:
     """Spark `parse_url(url, 'HOST')` (reference :965)."""
-    return _emit([None if b is None else _parse_one(b).host
-                  for b in _row_bytes(col)])
+    return _native_parse(col, _PART_HOST)
 
 
 def parse_uri_to_query(col: Column) -> Column:
     """Spark `parse_url(url, 'QUERY')` (reference :973)."""
+    return _native_parse(col, _PART_QUERY)
+
+
+# ---- python oracle implementations (kept for differential testing) ----------
+
+def py_parse_uri_to_protocol(col: Column) -> Column:
+    return _emit([None if b is None else _parse_one(b).scheme
+                  for b in _row_bytes(col)])
+
+
+def py_parse_uri_to_host(col: Column) -> Column:
+    return _emit([None if b is None else _parse_one(b).host
+                  for b in _row_bytes(col)])
+
+
+def py_parse_uri_to_query(col: Column) -> Column:
     return _emit([None if b is None else _parse_one(b).query
                   for b in _row_bytes(col)])
 
@@ -401,6 +492,16 @@ def _find_query_part(query: bytes, key: bytes) -> Optional[bytes]:
 
 
 def parse_uri_to_query_with_literal(col: Column, key: str) -> Column:
+    return _native_parse(col, _PART_QUERY, key_literal=key.encode())
+
+
+def parse_uri_to_query_with_column(col: Column, keys: Column) -> Column:
+    if keys.size != col.size:
+        raise ValueError("keys column must match the url column's row count")
+    return _native_parse(col, _PART_QUERY, key_col=keys)
+
+
+def py_parse_uri_to_query_with_literal(col: Column, key: str) -> Column:
     kb = key.encode()
     out = []
     for b in _row_bytes(col):
@@ -409,7 +510,7 @@ def parse_uri_to_query_with_literal(col: Column, key: str) -> Column:
     return _emit(out)
 
 
-def parse_uri_to_query_with_column(col: Column, keys: Column) -> Column:
+def py_parse_uri_to_query_with_column(col: Column, keys: Column) -> Column:
     kb = _row_bytes(keys)
     out = []
     for b, k in zip(_row_bytes(col), kb):

@@ -10,18 +10,10 @@ filter (see native/parquet_footer.cpp).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
-
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_PKG_ROOT = os.path.dirname(_HERE)
-_REPO_ROOT = os.path.dirname(_PKG_ROOT)
-_SRC = os.path.join(_REPO_ROOT, "native", "parquet_footer.cpp")
-_SO = os.path.join(_PKG_ROOT, "_native", "libsparkpq.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -35,16 +27,9 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            os.makedirs(os.path.dirname(_SO), exist_ok=True)
-            proc = subprocess.run(
-                ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
-                 "-o", _SO, _SRC],
-                capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise RuntimeError(f"failed to build {_SO}:\n{proc.stderr}")
-        lib = ctypes.CDLL(_SO)
+        from ..utils.nativeload import load_native
+        lib = load_native("parquet_footer.cpp", "libsparkpq.so",
+                          extra_deps=["thrift_compact.hpp"])
         c = ctypes
         lib.pqf_read_and_filter.restype = c.c_void_p
         lib.pqf_read_and_filter.argtypes = [

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence, Tuple
@@ -32,13 +31,6 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..memory.reservation import device_reservation, release_barrier
-
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_PKG_ROOT = os.path.dirname(_HERE)
-_REPO_ROOT = os.path.dirname(_PKG_ROOT)
-_SRC = os.path.join(_REPO_ROOT, "native", "parquet_decode.cpp")
-_HDR = os.path.join(_REPO_ROOT, "native", "thrift_compact.hpp")
-_SO = os.path.join(_PKG_ROOT, "_native", "libsparkpqd.so")
 
 _lock = threading.Lock()
 _lib = None
@@ -82,18 +74,9 @@ def _load():
     with _lock:
         if _lib is not None:
             return _lib
-        stale = (not os.path.exists(_SO)
-                 or os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-                 or os.path.getmtime(_HDR) > os.path.getmtime(_SO))
-        if stale:
-            os.makedirs(os.path.dirname(_SO), exist_ok=True)
-            proc = subprocess.run(
-                ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
-                 "-o", _SO, _SRC],
-                capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise RuntimeError(f"failed to build {_SO}:\n{proc.stderr}")
-        lib = ctypes.CDLL(_SO)
+        from ..utils.nativeload import load_native
+        lib = load_native("parquet_decode.cpp", "libsparkpqd.so",
+                          extra_deps=["thrift_compact.hpp"])
         c = ctypes
         lib.pqd_open.restype = c.c_void_p
         lib.pqd_open.argtypes = [c.POINTER(c.c_uint8), c.c_longlong,

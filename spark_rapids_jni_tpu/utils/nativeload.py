@@ -1,0 +1,54 @@
+"""Shared build-or-load helper for the thin ctypes native loaders.
+
+One place owns the compile recipe (g++ flags, staleness check, error
+surface) so the per-subsystem loaders (parse_uri, get_json_object, parquet
+footer/decode) can't drift apart. The resource adaptor keeps its own loader
+(memory/native.py) because it layers the sanitizer-override hook on top.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def load_native(src_name: str, so_name: str,
+                extra_deps: Sequence[str] = (),
+                link: Sequence[str] = ()) -> ctypes.CDLL:
+    """Build (when the source or a dependency is newer) and load a native
+    library from ``native/<src_name>`` into ``_native/<so_name>``.
+
+    Callers declare ctypes signatures on the returned CDLL; repeated calls
+    return the cached handle.
+    """
+    with _lock:
+        lib = _cache.get(so_name)
+        if lib is not None:
+            return lib
+        src = os.path.join(_REPO_ROOT, "native", src_name)
+        so = os.path.join(_PKG_ROOT, "_native", so_name)
+        deps = [src] + [os.path.join(_REPO_ROOT, "native", d)
+                        for d in extra_deps]
+        stale = (not os.path.exists(so)
+                 or any(os.path.getmtime(d) > os.path.getmtime(so)
+                        for d in deps))
+        if stale:
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
+                   "-o", so, src, *link]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"failed to build {so} from {src}:\n{proc.stderr}")
+        lib = ctypes.CDLL(so)
+        _cache[so_name] = lib
+        return lib
