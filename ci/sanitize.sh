@@ -28,7 +28,7 @@ echo "== ASan+UBSan: parser fuzz ($ROUNDS rounds) =="
 g++ -std=c++17 -Og -g -fsanitize=address,undefined -fno-sanitize-recover=all \
     -o "$BUILD/asan_fuzz" ci/asan_fuzz.cpp native/parquet_footer.cpp \
     native/parquet_decode.cpp native/get_json_object.cpp \
-    native/parse_uri.cpp -lpthread
+    native/parse_uri.cpp -lpthread -lz -lzstd
 ASAN_OPTIONS="detect_leaks=1" "$BUILD/asan_fuzz" "$ROUNDS"
 
 if [[ "${SRJT_TSAN_PYTEST:-0}" == "1" ]]; then

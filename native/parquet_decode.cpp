@@ -29,6 +29,9 @@
 #include <string>
 #include <vector>
 
+#include <zlib.h>
+#include <zstd.h>
+
 #include "thrift_compact.hpp"
 
 namespace {
@@ -69,7 +72,9 @@ enum phys_type {
 enum encoding {
   ENC_PLAIN = 0, ENC_PLAIN_DICT = 2, ENC_RLE = 3, ENC_RLE_DICT = 8,
 };
-enum codec { CODEC_NONE = 0, CODEC_SNAPPY = 1 };
+enum codec {
+  CODEC_NONE = 0, CODEC_SNAPPY = 1, CODEC_GZIP = 2, CODEC_ZSTD = 6,
+};
 constexpr int REP_OPTIONAL = 1, REP_REPEATED = 2;
 
 static int64_t i_of(const tvalue& s, int16_t id, int64_t dflt = 0) {
@@ -308,6 +313,25 @@ static void flba_decimal_to_le128(const uint8_t* src, int n, uint8_t out[16]) {
   for (int i = 0; i < n && i < 16; i++) out[i] = src[n - 1 - i];
 }
 
+// Legacy Impala INT96 timestamp: 8-byte LE nanos-of-day + 4-byte LE Julian
+// day number → int64 microseconds since the Unix epoch (Spark reads INT96
+// as TimestampType, microsecond precision).
+static void int96_to_micros(const uint8_t* src, uint8_t out[8]) {
+  int64_t nanos;
+  int32_t jdn;
+  memcpy(&nanos, src, 8);
+  memcpy(&jdn, src + 8, 4);
+  // jdn is untrusted file data: overflow-checked math, saturating on
+  // corrupt values (a crafted day number must not be signed-overflow UB)
+  int64_t days = (int64_t)jdn - 2440588;
+  int64_t day_micros, micros;
+  if (__builtin_mul_overflow(days, 86400000000LL, &day_micros) ||
+      __builtin_add_overflow(day_micros, nanos / 1000, &micros)) {
+    micros = days < 0 ? INT64_MIN : INT64_MAX;
+  }
+  memcpy(out, &micros, 8);
+}
+
 struct chunk_decoder {
   const leaf_info& leaf;
   int codec;
@@ -317,10 +341,28 @@ struct chunk_decoder {
   column_out out;
   bool emit_decimal128;     // FLBA/decimal → 16-byte values
 
+  bool emit_int96;          // INT96 → 8-byte micros values
+
   chunk_decoder(const leaf_info& l, int codec_, int64_t nv)
       : leaf(l), codec(codec_), num_values(nv) {
     emit_decimal128 = leaf.physical == PT_FLBA;
+    emit_int96 = leaf.physical == PT_INT96;
     out.validity.reserve(nv);
+  }
+
+  size_t out_elem_size(size_t es) const {
+    if (emit_decimal128) return 16;
+    if (emit_int96) return 8;
+    return es;
+  }
+
+  void convert_elem(const uint8_t* src, size_t es, uint8_t* dst) const {
+    if (emit_decimal128)
+      flba_decimal_to_le128(src, (int)es, dst);
+    else if (emit_int96)
+      int96_to_micros(src, dst);
+    else
+      memcpy(dst, src, es);
   }
 
   // decompress page payload according to codec
@@ -334,9 +376,46 @@ struct chunk_decoder {
       data_len = comp;
       return;
     }
-    if (codec != CODEC_SNAPPY)
+    // uncompressed_size comes from the (untrusted) page header: bound it
+    // before allocating, or a tiny crafted file could zero-fill terabytes
+    // (the thrift reader applies the same DoS discipline to its sizes).
+    constexpr size_t kMaxPageBytes = 1u << 30;  // far above real page sizes
+    if (uncomp > kMaxPageBytes)
+      throw std::runtime_error("page: uncompressed size over limit");
+    if (uncomp == 0) {
+      // empty section (e.g. all-null v2 values): nothing to decompress;
+      // zlib would reject a NULL output buffer on this valid case
+      buf.clear();
+      data = buf.data();
+      data_len = 0;
+      return;
+    }
+    if (codec == CODEC_SNAPPY) {
+      snappy_decompress(src, comp, buf, uncomp);
+    } else if (codec == CODEC_GZIP) {
+      buf.resize(uncomp);
+      z_stream zs{};
+      // 15+16: zlib header detection for gzip framing (parquet GZIP pages
+      // carry a gzip wrapper)
+      if (inflateInit2(&zs, 15 + 16) != Z_OK)
+        throw std::runtime_error("gzip: init failed");
+      zs.next_in = const_cast<Bytef*>(src);
+      zs.avail_in = (uInt)comp;
+      zs.next_out = buf.data();
+      zs.avail_out = (uInt)uncomp;
+      int rc = inflate(&zs, Z_FINISH);
+      uLong got = zs.total_out;
+      inflateEnd(&zs);
+      if (rc != Z_STREAM_END || got != uncomp)
+        throw std::runtime_error("gzip: bad stream");
+    } else if (codec == CODEC_ZSTD) {
+      buf.resize(uncomp);
+      size_t got = ZSTD_decompress(buf.data(), uncomp, src, comp);
+      if (ZSTD_isError(got) || got != uncomp)
+        throw std::runtime_error("zstd: bad stream");
+    } else {
       throw std::runtime_error("unsupported codec " + std::to_string(codec));
-    snappy_decompress(src, comp, buf, uncomp);
+    }
     data = buf.data();
     data_len = buf.size();
   }
@@ -444,19 +523,15 @@ struct chunk_decoder {
       }
     } else {
       size_t es = plain_elem_size(leaf.physical, leaf.type_length);
-      size_t oes = emit_decimal128 ? 16 : es;
+      size_t oes = out_elem_size(es);
       size_t vi = 0;
       size_t base = out.values.size();
       out.values.resize(base + defs.size() * oes, 0);
       uint8_t* dst = out.values.data() + base;
       for (size_t i = 0; i < defs.size(); i++) {
-        if (defs[i] == leaf.max_def) {
-          const uint8_t* src = dict.fixed.data() + (size_t)idx[vi++] * es;
-          if (emit_decimal128)
-            flba_decimal_to_le128(src, (int)es, dst + i * oes);
-          else
-            memcpy(dst + i * oes, src, es);
-        }
+        if (defs[i] == leaf.max_def)
+          convert_elem(dict.fixed.data() + (size_t)idx[vi++] * es, es,
+                       dst + i * oes);
       }
     }
   }
@@ -494,19 +569,14 @@ struct chunk_decoder {
     size_t es = plain_elem_size(leaf.physical, leaf.type_length);
     if (es == 0) throw std::runtime_error("plain: bad physical type");
     if ((size_t)n_valid * es > len) throw std::runtime_error("plain: truncated");
-    size_t oes = emit_decimal128 ? 16 : es;
+    size_t oes = out_elem_size(es);
     size_t base = out.values.size();
     out.values.resize(base + defs.size() * oes, 0);
     uint8_t* dst = out.values.data() + base;
     size_t vi = 0;
     for (size_t i = 0; i < defs.size(); i++) {
-      if (defs[i] == leaf.max_def) {
-        const uint8_t* src = data + (vi++) * es;
-        if (emit_decimal128)
-          flba_decimal_to_le128(src, (int)es, dst + i * oes);
-        else
-          memcpy(dst + i * oes, src, es);
-      }
+      if (defs[i] == leaf.max_def)
+        convert_elem(data + (vi++) * es, es, dst + i * oes);
     }
   }
 

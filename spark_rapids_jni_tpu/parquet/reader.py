@@ -76,7 +76,8 @@ def _load():
             return _lib
         from ..utils.nativeload import load_native
         lib = load_native("parquet_decode.cpp", "libsparkpqd.so",
-                          extra_deps=["thrift_compact.hpp"])
+                          extra_deps=["thrift_compact.hpp"],
+                          link=["-lz", "-lzstd"])
         c = ctypes
         lib.pqd_open.restype = c.c_void_p
         lib.pqd_open.argtypes = [c.POINTER(c.c_uint8), c.c_longlong,
@@ -162,6 +163,9 @@ def _map_dtype(physical: int, converted: int, scale: int,
         if converted == _CT_DECIMAL:
             return DType(TypeId.DECIMAL128, scale)
         raise ValueError("FIXED_LEN_BYTE_ARRAY without DECIMAL is unsupported")
+    if physical == _PT_INT96:
+        # legacy Impala timestamps; decoded natively to epoch microseconds
+        return dt.TIMESTAMP_MICROSECONDS
     raise ValueError(f"unsupported parquet physical type {physical}")
 
 

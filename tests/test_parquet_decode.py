@@ -225,3 +225,23 @@ def test_lineitem_shaped_end_to_end(tmp_path):
             total += chunk.num_rows
         assert total == n
     _check_file(path, t)
+
+
+@pytest.mark.parametrize("compression", ["gzip", "zstd"])
+def test_gzip_zstd_codecs(tmp_path, compression):
+    t = _mixed_table()
+    path = _roundtrip(t, tmp_path, compression=compression)
+    _check_file(path, t)
+
+
+def test_int96_legacy_timestamps(tmp_path):
+    ts = pa.array([datetime.datetime(2001, 2, 3, 4, 5, 6, 789012), None,
+                   datetime.datetime(1969, 12, 31, 23, 59, 59),
+                   datetime.datetime(1970, 1, 1, 0, 0, 0)],
+                  type=pa.timestamp("us"))
+    t = pa.table({"ts": ts})
+    path = str(tmp_path / "i96.parquet")
+    pq.write_table(t, path, use_deprecated_int96_timestamps=True)
+    out = read_parquet(path)
+    assert out[0].dtype.id is TypeId.TIMESTAMP_MICROSECONDS
+    _assert_matches(out[0], t.column("ts"))
