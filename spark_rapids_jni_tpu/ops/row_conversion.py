@@ -21,15 +21,19 @@ JCUDF row layout (row_conversion.cu:88-137 and RowConversion.java:44-118):
 
 TPU-first design: the CUDA implementation is a shared-memory tile transpose
 with memcpy_async; none of that machinery survives here. Layout metadata is
-computed host-side from the static schema; the data movement itself is a
-handful of XLA ops — byte bitcasts, static-slice writes into a dense
-[rows, size_per_row] matrix, and (for strings) one scatter/gather over the
-batch blob — which XLA fuses and tiles for the VPU on its own.
+computed host-side from the static schema; the data movement is word-oriented
+for the VPU: the fixed-width region is composed as uint32 *words* (shift/or
+for sub-word fields), becoming bytes only via one final bitcast — TPU tiles
+int8 as (32, 128) with costly relayouts, so byte-granular assembly is ~10x
+slower than 32-bit lanes. The variable-width blob is built by *gather* (each
+output byte indexes its source), never scatter — gathers vectorize on TPU,
+scatters serialize.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -39,7 +43,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
-from ..columnar.strings import padded_bytes
+from ..columnar.strings import pad_width, padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 
 JCUDF_ROW_ALIGNMENT = 8
@@ -86,112 +90,187 @@ def compute_column_information(dtypes: Sequence[DType]) -> ColumnInfo:
                       validity_offset, tuple(var_starts))
 
 
-def _split64_bytes(u: jnp.ndarray) -> jnp.ndarray:
-    """u64[n] -> little-endian uint8[n, 8] without a 64-bit bitcast (the TPU
-    X64 rewriter has no lowering for bitcast-convert on 64-bit element
-    types — docs/TPU_NUMERICS.md §3)."""
-    lo = (u & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = (u >> np.uint64(32)).astype(jnp.uint32)
-    return jnp.concatenate(
-        [jax.lax.bitcast_convert_type(lo, jnp.uint8),
-         jax.lax.bitcast_convert_type(hi, jnp.uint8)], axis=1)
+def _column_words(col: Column) -> List[jnp.ndarray]:
+    """Fixed-width column values as little-endian uint32 words.
 
-
-def _join64_bytes(mat: jnp.ndarray) -> jnp.ndarray:
-    """little-endian uint8[n, 8] -> u64[n] (inverse of _split64_bytes)."""
-    lo = jax.lax.bitcast_convert_type(mat[:, :4], jnp.uint32)
-    hi = jax.lax.bitcast_convert_type(mat[:, 4:], jnp.uint32)
-    return lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << np.uint64(32))
-
-
-def _column_bytes(col: Column) -> jnp.ndarray:
-    """Fixed-width column values as little-endian uint8[n, itemsize]."""
+    Columns of itemsize >= 4 return itemsize/4 full words; sub-word columns
+    (1/2 bytes) return one uint32 holding the value in its low bits (the
+    caller shifts it into lane position). 64-bit values split through u32
+    halves — the TPU X64 rewriter has no lowering for 64-bit bitcast-convert
+    (docs/TPU_NUMERICS.md §3)."""
     if col.dtype.id is TypeId.DECIMAL128:
-        # [n, 4] uint32 LE limbs -> [n, 4, 4] bytes -> [n, 16]
-        b = jax.lax.bitcast_convert_type(col.data, jnp.uint8)
-        return b.reshape(col.size, 16)
+        return [col.data[:, j] for j in range(4)]  # already LE uint32 limbs
     data = col.data
-    if data.dtype.itemsize == 1:
-        return jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(col.size, 1)
-    if data.dtype.itemsize == 8:
+    isz = data.dtype.itemsize
+    if isz == 8:
         # int64/uint64 value-cast preserves bits; FLOAT64 is stored as bits
-        return _split64_bytes(data.astype(jnp.uint64))
-    return jax.lax.bitcast_convert_type(data, jnp.uint8)
+        u = data.astype(jnp.uint64)
+        return [(u & np.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                (u >> np.uint64(32)).astype(jnp.uint32)]
+    if isz == 4:
+        return [jax.lax.bitcast_convert_type(data, jnp.uint32)]
+    if isz == 2:
+        return [jax.lax.bitcast_convert_type(data, jnp.uint16)
+                .astype(jnp.uint32)]
+    return [jax.lax.bitcast_convert_type(data, jnp.uint8).astype(jnp.uint32)]
 
 
-def _bytes_to_column(mat: jnp.ndarray, d: DType,
+def _words_to_column(words: jnp.ndarray, word0: int, byte_off: int, d: DType,
                      validity: Optional[jnp.ndarray]) -> Column:
-    """Inverse of _column_bytes: uint8[n, itemsize] -> Column."""
-    n = mat.shape[0]
+    """Inverse of _column_words: extract a column from uint32[n, W] row words.
+
+    word0 = column start word index; byte_off = start byte within that word
+    (non-zero only for sub-word columns)."""
+    n = words.shape[0]
     if d.id is TypeId.DECIMAL128:
-        limbs = jax.lax.bitcast_convert_type(
-            mat.reshape(n, 4, 4), jnp.uint32)
-        return Column(d, n, data=limbs, validity=validity)
+        return Column(d, n, data=words[:, word0:word0 + 4], validity=validity)
     if d.itemsize == 8:
-        u = _join64_bytes(mat)
+        u = (words[:, word0].astype(jnp.uint64)
+             | (words[:, word0 + 1].astype(jnp.uint64) << np.uint64(32)))
         # FLOAT64 keeps bit-pattern storage; int64 flavors value-cast back
         data = u if d.id is TypeId.FLOAT64 else u.astype(d.jnp_dtype)
         return Column(d, n, data=data, validity=validity)
-    target = d.jnp_dtype
-    if target.itemsize == 1:
-        data = jax.lax.bitcast_convert_type(mat[:, 0], target)
+    if d.itemsize == 4:
+        data = jax.lax.bitcast_convert_type(words[:, word0], d.jnp_dtype)
+        return Column(d, n, data=data, validity=validity)
+    lane = words[:, word0] >> np.uint32(8 * byte_off)
+    if d.itemsize == 2:
+        u16 = (lane & np.uint32(0xFFFF)).astype(jnp.uint16)
+        data = jax.lax.bitcast_convert_type(u16, d.jnp_dtype)
     else:
-        data = jax.lax.bitcast_convert_type(mat, target)
+        u8 = (lane & np.uint32(0xFF)).astype(jnp.uint8)
+        data = (u8 if d.jnp_dtype == jnp.dtype(jnp.uint8)
+                else jax.lax.bitcast_convert_type(u8, d.jnp_dtype))
     return Column(d, n, data=data, validity=validity)
 
 
-def _pack_row_validity(valid: jnp.ndarray) -> jnp.ndarray:
-    """bool[n, ncols] -> uint8[n, ceil(ncols/8)], bit c%8 of byte c/8."""
+def _pack_validity_words(valid: jnp.ndarray) -> jnp.ndarray:
+    """bool[n, ncols] -> uint32[n, ceil(ncols/8)] of *byte values* (bit c%8 of
+    byte c/8, JCUDF convention) kept in 32-bit lanes for shift/or packing."""
     n, ncols = valid.shape
     nbytes = (ncols + 7) // 8
-    padded = jnp.zeros((n, nbytes * 8), dtype=jnp.uint8)
-    padded = padded.at[:, :ncols].set(valid.astype(jnp.uint8))
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
-    return jnp.sum(padded.reshape(n, nbytes, 8) * weights[None, None, :],
-                   axis=2, dtype=jnp.uint8)
+    v = valid.astype(jnp.uint32)
+    if nbytes * 8 != ncols:
+        v = jnp.pad(v, ((0, 0), (0, nbytes * 8 - ncols)))
+    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))
+    return jnp.sum(v.reshape(n, nbytes, 8) * weights[None, None, :],
+                   axis=2, dtype=jnp.uint32)
 
 
-def _u32_bytes(x: jnp.ndarray) -> jnp.ndarray:
-    return jax.lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.uint8)
+def _build_fixed_words(table: Table, info: ColumnInfo, row_size: int,
+                       var_offsets: Optional[jnp.ndarray],
+                       var_lengths: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Dense uint32[n, row_size/4] fixed-width + validity region as LE words.
 
-
-def _build_fixed_region(table: Table, info: ColumnInfo,
-                        var_offsets: Optional[jnp.ndarray],
-                        var_lengths: Optional[jnp.ndarray]) -> jnp.ndarray:
-    """Dense uint8[n, size_per_row] fixed-width + validity region.
-
-    var_offsets/var_lengths: int32[n, n_string_cols] row-relative offsets and
-    lengths for STRING columns (None when the table is all fixed-width).
-    """
+    row_size must be a multiple of 4 and >= info.size_per_row; the tail
+    (padding and any bytes past size_per_row) is zero. var_offsets /
+    var_lengths: int32[n, n_string_cols] row-relative offsets and lengths for
+    STRING columns (None when the table is all fixed-width)."""
     n = table.num_rows
-    out = jnp.zeros((n, info.size_per_row), dtype=jnp.uint8)
+    nwords = row_size // 4
+    acc: dict = {}
+
+    def _or(w: int, expr: jnp.ndarray) -> None:
+        acc[w] = expr if w not in acc else acc[w] | expr
+
     var_idx = 0
     for c, col in enumerate(table):
         o = info.column_starts[c]
         if col.dtype.id is TypeId.STRING:
-            out = out.at[:, o:o + 4].set(_u32_bytes(var_offsets[:, var_idx]))
-            out = out.at[:, o + 4:o + 8].set(_u32_bytes(var_lengths[:, var_idx]))
+            _or(o // 4, var_offsets[:, var_idx].astype(jnp.uint32))
+            _or(o // 4 + 1, var_lengths[:, var_idx].astype(jnp.uint32))
             var_idx += 1
+            continue
+        words = _column_words(col)
+        if info.column_sizes[c] >= 4:  # o is word-aligned (alignment=size)
+            for j, w in enumerate(words):
+                _or(o // 4 + j, w)
         else:
-            out = out.at[:, o:o + info.column_sizes[c]].set(_column_bytes(col))
+            sh = 8 * (o % 4)
+            _or(o // 4, words[0] << np.uint32(sh) if sh else words[0])
+
     valid = jnp.stack([c.valid_mask() for c in table], axis=1)
-    out = out.at[:, info.validity_offset:].set(_pack_row_validity(valid))
-    return out
+    vbytes = _pack_validity_words(valid)
+    for k in range(vbytes.shape[1]):
+        bo = info.validity_offset + k
+        sh = 8 * (bo % 4)
+        _or(bo // 4, vbytes[:, k] << np.uint32(sh) if sh else vbytes[:, k])
+
+    zero = jnp.zeros((n,), dtype=jnp.uint32)
+    return jnp.stack([acc.get(w, zero) for w in range(nwords)], axis=1)
+
+
+def _words_to_u8(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[n, W] LE words -> uint8[n, 4W]."""
+    b = jax.lax.bitcast_convert_type(words, jnp.uint8)
+    return b.reshape(words.shape[0], words.shape[1] * 4)
 
 
 def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
     """Split rows into batches whose total size fits an int32-offset column
     (build_batches, row_conversion.cu:1458). Returns boundary row indices
-    [0, ..., num_rows]."""
+    [0, ..., num_rows]. Greedy fill via cumsum + searchsorted — a handful of
+    host ops per *batch*, not per row."""
+    n = len(row_sizes)
+    if n == 0:
+        return [0, 0]
+    cum = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(row_sizes, out=cum[1:])
     bounds = [0]
-    acc = 0
-    for i, s in enumerate(row_sizes):
-        if acc + int(s) > max_batch_bytes and acc > 0:
-            bounds.append(i)
-            acc = 0
-        acc += int(s)
-    bounds.append(len(row_sizes))
+    while bounds[-1] < n:
+        b = int(np.searchsorted(cum, cum[bounds[-1]] + max_batch_bytes,
+                                side="right")) - 1
+        if b == bounds[-1]:
+            b += 1  # a single row larger than the cap gets its own batch
+        bounds.append(min(b, n))
     return bounds
+
+
+def _row_of_position(boundaries: jnp.ndarray, total: int) -> jnp.ndarray:
+    """int32[total] mapping position k -> segment index, given int32 segment
+    boundaries [0, ..., total]. Indicator-scatter + cumsum — O(total) work
+    (searchsorted per element is a serial while-loop on XLA:CPU and far
+    slower than a cumsum on both backends)."""
+    marks = jnp.zeros((total,), dtype=jnp.int32)
+    inner = boundaries[1:-1]  # segment starts after the first
+    marks = marks.at[inner].add(1, mode="drop")
+    return jnp.cumsum(marks).astype(jnp.int32)
+
+
+def _blob_bucket(total: int) -> int:
+    """Round a blob byte length up to a compile-cache bucket (shared policy:
+    next power of two with a 64 KB floor) so the jitted assembly/extraction
+    programs specialize on a handful of sizes."""
+    return pad_width(total, 1 << 16)
+
+
+@partial(jax.jit, static_argnames=("spr", "padded_total"))
+def _assemble_blob(fixed, mats, lenss, starts, roffs, *, spr, padded_total):
+    """One fused device program building a (padded) JCUDF blob by gather.
+
+    fixed: uint8[n, >=spr] word-built fixed region; mats/lenss/starts: per
+    string column padded byte matrices [n, L_s], lengths int32[n], and
+    row-relative start offsets int32[n]; roffs: int32[n+1] output row
+    boundaries (padded tail rows map past the last row and produce zeros).
+    Runs as a single jit so the index arithmetic fuses into the gathers
+    instead of materializing blob-sized intermediates per op. Indexing is
+    2-D (row, byte) — a flattened int32 index would wrap once a padded
+    string matrix crosses 2^31 elements, which skewed lengths can reach
+    while the blob itself stays under the 2 GB batch cap.
+    """
+    k = jnp.arange(padded_total, dtype=jnp.int32)
+    row = _row_of_position(roffs, padded_total)
+    rel = k - roffs[row]
+    blob = jnp.where(
+        (rel >= 0) & (rel < spr),
+        fixed[row, jnp.clip(rel, 0, fixed.shape[1] - 1)],
+        jnp.uint8(0))
+    for mat, lens, start in zip(mats, lenss, starts):
+        j = rel - start[row]
+        in_s = (j >= 0) & (j < lens[row])
+        byte_s = mat[row, jnp.clip(j, 0, mat.shape[1] - 1)]
+        blob = jnp.where(in_s, byte_s, blob)
+    return blob
 
 
 def _rows_column(blob: jnp.ndarray, row_offsets: np.ndarray) -> Column:
@@ -212,9 +291,12 @@ def convert_to_rows(table: Table,
     n = table.num_rows
     string_cols = [c for c in table if c.dtype.id is TypeId.STRING]
 
-    # peak ≈ input + padded string matrices + output row blobs (reservation
-    # bracketing; see memory/reservation.py)
-    est = 2 * table.device_nbytes() + n * info.size_per_row
+    # peak ≈ input + padded string matrices + bucket-padded blob + the int32
+    # position/row index arrays _assemble_blob materializes per blob byte
+    # (reservation bracketing; see memory/reservation.py)
+    blob_est = n * info.size_per_row + sum(
+        int(c.data.size) for c in string_cols)
+    est = 2 * table.device_nbytes() + (2 + 8) * _blob_bucket(blob_est)
     with device_reservation(est) as took:
         out = _convert_to_rows(table, max_batch_bytes, info, n, string_cols)
         return release_barrier(out, took)
@@ -224,14 +306,12 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
 
     if not string_cols:
         row_size = _round_up(info.size_per_row, JCUDF_ROW_ALIGNMENT)
-        fixed = _build_fixed_region(table, info, None, None)
-        if row_size != info.size_per_row:
-            fixed = jnp.pad(fixed, ((0, 0), (0, row_size - info.size_per_row)))
+        words = _build_fixed_words(table, info, row_size, None, None)
         bounds = _batch_boundaries(
             np.full(n, row_size, dtype=np.int64), max_batch_bytes)
         out = []
         for b0, b1 in zip(bounds[:-1], bounds[1:]):
-            blob = fixed[b0:b1].reshape(-1)
+            blob = _words_to_u8(words[b0:b1]).reshape(-1)
             offsets = np.arange(b1 - b0 + 1, dtype=np.int64) * row_size
             out.append(_rows_column(blob, offsets))
         return out
@@ -248,7 +328,10 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         ((info.size_per_row + total_str + JCUDF_ROW_ALIGNMENT - 1)
          // JCUDF_ROW_ALIGNMENT) * JCUDF_ROW_ALIGNMENT, dtype=np.int64)
 
-    fixed = _build_fixed_region(table, info, var_offsets, lengths)
+    # fixed region as bytes (word-built; tail bytes past size_per_row unused)
+    spr = info.size_per_row
+    fixed = _words_to_u8(_build_fixed_words(
+        table, info, _round_up(spr, 4), var_offsets, lengths))
     padded = [padded_bytes(c) for c in string_cols]
     bounds = _batch_boundaries(row_sizes_np, max_batch_bytes)
 
@@ -259,20 +342,19 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         row_offsets = np.zeros(nb + 1, dtype=np.int64)
         np.cumsum(sizes, out=row_offsets[1:])
         total = int(row_offsets[-1])
-        roff = jnp.asarray(row_offsets[:-1], dtype=jnp.int32)
+        roffs = jnp.asarray(row_offsets, dtype=jnp.int32)
 
-        blob = jnp.zeros((total,), dtype=jnp.uint8)
-        # fixed region: one scatter of [nb, size_per_row]
-        pos = roff[:, None] + jnp.arange(info.size_per_row, dtype=jnp.int32)
-        blob = blob.at[pos.reshape(-1)].set(fixed[b0:b1].reshape(-1))
-        # string data: one scatter per string column from its padded matrix
-        for s, (mat, lens) in enumerate(padded):
-            mat, lens = mat[b0:b1], lens[b0:b1]
-            L = mat.shape[1]
-            j = jnp.arange(L, dtype=jnp.int32)[None, :]
-            p = roff[:, None] + var_offsets[b0:b1, s, None] + j
-            p = jnp.where(j < lens[:, None], p, total)  # OOB -> dropped
-            blob = blob.at[p.reshape(-1)].set(mat.reshape(-1), mode="drop")
+        if nb == 0 or total == 0:
+            out.append(_rows_column(jnp.zeros((0,), jnp.uint8), row_offsets))
+            continue
+        # gather-based blob (scatters serialize on TPU; gathers vectorize),
+        # fused in one jit, length-bucketed to bound the compile cache
+        blob = _assemble_blob(
+            fixed[b0:b1],
+            tuple(mat[b0:b1] for mat, _ in padded),
+            tuple(lens[b0:b1] for _, lens in padded),
+            tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
+            roffs, spr=spr, padded_total=_blob_bucket(total))[:total]
         out.append(_rows_column(blob, row_offsets))
     return out
 
@@ -294,13 +376,32 @@ def convert_to_rows_fixed_width_optimized(
     return convert_to_rows(table, max_batch_bytes)
 
 
-def _extract_validity(fixed: jnp.ndarray, info: ColumnInfo,
-                      ncols: int) -> jnp.ndarray:
-    """uint8[n, size_per_row] -> bool[n, ncols] validity."""
-    vbytes = fixed[:, info.validity_offset:
-                   info.validity_offset + (ncols + 7) // 8]
-    bits = (vbytes[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
-    return bits.reshape(fixed.shape[0], -1)[:, :ncols].astype(bool)
+@partial(jax.jit, static_argnames=("padded_total",))
+def _extract_string_bytes(blob, row_offsets, off_in_row, out_offsets, *,
+                          padded_total):
+    """Fused per-output-byte gather of one string column out of a JCUDF blob:
+    k -> (row via boundary marks, byte within row). Positions past the real
+    total (bucket padding) read clipped sources and are sliced off by the
+    caller."""
+    k = jnp.arange(padded_total, dtype=jnp.int32)
+    row = _row_of_position(out_offsets, padded_total)
+    src = row_offsets[row] + off_in_row[row] + (k - out_offsets[row])
+    return blob[jnp.clip(src, 0, blob.shape[0] - 1)]
+
+
+def _extract_validity_words(words: jnp.ndarray, info: ColumnInfo,
+                            ncols: int) -> jnp.ndarray:
+    """uint32[n, W] row words -> bool[n, ncols] validity."""
+    nbytes = (ncols + 7) // 8
+    byte_cols = []
+    for k in range(nbytes):
+        bo = info.validity_offset + k
+        byte_cols.append(
+            (words[:, bo // 4] >> np.uint32(8 * (bo % 4))) & np.uint32(0xFF))
+    vbytes = jnp.stack(byte_cols, axis=1)  # uint32[n, nbytes]
+    bits = (vbytes[:, :, None] >> jnp.arange(8, dtype=jnp.uint32)) & 1
+    return (bits.reshape(words.shape[0], nbytes * 8)[:, :ncols]
+            .astype(bool))
 
 
 def convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
@@ -319,10 +420,18 @@ def _convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
     row_offsets = jnp.asarray(rows.offsets, dtype=jnp.int32)[:-1]
     blob = jax.lax.bitcast_convert_type(rows.children[0].data, jnp.uint8)
 
-    # gather the dense fixed-width region
-    pos = row_offsets[:, None] + jnp.arange(info.size_per_row, dtype=jnp.int32)
-    fixed = blob[jnp.clip(pos, 0, max(blob.shape[0] - 1, 0))]
-    valid = _extract_validity(fixed, info, len(dtypes))
+    # gather the fixed-width region as LE uint32 words: row starts are
+    # 8-byte aligned, so word gathers are exact; a row's total size is >= the
+    # word-padded fixed region, so the trailing word never runs off the blob
+    nwords = (info.size_per_row + 3) // 4
+    total_words = blob.shape[0] // 4
+    blob_words = (jax.lax.bitcast_convert_type(
+        blob.reshape(total_words, 4), jnp.uint32)
+        if total_words else jnp.zeros((0,), jnp.uint32))
+    wpos = ((row_offsets // 4)[:, None]
+            + jnp.arange(nwords, dtype=jnp.int32)[None, :])
+    words = blob_words[jnp.clip(wpos, 0, max(total_words - 1, 0))]
+    valid = _extract_validity_words(words, info, len(dtypes))
 
     # null-mask materialization: single host sync over all columns
     any_null = np.asarray(~jnp.all(valid, axis=0))
@@ -332,23 +441,19 @@ def _convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
         vmask = valid[:, c] if any_null[c] else None
         o = info.column_starts[c]
         if d.id is TypeId.STRING:
-            off_in_row = jax.lax.bitcast_convert_type(
-                fixed[:, o:o + 4], jnp.uint32).astype(jnp.int32)
-            length = jax.lax.bitcast_convert_type(
-                fixed[:, o + 4:o + 8], jnp.uint32).astype(jnp.int32)
+            off_in_row = words[:, o // 4].astype(jnp.int32)
+            length = words[:, o // 4 + 1].astype(jnp.int32)
             out_offsets = jnp.concatenate(
                 [jnp.zeros((1,), jnp.int32), jnp.cumsum(length)])
             total = int(out_offsets[-1])
-            # per-output-byte gather: k -> (row via searchsorted, byte within)
-            k = jnp.arange(total, dtype=jnp.int32)
-            row = jnp.searchsorted(out_offsets, k, side="right") - 1
-            src = row_offsets[row] + off_in_row[row] + (k - out_offsets[row])
-            data = blob[src] if total else jnp.zeros((0,), jnp.uint8)
+            data = (_extract_string_bytes(
+                blob, row_offsets, off_in_row, out_offsets,
+                padded_total=_blob_bucket(total))[:total]
+                if total else jnp.zeros((0,), jnp.uint8))
             cols.append(Column(d, n, data=data, validity=vmask,
                                offsets=out_offsets))
         else:
-            s = info.column_sizes[c]
-            cols.append(_bytes_to_column(fixed[:, o:o + s], d, vmask))
+            cols.append(_words_to_column(words, o // 4, o % 4, d, vmask))
     return Table(tuple(cols))
 
 

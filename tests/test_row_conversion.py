@@ -157,3 +157,14 @@ def test_validity_many_columns():
     back = rc.convert_from_rows(rows, [c.dtype for c in cols])
     for orig, got in zip(cols, back):
         assert orig.to_pylist() == got.to_pylist()
+
+
+def test_empty_table_round_trip():
+    # zero rows with a STRING column: blob assembly and validity extraction
+    # must handle size-0 operands (regression: reshape(-1) on empty bits)
+    t = Table((Column.from_pylist([], dt.INT64),
+               Column.from_pylist([], dt.STRING)))
+    [rows] = rc.convert_to_rows(t)
+    assert rows.size == 0
+    back = rc.convert_from_rows(rows, [dt.INT64, dt.STRING])
+    assert [c.to_pylist() for c in back.columns] == [[], []]
