@@ -1,0 +1,3 @@
+"""Microbenchmark suite (see bench_ops.py); tpch.py holds the shared
+query-pipeline definitions so correctness tests exercise the exact code the
+benchmarks time."""

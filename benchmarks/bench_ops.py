@@ -210,6 +210,26 @@ def bench_sort(rows: int):
     return sec, rows * 8
 
 
+def bench_tpch_q3(rows: int):
+    """BASELINE configs[2]-shaped: the TPC-H q3 operator pipeline — two
+    filters, customer⋈orders and lineitem⋈orders hash joins, groupby-sum of
+    revenue, sort desc, top 10 — at `rows` lineitem rows (TPC-H row ratios).
+    Pipeline + data shapes live in benchmarks/tpch.py, shared with the
+    numpy-oracle correctness test."""
+    from benchmarks.tpch import generate_q3_tables, run_q3
+
+    datasets = [generate_q3_tables(rows, seed=s) for s in range(_NVARIANTS)]
+
+    def run(i):
+        out = run_q3(*datasets[i % _NVARIANTS])
+        return [c.data for c in out.columns]
+
+    sec = _time(run, warmup=_NVARIANTS)
+    cust, orders, _ = datasets[0]
+    nbytes = rows * 24 + orders.num_rows * 24 + cust.num_rows * 12
+    return sec, nbytes
+
+
 def bench_parquet_decode(rows: int):
     """BASELINE configs[3]-shaped: chunked decode of a lineitem-like file
     (ints, FLBA decimals, date32, low-card + comment strings, snappy)."""
@@ -266,7 +286,7 @@ def main():
     ap.add_argument("--bench", default="all",
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
-                             "join", "sort", "parquet_decode"])
+                             "join", "sort", "tpch_q3", "parquet_decode"])
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
@@ -298,6 +318,9 @@ def main():
     if args.bench in ("all", "sort"):
         runs.append(("sort", "int64", args.rows,
                      lambda: bench_sort(args.rows)))
+    if args.bench in ("all", "tpch_q3"):
+        runs.append(("tpch_q3", "filter+2join+groupby+sort", args.rows,
+                     lambda: bench_tpch_q3(args.rows)))
     if args.bench in ("all", "parquet_decode"):
         prows = min(args.rows, 1_000_000)
         runs.append(("parquet_decode", "lineitem-shaped snappy", prows,

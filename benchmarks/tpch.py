@@ -1,0 +1,86 @@
+"""TPC-H q3-shaped operator pipeline shared by the benchmark and its
+correctness test (BASELINE configs[2]).
+
+The query: filter customer by market segment and orders/lineitem by date,
+join orders⋈customer and lineitem⋈orders, sum revenue per (orderkey,
+orderdate, shippriority), sort by revenue desc / orderdate asc, take top 10.
+Money stays in int64 cents: exact and integer-lane friendly (f64 device
+storage is lossy on TPU — docs/TPU_NUMERICS.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.table_ops import (
+    filter_table,
+    gather_table,
+    slice_table,
+)
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.ops.sort import sort_table
+
+CUTOFF_DAYS = 1200  # "1995-03-15" as days into the generated date range
+
+
+def generate_q3_tables(rows: int, seed: int):
+    """(customer, orders, lineitem) Tables at `rows` lineitem rows with
+    TPC-H row ratios (orders = rows/4, customer = rows/40).
+
+    customer: (c_custkey i64, c_mktsegment-code i32)
+    orders:   (o_orderkey i64, o_custkey i64, o_orderdate-days i32,
+               o_shippriority i32)
+    lineitem: (l_orderkey i64, l_shipdate-days i32,
+               l_extendedprice-cents i64, l_discount-pct i32)
+    """
+    ncust = max(rows // 40, 16)
+    nord = max(rows // 4, 16)
+    rng = np.random.default_rng(seed)
+    cust = Table((
+        Column.from_numpy(np.arange(ncust, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, 5, ncust).astype(np.int32),
+                          dt.INT32),
+    ))
+    orders = Table((
+        Column.from_numpy(np.arange(nord, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, ncust, nord), dt.INT64),
+        Column.from_numpy(rng.integers(0, 2400, nord).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(0, 3, nord).astype(np.int32),
+                          dt.INT32),
+    ))
+    lineitem = Table((
+        Column.from_numpy(rng.integers(0, nord, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, 2400, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(90000, 10500000, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, 11, rows).astype(np.int32),
+                          dt.INT32),
+    ))
+    return cust, orders, lineitem
+
+
+def run_q3(cust: Table, orders: Table, lineitem: Table,
+           cutoff: int = CUTOFF_DAYS, segment_code: int = 1,
+           top_k: int = 10) -> Table:
+    """Execute the q3 pipeline; returns the top-k Table of
+    (l_orderkey, o_orderdate, o_shippriority, revenue)."""
+    cust_f = filter_table(cust, cust.columns[1].data == segment_code)
+    ord_f = filter_table(orders, orders.columns[2].data < cutoff)
+    oi, _ = inner_join([ord_f.columns[1]], [cust_f.columns[0]])
+    ord_j = gather_table(ord_f, jnp.asarray(oi))
+    li_f = filter_table(lineitem, lineitem.columns[1].data > cutoff)
+    lii, ori = inner_join([li_f.columns[0]], [ord_j.columns[0]])
+    li_j = gather_table(li_f, jnp.asarray(lii))
+    ord_jj = gather_table(ord_j, jnp.asarray(ori))
+    rev = (li_j.columns[2].data.astype(jnp.int64)
+           * (100 - li_j.columns[3].data.astype(jnp.int64)))
+    gt = Table((li_j.columns[0], ord_jj.columns[2], ord_jj.columns[3],
+                Column(dt.INT64, int(rev.shape[0]), data=rev)))
+    g = groupby_aggregate(gt, [0, 1, 2], [(3, "sum")])
+    top = sort_table(g, [3, 1], ascending=[False, True])
+    return slice_table(top, 0, min(top_k, g.num_rows))

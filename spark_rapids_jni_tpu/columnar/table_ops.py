@@ -93,3 +93,24 @@ def slice_table(table: Table, start: int, end: int) -> Table:
     """Row slice [start, end) of every column."""
     idx = jnp.arange(start, end, dtype=jnp.int32)
     return gather_table(table, idx)
+
+
+def filter_mask_indices(mask) -> jnp.ndarray:
+    """int32 row indices where ``mask`` is True, in row order. One host sync
+    (the surviving-row count — a data-dependent output shape, same contract
+    as join gather-map sizing)."""
+    mask = jnp.asarray(mask, dtype=bool)
+    m = int(jnp.sum(mask))
+    return jnp.nonzero(mask, size=m, fill_value=0)[0].astype(jnp.int32)
+
+
+def filter_table(table: Table, mask) -> Table:
+    """Keep rows where ``mask`` (bool[n]) is True — stream-compaction analog
+    of cudf::apply_boolean_mask, which the reference consumes from the
+    vendored layer for every GpuFilterExec. Errors on size mismatch (cudf
+    contract) rather than silently clipping gathered indices."""
+    mask = jnp.asarray(mask, dtype=bool)
+    if mask.shape[0] != table.num_rows:
+        raise ValueError(f"boolean mask length {mask.shape[0]} != table rows "
+                         f"{table.num_rows}")
+    return gather_table(table, filter_mask_indices(mask))

@@ -113,8 +113,9 @@ def _groupby_aggregate(
     seg_ids = jnp.cumsum(boundary) - 1
     num_segments = int(seg_ids[-1]) + 1
 
-    # representative row of each group (first sorted row)
-    first_in_seg = jnp.asarray(np.flatnonzero(np.asarray(boundary)))
+    # representative row of each group (first sorted row); num_segments is
+    # already synced, so the boundary→index expansion stays on device
+    first_in_seg = jnp.nonzero(boundary, size=num_segments)[0]
     rep_rows = jnp.take(order, first_in_seg)
 
     out_cols = [gather(k, rep_rows) for k in keys]

@@ -60,3 +60,53 @@ def test_outer_join_payload_application():
     out = gather_table(rpayload, ri, out_of_bounds_null=True)
     by_left = dict(zip(li.tolist(), out.columns[0].to_pylist()))
     assert by_left == {0: "one", 1: None, 2: "two"}
+
+
+def test_filter_table():
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    t = Table((Column.from_pylist([1, 2, 3, 4, 5], dt.INT64),
+               Column.from_pylist(["a", "bb", None, "dddd", ""], dt.STRING)))
+    mask = np.array([True, False, True, True, False])
+    out = filter_table(t, mask)
+    assert out.columns[0].to_pylist() == [1, 3, 4]
+    assert out.columns[1].to_pylist() == ["a", None, "dddd"]
+    # empty selection keeps schema, zero rows
+    none = filter_table(t, np.zeros(5, dtype=bool))
+    assert none.columns[0].to_pylist() == []
+    assert none.columns[1].to_pylist() == []
+
+
+def test_tpch_q3_pipeline_matches_numpy_oracle():
+    """The exact q3 pipeline the benchmark times (benchmarks/tpch.py) agrees
+    with a plain python evaluation of the same query on small data."""
+    from benchmarks.tpch import CUTOFF_DAYS, generate_q3_tables, run_q3
+
+    cust, orders, li = generate_q3_tables(600, seed=3)
+    cutoff = CUTOFF_DAYS
+    c_key, c_seg = (c.to_pylist() for c in cust.columns)
+    o_key, o_cust, o_date, o_prio = (c.to_pylist() for c in orders.columns)
+    l_ord, l_ship, l_price, l_disc = (c.to_pylist() for c in li.columns)
+
+    # python oracle
+    keep_c = {k for k, s in zip(c_key, c_seg) if s == 1}
+    keep_o = {k: d for k, c, d in zip(o_key, o_cust, o_date)
+              if d < cutoff and c in keep_c}
+    agg = {}
+    for ok, sd, pr, di in zip(l_ord, l_ship, l_price, l_disc):
+        if sd > cutoff and ok in keep_o:
+            agg[ok] = agg.get(ok, 0) + int(pr) * (100 - int(di))
+    oracle = sorted(((rev, keep_o[ok], ok) for ok, rev in agg.items()),
+                    key=lambda t: (-t[0], t[1]))[:10]
+
+    out = run_q3(cust, orders, li)
+    got = list(zip(out.columns[3].to_pylist(), out.columns[1].to_pylist(),
+                   out.columns[0].to_pylist()))
+    assert [(r, d) for r, d, _ in got] == [(r, d) for r, d, _ in oracle]
+
+
+def test_filter_table_mask_length_mismatch():
+    import pytest
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    t = Table((Column.from_pylist([1, 2, 3], dt.INT64),))
+    with pytest.raises(ValueError, match="mask length"):
+        filter_table(t, np.array([True, False]))
