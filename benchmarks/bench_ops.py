@@ -23,14 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _ensure_backend():
-    import jax
-    try:
-        jax.devices()
-    except RuntimeError as e:
-        print(f"bench: accelerator unavailable ({e}); using cpu",
-              file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-        jax.devices()
+    """Delegates to bench.py's tunnel-hang-safe backend selection: device
+    init runs in-process under a watchdog thread that re-execs this script
+    CPU-pinned (axon plugin registration dropped) if init wedges."""
+    import bench
+    bench._ensure_backend()
 
 
 def _time(fn, warmup=1, iters=3):

@@ -66,21 +66,34 @@ def generate_q3_tables(rows: int, seed: int):
 
 def run_q3(cust: Table, orders: Table, lineitem: Table,
            cutoff: int = CUTOFF_DAYS, segment_code: int = 1,
-           top_k: int = 10) -> Table:
+           top_k: int = 10, mesh=None) -> Table:
     """Execute the q3 pipeline; returns the top-k Table of
-    (l_orderkey, o_orderdate, o_shippriority, revenue)."""
+    (l_orderkey, o_orderdate, o_shippriority, revenue).
+
+    With ``mesh`` (a jax.sharding.Mesh), the joins and the groupby run
+    distributed: hash-partition exchanges over the mesh, local kernels per
+    partition (parallel/distributed). Filters are embarrassingly parallel
+    and the final sort sees only group-count rows, so both stay local.
+    """
+    if mesh is not None:
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_groupby, distributed_inner_join)
+        join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
+        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+    else:
+        join, group = inner_join, groupby_aggregate
     cust_f = filter_table(cust, cust.columns[1].data == segment_code)
     ord_f = filter_table(orders, orders.columns[2].data < cutoff)
-    oi, _ = inner_join([ord_f.columns[1]], [cust_f.columns[0]])
+    oi, _ = join([ord_f.columns[1]], [cust_f.columns[0]])
     ord_j = gather_table(ord_f, jnp.asarray(oi))
     li_f = filter_table(lineitem, lineitem.columns[1].data > cutoff)
-    lii, ori = inner_join([li_f.columns[0]], [ord_j.columns[0]])
+    lii, ori = join([li_f.columns[0]], [ord_j.columns[0]])
     li_j = gather_table(li_f, jnp.asarray(lii))
     ord_jj = gather_table(ord_j, jnp.asarray(ori))
     rev = (li_j.columns[2].data.astype(jnp.int64)
            * (100 - li_j.columns[3].data.astype(jnp.int64)))
     gt = Table((li_j.columns[0], ord_jj.columns[2], ord_jj.columns[3],
                 Column(dt.INT64, int(rev.shape[0]), data=rev)))
-    g = groupby_aggregate(gt, [0, 1, 2], [(3, "sum")])
+    g = group(gt, [0, 1, 2], [(3, "sum")])
     top = sort_table(g, [3, 1], ascending=[False, True])
     return slice_table(top, 0, min(top_k, g.num_rows))

@@ -130,3 +130,17 @@ def test_row_conversion_roundtrip_per_partition(mesh):
     want = sort_table(t, [0, 1])
     for gc, wc in zip(whole.columns, want.columns):
         assert gc.to_pylist() == wc.to_pylist()
+
+
+def test_distributed_q3_matches_local(mesh):
+    """The full q3 query pipeline (filter -> 2 joins -> groupby -> sort)
+    distributed over the mesh returns the same top-k as the local run."""
+    from benchmarks.tpch import generate_q3_tables, run_q3
+    cust, orders, li = generate_q3_tables(2000, seed=11)
+    local = run_q3(cust, orders, li)
+    dist = run_q3(cust, orders, li, mesh=mesh)
+    # orderdate/shippriority/revenue are deterministic; only orderkey may
+    # differ, on exact (revenue, orderdate) ties
+    lv = list(zip(*(local.columns[i].to_pylist() for i in (1, 2, 3))))
+    dv = list(zip(*(dist.columns[i].to_pylist() for i in (1, 2, 3))))
+    assert lv == dv
