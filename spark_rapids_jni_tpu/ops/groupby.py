@@ -124,7 +124,8 @@ def _groupby_aggregate(
         vcol = table.columns[ci]
         valid = jnp.take(vcol.valid_mask(), order)
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_ids,
-                                  num_segments=num_segments)
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
         if op == "count":
             out_cols.append(Column(dt.INT64, num_segments, data=cnt))
             continue
@@ -133,7 +134,8 @@ def _groupby_aggregate(
         any_valid = cnt > 0
         if op in ("sum", "mean"):
             z = jnp.where(valid, vals, jnp.zeros_like(vals))
-            s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments)
+            s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments,
+                                    indices_are_sorted=True)
             if op == "mean":
                 m = s / jnp.maximum(cnt, 1).astype(s.dtype)
                 out_cols.append(Column.from_numpy(
@@ -145,12 +147,14 @@ def _groupby_aggregate(
             big = (jnp.asarray(np.inf, vals.dtype) if is_float
                    else jnp.iinfo(jnp.int64).max)
             z = jnp.where(valid, vals, big)
-            res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments)
+            res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments,
+                                      indices_are_sorted=True)
         elif op == "max":
             small = (jnp.asarray(-np.inf, vals.dtype) if is_float
                      else jnp.iinfo(jnp.int64).min)
             z = jnp.where(valid, vals, small)
-            res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments)
+            res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments,
+                                      indices_are_sorted=True)
         else:
             raise ValueError(f"unknown aggregation {op}")
         out_dtype = _agg_out_dtype(vcol.dtype, op)

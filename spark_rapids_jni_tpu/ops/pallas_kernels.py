@@ -1,0 +1,172 @@
+"""Pallas TPU kernels for hot fixed-width paths.
+
+First kernel: the Spark murmur3_32 row hash over fixed-width columns — the
+headline benchmark path (reference: thread-per-row functor dispatch,
+murmur_hash.cu:187). The XLA path in ops/hashing is a fused elementwise
+chain already; the pallas version pins the whole per-column mixing chain in
+VMEM with explicit (sublane, lane) tiling so the only HBM traffic is one
+stream in per lane and one stream out, with zero intermediate
+materialization risk. Pure uint32 VPU ops — no MXU, no 64-bit lanes (64-bit
+values arrive pre-split into lo/hi uint32 lanes).
+
+Routing: ops/hashing consults `hashing.pallas` config ("auto" = use on a
+real accelerator backend, interpret-free; "on" forces it, interpreted on
+CPU — used by tests; "off" never).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROWS_PER_BLOCK = 2048  # (16, 128) uint32 tiles per lane per grid step
+_LANE = 128
+_SUB = ROWS_PER_BLOCK // _LANE
+
+
+def _mm_constants():
+    # import here: hashing imports this module's public entry lazily too
+    from . import hashing as H
+    return H
+
+
+def build_murmur3_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
+                               seed: int):
+    """Kernel body for a (kind, has_mask) schema, kind in {'u32','u64'}.
+
+    Input refs, in order: for each column its value lane(s) — one uint32
+    lane for 'u32', lo+hi uint32 lanes for 'u64' — then, if has_mask, a
+    uint32 validity lane (0 = null: the row's seed passes through,
+    murmur_hash.cu:40-58). One output ref: the uint32 row hash lane.
+    """
+    H = _mm_constants()
+    seed_u32 = np.uint32(seed & 0xFFFFFFFF)
+
+    def kernel(*refs):
+        out_ref = refs[-1]
+        h = jnp.full((_SUB, _LANE), seed_u32, dtype=jnp.uint32)
+        i = 0
+        for kind, has_mask in schema:
+            if kind == "u32":
+                k = refs[i][...]
+                i += 1
+                nh = H._mm_fmix(H._mm_block(h, k), np.uint32(4))
+            else:
+                lo = refs[i][...]
+                hi = refs[i + 1][...]
+                i += 2
+                nh = H._mm_fmix(H._mm_block(H._mm_block(h, lo), hi),
+                                np.uint32(8))
+            if has_mask:
+                m = refs[i][...]
+                i += 1
+                nh = jnp.where(m != 0, nh, h)
+            h = nh
+        out_ref[...] = h
+
+    return kernel
+
+
+@lru_cache(maxsize=64)
+def _murmur3_fixed_fn(schema: Tuple[Tuple[str, bool], ...], seed: int,
+                      interpret: bool):
+    """One jitted pad→tile→pallas_call program per (schema, seed,
+    interpret): the kernel closure is built once, so jax's dispatch cache
+    hits on repeated hash calls (shape changes re-specialize under the same
+    jit) instead of re-tracing a fresh pallas_call every time."""
+    from jax.experimental import pallas as pl
+
+    kernel = build_murmur3_fixed_kernel(schema, seed)
+
+    @partial(jax.jit, static_argnames=("n",))
+    def run(lanes, *, n):
+        n_pad = max(ROWS_PER_BLOCK,
+                    ((n + ROWS_PER_BLOCK - 1) // ROWS_PER_BLOCK)
+                    * ROWS_PER_BLOCK)
+
+        def shape2d(x):
+            x = jnp.pad(x.astype(jnp.uint32), (0, n_pad - n))
+            return x.reshape(n_pad // _LANE, _LANE)
+
+        ins = [shape2d(x) for x in lanes]
+        spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_pad // ROWS_PER_BLOCK,),
+            in_specs=[spec] * len(ins),
+            out_specs=pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_pad // _LANE, _LANE),
+                                           jnp.uint32),
+            interpret=interpret,
+        )(*ins)
+        return out.reshape(-1)[:n]
+
+    return run
+
+
+def murmur3_fixed_rows(lanes: Sequence[jnp.ndarray],
+                       schema: Tuple[Tuple[str, bool], ...],
+                       seed: int, n: int,
+                       interpret: bool = False) -> jnp.ndarray:
+    """uint32[n] Spark murmur3 row hashes from pre-split uint32 lanes.
+
+    `lanes` is the flat input list matching `schema` (see
+    build_murmur3_fixed_kernel). Rows are padded to ROWS_PER_BLOCK; padded
+    rows hash garbage and are sliced off.
+    """
+    return _murmur3_fixed_fn(schema, seed, interpret)(tuple(lanes), n=n)
+
+
+def split_u64_lanes(words: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """u64[n] -> (lo, hi) uint32 lanes (no 64-bit ops inside the kernel)."""
+    lo = (words & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (words >> np.uint64(32)).astype(jnp.uint32)
+    return lo, hi
+
+
+def pallas_mode() -> str:
+    """Resolved hashing.pallas config: 'on' | 'off' | 'auto'."""
+    from ..utils import config
+    return str(config.get("hashing.pallas")).lower()
+
+
+def murmur3_pallas_route(units, n: int) -> Optional[List]:
+    """If every hash unit is a fixed-width (non-decimal128) leaf and the
+    config allows, return the (lanes, schema, interpret) route; else None."""
+    from ..columnar.dtype import TypeId
+    from . import hashing as H
+
+    mode = pallas_mode()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"hashing.pallas must be auto|on|off, got {mode!r}")
+    if mode == "off" or n == 0:
+        return None
+    backend = jax.default_backend()
+    if mode == "auto" and backend not in ("tpu", "axon"):
+        # interpreted pallas (cpu) is slower than the fused XLA chain, and
+        # this kernel's (16,128) uint32 tiling is TPU-specific — don't
+        # auto-route other accelerators onto it
+        return None
+    interpret = backend == "cpu"
+
+    lanes: List[jnp.ndarray] = []
+    schema: List[Tuple[str, bool]] = []
+    for u in units:
+        tid = u.col.dtype.id
+        if (u.list_chain or tid in (TypeId.STRING, TypeId.DECIMAL128)
+                or u.col.dtype.is_nested):
+            return None
+        kind, words = H._fixed_element_words(u.col.dtype, u.col.data, False)
+        if kind == "u64":
+            lanes.extend(split_u64_lanes(words))
+        else:
+            lanes.append(words)
+        has_mask = u.valid is not None
+        if has_mask:
+            lanes.append(u.valid.astype(jnp.uint32))
+        schema.append((kind, has_mask))
+    return [lanes, tuple(schema), interpret]

@@ -64,6 +64,9 @@ _register("faultinj.config", "FAULT_INJECTOR_CONFIG_PATH", "", str,
 _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "input variants cycled by benchmarks to defeat identical-args "
           "elision")
+_register("hashing.pallas", "SRJT_HASH_PALLAS", "auto", str,
+          "murmur3 fixed-width row hash via the pallas VMEM kernel: "
+          "auto (accelerator only) | on (interpreted on CPU; tests) | off")
 
 
 def get(key: str) -> Any:

@@ -1,0 +1,76 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh): the murmur3
+fixed-width row-hash kernel must agree bit-for-bit with the vectorized XLA
+path, which is itself pinned to Spark golden vectors in test_hashing."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+from spark_rapids_jni_tpu.utils import config
+
+
+def _mixed_table(n=4111, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    v = (lambda: rng.random(n) > 0.25) if with_nulls else (lambda: None)
+    cols = (
+        Column.from_numpy(rng.integers(-2**31, 2**31, n).astype(np.int32),
+                          validity=v()),
+        Column.from_numpy(rng.integers(-2**62, 2**62, n), validity=v()),
+        Column.from_numpy(rng.standard_normal(n).astype(np.float32),
+                          validity=v()),
+        Column.from_numpy(rng.standard_normal(n), dt.FLOAT64, validity=v()),
+        Column.from_numpy(rng.integers(0, 2, n).astype(np.uint8), dt.BOOL8,
+                          validity=v()),
+        Column.from_numpy(rng.integers(-128, 127, n).astype(np.int8),
+                          validity=v()),
+    )
+    return Table(cols)
+
+
+def _both_paths(t, seed):
+    with config.override("hashing.pallas", "on"):   # interpreted on CPU
+        got = murmur_hash3_32(t, seed=seed).to_pylist()
+    with config.override("hashing.pallas", "off"):
+        want = murmur_hash3_32(t, seed=seed).to_pylist()
+    return got, want
+
+
+def test_pallas_murmur_matches_xla():
+    got, want = _both_paths(_mixed_table(), 42)
+    assert got == want
+
+
+def test_pallas_murmur_no_nulls_and_seeds():
+    t = _mixed_table(n=257, with_nulls=False)
+    for seed in (0, 42, -1):
+        got, want = _both_paths(t, seed)
+        assert got == want
+
+
+def test_pallas_route_declines_strings():
+    """STRING columns fall back to the XLA path regardless of config."""
+    t = Table((Column.from_pylist(["a", "bb", None], dt.STRING),
+               Column.from_pylist([1, 2, 3], dt.INT64)))
+    got, want = _both_paths(t, 42)
+    assert got == want
+
+
+def test_pallas_golden_int_vector():
+    c = Column.from_pylist([0, 100, -100, 0x12345678], dt.INT32)
+    got, want = _both_paths(Table((c,)), 42)
+    assert got == want
+
+
+def test_pallas_all_null_passes_seed_through():
+    t = Table((Column.from_pylist([None, None], dt.INT64),))
+    with config.override("hashing.pallas", "on"):
+        assert murmur_hash3_32(t, seed=42).to_pylist() == [42, 42]
+
+
+def test_pallas_bad_mode_raises():
+    t = Table((Column.from_pylist([1], dt.INT64),))
+    with config.override("hashing.pallas", "atuo"):
+        with pytest.raises(ValueError, match="auto|on|off"):
+            murmur_hash3_32(t, seed=42)
