@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -87,19 +88,23 @@ def _candidates(left_keys, right_keys, nulls_equal):
         total, state = _candidate_counts(left_keys, right_keys, nulls_equal)
         release_barrier(state, took)
     if total == 0:
-        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+        return (jnp.zeros(0, dtype=jnp.int64), jnp.zeros(0, dtype=jnp.int64))
     # expansion working set is data-dependent: re-bracket now that the
     # candidate-pair count is known (phase-1 arrays stay live → included);
-    # per-pair cost covers the index/verify columns (24 B) plus the padded
-    # byte rows _col_equal gathers per candidate for wide keys
-    per_pair = 24
+    # per-pair: 24 B of expansion indices + 24 B of device compaction (sel
+    # vector + two int64 output maps) + the padded byte rows _col_equal
+    # gathers per candidate for wide keys
+    per_pair = 48
     for lc, rc in zip(left_keys, right_keys):
         per_pair += _verify_width(lc) + _verify_width(rc)
     with device_reservation(2 * in_bytes + total * per_pair) as took:
         out = _expand_and_verify(left_keys, right_keys, nulls_equal, total,
                                  state)
-        release_barrier(state, took)  # out is host numpy; state backs it
-        return out
+        # framework-wide contract: reservations bracket an op's *transient*
+        # working set; the returned arrays (device gather maps here, device
+        # Columns for sort/groupby) are the caller's accounting, same as
+        # the reference's RMM brackets ending when do_allocate returns
+        return release_barrier(out, took)
 
 
 def _verify_width(col: Column) -> int:
@@ -145,7 +150,8 @@ def _candidate_counts(left_keys, right_keys, nulls_equal):
 
 def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     """Phase 2: expand candidate pairs on device and verify exact equality.
-    Host-syncs only the verified-match compaction (sync #2)."""
+    The compaction stays on device — only the verified-match *count* syncs
+    to host (sync #2); the gather maps themselves never round-trip."""
     order, lo, cnt, nl = state
     l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
                        total_repeat_length=total)
@@ -156,14 +162,25 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     keep = jnp.ones(total, dtype=bool)
     for lc, rc in zip(left_keys, right_keys):
         keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
-    keep_h = np.asarray(keep)  # host sync #2: verified-match compaction
-    return (np.asarray(l_idx)[keep_h].astype(np.int64),
-            np.asarray(r_idx)[keep_h].astype(np.int64))
+    if jax.default_backend() == "cpu":
+        # host compaction: numpy boolean indexing beats XLA:CPU nonzero,
+        # and there is no transfer cost to avoid
+        keep_h = np.asarray(keep)
+        return (jnp.asarray(np.asarray(l_idx)[keep_h].astype(np.int64)),
+                jnp.asarray(np.asarray(r_idx)[keep_h].astype(np.int64)))
+    # accelerator: compact on device — only the verified-match count syncs;
+    # the blob-sized mask and index arrays never cross the host boundary
+    nkeep = int(jnp.sum(keep))  # host sync #2: verified-match count
+    sel = jnp.nonzero(keep, size=nkeep, fill_value=0)[0]
+    return (jnp.take(l_idx, sel).astype(jnp.int64),
+            jnp.take(r_idx, sel).astype(jnp.int64))
 
 
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
-               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
-    """Gather maps (left_indices, right_indices) of matching row pairs."""
+               nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Gather maps (left_indices, right_indices) of matching row pairs —
+    device-resident int64 index arrays (apply with table_ops.gather_table;
+    np.asarray() them only if host logic needs them)."""
     return _candidates(left_keys, right_keys, nulls_equal)
 
 
@@ -171,6 +188,7 @@ def left_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Left outer join; unmatched left rows get right index -1."""
     l_idx, r_idx = _candidates(left_keys, right_keys, nulls_equal)
+    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)  # one D2H each
     matched = np.zeros(left_keys[0].size, dtype=bool)
     matched[l_idx] = True
     miss = np.where(~matched)[0]
@@ -182,6 +200,7 @@ def full_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Full outer join; unmatched rows get -1 on the other side."""
     l_idx, r_idx = _candidates(left_keys, right_keys, nulls_equal)
+    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)  # one D2H each
     lmatched = np.zeros(left_keys[0].size, dtype=bool)
     lmatched[l_idx] = True
     rmatched = np.zeros(right_keys[0].size, dtype=bool)
@@ -198,6 +217,7 @@ def left_semi_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with at least one match."""
     l_idx, _ = _candidates(left_keys, right_keys, nulls_equal)
+    l_idx = np.asarray(l_idx)
     matched = np.zeros(left_keys[0].size, dtype=bool)
     matched[l_idx] = True
     return np.where(matched)[0]
@@ -207,6 +227,7 @@ def left_anti_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with no match."""
     l_idx, _ = _candidates(left_keys, right_keys, nulls_equal)
+    l_idx = np.asarray(l_idx)
     matched = np.zeros(left_keys[0].size, dtype=bool)
     matched[l_idx] = True
     return np.where(~matched)[0]
