@@ -227,6 +227,23 @@ def bench_tpch_q3(rows: int):
     return sec, nbytes
 
 
+def bench_tpch_q5(rows: int):
+    """BASELINE configs[2]-shaped: the TPC-H q5 operator pipeline — four
+    joins, a co-nation predicate, groupby-sum per nation, sort. Pipeline in
+    benchmarks/tpch.py, shared with the oracle test."""
+    from benchmarks.tpch import generate_q5_tables, run_q5
+
+    datasets = [generate_q5_tables(rows, seed=s) for s in range(_NVARIANTS)]
+
+    def run(i):
+        out = run_q5(*datasets[i % _NVARIANTS])
+        return [c.data for c in out.columns]
+
+    sec = _time(run, warmup=_NVARIANTS)
+    nbytes = rows * 28
+    return sec, nbytes
+
+
 def bench_parquet_decode(rows: int):
     """BASELINE configs[3]-shaped: chunked decode of a lineitem-like file
     (ints, FLBA decimals, date32, low-card + comment strings, snappy)."""
@@ -283,7 +300,8 @@ def main():
     ap.add_argument("--bench", default="all",
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
-                             "join", "sort", "tpch_q3", "parquet_decode"])
+                             "join", "sort", "tpch_q3", "tpch_q5",
+                             "parquet_decode"])
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
@@ -318,6 +336,9 @@ def main():
     if args.bench in ("all", "tpch_q3"):
         runs.append(("tpch_q3", "filter+2join+groupby+sort", args.rows,
                      lambda: bench_tpch_q3(args.rows)))
+    if args.bench in ("all", "tpch_q5"):
+        runs.append(("tpch_q5", "4join+conation+groupby+sort", args.rows,
+                     lambda: bench_tpch_q5(args.rows)))
     if args.bench in ("all", "parquet_decode"):
         prows = min(args.rows, 1_000_000)
         runs.append(("parquet_decode", "lineitem-shaped snappy", prows,

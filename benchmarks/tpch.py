@@ -64,6 +64,103 @@ def generate_q3_tables(rows: int, seed: int):
     return cust, orders, lineitem
 
 
+def generate_q5_tables(rows: int, seed: int):
+    """(customer, orders, lineitem, supplier, nation) Tables at `rows`
+    lineitem rows, TPC-H ratios (orders=rows/4, customer=rows/40,
+    supplier=rows/600). Nation carries its region code so the region filter
+    is a column predicate; names stay integer codes (Spark would dictionary-
+    encode them the same way).
+
+    customer: (c_custkey i64, c_nationkey i32)
+    orders:   (o_orderkey i64, o_custkey i64, o_orderdate-days i32)
+    lineitem: (l_orderkey i64, l_suppkey i64, l_extendedprice-cents i64,
+               l_discount-pct i32)
+    supplier: (s_suppkey i64, s_nationkey i32)
+    nation:   (n_nationkey i32->i64 key col, n_regionkey i32)
+    """
+    ncust = max(rows // 40, 16)
+    nord = max(rows // 4, 16)
+    nsupp = max(rows // 600, 8)
+    rng = np.random.default_rng(seed)
+    cust = Table((
+        Column.from_numpy(np.arange(ncust, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, 25, ncust).astype(np.int32),
+                          dt.INT32),
+    ))
+    orders = Table((
+        Column.from_numpy(np.arange(nord, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, ncust, nord), dt.INT64),
+        Column.from_numpy(rng.integers(0, 2400, nord).astype(np.int32),
+                          dt.INT32),
+    ))
+    lineitem = Table((
+        Column.from_numpy(rng.integers(0, nord, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, nsupp, rows), dt.INT64),
+        Column.from_numpy(rng.integers(90000, 10500000, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, 11, rows).astype(np.int32),
+                          dt.INT32),
+    ))
+    supplier = Table((
+        Column.from_numpy(np.arange(nsupp, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, 25, nsupp).astype(np.int32),
+                          dt.INT32),
+    ))
+    nation = Table((
+        Column.from_numpy(np.arange(25, dtype=np.int64), dt.INT64),
+        Column.from_numpy(rng.integers(0, 5, 25).astype(np.int32), dt.INT32),
+    ))
+    return cust, orders, lineitem, supplier, nation
+
+
+def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
+           nation: Table, region_code: int = 2, date_lo: int = 700,
+           date_hi: int = 1065, mesh=None) -> Table:
+    """TPC-H q5 shape: local-supplier-volume — region-filtered nations,
+    customer⋈orders (date window), lineitem⋈orders, lineitem⋈supplier, the
+    c_nationkey = s_nationkey co-nation predicate, then revenue per nation
+    sorted descending. Returns (n_nationkey, revenue)."""
+    if mesh is not None:
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_groupby, distributed_inner_join)
+        join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
+        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+    else:
+        join, group = inner_join, groupby_aggregate
+
+    # nations in the region; suppliers in those nations
+    nat_f = filter_table(nation, nation.columns[1].data == region_code)
+    si, _ = join([Column(dt.INT64, supplier.num_rows,
+                         data=supplier.columns[1].data.astype(jnp.int64))],
+                 [nat_f.columns[0]])
+    supp_f = gather_table(supplier, jnp.asarray(si))
+
+    # orders in the date window, joined to customers (carry c_nationkey)
+    od = orders.columns[2].data
+    ord_f = filter_table(orders, (od >= date_lo) & (od < date_hi))
+    oi, ci = join([ord_f.columns[1]], [cust.columns[0]])
+    ord_j = gather_table(ord_f, jnp.asarray(oi))
+    cust_j = gather_table(cust, jnp.asarray(ci))
+
+    # lineitem to its order (carry the customer's nation), then its supplier
+    lii, ori = join([lineitem.columns[0]], [ord_j.columns[0]])
+    li_j = gather_table(lineitem, jnp.asarray(lii))
+    cnat = gather_table(Table((cust_j.columns[1],)), jnp.asarray(ori))
+    si2, spi = join([li_j.columns[1]], [supp_f.columns[0]])
+    li_jj = gather_table(li_j, jnp.asarray(si2))
+    cnat_j = gather_table(cnat, jnp.asarray(si2))
+    snat = gather_table(Table((supp_f.columns[1],)), jnp.asarray(spi))
+
+    # local-supplier predicate: customer and supplier share a nation
+    same = cnat_j.columns[0].data == snat.columns[0].data
+    li_f = filter_table(li_jj, same)
+    nat_key = filter_table(snat, same).columns[0]
+    rev = (li_f.columns[2].data.astype(jnp.int64)
+           * (100 - li_f.columns[3].data.astype(jnp.int64)))
+    gt = Table((nat_key, Column(dt.INT64, int(rev.shape[0]), data=rev)))
+    g = group(gt, [0], [(1, "sum")])
+    return sort_table(g, [1], ascending=[False])
+
+
 def run_q3(cust: Table, orders: Table, lineitem: Table,
            cutoff: int = CUTOFF_DAYS, segment_code: int = 1,
            top_k: int = 10, mesh=None) -> Table:

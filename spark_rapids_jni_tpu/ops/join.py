@@ -37,6 +37,11 @@ from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values, xxhash64
 
 
+def _backend() -> str:
+    """Seam for tests to force the accelerator compaction branch."""
+    return jax.default_backend()
+
+
 def _row_hash(cols: Sequence[Column]) -> jnp.ndarray:
     return xxhash64(Table(tuple(cols))).data.astype(jnp.uint64)
 
@@ -88,7 +93,9 @@ def _candidates(left_keys, right_keys, nulls_equal):
         total, state = _candidate_counts(left_keys, right_keys, nulls_equal)
         release_barrier(state, took)
     if total == 0:
-        return (jnp.zeros(0, dtype=jnp.int64), jnp.zeros(0, dtype=jnp.int64))
+        z = np.zeros(0, dtype=np.int64)
+        return (z, z) if _backend() == "cpu" else (jnp.asarray(z),
+                                                   jnp.asarray(z))
     # expansion working set is data-dependent: re-bracket now that the
     # candidate-pair count is known (phase-1 arrays stay live → included);
     # per-pair: 24 B of expansion indices + 24 B of device compaction (sel
@@ -162,12 +169,13 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     keep = jnp.ones(total, dtype=bool)
     for lc, rc in zip(left_keys, right_keys):
         keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
-    if jax.default_backend() == "cpu":
+    if _backend() == "cpu":
         # host compaction: numpy boolean indexing beats XLA:CPU nonzero,
-        # and there is no transfer cost to avoid
+        # and there is no transfer cost to avoid; return host arrays so the
+        # outer-join wrappers' host logic pays no round trip either
         keep_h = np.asarray(keep)
-        return (jnp.asarray(np.asarray(l_idx)[keep_h].astype(np.int64)),
-                jnp.asarray(np.asarray(r_idx)[keep_h].astype(np.int64)))
+        return (np.asarray(l_idx)[keep_h].astype(np.int64),
+                np.asarray(r_idx)[keep_h].astype(np.int64))
     # accelerator: compact on device — only the verified-match count syncs;
     # the blob-sized mask and index arrays never cross the host boundary
     nkeep = int(jnp.sum(keep))  # host sync #2: verified-match count
@@ -179,8 +187,9 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
                nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather maps (left_indices, right_indices) of matching row pairs —
-    device-resident int64 index arrays (apply with table_ops.gather_table;
-    np.asarray() them only if host logic needs them)."""
+    backend-natural int64 index arrays: device-resident on accelerators
+    (apply with table_ops.gather_table; np.asarray() only if host logic
+    needs them), host numpy on the CPU backend."""
     return _candidates(left_keys, right_keys, nulls_equal)
 
 
@@ -193,7 +202,7 @@ def left_join(left_keys, right_keys,
     matched[l_idx] = True
     miss = np.where(~matched)[0]
     return (np.concatenate([l_idx, miss]),
-            np.concatenate([r_idx, np.full(len(miss), -1, dtype=r_idx.dtype if len(r_idx) else np.int64)]))
+            np.concatenate([r_idx, np.full(len(miss), -1, dtype=np.int64)]))
 
 
 def full_join(left_keys, right_keys,

@@ -144,3 +144,13 @@ def test_distributed_q3_matches_local(mesh):
     lv = list(zip(*(local.columns[i].to_pylist() for i in (1, 2, 3))))
     dv = list(zip(*(dist.columns[i].to_pylist() for i in (1, 2, 3))))
     assert lv == dv
+
+
+def test_distributed_q5_matches_local(mesh):
+    from benchmarks.tpch import generate_q5_tables, run_q5
+    tables = generate_q5_tables(1500, seed=5)
+    local = run_q5(*tables)
+    dist = run_q5(*tables, mesh=mesh)
+    lv = dict(zip(local.columns[0].to_pylist(), local.columns[1].to_pylist()))
+    dv = dict(zip(dist.columns[0].to_pylist(), dist.columns[1].to_pylist()))
+    assert lv == dv

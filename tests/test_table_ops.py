@@ -110,3 +110,35 @@ def test_filter_table_mask_length_mismatch():
     t = Table((Column.from_pylist([1, 2, 3], dt.INT64),))
     with pytest.raises(ValueError, match="mask length"):
         filter_table(t, np.array([True, False]))
+
+
+def test_tpch_q5_pipeline_matches_python_oracle():
+    """The q5 pipeline (4 joins + co-nation predicate + groupby) agrees with
+    a plain python evaluation on small data."""
+    from benchmarks.tpch import generate_q5_tables, run_q5
+
+    cust, orders, li, supp, nation = generate_q5_tables(800, seed=7)
+    region_code, date_lo, date_hi = 2, 700, 1065
+    c_key, c_nat = (c.to_pylist() for c in cust.columns)
+    o_key, o_cust, o_date = (c.to_pylist() for c in orders.columns)
+    l_ord, l_supp, l_price, l_disc = (c.to_pylist() for c in li.columns)
+    s_key, s_nat = (c.to_pylist() for c in supp.columns)
+    n_key, n_reg = (c.to_pylist() for c in nation.columns)
+
+    nations = {k for k, r in zip(n_key, n_reg) if r == region_code}
+    supp_nat = {k: n for k, n in zip(s_key, s_nat) if n in nations}
+    cust_nat = dict(zip(c_key, c_nat))
+    ord_cnat = {k: cust_nat[c] for k, c, d in zip(o_key, o_cust, o_date)
+                if date_lo <= d < date_hi}
+    agg = {}
+    for ok, sk, pr, di in zip(l_ord, l_supp, l_price, l_disc):
+        if ok in ord_cnat and sk in supp_nat \
+                and supp_nat[sk] == ord_cnat[ok]:
+            n = supp_nat[sk]
+            agg[n] = agg.get(n, 0) + int(pr) * (100 - int(di))
+    oracle = sorted(agg.items(), key=lambda kv: -kv[1])
+
+    out = run_q5(cust, orders, li, supp, nation)
+    got = list(zip(out.columns[0].to_pylist(), out.columns[1].to_pylist()))
+    assert sorted(got, key=lambda kv: -kv[1]) == got  # sorted desc
+    assert dict(got) == dict(oracle)
