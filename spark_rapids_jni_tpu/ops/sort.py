@@ -35,7 +35,16 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
         mat, lengths = padded_bytes(col)
         # 0-padding sorts shorter strings first, matching byte-wise order
         # (strings containing NUL bytes tie with their prefixes; documented).
-        return [mat[:, i] for i in range(mat.shape[1])]
+        # Pack 4 bytes per BIG-endian u32 lane: unsigned order over a
+        # big-endian chunk == lexicographic byte order, 4x fewer sort
+        # operands than byte lanes, and u32 compares are native VPU ops
+        # (u64 would be limb-emulated — docs/TPU_NUMERICS.md §2). One
+        # vectorized build; byte fields are disjoint so sum == bitwise-or.
+        n, L = mat.shape  # L is a multiple of 8 (padded_bytes contract)
+        shifts = np.uint32(8) * jnp.arange(3, -1, -1, dtype=jnp.uint32)
+        w = jnp.sum(mat.reshape(n, L // 4, 4).astype(jnp.uint32)
+                    << shifts[None, None, :], axis=2, dtype=jnp.uint32)
+        return [w[:, c] for c in range(L // 4)]
     if tid is dt.TypeId.FLOAT64:
         # bit-pattern storage → Spark order: normalize first (all NaNs equal
         # and sort as one value above +inf; -0.0 ties 0.0 — matching the row
