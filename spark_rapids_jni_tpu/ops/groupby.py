@@ -51,6 +51,32 @@ def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
     return (v_cur & v_prev & same_val) | (~v_cur & ~v_prev)
 
 
+def _decimal128_segment_sum(vcol: Column, order, valid, seg_ids,
+                            num_segments: int, any_valid) -> Column:
+    """Exact 128-bit segmented sum: each u32 limb accumulates independently
+    in int64 lanes (limb sums stay < 2^63 for any group under 2^31 rows),
+    then one vectorized carry propagation per group reassembles the
+    two's-complement result mod 2^128 — negative addends enter as their
+    unsigned limb patterns, so the wrap *is* the signed sum. Matches the
+    vendored layer's wrapping sum; precision-overflow policy stays with the
+    caller, as in the reference plugin."""
+    limbs = jnp.take(vcol.data, order, axis=0)          # u32[n, 4] sorted
+    limbs = jnp.where(valid[:, None], limbs, jnp.uint32(0))
+    s = jax.ops.segment_sum(limbs.astype(jnp.int64), seg_ids,
+                            num_segments=num_segments,
+                            indices_are_sorted=True)    # i64[g, 4]
+    out = []
+    carry = jnp.zeros((num_segments,), dtype=jnp.int64)
+    for j in range(4):
+        t = s[:, j] + carry
+        out.append((t & np.int64(0xFFFFFFFF)).astype(jnp.uint32))
+        carry = t >> np.int64(32)  # t >= 0: limb sums and carries are
+        #                            nonnegative; signedness reappears only
+        #                            in the final mod-2^128 bit pattern
+    return Column(vcol.dtype, num_segments, data=jnp.stack(out, axis=1),
+                  validity=any_valid)
+
+
 def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
     """(numeric device array, is_float) for aggregation. Floats accumulate in
     f64: Spark promotes float to double before summing."""
@@ -59,14 +85,30 @@ def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
         return jnp.asarray(host), True
     if col.dtype.id is dt.TypeId.FLOAT32:
         return col.data.astype(jnp.float64), True
+    if col.dtype.id is dt.TypeId.DECIMAL128 or not col.dtype.is_fixed_width:
+        # DECIMAL128 limbs would sum per-limb without carries (silent
+        # garbage); route decimal128 aggregation through ops/decimal128
+        # arithmetic instead
+        raise TypeError(f"groupby aggregation unsupported for "
+                        f"{col.dtype.id.value} value columns")
     return col.data.astype(jnp.int64), False
 
 
 def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
-    """Result dtype of an aggregation, identical for empty and non-empty
-    inputs (Spark: sum(float/double)→double, sum(int)→long, mean→double)."""
+    """Result dtype of an aggregation — the single validation/dispatch table
+    shared by the empty and non-empty paths, so schemas and TypeErrors are
+    identical for 0-row partitions (Spark: sum(float/double)→double,
+    sum(int)→long, sum(decimal)→decimal same scale, mean→double)."""
     if op == "count":
         return dt.INT64
+    if vdtype.id is dt.TypeId.DECIMAL128:
+        if op != "sum":
+            raise TypeError(f"groupby {op} unsupported for decimal128 "
+                            f"(sum and count are)")
+        return vdtype
+    if not vdtype.is_fixed_width:
+        raise TypeError(f"groupby aggregation unsupported for "
+                        f"{vdtype.id.value} value columns")
     if op == "mean":
         return dt.FLOAT64
     if op == "sum":
@@ -100,8 +142,12 @@ def _groupby_aggregate(
         out_cols: List[Column] = [gather(k, order) for k in keys]
         for ci, op in aggs:
             od = _agg_out_dtype(table.columns[ci].dtype, op)
-            out_cols.append(Column.from_numpy(
-                np.zeros((0,), dtype=od.np_dtype), od))
+            if od.id is dt.TypeId.DECIMAL128:
+                out_cols.append(Column(od, 0,
+                                       data=jnp.zeros((0, 4), jnp.uint32)))
+            else:
+                out_cols.append(Column.from_numpy(
+                    np.zeros((0,), dtype=od.np_dtype), od))
         return Table(tuple(out_cols))
 
     same = jnp.ones(keys[0].size - 1, dtype=bool) \
@@ -122,12 +168,17 @@ def _groupby_aggregate(
 
     for ci, op in aggs:
         vcol = table.columns[ci]
+        out_dtype = _agg_out_dtype(vcol.dtype, op)  # validates op/type pair
         valid = jnp.take(vcol.valid_mask(), order)
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_ids,
                                   num_segments=num_segments,
                                   indices_are_sorted=True)
         if op == "count":
             out_cols.append(Column(dt.INT64, num_segments, data=cnt))
+            continue
+        if vcol.dtype.id is dt.TypeId.DECIMAL128:
+            out_cols.append(_decimal128_segment_sum(
+                vcol, order, valid, seg_ids, num_segments, cnt > 0))
             continue
         vals, is_float = _agg_values(vcol)
         vals = jnp.take(vals, order)
@@ -157,7 +208,6 @@ def _groupby_aggregate(
                                       indices_are_sorted=True)
         else:
             raise ValueError(f"unknown aggregation {op}")
-        out_dtype = _agg_out_dtype(vcol.dtype, op)
         if out_dtype.id is dt.TypeId.FLOAT64:
             out_cols.append(Column.from_numpy(
                 np.asarray(res, dtype=np.float64), dt.FLOAT64,

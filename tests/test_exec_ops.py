@@ -265,3 +265,53 @@ def test_join_device_compaction_branch(monkeypatch):
     el, er = J.inner_join([Column.from_pylist([9], dt.INT64)],
                           [Column.from_pylist([7], dt.INT64)])
     assert len(np.asarray(el)) == 0 and len(np.asarray(er)) == 0
+
+
+def test_groupby_decimal128_sum_exact():
+    """128-bit segmented sums are exact across limb boundaries, signs, and
+    nulls; unsupported ops and value types raise instead of corrupting."""
+    import pytest
+
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+
+    vals = [10**30, -3 * 10**30, 2**100, None, -1, 7, None]
+    keys = [1, 1, 1, 1, 2, 2, 3]
+    k = Column.from_pylist(keys, dt.INT64)
+    d = Column.from_pylist(vals, dt.decimal128(2))
+    g = sort_table(groupby_aggregate(Table((k, d)), [0],
+                                     [(1, "sum"), (1, "count")]), [0])
+    by_key = dict(zip(g.columns[0].to_pylist(),
+                      zip(g.columns[1].to_pylist(), g.columns[2].to_pylist())))
+    import decimal
+    with decimal.localcontext(decimal.Context(prec=60)):
+        exp1 = decimal.Decimal(
+            10**30 - 3 * 10**30 + 2**100).scaleb(-2)
+    assert by_key[1] == (exp1, 3)
+    assert by_key[2] == (decimal.Decimal(6).scaleb(-2), 2)
+    assert by_key[3] == (None, 0)  # all-null group -> null sum, count 0
+
+    with pytest.raises(TypeError, match="decimal128"):
+        groupby_aggregate(Table((k, d)), [0], [(1, "min")])
+    s = Column.from_pylist(["a", "b", "c", "d", "e", "f", "g"], dt.STRING)
+    with pytest.raises(TypeError, match="string"):
+        groupby_aggregate(Table((k, s)), [0], [(1, "sum")])
+
+
+def test_groupby_empty_table_schema_matches_nonempty():
+    """0-row partitions must produce the same output schema and the same
+    TypeErrors as non-empty ones (distributed concat depends on it)."""
+    import pytest
+
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    ke = Column.from_pylist([], dt.INT64)
+    de = Column.from_pylist([], dt.decimal128(2))
+    out = groupby_aggregate(Table((ke, de)), [0], [(1, "sum"), (1, "count")])
+    assert out.columns[1].dtype == dt.decimal128(2)
+    assert out.columns[2].dtype == dt.INT64
+    with pytest.raises(TypeError, match="decimal128"):
+        groupby_aggregate(Table((ke, de)), [0], [(1, "min")])
+    se = Column.from_pylist([], dt.STRING)
+    with pytest.raises(TypeError, match="string"):
+        groupby_aggregate(Table((ke, se)), [0], [(1, "sum")])
