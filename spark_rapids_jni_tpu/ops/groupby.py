@@ -77,6 +77,38 @@ def _decimal128_segment_sum(vcol: Column, order, valid, seg_ids,
                   validity=any_valid)
 
 
+def _decimal128_segment_minmax(vcol: Column, order, valid, seg_ids,
+                               num_segments: int, any_valid,
+                               is_min: bool) -> Column:
+    """128-bit segmented min/max: values map to an order-preserving
+    (hi, lo) pair of u64 lanes (sign bit flipped so unsigned order ==
+    signed order), reduced in two stages — reduce hi, then reduce lo among
+    rows whose hi equals their group's winning hi."""
+    limbs = jnp.take(vcol.data, order, axis=0)          # u32[n, 4] sorted
+    hi = ((limbs[:, 3].astype(jnp.uint64) ^ np.uint64(1 << 31)) << np.uint64(32)) \
+        | limbs[:, 2].astype(jnp.uint64)
+    lo = (limbs[:, 1].astype(jnp.uint64) << np.uint64(32)) \
+        | limbs[:, 0].astype(jnp.uint64)
+    pad_hi = np.uint64(2**64 - 1) if is_min else np.uint64(0)
+    pad_lo = pad_hi
+    hi = jnp.where(valid, hi, pad_hi)
+    reduce = jax.ops.segment_min if is_min else jax.ops.segment_max
+    win_hi = reduce(hi, seg_ids, num_segments=num_segments,
+                    indices_are_sorted=True)
+    on_win = valid & (hi == jnp.take(win_hi, seg_ids))
+    lo = jnp.where(on_win, lo, pad_lo)
+    win_lo = reduce(lo, seg_ids, num_segments=num_segments,
+                    indices_are_sorted=True)
+    out = jnp.stack([
+        (win_lo & np.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        (win_lo >> np.uint64(32)).astype(jnp.uint32),
+        (win_hi & np.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        ((win_hi >> np.uint64(32)).astype(jnp.uint32)
+         ^ np.uint32(1 << 31)),
+    ], axis=1)
+    return Column(vcol.dtype, num_segments, data=out, validity=any_valid)
+
+
 def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
     """(numeric device array, is_float) for aggregation. Floats accumulate in
     f64: Spark promotes float to double before summing."""
@@ -85,12 +117,8 @@ def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
         return jnp.asarray(host), True
     if col.dtype.id is dt.TypeId.FLOAT32:
         return col.data.astype(jnp.float64), True
-    if col.dtype.id is dt.TypeId.DECIMAL128 or not col.dtype.is_fixed_width:
-        # DECIMAL128 limbs would sum per-limb without carries (silent
-        # garbage); route decimal128 aggregation through ops/decimal128
-        # arithmetic instead
-        raise TypeError(f"groupby aggregation unsupported for "
-                        f"{col.dtype.id.value} value columns")
+    # _agg_out_dtype is the single validation point: DECIMAL128 and
+    # non-fixed-width columns never reach here
     return col.data.astype(jnp.int64), False
 
 
@@ -102,9 +130,9 @@ def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
     if op == "count":
         return dt.INT64
     if vdtype.id is dt.TypeId.DECIMAL128:
-        if op != "sum":
+        if op not in ("sum", "min", "max"):
             raise TypeError(f"groupby {op} unsupported for decimal128 "
-                            f"(sum and count are)")
+                            f"(sum/min/max/count are)")
         return vdtype
     if not vdtype.is_fixed_width:
         raise TypeError(f"groupby aggregation unsupported for "
@@ -177,8 +205,13 @@ def _groupby_aggregate(
             out_cols.append(Column(dt.INT64, num_segments, data=cnt))
             continue
         if vcol.dtype.id is dt.TypeId.DECIMAL128:
-            out_cols.append(_decimal128_segment_sum(
-                vcol, order, valid, seg_ids, num_segments, cnt > 0))
+            if op == "sum":
+                out_cols.append(_decimal128_segment_sum(
+                    vcol, order, valid, seg_ids, num_segments, cnt > 0))
+            else:
+                out_cols.append(_decimal128_segment_minmax(
+                    vcol, order, valid, seg_ids, num_segments, cnt > 0,
+                    is_min=(op == "min")))
             continue
         vals, is_float = _agg_values(vcol)
         vals = jnp.take(vals, order)

@@ -291,8 +291,18 @@ def test_groupby_decimal128_sum_exact():
     assert by_key[2] == (decimal.Decimal(6).scaleb(-2), 2)
     assert by_key[3] == (None, 0)  # all-null group -> null sum, count 0
 
+    g2 = sort_table(groupby_aggregate(Table((k, d)), [0],
+                                      [(1, "min"), (1, "max")]), [0])
+    mm = dict(zip(g2.columns[0].to_pylist(),
+                  zip(g2.columns[1].to_pylist(), g2.columns[2].to_pylist())))
+    with decimal.localcontext(decimal.Context(prec=60)):
+        assert mm[1] == (decimal.Decimal(-3 * 10**30).scaleb(-2),
+                         decimal.Decimal(2**100).scaleb(-2))
+        assert mm[2] == (decimal.Decimal(-1).scaleb(-2),
+                         decimal.Decimal(7).scaleb(-2))
+    assert mm[3] == (None, None)
     with pytest.raises(TypeError, match="decimal128"):
-        groupby_aggregate(Table((k, d)), [0], [(1, "min")])
+        groupby_aggregate(Table((k, d)), [0], [(1, "mean")])
     s = Column.from_pylist(["a", "b", "c", "d", "e", "f", "g"], dt.STRING)
     with pytest.raises(TypeError, match="string"):
         groupby_aggregate(Table((k, s)), [0], [(1, "sum")])
@@ -311,7 +321,7 @@ def test_groupby_empty_table_schema_matches_nonempty():
     assert out.columns[1].dtype == dt.decimal128(2)
     assert out.columns[2].dtype == dt.INT64
     with pytest.raises(TypeError, match="decimal128"):
-        groupby_aggregate(Table((ke, de)), [0], [(1, "min")])
+        groupby_aggregate(Table((ke, de)), [0], [(1, "mean")])
     se = Column.from_pylist([], dt.STRING)
     with pytest.raises(TypeError, match="string"):
         groupby_aggregate(Table((ke, se)), [0], [(1, "sum")])
