@@ -452,16 +452,19 @@ def _hash_rows(columns: Tuple[Column, ...], seed: int, algo: str) -> Column:
             raise TypeError("xxhash64 does not support nested types")
         units.extend(_flatten_units(c, None))
 
-    if not for_xx:
-        # all-fixed-width murmur rows can take the pallas VMEM kernel
-        # (ops/pallas_kernels; hashing.pallas config gates the route)
-        from .pallas_kernels import murmur3_fixed_rows, murmur3_pallas_route
-        route = murmur3_pallas_route(units, n)
-        if route is not None:
-            lanes, schema, interpret = route
-            hh = murmur3_fixed_rows(lanes, schema, seed, n,
-                                    interpret=interpret)
-            return Column(out_dt, n, data=hh.astype(jnp.int32))
+    # all-fixed-width rows can take the pallas VMEM kernels
+    # (ops/pallas_kernels; hashing.pallas config gates the route)
+    from .pallas_kernels import (hash_pallas_route, murmur3_fixed_rows,
+                                 xxhash64_fixed_rows)
+    route = hash_pallas_route(units, n, for_xx)
+    if route is not None:
+        lanes, schema, interpret = route
+        if for_xx:
+            hh = xxhash64_fixed_rows(lanes, schema, seed, n,
+                                     interpret=interpret)
+            return Column(out_dt, n, data=hh.astype(jnp.int64))
+        hh = murmur3_fixed_rows(lanes, schema, seed, n, interpret=interpret)
+        return Column(out_dt, n, data=hh.astype(jnp.int32))
 
     for u in units:
         h = _apply_unit(h, u, for_xx)

@@ -71,6 +71,37 @@ def build_murmur3_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
     return kernel
 
 
+def _tiled_lane_call(kernel, lanes, n: int, n_out: int, interpret: bool):
+    """Shared pad→tile→pallas_call harness for the row-hash kernels: every
+    uint32 input lane is padded to a ROWS_PER_BLOCK multiple, tiled
+    (_SUB, _LANE), and streamed block-per-grid-step; returns `n_out` flat
+    uint32[n] outputs."""
+    from jax.experimental import pallas as pl
+
+    n_pad = max(ROWS_PER_BLOCK,
+                ((n + ROWS_PER_BLOCK - 1) // ROWS_PER_BLOCK)
+                * ROWS_PER_BLOCK)
+
+    def shape2d(x):
+        x = jnp.pad(x.astype(jnp.uint32), (0, n_pad - n))
+        return x.reshape(n_pad // _LANE, _LANE)
+
+    ins = [shape2d(x) for x in lanes]
+    spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
+    shape = jax.ShapeDtypeStruct((n_pad // _LANE, _LANE), jnp.uint32)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // ROWS_PER_BLOCK,),
+        in_specs=[spec] * len(ins),
+        out_specs=spec if n_out == 1 else (spec,) * n_out,
+        out_shape=shape if n_out == 1 else (shape,) * n_out,
+        interpret=interpret,
+    )(*ins)
+    if n_out == 1:
+        return (out.reshape(-1)[:n],)
+    return tuple(o.reshape(-1)[:n] for o in out)
+
+
 @lru_cache(maxsize=64)
 def _murmur3_fixed_fn(schema: Tuple[Tuple[str, bool], ...], seed: int,
                       interpret: bool):
@@ -78,32 +109,11 @@ def _murmur3_fixed_fn(schema: Tuple[Tuple[str, bool], ...], seed: int,
     interpret): the kernel closure is built once, so jax's dispatch cache
     hits on repeated hash calls (shape changes re-specialize under the same
     jit) instead of re-tracing a fresh pallas_call every time."""
-    from jax.experimental import pallas as pl
-
     kernel = build_murmur3_fixed_kernel(schema, seed)
 
     @partial(jax.jit, static_argnames=("n",))
     def run(lanes, *, n):
-        n_pad = max(ROWS_PER_BLOCK,
-                    ((n + ROWS_PER_BLOCK - 1) // ROWS_PER_BLOCK)
-                    * ROWS_PER_BLOCK)
-
-        def shape2d(x):
-            x = jnp.pad(x.astype(jnp.uint32), (0, n_pad - n))
-            return x.reshape(n_pad // _LANE, _LANE)
-
-        ins = [shape2d(x) for x in lanes]
-        spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
-        out = pl.pallas_call(
-            kernel,
-            grid=(n_pad // ROWS_PER_BLOCK,),
-            in_specs=[spec] * len(ins),
-            out_specs=pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((n_pad // _LANE, _LANE),
-                                           jnp.uint32),
-            interpret=interpret,
-        )(*ins)
-        return out.reshape(-1)[:n]
+        return _tiled_lane_call(kernel, lanes, n, 1, interpret)[0]
 
     return run
 
@@ -128,15 +138,185 @@ def split_u64_lanes(words: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return lo, hi
 
 
+# ---------------------------------------------------------------------------
+# u64 arithmetic emulated on u32 pairs — Mosaic-safe building blocks for the
+# xxhash64 kernel (TPU vector lanes are 32-bit; 64-bit elements would be
+# limb-legalized anyway, and pallas support for them is not guaranteed)
+# ---------------------------------------------------------------------------
+
+_M16 = np.uint32(0xFFFF)
+
+
+def _mulhi_u32(a, b):
+    """High 32 bits of the 32x32 product via 16-bit partial products."""
+    al, ah = a & _M16, a >> np.uint32(16)
+    bl, bh = b & _M16, b >> np.uint32(16)
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = (ll >> np.uint32(16)) + (lh & _M16) + (hl & _M16)
+    return hh + (lh >> np.uint32(16)) + (hl >> np.uint32(16)) \
+        + (mid >> np.uint32(16))
+
+
+def _add64(alo, ahi, blo, bhi):
+    lo = alo + blo
+    carry = (lo < alo).astype(jnp.uint32)
+    return lo, ahi + bhi + carry
+
+
+def _mul64(alo, ahi, blo, bhi):
+    lo = alo * blo
+    hi = _mulhi_u32(alo, blo) + alo * bhi + ahi * blo
+    return lo, hi
+
+
+def _xor64(alo, ahi, blo, bhi):
+    return alo ^ blo, ahi ^ bhi
+
+
+def _rotl64_pair(lo, hi, r: int):
+    r = r % 64
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        s, t = np.uint32(r), np.uint32(32 - r)
+        return ((lo << s) | (hi >> t)), ((hi << s) | (lo >> t))
+    s, t = np.uint32(r - 32), np.uint32(64 - r)
+    return ((hi << s) | (lo >> t)), ((lo << s) | (hi >> t))
+
+
+def _shr64_pair(lo, hi, r: int):
+    if r < 32:
+        s = np.uint32(r)
+        return (lo >> s) | (hi << np.uint32(32 - r)), hi >> s
+    return hi >> np.uint32(r - 32), jnp.zeros_like(hi)
+
+
+def _const64(v: int):
+    return np.uint32(v & 0xFFFFFFFF), np.uint32((v >> 32) & 0xFFFFFFFF)
+
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def build_xxhash64_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
+                                seed: int):
+    """xxhash64 row hash over fixed-width columns, all arithmetic on u32
+    pairs (see the emulation helpers above). Per column: h' = final(round(h
+    + P5 + width, k)) with the running hash as the seed and null rows
+    passing it through — exactly ops/hashing._xx_u32/_xx_u64
+    (xxhash64.cu:197-295 semantics)."""
+    p1, p2, p3, p4, p5 = (_const64(v) for v in (_P1, _P2, _P3, _P4, _P5))
+
+    def mul_c(lo, hi, c):
+        return _mul64(lo, hi, jnp.full_like(lo, c[0]), jnp.full_like(hi, c[1]))
+
+    def add_c(lo, hi, c):
+        return _add64(lo, hi, jnp.full_like(lo, c[0]), jnp.full_like(hi, c[1]))
+
+    def final(lo, hi):
+        lo, hi = _xor64(lo, hi, *_shr64_pair(lo, hi, 33))
+        lo, hi = mul_c(lo, hi, p2)
+        lo, hi = _xor64(lo, hi, *_shr64_pair(lo, hi, 29))
+        lo, hi = mul_c(lo, hi, p3)
+        return _xor64(lo, hi, *_shr64_pair(lo, hi, 32))
+
+    def round8(lo, hi, klo, khi):
+        k1lo, k1hi = mul_c(klo, khi, p2)
+        k1lo, k1hi = _rotl64_pair(k1lo, k1hi, 31)
+        k1lo, k1hi = mul_c(k1lo, k1hi, p1)
+        lo, hi = _xor64(lo, hi, k1lo, k1hi)
+        lo, hi = _rotl64_pair(lo, hi, 27)
+        lo, hi = mul_c(lo, hi, p1)
+        return add_c(lo, hi, p4)
+
+    def round4(lo, hi, klo):
+        klo2, khi2 = _mul64(klo, jnp.zeros_like(klo),
+                            jnp.full_like(klo, p1[0]),
+                            jnp.full_like(klo, p1[1]))
+        lo, hi = _xor64(lo, hi, klo2, khi2)
+        lo, hi = _rotl64_pair(lo, hi, 23)
+        lo, hi = mul_c(lo, hi, p2)
+        return add_c(lo, hi, p3)
+
+    seed_lo, seed_hi = _const64(seed & 0xFFFFFFFFFFFFFFFF)
+
+    def kernel(*refs):
+        out_lo, out_hi = refs[-2], refs[-1]
+        shp = refs[0][...].shape if len(refs) > 2 else (_SUB, _LANE)
+        hlo = jnp.full(shp, seed_lo, dtype=jnp.uint32)
+        hhi = jnp.full(shp, seed_hi, dtype=jnp.uint32)
+        i = 0
+        for kind, has_mask in schema:
+            width = 4 if kind == "u32" else 8
+            # P5 + width folds to one compile-time 64-bit constant
+            c = _const64((_P5 + width) & 0xFFFFFFFFFFFFFFFF)
+            slo, shi = _add64(hlo, hhi,
+                              jnp.full(shp, c[0], jnp.uint32),
+                              jnp.full(shp, c[1], jnp.uint32))
+            if kind == "u32":
+                k = refs[i][...]
+                i += 1
+                nlo, nhi = round4(slo, shi, k)
+            else:
+                klo = refs[i][...]
+                khi = refs[i + 1][...]
+                i += 2
+                nlo, nhi = round8(slo, shi, klo, khi)
+            nlo, nhi = final(nlo, nhi)
+            if has_mask:
+                m = refs[i][...] != 0
+                i += 1
+                nlo = jnp.where(m, nlo, hlo)
+                nhi = jnp.where(m, nhi, hhi)
+            hlo, hhi = nlo, nhi
+        out_lo[...] = hlo
+        out_hi[...] = hhi
+
+    return kernel
+
+
+@lru_cache(maxsize=64)
+def _xxhash64_fixed_fn(schema: Tuple[Tuple[str, bool], ...], seed: int,
+                       interpret: bool):
+    kernel = build_xxhash64_fixed_kernel(schema, seed)
+
+    @partial(jax.jit, static_argnames=("n",))
+    def run(lanes, *, n):
+        lo, hi = _tiled_lane_call(kernel, lanes, n, 2, interpret)
+        return (hi.astype(jnp.uint64) << np.uint64(32)) \
+            | lo.astype(jnp.uint64)
+
+    return run
+
+
+def xxhash64_fixed_rows(lanes: Sequence[jnp.ndarray],
+                        schema: Tuple[Tuple[str, bool], ...],
+                        seed: int, n: int,
+                        interpret: bool = False) -> jnp.ndarray:
+    """uint64[n] Spark xxhash64 row hashes from pre-split uint32 lanes."""
+    return _xxhash64_fixed_fn(schema, seed, interpret)(tuple(lanes), n=n)
+
+
 def pallas_mode() -> str:
     """Resolved hashing.pallas config: 'on' | 'off' | 'auto'."""
     from ..utils import config
     return str(config.get("hashing.pallas")).lower()
 
 
-def murmur3_pallas_route(units, n: int) -> Optional[List]:
+def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
     """If every hash unit is a fixed-width (non-decimal128) leaf and the
-    config allows, return the (lanes, schema, interpret) route; else None."""
+    config allows, return the (lanes, schema, interpret) route; else None.
+    Shared by the murmur3 and xxhash64 kernels — only the per-element word
+    normalization differs (for_xx)."""
     from ..columnar.dtype import TypeId
     from . import hashing as H
 
@@ -160,7 +340,7 @@ def murmur3_pallas_route(units, n: int) -> Optional[List]:
         if (u.list_chain or tid in (TypeId.STRING, TypeId.DECIMAL128)
                 or u.col.dtype.is_nested):
             return None
-        kind, words = H._fixed_element_words(u.col.dtype, u.col.data, False)
+        kind, words = H._fixed_element_words(u.col.dtype, u.col.data, for_xx)
         if kind == "u64":
             lanes.extend(split_u64_lanes(words))
         else:

@@ -74,3 +74,31 @@ def test_pallas_bad_mode_raises():
     with config.override("hashing.pallas", "atuo"):
         with pytest.raises(ValueError, match="auto|on|off"):
             murmur_hash3_32(t, seed=42)
+
+
+def test_pallas_xxhash64_matches_xla():
+    from spark_rapids_jni_tpu.ops.hashing import xxhash64
+    t = _mixed_table(n=2313)
+    with config.override("hashing.pallas", "on"):
+        got = xxhash64(t, seed=42).to_pylist()
+    with config.override("hashing.pallas", "off"):
+        want = xxhash64(t, seed=42).to_pylist()
+    assert got == want
+
+
+def test_pallas_xxhash64_seeds_and_no_nulls():
+    from spark_rapids_jni_tpu.ops.hashing import xxhash64
+    t = _mixed_table(n=129, with_nulls=False)
+    for seed in (0, 42, -7):
+        with config.override("hashing.pallas", "on"):
+            got = xxhash64(t, seed=seed).to_pylist()
+        with config.override("hashing.pallas", "off"):
+            want = xxhash64(t, seed=seed).to_pylist()
+        assert got == want
+
+
+def test_pallas_xxhash64_null_passes_seed():
+    from spark_rapids_jni_tpu.ops.hashing import xxhash64
+    t = Table((Column.from_pylist([None, None], dt.INT64),))
+    with config.override("hashing.pallas", "on"):
+        assert xxhash64(t, seed=42).to_pylist() == [42, 42]
