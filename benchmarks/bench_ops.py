@@ -244,6 +244,37 @@ def bench_tpch_q5(rows: int):
     return sec, nbytes
 
 
+def bench_get_json_object(rows: int):
+    """get_json_object native host tier (SURVEY §7.8 tiering must be
+    justified with numbers; ref device kernel: get_json_object.cu)."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+    docs = [(f'{{"a": {{"b": [{i}, {i * 2}]}}, "name": "row{i % 997}", '
+             f'"tags": ["x", "y{i % 13}"], "active": {str(i % 2 == 0).lower()}}}')
+            for i in range(rows)]
+    col = Column.from_pylist(docs, dt.STRING)
+    nbytes = sum(len(d) for d in docs)
+    sec = _time(lambda: get_json_object(col, "$.a.b[1]"))  # host tier
+    return sec, nbytes
+
+
+def bench_from_json(rows: int):
+    """from_json raw-map extraction, native host tokenizer tier."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.map_utils import (
+        extract_raw_map_from_json_string)
+
+    docs = [(f'{{"k{i % 31}": "v{i}", "n": "{i}", "flag": "{i % 2}"}}')
+            for i in range(rows)]
+    col = Column.from_pylist(docs, dt.STRING)
+    nbytes = sum(len(d) for d in docs)
+    sec = _time(lambda: extract_raw_map_from_json_string(col))
+    return sec, nbytes
+
+
 def bench_parquet_decode(rows: int):
     """BASELINE configs[3]-shaped: chunked decode of a lineitem-like file
     (ints, FLBA decimals, date32, low-card + comment strings, snappy)."""
@@ -301,6 +332,7 @@ def main():
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
                              "join", "sort", "tpch_q3", "tpch_q5",
+                             "get_json_object", "from_json",
                              "parquet_decode"])
     args = ap.parse_args()
     _refresh_variants()
@@ -339,6 +371,14 @@ def main():
     if args.bench in ("all", "tpch_q5"):
         runs.append(("tpch_q5", "4join+conation+groupby+sort", args.rows,
                      lambda: bench_tpch_q5(args.rows)))
+    if args.bench in ("all", "get_json_object"):
+        jrows = min(args.rows, 500_000)
+        runs.append(("get_json_object", "native host tier", jrows,
+                     lambda: bench_get_json_object(jrows)))
+    if args.bench in ("all", "from_json"):
+        mrows = min(args.rows, 500_000)
+        runs.append(("from_json", "raw map, native host tier", mrows,
+                     lambda: bench_from_json(mrows)))
     if args.bench in ("all", "parquet_decode"):
         prows = min(args.rows, 1_000_000)
         runs.append(("parquet_decode", "lineitem-shaped snappy", prows,

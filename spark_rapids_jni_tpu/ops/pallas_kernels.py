@@ -200,13 +200,6 @@ def _const64(v: int):
     return np.uint32(v & 0xFFFFFFFF), np.uint32((v >> 32) & 0xFFFFFFFF)
 
 
-_P1 = 0x9E3779B185EBCA87
-_P2 = 0xC2B2AE3D27D4EB4F
-_P3 = 0x165667B19E3779F9
-_P4 = 0x85EBCA77C2B2AE63
-_P5 = 0x27D4EB2F165667C5
-
-
 def build_xxhash64_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
                                 seed: int):
     """xxhash64 row hash over fixed-width columns, all arithmetic on u32
@@ -214,7 +207,10 @@ def build_xxhash64_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
     + P5 + width, k)) with the running hash as the seed and null rows
     passing it through — exactly ops/hashing._xx_u32/_xx_u64
     (xxhash64.cu:197-295 semantics)."""
-    p1, p2, p3, p4, p5 = (_const64(v) for v in (_P1, _P2, _P3, _P4, _P5))
+    H = _mm_constants()  # primes come from the one definition in ops/hashing
+    P5 = int(H._P5)
+    p1, p2, p3, p4, p5 = (_const64(int(v)) for v in
+                          (H._P1, H._P2, H._P3, H._P4, H._P5))
 
     def mul_c(lo, hi, c):
         return _mul64(lo, hi, jnp.full_like(lo, c[0]), jnp.full_like(hi, c[1]))
@@ -251,14 +247,14 @@ def build_xxhash64_fixed_kernel(schema: Tuple[Tuple[str, bool], ...],
 
     def kernel(*refs):
         out_lo, out_hi = refs[-2], refs[-1]
-        shp = refs[0][...].shape if len(refs) > 2 else (_SUB, _LANE)
+        shp = (_SUB, _LANE)  # statically fixed by _tiled_lane_call's specs
         hlo = jnp.full(shp, seed_lo, dtype=jnp.uint32)
         hhi = jnp.full(shp, seed_hi, dtype=jnp.uint32)
         i = 0
         for kind, has_mask in schema:
             width = 4 if kind == "u32" else 8
             # P5 + width folds to one compile-time 64-bit constant
-            c = _const64((_P5 + width) & 0xFFFFFFFFFFFFFFFF)
+            c = _const64((P5 + width) & 0xFFFFFFFFFFFFFFFF)
             slo, shi = _add64(hlo, hhi,
                               jnp.full(shp, c[0], jnp.uint32),
                               jnp.full(shp, c[1], jnp.uint32))
