@@ -5,7 +5,7 @@
  * the engine's shared libraries with dlopen/dlsym exactly as a JVM loads a
  * native library, passes handles around as int64 (the jlong model — never
  * dereferenced client-side), and verifies correct bytes come back from
- * three subsystems:
+ * four subsystems:
  *
  *   1. resource adaptor: create -> register -> alloc/dealloc -> metrics ->
  *      destroy through the rm_* ABI (the control plane a Spark executor
@@ -14,9 +14,11 @@
  *      one column, re-serialize and check the PAR1 framing + row count
  *   3. get_json_object: evaluate $.k over a JSON column and compare the
  *      exact output bytes
+ *   4. parse_url: extract HOST with RFC-3986 validation (null on invalid,
+ *      IPv6 brackets kept) and compare the exact output bytes
  *
  * Usage: jvm_sim <libsparkrm.so> <libsparkpq.so> <libsparkjson.so>
- *                <parquet_file> <expected_rows> <keep_column>
+ *                <parquet_file> <expected_rows> <keep_column> <libsparkpuri.so>
  * Exit 0 = every byte matched.
  */
 
@@ -138,6 +140,34 @@ static void drive_footer(const char* path, const char* pq_file,
   printf("jvm_sim: parquet footer round-trip ok (%lld rows)\n", expected_rows);
 }
 
+/* ---- shared row packing / byte checking for columnar drivers ------------ */
+static void pack_rows(const char** rows, int n, uint8_t* data,
+                      int64_t* offsets) {
+  offsets[0] = 0;
+  for (int i = 0; i < n; i++) {
+    size_t len = strlen(rows[i]);
+    memcpy(data + offsets[i], rows[i], len);
+    offsets[i + 1] = offsets[i] + (int64_t)len;
+  }
+}
+
+static void check_rows(const char* what, const char** want, int n,
+                       const uint8_t* out_data, const int64_t* out_offsets,
+                       const uint8_t* out_valid) {
+  for (int i = 0; i < n; i++) {
+    if (want[i] == NULL) {
+      if (out_valid[i]) DIE("%s row %d: expected null", what, i);
+      continue;
+    }
+    if (!out_valid[i]) DIE("%s row %d: unexpectedly null", what, i);
+    int64_t b0 = out_offsets[i], b1 = out_offsets[i + 1];
+    if ((int64_t)strlen(want[i]) != b1 - b0 ||
+        memcmp(out_data + b0, want[i], (size_t)(b1 - b0)) != 0)
+      DIE("%s row %d: got '%.*s' want '%s'", what, i, (int)(b1 - b0),
+          out_data + b0, want[i]);
+  }
+}
+
 /* ---- 3. get_json_object ------------------------------------------------- */
 static void drive_json(const char* path) {
   void* lib = dlopen(path, RTLD_NOW | RTLD_LOCAL);
@@ -155,12 +185,8 @@ static void drive_json(const char* path) {
       "{\"k\": \"v0\"}", "{\"x\": 1}", "{\"k\": [1, 2]}",
   };
   uint8_t data[256];
-  int64_t offsets[4] = {0};
-  for (int i = 0; i < 3; i++) {
-    size_t n = strlen(rows[i]);
-    memcpy(data + offsets[i], rows[i], n);
-    offsets[i + 1] = offsets[i] + (int64_t)n;
-  }
+  int64_t offsets[4];
+  pack_rows(rows, 3, data, offsets);
   /* ops for $.k — two instructions (the engine's PathInstructionJni
      stream): KEY (no name) then NAMED("k"); each is u8 type, i64 index,
      i32 name_len, name bytes */
@@ -185,30 +211,58 @@ static void drive_json(const char* path) {
   /* Spark semantics: $.k of row0 -> v0 (unquoted), row1 -> null,
      row2 -> [1,2] raw */
   const char* want[3] = {"v0", NULL, "[1,2]"};
-  for (int i = 0; i < 3; i++) {
-    if (want[i] == NULL) {
-      if (out_valid[i]) DIE("row %d: expected null", i);
-      continue;
-    }
-    if (!out_valid[i]) DIE("row %d: unexpectedly null", i);
-    int64_t b0 = out_offsets[i], b1 = out_offsets[i + 1];
-    if ((int64_t)strlen(want[i]) != b1 - b0 ||
-        memcmp(out_data + b0, want[i], (size_t)(b1 - b0)) != 0)
-      DIE("row %d: got '%.*s' want '%s'", i, (int)(b1 - b0), out_data + b0,
-          want[i]);
-  }
+  check_rows("json", want, 3, out_data, out_offsets, out_valid);
   freep(out_data);
   freep(out_offsets);
   freep(out_valid);
   printf("jvm_sim: get_json_object bytes ok\n");
 }
 
+/* ---- 4. parse_url ------------------------------------------------------- */
+static void drive_parse_uri(const char* path) {
+  void* lib = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!lib) DIE("dlopen %s: %s", path, dlerror());
+
+  int (*parse)(const uint8_t*, const int64_t*, const uint8_t*, long, int,
+               const uint8_t*, const int64_t*, const uint8_t*, int,
+               uint8_t**, int64_t**, uint8_t**, int64_t*) =
+      (int (*)(const uint8_t*, const int64_t*, const uint8_t*, long, int,
+               const uint8_t*, const int64_t*, const uint8_t*, int,
+               uint8_t**, int64_t**, uint8_t**,
+               int64_t*))must_sym(lib, "puri_parse");
+  void (*freep)(void*) = (void (*)(void*))must_sym(lib, "puri_free");
+
+  const char* rows[3] = {
+      "https://user@host.example.com:8443/p?q=1",
+      "not a url",
+      "ftp://[2001:db8::1]/file",
+  };
+  uint8_t data[256];
+  int64_t offsets[4];
+  pack_rows(rows, 3, data, offsets);
+  uint8_t* out_data = NULL;
+  int64_t* out_offsets = NULL;
+  uint8_t* out_valid = NULL;
+  int64_t total = 0;
+  if (parse(data, offsets, NULL, 3, /*HOST*/ 1, NULL, NULL, NULL, 0,
+            &out_data, &out_offsets, &out_valid, &total) != 0)
+    DIE("puri_parse failed");
+  const char* want[3] = {"host.example.com", NULL, "[2001:db8::1]"};
+  check_rows("uri", want, 3, out_data, out_offsets, out_valid);
+  freep(out_data);
+  freep(out_offsets);
+  freep(out_valid);
+  printf("jvm_sim: parse_url HOST bytes ok\n");
+}
+
 int main(int argc, char** argv) {
-  if (argc != 7)
-    DIE("usage: jvm_sim <librm> <libpq> <libjson> <parquet> <rows> <col>");
+  if (argc != 8)
+    DIE("usage: jvm_sim <librm> <libpq> <libjson> <parquet> <rows> <col> "
+        "<libpuri>");
   drive_rmm(argv[1]);
   drive_footer(argv[2], argv[4], atoll(argv[5]), argv[6]);
   drive_json(argv[3]);
+  drive_parse_uri(argv[7]);
   printf("jvm_sim: all round-trips ok\n");
   return 0;
 }

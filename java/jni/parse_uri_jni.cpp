@@ -1,0 +1,97 @@
+// JNI shim: com.sparkrapids.tpu.ParseURIJni -> the puri_* C ABI
+// (native/parse_uri.cpp). Mechanical marshalling: Java primitive arrays in,
+// three malloc'd native buffers out (addresses returned through outPtrs as
+// jlongs — the jlong handle model; Java frees them via ParseURIJni.free).
+//
+// Build (requires a JDK; this repo's CI image has none — ci/jvm_sim.c
+// drives the same ABI from C instead):
+//   g++ -std=c++17 -O2 -fPIC -shared -I$JAVA_HOME/include \
+//       -I$JAVA_HOME/include/linux -o libsparkpuri_jni.so \
+//       java/jni/parse_uri_jni.cpp native/parse_uri.cpp -lpthread
+
+#include <jni.h>
+
+#include <cstdint>
+#include <cstdlib>
+
+extern "C" {
+int puri_parse(const uint8_t* data, const int64_t* offsets,
+               const uint8_t* valid_in, long n_rows, int part,
+               const uint8_t* key_data, const int64_t* key_offsets,
+               const uint8_t* key_valid, int key_broadcast,
+               uint8_t** out_data, int64_t** out_offsets,
+               uint8_t** out_valid, int64_t* out_total);
+void puri_free(void* p);
+}
+
+namespace {
+
+struct pinned_bytes {
+  JNIEnv* env;
+  jbyteArray arr;
+  jbyte* p;
+  pinned_bytes(JNIEnv* e, jbyteArray a) : env(e), arr(a), p(nullptr) {
+    if (arr) p = env->GetByteArrayElements(arr, nullptr);
+  }
+  ~pinned_bytes() {
+    if (p) env->ReleaseByteArrayElements(arr, p, JNI_ABORT);
+  }
+};
+
+struct pinned_longs {
+  JNIEnv* env;
+  jlongArray arr;
+  jlong* p;
+  pinned_longs(JNIEnv* e, jlongArray a) : env(e), arr(a), p(nullptr) {
+    if (arr) p = env->GetLongArrayElements(arr, nullptr);
+  }
+  ~pinned_longs() {
+    if (p) env->ReleaseLongArrayElements(arr, p, JNI_ABORT);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_com_sparkrapids_tpu_ParseURIJni_parse(
+    JNIEnv* env, jclass, jbyteArray data, jlongArray offsets,
+    jbyteArray validity, jlong rows, jint part, jbyteArray key_data,
+    jlongArray key_offsets, jbyteArray key_validity, jboolean key_broadcast,
+    jlongArray out_ptrs) {
+  if (!data || !offsets || !out_ptrs) {  // mandatory arrays: NPE, not SIGSEGV
+    env->ThrowNew(env->FindClass("java/lang/NullPointerException"),
+                  "data/offsets/outPtrs must not be null");
+    return -1;
+  }
+  pinned_bytes d(env, data), v(env, validity), kd(env, key_data),
+      kv(env, key_validity);
+  pinned_longs o(env, offsets), ko(env, key_offsets);
+
+  uint8_t* out_data = nullptr;
+  int64_t* out_offsets = nullptr;
+  uint8_t* out_valid = nullptr;
+  int64_t total = 0;
+  int rc = puri_parse(
+      reinterpret_cast<const uint8_t*>(d.p),
+      reinterpret_cast<const int64_t*>(o.p),
+      reinterpret_cast<const uint8_t*>(v.p), static_cast<long>(rows), part,
+      reinterpret_cast<const uint8_t*>(kd.p),
+      reinterpret_cast<const int64_t*>(ko.p),
+      reinterpret_cast<const uint8_t*>(kv.p), key_broadcast ? 1 : 0,
+      &out_data, &out_offsets, &out_valid, &total);
+  if (rc != 0) return rc;  // negative status; no buffers were returned
+
+  jlong ptrs[3] = {reinterpret_cast<jlong>(out_data),
+                   reinterpret_cast<jlong>(out_offsets),
+                   reinterpret_cast<jlong>(out_valid)};
+  env->SetLongArrayRegion(out_ptrs, 0, 3, ptrs);
+  return total;
+}
+
+JNIEXPORT void JNICALL Java_com_sparkrapids_tpu_ParseURIJni_free(
+    JNIEnv*, jclass, jlong ptr) {
+  puri_free(reinterpret_cast<void*>(ptr));
+}
+
+}  // extern "C"

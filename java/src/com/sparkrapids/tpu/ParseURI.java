@@ -1,0 +1,66 @@
+/*
+ * Spark parse_url kernel facade — capability parity with the reference's
+ * ParseURI.java:36-92 (parseURIProtocol/Host/Query[+key]) over the native
+ * host tier (native/parse_uri.cpp C ABI); implementation shim in
+ * java/jni/parse_uri_jni.cpp.
+ *
+ * Columns cross JNI as flat (data, offsets, validity) arrays — the same
+ * contract ci/jvm_sim.c proves byte-exact against the real library. Output
+ * arrays are returned via a long[] of three malloc'd native pointers plus
+ * lengths; the caller copies and then frees with free().
+ */
+package com.sparkrapids.tpu;
+
+public final class ParseURI {
+  private ParseURI() {}
+
+  public static final int PART_PROTOCOL = 0;
+  public static final int PART_HOST = 1;
+  public static final int PART_QUERY = 2;
+
+  /** parse_url(col, 'PROTOCOL'): scheme per row, null on invalid. */
+  public static long parseURIProtocol(byte[] data, long[] offsets,
+                                      byte[] validity, long rows,
+                                      long[] outPtrs) {
+    return ParseURIJni.parse(data, offsets, validity, rows, PART_PROTOCOL,
+                             null, null, null, false, outPtrs);
+  }
+
+  /** parse_url(col, 'HOST'): RFC-3986 validated host per row. */
+  public static long parseURIHost(byte[] data, long[] offsets,
+                                  byte[] validity, long rows,
+                                  long[] outPtrs) {
+    return ParseURIJni.parse(data, offsets, validity, rows, PART_HOST,
+                             null, null, null, false, outPtrs);
+  }
+
+  /** parse_url(col, 'QUERY'): full query string per row. */
+  public static long parseURIQuery(byte[] data, long[] offsets,
+                                   byte[] validity, long rows,
+                                   long[] outPtrs) {
+    return ParseURIJni.parse(data, offsets, validity, rows, PART_QUERY,
+                             null, null, null, false, outPtrs);
+  }
+
+  /** parse_url(col, 'QUERY', literalKey): one key's value per row. */
+  public static long parseURIQueryWithLiteral(byte[] data, long[] offsets,
+                                              byte[] validity, long rows,
+                                              byte[] keyData,
+                                              long[] keyOffsets,
+                                              long[] outPtrs) {
+    return ParseURIJni.parse(data, offsets, validity, rows, PART_QUERY,
+                             keyData, keyOffsets, null, true, outPtrs);
+  }
+
+  /** parse_url(col, 'QUERY', keyCol): per-row key column variant. */
+  public static long parseURIQueryWithColumn(byte[] data, long[] offsets,
+                                             byte[] validity, long rows,
+                                             byte[] keyData,
+                                             long[] keyOffsets,
+                                             byte[] keyValidity,
+                                             long[] outPtrs) {
+    return ParseURIJni.parse(data, offsets, validity, rows, PART_QUERY,
+                             keyData, keyOffsets, keyValidity, false,
+                             outPtrs);
+  }
+}

@@ -1,0 +1,28 @@
+/*
+ * JNI binding declarations for the native parse_url C ABI
+ * (native/parse_uri.cpp puri_parse/puri_free); implementation in
+ * java/jni/parse_uri_jni.cpp. Same handle-free flat-buffer contract the C
+ * simulator (ci/jvm_sim.c drive_parse_uri) proves against the built
+ * library.
+ */
+package com.sparkrapids.tpu;
+
+final class ParseURIJni {
+  static {
+    System.loadLibrary("sparkpuri_jni");
+  }
+
+  private ParseURIJni() {}
+
+  /**
+   * Returns the total output byte count (>= 0) or a negative status.
+   * outPtrs receives {dataPtr, offsetsPtr, validityPtr} as native
+   * addresses; free each with free().
+   */
+  static native long parse(byte[] data, long[] offsets, byte[] validity,
+                           long rows, int part, byte[] keyData,
+                           long[] keyOffsets, byte[] keyValidity,
+                           boolean keyBroadcast, long[] outPtrs);
+
+  static native void free(long ptr);
+}

@@ -23,22 +23,25 @@ NATIVE = os.path.join(REPO, "spark_rapids_jni_tpu", "_native")
 
 
 def _ensure_native():
-    # the loaders build on first use; force all three we need
+    # the loaders build on first use; force all four we need
     from spark_rapids_jni_tpu.memory import native as rm
+    from spark_rapids_jni_tpu.ops import _parse_uri_native as puri
     from spark_rapids_jni_tpu.ops import get_json_object as gjo
     from spark_rapids_jni_tpu.parquet import footer
 
     rm.load()
     footer._load()
     gjo._load()
+    puri.load()
     return (os.path.join(NATIVE, "libsparkrm.so"),
             os.path.join(NATIVE, "libsparkpq.so"),
-            os.path.join(NATIVE, "libsparkjson.so"))
+            os.path.join(NATIVE, "libsparkjson.so"),
+            os.path.join(NATIVE, "libsparkpuri.so"))
 
 
 @pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
 def test_jvm_sim_round_trips(tmp_path):
-    librm, libpq, libjson = _ensure_native()
+    librm, libpq, libjson, libpuri = _ensure_native()
 
     # a parquet file the "executor" will push through the footer path
     t = pa.table({
@@ -56,12 +59,13 @@ def test_jvm_sim_round_trips(tmp_path):
     assert build.returncode == 0, build.stderr
 
     run = subprocess.run(
-        [exe, librm, libpq, libjson, pq_file, "1234", "b"],
+        [exe, librm, libpq, libjson, pq_file, "1234", "b", libpuri],
         capture_output=True, text=True, timeout=120)
     assert run.returncode == 0, f"{run.stdout}\n{run.stderr}"
     assert "rmm control plane ok" in run.stdout
     assert "parquet footer round-trip ok (1234 rows)" in run.stdout
     assert "get_json_object bytes ok" in run.stdout
+    assert "parse_url HOST bytes ok" in run.stdout
     assert "all round-trips ok" in run.stdout
 
 
@@ -69,24 +73,29 @@ def _native_methods(java_src: str):
     return set(re.findall(r"static native \w+(?:\[\])? (\w+)\(", java_src))
 
 
-def _jni_impls(cpp_src: str):
-    return set(re.findall(r"Java_com_sparkrapids_tpu_RmmSparkJni_(\w+)\(",
-                          cpp_src))
+def _jni_impls(cpp_src: str, cls: str):
+    return set(re.findall(
+        r"Java_com_sparkrapids_tpu_" + cls + r"_(\w+)\(", cpp_src))
+
+
+_JNI_PAIRS = [("RmmSparkJni", "rmm_spark_jni.cpp"),
+              ("ParseURIJni", "parse_uri_jni.cpp")]
 
 
 def test_java_facade_and_jni_shim_in_sync():
-    """Every `static native` method declared by RmmSparkJni.java must have a
-    JNI implementation, and vice versa (the build would catch this with a
+    """Every `static native` method declared by a *Jni.java facade must have
+    a JNI implementation, and vice versa (the build would catch this with a
     JDK; without one this keeps the committed sources honest)."""
-    with open(os.path.join(REPO, "java", "src", "com", "sparkrapids", "tpu",
-                           "RmmSparkJni.java")) as f:
-        declared = _native_methods(f.read())
-    with open(os.path.join(REPO, "java", "jni", "rmm_spark_jni.cpp")) as f:
-        implemented = _jni_impls(f.read())
-    assert declared, "no native methods found in RmmSparkJni.java"
-    assert declared == implemented, (
-        f"missing impls: {declared - implemented}; "
-        f"orphan impls: {implemented - declared}")
+    for cls, shim in _JNI_PAIRS:
+        with open(os.path.join(REPO, "java", "src", "com", "sparkrapids",
+                               "tpu", f"{cls}.java")) as f:
+            declared = _native_methods(f.read())
+        with open(os.path.join(REPO, "java", "jni", shim)) as f:
+            implemented = _jni_impls(f.read(), cls)
+        assert declared, f"no native methods found in {cls}.java"
+        assert declared == implemented, (
+            f"{cls}: missing impls: {declared - implemented}; "
+            f"orphan impls: {implemented - declared}")
 
 
 def test_jni_shim_binds_real_abi_symbols():
@@ -94,12 +103,15 @@ def test_jni_shim_binds_real_abi_symbols():
     resource-adaptor library (ABI drift guard)."""
     import ctypes
 
-    librm, _, _ = _ensure_native()
-    lib = ctypes.CDLL(librm)
-    with open(os.path.join(REPO, "java", "jni", "rmm_spark_jni.cpp")) as f:
-        src = f.read()
-    externs = set(re.findall(r"^(?:int|void\*?|long long) (rm_\w+)\(", src,
-                             re.M))
-    assert externs, "no extern rm_* declarations found in the shim"
-    for sym in externs:
-        assert hasattr(lib, sym), f"shim binds {sym} but the .so lacks it"
+    libs = _ensure_native()
+    for so, shim, pat in [(libs[0], "rmm_spark_jni.cpp", r"(rm_\w+)"),
+                          (libs[3], "parse_uri_jni.cpp", r"(puri_\w+)")]:
+        lib = ctypes.CDLL(so)
+        with open(os.path.join(REPO, "java", "jni", shim)) as f:
+            src = f.read()
+        externs = set(re.findall(
+            r"^(?:int|void\*?|long long) " + pat + r"\(", src, re.M))
+        assert externs, f"no extern declarations found in {shim}"
+        for sym in externs:
+            assert hasattr(lib, sym), \
+                f"{shim} binds {sym} but the .so lacks it"
