@@ -453,18 +453,20 @@ def _hash_rows(columns: Tuple[Column, ...], seed: int, algo: str) -> Column:
         units.extend(_flatten_units(c, None))
 
     # all-fixed-width rows can take the pallas VMEM kernels
-    # (ops/pallas_kernels; hashing.pallas config gates the route)
+    # (ops/pallas_kernels; hashing.pallas config gates the route; a kernel
+    # failure in auto mode disables the route and falls through to XLA)
     from .pallas_kernels import (hash_pallas_route, murmur3_fixed_rows,
-                                 xxhash64_fixed_rows)
+                                 run_with_fallback, xxhash64_fixed_rows)
     route = hash_pallas_route(units, n, for_xx)
     if route is not None:
         lanes, schema, interpret = route
-        if for_xx:
-            hh = xxhash64_fixed_rows(lanes, schema, seed, n,
-                                     interpret=interpret)
-            return Column(out_dt, n, data=hh.astype(jnp.int64))
-        hh = murmur3_fixed_rows(lanes, schema, seed, n, interpret=interpret)
-        return Column(out_dt, n, data=hh.astype(jnp.int32))
+        kernel_fn = xxhash64_fixed_rows if for_xx else murmur3_fixed_rows
+        hh = run_with_fallback(kernel_fn, lanes, schema, seed, n,
+                               interpret=interpret)
+        if hh is not None:
+            if for_xx:
+                return Column(out_dt, n, data=hh.astype(jnp.int64))
+            return Column(out_dt, n, data=hh.astype(jnp.int32))
 
     for u in units:
         h = _apply_unit(h, u, for_xx)

@@ -308,6 +308,40 @@ def pallas_mode() -> str:
     return str(config.get("hashing.pallas")).lower()
 
 
+# Set on the first kernel failure (e.g. a Mosaic lowering this jax/libtpu
+# build rejects): 'auto' sessions fall back to the XLA path permanently
+# rather than failing every subsequent hash/join. "on" mode is unaffected —
+# it always routes and surfaces the real error (tests want it).
+_runtime_disabled = False
+# Until one kernel run completes on this backend, block inside the fallback
+# guard: jax dispatch is async, so an execute-time failure would otherwise
+# surface at the caller's materialization, outside the try. After the first
+# success the backend is proven and the sync tax stops.
+_validated = False
+
+
+def run_with_fallback(fn, *args, **kwargs):
+    """Run a pallas entry point; on failure in 'auto' mode, disable the
+    route for this session and signal the caller to use the XLA path by
+    returning None."""
+    global _runtime_disabled, _validated
+    try:
+        out = fn(*args, **kwargs)
+        if not _validated:
+            jax.block_until_ready(out)
+            _validated = True
+        return out
+    except Exception:
+        if pallas_mode() == "on":
+            raise
+        import warnings
+        warnings.warn("pallas kernel failed to compile/run on this backend; "
+                      "falling back to the XLA hash path for this session",
+                      RuntimeWarning)
+        _runtime_disabled = True
+        return None
+
+
 def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
     """If every hash unit is a fixed-width (non-decimal128) leaf and the
     config allows, return the (lanes, schema, interpret) route; else None.
@@ -319,7 +353,7 @@ def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
     mode = pallas_mode()
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"hashing.pallas must be auto|on|off, got {mode!r}")
-    if mode == "off" or n == 0:
+    if mode == "off" or n == 0 or (mode == "auto" and _runtime_disabled):
         return None
     backend = jax.default_backend()
     if mode == "auto" and backend not in ("tpu", "axon"):

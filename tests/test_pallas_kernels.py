@@ -102,3 +102,58 @@ def test_pallas_xxhash64_null_passes_seed():
     t = Table((Column.from_pylist([None, None], dt.INT64),))
     with config.override("hashing.pallas", "on"):
         assert xxhash64(t, seed=42).to_pylist() == [42, 42]
+
+
+def test_pallas_runtime_fallback(monkeypatch):
+    """A kernel failure in auto mode disables the route for the session and
+    falls back to the XLA path; 'on' mode surfaces the real error."""
+    from spark_rapids_jni_tpu.ops import pallas_kernels as PK
+    from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+
+    t = Table((Column.from_pylist([1, 2, 3], dt.INT64),))
+    with config.override("hashing.pallas", "off"):
+        want = murmur_hash3_32(t, seed=42).to_pylist()
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(PK, "_runtime_disabled", False)
+    # auto on a "tpu" backend routes to pallas; the failure must fall back
+    monkeypatch.setattr(PK.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(PK, "_murmur3_fixed_fn", lambda *a, **k: boom)
+    with config.override("hashing.pallas", "auto"):
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            got = murmur_hash3_32(t, seed=42).to_pylist()
+        assert got == want
+        assert PK._runtime_disabled
+        # subsequent calls skip the route entirely (no more warnings)
+        assert murmur_hash3_32(t, seed=42).to_pylist() == want
+    # 'on' mode re-raises
+    monkeypatch.setattr(PK, "_runtime_disabled", False)
+    with config.override("hashing.pallas", "on"):
+        with pytest.raises(RuntimeError, match="mosaic"):
+            murmur_hash3_32(t, seed=42)
+    monkeypatch.setattr(PK, "_runtime_disabled", False)
+
+
+def test_pallas_on_mode_ignores_runtime_disable(monkeypatch):
+    """'on' must still route (and run the real kernel) even after an auto
+    session tripped the disable flag."""
+    from spark_rapids_jni_tpu.ops import pallas_kernels as PK
+    from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+
+    t = Table((Column.from_pylist([4, 5], dt.INT64),))
+    with config.override("hashing.pallas", "off"):
+        want = murmur_hash3_32(t, seed=42).to_pylist()
+    monkeypatch.setattr(PK, "_runtime_disabled", True)
+    calls = []
+    real = PK.murmur3_fixed_rows
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(PK, "murmur3_fixed_rows", spy)
+    with config.override("hashing.pallas", "on"):
+        got = murmur_hash3_32(t, seed=42).to_pylist()
+    assert got == want and calls, "on-mode did not route through pallas"
