@@ -30,6 +30,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column
 from . import hashing as H
+from ..utils.tracing import func_range
 
 SPARK_BLOOM_FILTER_VERSION = 1
 HEADER_SIZE = 12  # 3 big-endian int32: version, num_hashes, num_longs
@@ -54,6 +55,7 @@ class BloomFilter:
         return self.num_longs * 64
 
 
+@func_range()
 def bloom_filter_create(num_hashes: int, num_longs: int) -> BloomFilter:
     """New empty filter (bloom_filter.cu:225)."""
     if num_hashes <= 0 or num_longs <= 0:
@@ -78,6 +80,7 @@ def _probe_bits(keys_i64, num_hashes: int, num_bits: int):
     return jnp.stack(idxs, axis=1)
 
 
+@func_range()
 def bloom_filter_put(bf: BloomFilter, col: Column) -> BloomFilter:
     """Insert an INT64 column's non-null values; returns the updated filter
     (functional; bloom_filter.cu:255 mutates in place)."""
@@ -91,6 +94,7 @@ def bloom_filter_put(bf: BloomFilter, col: Column) -> BloomFilter:
     return BloomFilter(bf.num_hashes, bf.num_longs, bits)
 
 
+@func_range()
 def bloom_filter_probe(col: Column, bf: BloomFilter) -> Column:
     """BOOL8 column: might-contain for each key; nulls propagate
     (bloom_filter.cu:339)."""
@@ -102,6 +106,7 @@ def bloom_filter_probe(col: Column, bf: BloomFilter) -> Column:
                   validity=col.validity)
 
 
+@func_range()
 def bloom_filter_merge(filters) -> BloomFilter:
     """OR-merge filters with identical parameters (bloom_filter.cu:277)."""
     filters = list(filters)

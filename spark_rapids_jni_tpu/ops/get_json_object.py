@@ -19,6 +19,7 @@ import numpy as np
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column
+from ..utils.tracing import func_range
 
 _lock = threading.Lock()
 _lib = None
@@ -122,6 +123,7 @@ def _encode_ops(ops: Sequence[Tuple[PathInstructionType, str, int]]) -> bytes:
     return bytes(buf)
 
 
+@func_range()
 def get_json_object_with_instructions(
         col: Column,
         ops: Sequence[Tuple[PathInstructionType, str, int]]) -> Column:
@@ -177,6 +179,7 @@ def get_json_object_with_instructions(
                   offsets=jnp.asarray(offs.astype(np.int32)))
 
 
+@func_range()
 def get_json_object(col: Column, path: str) -> Column:
     """Spark `get_json_object(col, path)`; invalid path → all-null column."""
     ops = parse_path(path)

@@ -28,6 +28,7 @@ from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values
 from .sort import gather, sort_order
+from ..utils.tracing import func_range
 
 
 def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
@@ -145,6 +146,7 @@ def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
     return vdtype  # min / max keep the input type
 
 
+@func_range()
 def groupby_aggregate(
         table: Table, key_indices: Sequence[int],
         aggs: Sequence[Tuple[int, str]]) -> Table:

@@ -38,6 +38,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..columnar.strings import pad_width, padded_bytes
+from ..utils.tracing import func_range
 
 DEFAULT_MURMUR_SEED = 42  # Hash.java:33
 DEFAULT_XXHASH64_SEED = 42  # hash.cuh:28
@@ -544,12 +545,14 @@ def _apply_unit(h, u: _HashUnit, for_xx: bool):
     return lax.fori_loop(0, trip, body, h)
 
 
+@func_range()
 def murmur_hash3_32(table: Union[Table, Sequence[Column]],
                     seed: int = DEFAULT_MURMUR_SEED) -> Column:
     """Spark murmur3_32 row hash -> INT32 column (Hash.java:40-56)."""
     return _hash_rows(_normalize_input(table), seed, "mm")
 
 
+@func_range()
 def xxhash64(table: Union[Table, Sequence[Column]],
              seed: int = DEFAULT_XXHASH64_SEED) -> Column:
     """Spark xxhash64 row hash -> INT64 column (Hash.java:70-90)."""

@@ -35,6 +35,7 @@ from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values, xxhash64
+from ..utils.tracing import func_range
 
 
 def _backend() -> str:
@@ -184,6 +185,7 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
             jnp.take(r_idx, sel).astype(jnp.int64))
 
 
+@func_range()
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
                nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather maps (left_indices, right_indices) of matching row pairs —
@@ -193,6 +195,7 @@ def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
     return _candidates(left_keys, right_keys, nulls_equal)
 
 
+@func_range()
 def left_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Left outer join; unmatched left rows get right index -1."""
@@ -205,6 +208,7 @@ def left_join(left_keys, right_keys,
             np.concatenate([r_idx, np.full(len(miss), -1, dtype=np.int64)]))
 
 
+@func_range()
 def full_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Full outer join; unmatched rows get -1 on the other side."""
@@ -222,6 +226,7 @@ def full_join(left_keys, right_keys,
                             rmiss]))
 
 
+@func_range()
 def left_semi_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with at least one match."""
@@ -232,6 +237,7 @@ def left_semi_join(left_keys, right_keys,
     return np.where(matched)[0]
 
 
+@func_range()
 def left_anti_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with no match."""

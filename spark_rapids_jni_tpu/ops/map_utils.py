@@ -19,6 +19,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column
 from .get_json_object import _load
+from ..utils.tracing import func_range
 
 
 def _declare(lib):
@@ -38,6 +39,7 @@ def _declare(lib):
     return lib
 
 
+@func_range()
 def extract_raw_map_from_json_string(col: Column) -> Column:
     """LIST<STRUCT<key STRING, value STRING>> of each row's top-level pairs."""
     assert col.dtype.id is dt.TypeId.STRING

@@ -27,6 +27,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column
 from ..columnar.strings import pack_byte_rows
+from ..utils.tracing import func_range
 
 # ---------------------------------------------------------------------------
 # character classes (ASCII); bytes >= 0x80 are handled by the UTF-8 rules
@@ -449,16 +450,19 @@ def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
                   offsets=jnp.asarray(offsets.astype(np.int32)))
 
 
+@func_range()
 def parse_uri_to_protocol(col: Column) -> Column:
     """Spark `parse_url(url, 'PROTOCOL')` (reference :957)."""
     return _native_parse(col, _PART_PROTOCOL)
 
 
+@func_range()
 def parse_uri_to_host(col: Column) -> Column:
     """Spark `parse_url(url, 'HOST')` (reference :965)."""
     return _native_parse(col, _PART_HOST)
 
 
+@func_range()
 def parse_uri_to_query(col: Column) -> Column:
     """Spark `parse_url(url, 'QUERY')` (reference :973)."""
     return _native_parse(col, _PART_QUERY)
@@ -491,10 +495,12 @@ def _find_query_part(query: bytes, key: bytes) -> Optional[bytes]:
     return None
 
 
+@func_range()
 def parse_uri_to_query_with_literal(col: Column, key: str) -> Column:
     return _native_parse(col, _PART_QUERY, key_literal=key.encode())
 
 
+@func_range()
 def parse_uri_to_query_with_column(col: Column, keys: Column) -> Column:
     if keys.size != col.size:
         raise ValueError("keys column must match the url column's row count")

@@ -45,6 +45,7 @@ from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..columnar.strings import pad_width, padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
+from ..utils.tracing import func_range
 
 JCUDF_ROW_ALIGNMENT = 8
 MAX_BATCH_BYTES = (1 << 31) - 1  # LIST<INT8> offsets are int32 (2 GB limit)
@@ -279,6 +280,7 @@ def _rows_column(blob: jnp.ndarray, row_offsets: np.ndarray) -> Column:
     return Column.list_of(child, jnp.asarray(row_offsets, dtype=jnp.int32))
 
 
+@func_range()
 def convert_to_rows(table: Table,
                     max_batch_bytes: int = MAX_BATCH_BYTES) -> List[Column]:
     """Columnar -> JCUDF rows (row_conversion.cu:1990).
@@ -404,6 +406,7 @@ def _extract_validity_words(words: jnp.ndarray, info: ColumnInfo,
             .astype(bool))
 
 
+@func_range()
 def convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
     """JCUDF rows -> columnar (row_conversion.cu:2145).
 

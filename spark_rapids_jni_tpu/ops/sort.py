@@ -23,6 +23,7 @@ from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import _f32_bits, _f64_bits
+from ..utils.tracing import func_range
 
 
 def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
@@ -79,6 +80,7 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
     return [data.astype(jnp.uint64)]
 
 
+@func_range()
 def sort_order(keys: Sequence[Column],
                ascending: Optional[Sequence[bool]] = None,
                nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
@@ -159,6 +161,7 @@ def gather(col: Column, idx: jnp.ndarray) -> Column:
                   validity=validity)
 
 
+@func_range()
 def sort_table(table: Table, key_indices: Sequence[int],
                ascending: Optional[Sequence[bool]] = None,
                nulls_first: Optional[Sequence[bool]] = None) -> Table:

@@ -40,6 +40,9 @@ class _Entry:
 _REGISTRY: Dict[str, _Entry] = {}
 _overrides: Dict[str, Any] = {}
 _lock = threading.Lock()
+# bumped on every mutation; lets hot paths cache a resolved flag and
+# revalidate with one unlocked integer read (see utils/tracing)
+_epoch = 0
 
 
 def _register(key: str, env: str, default: Any, parse, doc: str):
@@ -84,13 +87,22 @@ def get(key: str) -> Any:
 def set(key: str, value: Any) -> None:  # noqa: A001 - mirrors JVM setProperty
     if key not in _REGISTRY:
         raise KeyError(f"unknown config key {key!r}")
+    global _epoch
     with _lock:
         _overrides[key] = value
+        _epoch += 1
 
 
 def unset(key: str) -> None:
+    global _epoch
     with _lock:
         _overrides.pop(key, None)
+        _epoch += 1
+
+
+def epoch() -> int:
+    """Mutation counter (unlocked read; monotonic under the lock)."""
+    return _epoch
 
 
 @contextlib.contextmanager
@@ -98,10 +110,12 @@ def override(key: str, value: Any):
     """Scoped override (tests)."""
     if key not in _REGISTRY:
         raise KeyError(f"unknown config key {key!r}")
+    global _epoch
     with _lock:
         had = key in _overrides
         old = _overrides.get(key)
         _overrides[key] = value
+        _epoch += 1
     try:
         yield
     finally:
@@ -110,6 +124,7 @@ def override(key: str, value: Any):
                 _overrides[key] = old
             else:
                 _overrides.pop(key, None)
+            _epoch += 1
 
 
 def describe() -> Dict[str, Dict[str, Any]]:

@@ -21,9 +21,20 @@ import contextlib
 import functools
 
 
+_cached = (-1, False)  # (config epoch, resolved flag)
+
+
 def tracing_enabled() -> bool:
+    """Cheap-when-off: one unlocked epoch read per call; the flag is
+    re-resolved (lock + env) only after a config mutation. Env-var changes
+    made after the first call are seen at the next config mutation — use
+    config.set("trace.enabled", ...) to toggle at runtime."""
+    global _cached
     from . import config
-    return bool(config.get("trace.enabled"))
+    e = config.epoch()
+    if _cached[0] != e:
+        _cached = (e, bool(config.get("trace.enabled")))
+    return _cached[1]
 
 
 @contextlib.contextmanager
