@@ -53,19 +53,29 @@ def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
 
 
 def _decimal128_segment_sum(vcol: Column, order, valid, seg_ids,
-                            num_segments: int, any_valid) -> Column:
+                            num_segments: int, any_valid,
+                            with_overflow: bool = False):
     """Exact 128-bit segmented sum: each u32 limb accumulates independently
     in int64 lanes (limb sums stay < 2^63 for any group under 2^31 rows),
     then one vectorized carry propagation per group reassembles the
     two's-complement result mod 2^128 — negative addends enter as their
     unsigned limb patterns, so the wrap *is* the signed sum. Matches the
     vendored layer's wrapping sum; precision-overflow policy stays with the
-    caller, as in the reference plugin."""
+    caller, as in the reference plugin.
+
+    with_overflow: also return bool[g] marking groups whose TRUE sum falls
+    outside int128 (detected via a fifth sign-extension limb: the 160-bit
+    sum is exact for any group under 2^31 rows, and it fits int128 iff limb
+    4 equals the sign extension of limb 3's top bit)."""
     limbs = jnp.take(vcol.data, order, axis=0)          # u32[n, 4] sorted
     limbs = jnp.where(valid[:, None], limbs, jnp.uint32(0))
     s = jax.ops.segment_sum(limbs.astype(jnp.int64), seg_ids,
                             num_segments=num_segments,
                             indices_are_sorted=True)    # i64[g, 4]
+    neg = (limbs[:, 3] >> np.uint32(31)) != 0           # invalid rows are 0
+    s4 = jax.ops.segment_sum(
+        jnp.where(neg, np.int64(0xFFFFFFFF), np.int64(0)), seg_ids,
+        num_segments=num_segments, indices_are_sorted=True)
     out = []
     carry = jnp.zeros((num_segments,), dtype=jnp.int64)
     for j in range(4):
@@ -74,8 +84,14 @@ def _decimal128_segment_sum(vcol: Column, order, valid, seg_ids,
         carry = t >> np.int64(32)  # t >= 0: limb sums and carries are
         #                            nonnegative; signedness reappears only
         #                            in the final mod-2^128 bit pattern
-    return Column(vcol.dtype, num_segments, data=jnp.stack(out, axis=1),
-                  validity=any_valid)
+    col = Column(vcol.dtype, num_segments, data=jnp.stack(out, axis=1),
+                 validity=any_valid)
+    if not with_overflow:
+        return col
+    r4 = ((s4 + carry) & np.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    sign_ext = jnp.where((out[3] >> np.uint32(31)) != 0,
+                         np.uint32(0xFFFFFFFF), np.uint32(0))
+    return col, r4 != sign_ext
 
 
 def _decimal128_segment_minmax(vcol: Column, order, valid, seg_ids,
@@ -110,6 +126,33 @@ def _decimal128_segment_minmax(vcol: Column, order, valid, seg_ids,
     return Column(vcol.dtype, num_segments, data=out, validity=any_valid)
 
 
+def _decimal128_segment_mean(vcol: Column, order, valid, seg_ids,
+                             num_segments: int, cnt,
+                             out_dtype: dt.DType) -> Column:
+    """Spark avg(decimal): exact 128-bit group sums divided by the group
+    count through ops/decimal128's HALF_UP division at scale min(s+4, 38).
+    Zero-count (all-null) groups, sums wrapping past int128, and 38-digit
+    quotient overflows all come back null."""
+    from .decimal128 import divide_decimal128
+
+    sum_col, sum_wrap = _decimal128_segment_sum(
+        vcol, order, valid, seg_ids, num_segments, cnt > 0,
+        with_overflow=True)
+    cu = cnt.astype(jnp.uint64)  # counts are >= 0; scale-0 decimal limbs
+    cnt_limbs = jnp.stack([
+        (cu & np.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        (cu >> np.uint64(32)).astype(jnp.uint32),
+        jnp.zeros((num_segments,), jnp.uint32),
+        jnp.zeros((num_segments,), jnp.uint32),
+    ], axis=1)
+    cnt_col = Column(dt.decimal128(0), num_segments, data=cnt_limbs)
+    res = divide_decimal128(sum_col, cnt_col, out_dtype.scale)
+    overflow = (res.columns[0].data != 0) | sum_wrap
+    mean = res.columns[1]
+    return Column(out_dtype, num_segments, data=mean.data,
+                  validity=(cnt > 0) & ~overflow)
+
+
 def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
     """(numeric device array, is_float) for aggregation. Floats accumulate in
     f64: Spark promotes float to double before summing."""
@@ -131,9 +174,12 @@ def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
     if op == "count":
         return dt.INT64
     if vdtype.id is dt.TypeId.DECIMAL128:
+        if op == "mean":
+            # Spark avg(decimal(p, s)) -> decimal scale min(s+4, 38)
+            return dt.decimal128(min(vdtype.scale + 4, 38))
         if op not in ("sum", "min", "max"):
             raise TypeError(f"groupby {op} unsupported for decimal128 "
-                            f"(sum/min/max/count are)")
+                            f"(sum/min/max/mean/count are)")
         return vdtype
     if not vdtype.is_fixed_width:
         raise TypeError(f"groupby aggregation unsupported for "
@@ -210,6 +256,10 @@ def _groupby_aggregate(
             if op == "sum":
                 out_cols.append(_decimal128_segment_sum(
                     vcol, order, valid, seg_ids, num_segments, cnt > 0))
+            elif op == "mean":
+                out_cols.append(_decimal128_segment_mean(
+                    vcol, order, valid, seg_ids, num_segments, cnt,
+                    out_dtype))
             else:
                 out_cols.append(_decimal128_segment_minmax(
                     vcol, order, valid, seg_ids, num_segments, cnt > 0,

@@ -301,8 +301,6 @@ def test_groupby_decimal128_sum_exact():
         assert mm[2] == (decimal.Decimal(-1).scaleb(-2),
                          decimal.Decimal(7).scaleb(-2))
     assert mm[3] == (None, None)
-    with pytest.raises(TypeError, match="decimal128"):
-        groupby_aggregate(Table((k, d)), [0], [(1, "mean")])
     s = Column.from_pylist(["a", "b", "c", "d", "e", "f", "g"], dt.STRING)
     with pytest.raises(TypeError, match="string"):
         groupby_aggregate(Table((k, s)), [0], [(1, "sum")])
@@ -320,8 +318,58 @@ def test_groupby_empty_table_schema_matches_nonempty():
     out = groupby_aggregate(Table((ke, de)), [0], [(1, "sum"), (1, "count")])
     assert out.columns[1].dtype == dt.decimal128(2)
     assert out.columns[2].dtype == dt.INT64
-    with pytest.raises(TypeError, match="decimal128"):
-        groupby_aggregate(Table((ke, de)), [0], [(1, "mean")])
+    gm = groupby_aggregate(Table((ke, de)), [0], [(1, "mean")])
+    assert gm.columns[1].dtype == dt.decimal128(6)  # scale s+4
     se = Column.from_pylist([], dt.STRING)
     with pytest.raises(TypeError, match="string"):
         groupby_aggregate(Table((ke, se)), [0], [(1, "sum")])
+
+
+def test_groupby_decimal128_mean_matches_decimal_oracle():
+    """avg(decimal(s)) = HALF_UP sum/count at scale s+4, null for all-null
+    groups — checked against python Decimal arithmetic."""
+    import decimal
+
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+
+    keys = [1, 1, 1, 2, 2, 3, 4]
+    vals = [10**25, -3, 5, 7, None, None, 2]
+    k = Column.from_pylist(keys, dt.INT64)
+    d = Column.from_pylist(vals, dt.decimal128(2))
+    g = sort_table(groupby_aggregate(Table((k, d)), [0], [(1, "mean")]), [0])
+    got = dict(zip(g.columns[0].to_pylist(), g.columns[1].to_pylist()))
+
+    with decimal.localcontext(decimal.Context(prec=60)):
+        q = decimal.Decimal(1).scaleb(-6)  # scale 2 + 4
+        want = {}
+        sums, cnts = {}, {}
+        for kk, vv in zip(keys, vals):
+            if vv is None:
+                continue
+            sums[kk] = sums.get(kk, 0) + vv
+            cnts[kk] = cnts.get(kk, 0) + 1
+        for kk in set(keys):
+            if kk not in sums:
+                want[kk] = None
+            else:
+                want[kk] = (decimal.Decimal(sums[kk]).scaleb(-2)
+                            / cnts[kk]).quantize(
+                                q, rounding=decimal.ROUND_HALF_UP)
+    assert got == want, (got, want)
+
+
+def test_groupby_decimal128_mean_wrapped_sum_is_null():
+    """A group whose true sum exceeds int128 (the 128-bit sum op wraps by
+    contract) must yield a null mean, not a wrong sign-flipped value."""
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    big = 16 * 10**37  # fits int128; two of them do not
+    k = Column.from_pylist([1, 1, 2], dt.INT64)
+    d = Column.from_pylist([big, big, 5], dt.decimal128(0))
+    g = groupby_aggregate(Table((k, d)), [0], [(1, "mean")])
+    by_key = dict(zip(g.columns[0].to_pylist(), g.columns[1].to_pylist()))
+    import decimal
+    assert by_key[1] is None
+    assert by_key[2] == decimal.Decimal(5).scaleb(0).quantize(
+        decimal.Decimal(1).scaleb(-4))
