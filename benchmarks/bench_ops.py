@@ -207,18 +207,34 @@ def bench_sort(rows: int):
     return sec, rows * 8
 
 
-def bench_tpch_q3(rows: int):
+def _query_mesh(n_devices: int):
+    """Mesh for distributed query benches (None = local single-device)."""
+    if n_devices <= 0:
+        return None
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < n_devices:  # not assert: must hold under python -O too
+        raise SystemExit(
+            f"--mesh {n_devices} needs {n_devices} devices, have {len(devs)} "
+            f"(CPU: set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return Mesh(np.array(devs[:n_devices]), axis_names=("shuffle",))
+
+
+def bench_tpch_q3(rows: int, mesh_devices: int = 0):
     """BASELINE configs[2]-shaped: the TPC-H q3 operator pipeline — two
     filters, customer⋈orders and lineitem⋈orders hash joins, groupby-sum of
     revenue, sort desc, top 10 — at `rows` lineitem rows (TPC-H row ratios).
     Pipeline + data shapes live in benchmarks/tpch.py, shared with the
-    numpy-oracle correctness test."""
+    numpy-oracle correctness test. With mesh_devices > 0 the joins and
+    groupby run distributed over the device mesh."""
     from benchmarks.tpch import generate_q3_tables, run_q3
 
+    mesh = _query_mesh(mesh_devices)
     datasets = [generate_q3_tables(rows, seed=s) for s in range(_NVARIANTS)]
 
     def run(i):
-        out = run_q3(*datasets[i % _NVARIANTS])
+        out = run_q3(*datasets[i % _NVARIANTS], mesh=mesh)
         return [c.data for c in out.columns]
 
     sec = _time(run, warmup=_NVARIANTS)
@@ -227,16 +243,18 @@ def bench_tpch_q3(rows: int):
     return sec, nbytes
 
 
-def bench_tpch_q5(rows: int):
+def bench_tpch_q5(rows: int, mesh_devices: int = 0):
     """BASELINE configs[2]-shaped: the TPC-H q5 operator pipeline — four
     joins, a co-nation predicate, groupby-sum per nation, sort. Pipeline in
-    benchmarks/tpch.py, shared with the oracle test."""
+    benchmarks/tpch.py, shared with the oracle test. With mesh_devices > 0
+    the joins and groupby run distributed over the device mesh."""
     from benchmarks.tpch import generate_q5_tables, run_q5
 
+    mesh = _query_mesh(mesh_devices)
     datasets = [generate_q5_tables(rows, seed=s) for s in range(_NVARIANTS)]
 
     def run(i):
-        out = run_q5(*datasets[i % _NVARIANTS])
+        out = run_q5(*datasets[i % _NVARIANTS], mesh=mesh)
         return [c.data for c in out.columns]
 
     sec = _time(run, warmup=_NVARIANTS)
@@ -328,6 +346,9 @@ def bench_parquet_decode(rows: int):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="run the tpch query benches distributed over an "
+                         "N-device mesh (0 = local)")
     ap.add_argument("--bench", default="all",
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
@@ -366,11 +387,15 @@ def main():
         runs.append(("sort", "int64", args.rows,
                      lambda: bench_sort(args.rows)))
     if args.bench in ("all", "tpch_q3"):
-        runs.append(("tpch_q3", "filter+2join+groupby+sort", args.rows,
-                     lambda: bench_tpch_q3(args.rows)))
+        cfg = ("filter+2join+groupby+sort" if not args.mesh
+               else f"distributed mesh={args.mesh}")
+        runs.append(("tpch_q3", cfg, args.rows,
+                     lambda: bench_tpch_q3(args.rows, args.mesh)))
     if args.bench in ("all", "tpch_q5"):
-        runs.append(("tpch_q5", "4join+conation+groupby+sort", args.rows,
-                     lambda: bench_tpch_q5(args.rows)))
+        cfg = ("4join+conation+groupby+sort" if not args.mesh
+               else f"distributed mesh={args.mesh}")
+        runs.append(("tpch_q5", cfg, args.rows,
+                     lambda: bench_tpch_q5(args.rows, args.mesh)))
     if args.bench in ("all", "get_json_object"):
         jrows = min(args.rows, 500_000)
         runs.append(("get_json_object", "native host tier", jrows,
