@@ -346,7 +346,13 @@ def bench_parquet_decode(rows: int):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
-    ap.add_argument("--mesh", type=int, default=0,
+    def _nonneg(v):
+        v = int(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError("--mesh must be >= 0")
+        return v
+
+    ap.add_argument("--mesh", type=_nonneg, default=0,
                     help="run the tpch query benches distributed over an "
                          "N-device mesh (0 = local)")
     ap.add_argument("--bench", default="all",
@@ -358,6 +364,8 @@ def main():
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
+    if args.mesh:
+        _query_mesh(args.mesh)  # fail fast before any bench runs
 
     runs = []
     if args.bench in ("all", "row_conversion"):

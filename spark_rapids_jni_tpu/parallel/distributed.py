@@ -72,15 +72,19 @@ def distributed_inner_join(
 
 
 def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
-                     samples_per_part: int = 64) -> Table:
+                     samples_per_part: int = 64,
+                     ascending=None, nulls_first=None) -> Table:
     """Sample-sort across the mesh: sample keys to pick nd-1 splitters,
-    range-partition (partition p holds keys in [splitter[p-1], splitter[p])),
-    local sort per partition, concat in partition order = total order."""
+    range-partition (partition p holds keys in [splitter[p-1], splitter[p])
+    under the requested per-key order), local sort per partition, concat in
+    partition order = total order. ascending/nulls_first follow
+    ops/sort.sort_table (the splitter ranking uses the same comparator, so
+    the flags generalize the partitioning for free)."""
     nd = mesh.devices.size
     n = table.num_rows
     keys = [table.columns[i] for i in key_indices]
     if n == 0 or nd == 1:
-        return sort_table(table, key_indices)
+        return sort_table(table, key_indices, ascending, nulls_first)
 
     # sample rows, sort them with the real comparator, take even splitters
     rng = np.random.default_rng(0)
@@ -90,7 +94,7 @@ def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
     from ..columnar.table_ops import concat_columns
     from ..ops.sort import gather
     sampled = [gather(k, sample_idx) for k in keys]
-    sorder = np.asarray(sort_order(sampled))
+    sorder = np.asarray(sort_order(sampled, ascending, nulls_first))
     splitter_rows = jnp.asarray(
         np.array([sorder[(j * m) // nd] for j in range(1, nd)],
                  dtype=np.int32))
@@ -101,7 +105,7 @@ def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
     # precede their splitter and share a partition)
     merged = [concat_columns([k, gather(s, splitter_rows)])
               for k, s in zip(keys, sampled)]
-    order = np.asarray(sort_order(merged))
+    order = np.asarray(sort_order(merged, ascending, nulls_first))
     pos = np.empty(n + nd - 1, dtype=np.int64)
     pos[order] = np.arange(n + nd - 1)
     splitter_pos = np.sort(pos[n:])
@@ -109,7 +113,8 @@ def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
 
     parts = hash_partition_exchange(table, key_indices, mesh,
                                     dest=jnp.asarray(dest))
-    outs = [sort_table(p, key_indices) for p in parts if p.num_rows]
+    outs = [sort_table(p, key_indices, ascending, nulls_first)
+            for p in parts if p.num_rows]
     if not outs:
-        return sort_table(table, key_indices)
+        return sort_table(table, key_indices, ascending, nulls_first)
     return concat_tables(outs)
