@@ -186,6 +186,22 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
 
 
 @func_range()
+def _matched_mask(l_idx, n_left: int) -> np.ndarray:
+    """bool[n_left] marking rows present in an inner-join gather map."""
+    m = np.zeros(n_left, dtype=bool)
+    m[np.asarray(l_idx)] = True
+    return m
+
+
+def _expand_left_outer(l_idx, r_idx, n_left: int):
+    """Inner-join maps -> left-outer maps (unmatched left rows get right
+    index -1). Shared by the local and distributed left joins."""
+    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)
+    miss = np.flatnonzero(~_matched_mask(l_idx, n_left))
+    return (np.concatenate([l_idx, miss]),
+            np.concatenate([r_idx, np.full(len(miss), -1, dtype=np.int64)]))
+
+
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
                nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather maps (left_indices, right_indices) of matching row pairs —
@@ -200,12 +216,7 @@ def left_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Left outer join; unmatched left rows get right index -1."""
     l_idx, r_idx = _candidates(left_keys, right_keys, nulls_equal)
-    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)  # one D2H each
-    matched = np.zeros(left_keys[0].size, dtype=bool)
-    matched[l_idx] = True
-    miss = np.where(~matched)[0]
-    return (np.concatenate([l_idx, miss]),
-            np.concatenate([r_idx, np.full(len(miss), -1, dtype=np.int64)]))
+    return _expand_left_outer(l_idx, r_idx, left_keys[0].size)
 
 
 @func_range()
@@ -214,12 +225,8 @@ def full_join(left_keys, right_keys,
     """Full outer join; unmatched rows get -1 on the other side."""
     l_idx, r_idx = _candidates(left_keys, right_keys, nulls_equal)
     l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)  # one D2H each
-    lmatched = np.zeros(left_keys[0].size, dtype=bool)
-    lmatched[l_idx] = True
-    rmatched = np.zeros(right_keys[0].size, dtype=bool)
-    rmatched[r_idx] = True
-    lmiss = np.where(~lmatched)[0]
-    rmiss = np.where(~rmatched)[0]
+    lmiss = np.flatnonzero(~_matched_mask(l_idx, left_keys[0].size))
+    rmiss = np.flatnonzero(~_matched_mask(r_idx, right_keys[0].size))
     return (np.concatenate([l_idx, lmiss,
                             np.full(len(rmiss), -1, dtype=np.int64)]),
             np.concatenate([r_idx, np.full(len(lmiss), -1, dtype=np.int64),
@@ -231,10 +238,7 @@ def left_semi_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with at least one match."""
     l_idx, _ = _candidates(left_keys, right_keys, nulls_equal)
-    l_idx = np.asarray(l_idx)
-    matched = np.zeros(left_keys[0].size, dtype=bool)
-    matched[l_idx] = True
-    return np.where(matched)[0]
+    return np.flatnonzero(_matched_mask(l_idx, left_keys[0].size))
 
 
 @func_range()
@@ -242,7 +246,4 @@ def left_anti_join(left_keys, right_keys,
                    nulls_equal: bool = False) -> np.ndarray:
     """Indices of left rows with no match."""
     l_idx, _ = _candidates(left_keys, right_keys, nulls_equal)
-    l_idx = np.asarray(l_idx)
-    matched = np.zeros(left_keys[0].size, dtype=bool)
-    matched[l_idx] = True
-    return np.where(~matched)[0]
+    return np.flatnonzero(~_matched_mask(l_idx, left_keys[0].size))

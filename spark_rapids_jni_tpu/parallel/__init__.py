@@ -11,6 +11,9 @@ from .exchange import hash_partition_exchange
 from .distributed import (
     distributed_groupby,
     distributed_inner_join,
+    distributed_left_anti_join,
+    distributed_left_join,
+    distributed_left_semi_join,
     distributed_sort,
 )
 from .task_executor import TaskExecutor
@@ -19,6 +22,9 @@ __all__ = [
     "hash_partition_exchange",
     "distributed_groupby",
     "distributed_inner_join",
+    "distributed_left_anti_join",
+    "distributed_left_join",
+    "distributed_left_semi_join",
     "distributed_sort",
     "TaskExecutor",
 ]

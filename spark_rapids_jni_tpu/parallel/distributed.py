@@ -23,7 +23,12 @@ from ..columnar.column import Column, Table
 from ..columnar import dtype as dt
 from ..columnar.table_ops import concat_tables
 from ..ops.groupby import groupby_aggregate
-from ..ops.join import inner_join
+from ..ops.join import (
+    _expand_left_outer,
+    inner_join,
+    left_anti_join,
+    left_semi_join,
+)
 from ..ops.sort import sort_order, sort_table
 from .exchange import hash_partition_exchange
 
@@ -64,11 +69,63 @@ def distributed_inner_join(
             continue
         li, ri = inner_join(list(lp.columns[:nk]), list(rp.columns[:nk]),
                             nulls_equal=nulls_equal)
-        l_out.append(np.asarray(lp.columns[nk].data)[li])
-        r_out.append(np.asarray(rp.columns[nk].data)[ri])
+        l_out.append(np.asarray(lp.columns[nk].data)[np.asarray(li)])
+        r_out.append(np.asarray(rp.columns[nk].data)[np.asarray(ri)])
     if not l_out:
         return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
     return np.concatenate(l_out), np.concatenate(r_out)
+
+
+def distributed_left_join(
+        left_keys: Sequence[Column], right_keys: Sequence[Column],
+        mesh: Mesh, nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Left outer join: inner matches via the co-partitioned join, then
+    unmatched left rows appended with right index -1 (shared expansion with
+    ops/join.left_join) — matches are complete because co-partitioning puts
+    every equal-key pair in one partition."""
+    li, ri = distributed_inner_join(left_keys, right_keys, mesh, nulls_equal)
+    return _expand_left_outer(li, ri, left_keys[0].size)
+
+
+def _distributed_membership(left_keys, right_keys, mesh, nulls_equal,
+                            local_fn, empty_right_is_member: bool):
+    """Shared semi/anti machinery: run the *local* semi/anti per
+    co-partitioned partition and translate row ids — each left row lives in
+    exactly one partition, so per-partition membership is complete, and the
+    host never materializes the O(total pairs) inner gather maps."""
+    nk = len(left_keys)
+    key_idx = list(range(nk))
+    lparts = hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh)
+    rparts = hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh)
+    out: List[np.ndarray] = []
+    for lp, rp in zip(lparts, rparts):
+        if lp.num_rows == 0:
+            continue
+        rids = np.asarray(lp.columns[nk].data)
+        if rp.num_rows == 0:
+            if empty_right_is_member:  # anti: nothing to match against
+                out.append(rids)
+            continue
+        idx = local_fn(list(lp.columns[:nk]), list(rp.columns[:nk]),
+                       nulls_equal=nulls_equal)
+        out.append(rids[np.asarray(idx)])
+    if not out:
+        return np.zeros(0, dtype=np.int64)
+    return np.sort(np.concatenate(out))
+
+
+def distributed_left_semi_join(left_keys, right_keys, mesh: Mesh,
+                               nulls_equal: bool = False) -> np.ndarray:
+    """Indices of left rows with at least one match."""
+    return _distributed_membership(left_keys, right_keys, mesh, nulls_equal,
+                                   left_semi_join, False)
+
+
+def distributed_left_anti_join(left_keys, right_keys, mesh: Mesh,
+                               nulls_equal: bool = False) -> np.ndarray:
+    """Indices of left rows with no match."""
+    return _distributed_membership(left_keys, right_keys, mesh, nulls_equal,
+                                   left_anti_join, True)
 
 
 def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,

@@ -176,3 +176,23 @@ def test_distributed_sort_desc_nulls_last(mesh):
                       ascending=[False, True], nulls_first=[False, True])
     for gc, wc in zip(got.columns, want.columns):
         assert gc.to_pylist() == wc.to_pylist()
+
+
+def test_distributed_outer_semi_anti_joins_match_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import (
+        left_anti_join, left_join, left_semi_join)
+    from spark_rapids_jni_tpu.parallel import (
+        distributed_left_anti_join, distributed_left_join,
+        distributed_left_semi_join)
+    rng = np.random.default_rng(4)
+    lk = [Column.from_numpy(rng.integers(0, 50, 600), dt.INT64)]
+    rk = [Column.from_numpy(rng.integers(25, 75, 250), dt.INT64)]
+
+    gl, gr = distributed_left_join(lk, rk, mesh)
+    wl, wr = left_join(lk, rk)
+    assert sorted(zip(gl.tolist(), gr.tolist())) \
+        == sorted(zip(np.asarray(wl).tolist(), np.asarray(wr).tolist()))
+    assert sorted(distributed_left_semi_join(lk, rk, mesh).tolist()) \
+        == sorted(np.asarray(left_semi_join(lk, rk)).tolist())
+    assert sorted(distributed_left_anti_join(lk, rk, mesh).tolist()) \
+        == sorted(np.asarray(left_anti_join(lk, rk)).tolist())
