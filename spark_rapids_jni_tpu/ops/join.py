@@ -202,6 +202,18 @@ def _expand_left_outer(l_idx, r_idx, n_left: int):
             np.concatenate([r_idx, np.full(len(miss), -1, dtype=np.int64)]))
 
 
+def _expand_full_outer(l_idx, r_idx, n_left: int, n_right: int):
+    """Inner-join maps -> full-outer maps (unmatched rows on either side get
+    -1 on the other). Shared by the local and distributed full joins."""
+    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)
+    lmiss = np.flatnonzero(~_matched_mask(l_idx, n_left))
+    rmiss = np.flatnonzero(~_matched_mask(r_idx, n_right))
+    return (np.concatenate([l_idx, lmiss,
+                            np.full(len(rmiss), -1, dtype=np.int64)]),
+            np.concatenate([r_idx, np.full(len(lmiss), -1, dtype=np.int64),
+                            rmiss]))
+
+
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
                nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather maps (left_indices, right_indices) of matching row pairs —
@@ -224,13 +236,8 @@ def full_join(left_keys, right_keys,
               nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Full outer join; unmatched rows get -1 on the other side."""
     l_idx, r_idx = _candidates(left_keys, right_keys, nulls_equal)
-    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)  # one D2H each
-    lmiss = np.flatnonzero(~_matched_mask(l_idx, left_keys[0].size))
-    rmiss = np.flatnonzero(~_matched_mask(r_idx, right_keys[0].size))
-    return (np.concatenate([l_idx, lmiss,
-                            np.full(len(rmiss), -1, dtype=np.int64)]),
-            np.concatenate([r_idx, np.full(len(lmiss), -1, dtype=np.int64),
-                            rmiss]))
+    return _expand_full_outer(l_idx, r_idx, left_keys[0].size,
+                              right_keys[0].size)
 
 
 @func_range()

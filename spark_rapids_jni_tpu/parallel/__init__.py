@@ -9,6 +9,7 @@ shapes (XLA needs static shapes; capacity = the per-device row count).
 
 from .exchange import hash_partition_exchange
 from .distributed import (
+    distributed_full_join,
     distributed_groupby,
     distributed_inner_join,
     distributed_left_anti_join,
@@ -20,6 +21,7 @@ from .task_executor import TaskExecutor
 
 __all__ = [
     "hash_partition_exchange",
+    "distributed_full_join",
     "distributed_groupby",
     "distributed_inner_join",
     "distributed_left_anti_join",

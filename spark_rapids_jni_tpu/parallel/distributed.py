@@ -24,6 +24,7 @@ from ..columnar import dtype as dt
 from ..columnar.table_ops import concat_tables
 from ..ops.groupby import groupby_aggregate
 from ..ops.join import (
+    _expand_full_outer,
     _expand_left_outer,
     inner_join,
     left_anti_join,
@@ -85,6 +86,15 @@ def distributed_left_join(
     every equal-key pair in one partition."""
     li, ri = distributed_inner_join(left_keys, right_keys, mesh, nulls_equal)
     return _expand_left_outer(li, ri, left_keys[0].size)
+
+
+def distributed_full_join(
+        left_keys: Sequence[Column], right_keys: Sequence[Column],
+        mesh: Mesh, nulls_equal: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Full outer join: co-partitioned inner matches plus both sides'
+    unmatched rows (shared expansion with ops/join.full_join)."""
+    li, ri = distributed_inner_join(left_keys, right_keys, mesh, nulls_equal)
+    return _expand_full_outer(li, ri, left_keys[0].size, right_keys[0].size)
 
 
 def _distributed_membership(left_keys, right_keys, mesh, nulls_equal,

@@ -196,3 +196,15 @@ def test_distributed_outer_semi_anti_joins_match_local(mesh):
         == sorted(np.asarray(left_semi_join(lk, rk)).tolist())
     assert sorted(distributed_left_anti_join(lk, rk, mesh).tolist()) \
         == sorted(np.asarray(left_anti_join(lk, rk)).tolist())
+
+
+def test_distributed_full_join_matches_local(mesh):
+    from spark_rapids_jni_tpu.ops.join import full_join
+    from spark_rapids_jni_tpu.parallel import distributed_full_join
+    rng = np.random.default_rng(8)
+    lk = [Column.from_numpy(rng.integers(0, 40, 500), dt.INT64)]
+    rk = [Column.from_numpy(rng.integers(20, 60, 200), dt.INT64)]
+    gl, gr = distributed_full_join(lk, rk, mesh)
+    wl, wr = full_join(lk, rk)
+    assert sorted(zip(gl.tolist(), gr.tolist())) \
+        == sorted(zip(np.asarray(wl).tolist(), np.asarray(wr).tolist()))
