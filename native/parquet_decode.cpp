@@ -12,10 +12,12 @@
 //
 // Format coverage:
 //   * page headers: thrift-compact PageHeader (v1 + v2 data pages, dict pages)
-//   * codecs: UNCOMPRESSED, SNAPPY (independent re-implementation of the
+//   * codecs: UNCOMPRESSED, SNAPPY, GZIP, ZSTD, LZ4_RAW + legacy LZ4
+//     framing (independent re-implementation of the
 //     published snappy format spec)
 //   * encodings: PLAIN, PLAIN_DICTIONARY / RLE_DICTIONARY, RLE (bool),
-//     bit-packed/RLE hybrid definition levels
+//     DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY, DELTA_BYTE_ARRAY,
+//     BYTE_STREAM_SPLIT, bit-packed/RLE hybrid definition levels
 //   * physical types: BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY,
 //     FIXED_LEN_BYTE_ARRAY (decimals → 16-byte little-endian limb values)
 //   * flat columns (max_rep == 0); nested decode is rejected with a clear
@@ -70,10 +72,13 @@ enum phys_type {
   PT_DOUBLE = 5, PT_BYTE_ARRAY = 6, PT_FLBA = 7,
 };
 enum encoding {
-  ENC_PLAIN = 0, ENC_PLAIN_DICT = 2, ENC_RLE = 3, ENC_RLE_DICT = 8,
+  ENC_PLAIN = 0, ENC_PLAIN_DICT = 2, ENC_RLE = 3, ENC_DELTA_BP = 5,
+  ENC_DELTA_LEN_BA = 6, ENC_DELTA_BA = 7, ENC_RLE_DICT = 8,
+  ENC_BYTE_STREAM_SPLIT = 9,
 };
 enum codec {
-  CODEC_NONE = 0, CODEC_SNAPPY = 1, CODEC_GZIP = 2, CODEC_ZSTD = 6,
+  CODEC_NONE = 0, CODEC_SNAPPY = 1, CODEC_GZIP = 2, CODEC_LZ4 = 5,
+  CODEC_ZSTD = 6, CODEC_LZ4_RAW = 7,
 };
 constexpr int REP_OPTIONAL = 1, REP_REPEATED = 2;
 
@@ -154,6 +159,136 @@ static void snappy_decompress(const uint8_t* in, size_t in_len,
     if (out.size() > out_len) throw std::runtime_error("snappy: output overrun");
   }
   if (out.size() != out_len) throw std::runtime_error("snappy: short output");
+}
+
+// ---- LZ4 block format -------------------------------------------------------
+// Independent implementation of the LZ4 block decompressor (sequences of
+// [token][literals][16-bit offset][match]); LZ4_RAW pages are one block,
+// legacy LZ4 (hadoop) pages wrap blocks in big-endian size frames.
+static void lz4_block_decompress(const uint8_t* src, size_t comp,
+                                 std::vector<uint8_t>& out, size_t out_cap) {
+  size_t pos = 0;
+  while (pos < comp) {
+    uint8_t token = src[pos++];
+    size_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (pos >= comp) throw std::runtime_error("lz4: truncated litlen");
+        b = src[pos++];
+        lit += b;
+      } while (b == 255);
+    }
+    if (lit > comp - pos) throw std::runtime_error("lz4: truncated literals");
+    if (out.size() + lit > out_cap) throw std::runtime_error("lz4: overflow");
+    out.insert(out.end(), src + pos, src + pos + lit);
+    pos += lit;
+    if (pos == comp) break;  // last sequence carries literals only
+    if (pos + 2 > comp) throw std::runtime_error("lz4: truncated offset");
+    size_t offset = (size_t)src[pos] | ((size_t)src[pos + 1] << 8);
+    pos += 2;
+    if (offset == 0 || offset > out.size())
+      throw std::runtime_error("lz4: bad match offset");
+    size_t mlen = (token & 15) + 4;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (pos >= comp) throw std::runtime_error("lz4: truncated matchlen");
+        b = src[pos++];
+        mlen += b;
+      } while (b == 255);
+    }
+    if (out.size() + mlen > out_cap) throw std::runtime_error("lz4: overflow");
+    size_t from = out.size() - offset;
+    for (size_t i = 0; i < mlen; i++)  // byte-wise: matches may overlap
+      out.push_back(out[from + i]);
+  }
+}
+
+// ---- DELTA_BINARY_PACKED ----------------------------------------------------
+static uint64_t read_uleb(const uint8_t* p, size_t len, size_t& pos) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= len) throw std::runtime_error("delta: truncated varint");
+    uint8_t b = p[pos++];
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 63) throw std::runtime_error("delta: varint overflow");
+  }
+  return v;
+}
+
+static int64_t unzigzag(uint64_t v) {
+  return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+}
+
+// Decode a DELTA_BINARY_PACKED stream; returns values and the byte length
+// consumed (DELTA_BYTE_ARRAY needs to continue reading after it).
+// max_count: caller's value count from the page header — the untrusted
+// stream header may not materialize more (same DoS discipline as the
+// decompressor's kMaxPageBytes cap).
+static void delta_bp_decode(const uint8_t* p, size_t len,
+                            std::vector<int64_t>& out, size_t& consumed,
+                            uint64_t max_count) {
+  size_t pos = 0;
+  uint64_t block_size = read_uleb(p, len, pos);
+  uint64_t miniblocks = read_uleb(p, len, pos);
+  uint64_t total = read_uleb(p, len, pos);
+  // unsigned accumulation: parquet defines deltas mod 2^64, and int64
+  // wraparound would be signed-overflow UB
+  uint64_t value = (uint64_t)unzigzag(read_uleb(p, len, pos));
+  // geometry caps BEFORE any arithmetic: untrusted varints could otherwise
+  // wrap miniblocks*8 (division by zero) or pos+miniblocks (OOB widths read)
+  if (block_size == 0 || block_size > (1u << 24) || miniblocks == 0 ||
+      miniblocks > (1u << 16) || block_size % (miniblocks * 8) != 0)
+    throw std::runtime_error("delta: bad block geometry");
+  if (total > max_count)
+    throw std::runtime_error("delta: count exceeds page values");
+  uint64_t per_mini = block_size / miniblocks;
+  out.reserve(out.size() + total);
+  uint64_t remaining = total;
+  if (remaining) {
+    out.push_back((int64_t)value);
+    remaining--;
+  }
+  while (remaining > 0) {
+    uint64_t min_delta = (uint64_t)unzigzag(read_uleb(p, len, pos));
+    if (miniblocks > len - pos)
+      throw std::runtime_error("delta: truncated bit widths");
+    const uint8_t* widths = p + pos;
+    pos += miniblocks;
+    for (uint64_t m = 0; m < miniblocks && remaining > 0; m++) {
+      int bw = widths[m];
+      if (bw > 64) throw std::runtime_error("delta: bit width over 64");
+      size_t nbytes = (size_t)(per_mini * bw + 7) / 8;
+      if (nbytes > len - pos)
+        throw std::runtime_error("delta: truncated miniblock");
+      uint64_t take = std::min<uint64_t>(per_mini, remaining);
+      for (uint64_t i = 0; i < take; i++) {
+        uint64_t d = 0;
+        if (bw > 0) {
+          size_t bit = (size_t)i * bw;
+          size_t byte = bit / 8;
+          int shift = (int)(bit % 8);
+          int need = (shift + bw + 7) / 8;  // <= 9 bytes for bw <= 64
+          unsigned __int128 acc = 0;
+          for (int k = 0; k < need; k++) {
+            uint8_t b = (byte + (size_t)k < nbytes) ? p[pos + byte + k] : 0;
+            acc |= (unsigned __int128)b << (8 * k);
+          }
+          d = (uint64_t)(acc >> shift);
+          if (bw < 64) d &= (((uint64_t)1 << bw) - 1);
+        }
+        value += min_delta + d;  // mod 2^64 by construction
+        out.push_back((int64_t)value);
+      }
+      remaining -= std::min<uint64_t>(per_mini, remaining);
+      pos += nbytes;
+    }
+  }
+  consumed = pos;
 }
 
 // ---- RLE / bit-packed hybrid ------------------------------------------------
@@ -413,6 +548,43 @@ struct chunk_decoder {
       size_t got = ZSTD_decompress(buf.data(), uncomp, src, comp);
       if (ZSTD_isError(got) || got != uncomp)
         throw std::runtime_error("zstd: bad stream");
+    } else if (codec == CODEC_LZ4_RAW) {
+      buf.reserve(uncomp);
+      lz4_block_decompress(src, comp, buf, uncomp);
+      if (buf.size() != uncomp) throw std::runtime_error("lz4: short output");
+    } else if (codec == CODEC_LZ4) {
+      // codec id 5 is ambiguous in the wild: parquet-mr wrote hadoop
+      // framing (u32be uncompressed, u32be compressed, block bytes)*, old
+      // parquet-cpp wrote one bare block — try frames, fall back to raw
+      try {
+        buf.clear();
+        buf.reserve(uncomp);
+        size_t pos2 = 0;
+        while (pos2 < comp && buf.size() < uncomp) {
+          if (pos2 + 8 > comp)
+            throw std::runtime_error("lz4f: truncated frame");
+          auto be32 = [&](size_t o) {
+            return ((size_t)src[o] << 24) | ((size_t)src[o + 1] << 16) |
+                   ((size_t)src[o + 2] << 8) | (size_t)src[o + 3];
+          };
+          size_t fr_un = be32(pos2), fr_co = be32(pos2 + 4);
+          pos2 += 8;
+          if (fr_co > comp - pos2)
+            throw std::runtime_error("lz4f: truncated block");
+          size_t cap = buf.size() + fr_un;
+          if (cap > uncomp) throw std::runtime_error("lz4f: overflow");
+          lz4_block_decompress(src + pos2, fr_co, buf, cap);
+          pos2 += fr_co;
+        }
+        if (buf.size() != uncomp)
+          throw std::runtime_error("lz4f: short output");
+      } catch (const std::exception&) {
+        buf.clear();
+        buf.reserve(uncomp);
+        lz4_block_decompress(src, comp, buf, uncomp);
+        if (buf.size() != uncomp)
+          throw std::runtime_error("lz4: short output");
+      }
     } else {
       throw std::runtime_error("unsupported codec " + std::to_string(codec));
     }
@@ -505,7 +677,115 @@ struct chunk_decoder {
       scatter_fixed_i32(vals, defs, 1);
       return;
     }
+    if (enc == ENC_DELTA_BP &&
+        (leaf.physical == PT_INT32 || leaf.physical == PT_INT64)) {
+      std::vector<int64_t> vals;
+      size_t consumed;
+      delta_bp_decode(data, len, vals, consumed, (uint64_t)n_valid);
+      if ((int64_t)vals.size() < n_valid)
+        throw std::runtime_error("delta: fewer values than page declares");
+      scatter_fixed_i64(vals, defs);
+      return;
+    }
+    if ((enc == ENC_DELTA_LEN_BA || enc == ENC_DELTA_BA) &&
+        leaf.physical == PT_BYTE_ARRAY) {
+      append_delta_byte_array(data, len, defs, n_valid,
+                              /*prefixed=*/enc == ENC_DELTA_BA);
+      return;
+    }
+    if (enc == ENC_BYTE_STREAM_SPLIT) {
+      size_t es = plain_elem_size(leaf.physical, leaf.type_length);
+      if (es == 0 || leaf.physical == PT_BYTE_ARRAY)
+        throw std::runtime_error("bss: bad physical type");
+      if ((size_t)n_valid * es > len)
+        throw std::runtime_error("bss: truncated");
+      // k = es streams of n_valid bytes each; value i byte j lives at
+      // stream j position i
+      size_t oes = out_elem_size(es);
+      size_t base = out.values.size();
+      out.values.resize(base + defs.size() * oes, 0);
+      uint8_t* dst = out.values.data() + base;
+      std::vector<uint8_t> elem(es);
+      size_t vi = 0;
+      for (size_t i = 0; i < defs.size(); i++) {
+        if (defs[i] != leaf.max_def) continue;
+        for (size_t j = 0; j < es; j++)
+          elem[j] = data[j * (size_t)n_valid + vi];
+        convert_elem(elem.data(), es, dst + i * oes);
+        vi++;
+      }
+      return;
+    }
     throw std::runtime_error("unsupported encoding " + std::to_string(enc));
+  }
+
+  // DELTA_LENGTH_BYTE_ARRAY (lengths then bytes); DELTA_BYTE_ARRAY adds a
+  // prefix-length stream (incremental front coding against the previous
+  // value in the page).
+  void append_delta_byte_array(const uint8_t* data, size_t len,
+                               const std::vector<int32_t>& defs,
+                               int64_t n_valid, bool prefixed) {
+    std::vector<int64_t> prefix_lens;
+    size_t pos = 0;
+    if (prefixed) {
+      size_t consumed;
+      delta_bp_decode(data, len, prefix_lens, consumed, (uint64_t)n_valid);
+      pos = consumed;
+    }
+    std::vector<int64_t> suffix_lens;
+    size_t consumed;
+    delta_bp_decode(data + pos, len - pos, suffix_lens, consumed,
+                    (uint64_t)n_valid);
+    pos += consumed;
+    if ((int64_t)suffix_lens.size() < n_valid ||
+        (prefixed && (int64_t)prefix_lens.size() < n_valid))
+      throw std::runtime_error("delta-ba: fewer values than page declares");
+    // the previous value's bytes are the tail of out.values (nulls append
+    // nothing), so prefixes copy from there — zero per-value allocations
+    size_t prev_start = out.values.size(), prev_len = 0;
+    size_t vi = 0;
+    for (int32_t d : defs) {
+      if (d == leaf.max_def) {
+        int64_t plen = prefixed ? prefix_lens[vi] : 0;
+        int64_t slen = suffix_lens[vi];
+        if (plen < 0 || slen < 0 || (size_t)plen > prev_len)
+          throw std::runtime_error("delta-ba: bad prefix/suffix length");
+        if ((size_t)slen > len - pos)
+          throw std::runtime_error("delta-ba: truncated suffix bytes");
+        size_t cur_start = out.values.size();
+        out.values.reserve(cur_start + (size_t)plen + (size_t)slen);
+        out.values.resize(cur_start + (size_t)plen);
+        if (plen)  // disjoint: cur_start >= prev_start + prev_len
+          memcpy(out.values.data() + cur_start,
+                 out.values.data() + prev_start, (size_t)plen);
+        out.values.insert(out.values.end(), data + pos, data + pos + slen);
+        pos += (size_t)slen;
+        prev_start = cur_start;
+        prev_len = (size_t)plen + (size_t)slen;
+        vi++;
+      }
+      out.offsets.push_back((int32_t)out.values.size());
+    }
+  }
+
+  // scatter int64 values into fixed-width output (INT32 or INT64 leaves)
+  void scatter_fixed_i64(const std::vector<int64_t>& vals,
+                         const std::vector<int32_t>& defs) {
+    size_t es = plain_elem_size(leaf.physical, leaf.type_length);
+    size_t base = out.values.size();
+    out.values.resize(base + defs.size() * es, 0);
+    uint8_t* dst = out.values.data() + base;
+    size_t vi = 0;
+    for (size_t i = 0; i < defs.size(); i++) {
+      if (defs[i] != leaf.max_def) continue;
+      int64_t v = vals[vi++];
+      if (es == 4) {
+        int32_t v32 = (int32_t)v;
+        memcpy(dst + i * es, &v32, 4);
+      } else {
+        memcpy(dst + i * es, &v, 8);
+      }
+    }
   }
 
   void gather_from_dict(const std::vector<int32_t>& idx,
@@ -801,16 +1081,23 @@ int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
     out->null_count = dec.out.nulls;
     out->values_bytes = (long long)dec.out.values.size();
     out->values = (uint8_t*)malloc(dec.out.values.size() ? dec.out.values.size() : 1);
-    memcpy(out->values, dec.out.values.data(), dec.out.values.size());
+    if (!dec.out.values.empty())  // empty: data() may be null; memcpy(.,null,0) is UB
+      memcpy(out->values, dec.out.values.data(), dec.out.values.size());
     if (h->leaves[leaf].physical == PT_BYTE_ARRAY) {
-      out->offsets = (int32_t*)malloc(dec.out.offsets.size() * 4);
-      memcpy(out->offsets, dec.out.offsets.data(), dec.out.offsets.size() * 4);
+      out->offsets = (int32_t*)malloc(
+          dec.out.offsets.size() ? dec.out.offsets.size() * 4 : 4);
+      if (!dec.out.offsets.empty())
+        memcpy(out->offsets, dec.out.offsets.data(),
+               dec.out.offsets.size() * 4);
     } else {
       out->offsets = nullptr;
     }
     if (dec.out.nulls > 0) {
-      out->validity = (uint8_t*)malloc(dec.out.validity.size());
-      memcpy(out->validity, dec.out.validity.data(), dec.out.validity.size());
+      out->validity = (uint8_t*)malloc(
+          dec.out.validity.size() ? dec.out.validity.size() : 1);
+      if (!dec.out.validity.empty())
+        memcpy(out->validity, dec.out.validity.data(),
+               dec.out.validity.size());
     } else {
       out->validity = nullptr;
     }

@@ -245,3 +245,48 @@ def test_int96_legacy_timestamps(tmp_path):
     out = read_parquet(path)
     assert out[0].dtype.id is TypeId.TIMESTAMP_MICROSECONDS
     _assert_matches(out[0], t.column("ts"))
+
+
+@pytest.mark.parametrize("compression", ["lz4"])
+def test_lz4_compression(tmp_path, compression):
+    t = _mixed_table()
+    path = _roundtrip(t, tmp_path, compression=compression)
+    _check_file(path, t)
+
+
+def test_delta_and_byte_stream_split_encodings(tmp_path):
+    """parquet v2 encodings: DELTA_BINARY_PACKED ints (positive, negative,
+    large jumps), DELTA_LENGTH/DELTA_BYTE_ARRAY strings (shared prefixes),
+    BYTE_STREAM_SPLIT floats — all with nulls, against the pyarrow oracle."""
+    n = 3000
+    rng = np.random.default_rng(7)
+
+    def mask():
+        return rng.random(n) < 0.12
+
+    i32 = pa.array((rng.integers(-2**31, 2**31, n, dtype=np.int64)
+                    .astype(np.int32)), mask=mask())
+    i64 = pa.array(rng.integers(-2**62, 2**62, n), mask=mask())
+    mono = pa.array(np.cumsum(rng.integers(0, 9, n)), mask=mask())
+    s = pa.array([f"prefix/{i % 37:04d}/suffix{i % 11}" for i in range(n)],
+                 mask=mask())
+    f32 = pa.array(rng.standard_normal(n).astype(np.float32), mask=mask())
+    f64 = pa.array(rng.standard_normal(n), mask=mask())
+    t = pa.table({"i32": i32, "i64": i64, "mono": mono, "s": s,
+                  "f32": f32, "f64": f64})
+    path = str(tmp_path / "delta.parquet")
+    pq.write_table(
+        t, path, compression="none", use_dictionary=False, version="2.6",
+        column_encoding={"i32": "DELTA_BINARY_PACKED",
+                         "i64": "DELTA_BINARY_PACKED",
+                         "mono": "DELTA_BINARY_PACKED",
+                         "s": "DELTA_BYTE_ARRAY",
+                         "f32": "BYTE_STREAM_SPLIT",
+                         "f64": "BYTE_STREAM_SPLIT"})
+    _check_file(path, t)
+    # and the DELTA_LENGTH_BYTE_ARRAY variant for the string column
+    path2 = str(tmp_path / "dlba.parquet")
+    pq.write_table(
+        t.select(["s"]), path2, compression="none", use_dictionary=False,
+        version="2.6", column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY"})
+    _check_file(path2, t.select(["s"]))
