@@ -40,6 +40,7 @@ void pqf_free(void* p);
 typedef struct {
   char* path;
   int physical, type_length, converted, scale, precision, max_def, max_rep;
+  int rep_def;
 } pqd_leaf_t;
 typedef struct {
   uint8_t* values;
@@ -48,6 +49,10 @@ typedef struct {
   uint8_t* validity;
   long long rows;
   long long null_count;
+  int32_t* list_offsets;
+  uint8_t* list_validity;
+  long long list_rows;
+  long long list_null_count;
 } pqd_out_t;
 void* pqd_open(const uint8_t* footer, long long len, char** err_out);
 int pqd_num_row_groups(void* h);

@@ -20,8 +20,9 @@
 //     BYTE_STREAM_SPLIT, bit-packed/RLE hybrid definition levels
 //   * physical types: BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY,
 //     FIXED_LEN_BYTE_ARRAY (decimals → 16-byte little-endian limb values)
-//   * flat columns (max_rep == 0); nested decode is rejected with a clear
-//     error (the Python reader gates on schema)
+//   * flat columns and one-level LIST columns (max_rep <= 1: rep levels,
+//     per-row offsets/validity, empty vs null lists); deeper nesting is
+//     rejected with a clear error (the Python reader gates on schema)
 
 #include <cstdint>
 #include <cstdlib>
@@ -375,6 +376,10 @@ struct leaf_info {
   int converted = -1;     // -1 = absent
   int scale = 0, precision = 0;
   int max_def = 0, max_rep = 0;
+  // def level AT the (innermost) repeated ancestor: an element exists in a
+  // list slot iff def >= rep_def; the list itself is present iff
+  // def >= rep_def - 1 (0 for flat leaves)
+  int rep_def = 0;
 };
 
 struct decode_handle {
@@ -384,7 +389,7 @@ struct decode_handle {
 
 static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
                         int nchildren, const std::string& prefix, int def,
-                        int rep, std::vector<leaf_info>& out) {
+                        int rep, int rep_def, std::vector<leaf_info>& out) {
   for (int c = 0; c < nchildren; c++) {
     if (idx >= schema.size()) throw std::runtime_error("schema: truncated tree");
     const tvalue& se = *schema[idx++];
@@ -394,6 +399,7 @@ static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
     int r = (int)i_of(se, SE_REP, 0);
     int d2 = def + (r == REP_OPTIONAL || r == REP_REPEATED ? 1 : 0);
     int r2 = rep + (r == REP_REPEATED ? 1 : 0);
+    int rd2 = (r == REP_REPEATED) ? d2 : rep_def;
     int nc = (int)i_of(se, SE_NUM_CHILDREN, 0);
     if (nc == 0) {
       leaf_info li;
@@ -406,9 +412,10 @@ static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
       li.precision = (int)i_of(se, SE_PRECISION, 0);
       li.max_def = d2;
       li.max_rep = r2;
+      li.rep_def = rd2;
       out.push_back(std::move(li));
     } else {
-      walk_schema(schema, idx, nc, path, d2, r2, out);
+      walk_schema(schema, idx, nc, path, d2, r2, rd2, out);
     }
   }
 }
@@ -428,6 +435,11 @@ struct column_out {
   std::vector<uint8_t> validity;
   int64_t rows = 0;
   int64_t nulls = 0;
+  // LIST leaves (max_rep == 1): per-row structure over the element buffers
+  std::vector<int32_t> list_offsets{0};
+  std::vector<uint8_t> list_validity;
+  int64_t list_rows = 0;
+  int64_t list_nulls = 0;
 };
 
 static size_t plain_elem_size(int physical, int type_length) {
@@ -616,23 +628,62 @@ struct chunk_decoder {
     }
   }
 
-  // Decode def levels (v1 layout: u32 length + hybrid). Returns defs.
-  void read_def_levels_v1(const uint8_t*& data, size_t& len, int64_t n,
-                          std::vector<int32_t>& defs) {
-    if (leaf.max_def == 0) {
-      defs.assign((size_t)n, 0);
+  // Decode a v1 level stream (u32 length + hybrid) of bit width for
+  // max_level; fills `levels` with n entries (all-zero when max_level == 0).
+  void read_levels_v1(const uint8_t*& data, size_t& len, int64_t n,
+                      int max_level, std::vector<int32_t>& levels) {
+    if (max_level == 0) {
+      levels.assign((size_t)n, 0);
       return;
     }
-    if (len < 4) throw std::runtime_error("page: truncated def-level length");
+    if (len < 4) throw std::runtime_error("page: truncated level length");
     uint32_t nbytes;
     memcpy(&nbytes, data, 4);
     data += 4;
     len -= 4;
-    if (nbytes > len) throw std::runtime_error("page: truncated def levels");
-    hybrid_reader hr(data, nbytes, bits_needed(leaf.max_def));
-    hr.decode(n, defs);
+    if (nbytes > len) throw std::runtime_error("page: truncated levels");
+    hybrid_reader hr(data, nbytes, bits_needed(max_level));
+    hr.decode(n, levels);
     data += nbytes;
     len -= nbytes;
+  }
+
+  // LIST accounting state: a row may span pages, so it stays open across
+  // decode_values calls until the next rep==0 (or end of chunk).
+  bool list_row_open = false;
+  int64_t list_elem_cum = 0;
+
+  // Fold one page's (rep, def) pair into the per-row list structure and
+  // return the element-slot defs the value decoder consumes.
+  std::vector<int32_t> fold_list_levels(const std::vector<int32_t>& reps,
+                                        const std::vector<int32_t>& defs) {
+    std::vector<int32_t> child;
+    child.reserve(defs.size());
+    for (size_t i = 0; i < defs.size(); i++) {
+      if (reps[i] == 0) {
+        if (list_row_open)
+          out.list_offsets.push_back((int32_t)list_elem_cum);
+        bool valid = defs[i] >= leaf.rep_def - 1;
+        out.list_validity.push_back(valid ? 1 : 0);
+        out.list_nulls += valid ? 0 : 1;
+        out.list_rows += 1;
+        list_row_open = true;
+      } else if (!list_row_open) {
+        throw std::runtime_error("list: continuation before first row");
+      }
+      if (defs[i] >= leaf.rep_def) {
+        child.push_back(defs[i]);
+        list_elem_cum++;
+      }
+    }
+    return child;
+  }
+
+  void finish_lists() {
+    if (leaf.max_rep == 1 && list_row_open) {
+      out.list_offsets.push_back((int32_t)list_elem_cum);
+      list_row_open = false;
+    }
   }
 
   // Append n decoded values (with defs) from `data` using `enc`.
@@ -873,8 +924,9 @@ struct chunk_decoder {
 
   // ---- page walk ----------------------------------------------------------
   void decode_chunk(const uint8_t* buf, size_t len) {
-    if (leaf.max_rep != 0)
-      throw std::runtime_error("nested (repeated) columns not supported");
+    if (leaf.max_rep > 1)
+      throw std::runtime_error(
+          "multi-level nested columns not supported (max_rep > 1)");
     size_t pos = 0;
     int64_t seen = 0;
     while (seen < num_values) {
@@ -912,9 +964,15 @@ struct chunk_decoder {
         std::vector<int32_t> defs;
         const uint8_t* dp = data;
         size_t dl = dlen;
-        read_def_levels_v1(dp, dl, n, defs);
-        if (leaf.max_def == 0) defs.assign((size_t)n, 0);
-        decode_values(dp, dl, enc, defs);
+        if (leaf.max_rep == 1) {
+          std::vector<int32_t> reps;
+          read_levels_v1(dp, dl, n, leaf.max_rep, reps);  // reps come first
+          read_levels_v1(dp, dl, n, leaf.max_def, defs);
+          decode_values(dp, dl, enc, fold_list_levels(reps, defs));
+        } else {
+          read_levels_v1(dp, dl, n, leaf.max_def, defs);
+          decode_values(dp, dl, enc, defs);
+        }
         seen += n;
         continue;
       }
@@ -927,21 +985,34 @@ struct chunk_decoder {
         int64_t rep_bytes = i_of(*dh, DP2_REP_BYTES, 0);
         auto* icf = get(*dh, DP2_IS_COMPRESSED);
         bool is_comp = icf ? icf->b : true;
-        if (rep_bytes != 0)
-          throw std::runtime_error("nested v2 pages not supported");
-        if (def_bytes > comp) throw std::runtime_error("v2: bad level bytes");
+        if (rep_bytes < 0 || def_bytes < 0 || rep_bytes > comp ||
+            def_bytes > comp - rep_bytes)  // per-term: the sum could wrap
+          throw std::runtime_error("v2: bad level bytes");
+        if (leaf.max_rep == 0 && rep_bytes != 0)
+          throw std::runtime_error("v2: rep levels on a flat column");
         // levels are stored uncompressed ahead of the (possibly compressed)
-        // values section
-        std::vector<int32_t> defs;
+        // values section: rep section first, then def section (no u32
+        // length prefixes in v2)
+        std::vector<int32_t> reps, defs;
+        if (leaf.max_rep > 0) {
+          if (rep_bytes > 0) {
+            hybrid_reader hr(payload, (size_t)rep_bytes,
+                             bits_needed(leaf.max_rep));
+            hr.decode(n, reps);
+          } else {
+            reps.assign((size_t)n, 0);
+          }
+        }
         if (leaf.max_def > 0 && def_bytes > 0) {
-          hybrid_reader hr(payload, (size_t)def_bytes, bits_needed(leaf.max_def));
+          hybrid_reader hr(payload + rep_bytes, (size_t)def_bytes,
+                           bits_needed(leaf.max_def));
           hr.decode(n, defs);
         } else {
           defs.assign((size_t)n, 0);
         }
-        const uint8_t* vsrc = payload + def_bytes;
-        size_t vcomp = (size_t)(comp - def_bytes);
-        size_t vuncomp = (size_t)(uncomp - def_bytes);
+        const uint8_t* vsrc = payload + rep_bytes + def_bytes;
+        size_t vcomp = (size_t)(comp - rep_bytes - def_bytes);
+        size_t vuncomp = (size_t)(uncomp - rep_bytes - def_bytes);
         std::vector<uint8_t> dbuf;
         const uint8_t* data;
         size_t dlen;
@@ -951,12 +1022,17 @@ struct chunk_decoder {
           data = vsrc;
           dlen = vcomp;
         }
-        decode_values(data, dlen, enc, defs);
+        if (leaf.max_rep == 1) {
+          decode_values(data, dlen, enc, fold_list_levels(reps, defs));
+        } else {
+          decode_values(data, dlen, enc, defs);
+        }
         seen += n;
         continue;
       }
       // index or unknown pages: skip payload (already advanced)
     }
+    finish_lists();
   }
 };
 
@@ -975,6 +1051,7 @@ typedef struct {
   int converted;       // ConvertedType or -1
   int scale, precision;
   int max_def, max_rep;
+  int rep_def;         // def level at the repeated ancestor (lists)
 } pqd_leaf_t;
 
 typedef struct {
@@ -982,8 +1059,13 @@ typedef struct {
   long long values_bytes;
   int32_t* offsets;     // [rows+1] for BYTE_ARRAY, else NULL
   uint8_t* validity;    // bool[rows] or NULL when null_count == 0
-  long long rows;
+  long long rows;       // element rows for LIST leaves
   long long null_count;
+  // LIST leaves (max_rep == 1); NULL/0 otherwise
+  int32_t* list_offsets;   // [list_rows+1] element ranges per list row
+  uint8_t* list_validity;  // bool[list_rows] or NULL when no null lists
+  long long list_rows;
+  long long list_null_count;
 } pqd_out_t;
 
 // Parse raw thrift FileMetaData (no PAR1 framing). Caller buffer may be freed
@@ -1000,7 +1082,7 @@ void* pqd_open(const uint8_t* footer, long long len, char** err_out) {
     for (auto& se : schema_f->list) schema.push_back(&se);
     size_t idx = 1;  // skip root
     int root_children = (int)i_of(*schema[0], SE_NUM_CHILDREN, 0);
-    walk_schema(schema, idx, root_children, "", 0, 0, h->leaves);
+    walk_schema(schema, idx, root_children, "", 0, 0, 0, h->leaves);
     return h.release();
   } catch (std::exception& e) {
     if (err_out) *err_out = strdup(e.what());
@@ -1037,6 +1119,7 @@ int pqd_leaf_info(void* hp, int leaf, pqd_leaf_t* out) {
   out->precision = li.precision;
   out->max_def = li.max_def;
   out->max_rep = li.max_rep;
+  out->rep_def = li.rep_def;
   return 0;
 }
 
@@ -1101,6 +1184,26 @@ int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
     } else {
       out->validity = nullptr;
     }
+    out->list_offsets = nullptr;
+    out->list_validity = nullptr;
+    out->list_rows = 0;
+    out->list_null_count = 0;
+    if (h->leaves[leaf].max_rep == 1) {
+      out->list_rows = dec.out.list_rows;
+      out->list_null_count = dec.out.list_nulls;
+      out->list_offsets = (int32_t*)malloc(
+          dec.out.list_offsets.size() ? dec.out.list_offsets.size() * 4 : 4);
+      if (!dec.out.list_offsets.empty())
+        memcpy(out->list_offsets, dec.out.list_offsets.data(),
+               dec.out.list_offsets.size() * 4);
+      if (dec.out.list_nulls > 0) {
+        out->list_validity = (uint8_t*)malloc(
+            dec.out.list_validity.size() ? dec.out.list_validity.size() : 1);
+        if (!dec.out.list_validity.empty())
+          memcpy(out->list_validity, dec.out.list_validity.data(),
+                 dec.out.list_validity.size());
+      }
+    }
     return 0;
   } catch (std::exception& e) {
     if (err_out) *err_out = strdup(e.what());
@@ -1112,9 +1215,13 @@ void pqd_free_out(pqd_out_t* out) {
   free(out->values);
   free(out->offsets);
   free(out->validity);
+  free(out->list_offsets);
+  free(out->list_validity);
   out->values = nullptr;
   out->offsets = nullptr;
   out->validity = nullptr;
+  out->list_offsets = nullptr;
+  out->list_validity = nullptr;
 }
 
 void pqd_free(void* p) { free(p); }

@@ -55,6 +55,7 @@ class _LeafC(ctypes.Structure):
         ("precision", ctypes.c_int),
         ("max_def", ctypes.c_int),
         ("max_rep", ctypes.c_int),
+        ("rep_def", ctypes.c_int),
     ]
 
 
@@ -66,6 +67,10 @@ class _OutC(ctypes.Structure):
         ("validity", ctypes.POINTER(ctypes.c_uint8)),
         ("rows", ctypes.c_longlong),
         ("null_count", ctypes.c_longlong),
+        ("list_offsets", ctypes.POINTER(ctypes.c_int32)),
+        ("list_validity", ctypes.POINTER(ctypes.c_uint8)),
+        ("list_rows", ctypes.c_longlong),
+        ("list_null_count", ctypes.c_longlong),
     ]
 
 
@@ -111,15 +116,17 @@ def _load():
 
 @dataclass
 class LeafSchema:
-    """One flat leaf column of the file schema."""
+    """One leaf column of the file schema (LIST leaves carry the element
+    dtype in ``elem_dtype`` and ``dtype`` is the LIST type)."""
 
     index: int
-    name: str          # dotted path
+    name: str          # dotted path; LIST columns use the outer field name
     dtype: DType
     physical: int
     type_length: int
     max_def: int
     max_rep: int
+    elem_dtype: Optional[DType] = None
 
 
 def _map_dtype(physical: int, converted: int, scale: int,
@@ -219,10 +226,10 @@ class ParquetReader:
         else:
             self._selected = list(self._leaves)
         for leaf in self._selected:
-            if leaf.max_rep != 0:
+            if leaf.max_rep > 1:
                 raise ValueError(
-                    f"column {leaf.name!r} is nested (repeated); "
-                    "nested decode is not supported")
+                    f"column {leaf.name!r} is nested beyond one LIST level; "
+                    "multi-level nested decode is not supported")
 
     def _read_schema(self) -> List[LeafSchema]:
         out = []
@@ -235,9 +242,20 @@ class ParquetReader:
             name = info.path.decode()
             dtype = _map_dtype(info.physical, info.converted, info.scale,
                                info.precision)
+            elem_dtype = None
+            if info.max_rep == 1:
+                # one-level LIST: strip parquet's wrapper tail — 3-level
+                # files append '.list.element', legacy 2-level '.array' /
+                # '.item' — keeping any enclosing struct path intact
+                elem_dtype = dtype
+                dtype = dt.LIST
+                parts = name.split(".")
+                name = ".".join(parts[:-2] if len(parts) >= 3
+                                else parts[:-1] if len(parts) == 2
+                                else parts)
             out.append(LeafSchema(i, name, dtype, info.physical,
                                   info.type_length, info.max_def,
-                                  info.max_rep))
+                                  info.max_rep, elem_dtype))
         return out
 
     # ---- info -------------------------------------------------------------
@@ -298,18 +316,43 @@ class ParquetReader:
             if out.null_count > 0:
                 validity = np.ctypeslib.as_array(out.validity,
                                                  shape=(rows,)).copy()
-            return rows, values, offsets, validity
+            lists = None
+            if leaf.max_rep == 1:
+                lrows = out.list_rows
+                loffs = np.ctypeslib.as_array(
+                    out.list_offsets, shape=(lrows + 1,)).copy()
+                lvalid = None
+                if out.list_null_count > 0:
+                    lvalid = np.ctypeslib.as_array(
+                        out.list_validity, shape=(lrows,)).copy()
+                lists = (lrows, loffs, lvalid)
+            return rows, values, offsets, validity, lists
         finally:
             self._lib.pqd_free_out(ctypes.byref(out))
 
     @staticmethod
     def _to_column(leaf: LeafSchema, rows: int, values: np.ndarray,
                    offsets: Optional[np.ndarray],
-                   validity: Optional[np.ndarray]) -> Column:
-        """Host buffers → device Column (one transfer per buffer)."""
-        dtype = leaf.dtype
+                   validity: Optional[np.ndarray],
+                   lists=None) -> Column:
+        """Host buffers → device Column (one transfer per buffer). For
+        LIST leaves the primitive buffers become the element child and
+        ``lists`` = (list_rows, list_offsets, list_validity) wraps them."""
+        dtype = leaf.elem_dtype if leaf.max_rep == 1 else leaf.dtype
         vmask = None if validity is None else jnp.asarray(
             validity.astype(bool))
+        if leaf.max_rep == 1:
+            elem_leaf = LeafSchema(leaf.index, leaf.name, dtype,
+                                   leaf.physical, leaf.type_length,
+                                   leaf.max_def, 0)
+            child = ParquetReader._to_column(elem_leaf, rows, values,
+                                             offsets, validity)
+            lrows, loffs, lvalid = lists
+            lmask = None if lvalid is None else jnp.asarray(
+                lvalid.astype(bool))
+            return Column(dt.LIST, int(lrows), validity=lmask,
+                          offsets=jnp.asarray(loffs),
+                          children=(child,))
         if dtype.id is TypeId.STRING:
             data = jnp.asarray(values) if values.size else jnp.zeros(
                 (0,), dtype=jnp.uint8)
@@ -375,6 +418,9 @@ class ParquetReader:
                 p[1].nbytes
                 + (p[2].nbytes if p[2] is not None else 0)
                 + (p[3].nbytes if p[3] is not None else 0)
+                + ((p[4][1].nbytes
+                    + (p[4][2].nbytes if p[4][2] is not None else 0))
+                   if p[4] is not None else 0)
                 for p in parts)
             with device_reservation(est) as took:
                 col = self._concat_parts(leaf, parts)
@@ -413,28 +459,49 @@ class ParquetReader:
                     admit()
         return Table(tuple(cols))
 
+    @staticmethod
+    def _rebase_offsets(parts, rows_i, offs_i):
+        """Concatenate per-part int32 offset vectors with cumulative
+        rebasing (parts are (.., rows at rows_i, offsets at offs_i, ..))."""
+        total = sum(p[rows_i] for p in parts)
+        offsets = np.zeros(total + 1, dtype=np.int32)
+        base = 0
+        pos = 0
+        for p in parts:
+            offsets[pos + 1:pos + 1 + p[rows_i]] = p[offs_i][1:] + base
+            base += p[offs_i][-1]
+            pos += p[rows_i]
+        return offsets
+
     @classmethod
     def _concat_parts(cls, leaf: LeafSchema, parts) -> Column:
         if len(parts) == 1:
-            rows, values, offsets, validity = parts[0]
-            return cls._to_column(leaf, rows, values, offsets, validity)
+            rows, values, offsets, validity, lists = parts[0]
+            return cls._to_column(leaf, rows, values, offsets, validity,
+                                  lists)
         rows = sum(p[0] for p in parts)
         values = np.concatenate([p[1] for p in parts])
         offsets = None
         if leaf.physical == _PT_BYTE_ARRAY:
-            offsets = np.zeros(rows + 1, dtype=np.int32)
-            base = 0
-            pos = 0
-            for p in parts:
-                offsets[pos + 1:pos + 1 + p[0]] = p[2][1:] + base
-                base += p[2][-1]
-                pos += p[0]
+            offsets = cls._rebase_offsets(parts, 0, 2)
         validity = None
         if any(p[3] is not None for p in parts):
             validity = np.concatenate([
                 p[3] if p[3] is not None else np.ones(p[0], dtype=np.uint8)
                 for p in parts])
-        return cls._to_column(leaf, rows, values, offsets, validity)
+        lists = None
+        if leaf.max_rep == 1:
+            lrows = sum(p[4][0] for p in parts)
+            lparts = [(p[4][0], p[4][1]) for p in parts]
+            loffs = cls._rebase_offsets(lparts, 0, 1)
+            lvalid = None
+            if any(p[4][2] is not None for p in parts):
+                lvalid = np.concatenate([
+                    p[4][2] if p[4][2] is not None
+                    else np.ones(p[4][0], dtype=np.uint8)
+                    for p in parts])
+            lists = (lrows, loffs, lvalid)
+        return cls._to_column(leaf, rows, values, offsets, validity, lists)
 
     def read_all(self) -> Table:
         """Decode the whole file into one Table (host memory scales with the

@@ -183,12 +183,12 @@ def test_large_dictionary_fallback(tmp_path):
     _check_file(path, t)
 
 
-def test_nested_rejected(tmp_path):
+def test_list_decode_and_projection(tmp_path):
     t = pa.table({"l": pa.array([[1, 2], [3]], type=pa.list_(pa.int64()))})
     path = _roundtrip(t, tmp_path)
-    with pytest.raises(ValueError, match="nested"):
-        ParquetReader(path)
-    # projection away from the nested column still works
+    out = read_parquet(path)
+    assert out[0].to_pylist() == [[1, 2], [3]]
+    # projection away from the list column still works
     t2 = pa.table({"l": pa.array([[1], [2]], type=pa.list_(pa.int64())),
                    "x": pa.array([7, 8], type=pa.int64())})
     path2 = _roundtrip(t2, tmp_path, name="g.parquet")
@@ -290,3 +290,42 @@ def test_delta_and_byte_stream_split_encodings(tmp_path):
         t.select(["s"]), path2, compression="none", use_dictionary=False,
         version="2.6", column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY"})
     _check_file(path2, t.select(["s"]))
+
+
+def test_list_columns_roundtrip(tmp_path):
+    """One-level LIST decode: int and string lists with null lists, empty
+    lists, and null elements, across dict and plain encodings."""
+    n = 500
+    rng = np.random.default_rng(11)
+    ints, strs = [], []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.1:
+            ints.append(None); strs.append(None)
+        elif r < 0.25:
+            ints.append([]); strs.append([])
+        else:
+            k = int(rng.integers(1, 6))
+            ints.append([None if rng.random() < 0.2 else
+                         int(rng.integers(-10**9, 10**9)) for _ in range(k)])
+            strs.append([None if rng.random() < 0.2 else f"w{i}-{j}"
+                         for j in range(k)])
+    t = pa.table({"li": pa.array(ints, type=pa.list_(pa.int64())),
+                  "ls": pa.array(strs, type=pa.list_(pa.string())),
+                  "flat": pa.array(np.arange(n))})
+    for kwargs in ({"compression": "snappy"},
+                   {"compression": "none", "use_dictionary": False}):
+        path = str(tmp_path / f"lists_{kwargs['compression']}.parquet")
+        pq.write_table(t, path, row_group_size=128, **kwargs)
+        out = read_parquet(path)
+        assert [c.to_pylist() for c in out.columns] == \
+            [t.column(i).to_pylist() for i in range(3)]
+
+
+def test_list_multilevel_rejected(tmp_path):
+    t = pa.table({"ll": pa.array([[[1, 2]], [[3]]],
+                                 type=pa.list_(pa.list_(pa.int64())))})
+    path = str(tmp_path / "ll.parquet")
+    pq.write_table(t, path)
+    with pytest.raises(ValueError, match="beyond one LIST level"):
+        ParquetReader(path)
