@@ -45,19 +45,47 @@ def padded_bytes(col: Column, multiple: int = 8) -> Tuple[jnp.ndarray, jnp.ndarr
     return mat, lengths
 
 
+def densify_offsets(data: jnp.ndarray, offsets,
+                    L: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generic offset-run densification: flat elements + int32[n+1] offsets
+    -> (zero-padded [n, L] matrix, int32[n] lengths). Works for any element
+    dtype (uint8 for strings, child values/validity for LIST exchange);
+    device gathers only."""
+    offsets = jnp.asarray(offsets, dtype=jnp.int32)
+    lengths = offsets[1:] - offsets[:-1]
+    n = int(lengths.shape[0])
+    if data.shape[0] == 0:
+        return jnp.zeros((n, L), dtype=data.dtype), lengths
+    pos = offsets[:-1, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_range = pos < offsets[1:, None]
+    gathered = jnp.take(data, jnp.clip(pos, 0, data.shape[0] - 1), axis=0)
+    return jnp.where(in_range, gathered,
+                     jnp.zeros((), dtype=data.dtype)), lengths
+
+
+def unflatten_padded(mat, lengths) -> Tuple[np.ndarray, np.ndarray]:
+    """Host inverse of densify_offsets: padded [n, L] + lengths ->
+    (flat elements, int64[n+1] offsets), vectorized (no per-row loop)."""
+    mat = np.asarray(mat)
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n = int(lengths.shape[0])
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    if not total:
+        return np.zeros((0,), dtype=mat.dtype), offsets
+    row_of = np.repeat(np.arange(n), lengths)
+    col_in = np.arange(total) - np.repeat(offsets[:-1], lengths)
+    return mat[row_of, col_in], offsets
+
+
 def _padded_bytes_impl(col: Column, multiple: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     n = col.size
     offsets = jnp.asarray(col.offsets, dtype=jnp.int32)
     lengths = offsets[1:] - offsets[:-1]
     max_len = int(jnp.max(lengths)) if n else 0
     L = pad_width(max_len, multiple)
-    data = col.data
-    if data.shape[0] == 0:
-        return jnp.zeros((n, L), dtype=jnp.uint8), lengths
-    pos = offsets[:-1, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
-    in_range = pos < offsets[1:, None]
-    gathered = jnp.take(data, jnp.clip(pos, 0, data.shape[0] - 1), axis=0)
-    return jnp.where(in_range, gathered, jnp.uint8(0)), lengths
+    return densify_offsets(col.data, offsets, L)
 
 
 def pack_byte_rows(parts, validity=None) -> Column:

@@ -36,7 +36,8 @@ except ImportError:  # older jax
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
-from ..columnar.strings import from_padded_bytes, padded_bytes
+from ..columnar.strings import (densify_offsets, from_padded_bytes,
+                                pad_width, padded_bytes, unflatten_padded)
 from ..ops.hashing import murmur_hash3_32
 
 def _mesh_axis(mesh: Mesh) -> str:
@@ -57,9 +58,25 @@ def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
         mat, lengths = padded_bytes(col)
         return [mat, lengths.astype(jnp.int32), valid], {
             "kind": "string", "dtype": col.dtype}
-    if tid in (dt.TypeId.LIST, dt.TypeId.STRUCT):
+    if tid is dt.TypeId.LIST:
+        child = col.children[0]
+        if (not child.dtype.is_fixed_width
+                or child.dtype.id is dt.TypeId.DECIMAL128):
+            raise NotImplementedError(
+                "only LIST of fixed-width elements is exchangeable")
+        offs = jnp.asarray(col.offsets, dtype=jnp.int32)
+        lengths = offs[1:] - offs[:-1]
+        max_len = int(jnp.max(lengths)) if col.size else 0
+        L = pad_width(max_len, 4)
+        # shared densification (columnar/strings); child.data keeps its
+        # physical storage dtype (uint64 bit patterns for FLOAT64)
+        elems, _ = densify_offsets(child.data, offs, L)
+        evalid, _ = densify_offsets(child.valid_mask(), offs, L)
+        return [elems, evalid, lengths.astype(jnp.int32), valid], {
+            "kind": "list", "dtype": col.dtype, "child_dtype": child.dtype}
+    if tid is dt.TypeId.STRUCT:
         raise NotImplementedError(
-            "nested columns are not yet exchangeable; flatten first")
+            "STRUCT columns are not yet exchangeable; flatten first")
     return [col.data, valid], {"kind": "fixed", "dtype": col.dtype}
 
 
@@ -71,6 +88,26 @@ def _col_from_buffers(bufs: Sequence[np.ndarray], meta: dict,
         mat, lengths, valid = mat[keep], lengths[keep], valid[keep]
         return from_padded_bytes(mat, lengths,
                                  validity=None if valid.all() else valid)
+    if meta["kind"] == "list":
+        elems, evalid, lengths, valid = bufs
+        elems, evalid = elems[keep], evalid[keep]
+        lengths, valid = lengths[keep].astype(np.int64), valid[keep]
+        n = int(lengths.shape[0])
+        flat, offsets = unflatten_padded(elems, lengths)
+        cvalid, _ = unflatten_padded(evalid, lengths)
+        total = int(offsets[-1])
+        if not total:
+            # keep the child's *physical* storage dtype (FLOAT64 stores
+            # uint64 bit patterns; jnp_dtype would say float64)
+            flat = np.zeros((0,), dtype=np.asarray(elems).dtype)
+            cvalid = np.ones((0,), dtype=bool)
+        child = Column(meta["child_dtype"], total, data=jnp.asarray(flat),
+                       validity=None if cvalid.all()
+                       else jnp.asarray(cvalid))
+        return Column(meta["dtype"], n,
+                      validity=None if valid.all() else jnp.asarray(valid),
+                      offsets=jnp.asarray(offsets.astype(np.int32)),
+                      children=(child,))
     data, valid = bufs
     data, valid = data[keep], valid[keep]
     col = Column(meta["dtype"], int(data.shape[0]), data=jnp.asarray(data))

@@ -7,6 +7,7 @@ and compare against the single-device results.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh
@@ -208,3 +209,58 @@ def test_distributed_full_join_matches_local(mesh):
     wl, wr = full_join(lk, rk)
     assert sorted(zip(gl.tolist(), gr.tolist())) \
         == sorted(zip(np.asarray(wl).tolist(), np.asarray(wr).tolist()))
+
+
+def test_exchange_list_payload(mesh):
+    """LIST-of-int payload columns survive the hash-partition exchange
+    (null lists, empty lists, null elements)."""
+    rng = np.random.default_rng(13)
+    n = 400
+    keys = Column.from_numpy(rng.integers(0, 30, n), dt.INT64)
+    lists = [None if rng.random() < 0.1 else
+             [None if rng.random() < 0.2 else int(x)
+              for x in rng.integers(0, 99, rng.integers(0, 5))]
+             for _ in range(n)]
+    flat = [e for v in lists if v is not None for e in v]
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    for i, v in enumerate(lists):
+        offsets[i + 1] = offsets[i] + (len(v) if v is not None else 0)
+    child = Column.from_pylist(flat, dt.INT64)
+    lcol = Column(dt.LIST, n,
+                  validity=jnp.asarray(
+                      np.array([v is not None for v in lists])),
+                  offsets=jnp.asarray(offsets), children=(child,))
+    t = Table((keys, lcol))
+    parts = hash_partition_exchange(t, [0], mesh)
+    srt = lambda pairs: sorted(pairs, key=lambda kv: (kv[0], repr(kv[1])))
+    got = srt(
+        (k, tuple(v) if v is not None else None)
+        for p in parts if p.num_rows
+        for k, v in zip(p.columns[0].to_pylist(), p.columns[1].to_pylist()))
+    want = srt((k, tuple(v) if v is not None else None)
+               for k, v in zip(keys.to_pylist(), lists))
+    assert got == want
+
+
+def test_exchange_list_float64_keeps_bit_storage(mesh):
+    """LIST<FLOAT64> children keep uint64 bit-pattern storage through the
+    exchange — including partitions that receive only empty lists."""
+    n = 64
+    keys = Column.from_numpy(np.arange(n, dtype=np.int64), dt.INT64)
+    vals = np.array([1.5, -0.0, 2.25], dtype=np.float64)
+    child = Column.from_numpy(vals, dt.FLOAT64)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    offsets[1:4] = [1, 2, 3]  # rows 0-2 hold one element; the rest empty
+    offsets[4:] = 3
+    lcol = Column(dt.LIST, n, offsets=jnp.asarray(offsets), children=(child,))
+    parts = hash_partition_exchange(Table((keys, lcol)), [0], mesh)
+    got = {}
+    for p in parts:
+        if not p.num_rows:
+            continue
+        c = p.columns[1]
+        assert c.children[0].data.dtype == jnp.uint64, c.children[0].data.dtype
+        for k, v in zip(p.columns[0].to_pylist(), c.to_pylist()):
+            got[k] = v
+    assert got[0] == [1.5] and got[1] == [-0.0] and got[2] == [2.25]
+    assert all(got[k] == [] for k in range(3, n))
