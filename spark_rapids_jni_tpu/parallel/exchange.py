@@ -75,8 +75,15 @@ def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
         return [elems, evalid, lengths.astype(jnp.int32), valid], {
             "kind": "list", "dtype": col.dtype, "child_dtype": child.dtype}
     if tid is dt.TypeId.STRUCT:
-        raise NotImplementedError(
-            "STRUCT columns are not yet exchangeable; flatten first")
+        bufs: List[jnp.ndarray] = [valid]
+        child_metas, child_spans = [], []
+        for ch in col.children:
+            cb, cm = _col_to_buffers(ch)
+            child_spans.append(len(cb))
+            bufs.extend(cb)
+            child_metas.append(cm)
+        return bufs, {"kind": "struct", "dtype": col.dtype,
+                      "children": child_metas, "spans": child_spans}
     return [col.data, valid], {"kind": "fixed", "dtype": col.dtype}
 
 
@@ -108,6 +115,17 @@ def _col_from_buffers(bufs: Sequence[np.ndarray], meta: dict,
                       validity=None if valid.all() else jnp.asarray(valid),
                       offsets=jnp.asarray(offsets.astype(np.int32)),
                       children=(child,))
+    if meta["kind"] == "struct":
+        valid = bufs[0][keep]
+        pos = 1
+        children = []
+        for cm, span in zip(meta["children"], meta["spans"]):
+            children.append(
+                _col_from_buffers(bufs[pos:pos + span], cm, keep))
+            pos += span
+        return Column(meta["dtype"], int(valid.shape[0]),
+                      validity=None if valid.all() else jnp.asarray(valid),
+                      children=tuple(children))
     data, valid = bufs
     data, valid = data[keep], valid[keep]
     col = Column(meta["dtype"], int(data.shape[0]), data=jnp.asarray(data))

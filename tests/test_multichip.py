@@ -264,3 +264,26 @@ def test_exchange_list_float64_keeps_bit_storage(mesh):
             got[k] = v
     assert got[0] == [1.5] and got[1] == [-0.0] and got[2] == [2.25]
     assert all(got[k] == [] for k in range(3, n))
+
+
+def test_exchange_struct_payload(mesh):
+    """STRUCT<int64, string> payloads (struct nulls + field nulls) survive
+    the exchange via recursive child lowering."""
+    rng = np.random.default_rng(17)
+    n = 300
+    keys = Column.from_numpy(rng.integers(0, 25, n), dt.INT64)
+    f0 = Column.from_pylist(
+        [None if rng.random() < 0.15 else int(rng.integers(0, 999))
+         for _ in range(n)], dt.INT64)
+    f1 = Column.from_pylist(
+        [None if rng.random() < 0.15 else f"s{int(rng.integers(0, 50))}"
+         for _ in range(n)], dt.STRING)
+    svalid = np.array([rng.random() > 0.1 for _ in range(n)])
+    scol = Column.struct_of((f0, f1), validity=jnp.asarray(svalid))
+    parts = hash_partition_exchange(Table((keys, scol)), [0], mesh)
+    srt = lambda pairs: sorted(pairs, key=repr)
+    got = srt((k, v) for p in parts if p.num_rows
+              for k, v in zip(p.columns[0].to_pylist(),
+                              p.columns[1].to_pylist()))
+    want = srt(zip(keys.to_pylist(), scol.to_pylist()))
+    assert got == want
