@@ -73,7 +73,7 @@ def unflatten_padded(mat, lengths) -> Tuple[np.ndarray, np.ndarray]:
     np.cumsum(lengths, out=offsets[1:])
     total = int(offsets[-1])
     if not total:
-        return np.zeros((0,), dtype=mat.dtype), offsets
+        return np.zeros((0,) + mat.shape[2:], dtype=mat.dtype), offsets
     row_of = np.repeat(np.arange(n), lengths)
     col_in = np.arange(total) - np.repeat(offsets[:-1], lengths)
     return mat[row_of, col_in], offsets

@@ -60,18 +60,27 @@ def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
             "kind": "string", "dtype": col.dtype}
     if tid is dt.TypeId.LIST:
         child = col.children[0]
-        if (not child.dtype.is_fixed_width
-                or child.dtype.id is dt.TypeId.DECIMAL128):
-            raise NotImplementedError(
-                "only LIST of fixed-width elements is exchangeable")
         offs = jnp.asarray(col.offsets, dtype=jnp.int32)
         lengths = offs[1:] - offs[:-1]
         max_len = int(jnp.max(lengths)) if col.size else 0
         L = pad_width(max_len, 4)
+        evalid, _ = densify_offsets(child.valid_mask(), offs, L)
+        if child.dtype.id is dt.TypeId.STRING:
+            # LIST<STRING>: densify the child's padded byte rows per list
+            # slot -> [n, L, Ls] bytes + [n, L] element byte lengths
+            cmat, clens = padded_bytes(child)
+            emats, _ = densify_offsets(cmat, offs, L)
+            elens, _ = densify_offsets(clens, offs, L)
+            return [emats, elens, evalid, lengths.astype(jnp.int32),
+                    valid], {"kind": "list_str", "dtype": col.dtype,
+                             "child_dtype": child.dtype}
+        if (not child.dtype.is_fixed_width
+                or child.dtype.id is dt.TypeId.DECIMAL128):
+            raise NotImplementedError(
+                "LIST elements must be fixed-width or STRING to exchange")
         # shared densification (columnar/strings); child.data keeps its
         # physical storage dtype (uint64 bit patterns for FLOAT64)
         elems, _ = densify_offsets(child.data, offs, L)
-        evalid, _ = densify_offsets(child.valid_mask(), offs, L)
         return [elems, evalid, lengths.astype(jnp.int32), valid], {
             "kind": "list", "dtype": col.dtype, "child_dtype": child.dtype}
     if tid is dt.TypeId.STRUCT:
@@ -95,6 +104,20 @@ def _col_from_buffers(bufs: Sequence[np.ndarray], meta: dict,
         mat, lengths, valid = mat[keep], lengths[keep], valid[keep]
         return from_padded_bytes(mat, lengths,
                                  validity=None if valid.all() else valid)
+    if meta["kind"] == "list_str":
+        emats, elens, evalid, lengths, valid = bufs
+        emats, elens, evalid = emats[keep], elens[keep], evalid[keep]
+        lengths, valid = lengths[keep].astype(np.int64), valid[keep]
+        n = int(lengths.shape[0])
+        flat_mats, offsets = unflatten_padded(emats, lengths)  # [m, Ls]
+        flat_lens, _ = unflatten_padded(elens, lengths)
+        cvalid, _ = unflatten_padded(evalid, lengths)
+        child = from_padded_bytes(flat_mats, flat_lens,
+                                  validity=None if cvalid.all() else cvalid)
+        return Column(meta["dtype"], n,
+                      validity=None if valid.all() else jnp.asarray(valid),
+                      offsets=jnp.asarray(offsets.astype(np.int32)),
+                      children=(child,))
     if meta["kind"] == "list":
         elems, evalid, lengths, valid = bufs
         elems, evalid = elems[keep], evalid[keep]

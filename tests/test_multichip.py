@@ -287,3 +287,32 @@ def test_exchange_struct_payload(mesh):
                               p.columns[1].to_pylist()))
     want = srt(zip(keys.to_pylist(), scol.to_pylist()))
     assert got == want
+
+
+def test_exchange_list_of_strings(mesh):
+    """LIST<STRING> payloads (null lists, empty lists, null and empty
+    strings) survive the exchange — double-nested densification."""
+    rng = np.random.default_rng(23)
+    n = 250
+    keys = Column.from_numpy(rng.integers(0, 20, n), dt.INT64)
+    lists = [None if rng.random() < 0.1 else
+             [None if rng.random() < 0.15 else
+              ("" if rng.random() < 0.2 else f"v{int(rng.integers(0, 99))}")
+              for _ in range(rng.integers(0, 4))]
+             for _ in range(n)]
+    flat = [e for v in lists if v is not None for e in v]
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    for i, v in enumerate(lists):
+        offsets[i + 1] = offsets[i] + (len(v) if v is not None else 0)
+    child = Column.from_pylist(flat, dt.STRING)
+    lcol = Column(dt.LIST, n,
+                  validity=jnp.asarray(
+                      np.array([v is not None for v in lists])),
+                  offsets=jnp.asarray(offsets), children=(child,))
+    parts = hash_partition_exchange(Table((keys, lcol)), [0], mesh)
+    srt = lambda pairs: sorted(pairs, key=repr)
+    got = srt((k, v) for p in parts if p.num_rows
+              for k, v in zip(p.columns[0].to_pylist(),
+                              p.columns[1].to_pylist()))
+    want = srt(zip(keys.to_pylist(), lists))
+    assert got == want
