@@ -1,25 +1,53 @@
-"""Headline benchmark: Spark murmur3 row-hash throughput on TPU.
+"""Driver benchmark: full-axis sweep, headline = murmur3 row-hash on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend",
+"axes"}. The required headline fields describe the 4-column murmur3 row
+hash; "axes" carries the rest of the sweep (row_conversion 1M/4M ± strings,
+bloom, cast_string_to_float, parse_uri, groupby, join, sort, tpch q3/q5) so
+one capture window records every benchmark axis on whatever backend init
+lands on.
 
 The reference publishes no numbers (BASELINE.md): its NVBench suite measures
 but does not commit results. vs_baseline is therefore reported against the
 north-star nominal of 1e9 rows/s for a 4-column row hash on a single
 accelerator (GPU-class row-hash throughput per BASELINE.json configs).
+
+Backend selection is wedge-resilient *toward the TPU* (round-2 verdict: a
+single 420 s watchdog re-execed permanently onto CPU on one transient relay
+wedge, forfeiting the round's TPU evidence). Init is now probed in a
+subprocess — a hang kills only the probe — with bounded retries and backoff;
+only after every attempt fails does the process re-exec CPU-pinned.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
 NOMINAL_ROWS_PER_S = 1.0e9
 
-# Healthy first TPU contact takes ~1-3 min; the watchdog only fires on a
-# wedged relay (observed: indefinite hang), so the budget is generous —
-# it costs nothing when the tunnel is up.
-TUNNEL_INIT_TIMEOUT_S = 420
+# Healthy first TPU contact takes ~1-3 min. Each probe gets that budget;
+# a wedged relay (observed: indefinite hang) costs one killed subprocess,
+# not the round's TPU evidence.
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_BACKOFF_S = (15, 45)  # sleep between attempts (indexed, clamped)
+
+# In-process init backstop: the probe proved the tunnel healthy moments
+# ago, so the real init hanging anyway means the relay wedged in between —
+# re-exec to CPU rather than hang the driver.
+INIT_WATCHDOG_S = int(os.environ.get("BENCH_INIT_WATCHDOG_S", "420"))
+
+# Sweep budget after the headline lands: axes are attempted in priority
+# order until the deadline, skipped ones are reported as "skipped".
+SWEEP_DEADLINE_S = float(os.environ.get("BENCH_SWEEP_DEADLINE_S", "1500"))
+
+
+def _log(msg):
+    print(f"bench: {msg}", file=sys.stderr)
+    sys.stderr.flush()
 
 
 def _cpu_reexec(argv, reason):
@@ -29,8 +57,7 @@ def _cpu_reexec(argv, reason):
     registered (sitecustomize, interpreter start): device init then hangs
     even under JAX_PLATFORMS=cpu. Clearing PALLAS_AXON_POOL_IPS makes the
     re-exec'd interpreter skip the registration entirely."""
-    print(f"bench: {reason}; re-exec on cpu", file=sys.stderr)
-    sys.stderr.flush()
+    _log(f"{reason}; re-exec on cpu")
     env = dict(os.environ,
                _BENCH_CPU_FALLBACK="1",
                PALLAS_AXON_POOL_IPS="",  # sitecustomize skips axon register
@@ -38,27 +65,71 @@ def _cpu_reexec(argv, reason):
     os.execve(sys.executable, [sys.executable] + argv, env)
 
 
+def _probe_tpu(timeout_s):
+    """Init the accelerator in a disposable subprocess.
+
+    Returns the platform string ("tpu"/"cpu"/...) if init completed within
+    the budget, None if it hung or raised. A wedged relay hangs the *child*;
+    subprocess.run kills it on timeout and the parent is free to retry."""
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "print('BENCH_PROBE_OK', d[0].platform, len(d), flush=True)\n")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"probe hung (> {timeout_s}s), killed")
+        return None
+    for ln in (p.stdout or "").strip().splitlines():
+        if ln.startswith("BENCH_PROBE_OK") and p.returncode == 0:
+            _log(f"probe ok: {ln}")
+            return ln.split()[1]
+    tail = ((p.stderr or "").strip().splitlines() or ["<no stderr>"])[-1]
+    _log(f"probe failed rc={p.returncode}: {tail}")
+    return None
+
+
 def _ensure_backend(argv=None):
     """Use the TPU when the axon tunnel is up; otherwise fall back to CPU so
     the benchmark always emits its JSON line.
 
-    The tunnel can fail two ways: backend registration raises (cleanly), or
-    — when the relay is wedged, e.g. by an earlier killed client — device
-    init *hangs*. The hang is caught by a watchdog thread that re-execs the
-    process on timeout (exec replaces the process even while the main thread
-    is stuck inside the PJRT client init); the init itself runs once, in
-    this process, so a healthy tunnel pays no probe overhead."""
+    Strategy: probe init in a subprocess (N attempts, backoff) so a wedged
+    relay never strands this process; commit to in-process init only after
+    a probe succeeds, with a watchdog re-exec as the last-resort backstop
+    (exec replaces the process even while the main thread is stuck inside
+    PJRT client init)."""
     if os.environ.get("_BENCH_CPU_FALLBACK") == "1":
         return
     argv = argv if argv is not None else sys.argv
+
+    init_is_safe = False  # a probe completed (even if only on CPU)
+    for attempt in range(PROBE_ATTEMPTS):
+        if attempt:
+            back = PROBE_BACKOFF_S[min(attempt - 1, len(PROBE_BACKOFF_S) - 1)]
+            _log(f"retry {attempt + 1}/{PROBE_ATTEMPTS} in {back}s")
+            time.sleep(back)
+        plat = _probe_tpu(PROBE_TIMEOUT_S)
+        init_is_safe = init_is_safe or plat is not None
+        if plat is not None and plat != "cpu":
+            break  # accelerator reachable — commit this process to it
+        if plat == "cpu" and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            # no accelerator plugin is even registered in this environment;
+            # retrying cannot change a clean CPU answer
+            break
+    else:
+        if not init_is_safe:
+            _cpu_reexec(argv, f"accelerator unreachable after "
+                        f"{PROBE_ATTEMPTS} probe attempts")
+        _log("no accelerator found, but init is safe — continuing "
+             "in-process (cpu)")
+
     done = threading.Event()
 
     def _watchdog():
-        if not done.wait(TUNNEL_INIT_TIMEOUT_S):
-            if done.is_set():  # init finished right at the timeout boundary
-                return
-            _cpu_reexec(argv, "accelerator init wedged "
-                        f"(> {TUNNEL_INIT_TIMEOUT_S}s)")
+        if not done.wait(INIT_WATCHDOG_S) and not done.is_set():
+            _cpu_reexec(argv, "accelerator init wedged after healthy probe "
+                        f"(> {INIT_WATCHDOG_S}s)")
 
     threading.Thread(target=_watchdog, daemon=True).start()
     try:
@@ -70,14 +141,14 @@ def _ensure_backend(argv=None):
     done.set()
 
 
-def main():
+def _headline():
+    """4-column murmur3 row hash — the north-star axis, measured first so
+    the required JSON fields exist whatever happens to the rest."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from spark_rapids_jni_tpu.ops import hashing as H
-
-    _ensure_backend()
 
     n = 1 << 22  # 4M rows
     rng = np.random.default_rng(0)
@@ -108,13 +179,74 @@ def main():
         out = row_hash(jnp.uint32(i + 1), a, b, c, d)
         out.block_until_ready()
     dt = (time.perf_counter() - t0) / iters
+    return n / dt
 
-    rows_per_s = n / dt
+
+def _sweep(deadline):
+    """Run every benchmark axis (benchmarks/bench_ops.py implementations)
+    until the deadline; per-axis failures and skips are recorded, never
+    fatal. Returns {axis: {rows, seconds, mrows_per_s, gb_per_s} | {...}}."""
+    from benchmarks import bench_ops as B
+    B._refresh_variants()
+
+    axes = [
+        ("row_conversion_fixed_1m", lambda: B.bench_row_conversion(1 << 20, False), 1 << 20),
+        ("row_conversion_strings_1m", lambda: B.bench_row_conversion(1 << 20, True), 1 << 20),
+        ("groupby_1m", lambda: B.bench_groupby(1 << 20), 1 << 20),
+        ("join_1m", lambda: B.bench_join(1 << 20), 1 << 20),
+        ("sort_1m", lambda: B.bench_sort(1 << 20), 1 << 20),
+        ("bloom_filter_1m", lambda: B.bench_bloom_filter(1 << 20), 1 << 20),
+        ("cast_string_to_float_500k", lambda: B.bench_cast_string_to_float(500_000), 500_000),
+        ("parse_uri_200k", lambda: B.bench_parse_uri(200_000), 200_000),
+        ("tpch_q3_1m", lambda: B.bench_tpch_q3(1 << 20), 1 << 20),
+        ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
+        ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
+        ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
+    ]
+    results = {}
+    for name, fn, rows in axes:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            results[name] = {"skipped": "sweep deadline"}
+            continue
+        _log(f"axis {name} ({left:.0f}s left)")
+        try:
+            sec, nbytes = fn()
+            results[name] = {
+                "rows": rows,
+                "seconds": round(sec, 5),
+                "mrows_per_s": round(rows / sec / 1e6, 2),
+                "gb_per_s": round(nbytes / sec / 1e9, 3),
+            }
+            _log(f"  {name}: {results[name]['mrows_per_s']} Mrows/s")
+        except Exception as e:  # an axis must never sink the sweep
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"  {name} FAILED: {e}")
+    return results
+
+
+def main():
+    _ensure_backend()
+    import jax
+    backend = jax.devices()[0].platform
+    _log(f"backend: {backend} x{len(jax.devices())}")
+
+    rows_per_s = _headline()
+    _log(f"headline murmur3 hash: {rows_per_s / 1e6:.0f} Mrows/s")
+
+    try:
+        axes = _sweep(time.monotonic() + SWEEP_DEADLINE_S)
+    except Exception as e:  # the measured headline must still be emitted
+        axes = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"sweep failed: {e}")
+
     print(json.dumps({
         "metric": "murmur3_row_hash_4col_throughput",
         "value": round(rows_per_s / 1e6, 2),
         "unit": "Mrows/s",
         "vs_baseline": round(rows_per_s / NOMINAL_ROWS_PER_S, 4),
+        "backend": backend,
+        "axes": axes,
     }))
 
 

@@ -255,14 +255,249 @@ static void drive_parse_uri(const char* path) {
   printf("jvm_sim: parse_url HOST bytes ok\n");
 }
 
+/* ---- 5. engine bridge (the kernel surface behind the Java facades) ------ */
+
+/* Mirrors native/engine_bridge.cpp's eb_* ABI — the one the EngineJni shim
+ * binds. Each check drives a different kernel op end-to-end (C -> embedded
+ * CPython -> XLA -> back) and verifies exact output bytes. */
+typedef struct {
+  const char* dtype;
+  int64_t rows;
+  const uint8_t* data;
+  int64_t data_bytes;
+  const int64_t* offsets;
+  const uint8_t* validity;
+} eb_col;
+
+typedef struct {
+  char* dtype;
+  int64_t rows;
+  uint8_t* data;
+  int64_t data_bytes;
+  int64_t* offsets;
+  uint8_t* validity;
+} eb_out_col;
+
+typedef struct {
+  int32_t n_cols;
+  eb_out_col* cols;
+  char* meta_json;
+} eb_result;
+
+typedef int (*eb_call_fn)(const char*, const char*, const eb_col*, int32_t,
+                          eb_result**);
+typedef void (*eb_free_fn)(eb_result*);
+typedef const char* (*eb_err_fn)(void);
+
+static eb_call_fn eb_call;
+static eb_free_fn eb_free;
+static eb_err_fn eb_err;
+
+static eb_result* must_call(const char* op, const char* args,
+                            const eb_col* ins, int n_ins) {
+  eb_result* r = NULL;
+  int rc = eb_call(op, args, ins, n_ins, &r);
+  if (rc != 0) DIE("%s failed rc=%d: %s", op, rc, eb_err());
+  return r;
+}
+
+static eb_col i64_col(const int64_t* vals, int n) {
+  eb_col c = {"int64", n, (const uint8_t*)vals, (int64_t)n * 8, NULL, NULL};
+  return c;
+}
+
+static void drive_engine(const char* path, const char* repo_root) {
+  /* RTLD_GLOBAL: python extension modules imported by the embedded
+   * interpreter resolve libpython symbols through the global namespace */
+  void* lib = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) DIE("dlopen %s: %s", path, dlerror());
+  int (*init)(const char*) = (int (*)(const char*))must_sym(lib, "eb_init");
+  eb_call = (eb_call_fn)must_sym(lib, "eb_call");
+  eb_free = (eb_free_fn)must_sym(lib, "eb_free_result");
+  eb_err = (eb_err_fn)must_sym(lib, "eb_last_error");
+
+  if (init(repo_root) != 0) DIE("eb_init failed: %s", eb_err());
+
+  int64_t keys123[3] = {1, 2, 3};
+  eb_col in123 = i64_col(keys123, 3);
+
+  /* 5a. hash.murmur3 — Spark murmur3_32 of [1,2,3], seed 42 */
+  {
+    eb_result* r = must_call("hash.murmur3", "{}", &in123, 1);
+    int32_t want[3] = {-1712319331, -797927272, 519220707};
+    if (r->n_cols != 1 || r->cols[0].rows != 3 ||
+        memcmp(r->cols[0].data, want, sizeof want) != 0)
+      DIE("murmur3 bytes mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine hash.murmur3 ok\n");
+  }
+
+  /* 5b. hash.xxhash64 */
+  {
+    eb_result* r = must_call("hash.xxhash64", "{}", &in123, 1);
+    int64_t want[3] = {-7001672635703045582LL, -3341702809300393011LL,
+                       3188756510806108107LL};
+    if (memcmp(r->cols[0].data, want, sizeof want) != 0)
+      DIE("xxhash64 bytes mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine hash.xxhash64 ok\n");
+  }
+
+  /* 5c. bloom filter build -> probe (blob round-trips through the wire) */
+  {
+    int64_t build_keys[3] = {10, 20, 30};
+    eb_col bk = i64_col(build_keys, 3);
+    eb_result* blob = must_call(
+        "bloom.build", "{\"num_hashes\": 3, \"num_longs\": 64}", &bk, 1);
+    int64_t probe_keys[2] = {10, 99};
+    eb_col ins[2];
+    ins[0] = i64_col(probe_keys, 2);
+    ins[1].dtype = blob->cols[0].dtype;
+    ins[1].rows = blob->cols[0].rows;
+    ins[1].data = blob->cols[0].data;
+    ins[1].data_bytes = blob->cols[0].data_bytes;
+    ins[1].offsets = NULL;
+    ins[1].validity = NULL;
+    eb_result* r = must_call("bloom.probe", "{}", ins, 2);
+    if (r->cols[0].data[0] != 1 || r->cols[0].data[1] != 0)
+      DIE("bloom probe mismatch");
+    eb_free(r);
+    eb_free(blob);
+    printf("jvm_sim: engine bloom build/probe ok\n");
+  }
+
+  /* 5d. cast.string_to_integer — ANSI-off invalid row nulls out */
+  {
+    const char* rows[3] = {"42", "bogus", "-7"};
+    uint8_t data[64];
+    int64_t offsets[4];
+    pack_rows(rows, 3, data, offsets);
+    eb_col in = {"string", 3, data, offsets[3], offsets, NULL};
+    eb_result* r = must_call("cast.string_to_integer",
+                             "{\"type\": \"int32\"}", &in, 1);
+    const int32_t* vals = (const int32_t*)r->cols[0].data;
+    const uint8_t* valid = r->cols[0].validity;
+    if (vals[0] != 42 || vals[2] != -7 || !valid || valid[0] != 1 ||
+        valid[1] != 0 || valid[2] != 1)
+      DIE("string_to_integer mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine cast.string_to_integer ok\n");
+  }
+
+  /* 5e. cast.float_to_string — Ryu shortest form */
+  {
+    double vals[2] = {1.5, -0.25};
+    eb_col in = {"float64", 2, (const uint8_t*)vals, 16, NULL, NULL};
+    eb_result* r = must_call("cast.float_to_string", "{}", &in, 1);
+    const char* want[2] = {"1.5", "-0.25"};
+    uint8_t all_valid[2] = {1, 1};
+    check_rows("f2s", want, 2, r->cols[0].data, r->cols[0].offsets,
+               r->cols[0].validity ? r->cols[0].validity : all_valid);
+    eb_free(r);
+    printf("jvm_sim: engine cast.float_to_string ok\n");
+  }
+
+  /* 5f. rowconv to_rows -> from_rows round trip (JCUDF layout) */
+  {
+    int64_t a[3] = {5, 6, 7};
+    int32_t b[3] = {1, 2, 3};
+    eb_col ins[2];
+    ins[0] = i64_col(a, 3);
+    eb_col bcol = {"int32", 3, (const uint8_t*)b, 12, NULL, NULL};
+    ins[1] = bcol;
+    eb_result* rows = must_call("rowconv.to_rows", "{}", ins, 2);
+    if (rows->n_cols != 2) DIE("to_rows should return blob+offsets");
+    eb_col back_ins[2];
+    eb_col blob = {"uint8", rows->cols[0].rows, rows->cols[0].data,
+                   rows->cols[0].data_bytes, NULL, NULL};
+    eb_col offs = {"int64", rows->cols[1].rows, rows->cols[1].data,
+                   rows->cols[1].data_bytes, NULL, NULL};
+    back_ins[0] = blob;
+    back_ins[1] = offs;
+    eb_result* back = must_call("rowconv.from_rows",
+                                "{\"types\": [\"int64\", \"int32\"]}",
+                                back_ins, 2);
+    if (memcmp(back->cols[0].data, a, sizeof a) != 0 ||
+        memcmp(back->cols[1].data, b, sizeof b) != 0)
+      DIE("rowconv round-trip mismatch");
+    eb_free(back);
+    eb_free(rows);
+    printf("jvm_sim: engine rowconv round-trip ok\n");
+  }
+
+  /* 5g. zorder.interleave of int32 [1,2] x [3,4] */
+  {
+    int32_t za[2] = {1, 2};
+    int32_t zb[2] = {3, 4};
+    eb_col ins[2];
+    eb_col ca = {"int32", 2, (const uint8_t*)za, 8, NULL, NULL};
+    eb_col cb = {"int32", 2, (const uint8_t*)zb, 8, NULL, NULL};
+    ins[0] = ca;
+    ins[1] = cb;
+    eb_result* r = must_call("zorder.interleave", "{}", ins, 2);
+    const int64_t* offs = (const int64_t*)r->cols[0].data;
+    if (offs[0] != 0 || offs[1] != 8 || offs[2] != 16)
+      DIE("zorder offsets mismatch");
+    if (r->cols[1].data[7] != 7 || r->cols[1].data[15] != 24)
+      DIE("zorder bytes mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine zorder.interleave ok\n");
+  }
+
+  /* 5h. datetime.rebase gregorian -> julian (pre-1582 date shifts) */
+  {
+    int32_t days[2] = {-200000, 0};
+    eb_col in = {"timestamp_days", 2, (const uint8_t*)days, 8, NULL, NULL};
+    eb_result* r = must_call(
+        "datetime.rebase", "{\"direction\": \"gregorian_to_julian\"}",
+        &in, 1);
+    const int32_t* out = (const int32_t*)r->cols[0].data;
+    if (out[0] != -199991 || out[1] != 0) DIE("rebase mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine datetime.rebase ok\n");
+  }
+
+  /* 5i. decimal.add — DECIMAL128 limb arithmetic */
+  {
+    uint32_t limbs[2][4] = {{100, 0, 0, 0}, {250, 0, 0, 0}};
+    eb_col in = {"decimal128:2", 2, (const uint8_t*)limbs, 32, NULL, NULL};
+    eb_col ins[2] = {in, in};
+    eb_result* r = must_call("decimal.add", "{\"scale\": 2}", ins, 2);
+    const uint32_t* out = (const uint32_t*)r->cols[1].data;
+    if (r->cols[0].data[0] != 0 || out[0] != 200 || out[4] != 500)
+      DIE("decimal add mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine decimal.add ok\n");
+  }
+
+  /* 5j. json.get_json_object through the engine dispatch */
+  {
+    const char* rows[2] = {"{\"a\": \"x\"}", "nope"};
+    uint8_t data[64];
+    int64_t offsets[3];
+    pack_rows(rows, 2, data, offsets);
+    eb_col in = {"string", 2, data, offsets[2], offsets, NULL};
+    eb_result* r = must_call("json.get_json_object",
+                             "{\"path\": \"$.a\"}", &in, 1);
+    const char* want[2] = {"x", NULL};
+    check_rows("engine-gjo", want, 2, r->cols[0].data, r->cols[0].offsets,
+               r->cols[0].validity);
+    eb_free(r);
+    printf("jvm_sim: engine json.get_json_object ok\n");
+  }
+
+  printf("jvm_sim: engine bridge ok (10 kernel ops)\n");
+}
+
 int main(int argc, char** argv) {
-  if (argc != 8)
+  if (argc != 8 && argc != 10)
     DIE("usage: jvm_sim <librm> <libpq> <libjson> <parquet> <rows> <col> "
-        "<libpuri>");
+        "<libpuri> [<libeng> <repo_root>]");
   drive_rmm(argv[1]);
   drive_footer(argv[2], argv[4], atoll(argv[5]), argv[6]);
   drive_json(argv[3]);
   drive_parse_uri(argv[7]);
+  if (argc == 10) drive_engine(argv[8], argv[9]);
   printf("jvm_sim: all round-trips ok\n");
   return 0;
 }

@@ -1,0 +1,185 @@
+// JNI shim: com.sparkrapids.tpu.EngineJni -> the eb_* C ABI
+// (native/engine_bridge.cpp). Mechanical marshalling: Java arrays in,
+// Object[] {String[] dtypes, long[] rows, byte[][] data, long[][] offsets,
+// byte[][] validity, String metaJson} out. Engine errors (negative eb_call
+// status) are rethrown as RuntimeException with eb_last_error()'s text —
+// CastException messages pass through verbatim so the Java side can map
+// them (CastException.java).
+//
+// Build (requires a JDK; this repo's CI image has none — ci/jvm_sim.c
+// drives the same eb_* ABI from C instead):
+//   g++ -std=c++17 -O2 -fPIC -shared -I$JAVA_HOME/include \
+//       -I$JAVA_HOME/include/linux -o libsparkeng_jni.so \
+//       java/jni/engine_jni.cpp native/engine_bridge.cpp \
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+
+#include <jni.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+typedef struct {
+  const char* dtype;
+  int64_t rows;
+  const uint8_t* data;
+  int64_t data_bytes;
+  const int64_t* offsets;
+  const uint8_t* validity;
+} eb_col;
+
+typedef struct {
+  char* dtype;
+  int64_t rows;
+  uint8_t* data;
+  int64_t data_bytes;
+  int64_t* offsets;
+  uint8_t* validity;
+} eb_out_col;
+
+typedef struct {
+  int32_t n_cols;
+  eb_out_col* cols;
+  char* meta_json;
+} eb_result;
+
+int eb_init(const char* extra_sys_path);
+int eb_call(const char* op, const char* args_json, const eb_col* ins,
+            int32_t n_ins, eb_result** out);
+const char* eb_last_error(void);
+void eb_free_result(eb_result* r);
+void eb_shutdown(void);
+}
+
+namespace {
+
+void throw_runtime(JNIEnv* env, const char* msg) {
+  env->ThrowNew(env->FindClass("java/lang/RuntimeException"), msg);
+}
+
+struct utf_chars {
+  JNIEnv* env;
+  jstring s;
+  const char* p;
+  utf_chars(JNIEnv* e, jstring js) : env(e), s(js), p(nullptr) {
+    if (s) p = env->GetStringUTFChars(s, nullptr);
+  }
+  ~utf_chars() {
+    if (p) env->ReleaseStringUTFChars(s, p);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jint JNICALL Java_com_sparkrapids_tpu_EngineJni_init(
+    JNIEnv* env, jclass, jstring engine_path) {
+  utf_chars path(env, engine_path);
+  return eb_init(path.p ? path.p : "");
+}
+
+JNIEXPORT jobjectArray JNICALL Java_com_sparkrapids_tpu_EngineJni_call(
+    JNIEnv* env, jclass, jstring op, jstring args_json,
+    jobjectArray dtypes, jlongArray rows, jobjectArray data,
+    jobjectArray offsets, jobjectArray validity) {
+  jsize n = dtypes ? env->GetArrayLength(dtypes) : 0;
+
+  // pin/copy every input column into eb_col structs
+  std::vector<eb_col> ins(n);
+  std::vector<std::vector<uint8_t>> data_bufs(n), valid_bufs(n);
+  std::vector<std::vector<int64_t>> offs_bufs(n);
+  std::vector<std::string> dtype_strs(n);
+  jlong* rows_p = env->GetLongArrayElements(rows, nullptr);
+  for (jsize i = 0; i < n; i++) {
+    auto js = (jstring)env->GetObjectArrayElement(dtypes, i);
+    utf_chars dt(env, js);
+    dtype_strs[i] = dt.p ? dt.p : "";
+    auto d = (jbyteArray)env->GetObjectArrayElement(data, i);
+    jsize dl = d ? env->GetArrayLength(d) : 0;
+    data_bufs[i].resize(dl);
+    if (dl) env->GetByteArrayRegion(d, 0, dl,
+                                    (jbyte*)data_bufs[i].data());
+    auto o = offsets ? (jlongArray)env->GetObjectArrayElement(offsets, i)
+                     : nullptr;
+    if (o) {
+      jsize ol = env->GetArrayLength(o);
+      offs_bufs[i].resize(ol);
+      env->GetLongArrayRegion(o, 0, ol, (jlong*)offs_bufs[i].data());
+    }
+    auto v = validity ? (jbyteArray)env->GetObjectArrayElement(validity, i)
+                      : nullptr;
+    if (v) {
+      jsize vl = env->GetArrayLength(v);
+      valid_bufs[i].resize(vl);
+      env->GetByteArrayRegion(v, 0, vl, (jbyte*)valid_bufs[i].data());
+    }
+    ins[i] = {dtype_strs[i].c_str(), rows_p[i], data_bufs[i].data(),
+              (int64_t)data_bufs[i].size(),
+              o ? offs_bufs[i].data() : nullptr,
+              v ? valid_bufs[i].data() : nullptr};
+  }
+  env->ReleaseLongArrayElements(rows, rows_p, JNI_ABORT);
+
+  utf_chars op_c(env, op), args_c(env, args_json);
+  eb_result* res = nullptr;
+  int rc = eb_call(op_c.p, args_c.p ? args_c.p : "{}",
+                   ins.data(), (int32_t)n, &res);
+  if (rc != 0) {
+    throw_runtime(env, eb_last_error());
+    return nullptr;
+  }
+
+  // box outputs
+  jclass obj_cls = env->FindClass("java/lang/Object");
+  jclass str_cls = env->FindClass("java/lang/String");
+  jclass bytes_cls = env->FindClass("[B");
+  jclass longs_cls = env->FindClass("[J");
+  int32_t m = res->n_cols;
+  jobjectArray out = env->NewObjectArray(6, obj_cls, nullptr);
+  jobjectArray o_dt = env->NewObjectArray(m, str_cls, nullptr);
+  jlongArray o_rows = env->NewLongArray(m);
+  jobjectArray o_data = env->NewObjectArray(m, bytes_cls, nullptr);
+  jobjectArray o_offs = env->NewObjectArray(m, longs_cls, nullptr);
+  jobjectArray o_valid = env->NewObjectArray(m, bytes_cls, nullptr);
+  for (int32_t i = 0; i < m; i++) {
+    const eb_out_col& c = res->cols[i];
+    env->SetObjectArrayElement(o_dt, i, env->NewStringUTF(c.dtype));
+    jlong r = c.rows;
+    env->SetLongArrayRegion(o_rows, i, 1, &r);
+    jbyteArray d = env->NewByteArray((jsize)c.data_bytes);
+    env->SetByteArrayRegion(d, 0, (jsize)c.data_bytes,
+                            (const jbyte*)c.data);
+    env->SetObjectArrayElement(o_data, i, d);
+    if (c.offsets) {
+      jlongArray o = env->NewLongArray((jsize)(c.rows + 1));
+      env->SetLongArrayRegion(o, 0, (jsize)(c.rows + 1),
+                              (const jlong*)c.offsets);
+      env->SetObjectArrayElement(o_offs, i, o);
+    }
+    if (c.validity) {
+      jbyteArray v = env->NewByteArray((jsize)c.rows);
+      env->SetByteArrayRegion(v, 0, (jsize)c.rows,
+                              (const jbyte*)c.validity);
+      env->SetObjectArrayElement(o_valid, i, v);
+    }
+  }
+  env->SetObjectArrayElement(out, 0, o_dt);
+  env->SetObjectArrayElement(out, 1, o_rows);
+  env->SetObjectArrayElement(out, 2, o_data);
+  env->SetObjectArrayElement(out, 3, o_offs);
+  env->SetObjectArrayElement(out, 4, o_valid);
+  env->SetObjectArrayElement(out, 5, env->NewStringUTF(res->meta_json));
+  eb_free_result(res);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_com_sparkrapids_tpu_EngineJni_shutdown(
+    JNIEnv*, jclass) {
+  eb_shutdown();
+}
+
+}  // extern "C"

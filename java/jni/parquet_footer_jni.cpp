@@ -1,0 +1,102 @@
+// JNI shim: com.sparkrapids.tpu.ParquetFooterJni -> the pqf_* C ABI
+// (native/parquet_footer.cpp). jlong handle model; parse errors become
+// RuntimeException with the native error text.
+//
+// Build (requires a JDK; this repo's CI image has none — ci/jvm_sim.c
+// drives the same pqf_* ABI from C instead):
+//   g++ -std=c++17 -O2 -fPIC -shared -I$JAVA_HOME/include \
+//       -I$JAVA_HOME/include/linux -o libsparkpq_jni.so \
+//       java/jni/parquet_footer_jni.cpp native/parquet_footer.cpp
+
+#include <jni.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* pqf_read_and_filter(const uint8_t* buf, long len,
+                          long long part_offset, long long part_length,
+                          const char** names, const int* num_children,
+                          const int* tags, int n_entries,
+                          int parent_num_children, int ignore_case,
+                          char** err_out);
+long long pqf_num_rows(void* h);
+int pqf_num_columns(void* h);
+int pqf_serialize(void* h, uint8_t** out, long long* out_len);
+void pqf_close(void* h);
+void pqf_free(void* p);
+}
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_com_sparkrapids_tpu_ParquetFooterJni_readAndFilter(
+    JNIEnv* env, jclass, jbyteArray buf, jlong part_offset,
+    jlong part_length, jobjectArray names, jintArray num_children,
+    jintArray tags, jint parent_num_children, jboolean ignore_case) {
+  jsize len = env->GetArrayLength(buf);
+  std::vector<uint8_t> bytes(len);
+  env->GetByteArrayRegion(buf, 0, len, (jbyte*)bytes.data());
+
+  jsize n = names ? env->GetArrayLength(names) : 0;
+  std::vector<std::string> name_strs(n);
+  std::vector<const char*> name_ptrs(n);
+  for (jsize i = 0; i < n; i++) {
+    auto js = (jstring)env->GetObjectArrayElement(names, i);
+    const char* p = env->GetStringUTFChars(js, nullptr);
+    name_strs[i] = p ? p : "";
+    env->ReleaseStringUTFChars(js, p);
+    name_ptrs[i] = name_strs[i].c_str();
+  }
+  std::vector<jint> nc(n), tg(n);
+  if (n) {
+    env->GetIntArrayRegion(num_children, 0, n, nc.data());
+    env->GetIntArrayRegion(tags, 0, n, tg.data());
+  }
+
+  char* err = nullptr;
+  void* h = pqf_read_and_filter(
+      bytes.data(), (long)len, part_offset, part_length, name_ptrs.data(),
+      (const int*)nc.data(), (const int*)tg.data(), (int)n,
+      (int)parent_num_children, ignore_case ? 1 : 0, &err);
+  if (!h) {
+    env->ThrowNew(env->FindClass("java/lang/RuntimeException"),
+                  err ? err : "footer parse failed");
+    if (err) pqf_free(err);
+    return 0;
+  }
+  return reinterpret_cast<jlong>(h);
+}
+
+JNIEXPORT jlong JNICALL Java_com_sparkrapids_tpu_ParquetFooterJni_numRows(
+    JNIEnv*, jclass, jlong h) {
+  return pqf_num_rows(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jint JNICALL Java_com_sparkrapids_tpu_ParquetFooterJni_numColumns(
+    JNIEnv*, jclass, jlong h) {
+  return pqf_num_columns(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jbyteArray JNICALL Java_com_sparkrapids_tpu_ParquetFooterJni_serialize(
+    JNIEnv* env, jclass, jlong h) {
+  uint8_t* out = nullptr;
+  long long out_len = 0;
+  if (pqf_serialize(reinterpret_cast<void*>(h), &out, &out_len) != 0) {
+    env->ThrowNew(env->FindClass("java/lang/RuntimeException"),
+                  "footer serialize failed");
+    return nullptr;
+  }
+  jbyteArray arr = env->NewByteArray((jsize)out_len);
+  env->SetByteArrayRegion(arr, 0, (jsize)out_len, (const jbyte*)out);
+  pqf_free(out);
+  return arr;
+}
+
+JNIEXPORT void JNICALL Java_com_sparkrapids_tpu_ParquetFooterJni_close(
+    JNIEnv*, jclass, jlong h) {
+  pqf_close(reinterpret_cast<void*>(h));
+}
+
+}  // extern "C"

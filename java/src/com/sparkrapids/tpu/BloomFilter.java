@@ -1,0 +1,29 @@
+/*
+ * Bloom filter facade — capability parity with the reference's
+ * BloomFilter.java:34-104 (put/probe/merge over a serialized
+ * big-endian-layout blob) via engine ops "bloom.build" / "bloom.probe" /
+ * "bloom.merge" (ops/bloom_filter.py, layout parity incl. serialization).
+ */
+package com.sparkrapids.tpu;
+
+public final class BloomFilter {
+  private BloomFilter() {}
+
+  /** Build a filter from INT64 keys; returns the serialized blob. */
+  public static EngineColumn build(int numHashes, long numLongs,
+                                   EngineColumn keys) {
+    String args = "{\"num_hashes\": " + numHashes + ", \"num_longs\": "
+        + numLongs + "}";
+    return Engine.call("bloom.build", args, keys).columns[0];
+  }
+
+  /** Probe: BOOL8 column, true where the key may be present. */
+  public static EngineColumn probe(EngineColumn keys, EngineColumn blob) {
+    return Engine.call("bloom.probe", "{}", keys, blob).columns[0];
+  }
+
+  /** OR-merge serialized filters (same shape/hash count). */
+  public static EngineColumn merge(EngineColumn... blobs) {
+    return Engine.call("bloom.merge", "{}", blobs).columns[0];
+  }
+}

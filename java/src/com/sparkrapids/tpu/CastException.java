@@ -1,0 +1,28 @@
+/*
+ * ANSI cast failure — capability parity with the reference's
+ * CastException.java:24-38 (carries the first offending string and its
+ * row). The engine raises its python twin
+ * (ops/cast_string.py::CastException) with the same payload; the JNI
+ * layer rethrows as this type.
+ */
+package com.sparkrapids.tpu;
+
+public class CastException extends RuntimeException {
+  private final String stringWithError;
+  private final int rowWithError;
+
+  public CastException(String stringWithError, int rowWithError) {
+    super("Error casting data on row " + rowWithError + ": "
+        + stringWithError);
+    this.stringWithError = stringWithError;
+    this.rowWithError = rowWithError;
+  }
+
+  public String getStringWithError() {
+    return stringWithError;
+  }
+
+  public int getRowWithError() {
+    return rowWithError;
+  }
+}

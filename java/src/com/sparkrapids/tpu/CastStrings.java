@@ -1,0 +1,74 @@
+/*
+ * String cast kernels facade — capability parity with the reference's
+ * CastStrings.java:34-165 (toInteger/toFloat/toDecimal ANSI casts,
+ * fromFloat via Ryu, fromDecimal, base-10/16 conversions) over engine ops
+ * "cast.*" (ops/cast_string.py, cast_float_to_string.py,
+ * decimal_to_string.py, cast_string_base.py).
+ *
+ * ANSI-mode parse failures surface as RuntimeException carrying the
+ * engine's CastException(row, string) message.
+ */
+package com.sparkrapids.tpu;
+
+public final class CastStrings {
+  private CastStrings() {}
+
+  /** string -> int8/16/32/64 ("int32", ...), Spark semantics. */
+  public static EngineColumn toInteger(EngineColumn col, boolean ansi,
+                                       String intType) {
+    return Engine.call("cast.string_to_integer",
+        "{\"type\": \"" + intType + "\", \"ansi\": " + ansi + "}", col)
+        .columns[0];
+  }
+
+  /** string -> float32/float64 (inf/nan literals, trailing f/d). */
+  public static EngineColumn toFloat(EngineColumn col, boolean ansi,
+                                     String floatType) {
+    return Engine.call("cast.string_to_float",
+        "{\"type\": \"" + floatType + "\", \"ansi\": " + ansi + "}", col)
+        .columns[0];
+  }
+
+  /**
+   * string -> decimal. `scale` uses the native convention (negative =
+   * digits after the point), exactly as the reference's toDecimal.
+   */
+  public static EngineColumn toDecimal(EngineColumn col, boolean ansi,
+                                       int precision, int scale) {
+    return Engine.call("cast.string_to_decimal",
+        "{\"precision\": " + precision + ", \"scale\": " + scale
+            + ", \"ansi\": " + ansi + "}", col).columns[0];
+  }
+
+  /** float -> shortest-round-trip string (Ryu; Java toString format). */
+  public static EngineColumn fromFloat(EngineColumn col) {
+    return Engine.call("cast.float_to_string", "{}", col).columns[0];
+  }
+
+  /** Spark format_number(x, digits). */
+  public static EngineColumn fromFloatWithFormat(EngineColumn col,
+                                                 int digits) {
+    return Engine.call("cast.format_number",
+        "{\"digits\": " + digits + "}", col).columns[0];
+  }
+
+  /** decimal -> string (plain form, Java BigDecimal.toPlainString). */
+  public static EngineColumn fromDecimal(EngineColumn col) {
+    return Engine.call("cast.decimal_to_string", "{}", col).columns[0];
+  }
+
+  /** Parse a leading base-10/16 integer prefix per row. */
+  public static EngineColumn toIntegersWithBase(EngineColumn col, int base,
+                                                String intType) {
+    return Engine.call("cast.string_to_integer_base",
+        "{\"base\": " + base + ", \"type\": \"" + intType + "\"}", col)
+        .columns[0];
+  }
+
+  /** Render integers in base 10 (signed) / 16 (unsigned hex). */
+  public static EngineColumn fromIntegersWithBase(EngineColumn col,
+                                                  int base) {
+    return Engine.call("cast.integer_to_string_base",
+        "{\"base\": " + base + "}", col).columns[0];
+  }
+}

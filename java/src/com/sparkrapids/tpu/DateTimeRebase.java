@@ -1,0 +1,22 @@
+/*
+ * Proleptic-Gregorian <-> hybrid-Julian rebase facade — capability parity
+ * with the reference's DateTimeRebase.java:28-54 over engine op
+ * "datetime.rebase" (ops/datetime_rebase.py). Input dtype selects the
+ * unit: "timestamp_days" rebases dates, "timestamp_us" rebases
+ * microsecond timestamps.
+ */
+package com.sparkrapids.tpu;
+
+public final class DateTimeRebase {
+  private DateTimeRebase() {}
+
+  public static EngineColumn rebaseGregorianToJulian(EngineColumn col) {
+    return Engine.call("datetime.rebase",
+        "{\"direction\": \"gregorian_to_julian\"}", col).columns[0];
+  }
+
+  public static EngineColumn rebaseJulianToGregorian(EngineColumn col) {
+    return Engine.call("datetime.rebase",
+        "{\"direction\": \"julian_to_gregorian\"}", col).columns[0];
+  }
+}

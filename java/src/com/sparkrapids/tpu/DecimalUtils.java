@@ -1,0 +1,54 @@
+/*
+ * DECIMAL128 arithmetic facade — capability parity with the reference's
+ * DecimalUtils.java:30-128 (add128/subtract128/multiply128/divide128/
+ * integerDivide128/remainder128, each returning an (overflow BOOL8,
+ * result DECIMAL128) pair) over engine ops "decimal.*"
+ * (ops/decimal128.py — HALF_UP rounding, SPARK-40129 interim cast).
+ */
+package com.sparkrapids.tpu;
+
+public final class DecimalUtils {
+  private DecimalUtils() {}
+
+  /** columns[0] = overflow BOOL8, columns[1] = result DECIMAL128. */
+  public static EngineColumn[] add128(EngineColumn a, EngineColumn b,
+                                      int targetScale) {
+    return Engine.call("decimal.add", "{\"scale\": " + targetScale + "}",
+        a, b).columns;
+  }
+
+  public static EngineColumn[] subtract128(EngineColumn a, EngineColumn b,
+                                           int targetScale) {
+    return Engine.call("decimal.subtract",
+        "{\"scale\": " + targetScale + "}", a, b).columns;
+  }
+
+  public static EngineColumn[] multiply128(EngineColumn a, EngineColumn b,
+                                           int productScale,
+                                           boolean interimCast) {
+    return Engine.call("decimal.multiply", "{\"scale\": " + productScale
+        + ", \"interim_cast\": " + interimCast + "}", a, b).columns;
+  }
+
+  public static EngineColumn[] multiply128(EngineColumn a, EngineColumn b,
+                                           int productScale) {
+    return multiply128(a, b, productScale, true);
+  }
+
+  public static EngineColumn[] divide128(EngineColumn a, EngineColumn b,
+                                         int quotientScale) {
+    return Engine.call("decimal.divide",
+        "{\"scale\": " + quotientScale + "}", a, b).columns;
+  }
+
+  public static EngineColumn[] integerDivide128(EngineColumn a,
+                                                EngineColumn b) {
+    return Engine.call("decimal.integer_divide", "{}", a, b).columns;
+  }
+
+  public static EngineColumn[] remainder128(EngineColumn a, EngineColumn b,
+                                            int remainderScale) {
+    return Engine.call("decimal.remainder",
+        "{\"scale\": " + remainderScale + "}", a, b).columns;
+  }
+}

@@ -1,0 +1,60 @@
+/*
+ * Typed entry point over EngineJni: marshal EngineColumns across the eb_*
+ * wire, surface engine errors as RuntimeExceptions. The per-kernel facades
+ * (Hash, CastStrings, BloomFilter, ...) are thin veneers over this class,
+ * mirroring how the reference's Java classes sit over their JNI halves.
+ */
+package com.sparkrapids.tpu;
+
+public final class Engine {
+  private Engine() {}
+
+  public static final class Result {
+    public final EngineColumn[] columns;
+    public final String metaJson;
+    Result(EngineColumn[] columns, String metaJson) {
+      this.columns = columns;
+      this.metaJson = metaJson;
+    }
+  }
+
+  private static volatile boolean inited = false;
+
+  public static synchronized void init(String enginePath) {
+    if (inited) return;
+    int rc = EngineJni.init(enginePath);
+    if (rc != 0) {
+      throw new IllegalStateException("engine init failed rc=" + rc);
+    }
+    inited = true;
+  }
+
+  public static Result call(String op, String argsJson,
+                            EngineColumn... cols) {
+    String[] dtypes = new String[cols.length];
+    long[] rows = new long[cols.length];
+    byte[][] data = new byte[cols.length][];
+    long[][] offsets = new long[cols.length][];
+    byte[][] validity = new byte[cols.length][];
+    for (int i = 0; i < cols.length; i++) {
+      dtypes[i] = cols[i].dtype;
+      rows[i] = cols[i].rows;
+      data[i] = cols[i].data;
+      offsets[i] = cols[i].offsets;
+      validity[i] = cols[i].validity;
+    }
+    Object[] out = EngineJni.call(op, argsJson, dtypes, rows, data, offsets,
+                                  validity);
+    String[] odt = (String[]) out[0];
+    long[] orows = (long[]) out[1];
+    byte[][] odata = (byte[][]) out[2];
+    long[][] ooffs = (long[][]) out[3];
+    byte[][] ovalid = (byte[][]) out[4];
+    EngineColumn[] res = new EngineColumn[odt.length];
+    for (int i = 0; i < odt.length; i++) {
+      res[i] = new EngineColumn(odt[i], orows[i], odata[i], ooffs[i],
+                                ovalid[i]);
+    }
+    return new Result(res, (String) out[5]);
+  }
+}

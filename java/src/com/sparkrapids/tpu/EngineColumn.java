@@ -1,0 +1,80 @@
+/*
+ * A column crossing the engine bridge: the Java mirror of the `eb_col`
+ * wire struct (native/engine_bridge.cpp). Flat buffers only — nested
+ * results arrive decomposed (offsets column + child columns), exactly as
+ * spark_rapids_jni_tpu/bridge.py documents per op.
+ *
+ * dtype is the wire name ("int64", "string", "decimal128:2", ...);
+ * data is raw little-endian bytes (FLOAT64 = IEEE-754 bit patterns,
+ * DECIMAL128 = 16-byte two's-complement LE); offsets is int64[rows+1] for
+ * STRING; validity is uint8[rows] 0/1 (null = all valid).
+ */
+package com.sparkrapids.tpu;
+
+public final class EngineColumn {
+  public final String dtype;
+  public final long rows;
+  public final byte[] data;
+  public final long[] offsets;   // null unless STRING
+  public final byte[] validity;  // null = all valid
+
+  public EngineColumn(String dtype, long rows, byte[] data, long[] offsets,
+                      byte[] validity) {
+    this.dtype = dtype;
+    this.rows = rows;
+    this.data = data;
+    this.offsets = offsets;
+    this.validity = validity;
+  }
+
+  public static EngineColumn ofLongs(long[] vals) {
+    java.nio.ByteBuffer b = java.nio.ByteBuffer.allocate(vals.length * 8)
+        .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    b.asLongBuffer().put(vals);
+    return new EngineColumn("int64", vals.length, b.array(), null, null);
+  }
+
+  public static EngineColumn ofInts(int[] vals) {
+    java.nio.ByteBuffer b = java.nio.ByteBuffer.allocate(vals.length * 4)
+        .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    b.asIntBuffer().put(vals);
+    return new EngineColumn("int32", vals.length, b.array(), null, null);
+  }
+
+  public static EngineColumn ofStrings(String[] vals) {
+    long[] offsets = new long[vals.length + 1];
+    int total = 0;
+    byte[][] encoded = new byte[vals.length][];
+    for (int i = 0; i < vals.length; i++) {
+      encoded[i] = vals[i] == null ? new byte[0]
+          : vals[i].getBytes(java.nio.charset.StandardCharsets.UTF_8);
+      total += encoded[i].length;
+      offsets[i + 1] = total;
+    }
+    byte[] data = new byte[total];
+    byte[] validity = null;
+    int pos = 0;
+    for (int i = 0; i < vals.length; i++) {
+      System.arraycopy(encoded[i], 0, data, pos, encoded[i].length);
+      pos += encoded[i].length;
+      if (vals[i] == null && validity == null) {
+        validity = new byte[vals.length];
+        java.util.Arrays.fill(validity, (byte) 1);
+      }
+      if (validity != null) validity[i] = (byte) (vals[i] == null ? 0 : 1);
+    }
+    return new EngineColumn("string", vals.length, data, offsets, validity);
+  }
+
+  /** Decode a STRING result column (null entries for invalid rows). */
+  public String[] toStrings() {
+    String[] out = new String[(int) rows];
+    for (int i = 0; i < rows; i++) {
+      if (validity != null && validity[i] == 0) continue;
+      out[i] = new String(data, (int) offsets[i],
+          (int) (offsets[i + 1] - offsets[i]),
+          java.nio.charset.StandardCharsets.UTF_8);
+    }
+    return out;
+  }
+}

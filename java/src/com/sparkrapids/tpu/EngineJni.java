@@ -1,0 +1,34 @@
+/*
+ * Native declarations for the in-process engine bridge
+ * (native/engine_bridge.cpp eb_* C ABI; JNI shim java/jni/engine_jni.cpp).
+ *
+ * The engine is the same Python/XLA kernel surface every other entry point
+ * uses — the JVM hosts it in-process via an embedded CPython, the TPU-native
+ * analog of the reference's in-process CUDA JNI layer. ci/jvm_sim.c drives
+ * the identical ABI from plain C (the executable check in a JDK-less CI).
+ */
+package com.sparkrapids.tpu;
+
+final class EngineJni {
+  private EngineJni() {}
+
+  static {
+    // loaded by the application (System.loadLibrary("sparkeng_jni")); the
+    // shim links libsparkeng.so which embeds CPython on first init
+  }
+
+  /** Initialize the engine; enginePath is appended to the python path. */
+  static native int init(String enginePath);
+
+  /**
+   * Dispatch one op. Column i of the input is
+   * (dtypes[i], rows[i], data[i], offsets[i] or null, validity[i] or null).
+   * Returns Object[] {String[] dtypes, long[] rows, byte[][] data,
+   * long[][] offsets, byte[][] validity, String metaJson} or throws.
+   */
+  static native Object[] call(String op, String argsJson, String[] dtypes,
+                              long[] rows, byte[][] data, long[][] offsets,
+                              byte[][] validity);
+
+  static native void shutdown();
+}

@@ -1,0 +1,26 @@
+/*
+ * Timezone conversion facade — capability parity with the reference's
+ * GpuTimeZoneDB.java:60-110 (fromTimestampToUtcTimestamp,
+ * fromUtcTimestampToTimestamp; rule-based DST zones rejected like
+ * :236-240) over engine ops "tz.*" (ops/timezones.py — TZif transition
+ * tables, lazy cached in the engine's TimeZoneDB).
+ */
+package com.sparkrapids.tpu;
+
+public final class GpuTimeZoneDB {
+  private GpuTimeZoneDB() {}
+
+  /** timestamp in `zone` local time -> UTC (TIMESTAMP_MICROSECONDS). */
+  public static EngineColumn fromTimestampToUtcTimestamp(EngineColumn col,
+                                                         String zone) {
+    return Engine.call("tz.to_utc", "{\"zone\": \"" + zone + "\"}", col)
+        .columns[0];
+  }
+
+  /** UTC timestamp -> `zone` local time (TIMESTAMP_MICROSECONDS). */
+  public static EngineColumn fromUtcTimestampToTimestamp(EngineColumn col,
+                                                         String zone) {
+    return Engine.call("tz.from_utc", "{\"zone\": \"" + zone + "\"}", col)
+        .columns[0];
+  }
+}

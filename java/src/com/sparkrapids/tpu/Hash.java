@@ -1,0 +1,33 @@
+/*
+ * Spark hash kernels facade — capability parity with the reference's
+ * Hash.java:40-90 (murmurHash32 with seed, xxhash64) over the engine
+ * bridge ops "hash.murmur3" / "hash.xxhash64" (ops/hashing.py).
+ */
+package com.sparkrapids.tpu;
+
+public final class Hash {
+  private Hash() {}
+
+  public static final int DEFAULT_MURMUR_SEED = 42;
+  public static final long DEFAULT_XXHASH64_SEED = 42L;
+
+  /** Spark murmur3_32 row hash over the given columns -> INT32 column. */
+  public static EngineColumn murmurHash32(int seed, EngineColumn... cols) {
+    return Engine.call("hash.murmur3", "{\"seed\": " + seed + "}", cols)
+        .columns[0];
+  }
+
+  public static EngineColumn murmurHash32(EngineColumn... cols) {
+    return murmurHash32(DEFAULT_MURMUR_SEED, cols);
+  }
+
+  /** Spark xxhash64 row hash over the given columns -> INT64 column. */
+  public static EngineColumn xxhash64(long seed, EngineColumn... cols) {
+    return Engine.call("hash.xxhash64", "{\"seed\": " + seed + "}", cols)
+        .columns[0];
+  }
+
+  public static EngineColumn xxhash64(EngineColumn... cols) {
+    return xxhash64(DEFAULT_XXHASH64_SEED, cols);
+  }
+}

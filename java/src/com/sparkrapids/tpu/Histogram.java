@@ -1,0 +1,34 @@
+/*
+ * Histogram aggregation facade — capability parity with the reference's
+ * Histogram.java:33-73 (createHistogramIfValid,
+ * percentileFromHistogram) over engine ops "histogram.*"
+ * (ops/histogram.py).
+ *
+ * Nested results are decomposed: a histogram is (offsets INT64, values,
+ * frequencies INT64[, validity]); a list-percentile result is
+ * (offsets INT64, FLOAT64 values[, validity]).
+ */
+package com.sparkrapids.tpu;
+
+public final class Histogram {
+  private Histogram() {}
+
+  public static EngineColumn[] createHistogramIfValid(
+      EngineColumn values, EngineColumn frequencies, boolean asLists) {
+    return Engine.call("histogram.create", "{\"as_lists\": " + asLists + "}",
+        values, frequencies).columns;
+  }
+
+  public static EngineColumn[] percentileFromHistogram(
+      EngineColumn offsets, EngineColumn values, EngineColumn frequencies,
+      double[] percentages, boolean outputAsList) {
+    StringBuilder sb = new StringBuilder("{\"percentages\": [");
+    for (int i = 0; i < percentages.length; i++) {
+      if (i > 0) sb.append(", ");
+      sb.append(percentages[i]);
+    }
+    sb.append("], \"as_list\": ").append(outputAsList).append('}');
+    return Engine.call("histogram.percentile", sb.toString(),
+        offsets, values, frequencies).columns;
+  }
+}

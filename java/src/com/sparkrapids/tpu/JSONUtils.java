@@ -1,0 +1,18 @@
+/*
+ * get_json_object facade — capability parity with the reference's
+ * JSONUtils.java:37-60 over engine op "json.get_json_object"
+ * (ops/get_json_object.py -> native/get_json_object.cpp host tier:
+ * JSONPath subset $.field, [idx], [*], deep wildcards).
+ */
+package com.sparkrapids.tpu;
+
+public final class JSONUtils {
+  private JSONUtils() {}
+
+  public static EngineColumn getJsonObject(EngineColumn col, String path) {
+    // minimal JSON string escaping for the path literal
+    String esc = path.replace("\\", "\\\\").replace("\"", "\\\"");
+    return Engine.call("json.get_json_object",
+        "{\"path\": \"" + esc + "\"}", col).columns[0];
+  }
+}

@@ -1,0 +1,18 @@
+/*
+ * from_json raw-map facade — capability parity with the reference's
+ * MapUtils.java:33-49 (extractRawMapFromJsonString) over engine op
+ * "json.from_json_map" (ops/map_utils.py -> shared native tokenizer).
+ *
+ * The MAP result arrives decomposed: {offsets INT64, keys STRING,
+ * values STRING[, validity BOOL8]} — one (key, value) run per row.
+ */
+package com.sparkrapids.tpu;
+
+public final class MapUtils {
+  private MapUtils() {}
+
+  public static EngineColumn[] extractRawMapFromJsonString(
+      EngineColumn col) {
+    return Engine.call("json.from_json_map", "{}", col).columns;
+  }
+}

@@ -1,0 +1,59 @@
+/*
+ * Parquet footer parse/prune facade — capability parity with the
+ * reference's ParquetFooter.java:35-241 (readAndFilter over a
+ * depth-first schema DSL with Value/Struct/List/Map tags, num rows /
+ * num columns introspection, re-serialize) over the pqf_* C ABI
+ * (native/parquet_footer.cpp; JNI shim java/jni/parquet_footer_jni.cpp).
+ * The python twin of this facade is parquet/footer.py.
+ */
+package com.sparkrapids.tpu;
+
+public final class ParquetFooter implements AutoCloseable {
+  // schema tag values shared with the native side
+  public static final int TAG_VALUE = 0;
+  public static final int TAG_STRUCT = 1;
+  public static final int TAG_LIST = 2;
+  public static final int TAG_MAP = 3;
+
+  private long handle;
+
+  private ParquetFooter(long handle) {
+    this.handle = handle;
+  }
+
+  /**
+   * Parse footer bytes and prune to the requested Spark schema, given
+   * depth-first (root excluded): names[i]/numChildren[i]/tags[i] per
+   * schema node, parentNumChildren = root child count.
+   */
+  public static ParquetFooter readAndFilter(byte[] buf, long partOffset,
+                                            long partLength, String[] names,
+                                            int[] numChildren, int[] tags,
+                                            int parentNumChildren,
+                                            boolean ignoreCase) {
+    long h = ParquetFooterJni.readAndFilter(buf, partOffset, partLength,
+        names, numChildren, tags, parentNumChildren, ignoreCase);
+    return new ParquetFooter(h);
+  }
+
+  public long getNumRows() {
+    return ParquetFooterJni.numRows(handle);
+  }
+
+  public int getNumColumns() {
+    return ParquetFooterJni.numColumns(handle);
+  }
+
+  /** Thrift-compact re-serialization of the pruned footer. */
+  public byte[] serializeThriftFile() {
+    return ParquetFooterJni.serialize(handle);
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      ParquetFooterJni.close(handle);
+      handle = 0;
+    }
+  }
+}

@@ -1,0 +1,24 @@
+/*
+ * Native declarations over the pqf_* C ABI (native/parquet_footer.cpp),
+ * shim java/jni/parquet_footer_jni.cpp. Handle model: jlong, never
+ * dereferenced Java-side (ci/jvm_sim.c drives the same ABI from C).
+ */
+package com.sparkrapids.tpu;
+
+final class ParquetFooterJni {
+  private ParquetFooterJni() {}
+
+  static native long readAndFilter(byte[] buf, long partOffset,
+                                   long partLength, String[] names,
+                                   int[] numChildren, int[] tags,
+                                   int parentNumChildren,
+                                   boolean ignoreCase);
+
+  static native long numRows(long handle);
+
+  static native int numColumns(long handle);
+
+  static native byte[] serialize(long handle);
+
+  static native void close(long handle);
+}

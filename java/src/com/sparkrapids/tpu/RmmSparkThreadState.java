@@ -1,0 +1,37 @@
+/*
+ * Thread states of the resource-scheduling state machine — capability
+ * parity with the reference's RmmSparkThreadState.java:25-50. The
+ * native ids are the rm_thread_state enum shared with
+ * native/resource_adaptor.cpp (and memory/rmm_spark.py's TS_* mirror).
+ */
+package com.sparkrapids.tpu;
+
+public enum RmmSparkThreadState {
+  UNKNOWN(-1),          // thread is not tracked by the state machine
+  THREAD_RUNNING(0),    // running normally
+  THREAD_ALLOC(1),      // mid-allocation
+  THREAD_ALLOC_FREE(2), // mid-allocation and a free happened
+  THREAD_BLOCKED(3),    // temporarily blocked on memory
+  THREAD_BUFN_THROW(4), // should throw to roll back before blocking
+  THREAD_BUFN_WAIT(5),  // rolled back; blocks at next alloc
+  THREAD_BUFN(6),       // blocked until higher-priority tasks succeed
+  THREAD_SPLIT_THROW(7),// should throw split-and-retry
+  THREAD_REMOVE_THROW(8); // being removed; must throw
+
+  private final int nativeId;
+
+  RmmSparkThreadState(int nativeId) {
+    this.nativeId = nativeId;
+  }
+
+  public int getNativeId() {
+    return nativeId;
+  }
+
+  static RmmSparkThreadState fromNativeId(int nativeId) {
+    for (RmmSparkThreadState s : values()) {
+      if (s.nativeId == nativeId) return s;
+    }
+    throw new IllegalArgumentException("no thread state id " + nativeId);
+  }
+}

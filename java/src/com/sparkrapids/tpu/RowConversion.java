@@ -1,0 +1,33 @@
+/*
+ * Row <-> column conversion facade — capability parity with the
+ * reference's RowConversion.java:35-173 (convertToRows /
+ * convertFromRows) over engine ops "rowconv.*" (ops/row_conversion.py,
+ * JCUDF row layout).
+ *
+ * The packed rows come back decomposed: columns[0] = UINT8 blob,
+ * columns[1] = INT64 row offsets; metaJson carries {"n_batches", "rows"}.
+ */
+package com.sparkrapids.tpu;
+
+public final class RowConversion {
+  private RowConversion() {}
+
+  /** Pack columns into JCUDF rows (blob + offsets). */
+  public static Engine.Result convertToRows(EngineColumn... cols) {
+    return Engine.call("rowconv.to_rows", "{}", cols);
+  }
+
+  /** Unpack JCUDF rows into typed columns. */
+  public static EngineColumn[] convertFromRows(EngineColumn blob,
+                                               EngineColumn offsets,
+                                               String... types) {
+    StringBuilder sb = new StringBuilder("{\"types\": [");
+    for (int i = 0; i < types.length; i++) {
+      if (i > 0) sb.append(", ");
+      sb.append('"').append(types[i]).append('"');
+    }
+    sb.append("]}");
+    return Engine.call("rowconv.from_rows", sb.toString(), blob, offsets)
+        .columns;
+  }
+}

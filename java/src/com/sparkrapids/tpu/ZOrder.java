@@ -1,0 +1,26 @@
+/*
+ * Z-order / Hilbert curve facade — capability parity with the reference's
+ * ZOrder.java:30-88 (interleaveBits, hilbertIndex) over engine ops
+ * "zorder.*" (ops/zorder.py).
+ */
+package com.sparkrapids.tpu;
+
+public final class ZOrder {
+  private ZOrder() {}
+
+  /**
+   * Interleave same-typed fixed-width columns bit by bit (column 0 most
+   * significant). Returns {offsets INT64, bytes UINT8} — a decomposed
+   * LIST&lt;UINT8&gt; binary column.
+   */
+  public static EngineColumn[] interleaveBits(EngineColumn... cols) {
+    return Engine.call("zorder.interleave", "{}", cols).columns;
+  }
+
+  /** d-dimensional Hilbert index of INT32 columns -> INT64 column. */
+  public static EngineColumn hilbertIndex(int numBits,
+                                          EngineColumn... cols) {
+    return Engine.call("zorder.hilbert", "{\"num_bits\": " + numBits + "}",
+        cols).columns[0];
+  }
+}

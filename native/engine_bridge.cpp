@@ -1,0 +1,285 @@
+// In-process engine bridge: C ABI over an embedded CPython interpreter.
+//
+// The reference's L4 surface is JNI functions over CUDA kernels; this
+// framework's kernels are Python/XLA, so the JVM-facing native half hosts
+// the engine in-process (Py_Initialize) and dispatches by op name to
+// spark_rapids_jni_tpu.bridge — the same dispatch table every other entry
+// point uses. Columns cross as flat (dtype, data, offsets, validity)
+// buffers, the repo-wide C ABI convention (see ci/jvm_sim.c).
+//
+// Thread model: eb_init may be called from any thread (idempotent, mutex
+// guarded); after init the GIL is released, and every eb_call takes it via
+// PyGILState_Ensure, so concurrent callers serialize on the GIL exactly as
+// JNI threads would.
+//
+// Build:
+//   g++ -std=c++17 -O2 -fPIC -shared -o libsparkeng.so \
+//       native/engine_bridge.cpp $(python3-config --includes) \
+//       -L/usr/local/lib -lpython3.12 -lpthread
+//
+// Reference analog: src/main/cpp/src/*Jni.cpp marshalling layers.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+bool g_inited = false;
+bool g_own_interp = false;        // we ran Py_InitializeEx (true embedding)
+PyObject* g_call = nullptr;       // spark_rapids_jni_tpu.bridge.call
+PyThreadState* g_main_ts = nullptr;
+thread_local std::string g_err;
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_err = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+// A column crossing into the engine. dtype is the wire name ("int64",
+// "string", "decimal128:2", ...); offsets is int64[rows+1] for STRING.
+typedef struct {
+  const char* dtype;
+  int64_t rows;
+  const uint8_t* data;
+  int64_t data_bytes;
+  const int64_t* offsets;   // rows+1 entries, or NULL
+  const uint8_t* validity;  // rows bytes (0/1), or NULL
+} eb_col;
+
+typedef struct {
+  char* dtype;
+  int64_t rows;
+  uint8_t* data;
+  int64_t data_bytes;
+  int64_t* offsets;   // rows+1 entries, or NULL
+  uint8_t* validity;  // rows bytes, or NULL
+} eb_out_col;
+
+typedef struct {
+  int32_t n_cols;
+  eb_out_col* cols;
+  char* meta_json;  // op-specific scalar results
+} eb_result;
+
+const char* eb_last_error(void) { return g_err.c_str(); }
+
+// Initialize the engine. extra_sys_path (may be NULL) is appended to
+// sys.path before importing the bridge — pass the repo/install root.
+//
+// Works both as a true embedding (no interpreter yet: JVM/jvm_sim hosts —
+// we Py_Initialize and own it) and loaded *into* a running interpreter
+// (ctypes from pytest — we only import the bridge under the existing GIL).
+int eb_init(const char* extra_sys_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_inited) return 0;
+  // sticky: a failed first init must not flip ownership on retry (the
+  // interpreter we created reports Py_IsInitialized() == true then)
+  g_own_interp = g_own_interp || !Py_IsInitialized();
+  if (g_own_interp && !Py_IsInitialized()) Py_InitializeEx(0);
+
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (extra_sys_path && *extra_sys_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra_sys_path);
+    if (!sys_path || !p || PyList_Append(sys_path, p) != 0) {
+      Py_XDECREF(p);
+      set_err_from_python();
+      rc = -1;
+    } else {
+      Py_DECREF(p);
+    }
+  }
+  if (rc == 0) {
+    PyObject* mod = PyImport_ImportModule("spark_rapids_jni_tpu.bridge");
+    if (!mod) {
+      set_err_from_python();
+      rc = -2;
+    } else {
+      g_call = PyObject_GetAttrString(mod, "call");
+      Py_DECREF(mod);
+      if (!g_call) {
+        set_err_from_python();
+        rc = -3;
+      }
+    }
+  }
+  if (rc != 0) PyErr_Clear();  // never leave a pending exception behind
+  PyGILState_Release(gil);
+
+  if (g_own_interp && g_main_ts == nullptr) {
+    // the init thread still holds the GIL from Py_InitializeEx; release it
+    // so eb_call (or an eb_init retry from another thread) can take it —
+    // on failure too, else the failed-init thread deadlocks every caller
+    g_main_ts = PyEval_SaveThread();
+  }
+  if (rc != 0) return rc;
+  g_inited = true;
+  return 0;
+}
+
+void eb_free_result(eb_result* r) {
+  if (!r) return;
+  for (int32_t i = 0; i < r->n_cols; i++) {
+    free(r->cols[i].dtype);
+    free(r->cols[i].data);
+    free(r->cols[i].offsets);
+    free(r->cols[i].validity);
+  }
+  free(r->cols);
+  free(r->meta_json);
+  free(r);
+}
+
+int eb_call(const char* op, const char* args_json, const eb_col* ins,
+            int32_t n_ins, eb_result** out) {
+  if (!g_inited) {
+    g_err = "eb_init not called";
+    return -10;
+  }
+  if (!op || !out) {
+    g_err = "op/out must not be NULL";
+    return -11;
+  }
+  *out = nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  PyObject* cols = nullptr;
+  PyObject* res = nullptr;
+
+  do {
+    cols = PyList_New(n_ins);
+    if (!cols) { set_err_from_python(); rc = -12; break; }
+    bool bad = false;
+    for (int32_t i = 0; i < n_ins; i++) {
+      const eb_col& c = ins[i];
+      PyObject* data = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(c.data),
+          static_cast<Py_ssize_t>(c.data_bytes));
+      PyObject* offs = c.offsets
+          ? PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(c.offsets),
+                static_cast<Py_ssize_t>((c.rows + 1) * 8))
+          : (Py_INCREF(Py_None), Py_None);
+      PyObject* valid = c.validity
+          ? PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(c.validity),
+                static_cast<Py_ssize_t>(c.rows))
+          : (Py_INCREF(Py_None), Py_None);
+      PyObject* tup = (data && offs && valid)
+          ? Py_BuildValue("(sLNNN)", c.dtype,
+                          static_cast<long long>(c.rows), data, offs, valid)
+          : nullptr;
+      if (!tup) {
+        Py_XDECREF(data); Py_XDECREF(offs); Py_XDECREF(valid);
+        set_err_from_python(); rc = -12; bad = true; break;
+      }
+      PyList_SET_ITEM(cols, i, tup);  // steals
+    }
+    if (bad) break;
+
+    res = PyObject_CallFunction(g_call, "ssO", op,
+                                args_json ? args_json : "{}", cols);
+    if (!res) { set_err_from_python(); rc = -13; break; }
+
+    // res = (list[tuple], meta_json_str)
+    PyObject* out_list = PyTuple_GetItem(res, 0);  // borrowed
+    PyObject* meta = PyTuple_GetItem(res, 1);
+    if (!out_list || !meta || !PyList_Check(out_list)) {
+      g_err = "bridge.call returned unexpected shape";
+      rc = -14; break;
+    }
+    Py_ssize_t n_out = PyList_Size(out_list);
+    eb_result* r = static_cast<eb_result*>(calloc(1, sizeof(eb_result)));
+    r->n_cols = static_cast<int32_t>(n_out);
+    r->cols = static_cast<eb_out_col*>(calloc(n_out ? n_out : 1,
+                                              sizeof(eb_out_col)));
+    const char* meta_c = PyUnicode_AsUTF8(meta);
+    r->meta_json = strdup(meta_c ? meta_c : "{}");
+    for (Py_ssize_t i = 0; i < n_out && rc == 0; i++) {
+      PyObject* t = PyList_GetItem(out_list, i);  // borrowed
+      const char* dt_s = nullptr;
+      long long rows = 0;
+      PyObject *data = nullptr, *offs = nullptr, *valid = nullptr;
+      if (!PyArg_ParseTuple(t, "sLOOO", &dt_s, &rows, &data, &offs,
+                            &valid)) {
+        set_err_from_python(); rc = -14; break;
+      }
+      eb_out_col& oc = r->cols[i];
+      oc.dtype = strdup(dt_s);
+      oc.rows = rows;
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      if (PyBytes_AsStringAndSize(data, &buf, &len) != 0) {
+        set_err_from_python(); rc = -14; break;
+      }
+      oc.data = static_cast<uint8_t*>(malloc(len ? len : 1));
+      memcpy(oc.data, buf, len);
+      oc.data_bytes = len;
+      if (offs != Py_None) {
+        if (PyBytes_AsStringAndSize(offs, &buf, &len) != 0) {
+          set_err_from_python(); rc = -14; break;
+        }
+        oc.offsets = static_cast<int64_t*>(malloc(len ? len : 1));
+        memcpy(oc.offsets, buf, len);
+      }
+      if (valid != Py_None) {
+        if (PyBytes_AsStringAndSize(valid, &buf, &len) != 0) {
+          set_err_from_python(); rc = -14; break;
+        }
+        oc.validity = static_cast<uint8_t*>(malloc(len ? len : 1));
+        memcpy(oc.validity, buf, len);
+      }
+    }
+    if (rc != 0) { eb_free_result(r); break; }
+    *out = r;
+  } while (false);
+
+  Py_XDECREF(cols);
+  Py_XDECREF(res);
+  if (rc != 0) PyErr_Clear();  // manual-error paths may leave one pending
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void eb_shutdown(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited) return;
+  if (g_own_interp) {
+    PyEval_RestoreThread(g_main_ts);
+    Py_XDECREF(g_call);
+    g_call = nullptr;
+    Py_FinalizeEx();
+  } else {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(g_call);
+    g_call = nullptr;
+    PyGILState_Release(gil);
+  }
+  g_inited = false;
+}
+
+}  // extern "C"

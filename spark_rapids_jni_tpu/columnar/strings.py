@@ -59,7 +59,10 @@ def densify_offsets(data: jnp.ndarray, offsets,
     pos = offsets[:-1, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
     in_range = pos < offsets[1:, None]
     gathered = jnp.take(data, jnp.clip(pos, 0, data.shape[0] - 1), axis=0)
-    return jnp.where(in_range, gathered,
+    # Matrix payloads (e.g. a padded string child [total, Ls]) gather to
+    # [n, L, Ls]; expand the [n, L] mask with trailing axes to match.
+    mask = in_range.reshape(in_range.shape + (1,) * (gathered.ndim - 2))
+    return jnp.where(mask, gathered,
                      jnp.zeros((), dtype=data.dtype)), lengths
 
 

@@ -6,16 +6,22 @@ shuffle + JCUDF rows fill this role there, SURVEY.md §5.8):
   1. Row route = Spark murmur3 of the key columns (ops/hashing) mod the mesh
      size, so partitioning agrees with Spark's HashPartitioner convention of
      hashing the same bytes (route quality, not a wire contract).
-  2. Every column is lowered to fixed-shape device buffers (fixed-width
-     values, validity masks, padded string bytes + lengths) — XLA collectives
-     need static shapes.
-  3. Inside `shard_map`, each device slot-packs its rows into a
-     `[n_devices, rows_per_device]` grid keyed by (destination, rank within
-     destination) and one `lax.all_to_all` per buffer rides ICI. Slot
-     capacity is statically safe: a source holds only `rows_per_device` rows.
-  4. Receivers flatten their `n_devices * rows_per_device` landing zone; a
-     shipped occupancy mask marks live rows. The only host syncs are the
-     final per-partition compactions (data-dependent sizes), mirroring the
+  2. Every column is lowered to fixed-shape device buffers by *recursive*
+     descent (fixed-width values incl. DECIMAL128 limb matrices, validity
+     masks, padded string bytes + lengths, LIST children of any of these) —
+     XLA collectives need static shapes, so variable-length children ride as
+     per-slot padded matrices (columnar/strings.densify_offsets).
+  3. The exchange is two-phase, so traffic is proportional to the rows
+     actually shuffled: a first shard_map program all_gathers the
+     [n_devices, n_devices] destination-count matrix (tiny), whose host-read
+     max sizes the slot grid; the second program slot-packs rows into a
+     `[n_devices, cap]` grid (cap = bucketed actual max rows any source
+     sends to one destination — NOT the ceil(n/n_devices) worst case) and
+     one `lax.all_to_all` per buffer rides ICI.
+  4. Receivers compact their landing zone *on device* (stable argsort of
+     the occupancy mask + gather) inside the same program; partitions are
+     returned as device-resident Tables. The only host syncs are sizing
+     scalars (per-partition row counts, list/string totals), per the
      repo-wide "sizing on host, data on device" doctrine.
 """
 
@@ -36,8 +42,7 @@ except ImportError:  # older jax
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
-from ..columnar.strings import (densify_offsets, from_padded_bytes,
-                                pad_width, padded_bytes, unflatten_padded)
+from ..columnar.strings import densify_offsets, pad_width, padded_bytes
 from ..ops.hashing import murmur_hash3_32
 
 def _mesh_axis(mesh: Mesh) -> str:
@@ -45,13 +50,25 @@ def _mesh_axis(mesh: Mesh) -> str:
     return mesh.axis_names[0]
 
 
-# jitted exchange programs cached by (mesh, per_dev, buffer signature): a
-# fresh jit(shard_map(...)) per call would recompile every same-shape shuffle
+# jitted exchange programs cached by (mesh, per_dev, cap, buffer signature):
+# a fresh jit(shard_map(...)) per call would recompile every same-shape
+# shuffle. The counts program caches by (mesh, per_dev) alone.
 _EXCHANGE_CACHE: dict = {}
+_COUNTS_CACHE: dict = {}
 
+
+# ---------------------------------------------------------------------------
+# Column <-> fixed-shape buffer lowering (recursive over nesting)
+# ---------------------------------------------------------------------------
 
 def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
-    """Lower a column to fixed-shape [n, ...] buffers + rebuild metadata."""
+    """Lower a column to fixed-shape [n, ...] buffers + rebuild metadata.
+
+    Fully recursive: LIST children are lowered with this same function and
+    each child buffer is densified per list slot ([m, ...] -> [n, L, ...]),
+    so LIST<STRING>, LIST<DECIMAL128>, LIST<LIST<...>> and LIST<STRUCT>
+    all ship without special cases.
+    """
     tid = col.dtype.id
     valid = col.valid_mask()
     if tid is dt.TypeId.STRING:
@@ -64,25 +81,11 @@ def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
         lengths = offs[1:] - offs[:-1]
         max_len = int(jnp.max(lengths)) if col.size else 0
         L = pad_width(max_len, 4)
-        evalid, _ = densify_offsets(child.valid_mask(), offs, L)
-        if child.dtype.id is dt.TypeId.STRING:
-            # LIST<STRING>: densify the child's padded byte rows per list
-            # slot -> [n, L, Ls] bytes + [n, L] element byte lengths
-            cmat, clens = padded_bytes(child)
-            emats, _ = densify_offsets(cmat, offs, L)
-            elens, _ = densify_offsets(clens, offs, L)
-            return [emats, elens, evalid, lengths.astype(jnp.int32),
-                    valid], {"kind": "list_str", "dtype": col.dtype,
-                             "child_dtype": child.dtype}
-        if (not child.dtype.is_fixed_width
-                or child.dtype.id is dt.TypeId.DECIMAL128):
-            raise NotImplementedError(
-                "LIST elements must be fixed-width or STRING to exchange")
-        # shared densification (columnar/strings); child.data keeps its
-        # physical storage dtype (uint64 bit patterns for FLOAT64)
-        elems, _ = densify_offsets(child.data, offs, L)
-        return [elems, evalid, lengths.astype(jnp.int32), valid], {
-            "kind": "list", "dtype": col.dtype, "child_dtype": child.dtype}
+        child_bufs, child_meta = _col_to_buffers(child)
+        dens = [densify_offsets(cb, offs, L)[0] for cb in child_bufs]
+        return dens + [lengths.astype(jnp.int32), valid], {
+            "kind": "list", "dtype": col.dtype, "child": child_meta,
+            "child_nbufs": len(child_bufs)}
     if tid is dt.TypeId.STRUCT:
         bufs: List[jnp.ndarray] = [valid]
         child_metas, child_spans = [], []
@@ -93,69 +96,80 @@ def _col_to_buffers(col: Column) -> Tuple[List[jnp.ndarray], dict]:
             child_metas.append(cm)
         return bufs, {"kind": "struct", "dtype": col.dtype,
                       "children": child_metas, "spans": child_spans}
+    # fixed-width (incl. DECIMAL128 [n, 4] limb matrices); data keeps its
+    # physical storage dtype (uint64 bit patterns for FLOAT64)
     return [col.data, valid], {"kind": "fixed", "dtype": col.dtype}
 
 
-def _col_from_buffers(bufs: Sequence[np.ndarray], meta: dict,
-                      keep: np.ndarray) -> Column:
-    """Rebuild a column from received (host) buffers compacted by ``keep``."""
-    if meta["kind"] == "string":
+def _unflatten_device(mat: jnp.ndarray, lengths: jnp.ndarray,
+                      total: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device inverse of densify_offsets: padded [n, L, ...] + lengths ->
+    (flat [total, ...] elements, int32[n+1] offsets). ``total`` is a static
+    python int (host-synced sizing), so shapes stay static for XLA."""
+    lengths = lengths.astype(jnp.int32)
+    n = int(lengths.shape[0])
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)])
+    if total == 0:
+        return jnp.zeros((0,) + tuple(mat.shape[2:]), mat.dtype), offsets
+    row_of = jnp.repeat(jnp.arange(n, dtype=jnp.int32), lengths,
+                        total_repeat_length=total)
+    col_in = (jnp.arange(total, dtype=jnp.int32)
+              - jnp.take(offsets[:-1], row_of))
+    return mat[row_of, col_in], offsets
+
+
+def _maybe_valid(valid: jnp.ndarray) -> Optional[jnp.ndarray]:
+    """None when all rows are valid (scalar sizing sync) — preserves the
+    Column convention that validity=None means no nulls."""
+    return None if bool(jnp.all(valid)) else valid
+
+
+def _col_from_buffers(bufs: Sequence[jnp.ndarray], meta: dict) -> Column:
+    """Rebuild a column from received *compacted device* buffers.
+
+    Inverse of _col_to_buffers; all data movement is device gathers. Host
+    syncs are sizing only: list/string element totals and the
+    all-valid checks.
+    """
+    kind = meta["kind"]
+    if kind == "string":
         mat, lengths, valid = bufs
-        mat, lengths, valid = mat[keep], lengths[keep], valid[keep]
-        return from_padded_bytes(mat, lengths,
-                                 validity=None if valid.all() else valid)
-    if meta["kind"] == "list_str":
-        emats, elens, evalid, lengths, valid = bufs
-        emats, elens, evalid = emats[keep], elens[keep], evalid[keep]
-        lengths, valid = lengths[keep].astype(np.int64), valid[keep]
+        total = int(jnp.sum(lengths))
+        flat, offsets = _unflatten_device(mat, lengths, total)
+        return Column(meta["dtype"], int(lengths.shape[0]), data=flat,
+                      validity=_maybe_valid(valid), offsets=offsets)
+    if kind == "list":
+        nb = meta["child_nbufs"]
+        child_dens, lengths, valid = bufs[:nb], bufs[nb], bufs[nb + 1]
         n = int(lengths.shape[0])
-        flat_mats, offsets = unflatten_padded(emats, lengths)  # [m, Ls]
-        flat_lens, _ = unflatten_padded(elens, lengths)
-        cvalid, _ = unflatten_padded(evalid, lengths)
-        child = from_padded_bytes(flat_mats, flat_lens,
-                                  validity=None if cvalid.all() else cvalid)
-        return Column(meta["dtype"], n,
-                      validity=None if valid.all() else jnp.asarray(valid),
-                      offsets=jnp.asarray(offsets.astype(np.int32)),
-                      children=(child,))
-    if meta["kind"] == "list":
-        elems, evalid, lengths, valid = bufs
-        elems, evalid = elems[keep], evalid[keep]
-        lengths, valid = lengths[keep].astype(np.int64), valid[keep]
-        n = int(lengths.shape[0])
-        flat, offsets = unflatten_padded(elems, lengths)
-        cvalid, _ = unflatten_padded(evalid, lengths)
-        total = int(offsets[-1])
-        if not total:
-            # keep the child's *physical* storage dtype (FLOAT64 stores
-            # uint64 bit patterns; jnp_dtype would say float64)
-            flat = np.zeros((0,), dtype=np.asarray(elems).dtype)
-            cvalid = np.ones((0,), dtype=bool)
-        child = Column(meta["child_dtype"], total, data=jnp.asarray(flat),
-                       validity=None if cvalid.all()
-                       else jnp.asarray(cvalid))
-        return Column(meta["dtype"], n,
-                      validity=None if valid.all() else jnp.asarray(valid),
-                      offsets=jnp.asarray(offsets.astype(np.int32)),
-                      children=(child,))
-    if meta["kind"] == "struct":
-        valid = bufs[0][keep]
+        total = int(jnp.sum(lengths))
+        offsets = None
+        child_flat = []
+        for cb in child_dens:
+            flat, offsets = _unflatten_device(cb, lengths, total)
+            child_flat.append(flat)
+        child = _col_from_buffers(child_flat, meta["child"])
+        return Column(meta["dtype"], n, validity=_maybe_valid(valid),
+                      offsets=offsets, children=(child,))
+    if kind == "struct":
+        valid = bufs[0]
         pos = 1
         children = []
         for cm, span in zip(meta["children"], meta["spans"]):
-            children.append(
-                _col_from_buffers(bufs[pos:pos + span], cm, keep))
+            children.append(_col_from_buffers(bufs[pos:pos + span], cm))
             pos += span
         return Column(meta["dtype"], int(valid.shape[0]),
-                      validity=None if valid.all() else jnp.asarray(valid),
+                      validity=_maybe_valid(valid),
                       children=tuple(children))
     data, valid = bufs
-    data, valid = data[keep], valid[keep]
-    col = Column(meta["dtype"], int(data.shape[0]), data=jnp.asarray(data))
-    if not valid.all():
-        col = col.with_validity(jnp.asarray(valid))
-    return col
+    return Column(meta["dtype"], int(data.shape[0]), data=data,
+                  validity=_maybe_valid(valid))
 
+
+# ---------------------------------------------------------------------------
+# Routing + the two shard_map phases
+# ---------------------------------------------------------------------------
 
 def partition_ids(table: Table, key_indices: Sequence[int],
                   num_partitions: int) -> jnp.ndarray:
@@ -165,13 +179,81 @@ def partition_ids(table: Table, key_indices: Sequence[int],
         .astype(jnp.int32)
 
 
+def _counts_program(mesh: Mesh, per_dev: int, nd: int):
+    """Phase 1: per-shard destination histogram -> global [nd, nd] matrix
+    (row = source device). Dead (padding) rows are routed to bucket nd and
+    dropped. Only nd*nd int32s ever reach the host."""
+    key = (mesh, per_dev)
+    prog = _COUNTS_CACHE.get(key)
+    if prog is None:
+        axis = _mesh_axis(mesh)
+
+        def local(dest_l, live_l):
+            d = jnp.where(live_l, dest_l, nd)
+            return jnp.bincount(d, length=nd + 1)[:nd].astype(jnp.int32)
+
+        prog = jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(axis)),
+            out_specs=P(axis)))
+        _COUNTS_CACHE[key] = prog
+    return prog
+
+
+def _cap_bucket(cap: int) -> int:
+    """Bucket the slot capacity (next power of two, >= 16) so near-miss
+    sizes reuse one compiled exchange program."""
+    return pad_width(cap, 16)
+
+
+def _exchange_program(mesh: Mesh, per_dev: int, cap: int, nd: int,
+                      shapes: Tuple) -> "jax.stages.Wrapped":
+    axis = _mesh_axis(mesh)
+
+    def local(dest_l, live_l, *bufs_l):
+        # dead rows route to bucket nd: out of the [nd, cap] grid, so the
+        # scatter drops them (mode='drop') and they never ride the wire
+        d = jnp.where(live_l, dest_l, nd)
+        order = jnp.argsort(d, stable=True)
+        d_s = jnp.take(d, order)
+        counts = jnp.bincount(d, length=nd + 1)[:nd]
+        starts = jnp.cumsum(counts) - counts
+        starts_full = jnp.append(starts, jnp.sum(counts))
+        rank = (jnp.arange(per_dev, dtype=jnp.int32)
+                - jnp.take(starts_full, d_s).astype(jnp.int32))
+        occ = jnp.zeros((nd, cap), dtype=bool)
+        occ = occ.at[d_s, rank].set(d_s < nd, mode="drop")
+        recv_occ = lax.all_to_all(occ, axis, 0, 0).reshape(nd * cap)
+
+        # device-side compaction of the landing zone: live rows first
+        # (stable, so arrival order per source is preserved), then gather
+        corder = jnp.argsort(jnp.logical_not(recv_occ), stable=True)
+        k = jnp.sum(recv_occ).astype(jnp.int32).reshape(1)
+
+        received = [k]
+        for b in bufs_l:
+            slot = jnp.zeros((nd, cap) + b.shape[1:], dtype=b.dtype)
+            slot = slot.at[d_s, rank].set(jnp.take(b, order, axis=0),
+                                          mode="drop")
+            landed = lax.all_to_all(slot, axis, 0, 0) \
+                .reshape((nd * cap,) + b.shape[1:])
+            received.append(jnp.take(landed, corder, axis=0))
+        return tuple(received)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=tuple(P(axis) for _ in range(2 + len(shapes))),
+        out_specs=tuple(P(axis) for _ in range(1 + len(shapes))),
+    ))
+
+
 def hash_partition_exchange(
         table: Table, key_indices: Sequence[int], mesh: Mesh,
         dest: Optional[jnp.ndarray] = None) -> List[Table]:
     """Shuffle ``table`` across ``mesh`` so equal keys land on one device.
 
-    Returns the per-device partitions as local Tables (schema preserved).
-    ``dest`` overrides the murmur route (e.g. range partitioning for sort).
+    Returns the per-device partitions as device-resident local Tables
+    (schema preserved). ``dest`` overrides the murmur route (e.g. range
+    partitioning for sort).
     """
     nd = mesh.devices.size
     n = table.num_rows
@@ -179,7 +261,7 @@ def hash_partition_exchange(
         dest = partition_ids(table, key_indices, nd)
 
     # pad rows to a multiple of nd so the row axis shards evenly; padded
-    # rows carry live=False and are dropped on receive
+    # rows are routed out of the grid and never shipped
     per_dev = -(-max(n, 1) // nd)
     n_pad = per_dev * nd
     live = jnp.arange(n_pad) < n
@@ -190,62 +272,46 @@ def hash_partition_exchange(
         pad = [(0, n_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, pad)
 
-    buffers: List[jnp.ndarray] = [_pad(dest), live]
+    axis = _mesh_axis(mesh)
+    sharding = NamedSharding(mesh, P(axis))
+    dest_d = jax.device_put(_pad(dest), sharding)
+    live_d = jax.device_put(live, sharding)
+
+    # phase 1: destination-count matrix -> slot capacity (host sizing sync)
+    counts_mat = np.asarray(
+        _counts_program(mesh, per_dev, nd)(dest_d, live_d)).reshape(nd, nd)
+    cap = _cap_bucket(int(counts_mat.max(initial=0)))
+
+    buffers: List[jnp.ndarray] = []
     metas = []
     spans: List[Tuple[int, int]] = []
     for col in table.columns:
         bufs, meta = _col_to_buffers(col)
         spans.append((len(buffers), len(buffers) + len(bufs)))
-        buffers.extend(_pad(b) for b in bufs)
+        buffers.extend(
+            jax.device_put(_pad(b), sharding) for b in bufs)
         metas.append(meta)
 
-    axis = _mesh_axis(mesh)
-    sharding = NamedSharding(mesh, P(axis))
-    buffers = [jax.device_put(b, sharding) for b in buffers]
-
-    sig = (mesh, per_dev,
-           tuple((b.shape[1:], str(b.dtype)) for b in buffers))
+    shapes = tuple((b.shape[1:], str(b.dtype)) for b in buffers)
+    sig = (mesh, per_dev, cap, shapes)
     program = _EXCHANGE_CACHE.get(sig)
     if program is None:
-        def local(dest_l, live_l, *bufs_l):
-            # stable sort by destination → slot grid [nd, per_dev]
-            order = jnp.argsort(dest_l)
-            d_s = jnp.take(dest_l, order)
-            counts = jnp.bincount(dest_l, length=nd)
-            starts = jnp.cumsum(counts) - counts
-            rank = (jnp.arange(per_dev)
-                    - jnp.take(starts, d_s)).astype(jnp.int32)
-            occ = jnp.zeros((nd, per_dev), dtype=bool)
-            occ = occ.at[d_s, rank].set(jnp.take(live_l, order))
-            received = [lax.all_to_all(occ, axis, 0, 0).reshape(nd * per_dev)]
-            for b in bufs_l:
-                slot = jnp.zeros((nd, per_dev) + b.shape[1:], dtype=b.dtype)
-                slot = slot.at[d_s, rank].set(jnp.take(b, order, axis=0))
-                received.append(
-                    lax.all_to_all(slot, axis, 0, 0)
-                    .reshape((nd * per_dev,) + b.shape[1:]))
-            return tuple(received)
-
-        program = jax.jit(shard_map(
-            local, mesh=mesh,
-            in_specs=tuple(P(axis) for _ in buffers),
-            out_specs=tuple(P(axis) for _ in range(len(buffers) - 1)),
-        ))
+        program = _exchange_program(mesh, per_dev, cap, nd, shapes)
         _EXCHANGE_CACHE[sig] = program
 
-    shuffled = program(*buffers)
+    out = program(dest_d, live_d, *buffers)
 
-    # host compaction: split the [nd * nd * per_dev] landing zones into the
-    # nd local partitions and drop unoccupied slots (data-dependent sizes)
-    host = [np.asarray(b) for b in shuffled]
-    occ_all = host[0]
-    zone = nd * per_dev  # rows landing on one device
+    # per-partition sizing sync ([nd] int32), then device-resident rebuild:
+    # each partition's rows are the first k_p slots of its compacted zone
+    ks = np.asarray(out[0])
+    zone = nd * cap
     parts: List[Table] = []
     for p in range(nd):
-        keep = occ_all[p * zone:(p + 1) * zone]
+        k = int(ks[p])
         cols = []
         for (lo, hi), meta in zip(spans, metas):
-            bufs = [h[p * zone:(p + 1) * zone] for h in host[lo - 1:hi - 1]]
-            cols.append(_col_from_buffers(bufs, meta, keep))
+            bufs = [out[1 + i][p * zone:p * zone + k]
+                    for i in range(lo, hi)]
+            cols.append(_col_from_buffers(bufs, meta))
         parts.append(Table(tuple(cols)))
     return parts

@@ -58,14 +58,26 @@ def test_jvm_sim_round_trips(tmp_path):
         capture_output=True, text=True)
     assert build.returncode == 0, build.stderr
 
+    # the engine bridge embeds CPython: keep the child off the axon plugin
+    # (PYTHONPATH-reached sitecustomize) and on the CPU backend
+    libeng = os.path.join(NATIVE, "libsparkeng.so")
+    if not os.path.exists(libeng):
+        mk = subprocess.run(["make", "native"], cwd=REPO,
+                            capture_output=True, text=True)
+        assert os.path.exists(libeng), \
+            f"make native did not produce libsparkeng.so:\n{mk.stderr}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
+               PALLAS_AXON_POOL_IPS="")
     run = subprocess.run(
-        [exe, librm, libpq, libjson, pq_file, "1234", "b", libpuri],
-        capture_output=True, text=True, timeout=120)
+        [exe, librm, libpq, libjson, pq_file, "1234", "b", libpuri,
+         libeng, REPO],
+        capture_output=True, text=True, timeout=600, env=env)
     assert run.returncode == 0, f"{run.stdout}\n{run.stderr}"
     assert "rmm control plane ok" in run.stdout
     assert "parquet footer round-trip ok (1234 rows)" in run.stdout
     assert "get_json_object bytes ok" in run.stdout
     assert "parse_url HOST bytes ok" in run.stdout
+    assert "engine bridge ok (10 kernel ops)" in run.stdout
     assert "all round-trips ok" in run.stdout
 
 
@@ -79,7 +91,9 @@ def _jni_impls(cpp_src: str, cls: str):
 
 
 _JNI_PAIRS = [("RmmSparkJni", "rmm_spark_jni.cpp"),
-              ("ParseURIJni", "parse_uri_jni.cpp")]
+              ("ParseURIJni", "parse_uri_jni.cpp"),
+              ("EngineJni", "engine_jni.cpp"),
+              ("ParquetFooterJni", "parquet_footer_jni.cpp")]
 
 
 def test_java_facade_and_jni_shim_in_sync():
