@@ -41,6 +41,7 @@ typedef struct {
   char* path;
   int physical, type_length, converted, scale, precision, max_def, max_rep;
   int rep_def;
+  const char* path_json;  // handle-owned, not freed here
 } pqd_leaf_t;
 typedef struct {
   uint8_t* values;
@@ -53,6 +54,9 @@ typedef struct {
   uint8_t* list_validity;
   long long list_rows;
   long long list_null_count;
+  int32_t* defs;
+  int32_t* reps;
+  long long n_levels;
 } pqd_out_t;
 void* pqd_open(const uint8_t* footer, long long len, char** err_out);
 int pqd_num_row_groups(void* h);
@@ -62,6 +66,9 @@ int pqd_chunk_range(void* h, int rg, int leaf, long long* offset,
                     long long* length, long long* num_values, int* codec);
 int pqd_decode_chunk(void* h, int rg, int leaf, const uint8_t* bytes,
                      long long len, pqd_out_t* out, char** err_out);
+int pqd_decode_chunk2(void* h, int rg, int leaf, const uint8_t* bytes,
+                      long long len, int want_levels, pqd_out_t* out,
+                      char** err_out);
 void pqd_free_out(pqd_out_t* out);
 void pqd_free(void* p);
 void pqd_close(void* h);
@@ -220,12 +227,15 @@ void fuzz_decode(const std::string& footer, const std::string& chunk) {
     pqd_leaf_t li;
     if (pqd_leaf_info(h, leaf, &li) == 0) free(li.path);
     for (int rg = 0; rg < n_rg && rg < 2; rg++) {
-      pqd_out_t out;
-      char* derr = nullptr;
-      if (pqd_decode_chunk(h, rg, leaf, (const uint8_t*)chunk.data(),
-                           (long long)chunk.size(), &out, &derr) == 0)
-        pqd_free_out(&out);
-      if (derr) pqd_free(derr);
+      for (int want_levels = 0; want_levels < 2; want_levels++) {
+        pqd_out_t out;
+        char* derr = nullptr;
+        if (pqd_decode_chunk2(h, rg, leaf, (const uint8_t*)chunk.data(),
+                              (long long)chunk.size(), want_levels, &out,
+                              &derr) == 0)
+          pqd_free_out(&out);
+        if (derr) pqd_free(derr);
+      }
     }
   }
   pqd_close(h);

@@ -1,0 +1,243 @@
+"""On-chip kernel-correctness smoke: oracle sweeps on the REAL backend.
+
+tests/ pins JAX_PLATFORMS=cpu (suite greenness must not depend on tunnel
+health), which round 2's verdict flagged: no committed way existed to run
+correctness on the actual TPU. This script is that way — the driver (or a
+user) runs it with the live environment and gets a JSON verdict comparing
+every core kernel against a host oracle *on whatever backend jax.devices()
+resolves to* (the axon TPU when the tunnel is up).
+
+Backend selection reuses bench.py's wedge-resilient probe (subprocess init
+with retries, CPU only as a last resort), so a wedged relay yields a CPU
+verdict line rather than a hang.
+
+Run: python ci/tpu_smoke.py           → one JSON line
+Exit 0 iff every check passed.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHECKS = {}
+
+
+def check(name):
+    def deco(fn):
+        CHECKS[name] = fn
+        return fn
+    return deco
+
+
+@check("murmur3_hash_golden")
+def _murmur(np, jnp):
+    """Spark golden vectors (Hash.java semantics) must hold on-chip."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+    col = Column.from_pylist([1, None, 3], dt.INT64)
+    got = murmur_hash3_32(Table((col,))).to_pylist()
+    assert got == [-1712319331, 42, 519220707], got
+
+
+@check("xxhash64_golden")
+def _xx(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.hashing import xxhash64
+    col = Column.from_numpy(np.array([1, 2, 3], np.int64), dt.INT64)
+    got = xxhash64(Table((col,))).to_pylist()
+    assert got == [-7001672635703045582, -3341702809300393011,
+                   3188756510806108107], got
+
+
+@check("float_to_string_ryu_oracle")
+def _ryu(np, jnp):
+    """Shortest-round-trip strings vs python repr oracle, random sweep."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.cast_float_to_string import float_to_string
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.standard_normal(2000) * 10.0 ** rng.integers(-30, 30, 2000),
+        np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1e-300, 1e300])])
+    col = Column.from_numpy(vals, dt.FLOAT64)
+    got = float_to_string(col).to_pylist()
+    for v, g in zip(vals, got):
+        # Java Double.toString oracle relation: parsing the string must
+        # round-trip to the exact double
+        if np.isnan(v):
+            assert g == "NaN", g
+        elif np.isinf(v):
+            assert g in ("Infinity", "-Infinity"), g
+        else:
+            assert float(g) == v, (v, g)
+
+
+@check("string_to_float_oracle")
+def _s2f(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_float
+    rng = np.random.default_rng(1)
+    vals = rng.standard_normal(2000) * 10.0 ** rng.integers(-20, 20, 2000)
+    strs = [f"{v:.10e}" for v in vals]
+    col = Column.from_pylist(strs, dt.STRING)
+    out = string_to_float(col, dt.FLOAT64)
+    got = np.asarray(out.data).view(np.float64)
+    want = np.array([float(s) for s in strs])
+    # the engine reproduces the reference parser's accuracy contract
+    # (cast_string_to_float.cu digit accumulation): within 1 ULP of the
+    # correctly-rounded value, exact for most inputs
+    ulp = np.abs(got.view(np.int64) - want.view(np.int64))
+    assert ulp.max() <= 1, ulp.max()
+
+
+@check("row_conversion_roundtrip")
+def _rowconv(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.row_conversion import (
+        convert_from_rows, convert_to_rows)
+    rng = np.random.default_rng(2)
+    n = 10000
+    t = Table((
+        Column.from_numpy(rng.integers(-2**62, 2**62, n), dt.INT64),
+        Column.from_numpy(rng.integers(0, 100, n).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.standard_normal(n), dt.FLOAT64),
+        Column.from_pylist([f"s{i % 97}" for i in range(n)], dt.STRING),
+    ))
+    back = convert_from_rows(convert_to_rows(t)[0],
+                             [c.dtype for c in t.columns])
+    for a, b in zip(t.columns, back.columns):
+        assert a.to_pylist() == b.to_pylist()
+
+
+@check("groupby_oracle")
+def _groupby(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    rng = np.random.default_rng(3)
+    n = 50000
+    k = rng.integers(0, 500, n)
+    v = rng.integers(-1000, 1000, n)
+    t = Table((Column.from_numpy(k, dt.INT64),
+               Column.from_numpy(v, dt.INT64)))
+    out = groupby_aggregate(t, [0], [(1, "sum"), (1, "count")])
+    got = {kk: (s, c) for kk, s, c in zip(out.columns[0].to_pylist(),
+                                          out.columns[1].to_pylist(),
+                                          out.columns[2].to_pylist())}
+    import collections
+    sums = collections.defaultdict(int)
+    counts = collections.defaultdict(int)
+    for kk, vv in zip(k.tolist(), v.tolist()):
+        sums[kk] += vv
+        counts[kk] += 1
+    assert got == {kk: (sums[kk], counts[kk]) for kk in sums}
+
+
+@check("join_oracle")
+def _join(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    rng = np.random.default_rng(4)
+    lk = rng.integers(0, 300, 20000)
+    rk = rng.permutation(np.arange(400))[:300]
+    lg, rg = inner_join([Column.from_numpy(lk, dt.INT64)],
+                        [Column.from_numpy(rk, dt.INT64)])
+    got = sorted(zip(np.asarray(lg.data).tolist(),
+                     np.asarray(rg.data).tolist()))
+    rpos = {int(kv): i for i, kv in enumerate(rk)}
+    want = sorted((i, rpos[int(kv)]) for i, kv in enumerate(lk)
+                  if int(kv) in rpos)
+    assert got == want
+
+
+@check("sort_oracle")
+def _sort(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+    rng = np.random.default_rng(5)
+    vals = rng.integers(-2**62, 2**62, 30000)
+    out = sort_table(Table((Column.from_numpy(vals, dt.INT64),)), [0])
+    assert np.asarray(out.columns[0].data).tolist() == sorted(vals.tolist())
+
+
+@check("bloom_filter_no_false_negatives")
+def _bloom(np, jnp):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    rng = np.random.default_rng(6)
+    keys = rng.integers(0, 1 << 40, 20000)
+    filt = bf.bloom_filter_put(bf.bloom_filter_create(3, 4096),
+                               Column.from_numpy(keys, dt.INT64))
+    hit = bf.bloom_filter_probe(Column.from_numpy(keys, dt.INT64), filt)
+    assert all(hit.to_pylist())
+
+
+@check("decimal128_multiply_oracle")
+def _dec(np, jnp):
+    import decimal
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.decimal128 import multiply_decimal128
+    rng = np.random.default_rng(7)
+    d = dt.DType(dt.TypeId.DECIMAL128, 2)
+    a_vals = [decimal.Decimal(int(x)) / 100
+              for x in rng.integers(-10**15, 10**15, 1000)]
+    b_vals = [decimal.Decimal(int(x)) / 100
+              for x in rng.integers(-10**6, 10**6, 1000)]
+    out = multiply_decimal128(Column.from_pylist(a_vals, d),
+                              Column.from_pylist(b_vals, d), 4)
+    ovf = out.columns[0].to_pylist()
+    got = out.columns[1].to_pylist()
+    ctx = decimal.Context(prec=60, rounding=decimal.ROUND_HALF_UP)
+    for av, bv, o, g in zip(a_vals, b_vals, ovf, got):
+        if o:
+            continue
+        want = (av * bv).quantize(decimal.Decimal("0.0001"), context=ctx)
+        assert g == want, (av, bv, g, want)
+
+
+def main():
+    import bench
+    bench._ensure_backend()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.devices()[0].platform
+    results = {}
+    failed = 0
+    t0 = time.perf_counter()
+    for name, fn in CHECKS.items():
+        t1 = time.perf_counter()
+        try:
+            fn(np, jnp)
+            results[name] = {"ok": True,
+                             "seconds": round(time.perf_counter() - t1, 3)}
+        except Exception as e:
+            failed += 1
+            results[name] = {"ok": False, "error": f"{type(e).__name__}: "
+                             f"{str(e)[:300]}"}
+        print(f"smoke: {name}: {results[name]}", file=sys.stderr)
+    print(json.dumps({
+        "backend": backend,
+        "passed": len(CHECKS) - failed,
+        "failed": failed,
+        "seconds": round(time.perf_counter() - t0, 2),
+        "checks": results,
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

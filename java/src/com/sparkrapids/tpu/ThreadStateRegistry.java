@@ -1,0 +1,43 @@
+/*
+ * Engine-thread-id → Thread map consulted by the native deadlock sweep —
+ * capability parity with the reference's ThreadStateRegistry.java:33-66.
+ * The native adaptor asks isThreadBlocked(tid) for threads its state
+ * machine sees as RUNNING, so a task thread OS-blocked on I/O or a lock
+ * while holding reservations cannot stall BUFN/SPLIT escalation.
+ * The python twin (the engine-registered callback) is
+ * memory/rmm_spark.py::ThreadStateRegistry.
+ */
+package com.sparkrapids.tpu;
+
+import java.util.HashMap;
+
+public final class ThreadStateRegistry {
+  private ThreadStateRegistry() {}
+
+  private static final HashMap<Long, Thread> knownThreads = new HashMap<>();
+
+  public static synchronized void addThread(long tid, Thread t) {
+    knownThreads.put(tid, t);
+  }
+
+  public static synchronized void removeThread(long tid) {
+    knownThreads.remove(tid);
+  }
+
+  /** Called from the native watchdog sweep (rm_set_external_blocked_cb). */
+  public static synchronized boolean isThreadBlocked(long tid) {
+    Thread t = knownThreads.get(tid);
+    if (t == null || !t.isAlive()) {
+      return true;  // dead is as good as blocked
+    }
+    switch (t.getState()) {
+      case BLOCKED:
+      case WAITING:
+      case TIMED_WAITING:
+      case TERMINATED:
+        return true;
+      default:
+        return false;
+    }
+  }
+}

@@ -380,6 +380,11 @@ struct leaf_info {
   // list slot iff def >= rep_def; the list itself is present iff
   // def >= rep_def - 1 (0 for flat leaves)
   int rep_def = 0;
+  // JSON array describing every node on the root→leaf path:
+  // [{"name":..,"repetition":0|1|2,"def":..,"rep":..,"converted":..}, ...]
+  // — what the Python reader needs to rebuild nested STRUCT/LIST trees
+  // from raw def/rep level streams (handle-owned storage)
+  std::string path_json;
 };
 
 struct decode_handle {
@@ -387,9 +392,26 @@ struct decode_handle {
   std::vector<leaf_info> leaves;
 };
 
+static std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') out.push_back('\\');
+    if ((unsigned char)ch < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", ch);
+      out += buf;
+      continue;
+    }
+    out.push_back(ch);
+  }
+  return out;
+}
+
 static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
                         int nchildren, const std::string& prefix, int def,
-                        int rep, int rep_def, std::vector<leaf_info>& out) {
+                        int rep, int rep_def, const std::string& nodes_json,
+                        std::vector<leaf_info>& out) {
   for (int c = 0; c < nchildren; c++) {
     if (idx >= schema.size()) throw std::runtime_error("schema: truncated tree");
     const tvalue& se = *schema[idx++];
@@ -401,21 +423,30 @@ static void walk_schema(const std::vector<const tvalue*>& schema, size_t& idx,
     int r2 = rep + (r == REP_REPEATED ? 1 : 0);
     int rd2 = (r == REP_REPEATED) ? d2 : rep_def;
     int nc = (int)i_of(se, SE_NUM_CHILDREN, 0);
+    auto* conv_f = get(se, SE_CONVERTED);
+    int conv = conv_f ? (int)conv_f->i : -1;
+    std::string node = "{\"name\":\"" + json_escape(name) +
+        "\",\"repetition\":" + std::to_string(r) +
+        ",\"def\":" + std::to_string(d2) +
+        ",\"rep\":" + std::to_string(r2) +
+        ",\"converted\":" + std::to_string(conv) + "}";
+    std::string nodes2 =
+        nodes_json.empty() ? node : nodes_json + "," + node;
     if (nc == 0) {
       leaf_info li;
       li.path = path;
       li.physical = (int)i_of(se, SE_TYPE, -1);
       li.type_length = (int)i_of(se, SE_TYPE_LENGTH, 0);
-      auto* conv = get(se, SE_CONVERTED);
-      li.converted = conv ? (int)conv->i : -1;
+      li.converted = conv;
       li.scale = (int)i_of(se, SE_SCALE, 0);
       li.precision = (int)i_of(se, SE_PRECISION, 0);
       li.max_def = d2;
       li.max_rep = r2;
       li.rep_def = rd2;
+      li.path_json = "[" + nodes2 + "]";
       out.push_back(std::move(li));
     } else {
-      walk_schema(schema, idx, nc, path, d2, r2, rd2, out);
+      walk_schema(schema, idx, nc, path, d2, r2, rd2, nodes2, out);
     }
   }
 }
@@ -440,6 +471,9 @@ struct column_out {
   std::vector<uint8_t> list_validity;
   int64_t list_rows = 0;
   int64_t list_nulls = 0;
+  // want_levels mode: the raw per-entry level streams (nested reconstruction
+  // happens in the Python reader, vectorized)
+  std::vector<int32_t> defs, reps;
 };
 
 static size_t plain_elem_size(int physical, int type_length) {
@@ -489,6 +523,10 @@ struct chunk_decoder {
   bool emit_decimal128;     // FLBA/decimal → 16-byte values
 
   bool emit_int96;          // INT96 → 8-byte micros values
+
+  // export raw def/rep streams and skip one-level list assembly — the
+  // nested-reconstruction mode (any max_rep, STRUCT paths)
+  bool want_levels = false;
 
   chunk_decoder(const leaf_info& l, int codec_, int64_t nv)
       : leaf(l), codec(codec_), num_values(nv) {
@@ -684,6 +722,26 @@ struct chunk_decoder {
       out.list_offsets.push_back((int32_t)list_elem_cum);
       list_row_open = false;
     }
+  }
+
+  // want_levels mode: record the raw streams and return the element-slot
+  // defs (an element slot exists wherever every repeated ancestor has an
+  // entry: def >= rep_def). Works for any nesting depth, and for flat
+  // STRUCT paths (rep_def == 0 keeps every entry).
+  std::vector<int32_t> record_levels(const std::vector<int32_t>& reps,
+                                     const std::vector<int32_t>& defs) {
+    if (reps.empty()) {
+      out.reps.insert(out.reps.end(), defs.size(), 0);
+    } else {
+      out.reps.insert(out.reps.end(), reps.begin(), reps.end());
+    }
+    out.defs.insert(out.defs.end(), defs.begin(), defs.end());
+    if (leaf.rep_def == 0) return defs;
+    std::vector<int32_t> child;
+    child.reserve(defs.size());
+    for (int32_t d : defs)
+      if (d >= leaf.rep_def) child.push_back(d);
+    return child;
   }
 
   // Append n decoded values (with defs) from `data` using `enc`.
@@ -924,9 +982,9 @@ struct chunk_decoder {
 
   // ---- page walk ----------------------------------------------------------
   void decode_chunk(const uint8_t* buf, size_t len) {
-    if (leaf.max_rep > 1)
+    if (leaf.max_rep > 1 && !want_levels)
       throw std::runtime_error(
-          "multi-level nested columns not supported (max_rep > 1)");
+          "multi-level nested columns need the level-export decode path");
     size_t pos = 0;
     int64_t seen = 0;
     while (seen < num_values) {
@@ -964,14 +1022,20 @@ struct chunk_decoder {
         std::vector<int32_t> defs;
         const uint8_t* dp = data;
         size_t dl = dlen;
-        if (leaf.max_rep == 1) {
+        if (leaf.max_rep >= 1) {
           std::vector<int32_t> reps;
           read_levels_v1(dp, dl, n, leaf.max_rep, reps);  // reps come first
           read_levels_v1(dp, dl, n, leaf.max_def, defs);
-          decode_values(dp, dl, enc, fold_list_levels(reps, defs));
+          decode_values(dp, dl, enc,
+                        want_levels ? record_levels(reps, defs)
+                                    : fold_list_levels(reps, defs));
         } else {
           read_levels_v1(dp, dl, n, leaf.max_def, defs);
-          decode_values(dp, dl, enc, defs);
+          if (want_levels) {
+            decode_values(dp, dl, enc, record_levels({}, defs));
+          } else {
+            decode_values(dp, dl, enc, defs);
+          }
         }
         seen += n;
         continue;
@@ -1022,7 +1086,9 @@ struct chunk_decoder {
           data = vsrc;
           dlen = vcomp;
         }
-        if (leaf.max_rep == 1) {
+        if (want_levels) {
+          decode_values(data, dlen, enc, record_levels(reps, defs));
+        } else if (leaf.max_rep == 1) {
           decode_values(data, dlen, enc, fold_list_levels(reps, defs));
         } else {
           decode_values(data, dlen, enc, defs);
@@ -1052,6 +1118,7 @@ typedef struct {
   int scale, precision;
   int max_def, max_rep;
   int rep_def;         // def level at the repeated ancestor (lists)
+  const char* path_json;  // root→leaf node array (handle-owned, no free)
 } pqd_leaf_t;
 
 typedef struct {
@@ -1066,6 +1133,11 @@ typedef struct {
   uint8_t* list_validity;  // bool[list_rows] or NULL when no null lists
   long long list_rows;
   long long list_null_count;
+  // want_levels mode (pqd_decode_chunk2): raw per-entry level streams for
+  // nested reconstruction; NULL/0 otherwise
+  int32_t* defs;
+  int32_t* reps;
+  long long n_levels;
 } pqd_out_t;
 
 // Parse raw thrift FileMetaData (no PAR1 framing). Caller buffer may be freed
@@ -1082,7 +1154,7 @@ void* pqd_open(const uint8_t* footer, long long len, char** err_out) {
     for (auto& se : schema_f->list) schema.push_back(&se);
     size_t idx = 1;  // skip root
     int root_children = (int)i_of(*schema[0], SE_NUM_CHILDREN, 0);
-    walk_schema(schema, idx, root_children, "", 0, 0, 0, h->leaves);
+    walk_schema(schema, idx, root_children, "", 0, 0, 0, "", h->leaves);
     return h.release();
   } catch (std::exception& e) {
     if (err_out) *err_out = strdup(e.what());
@@ -1120,6 +1192,7 @@ int pqd_leaf_info(void* hp, int leaf, pqd_leaf_t* out) {
   out->max_def = li.max_def;
   out->max_rep = li.max_rep;
   out->rep_def = li.rep_def;
+  out->path_json = li.path_json.c_str();
   return 0;
 }
 
@@ -1145,9 +1218,12 @@ int pqd_chunk_range(void* hp, int rg, int leaf, long long* offset,
   return 0;
 }
 
-// Decode one column chunk from its raw file bytes.
-int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
-                     long long len, pqd_out_t* out, char** err_out) {
+// Decode one column chunk from its raw file bytes. want_levels additionally
+// exports the raw def/rep streams (and lifts the max_rep <= 1 limit) for
+// nested reconstruction in the reader.
+int pqd_decode_chunk2(void* hp, int rg, int leaf, const uint8_t* bytes,
+                      long long len, int want_levels, pqd_out_t* out,
+                      char** err_out) {
   auto* h = (decode_handle*)hp;
   try {
     if (leaf < 0 || leaf >= (int)h->leaves.size())
@@ -1158,6 +1234,7 @@ int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
     if (rc != 0) throw std::runtime_error("bad row group / leaf");
     if (len < chunk_len) throw std::runtime_error("short chunk buffer");
     chunk_decoder dec(h->leaves[leaf], codec, nv);
+    dec.want_levels = want_levels != 0;
     dec.decode_chunk(bytes, (size_t)chunk_len);
 
     out->rows = dec.out.rows;
@@ -1204,11 +1281,30 @@ int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
                  dec.out.list_validity.size());
       }
     }
+    out->defs = nullptr;
+    out->reps = nullptr;
+    out->n_levels = 0;
+    if (want_levels) {
+      out->n_levels = (long long)dec.out.defs.size();
+      size_t nb = dec.out.defs.size() * 4;
+      out->defs = (int32_t*)malloc(nb ? nb : 4);
+      out->reps = (int32_t*)malloc(nb ? nb : 4);
+      if (nb) {
+        memcpy(out->defs, dec.out.defs.data(), nb);
+        memcpy(out->reps, dec.out.reps.data(), nb);
+      }
+    }
     return 0;
   } catch (std::exception& e) {
     if (err_out) *err_out = strdup(e.what());
     return -1;
   }
+}
+
+// Back-compat entry: flat + one-level LIST decode, no level export.
+int pqd_decode_chunk(void* hp, int rg, int leaf, const uint8_t* bytes,
+                     long long len, pqd_out_t* out, char** err_out) {
+  return pqd_decode_chunk2(hp, rg, leaf, bytes, len, 0, out, err_out);
 }
 
 void pqd_free_out(pqd_out_t* out) {
@@ -1217,11 +1313,15 @@ void pqd_free_out(pqd_out_t* out) {
   free(out->validity);
   free(out->list_offsets);
   free(out->list_validity);
+  free(out->defs);
+  free(out->reps);
   out->values = nullptr;
   out->offsets = nullptr;
   out->validity = nullptr;
   out->list_offsets = nullptr;
   out->list_validity = nullptr;
+  out->defs = nullptr;
+  out->reps = nullptr;
 }
 
 void pqd_free(void* p) { free(p); }

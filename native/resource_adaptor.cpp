@@ -535,8 +535,35 @@ class resource_adaptor {
   // ---- watchdog (100 ms poll from a host daemon thread) -------------------
 
   int check_and_break_deadlocks() {
+    // Two-phase so the external blocked-state query runs unlocked: snapshot
+    // the gating threads the state machine does NOT already count blocked,
+    // ask the host runtime about each, then re-take the lock and sweep.
+    // The unlocked query can go stale either way — a momentary wait
+    // observed as "blocked", or a fresh block missed. To keep a transient
+    // wait from triggering a wrong escalation, a thread only counts as
+    // externally blocked when TWO consecutive sweeps (one watchdog period
+    // apart) both observed it blocked; a genuinely stuck thread passes that
+    // filter on the second sweep, a momentary lock hand-off does not.
+    ext_blocked_fn cb = ext_blocked_cb_.load();
+    std::set<long> ext;
+    if (cb) {
+      std::vector<long> candidates;
+      {
+        std::lock_guard<std::mutex> g(m_);
+        for (auto& [tid, t] : threads_)
+          if (!t.is_task_less() && !t.counts_blocked_for_deadlock())
+            candidates.push_back(tid);
+      }
+      for (long tid : candidates)
+        if (cb(tid)) ext.insert(tid);
+    }
     std::unique_lock<std::mutex> lk(m_);
-    check_and_update_for_bufn_locked(lk);
+    std::set<long> stable;
+    for (long tid : ext)
+      if (prev_ext_blocked_.count(tid)) stable.insert(tid);
+    prev_ext_blocked_ = std::move(ext);
+    check_and_update_for_bufn_locked(lk,
+                                     stable.empty() ? nullptr : &stable);
     return RM_OK;
   }
 
@@ -836,7 +863,23 @@ class resource_adaptor {
   //    BLOCKED thread gets BUFN_THROW (roll back & retry);
   //  * all task threads at BUFN                        → highest-priority BUFN
   //    thread gets SPLIT_THROW (halve input & retry).
-  void check_and_update_for_bufn_locked(std::unique_lock<std::mutex>&) {
+  // ThreadStateRegistry analog (reference ThreadStateRegistry.java:33-66 +
+  // SparkResourceAdaptorJni.cpp:1498-1500): asks the host runtime whether a
+  // thread is OS-blocked for non-memory reasons (I/O, locks). Registered by
+  // the Python facade; consulted only by the watchdog's deadlock sweep, and
+  // NEVER invoked while the adaptor mutex is held (the callback re-enters
+  // the host runtime — Python — whose own locks must not nest inside m_).
+  using ext_blocked_fn = int (*)(long);
+  std::atomic<ext_blocked_fn> ext_blocked_cb_{nullptr};
+
+ public:
+  void set_external_blocked_cb(ext_blocked_fn cb) { ext_blocked_cb_ = cb; }
+
+ private:
+
+  void check_and_update_for_bufn_locked(
+      std::unique_lock<std::mutex>& lk,
+      const std::set<long>* ext_blocked = nullptr) {
     // Only *dedicated* task threads gate the deadlock check. A pool/shuffle
     // thread serving many tasks can churn small transfers forever without
     // unblocking anyone's big request — treating its RUNNING state as
@@ -859,7 +902,11 @@ class resource_adaptor {
     for (auto& [tid, t] : threads_) {
       if (!gates(t)) continue;
       any_task_thread = true;
-      if (!t.counts_blocked_for_deadlock()) { all_blocked = false; break; }
+      bool ext = ext_blocked && ext_blocked->count(tid);
+      if (!t.counts_blocked_for_deadlock() && !ext) {
+        all_blocked = false;
+        break;
+      }
     }
     if (!any_task_thread || !all_blocked) return;
 
@@ -914,6 +961,7 @@ class resource_adaptor {
 
   std::mutex m_;
   std::map<long, per_thread> threads_;
+  std::set<long> prev_ext_blocked_;  // last sweep's external-blocked set
   std::map<long, task_metrics> task_metrics_;
   int64_t pool_limit_;
   int64_t pool_used_ = 0;
@@ -983,6 +1031,9 @@ int rm_waiting_on_pool(void* h, long tid, int flag) {
   return A->waiting_on_pool(tid, flag);
 }
 int rm_check_and_break_deadlocks(void* h) { return A->check_and_break_deadlocks(); }
+void rm_set_external_blocked_cb(void* h, int (*cb)(long)) {
+  A->set_external_blocked_cb(cb);
+}
 int rm_get_state_of(void* h, long tid) { return A->get_state_of(tid); }
 long long rm_get_metric(void* h, long task, int which, int reset) {
   return A->get_metric(task, which, reset);

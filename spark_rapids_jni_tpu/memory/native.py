@@ -23,6 +23,10 @@ _SO = os.path.join(_PKG_ROOT, "_native", "libsparkrm.so")
 _lock = threading.Lock()
 _lib = None
 
+# external blocked-thread query: int cb(long engine_thread_id) -> 0/1
+# (ThreadStateRegistry analog; see rmm_spark.py)
+EXT_BLOCKED_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_long)
+
 
 def _build() -> None:
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
@@ -93,6 +97,8 @@ def load() -> ctypes.CDLL:
         fn("rm_submitting_to_pool", I, H, L, I)
         fn("rm_waiting_on_pool", I, H, L, I)
         fn("rm_check_and_break_deadlocks", I, H)
+        lib.rm_set_external_blocked_cb.restype = None
+        lib.rm_set_external_blocked_cb.argtypes = [H, EXT_BLOCKED_CB]
         fn("rm_get_state_of", I, H, L)
         fn("rm_get_metric", LL, H, L, I, I)
         fn("rm_pool_used", LL, H)

@@ -67,6 +67,85 @@ OOM_MODE_TPU = 1
 OOM_MODE_CPU = 2
 
 
+class ThreadStateRegistry:
+    """Engine-thread-id → python Thread map consulted by the native deadlock
+    sweep (reference ThreadStateRegistry.java:33-66 +
+    SparkResourceAdaptorJni.cpp:1498-1500).
+
+    The detector's "all task threads blocked" predicate counts threads the
+    state machine sees as RUNNING but that are actually OS-blocked for
+    non-memory reasons (I/O, locks, pool waits) — without this, one task
+    thread stuck in a lock while holding reservations stalls BUFN/SPLIT
+    escalation forever.
+
+    Java reads Thread.getState(); CPython has no equivalent, so blockedness
+    is inferred from the thread's current innermost frame: well-known
+    blocking callables (lock/event waits, queue gets, selectors, socket
+    reads, sleeps) or frames inside the threading/queue/selectors modules.
+    A dead thread is blocked ("dead is as good as blocked", ref :46-48).
+    Unlike the reference, an *unknown* id reports NOT blocked: the facade
+    registers every thread it names (get_current_thread_id), so unknown ids
+    here are external drivers (tests, jvm_sim) whose escalation semantics
+    must not change underneath them.
+    """
+
+    _by_tid: Dict[int, "weakref.ref"] = {}
+    _lock = threading.Lock()
+
+    # Module-based detection only: blocking *C* primitives (lock.acquire,
+    # socket.recv, time.sleep) never appear as python frame names — the
+    # innermost python frame is their *caller* — so a bare-name list would
+    # only ever match ordinary running functions that happen to share a
+    # name ("get", "read", ...), i.e. pure false positives. The python-level
+    # blocking wrappers that DO frame (Event.wait, Condition.wait,
+    # Queue.get, selector loops, executor waits) all live in these modules.
+    _BLOCKING_MODULES = frozenset({
+        "threading", "queue", "selectors", "select", "socket",
+        "concurrent.futures._base", "concurrent.futures.thread",
+    })
+
+    @classmethod
+    def add_thread(cls, tid: int, thread: threading.Thread) -> None:
+        with cls._lock:
+            cls._by_tid[tid] = weakref.ref(thread)
+            # opportunistic prune: tids are never reused, so dead-thread
+            # entries would otherwise accumulate for the process lifetime
+            dead = [k for k, r in cls._by_tid.items() if r() is None]
+            for k in dead:
+                del cls._by_tid[k]
+
+    @classmethod
+    def remove_thread(cls, tid: int) -> None:
+        with cls._lock:
+            cls._by_tid.pop(tid, None)
+
+    @classmethod
+    def is_thread_blocked(cls, tid: int) -> bool:
+        with cls._lock:
+            ref = cls._by_tid.get(tid)
+        if ref is None:
+            return False  # unknown: external driver, stay out of its way
+        th = ref()
+        if th is None or not th.is_alive():
+            return True
+        import sys
+        frame = sys._current_frames().get(th.ident)
+        if frame is None:
+            return True
+        return frame.f_globals.get("__name__", "") in cls._BLOCKING_MODULES
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._by_tid.clear()
+
+
+# module-level so the callback object outlives any single adaptor and the
+# native side never holds a dangling function pointer
+_EXT_BLOCKED_CB = native.EXT_BLOCKED_CB(
+    lambda tid: 1 if ThreadStateRegistry.is_thread_blocked(int(tid)) else 0)
+
+
 class SparkResourceAdaptor:
     """Owns the native adaptor handle and the deadlock watchdog daemon.
 
@@ -86,6 +165,7 @@ class SparkResourceAdaptor:
         self._handle = self._lib.rm_create(pool_bytes, loc)
         if not self._handle:
             raise RuntimeError("failed to create native resource adaptor")
+        self._lib.rm_set_external_blocked_cb(self._handle, _EXT_BLOCKED_CB)
         self._closed = threading.Event()
         self._watchdog = threading.Thread(
             target=self._watch, args=(watchdog_period_s,),
@@ -228,6 +308,7 @@ class RmmSpark:
             cls._tid_counter += 1
             tid = cls._tid_counter
             cls._tid_map[ident] = (weakref.ref(cur), tid)
+            ThreadStateRegistry.add_thread(tid, cur)
             # Opportunistically drop entries whose threads died.
             dead = [k for k, (r, _) in cls._tid_map.items() if r() is None]
             for k in dead:

@@ -1,0 +1,232 @@
+"""Nested Parquet column reconstruction from raw def/rep level streams.
+
+The native decoder (native/parquet_decode.cpp, want_levels mode) hands back,
+per leaf: the decoded element-slot value buffers plus the raw per-entry
+(definition, repetition) level streams. This module rebuilds arbitrary
+STRUCT / LIST nesting — STRUCT<...>, LIST<LIST<...>>, LIST<STRUCT<...>>,
+STRUCT<LIST<...>>, MAP (as LIST<STRUCT<key, value>>) — with vectorized
+numpy passes (a few searchsorted/cumsum ops per nesting level, no per-row
+python loops), then wraps the results into device Columns.
+
+Reference capability: cudf's chunked Parquet reader decodes these schemas on
+GPU for the footer the reference prunes (NativeParquetJni.cpp:689,
+ParquetFooter.java:35-93 models the same trees). The level algebra below is
+the Dremel record-shredding inverse, implemented against the published
+Parquet format spec (no reference code involved).
+
+Schema-node facts used (walk_schema exports them per leaf as path_json):
+  * a REPEATED node at rep level r, def level d_rep starts a new element of
+    its list at every entry with rep == r; a parent slot's list is non-empty
+    iff the def at the slot's first entry >= d_rep
+  * an OPTIONAL node at def level d is present for a slot iff the def at the
+    slot's first entry >= d
+  * entries of empty/null slots sit between element starts and carry
+    def < d_rep, so they never match a deeper element-start mask — deeper
+    levels can ignore span ownership entirely
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.dtype import DType, TypeId
+
+REP_REQUIRED, REP_OPTIONAL, REP_REPEATED = 0, 1, 2
+_CONV_MAP, _CONV_MAP_KV, _CONV_LIST = 1, 2, 3
+
+
+@dataclass
+class PathNode:
+    name: str
+    repetition: int
+    def_: int
+    rep: int
+    converted: int
+
+
+def parse_path(path_json: str) -> List[PathNode]:
+    return [PathNode(n["name"], n["repetition"], n["def"], n["rep"],
+                     n["converted"]) for n in json.loads(path_json)]
+
+
+@dataclass
+class TreeNode:
+    """Schema tree node for one top-level column (groups + leaves)."""
+    node: PathNode
+    children: List["TreeNode"] = field(default_factory=list)
+    leaf_ids: List[int] = field(default_factory=list)  # leaves under subtree
+    leaf_id: Optional[int] = None  # set iff this is a leaf
+
+
+def build_tree(paths: Dict[int, List[PathNode]]) -> List[TreeNode]:
+    """Group per-leaf paths into the schema forest (root's children)."""
+    roots: List[TreeNode] = []
+
+    def place(into: List[TreeNode], leaf_id: int, nodes: List[PathNode]):
+        head = nodes[0]
+        for t in into:
+            if t.node.name == head.name and t.leaf_id is None:
+                break
+        else:
+            t = TreeNode(head)
+            into.append(t)
+        t.leaf_ids.append(leaf_id)
+        if len(nodes) == 1:
+            t.leaf_id = leaf_id
+        else:
+            place(t.children, leaf_id, nodes[1:])
+
+    for leaf_id, nodes in paths.items():
+        place(roots, leaf_id, nodes)
+    return roots
+
+
+@dataclass
+class LeafLevels:
+    """One leaf's decoded chunk in level-export mode."""
+    defs: np.ndarray           # int32[n_entries]
+    reps: np.ndarray           # int32[n_entries]
+    rows: int                  # element slots (= value rows)
+    values: np.ndarray         # raw value bytes (slot-indexed)
+    offsets: Optional[np.ndarray]   # BYTE_ARRAY only
+    validity: Optional[np.ndarray]  # uint8[rows], None = all valid
+    dtype: DType               # element dtype (primitive)
+    physical: int
+    max_def: int
+
+
+def _counts_between(positions: np.ndarray, starts: np.ndarray,
+                    total: int) -> np.ndarray:
+    """counts[k] = #positions in [starts[k], starts[k+1]) (last span ends at
+    total). positions and starts are sorted entry indices."""
+    bounds = np.append(starts, total)
+    return np.diff(np.searchsorted(positions, bounds))
+
+
+def _leaf_column(lv: LeafLevels, starts: np.ndarray) -> Column:
+    """Terminal: the decoded slot buffers are exactly the slots selected by
+    ``starts`` (the recursion consumed every repeated ancestor)."""
+    if len(starts) != lv.rows:
+        raise ValueError(
+            f"level reconstruction mismatch: {len(starts)} slots vs "
+            f"{lv.rows} decoded rows")
+    rows = lv.rows
+    vmask = None if lv.validity is None else jnp.asarray(
+        lv.validity.astype(bool))
+    d = lv.dtype
+    if d.id is TypeId.STRING:
+        data = jnp.asarray(lv.values) if lv.values.size else jnp.zeros(
+            (0,), dtype=jnp.uint8)
+        return Column(d, rows, data=data, validity=vmask,
+                      offsets=jnp.asarray(lv.offsets))
+    if d.id is TypeId.DECIMAL128:
+        limbs = lv.values.view(np.uint32).reshape(rows, 4)
+        return Column(d, rows, data=jnp.asarray(limbs), validity=vmask)
+    if d.id is TypeId.FLOAT64:
+        return Column(d, rows, data=jnp.asarray(lv.values.view(np.uint64)),
+                      validity=vmask)
+    return Column(d, rows, data=jnp.asarray(lv.values.view(d.np_dtype)),
+                  validity=vmask)
+
+
+def _slot_validity(defs: np.ndarray, starts: np.ndarray,
+                   d_present: int) -> Optional[np.ndarray]:
+    """bool[k]: slot's node present (def at slot start >= d_present)."""
+    v = defs[starts] >= d_present
+    return None if v.all() else v
+
+
+class _Assembler:
+    """Builds one top-level nested Column from its leaves' level streams."""
+
+    def __init__(self, levels: Dict[int, LeafLevels]):
+        self.levels = levels
+
+    def assemble(self, tree: TreeNode) -> Column:
+        # root slots: one per row (entries with rep == 0), per leaf
+        starts = {i: np.flatnonzero(self.levels[i].reps == 0)
+                  for i in tree.leaf_ids}
+        return self._build(tree, starts)
+
+    def _build(self, t: TreeNode, starts: Dict[int, np.ndarray]) -> Column:
+        node = t.node
+        if t.leaf_id is not None and node.repetition is not REP_REPEATED:
+            return self._terminal(t, starts)
+
+        if node.repetition == REP_REPEATED:
+            # bare repeated field (legacy 2-level / repeated primitive):
+            # the node itself is the repetition; no wrapper validity
+            return self._list_level(t, starts, d_valid=None)
+
+        if node.converted in (_CONV_LIST, _CONV_MAP) and len(t.children) == 1 \
+                and t.children[0].node.repetition == REP_REPEATED:
+            # annotated LIST/MAP wrapper group + its repeated child
+            return self._list_level(t.children[0], starts,
+                                    d_valid=node.def_
+                                    if node.repetition == REP_OPTIONAL
+                                    else None)
+
+        # plain STRUCT group
+        lv0 = self.levels[t.leaf_ids[0]]
+        s0 = starts[t.leaf_ids[0]]
+        vm = None
+        if node.repetition == REP_OPTIONAL:
+            vm = _slot_validity(lv0.defs, s0, node.def_)
+        children = [self._build(c, {i: starts[i] for i in c.leaf_ids})
+                    for c in t.children]
+        return Column.struct_of(
+            children, None if vm is None else jnp.asarray(vm))
+
+    def _list_level(self, rep_t: TreeNode, starts: Dict[int, np.ndarray],
+                    d_valid: Optional[int]) -> Column:
+        """One repetition level: rep_t.node is the REPEATED schema node."""
+        r = rep_t.node.rep
+        d_rep = rep_t.node.def_
+        new_starts: Dict[int, np.ndarray] = {}
+        offsets = validity = None
+        for i in rep_t.leaf_ids:
+            lv = self.levels[i]
+            s = starts[i]
+            # element starts: continuation entries (rep == r) plus each
+            # slot's first entry when its list is non-empty (def >= d_rep)
+            mask = lv.reps == r
+            mask[s] = lv.defs[s] >= d_rep
+            elems = np.flatnonzero(mask)
+            new_starts[i] = elems
+            if offsets is None:  # node-level output from the first leaf
+                counts = _counts_between(elems, s, len(lv.defs))
+                offsets = np.zeros(len(s) + 1, dtype=np.int32)
+                np.cumsum(counts, out=offsets[1:])
+                if d_valid is not None:
+                    validity = _slot_validity(lv.defs, s, d_valid)
+
+        # what hangs below the repeated node:
+        if rep_t.leaf_id is not None:
+            child = self._terminal(rep_t, new_starts)
+        elif len(rep_t.children) == 1:
+            child = self._build(rep_t.children[0], new_starts)
+        else:
+            # repeated group with several fields (MAP key_value, legacy
+            # repeated-struct): the elements form a required STRUCT
+            child = Column.struct_of(
+                [self._build(c, {i: new_starts[i] for i in c.leaf_ids})
+                 for c in rep_t.children])
+        return Column.list_of(
+            child, jnp.asarray(offsets),
+            None if validity is None else jnp.asarray(validity))
+
+    def _terminal(self, t: TreeNode, starts: Dict[int, np.ndarray]) -> Column:
+        return _leaf_column(self.levels[t.leaf_id], starts[t.leaf_id])
+
+
+def assemble_column(tree: TreeNode,
+                    levels: Dict[int, LeafLevels]) -> Column:
+    """Entry point: one top-level column tree + its leaves' levels."""
+    return _Assembler(levels).assemble(tree)

@@ -56,6 +56,7 @@ class _LeafC(ctypes.Structure):
         ("max_def", ctypes.c_int),
         ("max_rep", ctypes.c_int),
         ("rep_def", ctypes.c_int),
+        ("path_json", ctypes.c_char_p),
     ]
 
 
@@ -71,6 +72,9 @@ class _OutC(ctypes.Structure):
         ("list_validity", ctypes.POINTER(ctypes.c_uint8)),
         ("list_rows", ctypes.c_longlong),
         ("list_null_count", ctypes.c_longlong),
+        ("defs", ctypes.POINTER(ctypes.c_int32)),
+        ("reps", ctypes.POINTER(ctypes.c_int32)),
+        ("n_levels", ctypes.c_longlong),
     ]
 
 
@@ -104,6 +108,10 @@ def _load():
         lib.pqd_decode_chunk.argtypes = [
             c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_uint8), c.c_longlong,
             c.POINTER(_OutC), c.POINTER(c.c_char_p)]
+        lib.pqd_decode_chunk2.restype = c.c_int
+        lib.pqd_decode_chunk2.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_uint8), c.c_longlong,
+            c.c_int, c.POINTER(_OutC), c.POINTER(c.c_char_p)]
         lib.pqd_free_out.restype = None
         lib.pqd_free_out.argtypes = [c.POINTER(_OutC)]
         lib.pqd_free.restype = None
@@ -127,6 +135,21 @@ class LeafSchema:
     max_def: int
     max_rep: int
     elem_dtype: Optional[DType] = None
+    nodes: list = None   # root→leaf PathNodes (parquet/nested.py)
+
+
+@dataclass
+class ColumnPlan:
+    """One top-level output column: either the fast single-leaf path
+    ("simple": flat or one-level LIST, no level streams) or the nested
+    reconstruction path ("nested": STRUCT / multi-level LIST trees rebuilt
+    from raw def/rep levels — parquet/nested.py)."""
+
+    name: str
+    kind: str                    # "simple" | "nested"
+    leaves: List[LeafSchema]
+    tree: object = None          # TreeNode for nested
+    dtype: DType = None          # top-level dtype (LIST/STRUCT/primitive)
 
 
 def _map_dtype(physical: int, converted: int, scale: int,
@@ -217,21 +240,19 @@ class ParquetReader:
             raise RuntimeError(f"parquet open failed: {msg}")
         self._h = h
         self._leaves = self._read_schema()
+        self._plans = self._build_plans()
         if columns is not None:
-            by_name = {l.name: l for l in self._leaves}
+            by_name = {p.name: p for p in self._plans}
             missing = [c for c in columns if c not in by_name]
             if missing:
                 raise KeyError(f"columns not in file: {missing}")
-            self._selected = [by_name[c] for c in columns]
+            self._selected_plans = [by_name[c] for c in columns]
         else:
-            self._selected = list(self._leaves)
-        for leaf in self._selected:
-            if leaf.max_rep > 1:
-                raise ValueError(
-                    f"column {leaf.name!r} is nested beyond one LIST level; "
-                    "multi-level nested decode is not supported")
+            self._selected_plans = list(self._plans)
+        self._selected = [l for p in self._selected_plans for l in p.leaves]
 
     def _read_schema(self) -> List[LeafSchema]:
+        from .nested import parse_path
         out = []
         n = self._lib.pqd_num_leaves(self._h)
         for i in range(n):
@@ -240,6 +261,7 @@ class ParquetReader:
             if rc != 0:
                 raise RuntimeError(f"leaf_info({i}) failed")
             name = info.path.decode()
+            nodes = parse_path(info.path_json.decode())
             dtype = _map_dtype(info.physical, info.converted, info.scale,
                                info.precision)
             elem_dtype = None
@@ -255,13 +277,54 @@ class ParquetReader:
                                 else parts)
             out.append(LeafSchema(i, name, dtype, info.physical,
                                   info.type_length, info.max_def,
-                                  info.max_rep, elem_dtype))
+                                  info.max_rep, elem_dtype, nodes))
         return out
+
+    def _build_plans(self) -> List[ColumnPlan]:
+        """Group leaves into top-level column plans (simple vs nested)."""
+        from .nested import (REP_REPEATED, build_tree)
+        trees = build_tree({l.index: l.nodes for l in self._leaves})
+        by_id = {l.index: l for l in self._leaves}
+        plans = []
+        for tree in trees:
+            leaves = [by_id[i] for i in tree.leaf_ids]
+            name = tree.node.name
+            if len(leaves) == 1 and self._is_simple(leaves[0]):
+                leaf = leaves[0]
+                plans.append(ColumnPlan(name, "simple", [leaf],
+                                        dtype=leaf.dtype))
+            else:
+                top = (dt.LIST if tree.node.repetition == REP_REPEATED
+                       or tree.node.converted in (1, 3) else dt.STRUCT)
+                plans.append(ColumnPlan(name, "nested", leaves, tree=tree,
+                                        dtype=top))
+        return plans
+
+    @staticmethod
+    def _is_simple(leaf: LeafSchema) -> bool:
+        """Fast-path shapes the native decoder assembles itself: flat
+        primitives, and one-level LISTs of primitives (annotated 3-level,
+        legacy 2-level, bare repeated primitive)."""
+        from .nested import REP_REPEATED
+        nodes = leaf.nodes
+        if leaf.max_rep == 0:
+            return len(nodes) == 1
+        if leaf.max_rep != 1:
+            return False
+        if len(nodes) == 1:  # bare repeated primitive
+            return nodes[0].repetition == REP_REPEATED
+        if len(nodes) == 2:  # legacy 2-level: repeated group + leaf
+            return nodes[0].repetition == REP_REPEATED
+        if len(nodes) == 3:  # annotated: wrapper group + repeated + leaf
+            return (nodes[1].repetition == REP_REPEATED
+                    and (nodes[0].converted in (1, 3)
+                         or nodes[1].name in ("list", "array", "bag")))
+        return False
 
     # ---- info -------------------------------------------------------------
     @property
     def schema(self) -> List[Tuple[str, DType]]:
-        return [(l.name, l.dtype) for l in self._selected]
+        return [(p.name, p.dtype) for p in self._selected_plans]
 
     @property
     def num_row_groups(self) -> int:
@@ -288,18 +351,22 @@ class ParquetReader:
         return sum(self._chunk_range(rg, l.index)[1] for l in self._selected)
 
     # ---- decode -----------------------------------------------------------
-    def _decode_leaf(self, f, rg: int, leaf: LeafSchema):
-        """Decode one (row group, leaf) into host numpy buffers."""
+    def _decode_leaf(self, f, rg: int, leaf: LeafSchema,
+                     want_levels: bool = False):
+        """Decode one (row group, leaf) into host numpy buffers.
+
+        want_levels (nested plans): the tuple's ``lists`` slot instead
+        carries the raw (defs, reps) streams for tree reconstruction."""
         off, length, _, _ = self._chunk_range(rg, leaf.index)
         f.seek(off)
         raw = f.read(length)
         buf = np.frombuffer(raw, dtype=np.uint8)
         out = _OutC()
         err = ctypes.c_char_p()
-        rc = self._lib.pqd_decode_chunk(
+        rc = self._lib.pqd_decode_chunk2(
             self._h, rg, leaf.index,
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
-            ctypes.byref(out), ctypes.byref(err))
+            1 if want_levels else 0, ctypes.byref(out), ctypes.byref(err))
         if rc != 0:
             msg = err.value.decode() if err.value else "unknown error"
             self._lib.pqd_free(err)
@@ -317,7 +384,13 @@ class ParquetReader:
                 validity = np.ctypeslib.as_array(out.validity,
                                                  shape=(rows,)).copy()
             lists = None
-            if leaf.max_rep == 1:
+            if want_levels:
+                nl = out.n_levels
+                lists = (np.ctypeslib.as_array(out.defs, shape=(nl,)).copy()
+                         if nl else np.zeros(0, np.int32),
+                         np.ctypeslib.as_array(out.reps, shape=(nl,)).copy()
+                         if nl else np.zeros(0, np.int32))
+            elif leaf.max_rep == 1:
                 lrows = out.list_rows
                 loffs = np.ctypeslib.as_array(
                     out.list_offsets, shape=(lrows + 1,)).copy()
@@ -397,67 +470,109 @@ class ParquetReader:
                 rg += 1
             yield self._read_groups(group)
 
+    @staticmethod
+    def _part_nbytes(p) -> int:
+        n = p[1].nbytes
+        if p[2] is not None:
+            n += p[2].nbytes
+        if p[3] is not None:
+            n += p[3].nbytes
+        if p[4] is not None:
+            n += sum(x.nbytes for x in p[4] if isinstance(x, np.ndarray))
+        return n
+
     def _read_groups(self, groups: Sequence[int]) -> Table:
-        # Decode (leaf, row-group) chunks in parallel: the native decoder
-        # runs outside the GIL (ctypes releases it), so page decode scales
-        # with cores the way the reference's decode scales with SMs. A
-        # sliding window of at most `workers` in-flight leaves bounds host
-        # peak to ~workers leaves' decoded bytes (decoded size is NOT
-        # bounded by the compressed-byte chunk budget); each finished leaf
-        # ships under an exact HBM reservation and its host buffers are
-        # dropped before the next decode is admitted.
+        # Decode column plans in parallel: the native decoder runs outside
+        # the GIL (ctypes releases it), so page decode scales with cores the
+        # way the reference's decode scales with SMs. A sliding window of at
+        # most `workers` in-flight plans bounds host peak to ~workers plans'
+        # decoded bytes (decoded size is NOT bounded by the compressed-byte
+        # chunk budget); each finished plan ships under an exact HBM
+        # reservation and its host buffers are dropped before the next
+        # decode is admitted.
         from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, \
             wait
 
-        def decode_leaf(leaf):
+        def decode_plan(plan: ColumnPlan):
+            want = plan.kind == "nested"
             with open(self._path, "rb") as f:
-                return [self._decode_leaf(f, g, leaf) for g in groups]
+                return {leaf.index: [self._decode_leaf(f, g, leaf, want)
+                                     for g in groups]
+                        for leaf in plan.leaves}
 
-        def ship(leaf, parts):
-            est = sum(
-                p[1].nbytes
-                + (p[2].nbytes if p[2] is not None else 0)
-                + (p[3].nbytes if p[3] is not None else 0)
-                + ((p[4][1].nbytes
-                    + (p[4][2].nbytes if p[4][2] is not None else 0))
-                   if p[4] is not None else 0)
-                for p in parts)
+        def ship(plan: ColumnPlan, by_leaf):
+            est = sum(self._part_nbytes(p)
+                      for parts in by_leaf.values() for p in parts)
             with device_reservation(est) as took:
-                col = self._concat_parts(leaf, parts)
+                if plan.kind == "simple":
+                    leaf = plan.leaves[0]
+                    col = self._concat_parts(leaf, by_leaf[leaf.index])
+                else:
+                    col = self._assemble_nested(plan, by_leaf)
                 release_barrier(col, took)
             return col
 
-        n = len(self._selected)
-        workers = min(8, os.cpu_count() or 1, max(1, n))
+        from ..utils import config
+        n = len(self._selected_plans)
+        workers = int(config.get("parquet.decode_workers"))
+        if workers <= 0:
+            workers = min(8, os.cpu_count() or 1)
+        workers = min(workers, max(1, n))
         if workers <= 1 or n <= 1:
             return Table(tuple(
-                ship(leaf, decode_leaf(leaf)) for leaf in self._selected))
+                ship(p, decode_plan(p)) for p in self._selected_plans))
 
         cols: List[Optional[Column]] = [None] * n
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            pending = iter(enumerate(self._selected))
+            pending = iter(enumerate(self._selected_plans))
             futures = {}
 
             def admit():
                 try:
-                    i, leaf = next(pending)
+                    i, plan = next(pending)
                 except StopIteration:
                     return
-                futures[pool.submit(decode_leaf, leaf)] = (i, leaf)
+                futures[pool.submit(decode_plan, plan)] = (i, plan)
 
             for _ in range(workers):
                 admit()
             while futures:
                 done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
-                # ship every completed leaf (dropping its host buffers)
+                # ship every completed plan (dropping its host buffers)
                 # BEFORE admitting replacements, so resident decoded bytes
-                # never exceed ~workers leaves
+                # never exceed ~workers plans
                 for fut in done:
-                    i, leaf = futures.pop(fut)
-                    cols[i] = ship(leaf, fut.result())
+                    i, plan = futures.pop(fut)
+                    cols[i] = ship(plan, fut.result())
                 for _ in range(len(done)):
                     admit()
         return Table(tuple(cols))
+
+    def _assemble_nested(self, plan: ColumnPlan, by_leaf) -> Column:
+        """Concatenate each leaf's per-row-group level-mode parts, then
+        rebuild the nested column tree (parquet/nested.py)."""
+        from .nested import LeafLevels, assemble_column
+        levels = {}
+        for leaf in plan.leaves:
+            parts = by_leaf[leaf.index]
+            rows = sum(p[0] for p in parts)
+            values = np.concatenate([p[1] for p in parts])
+            offsets = None
+            if leaf.physical == _PT_BYTE_ARRAY:
+                offsets = self._rebase_offsets(parts, 0, 2)
+            validity = None
+            if any(p[3] is not None for p in parts):
+                validity = np.concatenate([
+                    p[3] if p[3] is not None
+                    else np.ones(p[0], dtype=np.uint8) for p in parts])
+            defs = np.concatenate([p[4][0] for p in parts])
+            reps = np.concatenate([p[4][1] for p in parts])
+            elem = (leaf.elem_dtype if leaf.max_rep == 1 and
+                    leaf.elem_dtype is not None else leaf.dtype)
+            levels[leaf.index] = LeafLevels(
+                defs, reps, rows, values, offsets, validity, elem,
+                leaf.physical, leaf.max_def)
+        return assemble_column(plan.tree, levels)
 
     @staticmethod
     def _rebase_offsets(parts, rows_i, offs_i):

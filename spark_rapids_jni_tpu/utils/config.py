@@ -59,6 +59,9 @@ _register("rmm.pool_bytes", "SRJT_RMM_POOL_BYTES", 0, int,
           "default HBM reservation pool size; 0 = caller must pass one")
 _register("parquet.chunk_byte_budget", "SRJT_PARQUET_CHUNK_BYTES", 128 << 20,
           int, "row-group batching budget for the chunked reader")
+_register("parquet.decode_workers", "SRJT_PARQUET_DECODE_WORKERS", 0, int,
+          "column-decode thread count (GIL-free native decode); "
+          "0 = min(8, cpu count)")
 _register("native.so_override", "SRJT_NATIVE_SO_OVERRIDE", "", str,
           "load a prebuilt resource-adaptor .so instead of building "
           "(sanitizer tier, ci/sanitize.sh)")

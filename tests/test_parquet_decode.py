@@ -322,10 +322,95 @@ def test_list_columns_roundtrip(tmp_path):
             [t.column(i).to_pylist() for i in range(3)]
 
 
-def test_list_multilevel_rejected(tmp_path):
-    t = pa.table({"ll": pa.array([[[1, 2]], [[3]]],
-                                 type=pa.list_(pa.list_(pa.int64())))})
-    path = str(tmp_path / "ll.parquet")
-    pq.write_table(t, path)
-    with pytest.raises(ValueError, match="beyond one LIST level"):
-        ParquetReader(path)
+def _norm(v):
+    """Arrow pylist → engine pylist shape (dicts become tuples)."""
+    if isinstance(v, dict):
+        return tuple(_norm(x) for x in v.values())
+    if isinstance(v, list):
+        return [_norm(x) for x in v]
+    return v
+
+
+def _rand_nested_rows(rng, n):
+    def maybe(p, f):
+        return None if rng.random() < p else f()
+
+    def ints(k=4):
+        return [maybe(0.2, lambda: int(rng.integers(-1000, 1000)))
+                for _ in range(rng.integers(0, k))]
+
+    struct = [maybe(0.15, lambda: {"x": maybe(0.2, lambda: int(
+        rng.integers(0, 99))), "y": maybe(0.2, lambda: f"s{i}")})
+        for i in range(n)]
+    ll = [maybe(0.15, lambda: [maybe(0.1, ints)
+                               for _ in range(rng.integers(0, 3))])
+          for _ in range(n)]
+    ls = [maybe(0.15, lambda: [maybe(0.2, lambda: {
+        "a": maybe(0.2, lambda: float(rng.standard_normal())),
+        "b": maybe(0.2, lambda: f"v{int(rng.integers(0, 50))}")})
+        for _ in range(rng.integers(0, 3))]) for _ in range(n)]
+    sl = [maybe(0.15, lambda: {"v": maybe(0.2, ints),
+                               "w": maybe(0.2, lambda: int(
+                                   rng.integers(0, 9)))})
+          for _ in range(n)]
+    m = [maybe(0.15, lambda: {f"k{j}": maybe(0.2, lambda: f"x{j}")
+                              for j in range(rng.integers(0, 3))})
+         for _ in range(n)]
+    return struct, ll, ls, sl, m
+
+
+def _nested_table(n=600, seed=7):
+    rng = np.random.default_rng(seed)
+    struct, ll, ls, sl, m = _rand_nested_rows(rng, n)
+    return pa.table({
+        "s": pa.array(struct, type=pa.struct(
+            [("x", pa.int64()), ("y", pa.string())])),
+        "ll": pa.array(ll, type=pa.list_(pa.list_(pa.int64()))),
+        "ls": pa.array(ls, type=pa.list_(pa.struct(
+            [("a", pa.float64()), ("b", pa.string())]))),
+        "sl": pa.array(sl, type=pa.struct(
+            [("v", pa.list_(pa.int64())), ("w", pa.int32())])),
+        "m": pa.array(m, type=pa.map_(pa.string(), pa.string())),
+        "flat": pa.array(np.arange(n)),
+    })
+
+
+@pytest.mark.parametrize("compression", ["snappy", "none"])
+def test_nested_struct_list_decode(tmp_path, compression):
+    """STRUCT, LIST<LIST>, LIST<STRUCT>, STRUCT<LIST>, MAP — rebuilt from
+    raw def/rep streams (round-2 verdict gap #3); nulls at every level,
+    multiple row groups, validated against pyarrow."""
+    t = _nested_table()
+    path = str(tmp_path / f"nested_{compression}.parquet")
+    pq.write_table(t, path, compression=compression, row_group_size=100)
+    out = read_parquet(path)
+    assert out.num_columns == 6
+    for i, name in enumerate(t.column_names):
+        got = out[i].to_pylist()
+        want = [_norm(v) for v in t.column(name).to_pylist()]
+        assert got == want, name
+
+
+def test_nested_projection_and_chunking(tmp_path):
+    t = _nested_table(300, seed=11)
+    path = str(tmp_path / "nested_proj.parquet")
+    pq.write_table(t, path, row_group_size=64)
+    out = read_parquet(path, columns=["ll", "flat"])
+    assert out.num_columns == 2
+    assert out[0].to_pylist() == [_norm(v)
+                                  for v in t.column("ll").to_pylist()]
+    with ParquetReader(path, columns=["s"]) as r:
+        rows = 0
+        for chunk in r.iter_chunks(byte_budget=1):  # one row group per chunk
+            rows += chunk.num_rows
+        assert rows == 300
+
+
+def test_nested_data_page_v2(tmp_path):
+    t = _nested_table(200, seed=13)
+    path = str(tmp_path / "nested_v2.parquet")
+    pq.write_table(t, path, data_page_version="2.0")
+    out = read_parquet(path)
+    for i, name in enumerate(t.column_names):
+        assert out[i].to_pylist() == [_norm(v)
+                                      for v in t.column(name).to_pylist()]
