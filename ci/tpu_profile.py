@@ -1,0 +1,58 @@
+"""On-chip trace capture for the hot paths (docs/TPU_PERF.md §3).
+
+Wraps the row-conversion / join / groupby / hash benchmark bodies in
+``jax.profiler.trace`` so xprof shows the fusion boundaries on the real
+backend. Usage:
+
+    python ci/tpu_profile.py [trace_dir] [rows]
+
+Writes one trace session under ``trace_dir`` (default /tmp/srjt_trace);
+inspect with ``tensorboard --logdir <trace_dir>`` (xprof plugin) or the
+trace viewer. Falls back to CPU via bench.py's wedge-resilient probe, so
+the script is runnable (and produces a trace) on any backend.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/srjt_trace"
+    rows = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 20
+
+    import bench
+    bench._ensure_backend()
+    import jax
+
+    from benchmarks import bench_ops as B
+    B._refresh_variants()
+
+    backend = jax.devices()[0].platform
+    print(f"profile: backend={backend} rows={rows} -> {trace_dir}",
+          file=sys.stderr)
+
+    axes = [
+        ("row_conversion_fixed", lambda: B.bench_row_conversion(rows, False)),
+        ("row_conversion_strings", lambda: B.bench_row_conversion(rows, True)),
+        ("join", lambda: B.bench_join(rows)),
+        ("groupby", lambda: B.bench_groupby(rows)),
+        ("hash_headline", bench._headline),
+    ]
+    results = {}
+    with jax.profiler.trace(trace_dir):
+        for name, fn in axes:
+            t0 = time.perf_counter()
+            try:
+                fn()
+                results[name] = round(time.perf_counter() - t0, 3)
+            except Exception as e:
+                results[name] = f"FAILED: {e}"
+            print(f"profile: {name}: {results[name]}", file=sys.stderr)
+    print({"backend": backend, "trace_dir": trace_dir, "axes": results})
+
+
+if __name__ == "__main__":
+    main()

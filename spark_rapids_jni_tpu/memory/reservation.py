@@ -24,6 +24,7 @@ from contextlib import contextmanager
 
 import jax
 
+from . import hbm
 from .rmm_spark import RmmSpark, ThreadState
 
 # Per-thread depth: a reservation taken inside another reservation's bracket
@@ -58,11 +59,18 @@ def device_reservation(nbytes: int):
             _tls.depth = depth
         return
     RmmSpark.alloc(nbytes)
+    # optional real-HBM audit (rmm.validate_hbm): sample the PJRT
+    # allocator's counters around the bracket — see memory/hbm.py
+    mark = None
+    if hbm.enabled():
+        mark = hbm.bracket_begin()
     _tls.depth = depth + 1
     try:
         yield True
     finally:
         _tls.depth = depth
+        if mark is not None:
+            hbm.bracket_end(mark, nbytes)
         RmmSpark.dealloc(nbytes)
 
 

@@ -302,44 +302,71 @@ def xxhash64_fixed_rows(lanes: Sequence[jnp.ndarray],
     return _xxhash64_fixed_fn(schema, seed, interpret)(tuple(lanes), n=n)
 
 
-def pallas_mode() -> str:
-    """Resolved hashing.pallas config: 'on' | 'off' | 'auto'."""
+def pallas_mode(config_key: str = "hashing.pallas") -> str:
+    """Resolved route config: 'on' | 'off' | 'auto'."""
     from ..utils import config
-    return str(config.get("hashing.pallas")).lower()
+    return str(config.get(config_key)).lower()
 
 
-# Set on the first kernel failure (e.g. a Mosaic lowering this jax/libtpu
-# build rejects): 'auto' sessions fall back to the XLA path permanently
-# rather than failing every subsequent hash/join. "on" mode is unaffected —
-# it always routes and surfaces the real error (tests want it).
-_runtime_disabled = False
-# Until one kernel run completes on this backend, block inside the fallback
-# guard: jax dispatch is async, so an execute-time failure would otherwise
-# surface at the caller's materialization, outside the try. After the first
-# success the backend is proven and the sync tax stops.
-_validated = False
+# Per-route state, keyed by config flag ("hashing.pallas",
+# "rowconv.pallas"):
+#  * disabled — set on the first kernel failure (e.g. a Mosaic lowering this
+#    jax/libtpu build rejects): that route's 'auto' sessions fall back to
+#    XLA permanently rather than failing every call. "on" mode is
+#    unaffected — it always routes and surfaces the real error (tests).
+#  * validated — until one of the route's kernels completes on this
+#    backend, block inside the fallback guard: jax dispatch is async, so an
+#    execute-time failure would otherwise surface at the caller's
+#    materialization, outside the try. Validation is per route: a working
+#    hash kernel proves nothing about the rowconv kernel.
+_route_state: dict = {}
 
 
-def run_with_fallback(fn, *args, **kwargs):
-    """Run a pallas entry point; on failure in 'auto' mode, disable the
+def _state(config_key: str) -> dict:
+    return _route_state.setdefault(config_key,
+                                   {"disabled": False, "validated": False})
+
+
+def run_with_fallback(fn, *args, config_key: str = "hashing.pallas",
+                      **kwargs):
+    """Run a pallas entry point; on failure in 'auto' mode, disable that
     route for this session and signal the caller to use the XLA path by
     returning None."""
-    global _runtime_disabled, _validated
+    st = _state(config_key)
     try:
         out = fn(*args, **kwargs)
-        if not _validated:
+        if not st["validated"]:
             jax.block_until_ready(out)
-            _validated = True
+            st["validated"] = True
         return out
     except Exception:
-        if pallas_mode() == "on":
+        if pallas_mode(config_key) == "on":
             raise
         import warnings
-        warnings.warn("pallas kernel failed to compile/run on this backend; "
-                      "falling back to the XLA hash path for this session",
-                      RuntimeWarning)
-        _runtime_disabled = True
+        warnings.warn(f"pallas kernel ({config_key}) failed to compile/run "
+                      "on this backend; falling back to the XLA path for "
+                      "this session", RuntimeWarning)
+        st["disabled"] = True
         return None
+
+
+def pallas_gate(config_key: str) -> Optional[bool]:
+    """Shared route policy: None = use the XLA path, else the `interpret`
+    flag for a pallas call. One definition so every route validates its
+    mode string, honors its own disabled state, and applies the same
+    backend allowlist."""
+    mode = pallas_mode(config_key)
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{config_key} must be auto|on|off, got {mode!r}")
+    if mode == "off" or (mode == "auto" and _state(config_key)["disabled"]):
+        return None
+    backend = jax.default_backend()
+    if mode == "auto" and backend not in ("tpu", "axon"):
+        # interpreted pallas (cpu) is slower than the fused XLA chain, and
+        # these (16,128) uint32 tilings are TPU-specific — don't auto-route
+        # other accelerators onto them
+        return None
+    return backend == "cpu"
 
 
 def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
@@ -350,18 +377,9 @@ def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
     from ..columnar.dtype import TypeId
     from . import hashing as H
 
-    mode = pallas_mode()
-    if mode not in ("auto", "on", "off"):
-        raise ValueError(f"hashing.pallas must be auto|on|off, got {mode!r}")
-    if mode == "off" or n == 0 or (mode == "auto" and _runtime_disabled):
+    interpret = pallas_gate("hashing.pallas")
+    if interpret is None or n == 0:
         return None
-    backend = jax.default_backend()
-    if mode == "auto" and backend not in ("tpu", "axon"):
-        # interpreted pallas (cpu) is slower than the fused XLA chain, and
-        # this kernel's (16,128) uint32 tiling is TPU-specific — don't
-        # auto-route other accelerators onto it
-        return None
-    interpret = backend == "cpu"
 
     lanes: List[jnp.ndarray] = []
     schema: List[Tuple[str, bool]] = []
@@ -380,3 +398,61 @@ def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:
             lanes.append(u.valid.astype(jnp.uint32))
         schema.append((kind, has_mask))
     return [lanes, tuple(schema), interpret]
+
+
+# ---------------------------------------------------------------------------
+# JCUDF fixed-region word assembly (ops/row_conversion)
+# ---------------------------------------------------------------------------
+
+def build_rowconv_fixed_kernel(plan: Tuple[Tuple[int, int], ...],
+                               n_words: int):
+    """Kernel assembling the JCUDF fixed+validity region: input lane i ORs
+    into output word ``plan[i][0]`` shifted left ``plan[i][1]`` bits.
+
+    The XLA path (_build_fixed_words) emits the same OR chains and relies on
+    fusion; this kernel pins the whole assembly in VMEM — one streamed read
+    per input lane, one write per output word lane, zero intermediate
+    materialization risk (reference bar: row_conversion.cu:574's shared-mem
+    tile transpose). Pure uint32 VPU shifts/ORs, no MXU.
+    """
+    def kernel(*refs):
+        ins, outs = refs[:len(plan)], refs[len(plan):]
+        acc = {}
+        for (w, sh), r in zip(plan, ins):
+            v = r[...]
+            if sh:
+                v = v << np.uint32(sh)
+            acc[w] = v if w not in acc else acc[w] | v
+        zero = jnp.zeros((_SUB, _LANE), dtype=jnp.uint32)
+        for w in range(n_words):
+            outs[w][...] = acc.get(w, zero)
+
+    return kernel
+
+
+@lru_cache(maxsize=64)
+def _rowconv_fixed_fn(plan: Tuple[Tuple[int, int], ...], n_words: int,
+                      interpret: bool):
+    kernel = build_rowconv_fixed_kernel(plan, n_words)
+
+    @partial(jax.jit, static_argnames=("n",))
+    def run(lanes, *, n):
+        outs = _tiled_lane_call(kernel, lanes, n, n_words, interpret)
+        return jnp.stack(outs, axis=1)
+
+    return run
+
+
+def rowconv_fixed_words(lanes: Sequence[jnp.ndarray],
+                        plan: Tuple[Tuple[int, int], ...], n_words: int,
+                        n: int, interpret: bool = False) -> jnp.ndarray:
+    """uint32[n, n_words] JCUDF words from uint32 input lanes + OR plan."""
+    return _rowconv_fixed_fn(tuple(plan), n_words, interpret)(
+        tuple(lanes), n=n)
+
+
+def rowconv_pallas_interpret() -> Optional[bool]:
+    """Config/backend gate for the row-conversion kernel: None = use the
+    XLA path, else the `interpret` flag for the pallas route
+    (shared policy: pallas_gate)."""
+    return pallas_gate("rowconv.pallas")

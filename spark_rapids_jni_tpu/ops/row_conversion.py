@@ -145,17 +145,41 @@ def _words_to_column(words: jnp.ndarray, word0: int, byte_off: int, d: DType,
     return Column(d, n, data=data, validity=validity)
 
 
-def _pack_validity_words(valid: jnp.ndarray) -> jnp.ndarray:
-    """bool[n, ncols] -> uint32[n, ceil(ncols/8)] of *byte values* (bit c%8 of
-    byte c/8, JCUDF convention) kept in 32-bit lanes for shift/or packing."""
-    n, ncols = valid.shape
-    nbytes = (ncols + 7) // 8
-    v = valid.astype(jnp.uint32)
-    if nbytes * 8 != ncols:
-        v = jnp.pad(v, ((0, 0), (0, nbytes * 8 - ncols)))
-    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))
-    return jnp.sum(v.reshape(n, nbytes, 8) * weights[None, None, :],
-                   axis=2, dtype=jnp.uint32)
+def _word_plan(table: Table, info: ColumnInfo,
+               var_offsets: Optional[jnp.ndarray],
+               var_lengths: Optional[jnp.ndarray]):
+    """(lanes, plan): uint32[n] input lanes and, per lane, the (word, shift)
+    it ORs into in the JCUDF fixed+validity region. One plan drives both
+    executors — the XLA OR-chain and the pallas VMEM kernel
+    (ops/pallas_kernels.build_rowconv_fixed_kernel)."""
+    lanes: List[jnp.ndarray] = []
+    plan: List[tuple] = []
+
+    def put(lane, word: int, shift: int = 0) -> None:
+        lanes.append(lane.astype(jnp.uint32))
+        plan.append((word, shift))
+
+    var_idx = 0
+    for c, col in enumerate(table):
+        o = info.column_starts[c]
+        if col.dtype.id is TypeId.STRING:
+            put(var_offsets[:, var_idx], o // 4)
+            put(var_lengths[:, var_idx], o // 4 + 1)
+            var_idx += 1
+            continue
+        words = _column_words(col)
+        if info.column_sizes[c] >= 4:  # o is word-aligned (alignment=size)
+            for j, w in enumerate(words):
+                put(w, o // 4 + j)
+        else:
+            put(words[0], o // 4, 8 * (o % 4))
+
+    # validity: column c is bit c%8 of byte validity_offset + c//8
+    # (JCUDF convention) — each column's mask is one lane ORed at its bit
+    for c, col in enumerate(table):
+        bo = info.validity_offset + c // 8
+        put(col.valid_mask(), bo // 4, 8 * (bo % 4) + (c % 8))
+    return lanes, plan
 
 
 def _build_fixed_words(table: Table, info: ColumnInfo, row_size: int,
@@ -166,37 +190,28 @@ def _build_fixed_words(table: Table, info: ColumnInfo, row_size: int,
     row_size must be a multiple of 4 and >= info.size_per_row; the tail
     (padding and any bytes past size_per_row) is zero. var_offsets /
     var_lengths: int32[n, n_string_cols] row-relative offsets and lengths for
-    STRING columns (None when the table is all fixed-width)."""
+    STRING columns (None when the table is all fixed-width).
+
+    Routed to the pallas VMEM word-assembly kernel when the
+    ``rowconv.pallas`` config and backend allow; the fused-XLA OR chain is
+    the fallback and the oracle."""
     n = table.num_rows
     nwords = row_size // 4
+    lanes, plan = _word_plan(table, info, var_offsets, var_lengths)
+
+    from . import pallas_kernels as PK
+    interpret = PK.rowconv_pallas_interpret()
+    if interpret is not None and n > 0:
+        out = PK.run_with_fallback(PK.rowconv_fixed_words, lanes,
+                                   tuple(plan), nwords, n, interpret,
+                                   config_key="rowconv.pallas")
+        if out is not None:
+            return out
+
     acc: dict = {}
-
-    def _or(w: int, expr: jnp.ndarray) -> None:
-        acc[w] = expr if w not in acc else acc[w] | expr
-
-    var_idx = 0
-    for c, col in enumerate(table):
-        o = info.column_starts[c]
-        if col.dtype.id is TypeId.STRING:
-            _or(o // 4, var_offsets[:, var_idx].astype(jnp.uint32))
-            _or(o // 4 + 1, var_lengths[:, var_idx].astype(jnp.uint32))
-            var_idx += 1
-            continue
-        words = _column_words(col)
-        if info.column_sizes[c] >= 4:  # o is word-aligned (alignment=size)
-            for j, w in enumerate(words):
-                _or(o // 4 + j, w)
-        else:
-            sh = 8 * (o % 4)
-            _or(o // 4, words[0] << np.uint32(sh) if sh else words[0])
-
-    valid = jnp.stack([c.valid_mask() for c in table], axis=1)
-    vbytes = _pack_validity_words(valid)
-    for k in range(vbytes.shape[1]):
-        bo = info.validity_offset + k
-        sh = 8 * (bo % 4)
-        _or(bo // 4, vbytes[:, k] << np.uint32(sh) if sh else vbytes[:, k])
-
+    for (w, sh), lane in zip(plan, lanes):
+        v = lane << np.uint32(sh) if sh else lane
+        acc[w] = v if w not in acc else acc[w] | v
     zero = jnp.zeros((n,), dtype=jnp.uint32)
     return jnp.stack([acc.get(w, zero) for w in range(nwords)], axis=1)
 

@@ -57,6 +57,9 @@ _register("rmm.watchdog_period_s", "SRJT_RMM_WATCHDOG_PERIOD_S", 0.1, float,
           "(ref: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod, 100ms)")
 _register("rmm.pool_bytes", "SRJT_RMM_POOL_BYTES", 0, int,
           "default HBM reservation pool size; 0 = caller must pass one")
+_register("rmm.validate_hbm", "SRJT_RMM_VALIDATE_HBM", False, _parse_bool,
+          "audit taken reservations against the PJRT allocator's real "
+          "bytes_in_use/peak counters (memory/hbm.py report)")
 _register("parquet.chunk_byte_budget", "SRJT_PARQUET_CHUNK_BYTES", 128 << 20,
           int, "row-group batching budget for the chunked reader")
 _register("parquet.decode_workers", "SRJT_PARQUET_DECODE_WORKERS", 0, int,
@@ -72,6 +75,9 @@ _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "elision")
 _register("hashing.pallas", "SRJT_HASH_PALLAS", "auto", str,
           "murmur3 fixed-width row hash via the pallas VMEM kernel: "
+          "auto (accelerator only) | on (interpreted on CPU; tests) | off")
+_register("rowconv.pallas", "SRJT_ROWCONV_PALLAS", "auto", str,
+          "JCUDF fixed-region word assembly via the pallas VMEM kernel: "
           "auto (accelerator only) | on (interpreted on CPU; tests) | off")
 
 

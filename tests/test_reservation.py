@@ -225,3 +225,24 @@ def test_externally_blocked_thread_does_not_stall_escalation(adaptor):
     assert not ta.is_alive()
     RmmSpark.task_done(1)
     RmmSpark.task_done(2)
+
+
+def test_hbm_audit_brackets_counted(adaptor):
+    """rmm.validate_hbm wires the bracket audit (memory/hbm.py); on CPU the
+    PJRT counters are unavailable so validated stays 0, but brackets must
+    be counted and the bracket must still release cleanly."""
+    from spark_rapids_jni_tpu.memory import hbm
+    from spark_rapids_jni_tpu.utils import config
+
+    hbm.reset()
+    with config.override("rmm.validate_hbm", True):
+        RmmSpark.current_thread_is_dedicated_to_task(77)
+        try:
+            t = _table(50000)
+            groupby_aggregate(t, [0], [(1, "sum")])
+        finally:
+            RmmSpark.remove_current_thread_association()
+            RmmSpark.task_done(77)
+    rep = hbm.report()
+    assert rep["brackets"] > 0
+    assert RmmSpark.pool_used() == 0
