@@ -42,6 +42,7 @@ def main():
         ("hash_headline", bench._headline),
     ]
     results = {}
+    failed = 0
     with jax.profiler.trace(trace_dir):
         for name, fn in axes:
             t0 = time.perf_counter()
@@ -49,10 +50,12 @@ def main():
                 fn()
                 results[name] = round(time.perf_counter() - t0, 3)
             except Exception as e:
+                failed += 1
                 results[name] = f"FAILED: {e}"
             print(f"profile: {name}: {results[name]}", file=sys.stderr)
     print({"backend": backend, "trace_dir": trace_dir, "axes": results})
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -84,6 +84,10 @@ def bracket_end(mark: tuple, reserved: int) -> None:
         _stats["validated"] += 1
         if observed > reserved:
             _stats["underestimates"] += 1
+        if observed == 0 and reserved == 0:
+            return  # nothing reserved, nothing observed: not a signal
+        # ratio inf only for the genuine worst case (growth against a
+        # zero reservation); zero-growth brackets rank at the bottom
         ratio = observed / reserved if reserved else float("inf")
         _stats["worst"].append((observed, reserved, round(ratio, 3)))
         _stats["worst"].sort(key=lambda t: -t[2])

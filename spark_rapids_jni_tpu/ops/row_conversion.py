@@ -174,11 +174,19 @@ def _word_plan(table: Table, info: ColumnInfo,
         else:
             put(words[0], o // 4, 8 * (o % 4))
 
-    # validity: column c is bit c%8 of byte validity_offset + c//8
-    # (JCUDF convention) — each column's mask is one lane ORed at its bit
-    for c, col in enumerate(table):
-        bo = info.validity_offset + c // 8
-        put(col.valid_mask(), bo // 4, 8 * (bo % 4) + (c % 8))
+    # validity: column c is bit c%8 of byte validity_offset + c//8 (JCUDF
+    # convention). Pack 8 masks per byte lane host-side (cheap XLA
+    # elementwise) so wide schemas feed ceil(ncols/8) lanes to the kernel,
+    # not ncols.
+    ncols = table.num_columns
+    for b in range((ncols + 7) // 8):
+        lane = None
+        for c in range(8 * b, min(8 * b + 8, ncols)):
+            v = table.columns[c].valid_mask().astype(jnp.uint32)
+            v = v << np.uint32(c % 8) if c % 8 else v
+            lane = v if lane is None else lane | v
+        bo = info.validity_offset + b
+        put(lane, bo // 4, 8 * (bo % 4))
     return lanes, plan
 
 
