@@ -198,8 +198,10 @@ def _sweep(deadline):
         ("bloom_filter_1m", lambda: B.bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: B.bench_cast_string_to_float(500_000), 500_000),
         ("parse_uri_200k", lambda: B.bench_parse_uri(200_000), 200_000),
+        ("tpch_q1_1m", lambda: B.bench_tpch_q1(1 << 20), 1 << 20),
         ("tpch_q3_1m", lambda: B.bench_tpch_q3(1 << 20), 1 << 20),
         ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
+        ("tpch_q6_1m", lambda: B.bench_tpch_q6(1 << 20), 1 << 20),
         ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
         ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
     ]

@@ -243,6 +243,37 @@ def bench_tpch_q3(rows: int, mesh_devices: int = 0):
     return sec, nbytes
 
 
+def bench_tpch_q1(rows: int, mesh_devices: int = 0):
+    """TPC-H q1 pricing-summary pipeline (filter + 8-agg groupby + sort)
+    at `rows` lineitem rows; pipeline in benchmarks/tpch.py, oracle-tested."""
+    from benchmarks.tpch import generate_q1_lineitem, run_q1
+
+    mesh = _query_mesh(mesh_devices)
+    datasets = [generate_q1_lineitem(rows, seed=s)
+                for s in range(_NVARIANTS)]
+
+    def run(i):
+        out = run_q1(datasets[i % _NVARIANTS], mesh=mesh)
+        return [c.data for c in out.columns]
+
+    sec = _time(run, warmup=_NVARIANTS)
+    # q1 touches the full lineitem row: 2 int64 + 5 int32 per row
+    return sec, rows * (2 * 8 + 5 * 4)
+
+
+def bench_tpch_q6(rows: int, mesh_devices: int = 0):
+    """TPC-H q6 forecast-revenue pipeline (multi-predicate filter + sum)."""
+    from benchmarks.tpch import generate_q1_lineitem, run_q6
+
+    mesh = _query_mesh(mesh_devices)
+    datasets = [generate_q1_lineitem(rows, seed=s)
+                for s in range(_NVARIANTS)]
+    sec = _time(lambda i: run_q6(datasets[i % _NVARIANTS], mesh=mesh),
+                warmup=_NVARIANTS)
+    # q6 touches qty i64 + price i64 + disc i32 + shipdate i32
+    return sec, rows * (2 * 8 + 2 * 4)
+
+
 def bench_tpch_q5(rows: int, mesh_devices: int = 0):
     """BASELINE configs[2]-shaped: the TPC-H q5 operator pipeline — four
     joins, a co-nation predicate, groupby-sum per nation, sort. Pipeline in
@@ -358,7 +389,8 @@ def main():
     ap.add_argument("--bench", default="all",
                     choices=["all", "row_conversion", "bloom_filter",
                              "cast_string_to_float", "parse_uri", "groupby",
-                             "join", "sort", "tpch_q3", "tpch_q5",
+                             "join", "sort", "tpch_q1", "tpch_q3",
+                             "tpch_q5", "tpch_q6",
                              "get_json_object", "from_json",
                              "parquet_decode"])
     args = ap.parse_args()
@@ -394,6 +426,11 @@ def main():
     if args.bench in ("all", "sort"):
         runs.append(("sort", "int64", args.rows,
                      lambda: bench_sort(args.rows)))
+    if args.bench in ("all", "tpch_q1"):
+        cfg = ("filter+8agg-groupby+sort" if not args.mesh
+               else f"distributed mesh={args.mesh}")
+        runs.append(("tpch_q1", cfg, args.rows,
+                     lambda: bench_tpch_q1(args.rows, args.mesh)))
     if args.bench in ("all", "tpch_q3"):
         cfg = ("filter+2join+groupby+sort" if not args.mesh
                else f"distributed mesh={args.mesh}")
@@ -404,6 +441,11 @@ def main():
                else f"distributed mesh={args.mesh}")
         runs.append(("tpch_q5", cfg, args.rows,
                      lambda: bench_tpch_q5(args.rows, args.mesh)))
+    if args.bench in ("all", "tpch_q6"):
+        cfg = ("multi-predicate filter+sum" if not args.mesh
+               else f"distributed mesh={args.mesh}")
+        runs.append(("tpch_q6", cfg, args.rows,
+                     lambda: bench_tpch_q6(args.rows, args.mesh)))
     if args.bench in ("all", "get_json_object"):
         jrows = min(args.rows, 500_000)
         runs.append(("get_json_object", "native host tier", jrows,

@@ -194,3 +194,90 @@ def run_q3(cust: Table, orders: Table, lineitem: Table,
     g = group(gt, [0, 1, 2], [(3, "sum")])
     top = sort_table(g, [3, 1], ascending=[False, True])
     return slice_table(top, 0, min(top_k, g.num_rows))
+
+
+def generate_q1_lineitem(rows: int, seed: int) -> Table:
+    """lineitem for q1/q6: (l_quantity i64, l_extendedprice-cents i64,
+    l_discount-pct i32, l_tax-pct i32, l_returnflag-code i32,
+    l_linestatus-code i32, l_shipdate-days i32)."""
+    rng = np.random.default_rng(seed)
+    return Table((
+        Column.from_numpy(rng.integers(1, 51, rows), dt.INT64),
+        Column.from_numpy(rng.integers(90000, 10500000, rows), dt.INT64),
+        Column.from_numpy(rng.integers(0, 11, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(0, 9, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(0, 3, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(0, 2, rows).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.integers(0, 2500, rows).astype(np.int32),
+                          dt.INT32),
+    ))
+
+
+def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
+    """TPC-H q1 shape: pricing summary report. Filter shipdate <= cutoff,
+    group by (returnflag, linestatus): sum qty, sum base price, sum
+    discounted price, sum charge, avg qty, avg price, avg discount, count.
+    Money/derived sums stay in exact int64 (cents × pct scales); averages
+    are FLOAT64. Sorted by the two group keys.
+
+    Reference-role note: the reference library supplies the kernels for
+    this composition (groupby/sort via its vendored layer); the pipeline
+    itself exercises BASELINE configs[1]-style aggregation at q1's shape.
+    """
+    if mesh is not None:
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_groupby)
+        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+    else:
+        group = groupby_aggregate
+    li = filter_table(lineitem, lineitem.columns[6].data <= cutoff)
+    qty = li.columns[0].data.astype(jnp.int64)
+    price = li.columns[1].data.astype(jnp.int64)
+    disc = li.columns[2].data.astype(jnp.int64)
+    tax = li.columns[3].data.astype(jnp.int64)
+    disc_price = price * (100 - disc)            # cents·pct
+    charge = disc_price * (100 + tax)            # cents·pct²
+    n = li.num_rows
+    gt = Table((li.columns[4], li.columns[5],
+                Column(dt.INT64, n, data=qty),
+                Column(dt.INT64, n, data=price),
+                Column(dt.INT64, n, data=disc_price),
+                Column(dt.INT64, n, data=charge),
+                Column(dt.INT64, n, data=disc)))
+    g = group(gt, [0, 1], [(2, "sum"), (3, "sum"), (4, "sum"), (5, "sum"),
+                           (2, "mean"), (3, "mean"), (6, "mean"),
+                           (2, "count")])
+    return sort_table(g, [0, 1])
+
+
+def run_q6(lineitem: Table, date_lo: int = 365, date_hi: int = 730,
+           disc_lo: int = 5, disc_hi: int = 7, qty_max: int = 24,
+           mesh=None) -> int:
+    """TPC-H q6 shape: forecast-revenue-change — one filtered sum.
+    Returns revenue in cents·pct as an exact python int."""
+    sd = lineitem.columns[6].data
+    disc = lineitem.columns[2].data
+    qty = lineitem.columns[0].data
+    keep = ((sd >= date_lo) & (sd < date_hi)
+            & (disc >= disc_lo) & (disc <= disc_hi)
+            & (qty < qty_max))
+    li = filter_table(lineitem, keep)
+    rev = (li.columns[1].data.astype(jnp.int64)
+           * li.columns[2].data.astype(jnp.int64))
+    if mesh is not None:
+        # one-key groupby over the mesh: same exchange path, trivial key
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_groupby)
+        n = li.num_rows
+        if n == 0:
+            return 0
+        gt = Table((Column(dt.INT64, n,
+                           data=jnp.zeros((n,), dtype=jnp.int64)),
+                    Column(dt.INT64, n, data=rev)))
+        g = distributed_groupby(gt, [0], [(1, "sum")], mesh)
+        return int(g.columns[1].to_pylist()[0]) if g.num_rows else 0
+    return int(jnp.sum(rev))

@@ -7,6 +7,7 @@ ICI as XLA `all_to_all` collectives inside `shard_map`, with static slot
 shapes (XLA needs static shapes; capacity = the per-device row count).
 """
 
+from . import cluster
 from .exchange import hash_partition_exchange
 from .distributed import (
     distributed_full_join,
@@ -20,6 +21,7 @@ from .distributed import (
 from .task_executor import TaskExecutor
 
 __all__ = [
+    "cluster",
     "hash_partition_exchange",
     "distributed_full_join",
     "distributed_groupby",

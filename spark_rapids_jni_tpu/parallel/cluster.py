@@ -1,0 +1,79 @@
+"""Multi-host mesh bootstrap: the distributed communication backend entry.
+
+The reference scales across executors via Spark shuffle over the network
+(SURVEY.md §5.8 — its only "backend"); this framework's exchange already
+rides XLA collectives, which scale from one chip to multi-host pods with
+*no operator changes*: `shard_map` + `lax.all_to_all` compile to ICI
+transfers within a slice and DCN transfers across hosts, chosen by XLA from
+the mesh's device topology. What multi-host adds is only process bootstrap
+— every host runs the same program and must agree on the global device set
+— which this module wraps:
+
+    # on every host (Spark executor / pod worker):
+    cluster.initialize(coordinator="host0:9999",
+                       num_processes=4, process_id=rank)
+    mesh = cluster.global_mesh("shuffle")
+    parts = hash_partition_exchange(table, keys, mesh)   # unchanged
+
+`global_mesh` orders `jax.devices()` (the *global* device list after
+`jax.distributed.initialize`) into a 1-D mesh whose contiguous runs are
+per-host, so all_to_all partners between co-located devices stay on ICI
+and only cross-host slots traverse DCN.
+
+Single-host callers skip `initialize` entirely: `global_mesh` over local
+devices is exactly the mesh the tests and `dryrun_multichip` build.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Join this process to the cluster (jax.distributed.initialize).
+
+    Must run before any device access, on every participating host.
+    Idempotent per process; raises if the runtime was already initialized
+    with different parameters.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def global_mesh(axis_name: str = "shuffle", num_devices: int = 0):
+    """1-D mesh over the cluster's global device list.
+
+    num_devices = 0 uses every device; otherwise the first N (useful for
+    carving a sub-mesh on shared hosts). Device order is jax's global
+    order: process-major, so per-host runs are contiguous.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if num_devices:
+        if len(devs) < num_devices:
+            raise ValueError(
+                f"need {num_devices} devices, cluster has {len(devs)}")
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), axis_names=(axis_name,))
+
+
+def process_info() -> dict:
+    """This process's place in the cluster (single-host: 1 process)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

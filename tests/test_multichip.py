@@ -413,3 +413,40 @@ def test_exchange_traffic_proportional_to_rows(mesh):
     # design would have used per_dev (1000) slots — require a real
     # reduction (with nd=8 this bound is cap <= 500; observed: 256).
     assert cap <= 2 * ((per_dev // nd) * 2), (cap, per_dev)
+
+
+def test_cluster_global_mesh_and_info():
+    """cluster.global_mesh builds the same 1-D mesh the suite uses; the
+    exchange runs over it unchanged (multi-host adds only bootstrap —
+    parallel/cluster.py)."""
+    from spark_rapids_jni_tpu.parallel import cluster
+
+    info = cluster.process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] >= 8
+    m = cluster.global_mesh("shuffle", num_devices=8)
+    t = _table(300)
+    parts = hash_partition_exchange(t, [0], m)
+    assert sum(p.num_rows for p in parts) == 300
+    with pytest.raises(ValueError, match="devices"):
+        cluster.global_mesh(num_devices=10**6)
+
+
+def test_distributed_q1_matches_local(mesh):
+    from benchmarks.tpch import generate_q1_lineitem, run_q1
+    li = generate_q1_lineitem(3000, seed=7)
+    local = run_q1(li)
+    dist = run_q1(li, mesh=mesh)
+    for lc, dc in zip(local.columns, dist.columns):
+        lv, dv = lc.to_pylist(), dc.to_pylist()
+        if lc.dtype.id is dt.TypeId.FLOAT64:
+            np.testing.assert_allclose(np.array(lv), np.array(dv),
+                                       rtol=1e-12)
+        else:
+            assert lv == dv
+
+
+def test_distributed_q6_matches_local(mesh):
+    from benchmarks.tpch import generate_q1_lineitem, run_q6
+    li = generate_q1_lineitem(2500, seed=9)
+    assert run_q6(li, mesh=mesh) == run_q6(li)

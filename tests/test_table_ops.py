@@ -142,3 +142,60 @@ def test_tpch_q5_pipeline_matches_python_oracle():
     got = list(zip(out.columns[0].to_pylist(), out.columns[1].to_pylist()))
     assert sorted(got, key=lambda kv: -kv[1]) == got  # sorted desc
     assert dict(got) == dict(oracle)
+
+
+def test_tpch_q1_pipeline_matches_numpy_oracle():
+    """q1 pricing summary (benchmarks/tpch.py run_q1) vs a pandas-free
+    numpy oracle — exact int64 sums, float64 means."""
+    import collections
+
+    from benchmarks.tpch import generate_q1_lineitem, run_q1
+
+    li = generate_q1_lineitem(20000, seed=3)
+    out = run_q1(li, cutoff=2000)
+
+    qty = np.asarray(li.columns[0].data)
+    price = np.asarray(li.columns[1].data)
+    disc = np.asarray(li.columns[2].data)
+    tax = np.asarray(li.columns[3].data)
+    rf = np.asarray(li.columns[4].data)
+    ls = np.asarray(li.columns[5].data)
+    sd = np.asarray(li.columns[6].data)
+    keep = sd <= 2000
+    groups = collections.defaultdict(lambda: [0, 0, 0, 0, 0, 0])
+    for i in np.nonzero(keep)[0]:
+        g = groups[(int(rf[i]), int(ls[i]))]
+        g[0] += int(qty[i])
+        g[1] += int(price[i])
+        g[2] += int(price[i]) * (100 - int(disc[i]))
+        g[3] += int(price[i]) * (100 - int(disc[i])) * (100 + int(tax[i]))
+        g[4] += 1
+        g[5] += int(disc[i])
+    keys = sorted(groups)
+    assert list(zip(out.columns[0].to_pylist(),
+                    out.columns[1].to_pylist())) == keys
+    for j, (k) in enumerate(keys):
+        g = groups[k]
+        assert out.columns[2].to_pylist()[j] == g[0]   # sum qty
+        assert out.columns[3].to_pylist()[j] == g[1]   # sum price
+        assert out.columns[4].to_pylist()[j] == g[2]   # sum disc price
+        assert out.columns[5].to_pylist()[j] == g[3]   # sum charge
+        assert out.columns[9].to_pylist()[j] == g[4]   # count
+        assert abs(out.columns[6].to_pylist()[j] - g[0] / g[4]) < 1e-9
+        assert abs(out.columns[7].to_pylist()[j] - g[1] / g[4]) < 1e-6
+        assert abs(out.columns[8].to_pylist()[j] - g[5] / g[4]) < 1e-9
+
+
+def test_tpch_q6_pipeline_matches_numpy_oracle():
+    from benchmarks.tpch import generate_q1_lineitem, run_q6
+
+    li = generate_q1_lineitem(30000, seed=5)
+    got = run_q6(li)
+    qty = np.asarray(li.columns[0].data)
+    price = np.asarray(li.columns[1].data)
+    disc = np.asarray(li.columns[2].data)
+    sd = np.asarray(li.columns[6].data)
+    keep = ((sd >= 365) & (sd < 730) & (disc >= 5) & (disc <= 7)
+            & (qty < 24))
+    want = int(np.sum(price[keep].astype(object) * disc[keep]))
+    assert got == want
