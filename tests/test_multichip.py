@@ -450,3 +450,16 @@ def test_distributed_q6_matches_local(mesh):
     from benchmarks.tpch import generate_q1_lineitem, run_q6
     li = generate_q1_lineitem(2500, seed=9)
     assert run_q6(li, mesh=mesh) == run_q6(li)
+
+
+def test_exchange_single_device_mesh():
+    """nd=1 degenerate mesh: the exchange must be an identity shuffle
+    (all_to_all over an axis of size 1), not a special case."""
+    m = Mesh(np.array(jax.devices()[:1]), axis_names=("shuffle",))
+    t = _table(123)
+    parts = hash_partition_exchange(t, [0], m)
+    assert len(parts) == 1 and parts[0].num_rows == 123
+    got = sort_table(parts[0], [0, 1])
+    want = sort_table(t, [0, 1])
+    for gc, wc in zip(got.columns, want.columns):
+        assert gc.to_pylist() == wc.to_pylist()
