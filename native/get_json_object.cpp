@@ -15,7 +15,11 @@
 //
 // C ABI consumed by spark_rapids_jni_tpu/ops/get_json_object.py via ctypes.
 
+#include <cerrno>
+#include <charconv>
+#include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -426,9 +430,105 @@ struct generator {
     unescape(s, n, dec);
     write_escaped(dec, out);
   }
-  void raw_value(const char* s, size_t n) {  // numbers / literals
+  void raw_value(const char* s, size_t n) {  // literals
     pre_value();
     out.append(s, n);
+  }
+
+  // Spark/reference number normalization (GetJsonObjectTest
+  // "Number_Normalization"): integral tokens that fit int64 re-emit
+  // canonically (-0 -> 0), larger integrals copy verbatim; tokens with
+  // . / e / E parse as double and re-emit in Java Double.toString form,
+  // with overflow becoming the JSON *string* "Infinity"/"-Infinity".
+  static std::string java_double_to_string(double v) {
+    if (v == 0.0) return std::signbit(v) ? "-0.0" : "0.0";
+    char buf[64];
+    auto res = std::to_chars(buf, buf + sizeof buf, v,
+                             std::chars_format::scientific);
+    std::string s(buf, res.ptr);  // shortest round-trip "d.ddde±XX"
+    bool neg = s[0] == '-';
+    size_t i = neg ? 1 : 0;
+    std::string digits(1, s[i]);
+    i++;
+    if (i < s.size() && s[i] == '.') {
+      for (i++; i < s.size() && s[i] >= '0' && s[i] <= '9'; i++)
+        digits.push_back(s[i]);
+    }
+    int exp = atoi(s.c_str() + i + 1);  // s[i] == 'e'
+    std::string o = neg ? "-" : "";
+    if (exp >= -3 && exp < 7) {  // Java: plain form for 1e-3 <= |v| < 1e7
+      if (exp >= 0) {
+        for (int k = 0; k <= exp; k++)
+          o.push_back(k < (int)digits.size() ? digits[k] : '0');
+        o.push_back('.');
+        if ((int)digits.size() > exp + 1)
+          o.append(digits.begin() + exp + 1, digits.end());
+        else
+          o.push_back('0');
+      } else {
+        o += "0.";
+        o.append(-exp - 1, '0');
+        o += digits;
+      }
+    } else {
+      o.push_back(digits[0]);
+      o.push_back('.');
+      if (digits.size() > 1)
+        o.append(digits.begin() + 1, digits.end());
+      else
+        o.push_back('0');
+      o.push_back('E');
+      o += std::to_string(exp);
+    }
+    return o;
+  }
+
+  void number_value(const char* s, size_t n) {
+    bool is_double = false;
+    for (size_t k = 0; k < n; k++)
+      if (s[k] == '.' || s[k] == 'e' || s[k] == 'E') { is_double = true; break; }
+    if (!is_double) {
+      char tmp[24];
+      if (n < sizeof(tmp)) {
+        memcpy(tmp, s, n);
+        tmp[n] = 0;
+        errno = 0;
+        char* end = nullptr;
+        long long v = strtoll(tmp, &end, 10);
+        if (errno == 0 && end == tmp + n) {
+          char num[24];
+          int m = snprintf(num, sizeof num, "%lld", v);
+          raw_value(num, (size_t)m);
+          return;
+        }
+      }
+      raw_value(s, n);  // integral too wide for int64: verbatim
+      return;
+    }
+    // from_chars: locale-independent (strtod honors LC_NUMERIC, which the
+    // embedding host process may set) and allocation-free
+    double v = 0.0;
+    auto fc = std::from_chars(s, s + n, v);
+    if (fc.ec == std::errc::result_out_of_range) {
+      // huge exponents overflow to ±inf with Spark semantics below; tiny
+      // ones underflow toward zero, which from_chars reports the same way
+      v = (s[0] == '-') ? -HUGE_VAL : HUGE_VAL;
+      // distinguish underflow (negative exponent): collapses toward zero
+      const void* epos = memchr(s, 'e', n);
+      if (!epos) epos = memchr(s, 'E', n);
+      if (epos) {
+        const char* e = (const char*)epos;
+        if ((size_t)(e - s) + 1 < n && e[1] == '-')
+          v = (s[0] == '-') ? -0.0 : 0.0;
+      }
+    }
+    if (!std::isfinite(v)) {
+      const char* t = (s[0] == '-') ? "\"-Infinity\"" : "\"Infinity\"";
+      raw_value(t, strlen(t));
+      return;
+    }
+    std::string o = java_double_to_string(v);
+    raw_value(o.data(), o.size());
   }
   // raw string content without quotes (case 1: top-level string match)
   void raw_unescaped(const char* s, size_t n) {
@@ -446,7 +546,7 @@ struct generator {
   bool copy_current_structure(parser& p) {
     switch (p.cur) {
       case tok::VALUE_STRING: string_value(p.buf + p.tstart, p.tend - p.tstart); return true;
-      case tok::VALUE_NUMBER: raw_value(p.buf + p.tstart, p.tend - p.tstart); return true;
+      case tok::VALUE_NUMBER: number_value(p.buf + p.tstart, p.tend - p.tstart); return true;
       case tok::VALUE_TRUE: raw_value("true", 4); return true;
       case tok::VALUE_FALSE: raw_value("false", 5); return true;
       case tok::VALUE_NULL: raw_value("null", 4); return true;

@@ -134,3 +134,55 @@ def test_index_then_wildcard():
     assert run(js, "$[1].k[*]") == "[10,11,12]"
     # $[*].k[*] — per reference path6/7 composition
     assert run(js, "$[*].k[*]") == "[[0,1,2],[10,11,12],[20,21,22]]"
+
+
+def test_number_normalization_reference_vectors():
+    """Reference GetJsonObjectTest 'Number_Normalization' + leading-zero
+    vectors: doubles re-emit in Java Double.toString form (overflow becomes
+    the JSON string "Infinity"), int64-fitting integrals canonicalize
+    (-0 -> 0), wider integrals copy verbatim."""
+    cases = [
+        ('[100.0,200.000,351.980]', '$', '[100.0,200.0,351.98]'),
+        ('[12345678900000000000.0]', '$', '[1.23456789E19]'),
+        ('[0.0]', '$', '[0.0]'),
+        ('[-0.0]', '$', '[-0.0]'),
+        ('[-0]', '$', '[0]'),
+        ('[12345678999999999999999999]', '$',
+         '[12345678999999999999999999]'),
+        ('[1E308]', '$', '[1.0E308]'),
+        ('[1.0E309,-1E309,1E5000]', '$',
+         '["Infinity","-Infinity","Infinity"]'),
+        ('0.3', '$', '0.3'),
+        ('0.03', '$', '0.03'),
+        ('0.003', '$', '0.003'),
+        ('0.0003', '$', '3.0E-4'),
+        ('0.00003', '$', '3.0E-5'),
+        ('00', '$', None),
+        ('01', '$', None),
+        ('-01', '$', None),
+        ('-00', '$', None),
+    ]
+    for j, p, want in cases:
+        got = get_json_object(
+            Column.from_pylist([j], dt.STRING), p).to_pylist()[0]
+        assert got == want, (j, p, got, want)
+
+
+def test_case_path_reference_vectors():
+    """Reference GetJsonObjectTest case-path suite: top-level scalar
+    unquoting, [*][*] flatten style, single-item wildcard unwrap."""
+    cases = [
+        ("'abc'", '$', 'abc'),
+        ("[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]",
+         '$[*][*]', '[11,12,21,221,2221,22221,22222,31,32]'),
+        ('123', '$', '123'),
+        ("{ 'k' : 'v'  }", '$.k', 'v'),
+        ("[  [[[ {'k': 'v1'} ], {'k': 'v2'}]], [[{'k': 'v3'}], "
+         "{'k': 'v4'}], {'k': 'v5'}  ]", '$[*][*].k', '["v5"]'),
+        ('[1, [21, 22], 3]', '$[*]', '[1,[21,22],3]'),
+        ('[1]', '$[*]', '1'),
+    ]
+    for j, p, want in cases:
+        got = get_json_object(
+            Column.from_pylist([j], dt.STRING), p).to_pylist()[0]
+        assert got == want, (j, p, got, want)
