@@ -483,26 +483,52 @@ struct generator {
     return o;
   }
 
+  // Classify an out-of-range double token: true = overflow (±Infinity),
+  // false = underflow (±0). Decides by the token's decimal magnitude —
+  // first-significant-digit position plus the explicit exponent — never by
+  // the exponent's sign alone (a long digit string overflows with e-2, and
+  // 0.00...01 underflows with no exponent at all).
+  static bool out_of_range_is_overflow(const char* s, size_t n) {
+    size_t i = (n && s[0] == '-') ? 1 : 0;
+    size_t epos = n;
+    for (size_t k = i; k < n; k++)
+      if (s[k] == 'e' || s[k] == 'E') { epos = k; break; }
+    long long exp10 = 0;
+    if (epos < n) {
+      size_t x = epos + 1;
+      bool neg = x < n && s[x] == '-';
+      if (x < n && (s[x] == '-' || s[x] == '+')) x++;
+      auto fe = std::from_chars(s + x, s + n, exp10);
+      if (fe.ec != std::errc{})  // exponent itself beyond int64: its sign
+        return !neg;             // dominates any digit-position term
+      if (neg) exp10 = -exp10;
+    }
+    size_t dot = epos;
+    for (size_t k = i; k < epos; k++)
+      if (s[k] == '.') { dot = k; break; }
+    size_t fs = epos;  // first significant digit
+    for (size_t k = i; k < epos; k++)
+      if (s[k] >= '1' && s[k] <= '9') { fs = k; break; }
+    if (fs == epos) return false;  // all zero digits: toward zero
+    long long lead = (fs < dot) ? (long long)(dot - fs) - 1
+                                : -(long long)(fs - dot);
+    return lead + exp10 > 0;
+  }
+
   void number_value(const char* s, size_t n) {
     bool is_double = false;
     for (size_t k = 0; k < n; k++)
       if (s[k] == '.' || s[k] == 'e' || s[k] == 'E') { is_double = true; break; }
     if (!is_double) {
-      char tmp[24];
-      if (n < sizeof(tmp)) {
-        memcpy(tmp, s, n);
-        tmp[n] = 0;
-        errno = 0;
-        char* end = nullptr;
-        long long v = strtoll(tmp, &end, 10);
-        if (errno == 0 && end == tmp + n) {
-          char num[24];
-          int m = snprintf(num, sizeof num, "%lld", v);
-          raw_value(num, (size_t)m);
-          return;
-        }
+      long long v = 0;
+      auto fc = std::from_chars(s, s + n, v);
+      if (fc.ec == std::errc{} && fc.ptr == s + n) {
+        char num[24];
+        int m = snprintf(num, sizeof num, "%lld", v);
+        raw_value(num, (size_t)m);
+      } else {
+        raw_value(s, n);  // integral too wide for int64: verbatim
       }
-      raw_value(s, n);  // integral too wide for int64: verbatim
       return;
     }
     // from_chars: locale-independent (strtod honors LC_NUMERIC, which the
@@ -510,17 +536,10 @@ struct generator {
     double v = 0.0;
     auto fc = std::from_chars(s, s + n, v);
     if (fc.ec == std::errc::result_out_of_range) {
-      // huge exponents overflow to ±inf with Spark semantics below; tiny
-      // ones underflow toward zero, which from_chars reports the same way
-      v = (s[0] == '-') ? -HUGE_VAL : HUGE_VAL;
-      // distinguish underflow (negative exponent): collapses toward zero
-      const void* epos = memchr(s, 'e', n);
-      if (!epos) epos = memchr(s, 'E', n);
-      if (epos) {
-        const char* e = (const char*)epos;
-        if ((size_t)(e - s) + 1 < n && e[1] == '-')
-          v = (s[0] == '-') ? -0.0 : 0.0;
-      }
+      if (out_of_range_is_overflow(s, n))
+        v = (s[0] == '-') ? -HUGE_VAL : HUGE_VAL;
+      else
+        v = (s[0] == '-') ? -0.0 : 0.0;
     }
     if (!std::isfinite(v)) {
       const char* t = (s[0] == '-') ? "\"-Infinity\"" : "\"Infinity\"";

@@ -186,3 +186,24 @@ def test_case_path_reference_vectors():
         got = get_json_object(
             Column.from_pylist([j], dt.STRING), p).to_pylist()[0]
         assert got == want, (j, p, got, want)
+
+
+def test_number_out_of_range_classification():
+    """Out-of-range doubles classify by decimal magnitude, not exponent
+    sign: long digit strings overflow despite e-, bare 0.00..01 underflows
+    with no exponent, and exponents beyond int64 still classify."""
+    cases = [
+        ('[0.' + '0' * 330 + '1]', '[0.0]'),
+        ('[1' + '0' * 400 + '.0e-2]', '["Infinity"]'),
+        ('[-1' + '0' * 400 + '.0e-2]', '["-Infinity"]'),
+        ('[1E5000]', '["Infinity"]'),
+        ('[1E-5000]', '[0.0]'),
+        ('[-1E-5000]', '[-0.0]'),
+        ('[1e99999999999999999999]', '["Infinity"]'),
+        ('[1e-99999999999999999999]', '[0.0]'),
+        ('[0.' + '0' * 330 + '1e400]', '[1.0E69]'),  # finite: 10^-331*10^400
+    ]
+    for j, want in cases:
+        got = get_json_object(
+            Column.from_pylist([j], dt.STRING), '$').to_pylist()[0]
+        assert got == want, (j[:40], got, want)
