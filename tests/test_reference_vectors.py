@@ -95,3 +95,46 @@ def test_cast_to_integer_reference_vectors():
         got = string_to_integer(
             Column.from_pylist(strs, dt.STRING), d).to_pylist()
         assert got == want, (strs, got, want)
+
+
+def test_cast_to_decimal_reference_vectors():
+    """CastStringsTest.castToDecimalTest (non-ANSI; cudf scale convention:
+    negative = digits after the point; HALF_UP rounding of extra digits)."""
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_decimal
+    batches = [
+        ([" 3", "9", "4", "2", "20.5", None, "7.6asd"], 2, 0,
+         [D(3), D(9), D(4), D(2), D(21), None, None]),
+        (["5", "1 ", "0", "2", "7.1", None, "asdf"], 10, 0,
+         [D(5), D(1), D(0), D(2), D(7), None, None]),
+        (["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3"], 3, -1,
+         [D("2.0"), D("3.0"), D("4.0"), D("5.1"), D("9.2"), None, None]),
+    ]
+    for strs, prec, scale, want in batches:
+        got = string_to_decimal(
+            Column.from_pylist(strs, dt.STRING), prec, scale).to_pylist()
+        assert got == want, (strs, got, want)
+
+
+def test_from_json_reference_vectors():
+    """MapUtilsTest.testFromJsonSimpleInput — raw values verbatim (no
+    number normalization in map extraction), nested values as source
+    text, empty object, null row."""
+    from spark_rapids_jni_tpu.ops.map_utils import (
+        extract_raw_map_from_json_string)
+    j1 = ('{"Zipcode" : 704 , "ZipCodeType" : "STANDARD" , '
+          '"City" : "PARC PARQUE" , "State" : "PR"}')
+    j3 = ('{"category": "reference", "index": [4,{},null,{"a":[{ }, {}] } '
+          '], "author": "Nigel Rees", "title": "{}[], '
+          '<=semantic-symbols-string", "price": 8.95}')
+    col = Column.from_pylist([j1, "{}", None, j3], dt.STRING)
+    got = extract_raw_map_from_json_string(col).to_pylist()
+    assert got == [
+        [("Zipcode", "704"), ("ZipCodeType", "STANDARD"),
+         ("City", "PARC PARQUE"), ("State", "PR")],
+        [],
+        None,
+        [("category", "reference"),
+         ("index", '[4,{},null,{"a":[{ }, {}] } ]'),
+         ("author", "Nigel Rees"),
+         ("title", "{}[], <=semantic-symbols-string"), ("price", "8.95")],
+    ]
