@@ -181,6 +181,18 @@ def test_case_path_reference_vectors():
          "{'k': 'v4'}], {'k': 'v5'}  ]", '$[*][*].k', '["v5"]'),
         ('[1, [21, 22], 3]', '$[*]', '[1,[21,22],3]'),
         ('[1]', '$[*]', '1'),
+        # case paths 7-12 + comma/outer-array insertion
+        ("[ {'k': [0, 1, 2]}, {'k': [10, 11, 12]}, {'k': [20, 21, 22]}  ]",
+         '$[*].k[*]', '[[0,1,2],[10,11,12],[20,21,22]]'),
+        ('[ [0], [10, 11, 12], [2] ]', '$[1][*]', '[10,11,12]'),
+        ('[[0, 1, 2], [10, [111, 112, 113], 12], [20, 21, 22]]',
+         '$[1][1][*]', '[111,112,113]'),
+        ('[[0, 1, 2], [10, [], 12], [20, 21, 22]]', '$[1][1][*]', None),
+        ("{'k' : [0,1,2]}", '$.k[1]', '1'),
+        ("{'k' : null}", '$.k[1]', None),
+        ('123', '$[*]', None),
+        ('[ [11, 12], [21, 22]]', '$[*][*][*]', '[[11,12],[21,22]]'),
+        ('[ [11], [22] ]', '$[*][*][*]', '[11,22]'),
     ]
     for j, p, want in cases:
         got = get_json_object(
