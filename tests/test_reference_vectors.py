@@ -138,3 +138,37 @@ def test_from_json_reference_vectors():
          ("author", "Nigel Rees"),
          ("title", "{}[], <=semantic-symbols-string"), ("price", "8.95")],
     ]
+
+
+def test_cast_to_integer_no_strip_reference_vectors():
+    """CastStringsTest.castToIntegerNoStripTest — whitespace invalidates."""
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_integer
+    batches = [
+        ([" 3", "9", "4", "2", "20.5", None, "7.6asd"], dt.INT64,
+         [None, 9, 4, 2, 20, None, None]),
+        (["5", "1 ", "0", "2", "7.1", None, "asdf"], dt.INT32,
+         [5, None, 0, 2, 7, None, None]),
+        (["2", "3", " 4 ", "5.6", " 9.2 ", None, "7.8.3"], dt.INT8,
+         [2, 3, None, 5, None, None, None]),
+    ]
+    for strs, d, want in batches:
+        got = string_to_integer(Column.from_pylist(strs, dt.STRING), d,
+                                ansi_mode=False, strip=False).to_pylist()
+        assert got == want, (strs, got, want)
+
+
+def test_cast_to_integer_ansi_reference_vectors():
+    """CastStringsTest.castToIntegerAnsiTest — the exception carries the
+    first offending row index and string."""
+    from spark_rapids_jni_tpu.ops.cast_string import (CastException,
+                                                      string_to_integer)
+    ok = string_to_integer(
+        Column.from_pylist(["3", "9", "4", "2", "20"], dt.STRING),
+        dt.INT64, ansi_mode=True)
+    assert ok.to_pylist() == [3, 9, 4, 2, 20]
+    with pytest.raises(CastException) as ei:
+        string_to_integer(
+            Column.from_pylist(["asdf", "9.0.2", "- 4e", "b2", "20-fe"],
+                               dt.STRING), dt.INT64, ansi_mode=True)
+    assert ei.value.string_with_error == "asdf"
+    assert ei.value.row_number == 0
