@@ -172,3 +172,42 @@ def test_cast_to_integer_ansi_reference_vectors():
                                dt.STRING), dt.INT64, ansi_mode=True)
     assert ei.value.string_with_error == "asdf"
     assert ei.value.row_number == 0
+
+
+def test_row_conversion_wide_reference_shape():
+    """RowConversionTest.fixedWidthRowsRoundTripWide — 80 columns (10x each
+    of int64/float64/int32/bool/float32/int8/decimal32/decimal64) with
+    nulls round-trip in one batch; exercises multi-byte validity packing."""
+    from spark_rapids_jni_tpu.ops.row_conversion import (convert_from_rows,
+                                                         convert_to_rows)
+    cols = []
+    for _ in range(10):
+        cols.append(Column.from_pylist([3, 9, 4, 2, 20, None], dt.INT64))
+    for _ in range(10):
+        cols.append(Column.from_pylist(
+            [5.0, 9.5, 0.9, 7.23, 2.8, None], dt.FLOAT64))
+    for _ in range(10):
+        cols.append(Column.from_pylist([5, 1, 0, 2, 7, None], dt.INT32))
+    for _ in range(10):
+        cols.append(Column.from_pylist(
+            [True, False, False, True, False, None], dt.BOOL8))
+    for _ in range(10):
+        cols.append(Column.from_pylist(
+            [1.0, 3.5, 5.9, 7.1, 9.8, None], dt.FLOAT32))
+    for _ in range(10):
+        cols.append(Column.from_pylist([2, 3, 4, 5, 9, None], dt.INT8))
+    d32 = dt.DType(dt.TypeId.DECIMAL32, 3)
+    for _ in range(10):
+        cols.append(Column.from_pylist(
+            [D("5.000"), D("9.500"), D("0.900"), D("7.230"), D("2.800"),
+             None], d32))
+    d64 = dt.DType(dt.TypeId.DECIMAL64, 8)
+    for _ in range(10):
+        cols.append(Column.from_pylist([3, 9, 4, 2, 20, None], d64))
+    from spark_rapids_jni_tpu.columnar.column import Table
+    t = Table(tuple(cols))
+    batches = convert_to_rows(t)
+    assert len(batches) == 1 and batches[0].size == 6
+    back = convert_from_rows(batches[0], [c.dtype for c in t.columns])
+    for i, (a, b) in enumerate(zip(t.columns, back.columns)):
+        assert a.to_pylist() == b.to_pylist(), i
