@@ -211,3 +211,27 @@ def test_row_conversion_wide_reference_shape():
     back = convert_from_rows(batches[0], [c.dtype for c in t.columns])
     for i, (a, b) in enumerate(zip(t.columns, back.columns)):
         assert a.to_pylist() == b.to_pylist(), i
+
+
+def test_bloom_filter_reference_vectors():
+    """BloomFilterTest.testBuildAndProbeBuffer / testBuildWithNullsAndProbe
+    at the reference's exact sizes (4M bits, 3 hashes): all put keys probe
+    true, non-members false, null puts contribute nothing."""
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    longs = (4 * 1024 * 1024) // 64
+    probe = Column.from_pylist(
+        [20, 80, 100, 99, 47, -9, 234000000, -10, 1, 2, 3], dt.INT64)
+
+    filt = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([20, 80, 100, 99, 47, -9, 234000000], dt.INT64))
+    assert bf.bloom_filter_probe(probe, filt).to_pylist() == \
+        [True] * 7 + [False] * 4
+
+    filt2 = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([None, 80, 100, None, 47, -9, 234000000],
+                           dt.INT64))
+    assert bf.bloom_filter_probe(probe, filt2).to_pylist() == \
+        [False, True, True, False, True, True, True, False, False, False,
+         False]
