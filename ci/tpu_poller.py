@@ -1,0 +1,175 @@
+"""Opportunistic TPU-window capture daemon (round-3 verdict missing #1).
+
+Three rounds have ended with zero driver-captured TPU evidence because the
+axon tunnel was wedged whenever a human-scale "try bench now" decision was
+made. This daemon removes the human from the loop: it probes the tunnel in
+a disposable subprocess every POLL_S seconds, logs every attempt, and on
+the FIRST healthy accelerator probe immediately runs the full capture
+stack — `python bench.py` (14-axis sweep, median-of-repeats),
+`python ci/tpu_smoke.py` (12 oracle checks incl. the compiled-Pallas
+bit-compare + HBM watermark audit) — then commits the artifacts
+(BENCH_tpu.json, SMOKE_tpu.json) to git at once, not at round end when the
+tunnel may be dead again.
+
+The capture only commits if bench.py's emitted JSON says the backend was
+a real accelerator: bench.py itself is wedge-resilient and falls back to
+CPU, and a CPU record is exactly the non-evidence we already have.
+
+Run (persistent, via tmux so it outlives any one shell):
+    tmux new-session -d -s tpupoll 'python ci/tpu_poller.py'
+Log: ci/tpu_poller.log   Success marker: ci/tpu_capture_done
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "ci", "tpu_poller.log")
+DONE = os.path.join(REPO, "ci", "tpu_capture_done")
+
+POLL_S = int(os.environ.get("TPU_POLL_S", "600"))
+PROBE_TIMEOUT_S = int(os.environ.get("TPU_PROBE_TIMEOUT_S", "240"))
+BENCH_TIMEOUT_S = int(os.environ.get("TPU_BENCH_TIMEOUT_S", "3600"))
+SMOKE_TIMEOUT_S = int(os.environ.get("TPU_SMOKE_TIMEOUT_S", "2400"))
+
+
+def log(msg):
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def probe():
+    """One disposable-subprocess device init. Returns platform or None."""
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "print('POLL_OK', d[0].platform, len(d), flush=True)\n")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           timeout=PROBE_TIMEOUT_S, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None
+    for ln in (p.stdout or "").splitlines():
+        if ln.startswith("POLL_OK") and p.returncode == 0:
+            return ln.split()[1]
+    return None
+
+
+def run_capture():
+    """Full capture on a healthy window. True iff TPU evidence committed."""
+    log("capture: running bench.py (full sweep)")
+    try:
+        b = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+                           timeout=BENCH_TIMEOUT_S, capture_output=True,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        log("capture: bench.py timed out")
+        return False
+    bench_line = None
+    for ln in (b.stdout or "").splitlines():
+        try:
+            j = json.loads(ln)
+            if "metric" in j:
+                bench_line = j
+        except ValueError:
+            continue
+    if not bench_line:
+        log(f"capture: bench.py emitted no JSON (rc={b.returncode}); "
+            f"stderr tail: {(b.stderr or '')[-300:]}")
+        return False
+    backend = bench_line.get("backend")
+    if backend == "cpu":
+        log("capture: bench fell back to CPU mid-run (tunnel re-wedged?) — "
+            "not committing, will keep polling")
+        return False
+    with open(os.path.join(REPO, "BENCH_tpu.json"), "w") as f:
+        json.dump(bench_line, f, indent=1)
+    log(f"capture: bench backend={backend} headline="
+        f"{bench_line.get('value')} {bench_line.get('unit')}")
+
+    log("capture: running ci/tpu_smoke.py")
+    smoke_line = None
+    try:
+        s = subprocess.run([sys.executable, "ci/tpu_smoke.py"], cwd=REPO,
+                           timeout=SMOKE_TIMEOUT_S, capture_output=True,
+                           text=True)
+        for ln in (s.stdout or "").splitlines():
+            try:
+                j = json.loads(ln)
+                if "checks" in j:
+                    smoke_line = j
+            except ValueError:
+                continue
+        if smoke_line:
+            with open(os.path.join(REPO, "SMOKE_tpu.json"), "w") as f:
+                json.dump(smoke_line, f, indent=1)
+            log(f"capture: smoke backend={smoke_line.get('backend')} "
+                f"passed={smoke_line.get('passed')} "
+                f"failed={smoke_line.get('failed')}")
+        else:
+            log(f"capture: smoke emitted no JSON (rc={s.returncode})")
+    except subprocess.TimeoutExpired:
+        log("capture: tpu_smoke.py timed out (bench results still commit)")
+
+    files = ["BENCH_tpu.json"]
+    if smoke_line:
+        files.append("SMOKE_tpu.json")
+    msg = (f"Capture first healthy TPU window: bench backend={backend}, "
+           f"headline {bench_line.get('value')} {bench_line.get('unit')}"
+           + (f", smoke {smoke_line.get('passed')}/"
+              f"{smoke_line.get('passed', 0) + smoke_line.get('failed', 0)}"
+              if smoke_line else ""))
+    committed = False
+    for attempt in range(10):  # index.lock contention with the main session
+        subprocess.run(["git", "add", "--"] + files, cwd=REPO,
+                       capture_output=True, text=True)
+        # pathspec'd commit: must not sweep up whatever the concurrent main
+        # session has staged mid-commit
+        cm = subprocess.run(["git", "commit", "-m", msg, "--"] + files,
+                            cwd=REPO, capture_output=True, text=True)
+        if cm.returncode == 0:
+            log(f"capture: committed ({msg})")
+            committed = True
+            break
+        log(f"capture: git commit attempt {attempt + 1} failed: "
+            f"{(cm.stderr or cm.stdout)[-200:]}")
+        time.sleep(30)
+    if not committed:
+        # evidence exists only in the working tree; stay alive and retry the
+        # whole capture on the next healthy probe rather than declaring done
+        log("capture: could not commit after 10 attempts — NOT writing done "
+            "marker; will retry on next healthy window")
+        return False
+    with open(DONE, "w") as f:
+        json.dump({"backend": backend, "time": time.strftime("%FT%T"),
+                   "bench": bench_line, "smoke": smoke_line}, f, indent=1)
+    return True
+
+
+def main():
+    log(f"poller start: pid={os.getpid()} poll={POLL_S}s "
+        f"probe_timeout={PROBE_TIMEOUT_S}s")
+    if os.path.exists(DONE):
+        log("capture already done (marker exists); exiting")
+        return 0
+    n = 0
+    while True:
+        n += 1
+        plat = probe()
+        log(f"probe #{n}: {plat or 'WEDGED (timeout/fail)'}")
+        if plat and plat != "cpu":
+            log(f"probe #{n}: HEALTHY WINDOW ({plat}) — capturing now")
+            if run_capture():
+                log("poller: capture complete; exiting")
+                return 0
+            log("poller: capture did not yield TPU evidence; continuing")
+        time.sleep(POLL_S)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

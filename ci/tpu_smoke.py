@@ -207,6 +207,51 @@ def _dec(np, jnp):
         assert g == want, (av, bv, g, want)
 
 
+@check("pallas_compiled_vs_xla_bitcompare")
+def _pallas_bitcompare(np, jnp):
+    """All three pallas kernels (murmur3, xxhash64, rowconv word assembly)
+    must produce bit-identical results to the XLA paths *with the real
+    Mosaic lowering*. tests/ only ever exercise interpret mode (CPU); this
+    check is the first place the compiled kernels run — config 'on' forces
+    the pallas route, and on an accelerator backend pallas_gate resolves
+    interpret=False, i.e. a genuine Mosaic compile. On CPU it degrades to
+    an interpret-mode compare (still useful, not the point)."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32, xxhash64
+    from spark_rapids_jni_tpu.ops.row_conversion import convert_to_rows
+    from spark_rapids_jni_tpu.utils import config
+
+    rng = np.random.default_rng(9)
+    n = 100_000
+    vals64 = rng.integers(-2**62, 2**62, n)
+    mask = rng.random(n) < 0.9
+    t = Table((
+        Column.from_numpy(vals64, dt.INT64).with_validity(mask),
+        Column.from_numpy(rng.integers(-2**31, 2**31, n).astype(np.int32),
+                          dt.INT32),
+        Column.from_numpy(rng.standard_normal(n).astype(np.float32),
+                          dt.FLOAT32),
+        Column.from_numpy(rng.standard_normal(n), dt.FLOAT64),
+    ))
+    import jax
+    compiled = jax.default_backend() != "cpu"
+    for key, fn in (("hashing.pallas", lambda: murmur_hash3_32(t).data),
+                    ("hashing.pallas", lambda: xxhash64(t).data),
+                    ("rowconv.pallas",
+                     lambda: convert_to_rows(t)[0].children[0].data)):
+        with config.override(key, "off"):
+            want = fn()
+        with config.override(key, "on"):
+            got = fn()
+        w = np.asarray(jnp.asarray(want))
+        g = np.asarray(jnp.asarray(got))
+        assert w.dtype == g.dtype and w.shape == g.shape, (w.shape, g.shape)
+        assert np.array_equal(w, g), f"{key}: pallas != xla"
+    print(f"smoke: pallas bitcompare ran {'COMPILED (Mosaic)' if compiled else 'interpreted (cpu)'}",
+          file=sys.stderr)
+
+
 @check("hbm_reservation_watermarks")
 def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real

@@ -88,6 +88,10 @@ JNIEXPORT jobjectArray JNICALL Java_com_sparkrapids_tpu_EngineJni_call(
     jobjectArray offsets, jobjectArray validity) {
   jsize n = dtypes ? env->GetArrayLength(dtypes) : 0;
 
+  // JNI guarantees only ~16 local refs by default; a wide table's per-column
+  // loops would otherwise overflow the local-reference table.
+  if (env->EnsureLocalCapacity(64) != 0) return nullptr;  // OOME pending
+
   // pin/copy every input column into eb_col structs
   std::vector<eb_col> ins(n);
   std::vector<std::vector<uint8_t>> data_bufs(n), valid_bufs(n);
@@ -96,8 +100,10 @@ JNIEXPORT jobjectArray JNICALL Java_com_sparkrapids_tpu_EngineJni_call(
   jlong* rows_p = env->GetLongArrayElements(rows, nullptr);
   for (jsize i = 0; i < n; i++) {
     auto js = (jstring)env->GetObjectArrayElement(dtypes, i);
-    utf_chars dt(env, js);
-    dtype_strs[i] = dt.p ? dt.p : "";
+    {
+      utf_chars dt(env, js);  // released before js's local ref is deleted
+      dtype_strs[i] = dt.p ? dt.p : "";
+    }
     auto d = (jbyteArray)env->GetObjectArrayElement(data, i);
     jsize dl = d ? env->GetArrayLength(d) : 0;
     data_bufs[i].resize(dl);
@@ -121,6 +127,12 @@ JNIEXPORT jobjectArray JNICALL Java_com_sparkrapids_tpu_EngineJni_call(
               (int64_t)data_bufs[i].size(),
               o ? offs_bufs[i].data() : nullptr,
               v ? valid_bufs[i].data() : nullptr};
+    // drop per-iteration locals so wide tables can't overflow the
+    // local-reference table (contents were copied above)
+    if (js) env->DeleteLocalRef(js);
+    if (d) env->DeleteLocalRef(d);
+    if (o) env->DeleteLocalRef(o);
+    if (v) env->DeleteLocalRef(v);
   }
   env->ReleaseLongArrayElements(rows, rows_p, JNI_ABORT);
 
@@ -147,24 +159,29 @@ JNIEXPORT jobjectArray JNICALL Java_com_sparkrapids_tpu_EngineJni_call(
   jobjectArray o_valid = env->NewObjectArray(m, bytes_cls, nullptr);
   for (int32_t i = 0; i < m; i++) {
     const eb_out_col& c = res->cols[i];
-    env->SetObjectArrayElement(o_dt, i, env->NewStringUTF(c.dtype));
+    jstring dt = env->NewStringUTF(c.dtype);
+    env->SetObjectArrayElement(o_dt, i, dt);
+    env->DeleteLocalRef(dt);
     jlong r = c.rows;
     env->SetLongArrayRegion(o_rows, i, 1, &r);
     jbyteArray d = env->NewByteArray((jsize)c.data_bytes);
     env->SetByteArrayRegion(d, 0, (jsize)c.data_bytes,
                             (const jbyte*)c.data);
     env->SetObjectArrayElement(o_data, i, d);
+    env->DeleteLocalRef(d);
     if (c.offsets) {
       jlongArray o = env->NewLongArray((jsize)(c.rows + 1));
       env->SetLongArrayRegion(o, 0, (jsize)(c.rows + 1),
                               (const jlong*)c.offsets);
       env->SetObjectArrayElement(o_offs, i, o);
+      env->DeleteLocalRef(o);
     }
     if (c.validity) {
       jbyteArray v = env->NewByteArray((jsize)c.rows);
       env->SetByteArrayRegion(v, 0, (jsize)c.rows,
                               (const jbyte*)c.validity);
       env->SetObjectArrayElement(o_valid, i, v);
+      env->DeleteLocalRef(v);
     }
   }
   env->SetObjectArrayElement(out, 0, o_dt);

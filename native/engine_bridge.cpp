@@ -189,12 +189,15 @@ int eb_call(const char* op, const char* args_json, const eb_col* ins,
                 reinterpret_cast<const char*>(c.validity),
                 static_cast<Py_ssize_t>(c.rows))
           : (Py_INCREF(Py_None), Py_None);
+      // "O" (not "N") keeps ownership here: on Py_BuildValue failure the N
+      // forms may or may not have consumed each reference, so the single
+      // unconditional Py_XDECREF below would double-decref.
       PyObject* tup = (data && offs && valid)
-          ? Py_BuildValue("(sLNNN)", c.dtype,
+          ? Py_BuildValue("(sLOOO)", c.dtype,
                           static_cast<long long>(c.rows), data, offs, valid)
           : nullptr;
+      Py_XDECREF(data); Py_XDECREF(offs); Py_XDECREF(valid);
       if (!tup) {
-        Py_XDECREF(data); Py_XDECREF(offs); Py_XDECREF(valid);
         set_err_from_python(); rc = -12; bad = true; break;
       }
       PyList_SET_ITEM(cols, i, tup);  // steals

@@ -16,6 +16,7 @@ escalation behave as in the reference.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import threading
 import weakref
@@ -87,9 +88,19 @@ class ThreadStateRegistry:
     registers every thread it names (get_current_thread_id), so unknown ids
     here are external drivers (tests, jvm_sim) whose escalation semantics
     must not change underneath them.
+
+    Known false-negative class: a thread blocked in a C-level primitive
+    called directly from *user* code (e.g. a bare ``lock.acquire()`` in a
+    task function) shows the caller's own module as its innermost frame, so
+    module-based detection misses it and the deadlock sweep may stall
+    waiting on it. Conversely a thread actively *executing* python code
+    inside queue/socket/etc. counts blocked. Task code holding reservations
+    around a known-blocking section should wrap it in :meth:`mark_blocked`
+    to make blockedness explicit and exact.
     """
 
     _by_tid: Dict[int, "weakref.ref"] = {}
+    _marked_blocked: Dict[int, int] = {}  # tid -> nesting depth
     _lock = threading.Lock()
 
     # Module-based detection only: blocking *C* primitives (lock.acquire,
@@ -120,8 +131,32 @@ class ThreadStateRegistry:
             cls._by_tid.pop(tid, None)
 
     @classmethod
+    @contextlib.contextmanager
+    def mark_blocked(cls, tid: int):
+        """Explicitly mark `tid` blocked for the duration of a with-block.
+
+        Closes the frame heuristic's false-negative class: task code about
+        to block in a C primitive invisible to frame inspection (a bare
+        ``lock.acquire()``, a C-extension wait) wraps the section so the
+        deadlock sweep sees it as blocked immediately and exactly.
+        Re-entrant (nesting depth counted)."""
+        with cls._lock:
+            cls._marked_blocked[tid] = cls._marked_blocked.get(tid, 0) + 1
+        try:
+            yield
+        finally:
+            with cls._lock:
+                d = cls._marked_blocked.get(tid, 1) - 1
+                if d <= 0:
+                    cls._marked_blocked.pop(tid, None)
+                else:
+                    cls._marked_blocked[tid] = d
+
+    @classmethod
     def is_thread_blocked(cls, tid: int) -> bool:
         with cls._lock:
+            if cls._marked_blocked.get(tid, 0) > 0:
+                return True
             ref = cls._by_tid.get(tid)
         if ref is None:
             return False  # unknown: external driver, stay out of its way
@@ -138,6 +173,7 @@ class ThreadStateRegistry:
     def clear(cls) -> None:
         with cls._lock:
             cls._by_tid.clear()
+            cls._marked_blocked.clear()
 
 
 # module-level so the callback object outlives any single adaptor and the

@@ -149,7 +149,9 @@ def test_histogram_percentile_via_bridge():
 
 def test_tz_convert_via_bridge():
     micros = np.array([0], np.int64)  # 1970-01-01T00:00Z
-    # a no-DST zone: rule-based DST zones are rejected like the reference
+    # Shanghai's DST is historical (transition-table based), so it is
+    # accepted; only rule-based *recurring* DST zones are rejected like the
+    # reference's fixed-transition limitation.
     out, _ = bridge.call("tz.from_utc",
                          json.dumps({"zone": "Asia/Shanghai"}),
                          [("timestamp_us", 1, micros.tobytes(), None, None)])

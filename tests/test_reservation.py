@@ -227,6 +227,62 @@ def test_externally_blocked_thread_does_not_stall_escalation(adaptor):
     RmmSpark.task_done(2)
 
 
+def test_mark_blocked_covers_frame_heuristic_false_negative(adaptor):
+    """A thread blocked in a bare ``lock.acquire()`` from *user* code is
+    invisible to the frame-module heuristic (the innermost python frame is
+    this test module, not `threading`), so without the explicit
+    ThreadStateRegistry.mark_blocked wrapper the sweep would see it RUNNING
+    and stall escalation. With the wrapper, thread B escalates to
+    TpuRetryOOM exactly as in the event-blocked case above."""
+    from spark_rapids_jni_tpu.memory.exceptions import TpuRetryOOM
+    from spark_rapids_jni_tpu.memory.rmm_spark import ThreadStateRegistry
+
+    gate = threading.Lock()
+    gate.acquire()  # thread A will block acquiring it
+    a_holding = threading.Event()
+    b_result = []
+
+    def thread_a():
+        tid = RmmSpark.get_current_thread_id()
+        RmmSpark.current_thread_is_dedicated_to_task(11)
+        try:
+            RmmSpark.alloc(6 * MB)
+            a_holding.set()
+            # bare C-level lock wait: innermost frame is THIS module, so
+            # only the explicit marker reports blockedness
+            with ThreadStateRegistry.mark_blocked(tid):
+                gate.acquire(timeout=30)
+            RmmSpark.dealloc(6 * MB)
+        finally:
+            RmmSpark.remove_current_thread_association()
+
+    def thread_b():
+        RmmSpark.current_thread_is_dedicated_to_task(12)
+        try:
+            a_holding.wait(timeout=30)
+            try:
+                RmmSpark.alloc(4 * MB)
+                b_result.append("allocated")
+                RmmSpark.dealloc(4 * MB)
+            except TpuRetryOOM:
+                b_result.append("retry_oom")
+        finally:
+            RmmSpark.remove_current_thread_association()
+
+    ta = threading.Thread(target=thread_a, daemon=True)
+    tb = threading.Thread(target=thread_b, daemon=True)
+    ta.start()
+    tb.start()
+    tb.join(timeout=10)
+    assert not tb.is_alive(), "thread B never escalated (marker ignored)"
+    assert b_result == ["retry_oom"]
+    gate.release()
+    ta.join(timeout=10)
+    assert not ta.is_alive()
+    RmmSpark.task_done(11)
+    RmmSpark.task_done(12)
+
+
 def test_hbm_audit_brackets_counted(adaptor):
     """rmm.validate_hbm wires the bracket audit (memory/hbm.py); on CPU the
     PJRT counters are unavailable so validated stays 0, but brackets must
