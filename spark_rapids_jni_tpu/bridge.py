@@ -381,9 +381,12 @@ def _hist_pct(args, cols):
 @op("zorder.interleave")
 def _zorder(args, cols):
     """ZOrder.java interleaveBits -> (offsets INT64, bytes UINT8)
-    (ref ZOrder.java:30-45)."""
+    (ref ZOrder.java:30-45). With zero input columns the reference's
+    interleaveBits(numRows) overload emits numRows empty lists; the row
+    count then rides args["num_rows"]."""
     from .ops.zorder import interleave_bits
-    out = interleave_bits(cols)
+    out = interleave_bits(
+        cols, num_rows=int(args["num_rows"]) if "num_rows" in args else None)
     offs_w, _ = _list_parts(out)
     return [offs_w, out.children[0]]
 

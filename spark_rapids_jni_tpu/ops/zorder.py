@@ -14,7 +14,7 @@ loops unrolled at trace time.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,13 +44,24 @@ def _as_unsigned_bits(col: Column) -> jnp.ndarray:
     return data
 
 
-def interleave_bits(table: Union[Table, Sequence[Column]]) -> Column:
+def interleave_bits(table: Union[Table, Sequence[Column]],
+                    num_rows: Optional[int] = None) -> Column:
     """Interleave the bits of n same-typed fixed-width columns, column 0
     most significant, into a LIST<UINT8> binary column (zorder.cu:138-222;
-    semantics of deltalake's interleaveBits)."""
+    semantics of deltalake's interleaveBits).
+
+    With zero columns the reference (ZOrder.interleaveBits(numRows),
+    InterleaveBitsTest.java:238-251) emits `num_rows` empty lists —
+    `num_rows` is required in that case since no column carries the count.
+    """
     cols = tuple(table.columns if isinstance(table, Table) else table)
     if not cols:
-        raise ValueError("The input table must have at least one column.")
+        if num_rows is None:
+            raise ValueError("The input table must have at least one column"
+                             " (or pass num_rows for the 0-column form).")
+        child = Column(dt.UINT8, 0, data=jnp.zeros((0,), jnp.uint8))
+        return Column.list_of(
+            child, jnp.zeros((num_rows + 1,), jnp.int32))
     if any(not c.dtype.is_fixed_width for c in cols):
         raise TypeError("Only fixed width columns can be used")
     tid = cols[0].dtype.id

@@ -62,6 +62,46 @@ def test_from_int_base16_int64_negative():
     assert from_integers_with_base(col, 16).to_pylist() == ["FFFFFFFFFFFFFFFE"]
 
 
+def _conv_pipeline(strs, from_base):
+    """Reference convTestInternal (CastStringsTest.java:196-206): parse as
+    UINT64 in `from_base`, then re-render in base 10 and base 16."""
+    col = Column.from_pylist(strs, dt.STRING)
+    ints = to_integers_with_base(col, from_base, dt.UINT64)
+    dec = from_integers_with_base(ints, 10).to_pylist()
+    hexs = from_integers_with_base(ints, 16).to_pylist()
+    return dec, hexs
+
+
+def test_base_dec2hex_no_nulls():
+    # CastStringsTest.java:209-230 (baseDec2HexTestNoNulls)
+    dec, hexs = _conv_pipeline(["510", "00510", "00-510"], 10)
+    assert dec == ["510", "510", "0"]
+    assert hexs == ["1FE", "1FE", "0"]
+
+
+def test_base_dec2hex_mixed():
+    # CastStringsTest.java:233-272 (baseDec2HexTestMixed): junk prefixes
+    # zero out, a leading-whitespace negative wraps through u64
+    dec, hexs = _conv_pipeline(
+        [None, " ", "junk-510junk510", "--510", "   -510junk510",
+         "  510junk510", "510", "00510", "00-510"], 10)
+    assert dec == [None, None, "0", "0", "18446744073709551106", "510",
+                   "510", "510", "0"]
+    assert hexs == [None, None, "0", "0", "FFFFFFFFFFFFFE02", "1FE", "1FE",
+                    "1FE", "0"]
+
+
+def test_base_hex2dec():
+    # CastStringsTest.java:275-326 (baseHex2DecTest)
+    dec, hexs = _conv_pipeline(
+        [None, "junk", "0", "f", "junk-5Ajunk5A", "--5A", "   -5Ajunk5A",
+         "  5Ajunk5A", "5a", "05a", "005a", "00-5a", "NzGGImWNRh"], 16)
+    assert dec == [None, "0", "0", "15", "0", "0", "18446744073709551526",
+                   "90", "90", "90", "90", "0", "0"]
+    assert hexs == [None, "0", "0", "F", "0", "0", "FFFFFFFFFFFFFFA6", "5A",
+                    "5A", "5A", "5A", "0", "0"]
+
+
 def test_roundtrip_random():
     rng = np.random.default_rng(2)
     vals = rng.integers(-(2**31), 2**31, 200).tolist()

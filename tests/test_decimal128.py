@@ -338,6 +338,46 @@ def test_add_overflow_scale_neg10():
     assert t[0].to_pylist() == [True, True]
 
 
+def test_add_precision38_scale_neg10_full():
+    # DecimalUtilsTest.java:439-480 (addPrecision38ScaleNeg10): 11 rows,
+    # both operands scale 10, result scale 9
+    lhs = dec_col(["9191008513307131620269245301.1615457290",
+                   "-9191008513307131620269245301.1615457290",
+                   "577694938495380589068894346.7625198736",
+                   "-7949989536398283250841565918.6123449781",
+                   "-569260079419403643627836417.1451349695",
+                   "4268696962649098725873162852.3422176564",
+                   "948521076935839001259204571.1574829065",
+                   "-9299778357834801251892834048.0026057082",
+                   "8127384240098008972235509102.7063990819",
+                   "-1012433127481465711031073593.0625063701",
+                   "-3008128675386495592846447084.0906874636"])
+    rhs = dec_col(["9447850332473678680446404122.5624623187",
+                   "-9447850332473678680446404122.5624623187",
+                   "-1258508260891400005608241690.1564700995",
+                   "0E-10",
+                   "4506903505351346531188531230.8104179784",
+                   "8289592062844478064245294937.3714242072",
+                   "475827447078875704758652459.0564660621",
+                   "960510811873374359477931158.7077642783",
+                   "7213672086663445017824298126.4525607205",
+                   "2346189245818456940830953479.5847958897",
+                   "449885491907950809374133839.5150485453"])
+    t = d128.add_decimal128(lhs, rhs, 9)
+    check(t, [False] * 11,
+          ["18638858845780810300715649423.724008048",
+           "-18638858845780810300715649423.724008048",
+           "-680813322396019416539347343.393950226",
+           "-7949989536398283250841565918.612344978",
+           "3937643425931942887560694813.665283009",
+           "12558289025493576790118457789.713641864",
+           "1424348524014714706017857030.213948969",
+           "-8339267545961426892414902889.294841430",
+           "15341056326761453990059807229.158959802",
+           "1333756118336991229799879886.522289520",
+           "-2558243183478544783472313244.575638918"])
+
+
 def test_add_different_scales():
     lhs = dec_col(["9191008513307131620269245301.1615457290",
                    "-9191008513307131620269245301.1615457290",
@@ -374,19 +414,39 @@ def test_add_different_scales():
 
 
 def test_add_precision38_scale_minus5_with_null():
+    # DecimalUtilsTest.java:483-524 (addPrecision38Scale5): all 10 rows
     lhs = dec_col(["4.2701861951571908374098848594277520E+39",
                    "-9.51477182371612065851896242097995638E+40",
                    "-2.0167866914929483784509827485383359E+39",
+                   "3.09186385410128070998385426348594484E+40",
+                   "7.1672663199631946247197119155144713E+39",
+                   "-9.32396355260007858810554960112006290E+40",
+                   "8.24190234828859904475261796305602287E+40",
+                   "6.10646349654220618869425418121505315E+40",
+                   "-5.4790787707639406411507823776332565E+39",
                    None])
     rhs = dec_col(["-7.4015414116488076297669800353634627E+39",
                    "8.26223612055178995785348949126553327E+40",
                    "3.27796298399180383738215644697505864E+40",
+                   "6.23318861108302118457923491160201752E+40",
+                   "1.2868445730284429449720988121912717E+39",
+                   "-9.89573762074541324330058371364880604E+40",
+                   "1.83583924726137822744760302018523424E+40",
+                   "5.39262612260712860406222466457256229E+40",
+                   "-1.0688816822936864401341690563696501E+39",
                    "-1.0688816822936864401341690563696501E+39"])
     t = d128.add_decimal128(lhs, rhs, -5)
-    check(t, [False, False, False, None],
+    check(t, [False, False, False, False, False, False, False, False, False,
+              None],
           ["-3.1313552164916167923570951759357107E+39",
            "-1.25253570316433070066547292971442311E+40",
            "3.07628431484250899953705817212122505E+40",
+           "9.32505246518430189456308917508796236E+40",
+           "8.4541108929916375696918107277057430E+39",
+           "-1.921970117334549183140613331476886894E+41",
+           "1.007774159554997727220022098324125711E+41",
+           "1.149908961914933479275647884578761544E+41",
+           "-6.5479604530576270812849514340029066E+39",
            None])
 
 
@@ -399,6 +459,43 @@ def test_add_sub_overflow_scale0():
         dec_col(["-99999999999999999999999999999999999999"]),
         dec_col(["1"]), 0)
     assert t[0].to_pylist() == [True]
+
+
+def test_sub_different_scales():
+    # DecimalUtilsTest.java:605-647 (subDifferentScales): lhs scale 10,
+    # rhs scale 2, result scale 9
+    lhs = dec_col(["9191008513307131620269245301.1615457290",
+                   "-9191008513307131620269245301.1615457290",
+                   "577694938495380589068894346.7625198736",
+                   "-7949989536398283250841565918.6123449781",
+                   "-569260079419403643627836417.1451349695",
+                   "4268696962649098725873162852.3422176564",
+                   "948521076935839001259204571.1574829065",
+                   "-9299778357834801251892834048.0026057082",
+                   "8127384240098008972235509102.7063990819",
+                   "-1012433127481465711031073593.0625063701"])
+    rhs = dec_col(["451635271134476686911387864.48",
+                   "-9037370400215680718822505020.06",
+                   "-200173438757934601210092407.67",
+                   "3022290197578200820919308997.64",
+                   "388221337108432989001879408.73",
+                   "-9119163961520067341639997328.82",
+                   "7732813484881363300406806463.83",
+                   "5941454871287785414686091453.79",
+                   "-357209139972312354271434821.33",
+                   "-857448828702886587693936536.21"])
+    t = d128.sub_decimal128(lhs, rhs, 9)
+    check(t, [False] * 10,
+          ["8739373242172654933357857436.681545729",
+           "-153638113091450901446740281.101545729",
+           "777868377253315190278986754.432519874",
+           "-10972279733976484071760874916.252344978",
+           "-957481416527836632629715825.875134970",
+           "13387860924169166067513160181.162217656",
+           "-6784292407945524299147601892.672517094",
+           "-15241233229122586666578925501.792605708",
+           "8484593380070321326506943924.036399082",
+           "-154984298778579123337137056.852506370"])
 
 
 def test_sub_simple():

@@ -60,6 +60,24 @@ def test_unknown_op_raises():
         bridge.call("nope.nothing", "{}", [])
 
 
+def test_zorder_interleave_via_bridge():
+    """ZOrder.interleaveBits through the bridge, both forms: with columns,
+    and the reference's zero-column interleaveBits(numRows) overload
+    (InterleaveBitsTest.java:238-251) via args num_rows."""
+    a = np.array([0x01020304], np.int32)
+    out, _ = bridge.call(
+        "zorder.interleave", "{}",
+        [("int32", 1, a.tobytes(), None, None)])
+    offs = np.frombuffer(out[0][2], np.int64)
+    assert list(offs) == [0, 4]
+    assert list(np.frombuffer(out[1][2], np.uint8)) == [1, 2, 3, 4]
+
+    out0, _ = bridge.call("zorder.interleave",
+                          json.dumps({"num_rows": 3}), [])
+    assert list(np.frombuffer(out0[0][2], np.int64)) == [0, 0, 0, 0]
+    assert len(out0[1][2]) == 0
+
+
 def test_murmur3_matches_ops_module():
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column, Table

@@ -82,6 +82,60 @@ def test_unescape_on_raw_emission():
     assert run(js, "$.url") == "http://nadURdeo2.baRdu.cox/5fa.xT3"
 
 
+def test_long_key_batch():
+    """GetJsonObjectTest.java:44-64 (getJsonObjectTest2): a ~100-char key
+    and value through a 7-row batch."""
+    k = "k1_" + "1" * 97
+    v = "v1_" + "1" * 97
+    js = '{"%s":"%s"}' % (k, v)
+    col = Column.from_pylist([js] * 7, dt.STRING)
+    assert get_json_object(col, "$." + k).to_pylist() == [v] * 7
+
+
+def test_baidu_full_vectors():
+    """GetJsonObjectTest.java:119-155: the full Baidu production JSON —
+    backslash-slash unescape on raw emission and the missing-field null."""
+    js = ('{"brand":"ssssss","duratRon":15,"eqTosuresurl":"",'
+          '"RsZxarthrl":false,"xonRtorsurl":"","xonRtorsurlstOTe":0,'
+          '"TRctures":[{"RxaGe":"VttTs:\\/\\/feed-RxaGe.baRdu.cox\\/0\\/'
+          'TRc\\/-196588744s840172444s-773690137.zTG"}],'
+          '"Toster":"VttTs:\\/\\/feed-RxaGe.baRdu.cox\\/0\\/TRc\\/'
+          '-196588744s840172444s-773690137.zTG",'
+          '"reserUed":{"bRtLate":391.79,"xooUZRke":26876,'
+          '"nahrlIeneratRonNOTe":0,"useJublRc":6,"URdeoRd":821284086},'
+          '"tRtle":"ssssssssssmMsssssssssssssssssss","url":"s{storehrl}",'
+          '"usersTortraRt":"VttTs:\\/\\/feed-RxaGe.baRdu.cox\\/0\\/TRc\\/'
+          '-6971178959s-664926866s-6096674871.zTG",'
+          '"URdeosurl":"http:\\/\\/nadURdeo2.baRdu.cox\\/'
+          '5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3",'
+          '"URdeoRd":821284086}')
+    want = ("http://nadURdeo2.baRdu.cox/"
+            "5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3")
+    col = Column.from_pylist([js] * 7, dt.STRING)
+    assert get_json_object(col, "$.URdeosurl").to_pylist() == [want] * 7
+    # unexist field name -> all nulls
+    assert get_json_object(col, "$.Vgdezsurl").to_pylist() == [None] * 7
+
+
+def test_escape_reference_suite():
+    """GetJsonObjectTest.java:164-189 (getJsonObjectTest_Escape): quote
+    pairing, structural re-escaping, and \\uXXXX decoding on the empty
+    query ($)."""
+    cases = [
+        ('{ "a": "A" }', '{"a":"A"}'),
+        ("{'a':'A\"'}", '{"a":"A\\""}'),
+        ("{'a':\"B'\"}", '{"a":"B\'"}'),
+        ("['a','b','\"C\"']", '["a","b","\\"C\\""]'),
+        # 中国 is 中国; raw emission unescapes everything
+        ("'\\u4e2d\\u56FD\\\"\\'\\\\\\/\\b\\f\\n\\r\\t\\b'",
+         '中国"\'\\/\b\f\n\r\t\b'),
+    ]
+    col = Column.from_pylist([c[0] for c in cases], dt.STRING)
+    got = get_json_object(col, "$").to_pylist()
+    for (j, want), g in zip(cases, got):
+        assert g == want, (j, g, want)
+
+
 def test_escapes_preserved_inside_structures():
     js = '{"a": {"s": "x\\ny"}}'
     assert run(js, "$.a") == '{"s":"x\\ny"}'
@@ -159,8 +213,11 @@ def test_number_normalization_reference_vectors():
         ('0.00003', '$', '3.0E-5'),
         ('00', '$', None),
         ('01', '$', None),
+        ('02', '$', None),
+        ('000', '$', None),
         ('-01', '$', None),
         ('-00', '$', None),
+        ('-02', '$', None),
     ]
     for j, p, want in cases:
         got = get_json_object(

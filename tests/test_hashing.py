@@ -403,3 +403,40 @@ class TestSparkXXHash64:
         s = Column.struct_of([Column.from_pylist([1], dt.INT32)])
         with pytest.raises(TypeError):
             xxhash64([s], 42)
+
+
+def test_null_value_invariance():
+    """hash.cpp:68-142 (MultiValueNulls): rows that are null must hash
+    identically regardless of the garbage behind the null bit, for both
+    murmur3 and xxhash64, across string/int/bool/timestamp columns."""
+    strs1 = ["", "The quick brown fox", "jumps over the lazy dog.",
+             "All work and no play makes Jack a dull boy",
+             "!\"#$%&'()*+,-./0123456789:;<=>?@[\\]^_`{|}~"]
+    strs2 = ["different but null", "The quick brown fox",
+             "jumps over the lazy dog.",
+             "I am Jack's complete lack of null value",
+             "!\"#$%&'()*+,-./0123456789:;<=>?@[\\]^_`{|}~"]
+    sv = np.array([0, 1, 1, 0, 1], dtype=bool)
+    iv = np.array([1, 0, 0, 1, 1], dtype=bool)
+    bv = np.array([1, 1, 0, 0, 1], dtype=bool)
+    i1 = [0, 100, -100, I32_MIN, I32_MAX]
+    i2 = [0, -200, 200, I32_MIN, I32_MAX]
+    b1 = np.array([0, 1, 0, 1, 1], dtype=np.uint8)
+    b2 = np.array([0, 2, 1, 0, 255], dtype=np.uint8)
+    t1 = [0, 100, -100, -9223372036854, 9223372036854]
+    t2 = [0, -200, 200, -9223372036854, 9223372036854]
+
+    def cols(strs, ints, bools, ts):
+        return [
+            Column.from_pylist(strs, dt.STRING).with_validity(sv),
+            Column.from_numpy(np.array(ints, np.int32), dt.INT32,
+                              validity=iv),
+            Column.from_numpy(bools, dt.BOOL8, validity=bv),
+            Column.from_numpy(np.array(ts, np.int64),
+                              dt.TIMESTAMP_MILLISECONDS, validity=iv),
+        ]
+
+    for fn in (murmur_hash3_32, xxhash64):
+        out1 = fn(cols(strs1, i1, b1, t1), 42).to_pylist()
+        out2 = fn(cols(strs2, i2, b2, t2), 42).to_pylist()
+        assert out1 == out2, fn.__name__

@@ -114,6 +114,76 @@ def test_query():
     assert not bad, bad[:5]
 
 
+# ParseURITest.java:292-303 (parseURIUTF8Test) — expectations per
+# java.net.URI: a space in the authority is fatal; percent-escapes are legal
+# in paths but not hostnames; non-ASCII hostname chars fail the ASCII-only
+# hostname parse (registry authority -> getHost() null) while the scheme
+# still parses.
+UTF8_CASES = [
+    ("https:// /path/to/file", None, None, None),
+    ("https://nvidia.com/%4EV%49%44%49%41", "https", "nvidia.com", None),
+    ("http://%77%77%77.%4EV%49%44%49%41.com", "http", None, None),
+    ("http://✪↩d⁚f„⁈.ws/123", "http", None, None),
+]
+
+# ParseURITest.java:306-319 (parseURIIP4Test) — java.net.URI applies
+# RFC2396's toplabel rule (the last hostname label must not start with a
+# digit), so anything that is not a strict dotted-quad IPv4 falls to a
+# registry authority and getHost() is null.
+IP4_CASES = [
+    ("https://192.168.1.100/", "https", "192.168.1.100", None),
+    ("https://192.168.1.100:8443/", "https", "192.168.1.100", None),
+    ("https://192.168.1.100.5/", "https", None, None),
+    ("https://192.168.1/", "https", None, None),
+    ("https://280.100.1.1/", "https", None, None),
+    ("https://182.168..100/path/to/file", "https", None, None),
+]
+
+# ParseURITest.java:322-348 (parseURIIP6Test) — bracketed literals keep
+# their source text (including case and scope ids); malformed literals are
+# fatal to the whole URI.
+IP6_CASES = [
+    ("https://[fe80::]", "https", "[fe80::]", None),
+    ("https://[2001:0db8:85a3:0000:0000:8a2e:0370:7334]",
+     "https", "[2001:0db8:85a3:0000:0000:8a2e:0370:7334]", None),
+    ("https://[2001:0DB8:85A3:0000:0000:8A2E:0370:7334]",
+     "https", "[2001:0DB8:85A3:0000:0000:8A2E:0370:7334]", None),
+    ("https://[2001:db8::1:0]", "https", "[2001:db8::1:0]", None),
+    ("http://[2001:db8::2:1]", "http", "[2001:db8::2:1]", None),
+    ("https://[::1]", "https", "[::1]", None),
+    ("https://[2001:db8:85a3:8d3:1319:8a2e:370:7348]:443",
+     "https", "[2001:db8:85a3:8d3:1319:8a2e:370:7348]", None),
+    ("https://[2001:db8:3333:4444:5555:6666:1.2.3.4]/path/to/file",
+     "https", "[2001:db8:3333:4444:5555:6666:1.2.3.4]", None),
+    ("https://[2001:db8:3333:4444:5555:6666:7777:8888:1.2.3.4]/path/to/file",
+     None, None, None),
+    ("https://[::db8:3333:4444:5555:6666:1.2.3.4]/path/to/file]",
+     None, None, None),
+    ("https://[2001:]db8:85a3:8d3:1319:8a2e:370:7348/", None, None, None),
+    ("https://[][][][]nvidia.com/", None, None, None),
+    ("https://[2001:db8:85a3:8d3:1319:8a2e:370:7348:2001:db8:85a3]/path",
+     None, None, None),
+    ("http://[1:2:3:4:5:6:7::]", "http", "[1:2:3:4:5:6:7::]", None),
+    ("http://[::2:3:4:5:6:7:8]", "http", "[::2:3:4:5:6:7:8]", None),
+    ("http://[fe80::7:8%eth0]", "http", "[fe80::7:8%eth0]", None),
+    ("http://[fe80::7:8%1]", "http", "[fe80::7:8%1]", None),
+]
+
+
+@pytest.mark.parametrize("cases", [UTF8_CASES, IP4_CASES, IP6_CASES],
+                         ids=["utf8", "ip4", "ip6"])
+def test_reference_suites(cases):
+    col = Column.from_pylist([c[0] for c in cases], dt.STRING)
+    got_p = parse_uri_to_protocol(col).to_pylist()
+    got_h = parse_uri_to_host(col).to_pylist()
+    got_q = parse_uri_to_query(col).to_pylist()
+    got_k = parse_uri_to_query_with_literal(col, "query").to_pylist()
+    for (u, p, h, q), gp, gh, gq, gk in zip(cases, got_p, got_h, got_q,
+                                            got_k):
+        assert (gp, gh, gq) == (p, h, q), (u, (gp, gh, gq), (p, h, q))
+        assert gk is None, (u, gk)  # no row in these sets has ?query=
+
+
 QUERY_KEY_CASES = [
     ("https://www.nvidia.com/path?param0=1&param2=3&param4=5%206", "param0", "1"),
     ("https://www.nvidia.com/path?param0=1&param2=3&param4=5%206", "param2", "3"),
@@ -125,6 +195,12 @@ QUERY_KEY_CASES = [
     ("nvidia.com:8080", "a", None),             # opaque -> no query
     ("https://nvidia.com/2Ru15Ss ", "a", None),  # fatal -> null
     (None, "a", None),
+    # ParseURITest queries[] oddities: a key containing '=' never matches
+    # (the pair splits at the FIRST '='), and a missing-value key matches
+    # nothing when the query has no such prefix
+    ("http://www.nvidia.com/picshow.asp?id=106&mnid=5080&classname=x",
+     "mnid=5080", None),
+    ("https://www.nvidia.com/?cat=12", "", None),
 ]
 
 

@@ -157,6 +157,26 @@ def test_cast_to_integer_no_strip_reference_vectors():
         assert got == want, (strs, got, want)
 
 
+def test_cast_to_decimal_no_strip_reference_vectors():
+    """CastStringsTest.castToDecimalNoStripTest — same matrix as
+    castToDecimalTest but with strip=False: unstripped whitespace rows
+    become null."""
+    from spark_rapids_jni_tpu.ops.cast_string import string_to_decimal
+    batches = [
+        ([" 3", "9", "4", "2", "20.5", None, "7.6asd"], 2, 0,
+         [None, D(9), D(4), D(2), D(21), None, None]),
+        (["5", "1 ", "0", "2", "7.1", None, "asdf"], 10, 0,
+         [D(5), None, D(0), D(2), D(7), None, None]),
+        (["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3"], 3, -1,
+         [D("2.0"), D("3.0"), None, D("5.1"), D("9.2"), None, None]),
+    ]
+    for strs, prec, scale, want in batches:
+        got = string_to_decimal(
+            Column.from_pylist(strs, dt.STRING), prec, scale,
+            strip=False).to_pylist()
+        assert got == want, (strs, got, want)
+
+
 def test_cast_to_integer_ansi_reference_vectors():
     """CastStringsTest.castToIntegerAnsiTest — the exception carries the
     first offending row index and string."""
@@ -235,3 +255,56 @@ def test_bloom_filter_reference_vectors():
     assert bf.bloom_filter_probe(probe, filt2).to_pylist() == \
         [False, True, True, False, True, True, True, False, False, False,
          False]
+
+
+def test_bloom_filter_probe_nulls_reference_vectors():
+    """BloomFilterTest.testBuildAndProbeWithNulls — null probe rows yield
+    null results."""
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    longs = (4 * 1024 * 1024) // 64
+    filt = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([20, 80, 100, 99, 47, -9, 234000000], dt.INT64))
+    probe = Column.from_pylist(
+        [None, None, None, 99, 47, -9, 234000000, None, None, 2, 3],
+        dt.INT64)
+    assert bf.bloom_filter_probe(probe, filt).to_pylist() == \
+        [None, None, None, True, True, True, True, None, None, False, False]
+
+
+def test_bloom_filter_merge_reference_vectors():
+    """BloomFilterTest.testBuildMergeProbe + testBuildTrivialMergeProbe at
+    the reference's exact sizes, plus the four expected-failure shapes
+    (0 hashes, 0 size, mixed hash counts, mixed sizes)."""
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    longs = (4 * 1024 * 1024) // 64
+    fa = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([20, 80, 100, 99, 47, -9, 234000000], dt.INT64))
+    fb = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([100, 200, 300, 400], dt.INT64))
+    fc = bf.bloom_filter_put(
+        bf.bloom_filter_create(3, longs),
+        Column.from_pylist([-100, -200, -300, -400], dt.INT64))
+    probe = Column.from_pylist(
+        [-9, 200, 300, 6000, -2546, 99, 65535, 0, -100, -200, -300, -400],
+        dt.INT64)
+    merged = bf.bloom_filter_merge([fa, fb, fc])
+    assert bf.bloom_filter_probe(probe, merged).to_pylist() == \
+        [True, True, True, False, False, True, False, False, True, True,
+         True, True]
+    trivial = bf.bloom_filter_merge([fa])
+    assert bf.bloom_filter_probe(probe, trivial).to_pylist() == \
+        [True, False, False, False, False, True, False, False, False,
+         False, False, False]
+    with pytest.raises(ValueError):
+        bf.bloom_filter_create(0, 1)
+    with pytest.raises(ValueError):
+        bf.bloom_filter_create(3, 0)
+    with pytest.raises(ValueError):
+        bf.bloom_filter_merge([bf.bloom_filter_create(3, 16),
+                               bf.bloom_filter_create(4, 16)])
+    with pytest.raises(ValueError):
+        bf.bloom_filter_merge([bf.bloom_filter_create(3, 16),
+                               bf.bloom_filter_create(3, 32)])
