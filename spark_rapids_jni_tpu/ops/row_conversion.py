@@ -268,6 +268,74 @@ def _blob_bucket(total: int) -> int:
     return pad_width(total, 1 << 16)
 
 
+# Row-matrix padding blowup guard: the fast word-flatten path pads every
+# row to the max (aligned) row size, so one huge string row would inflate
+# the [n, row_pad] matrix for all rows. Beyond 8x the mean row size (or an
+# absolute 4 KB), fall back to the per-byte gather path whose memory is
+# blob-proportional.
+_ROWMAT_MAX_BLOWUP = 8
+_ROWMAT_MAX_ROW_PAD = 4096
+
+
+@partial(jax.jit, static_argnames=("spr", "row_pad", "padded_words"))
+def _assemble_blob_rowmat(fixed_words, mats, lenss, starts, row_words,
+                          word_roffs, *, spr, row_pad, padded_words):
+    """Two-phase JCUDF blob assembly (fast path).
+
+    Phase 1 is row-LOCAL: build uint8[n, row_pad] where row i holds its
+    fixed region at [0, spr) and its string bytes at their row-relative
+    offsets — every index computed from that row's own lengths, so XLA
+    vectorizes it as plain [n, W]-shaped arithmetic + take_along_axis
+    (small, cache-friendly windows) with no cross-row decode.
+
+    Phase 2 flattens tight at 8-byte WORD granularity: rows are 8-aligned
+    (JCUDF_ROW_ALIGNMENT), so the padded matrix bitcasts to uint64[n,
+    row_pad/8] and one gather of total/8 words packs the blob — 8x fewer
+    gather elements than the per-byte path, and the per-output 'which row'
+    decode collapses to jnp.repeat over row word counts.
+
+    Replaces the per-byte path (below) for typical string data; profiled
+    5-10x faster on CPU at 1M rows and strictly fewer gathered elements for
+    the TPU. Reference bar: copy_strings_to_rows (row_conversion.cu:813).
+    """
+    n = fixed_words.shape[0]
+    # fixed region arrives as the uint32 words _build_fixed_words produced;
+    # bitcasting to bytes INSIDE this jit lets XLA fuse the conversion into
+    # the concat instead of materializing a byte copy of the fixed region
+    fixed = jax.lax.bitcast_convert_type(
+        fixed_words, jnp.uint8).reshape(n, fixed_words.shape[1] * 4)
+    width = row_pad - spr
+    c = jnp.arange(width, dtype=jnp.int32)
+    if len(mats) == 1:
+        # one string column: its bytes always start exactly at spr, so the
+        # window is a masked zero-pad of the padded matrix — no gather and
+        # no [n, width] int32 index intermediates at all
+        mat, lens = mats[0], lenss[0]
+        w2 = min(mat.shape[1], width)  # width >= max len, so the slice is safe
+        masked = jnp.where(c[None, :w2] < lens[:, None], mat[:, :w2],
+                           jnp.uint8(0))
+        win = (masked if w2 == width
+               else jnp.pad(masked, ((0, 0), (0, width - w2))))
+    else:
+        win = jnp.zeros((n, width), dtype=jnp.uint8)
+        for mat, lens, start in zip(mats, lenss, starts):
+            j = c[None, :] - (start[:, None] - spr)
+            in_s = (j >= 0) & (j < lens[:, None])
+            byte_s = jnp.take_along_axis(
+                mat, jnp.clip(j, 0, mat.shape[1] - 1), axis=1)
+            win = jnp.where(in_s, byte_s, win)
+    rowmat = jnp.concatenate([fixed[:, :spr], win], axis=1)
+    roww = jax.lax.bitcast_convert_type(
+        rowmat.reshape(n, row_pad // 8, 8), jnp.uint64)  # [n, row_pad/8]
+
+    row = jnp.repeat(jnp.arange(n, dtype=jnp.int32), row_words,
+                     total_repeat_length=padded_words)
+    relw = jnp.arange(padded_words, dtype=jnp.int32) - word_roffs[row]
+    src = row * (row_pad // 8) + jnp.clip(relw, 0, row_pad // 8 - 1)
+    words = roww.reshape(-1)[jnp.clip(src, 0, n * (row_pad // 8) - 1)]
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(-1)
+
+
 @partial(jax.jit, static_argnames=("spr", "padded_total"))
 def _assemble_blob(fixed, mats, lenss, starts, roffs, *, spr, padded_total):
     """One fused device program building a (padded) JCUDF blob by gather.
@@ -353,10 +421,12 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         ((info.size_per_row + total_str + JCUDF_ROW_ALIGNMENT - 1)
          // JCUDF_ROW_ALIGNMENT) * JCUDF_ROW_ALIGNMENT, dtype=np.int64)
 
-    # fixed region as bytes (word-built; tail bytes past size_per_row unused)
+    # fixed region as uint32 words (bytes are produced inside the assembly
+    # jits so the conversion fuses; tail bytes past size_per_row unused)
     spr = info.size_per_row
-    fixed = _words_to_u8(_build_fixed_words(
-        table, info, _round_up(spr, 4), var_offsets, lengths))
+    fixed_words = _build_fixed_words(
+        table, info, _round_up(spr, 4), var_offsets, lengths)
+    fixed = None  # byte view, materialized only if the fallback needs it
     padded = [padded_bytes(c) for c in string_cols]
     bounds = _batch_boundaries(row_sizes_np, max_batch_bytes)
 
@@ -367,19 +437,38 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         row_offsets = np.zeros(nb + 1, dtype=np.int64)
         np.cumsum(sizes, out=row_offsets[1:])
         total = int(row_offsets[-1])
-        roffs = jnp.asarray(row_offsets, dtype=jnp.int32)
 
         if nb == 0 or total == 0:
             out.append(_rows_column(jnp.zeros((0,), jnp.uint8), row_offsets))
             continue
-        # gather-based blob (scatters serialize on TPU; gathers vectorize),
-        # fused in one jit, length-bucketed to bound the compile cache
-        blob = _assemble_blob(
-            fixed[b0:b1],
-            tuple(mat[b0:b1] for mat, _ in padded),
-            tuple(lens[b0:b1] for _, lens in padded),
-            tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
-            roffs, spr=spr, padded_total=_blob_bucket(total))[:total]
+        max_row = int(sizes.max())
+        # multiple-of-16 bucket (not pow2): the [n, row_pad] matrix is the
+        # dominant allocation, and pow2 rounding nearly doubles it at e.g.
+        # max_row=72; at most 256 distinct specializations below the 4K cap
+        row_pad = _round_up(max_row, 16)
+        if (row_pad <= _ROWMAT_MAX_ROW_PAD
+                and nb * row_pad <= _ROWMAT_MAX_BLOWUP * total):
+            # fast path: row-local assembly + word-granular tight flatten
+            row_words = jnp.asarray(sizes // 8, dtype=jnp.int32)
+            word_roffs = jnp.asarray(row_offsets // 8, dtype=jnp.int32)
+            blob = _assemble_blob_rowmat(
+                fixed_words[b0:b1],
+                tuple(mat[b0:b1] for mat, _ in padded),
+                tuple(lens[b0:b1] for _, lens in padded),
+                tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
+                row_words, word_roffs, spr=spr, row_pad=row_pad,
+                padded_words=_blob_bucket(total) // 8)[:total]
+        else:
+            # skew fallback: per-byte gather, memory stays blob-proportional
+            if fixed is None:
+                fixed = _words_to_u8(fixed_words)
+            roffs = jnp.asarray(row_offsets, dtype=jnp.int32)
+            blob = _assemble_blob(
+                fixed[b0:b1],
+                tuple(mat[b0:b1] for mat, _ in padded),
+                tuple(lens[b0:b1] for _, lens in padded),
+                tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
+                roffs, spr=spr, padded_total=_blob_bucket(total))[:total]
         out.append(_rows_column(blob, row_offsets))
     return out
 

@@ -168,3 +168,48 @@ def test_empty_table_round_trip():
     assert rows.size == 0
     back = rc.convert_from_rows(rows, [dt.INT64, dt.STRING])
     assert [c.to_pylist() for c in back.columns] == [[], []]
+
+
+def test_skewed_strings_use_fallback_and_roundtrip():
+    """One pathological row (8 KB string among tiny ones) must route the
+    batch to the blob-proportional per-byte fallback (_assemble_blob) —
+    the row-matrix fast path would pad every row to ~8 KB — and still
+    round-trip exactly."""
+    strs = [f"s{i}" for i in range(5000)]
+    strs[1234] = "X" * 8192
+    t = Table((Column.from_pylist(list(range(5000)), dt.INT64),
+               Column.from_pylist(strs, dt.STRING)))
+    max_row = 8192  # row_pad would exceed _ROWMAT_MAX_ROW_PAD
+    assert rc._round_up(max_row, 16) > rc._ROWMAT_MAX_ROW_PAD
+    [rows] = rc.convert_to_rows(t)
+    back = rc.convert_from_rows(rows, [dt.INT64, dt.STRING])
+    assert back.columns[1].to_pylist() == strs
+    assert back.columns[0].to_pylist() == list(range(5000))
+
+
+def test_moderate_blowup_guard_roundtrip():
+    """Rows just below the absolute row_pad cap but above the x8 mean-size
+    blowup guard also take the fallback; equal results either way."""
+    strs = ["ab"] * 2000
+    strs[7] = "Y" * 2000  # max_row ~2 KB, mean ~40 B -> blowup >> 8x
+    t = Table((Column.from_pylist(strs, dt.STRING),))
+    [rows] = rc.convert_to_rows(t)
+    back = rc.convert_from_rows(rows, [dt.STRING])
+    assert back.columns[0].to_pylist() == strs
+
+
+def test_two_string_columns_rowmat_path():
+    """Two string columns exercise the take_along_axis branch of the
+    row-matrix fast path (starts vary per row)."""
+    rng = np.random.default_rng(11)
+    a = ["".join(chr(97 + int(x)) for x in rng.integers(0, 26, int(n)))
+         for n in rng.integers(0, 20, 3000)]
+    b = ["".join(chr(65 + int(x)) for x in rng.integers(0, 26, int(n)))
+         for n in rng.integers(0, 15, 3000)]
+    t = Table((Column.from_pylist(a, dt.STRING),
+               Column.from_pylist(list(range(3000)), dt.INT32),
+               Column.from_pylist(b, dt.STRING)))
+    [rows] = rc.convert_to_rows(t)
+    back = rc.convert_from_rows(rows, [c.dtype for c in t.columns])
+    assert back.columns[0].to_pylist() == a
+    assert back.columns[2].to_pylist() == b
