@@ -486,7 +486,232 @@ static void drive_engine(const char* path, const char* repo_root) {
     printf("jvm_sim: engine json.get_json_object ok\n");
   }
 
-  printf("jvm_sim: engine bridge ok (10 kernel ops)\n");
+  /* 5k. cast.string_to_float — invalid row nulls out */
+  {
+    const char* rows[2] = {"1.5", "bogus"};
+    uint8_t data[64];
+    int64_t offsets[3];
+    pack_rows(rows, 2, data, offsets);
+    eb_col in = {"string", 2, data, offsets[2], offsets, NULL};
+    eb_result* r = must_call("cast.string_to_float",
+                             "{\"type\": \"float64\"}", &in, 1);
+    const double* vals = (const double*)r->cols[0].data;
+    if (vals[0] != 1.5 || !r->cols[0].validity ||
+        r->cols[0].validity[0] != 1 || r->cols[0].validity[1] != 0)
+      DIE("string_to_float mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine cast.string_to_float ok\n");
+  }
+
+  /* 5l. cast.string_to_decimal — "1.5" @ precision 3, scale -1 */
+  {
+    const char* rows[1] = {"1.5"};
+    uint8_t data[16];
+    int64_t offsets[2];
+    pack_rows(rows, 1, data, offsets);
+    eb_col in = {"string", 1, data, offsets[1], offsets, NULL};
+    eb_result* r = must_call("cast.string_to_decimal",
+                             "{\"precision\": 3, \"scale\": -1}", &in, 1);
+    if (((const int32_t*)r->cols[0].data)[0] != 15)
+      DIE("string_to_decimal mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine cast.string_to_decimal ok\n");
+  }
+
+  /* 5m. cast.format_number — Spark format_number(1234.5, 2) */
+  {
+    double v = 1234.5;
+    eb_col in = {"float64", 1, (const uint8_t*)&v, 8, NULL, NULL};
+    eb_result* r = must_call("cast.format_number", "{\"digits\": 2}",
+                             &in, 1);
+    const char* want[1] = {"1,234.50"};
+    uint8_t all_valid[1] = {1};
+    check_rows("fmtnum", want, 1, r->cols[0].data, r->cols[0].offsets,
+               r->cols[0].validity ? r->cols[0].validity : all_valid);
+    eb_free(r);
+    printf("jvm_sim: engine cast.format_number ok\n");
+  }
+
+  /* 5n. cast.decimal_to_string — 150 @ scale 2 -> "1.50" */
+  {
+    uint32_t limbs[4] = {150, 0, 0, 0};
+    eb_col in = {"decimal128:2", 1, (const uint8_t*)limbs, 16, NULL, NULL};
+    eb_result* r = must_call("cast.decimal_to_string", "{}", &in, 1);
+    const char* want[1] = {"1.50"};
+    uint8_t all_valid[1] = {1};
+    check_rows("d2s", want, 1, r->cols[0].data, r->cols[0].offsets,
+               r->cols[0].validity ? r->cols[0].validity : all_valid);
+    eb_free(r);
+    printf("jvm_sim: engine cast.decimal_to_string ok\n");
+  }
+
+  /* 5o/5p. base-16 string<->integer pipelines */
+  {
+    const char* rows[1] = {"ff"};
+    uint8_t data[8];
+    int64_t offsets[2];
+    pack_rows(rows, 1, data, offsets);
+    eb_col in = {"string", 1, data, offsets[1], offsets, NULL};
+    eb_result* r = must_call("cast.string_to_integer_base",
+                             "{\"base\": 16, \"type\": \"int64\"}", &in, 1);
+    if (((const int64_t*)r->cols[0].data)[0] != 255)
+      DIE("string_to_integer_base mismatch");
+    eb_free(r);
+
+    int64_t v255 = 255;
+    eb_col iin = i64_col(&v255, 1);
+    r = must_call("cast.integer_to_string_base", "{\"base\": 16}", &iin, 1);
+    const char* want[1] = {"FF"};
+    uint8_t all_valid[1] = {1};
+    check_rows("i2sb", want, 1, r->cols[0].data, r->cols[0].offsets,
+               r->cols[0].validity ? r->cols[0].validity : all_valid);
+    eb_free(r);
+    printf("jvm_sim: engine cast base-16 pipelines ok\n");
+  }
+
+  /* 5q/5r/5s. decimal multiply / subtract / remainder */
+  {
+    uint32_t la[4] = {100, 0, 0, 0};  /* 1.00 @ scale 2 */
+    uint32_t lb[4] = {250, 0, 0, 0};  /* 2.50 */
+    eb_col a = {"decimal128:2", 1, (const uint8_t*)la, 16, NULL, NULL};
+    eb_col b = {"decimal128:2", 1, (const uint8_t*)lb, 16, NULL, NULL};
+    eb_col ab[2] = {a, b};
+    eb_result* r = must_call("decimal.multiply", "{\"scale\": 2}", ab, 2);
+    if (r->cols[0].data[0] != 0 ||
+        ((const uint32_t*)r->cols[1].data)[0] != 250)
+      DIE("decimal multiply mismatch");
+    eb_free(r);
+    eb_col ba[2] = {b, a};
+    r = must_call("decimal.subtract", "{\"scale\": 2}", ba, 2);
+    if (((const uint32_t*)r->cols[1].data)[0] != 150)
+      DIE("decimal subtract mismatch");
+    eb_free(r);
+    r = must_call("decimal.remainder", "{\"scale\": 2}", ba, 2);
+    if (((const uint32_t*)r->cols[1].data)[0] != 50)
+      DIE("decimal remainder mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine decimal multiply/subtract/remainder ok\n");
+  }
+
+  /* 5t/5u. timezone conversion both directions (Asia/Shanghai, +8h) */
+  {
+    int64_t zero = 0;
+    eb_col in = {"timestamp_us", 1, (const uint8_t*)&zero, 8, NULL, NULL};
+    eb_result* r = must_call("tz.from_utc",
+                             "{\"zone\": \"Asia/Shanghai\"}", &in, 1);
+    int64_t shifted = ((const int64_t*)r->cols[0].data)[0];
+    if (shifted != 28800000000LL) DIE("tz.from_utc mismatch");
+    eb_free(r);
+    eb_col in2 = {"timestamp_us", 1, (const uint8_t*)&shifted, 8, NULL,
+                  NULL};
+    r = must_call("tz.to_utc", "{\"zone\": \"Asia/Shanghai\"}", &in2, 1);
+    if (((const int64_t*)r->cols[0].data)[0] != 0)
+      DIE("tz.to_utc mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine tz from_utc/to_utc ok\n");
+  }
+
+  /* 5v. json.from_json_map — raw key/value map extraction */
+  {
+    const char* rows[1] = {"{\"k\":\"v\"}"};
+    uint8_t data[32];
+    int64_t offsets[2];
+    pack_rows(rows, 1, data, offsets);
+    eb_col in = {"string", 1, data, offsets[1], offsets, NULL};
+    eb_result* r = must_call("json.from_json_map", "{}", &in, 1);
+    const int64_t* moffs = (const int64_t*)r->cols[0].data;
+    /* (map offsets INT64, keys STRING, values STRING, row validity) */
+    if (r->n_cols != 4 || moffs[0] != 0 || moffs[1] != 1 ||
+        r->cols[1].data[0] != 'k' || r->cols[2].data[0] != 'v' ||
+        r->cols[3].data[0] != 1)
+      DIE("from_json_map mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine json.from_json_map ok\n");
+  }
+
+  /* 5w. bloom.merge — two filters OR together, probe hits both */
+  {
+    int64_t k1 = 10, k2 = 77;
+    eb_col c1 = i64_col(&k1, 1);
+    eb_col c2 = i64_col(&k2, 1);
+    const char* cargs = "{\"num_hashes\": 3, \"num_longs\": 64}";
+    eb_result* b1 = must_call("bloom.build", cargs, &c1, 1);
+    eb_result* b2 = must_call("bloom.build", cargs, &c2, 1);
+    eb_col blobs[2];
+    blobs[0].dtype = b1->cols[0].dtype;
+    blobs[0].rows = b1->cols[0].rows;
+    blobs[0].data = b1->cols[0].data;
+    blobs[0].data_bytes = b1->cols[0].data_bytes;
+    blobs[0].offsets = NULL;
+    blobs[0].validity = NULL;
+    blobs[1] = blobs[0];
+    blobs[1].data = b2->cols[0].data;
+    blobs[1].data_bytes = b2->cols[0].data_bytes;
+    blobs[1].rows = b2->cols[0].rows;
+    eb_result* m = must_call("bloom.merge", "{}", blobs, 2);
+    int64_t probes[3] = {10, 77, 99};
+    eb_col pin[2];
+    pin[0] = i64_col(probes, 3);
+    pin[1].dtype = m->cols[0].dtype;
+    pin[1].rows = m->cols[0].rows;
+    pin[1].data = m->cols[0].data;
+    pin[1].data_bytes = m->cols[0].data_bytes;
+    pin[1].offsets = NULL;
+    pin[1].validity = NULL;
+    eb_result* r = must_call("bloom.probe", "{}", pin, 2);
+    if (r->cols[0].data[0] != 1 || r->cols[0].data[1] != 1 ||
+        r->cols[0].data[2] != 0)
+      DIE("bloom merge/probe mismatch");
+    eb_free(r);
+    eb_free(m);
+    eb_free(b1);
+    eb_free(b2);
+    printf("jvm_sim: engine bloom.merge ok\n");
+  }
+
+  /* 5x. zorder.hilbert — origin maps to index 0 */
+  {
+    int32_t zero32 = 0;
+    eb_col x = {"int32", 1, (const uint8_t*)&zero32, 4, NULL, NULL};
+    eb_col xy[2] = {x, x};
+    eb_result* r = must_call("zorder.hilbert", "{\"num_bits\": 4}", xy, 2);
+    if (((const int64_t*)r->cols[0].data)[0] != 0)
+      DIE("hilbert mismatch");
+    eb_free(r);
+    printf("jvm_sim: engine zorder.hilbert ok\n");
+  }
+
+  /* 5y. histogram.create -> histogram.percentile (median) */
+  {
+    int64_t vals[4] = {1, 2, 3, 4};
+    int64_t freqs[4] = {1, 1, 1, 1};
+    eb_col ins[2];
+    ins[0] = i64_col(vals, 4);
+    ins[1] = i64_col(freqs, 4);
+    eb_result* h = must_call("histogram.create", "{\"as_lists\": false}",
+                             ins, 2);
+    if (h->n_cols != 3) DIE("histogram.create should return 3 columns");
+    eb_col hin[3];
+    for (int i = 0; i < 3; i++) {
+      hin[i].dtype = h->cols[i].dtype;
+      hin[i].rows = h->cols[i].rows;
+      hin[i].data = h->cols[i].data;
+      hin[i].data_bytes = h->cols[i].data_bytes;
+      hin[i].offsets = h->cols[i].offsets;
+      hin[i].validity = h->cols[i].validity;
+    }
+    eb_result* r = must_call(
+        "histogram.percentile",
+        "{\"percentages\": [0.5], \"as_list\": false}", hin, 3);
+    double med;
+    memcpy(&med, r->cols[0].data, 8);
+    if (med != 2.5) DIE("percentile mismatch: %f", med);
+    eb_free(r);
+    eb_free(h);
+    printf("jvm_sim: engine histogram create/percentile ok\n");
+  }
+
+  printf("jvm_sim: engine bridge ok (24 kernel ops)\n");
 }
 
 int main(int argc, char** argv) {

@@ -17,16 +17,16 @@ public final class CastStrings {
   public static EngineColumn toInteger(EngineColumn col, boolean ansi,
                                        String intType) {
     return Engine.call("cast.string_to_integer",
-        "{\"type\": \"" + intType + "\", \"ansi\": " + ansi + "}", col)
-        .columns[0];
+        "{\"type\": " + Json.str(intType) + ", \"ansi\": " + ansi + "}",
+        col).columns[0];
   }
 
   /** string -> float32/float64 (inf/nan literals, trailing f/d). */
   public static EngineColumn toFloat(EngineColumn col, boolean ansi,
                                      String floatType) {
     return Engine.call("cast.string_to_float",
-        "{\"type\": \"" + floatType + "\", \"ansi\": " + ansi + "}", col)
-        .columns[0];
+        "{\"type\": " + Json.str(floatType) + ", \"ansi\": " + ansi + "}",
+        col).columns[0];
   }
 
   /**
@@ -61,8 +61,8 @@ public final class CastStrings {
   public static EngineColumn toIntegersWithBase(EngineColumn col, int base,
                                                 String intType) {
     return Engine.call("cast.string_to_integer_base",
-        "{\"base\": " + base + ", \"type\": \"" + intType + "\"}", col)
-        .columns[0];
+        "{\"base\": " + base + ", \"type\": " + Json.str(intType) + "}",
+        col).columns[0];
   }
 
   /** Render integers in base 10 (signed) / 16 (unsigned hex). */

@@ -13,14 +13,14 @@ public final class GpuTimeZoneDB {
   /** timestamp in `zone` local time -> UTC (TIMESTAMP_MICROSECONDS). */
   public static EngineColumn fromTimestampToUtcTimestamp(EngineColumn col,
                                                          String zone) {
-    return Engine.call("tz.to_utc", "{\"zone\": \"" + zone + "\"}", col)
+    return Engine.call("tz.to_utc", "{\"zone\": " + Json.str(zone) + "}", col)
         .columns[0];
   }
 
   /** UTC timestamp -> `zone` local time (TIMESTAMP_MICROSECONDS). */
   public static EngineColumn fromUtcTimestampToTimestamp(EngineColumn col,
                                                          String zone) {
-    return Engine.call("tz.from_utc", "{\"zone\": \"" + zone + "\"}", col)
+    return Engine.call("tz.from_utc", "{\"zone\": " + Json.str(zone) + "}", col)
         .columns[0];
   }
 }

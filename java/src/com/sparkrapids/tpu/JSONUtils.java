@@ -10,9 +10,7 @@ public final class JSONUtils {
   private JSONUtils() {}
 
   public static EngineColumn getJsonObject(EngineColumn col, String path) {
-    // minimal JSON string escaping for the path literal
-    String esc = path.replace("\\", "\\\\").replace("\"", "\\\"");
     return Engine.call("json.get_json_object",
-        "{\"path\": \"" + esc + "\"}", col).columns[0];
+        "{\"path\": " + Json.str(path) + "}", col).columns[0];
   }
 }

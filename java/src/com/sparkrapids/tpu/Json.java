@@ -1,0 +1,40 @@
+/*
+ * Minimal JSON string escaping for facade argument marshalling. The facades
+ * build their args JSON by concatenation (matching the reference's thin
+ * static-method style); every STRING value interpolated into that JSON must
+ * pass through Json.str so quotes, backslashes, and control characters
+ * cannot produce malformed JSON (or worse, smuggle extra keys) on the
+ * bridge's json.loads side.
+ */
+package com.sparkrapids.tpu;
+
+public final class Json {
+  private Json() {}
+
+  /** Quote + escape a string as a JSON string literal (null -> null). */
+  public static String str(String s) {
+    if (s == null) return "null";
+    StringBuilder sb = new StringBuilder(s.length() + 2);
+    sb.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"': sb.append("\\\""); break;
+        case '\\': sb.append("\\\\"); break;
+        case '\b': sb.append("\\b"); break;
+        case '\f': sb.append("\\f"); break;
+        case '\n': sb.append("\\n"); break;
+        case '\r': sb.append("\\r"); break;
+        case '\t': sb.append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            sb.append(String.format("\\u%04x", (int) c));
+          } else {
+            sb.append(c);
+          }
+      }
+    }
+    sb.append('"');
+    return sb.toString();
+  }
+}

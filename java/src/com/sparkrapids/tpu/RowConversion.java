@@ -24,7 +24,7 @@ public final class RowConversion {
     StringBuilder sb = new StringBuilder("{\"types\": [");
     for (int i = 0; i < types.length; i++) {
       if (i > 0) sb.append(", ");
-      sb.append('"').append(types[i]).append('"');
+      sb.append(Json.str(types[i]));
     }
     sb.append("]}");
     return Engine.call("rowconv.from_rows", sb.toString(), blob, offsets)

