@@ -83,17 +83,21 @@ def _s2f(np, jnp):
     from spark_rapids_jni_tpu.columnar.column import Column
     from spark_rapids_jni_tpu.ops.cast_string import string_to_float
     rng = np.random.default_rng(1)
-    vals = rng.standard_normal(2000) * 10.0 ** rng.integers(-20, 20, 2000)
-    strs = [f"{v:.10e}" for v in vals]
+    vals = rng.standard_normal(2000) * 10.0 ** rng.integers(-300, 300, 2000)
+    strs = [f"{v:.17e}" for v in vals] + [
+        "5e-324", "2.47e-324", "1.7976931348623157e308", "1e300", "-1e-310"]
     col = Column.from_pylist(strs, dt.STRING)
     out = string_to_float(col, dt.FLOAT64)
-    got = np.asarray(out.data).view(np.float64)
-    want = np.array([float(s) for s in strs])
-    # the engine reproduces the reference parser's accuracy contract
-    # (cast_string_to_float.cu digit accumulation): within 1 ULP of the
-    # correctly-rounded value, exact for most inputs
-    ulp = np.abs(got.view(np.int64) - want.view(np.int64))
-    assert ulp.max() <= 1, ulp.max()
+    got = np.asarray(out.data)  # FLOAT64 storage = uint64 bit patterns
+    # bit-exact on this corpus since the integer Eisel–Lemire assembly
+    # (ops/float_bits.py — correctly rounded everywhere except inputs
+    # within ~2^-75 of a rounding boundary, none known): the parse never
+    # touches device f64, so the full double range incl. subnormals must
+    # match the CPython oracle on-chip (round 4: the old f64-pow path
+    # diverged 2288 ULP here and flushed |x| outside float32 range)
+    bad = [s for i, s in enumerate(strs)
+           if got[i] != np.float64(float(s)).view(np.uint64)]
+    assert not bad, f"{len(bad)} bit mismatches, first: {bad[:3]}"
 
 
 @check("row_conversion_roundtrip")
@@ -151,8 +155,9 @@ def _join(np, jnp):
     rk = rng.permutation(np.arange(400))[:300]
     lg, rg = inner_join([Column.from_numpy(lk, dt.INT64)],
                         [Column.from_numpy(rk, dt.INT64)])
-    got = sorted(zip(np.asarray(lg.data).tolist(),
-                     np.asarray(rg.data).tolist()))
+    # inner_join returns raw gather-map index arrays (device on
+    # accelerators, numpy on cpu), not Columns
+    got = sorted(zip(np.asarray(lg).tolist(), np.asarray(rg).tolist()))
     rpos = {int(kv): i for i, kv in enumerate(rk)}
     want = sorted((i, rpos[int(kv)]) for i, kv in enumerate(lk)
                   if int(kv) in rpos)
@@ -286,10 +291,21 @@ def _hbm_watermarks(np, jnp):
         RmmSpark.clear_event_handler()
     rep = hbm.report()
     assert rep["brackets"] > 0, rep
-    # chip backends must actually validate; worst offenders ride the report
-    import jax
-    if jax.devices()[0].platform != "cpu":
+    # chip backends must actually validate — when the PJRT client surfaces
+    # allocator counters at all. The axon tunnel returns None from
+    # device.memory_stats() (measured round 4), so availability is probed
+    # rather than inferred from the platform string; an unavailable
+    # counter is reported, not failed — the reservation ledger itself is
+    # exercised either way.
+    stats = hbm.device_memory_stats()
+    if stats is not None and "bytes_in_use" in stats:
+        # the same key bracket_begin/bracket_end require — probe what the
+        # audit actually consumes, not mere presence of a stats dict
         assert rep["validated"] > 0, rep
+    else:
+        rep["device_counters"] = (
+            "unavailable (memory_stats() -> %s)"
+            % ("None" if stats is None else "no bytes_in_use"))
     print(f"smoke: hbm audit: {rep}", file=sys.stderr)
 
 

@@ -30,6 +30,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column
 from ..columnar.strings import from_padded_bytes, pack_byte_rows
+from . import int128
 
 # ---------------------------------------------------------------------------
 # table generation (host, python bignums, once at import)
@@ -100,21 +101,9 @@ def _u64(x):
 
 _M32 = np.uint64(0xFFFFFFFF)
 
-
-def _umul128(a, b):
-    """u64 × u64 → (hi, lo) via 32-bit limb products."""
-    a_lo = a & _M32
-    a_hi = a >> np.uint64(32)
-    b_lo = b & _M32
-    b_hi = b >> np.uint64(32)
-    ll = a_lo * b_lo
-    hl = a_hi * b_lo
-    lh = a_lo * b_hi
-    hh = a_hi * b_hi
-    cross = (ll >> np.uint64(32)) + (hl & _M32) + lh
-    lo = (cross << np.uint64(32)) | (ll & _M32)
-    hi = hh + (hl >> np.uint64(32)) + (cross >> np.uint64(32))
-    return hi, lo
+# u64 × u64 → (hi, lo); one definition shared with the string→float
+# assembly (int128.umul128)
+_umul128 = int128.umul128
 
 
 def _shr128(hi, lo, s):

@@ -45,7 +45,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column
 from ..columnar.dtype import DType, TypeId
 from ..columnar.strings import padded_bytes
-from . import int128
+from . import float_bits, int128
 
 
 class CastException(RuntimeError):
@@ -482,8 +482,8 @@ _MAX_SAFE_DIGITS = 19  # cast_string_to_float.cu:198
 _MAX_HOLDING = np.uint64((2 ** 64 - 1 - 9) // 10)  # cast_string_to_float.cu:401
 
 
-@partial(jax.jit, static_argnames=())
-def _string_to_float_core(mat, lengths, in_valid):
+@partial(jax.jit, static_argnames=("is64",))
+def _string_to_float_core(mat, lengths, in_valid, *, is64: bool):
     n, L = mat.shape
     i0, negative, _ = _lead_sign(mat, lengths, strip=True, signed=True)
     lower = mat | np.uint8(0x20)
@@ -605,35 +605,35 @@ def _string_to_float_core(mat, lengths, in_valid):
     excp = excp | zero_bad
 
     # ---- final value (cast_string_to_float.cu:152-194) ---------------------
+    # Integer-exact Eisel–Lemire bit assembly (ops/float_bits.py): the value
+    # ±digits·10^exp_ten becomes IEEE bits via one u64×u128 fixed-point
+    # multiply — bit-identical on CPU and TPU, where f64 arithmetic is
+    # double-double emulated with float32 range (docs/TPU_NUMERICS.md §1).
     total = (real + trunc).astype(jnp.int32)
     exp_base = trunc - jnp.where(dec, total - dec_pos, 0)
     manual = jnp.where(exp_neg, -exp_val, exp_val)
     exp_ten = exp_base + manual
-    sign_f = jnp.where(negative, -1.0, 1.0)
-    digits_f = digits.astype(jnp.float64)
-    digitsf = sign_f * digits_f
-    safe_digits = jnp.where(digits == 0, np.uint64(1), digits)
-    nd = jnp.floor(jnp.log10(safe_digits.astype(jnp.float64))).astype(jnp.int32) + 1
-    sub_shift = -307 - exp_ten
-    # subnormal two-step: normalize mantissa, then scale by a finite exponent
-    d_sub = digitsf / jnp.power(10.0, (nd - 1 + sub_shift).astype(jnp.float64))
-    out_sub = d_sub * jnp.power(10.0, (nd - 308).astype(jnp.float64))
-    e_abs = jnp.power(10.0, jnp.abs(exp_ten).astype(jnp.float64))
-    out_norm = jnp.where(exp_ten < 0, digitsf / e_abs, digitsf * e_abs)
-    out = jnp.where(sub_shift > 0, out_sub, out_norm)
-    out = jnp.where(exp_ten > 308, sign_f * np.inf, out)
-    out = jnp.where(digits == 0, sign_f * 0.0, out)
+    if is64:
+        bits = float_bits.decimal_to_f64_bits(digits, exp_ten, negative)
+        nan_b = np.uint64(0x7FF8000000000000)
+        inf_b = np.uint64(0x7FF0000000000000)
+        sign_b = jnp.where(negative, np.uint64(1 << 63), np.uint64(0))
+    else:
+        bits = float_bits.decimal_to_f32_bits(digits, exp_ten, negative)
+        nan_b = np.uint64(0x7FC00000)
+        inf_b = np.uint64(0x7F800000)
+        sign_b = jnp.where(negative, np.uint64(1 << 31), np.uint64(0))
 
     # merge literal/handled rows
-    out = jnp.where(is_nan, np.nan, out)
-    out = jnp.where(is_inf, sign_f * np.inf, out)
+    bits = jnp.where(is_nan, nan_b, bits)
+    bits = jnp.where(is_inf, sign_b | inf_b, bits)
     valid = jnp.where(handled, nan_valid | inf_valid, scan_valid)
     valid = valid & in_valid & ~no_payload
     excp = jnp.where(handled,
                      (is_nan & ~nan_valid) | (no_payload & ~is_nan & ~is_inf),
                      excp)
     excp = excp & in_valid
-    return out, valid, excp
+    return bits, valid, excp
 
 
 def string_to_float(col: Column, out_dtype: DType,
@@ -662,19 +662,17 @@ def string_to_float(col: Column, out_dtype: DType,
                       data=jnp.zeros((0,), dtype=out_dtype.np_dtype))
     mat, lengths = padded_bytes(col)
     in_valid = col.valid_mask()
-    out, valid, excp = _string_to_float_core(mat, lengths, in_valid)
+    is64 = out_dtype.id is TypeId.FLOAT64
+    bits, valid, excp = _string_to_float_core(mat, lengths, in_valid,
+                                              is64=is64)
     if ansi_mode:
         _raise_first_error(col, in_valid, ~excp)
-    if out_dtype.id is TypeId.FLOAT64:
-        # Repack into FLOAT64 bit-pattern storage. Note this snapshots the
-        # core's f64 output: exact on CPU; on TPU the parse itself runs at
-        # double-double precision (docs/TPU_NUMERICS.md §1), so exactness
-        # there needs a bits-emitting core (integer mantissa assembly) —
-        # future work.
-        return Column.from_numpy(np.asarray(out).astype(np.float64),
-                                 out_dtype, validity=np.asarray(valid))
-    return Column(out_dtype, n, data=out.astype(out_dtype.np_dtype),
-                  validity=valid)
+    if is64:
+        # bits ARE the FLOAT64 storage (uint64 bit patterns) — device
+        # resident, bit-exact on every backend, no host round-trip
+        return Column(out_dtype, n, data=bits, validity=valid)
+    f32 = lax.bitcast_convert_type(bits.astype(jnp.uint32), jnp.float32)
+    return Column(out_dtype, n, data=f32, validity=valid)
 
 
 def string_to_decimal(col: Column, precision: int, scale: int,

@@ -1,0 +1,176 @@
+"""Integer-exact decimal→binary float assembly (Eisel–Lemire on u64 lanes).
+
+Why this exists: the TPU X64 rewriter emulates f64 as a float32 pair with
+~49 mantissa bits and float32's exponent range (docs/TPU_NUMERICS.md §1), so
+the obvious `digits * 10.0**exp` final step of a string→float cast is wrong
+on-chip — the round-4 on-chip smoke measured 2288 ULP of divergence, and any
+|value| outside ~[1e-38, 3e38] flushes entirely. The fix is the same trick
+the rest of this codebase uses for FLOAT64: never touch device f64 at all.
+This module assembles the IEEE-754 *bit pattern* with pure u64 integer
+arithmetic, which the rewriter emulates exactly (§2), so the cast is
+bit-identical on CPU and TPU.
+
+Algorithm: the Eisel–Lemire fast path (Lemire, "Number Parsing at a
+Gigabyte per Second", §5; public algorithm, implementation here is
+vectorized from the paper's math, not ported code): a 19-digit decimal
+mantissa `d` and power-of-ten exponent `q` are mapped to `d × 10^q` by one
+64×128-bit fixed-point multiply against a precomputed table of 128-bit
+truncated mantissas of 10^q, q ∈ [-342, 308], followed by round-to-nearest-
+even on the product's top bits. We always compute the full 192-bit product
+(the paper's optional refinement step), so the only inexactness left is the
+table truncation itself: for q ∈ [0, 55] the table is exact and the result
+provably correctly rounded; outside that range the true product differs by
+less than 2^-127 relative, so a misround (≤1 ULP) requires the infinite-
+precision value to sit within ~2^-75 of a 53-bit rounding boundary — no
+such input is known, none was constructed, and none appeared in the
+220k-case + boundary-structure corpus (tests/test_float_bits.py). The
+reference parser's own contract (cast_string_to_float.cu digit
+accumulation in f64) is 1 ULP everywhere.
+
+Deliberate deviation for FLOAT32: this module rounds the decimal value to
+binary32 ONCE, matching Java Float.parseFloat (and therefore Spark CPU).
+The CUDA reference double-rounds — it builds an f64, then narrows
+(cast_string_to_float.cu:653 `string_to_float<float>`), which differs from
+Spark CPU by 1 ULP on inputs that straddle an f32 halfway point after the
+f64 rounding. We side with Spark CPU; tests pin one such straddling input.
+
+Parity target: spark_rapids_jni::string_to_float final-value construction
+(cast_string_to_float.cu:152-194); this replaces ops/cast_string.py's
+f64-arithmetic assembly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_Q_MIN, _Q_MAX = -342, 308
+_U64 = np.uint64
+
+
+def _build_pow10_table():
+    """128-bit fixed-point mantissas m and binary exponents e2 with
+    10^q = (m / 2^127) · 2^e2, m ∈ [2^127, 2^128). Exact for q ∈ [0, 55];
+    truncated (q > 55) or rounded up (q < 0, reciprocal) otherwise —
+    the Eisel–Lemire table contract, derived from bignum here rather than
+    transcribed."""
+    hi = np.empty(_Q_MAX - _Q_MIN + 1, dtype=np.uint64)
+    lo = np.empty_like(hi)
+    e2 = np.empty(hi.shape, dtype=np.int32)
+    for q in range(_Q_MIN, _Q_MAX + 1):
+        if q >= 0:
+            n = 5 ** q
+            b = n.bit_length()
+            m = n << (128 - b) if b <= 128 else n >> (b - 128)
+            e = q + b - 1
+        else:
+            f = 5 ** (-q)
+            b = f.bit_length()
+            m = (1 << (127 + b)) // f + 1  # round up: value underestimates
+            e = q - b
+        i = q - _Q_MIN
+        hi[i] = np.uint64(m >> 64)
+        lo[i] = np.uint64(m & 0xFFFFFFFFFFFFFFFF)
+        e2[i] = e
+    return hi, lo, e2
+
+
+_POW10_HI, _POW10_LO, _POW10_E2 = _build_pow10_table()
+
+
+def _clz64(x):
+    """Count leading zeros of u64 lanes (x > 0) via shift cascade — no
+    reliance on lax.clz lowering through the X64 rewriter."""
+    n = jnp.zeros(x.shape, dtype=jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        low = x < (_U64(1) << _U64(64 - s))
+        x = jnp.where(low, x << _U64(s), x)
+        n = n + jnp.where(low, s, 0)
+    return n
+
+
+from .int128 import umul128 as _mul_64_64  # u64 × u64 → (hi, lo), exact
+
+
+def _decimal_to_bits(digits, exp10, negative, *, mant_bits: int,
+                     exp_bias: int, min_unbiased: int, max_unbiased: int):
+    """Shared EL assembly → integer bit pattern lanes (u64).
+
+    value = digits · 10^exp10, digits: u64 (0 allowed → signed zero),
+    exp10: i32 (clamped to the table; out-of-range decides 0/∞ below).
+    """
+    digits = digits.astype(jnp.uint64)
+    exp10 = exp10.astype(jnp.int32)
+
+    q = jnp.clip(exp10, _Q_MIN, _Q_MAX)
+    idx = q - _Q_MIN
+    m_hi = jnp.asarray(_POW10_HI)[idx]
+    m_lo = jnp.asarray(_POW10_LO)[idx]
+    e2 = jnp.asarray(_POW10_E2)[idx]
+
+    safe = jnp.where(digits == 0, _U64(1), digits)
+    l = _clz64(safe)
+    w = safe << l.astype(jnp.uint64)
+
+    # full 192-bit product w × (m_hi·2^64 + m_lo): top 128 bits (uh, ul),
+    # low 64 folded into sticky
+    h1, l1 = _mul_64_64(w, m_hi)
+    h0, l0 = _mul_64_64(w, m_lo)
+    ul = l1 + h0
+    carry = (ul < l1).astype(jnp.uint64)
+    uh = h1 + carry
+
+    msb = (uh >> _U64(63)).astype(jnp.int32)  # product top bit: 191 or 190
+    # leading mant_bits+2 product bits: kept + round, lower bits → sticky
+    win_shift = (63 - (mant_bits + 2) + msb).astype(jnp.uint64)
+    window = uh >> win_shift
+    dropped_uh = uh & ((_U64(1) << win_shift) - _U64(1))
+    sticky = (dropped_uh != 0) | (ul != 0) | (l0 != 0)
+
+    # unbiased exponent of the value's leading bit:
+    # value = P·2^(e2-l-127), P ≈ uh·2^128, uh's top bit at 62+msb
+    e_lead = e2 - l + 63 + msb
+
+    # rounding shift: 1 for normals, more for subnormals (clipped so the
+    # whole window can shift out → ±0)
+    r = jnp.where(e_lead >= min_unbiased, 1, min_unbiased - e_lead + 1)
+    r = jnp.clip(r, 1, mant_bits + 3).astype(jnp.uint64)
+    kept = window >> r
+    round_bit = (window >> (r - _U64(1))) & _U64(1)
+    below = window & ((_U64(1) << (r - _U64(1))) - _U64(1))
+    sticky = sticky | (below != 0)
+    inc = (round_bit == 1) & (sticky | ((kept & _U64(1)) == 1))
+    kept = kept + inc.astype(jnp.uint64)
+
+    eterm = jnp.where(e_lead >= min_unbiased,
+                      e_lead + exp_bias - 1, 0).astype(jnp.uint64)
+    bits = (eterm << np.uint64(mant_bits)) + kept
+
+    inf_bits = _U64((2 * exp_bias + 1) << mant_bits)
+    # overflow: leading exponent beyond max, or rounding carried past it
+    # (the (eterm<<mant)+kept formulation promotes the carry, so a carry at
+    # e_lead == max_unbiased already lands exactly on inf_bits)
+    bits = jnp.where(e_lead > max_unbiased, inf_bits, bits)
+    # beyond the table, the clamped product is meaningless — but the true
+    # value is provably ∞ (≥ 1·10^309 > max double) or 0 (≤ (2^64-1)·10^-343,
+    # under half the smallest subnormal) for every u64 digits
+    bits = jnp.where(exp10 > _Q_MAX, inf_bits, bits)
+    bits = jnp.where(exp10 < _Q_MIN, _U64(0), bits)
+    bits = jnp.where(digits == 0, _U64(0), bits)
+    sign = jnp.where(negative, _U64(1) << _U64(63 if mant_bits == 52 else 31),
+                     _U64(0))
+    return bits | sign
+
+
+def decimal_to_f64_bits(digits, exp10, negative):
+    """uint64 IEEE-754 binary64 bit patterns of ±digits·10^exp10."""
+    return _decimal_to_bits(digits, exp10, negative, mant_bits=52,
+                            exp_bias=1023, min_unbiased=-1022,
+                            max_unbiased=1023)
+
+
+def decimal_to_f32_bits(digits, exp10, negative):
+    """uint64 lanes holding IEEE-754 binary32 bit patterns (low 32 bits)."""
+    return _decimal_to_bits(digits, exp10, negative, mant_bits=23,
+                            exp_bias=127, min_unbiased=-126,
+                            max_unbiased=127)

@@ -20,6 +20,25 @@ _LO32 = np.uint64(0xFFFFFFFF)
 _MASK128 = (1 << 128) - 1
 
 
+def umul128(a, b):
+    """u64 × u64 → (hi, lo) via 32-bit limb products — exact on every
+    backend (docs/TPU_NUMERICS.md §2). Shared by the Ryu float→string
+    tables (cast_float_to_string.py) and the Eisel–Lemire string→float
+    assembly (float_bits.py)."""
+    a_lo = a & _LO32
+    a_hi = a >> np.uint64(32)
+    b_lo = b & _LO32
+    b_hi = b >> np.uint64(32)
+    ll = a_lo * b_lo
+    hl = a_hi * b_lo
+    lh = a_lo * b_hi
+    hh = a_hi * b_hi
+    cross = (ll >> np.uint64(32)) + (hl & _LO32) + lh
+    lo = (cross << np.uint64(32)) | (ll & _LO32)
+    hi = hh + (hl >> np.uint64(32)) + (cross >> np.uint64(32))
+    return hi, lo
+
+
 def from_int_py(value: int, n: int) -> jnp.ndarray:
     """Broadcast a python int to [n, 4] two's-complement limbs."""
     return jnp.broadcast_to(jnp.asarray(limbs_const(value)), (n, NLIMBS))

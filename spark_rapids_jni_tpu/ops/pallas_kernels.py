@@ -89,14 +89,21 @@ def _tiled_lane_call(kernel, lanes, n: int, n_out: int, interpret: bool):
     ins = [shape2d(x) for x in lanes]
     spec = pl.BlockSpec((_SUB, _LANE), lambda i: (i, 0))
     shape = jax.ShapeDtypeStruct((n_pad // _LANE, _LANE), jnp.uint32)
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_pad // ROWS_PER_BLOCK,),
-        in_specs=[spec] * len(ins),
-        out_specs=spec if n_out == 1 else (spec,) * n_out,
-        out_shape=shape if n_out == 1 else (shape,) * n_out,
-        interpret=interpret,
-    )(*ins)
+    # The kernels are u32-pure end to end, so trace/lower the pallas_call
+    # with X64 off: under jax_enable_x64 the emitted Mosaic module fails the
+    # axon remote-compile helper (round-4 bisect: an 8x128 u32 +1 kernel
+    # compiles with x64 off and 500s with it on — the flag, not the kernel
+    # body, block shape, grid, or jit wrapper, is the trigger). Any 64-bit
+    # assembly (xxhash64's hi<<32|lo) stays outside this context.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_pad // ROWS_PER_BLOCK,),
+            in_specs=[spec] * len(ins),
+            out_specs=spec if n_out == 1 else (spec,) * n_out,
+            out_shape=shape if n_out == 1 else (shape,) * n_out,
+            interpret=interpret,
+        )(*ins)
     if n_out == 1:
         return (out.reshape(-1)[:n],)
     return tuple(o.reshape(-1)[:n] for o in out)
