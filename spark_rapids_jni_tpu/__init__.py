@@ -26,9 +26,34 @@ x64 mode is enabled at import (TPU emulates int64; hot kernels use 32-bit
 lanes internally).
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache. On the axon TPU backend every fresh
+# program shape costs ~0.9 s through the remote-compile helper (measured
+# round 4; cached sub-ms), so caching everything to disk amortizes compiles
+# across processes — 954 ms → 72 ms for the same shape in a fresh process.
+# On CPU backends compiles are cheap; only slow ones are worth the disk IO.
+# Opt out with SRJT_COMPILE_CACHE=0, or point it at a different directory.
+_cache = _os.environ.get(
+    "SRJT_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache",
+                  "spark_rapids_jni_tpu", "xla"))
+if _cache not in ("0", ""):
+    _jax.config.update("jax_compilation_cache_dir", _cache)
+    # cache-everything only when an accelerator platform is explicitly
+    # requested; default (unset / cpu / unknown) keeps the conservative
+    # 1 s threshold so plain-CPU machines don't serialize every trivial
+    # sub-ms program to disk
+    _plats = _os.environ.get("JAX_PLATFORMS", "").lower().split(",")
+    _accel = any(p.strip() in ("axon", "tpu", "cuda", "rocm")
+                 for p in _plats)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                       0.0 if _accel else 1.0)
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 from .columnar.dtype import DType, TypeId  # noqa: E402
 from .columnar.column import Column, Table  # noqa: E402

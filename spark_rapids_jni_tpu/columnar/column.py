@@ -107,6 +107,54 @@ class Column:
             return self.validity
         return jnp.ones((self.size,), dtype=bool)
 
+    # ---- host mirror cache ------------------------------------------------
+    # The native host tier (parse_uri, get_json_object, from_json, parquet)
+    # consumes column payloads as numpy. On the axon TPU backend a
+    # device→host transfer runs at ~0.2 GB/s with ~16 ms floor
+    # (docs/TPU_PERF.md), so paying it once per column, not once per call,
+    # matters — and columns built from host data never need it at all:
+    # the host constructors seed the mirror with the array they already
+    # hold. Same memoize-on-immutable pattern as strings.padded_bytes.
+    def host_data(self) -> Optional[np.ndarray]:
+        """Memoized host numpy mirror of .data (raw storage — FLOAT64
+        stays u64 bit patterns; see host_values for the viewed form).
+        The returned array is read-only: it is shared across all host-tier
+        consumers of this immutable column."""
+        if self.data is None:
+            return None
+        cached = getattr(self, "_host_data_cache", None)
+        if cached is None:
+            cached = np.asarray(self.data)
+            cached.flags.writeable = False
+            object.__setattr__(self, "_host_data_cache", cached)
+        return cached
+
+    def host_offsets(self) -> Optional[np.ndarray]:
+        """Memoized host numpy mirror of .offsets (read-only, shared)."""
+        if self.offsets is None:
+            return None
+        cached = getattr(self, "_host_offsets_cache", None)
+        if cached is None:
+            cached = np.asarray(self.offsets)
+            cached.flags.writeable = False
+            object.__setattr__(self, "_host_offsets_cache", cached)
+        return cached
+
+    def _seed_host_cache(self, data: Optional[np.ndarray],
+                         offsets: Optional[np.ndarray] = None) -> "Column":
+        """Pre-populate the host mirror with arrays this constructor OWNS.
+        Callers must pass freshly-allocated buffers only — the arrays are
+        frozen read-only here, and an array aliasing caller memory would
+        both freeze the caller's buffer and let later caller mutation
+        desynchronize the mirror from device data."""
+        if data is not None:
+            data.flags.writeable = False
+            object.__setattr__(self, "_host_data_cache", data)
+        if offsets is not None:
+            offsets.flags.writeable = False
+            object.__setattr__(self, "_host_offsets_cache", offsets)
+        return self
+
     def with_validity(self, validity: Optional[jnp.ndarray]) -> "Column":
         return replace(self, validity=validity)
 
@@ -118,11 +166,16 @@ class Column:
         if dtype is None:
             dtype = _infer_dtype(arr.dtype)
         host = arr.astype(dtype.np_dtype, copy=False)
+        owned = host is not arr and host.base is not arr  # astype copied
         if dtype.id is TypeId.FLOAT64:
             host = host.view(np.uint64)  # exact bit-pattern storage
         data = jnp.asarray(host)
         vmask = None if validity is None else jnp.asarray(validity.astype(bool))
-        return Column(dtype, int(arr.shape[0]), data=data, validity=vmask)
+        col = Column(dtype, int(arr.shape[0]), data=data, validity=vmask)
+        # seed the host mirror only when astype allocated a buffer we own —
+        # seeding an alias of the caller's array would freeze it and let
+        # caller mutation desynchronize host-tier reads from device data
+        return col._seed_host_cache(host) if owned else col
 
     @staticmethod
     def from_pylist(values: Sequence[Any], dtype: DType) -> "Column":
@@ -141,10 +194,11 @@ class Column:
                 bufs.append(b)
                 offsets[i + 1] = offsets[i] + len(b)
             blob = b"".join(bufs)
-            data = jnp.asarray(np.frombuffer(blob, dtype=np.uint8).copy()) \
-                if blob else jnp.zeros((0,), dtype=jnp.uint8)
-            return Column(dtype, n, data=data, validity=vmask,
-                          offsets=jnp.asarray(offsets))
+            host = np.frombuffer(blob, dtype=np.uint8).copy() if blob \
+                else np.zeros((0,), dtype=np.uint8)
+            return Column(dtype, n, data=jnp.asarray(host), validity=vmask,
+                          offsets=jnp.asarray(offsets)
+                          )._seed_host_cache(host, offsets)
 
         if dtype.id is TypeId.DECIMAL128:
             limbs = np.zeros((n, 4), dtype=np.uint32)
@@ -201,8 +255,8 @@ class Column:
         tid = self.dtype.id
 
         if tid is TypeId.STRING:
-            data = np.asarray(self.data).tobytes()
-            offs = np.asarray(self.offsets)
+            data = self.host_data().tobytes()
+            offs = self.host_offsets()
             out = []
             for i in range(self.size):
                 if not valid[i]:
@@ -253,7 +307,7 @@ class Column:
     def host_values(self) -> np.ndarray:
         """Host numpy view of fixed-width values; FLOAT64 bit storage is
         viewed back to float64 (see class docstring)."""
-        arr = np.asarray(self.data)
+        arr = self.host_data()
         if self.dtype.id is TypeId.FLOAT64 and arr.dtype != np.float64:
             arr = arr.view(np.float64)
         return arr

@@ -197,8 +197,8 @@ def _raise_first_error(col: Column, in_valid, out_valid):
     errors = np.asarray(in_valid & ~out_valid)
     if errors.any():
         row = int(np.argmax(errors))
-        offs = np.asarray(col.offsets)
-        data = np.asarray(col.data).tobytes()
+        offs = col.host_offsets()
+        data = col.host_data().tobytes()
         s = data[offs[row]:offs[row + 1]].decode("utf-8", errors="replace")
         raise CastException(row, s)
 

@@ -98,7 +98,7 @@ def from_integers_with_base(col: Column, base: int) -> Column:
     uppercase, no leading zeros)."""
     if base not in (10, 16):
         raise ValueError(f"Bases supported 10, 16; Actual: {base}")
-    vals = np.asarray(col.data)
+    vals = col.host_data()
     n = col.size
     width = vals.dtype.itemsize * 8
     parts = []

@@ -23,7 +23,7 @@ from ..columnar.strings import pack_byte_rows
 
 
 def _unscaled_ints(col: Column) -> np.ndarray:
-    arr = np.asarray(col.data)
+    arr = col.host_data()
     if col.dtype.id is dt.TypeId.DECIMAL128:
         # uint32[n, 4] little-endian limbs, two's complement
         v = (arr.astype(object) * [1 << 0, 1 << 32, 1 << 64, 1 << 96]).sum(axis=1)

@@ -174,3 +174,25 @@ def decimal_to_f32_bits(digits, exp10, negative):
     return _decimal_to_bits(digits, exp10, negative, mant_bits=23,
                             exp_bias=127, min_unbiased=-126,
                             max_unbiased=127)
+
+
+def f64_value_from_bits(bits):
+    """Decode FLOAT64 bit-pattern storage (uint64) to device f64 values
+    WITHOUT a host round-trip: integer field extraction + one exact u64→f64
+    convert + ldexp. 64-bit bitcast doesn't compile on the TPU rewriter
+    (docs/TPU_NUMERICS.md §3) and shipping through the host costs two
+    tunnel transfers per column (~0.1-0.2 GB/s measured); this decode is
+    pure device work. On CPU it is IEEE-exact; on TPU the *result* is a
+    double-double like any device f64 — same precision/range as the value
+    would have had after a host transfer, minus the transfer."""
+    bits = bits.astype(jnp.uint64)
+    e = ((bits >> _U64(52)) & _U64(0x7FF)).astype(jnp.int32)
+    frac = bits & ((_U64(1) << _U64(52)) - _U64(1))
+    negative = (bits >> _U64(63)) != 0
+    mant = jnp.where(e > 0, frac | (_U64(1) << _U64(52)), frac)
+    v = jnp.ldexp(mant.astype(jnp.float64),
+                  jnp.where(e > 0, e - 1075, -1074))
+    v = jnp.where(e == 0x7FF,
+                  jnp.where(frac != 0, jnp.float64(jnp.nan),
+                            jnp.float64(jnp.inf)), v)
+    return jnp.where(negative, -v, v)

@@ -132,9 +132,9 @@ def get_json_object_with_instructions(
     lib = _load()
     c = ctypes
     n = col.size
-    data = np.ascontiguousarray(np.asarray(col.data), dtype=np.uint8)
+    data = np.ascontiguousarray(col.host_data(), dtype=np.uint8)
     offsets = np.ascontiguousarray(
-        np.asarray(col.offsets), dtype=np.int64)
+        col.host_offsets(), dtype=np.int64)
     if col.validity is not None:
         valid = np.ascontiguousarray(
             np.asarray(col.validity).astype(np.uint8))

@@ -28,6 +28,7 @@ from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values
 from .sort import gather, sort_order
+from ..utils.shapes import bucket_size
 from ..utils.tracing import func_range
 
 
@@ -157,8 +158,10 @@ def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
     """(numeric device array, is_float) for aggregation. Floats accumulate in
     f64: Spark promotes float to double before summing."""
     if col.dtype.id is dt.TypeId.FLOAT64:
-        host = col.host_values()  # bits → f64 view
-        return jnp.asarray(host), True
+        # device-side bits→value decode: two tunnel transfers saved per
+        # aggregated column vs the old host .view() round-trip
+        from .float_bits import f64_value_from_bits
+        return f64_value_from_bits(col.data), True
     if col.dtype.id is dt.TypeId.FLOAT32:
         return col.data.astype(jnp.float64), True
     # _agg_out_dtype is the single validation point: DECIMAL128 and
@@ -233,9 +236,15 @@ def _groupby_aggregate(
     boundary = jnp.concatenate([jnp.ones(1, dtype=jnp.int32),
                                 (~same).astype(jnp.int32)])
     seg_ids = jnp.cumsum(boundary) - 1
-    num_segments = int(seg_ids[-1]) + 1
+    true_segments = int(seg_ids[-1]) + 1  # the op's one host sync
+    # run every segment op at a power-of-two bucket so the XLA op cache
+    # keys on the bucket, not the data-dependent group count (a fresh
+    # shape costs ~0.9 s through the axon remote-compile helper —
+    # utils/shapes.py); padded tail groups have cnt == 0 and are trimmed
+    # from every output at the end by _shrink (a trivial slice program)
+    num_segments = bucket_size(true_segments)
 
-    # representative row of each group (first sorted row); num_segments is
+    # representative row of each group (first sorted row); the count is
     # already synced, so the boundary→index expansion stays on device
     first_in_seg = jnp.nonzero(boundary, size=num_segments)[0]
     rep_rows = jnp.take(order, first_in_seg)
@@ -301,4 +310,17 @@ def _groupby_aggregate(
             out_cols.append(Column(out_dtype, num_segments,
                                    data=res.astype(out_dtype.jnp_dtype),
                                    validity=any_valid))
-    return Table(tuple(out_cols))
+    return Table(tuple(_shrink(c, true_segments) for c in out_cols))
+
+
+def _shrink(col: Column, n: int) -> Column:
+    """Trim a bucket-padded result column to the true group count — the
+    only per-distinct-count program this op compiles (one slice for
+    flat-payload columns, a row gather for offset-carrying ones)."""
+    if col.size == n:
+        return col
+    if col.offsets is not None or col.children:
+        # STRING et al.: payload is offset-indexed, not row-sliceable
+        return gather(col, jnp.arange(n, dtype=jnp.int32))
+    validity = None if col.validity is None else col.validity[:n]
+    return Column(col.dtype, n, data=col.data[:n], validity=validity)

@@ -35,6 +35,7 @@ from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values, xxhash64
+from ..utils.shapes import bucket_size
 from ..utils.tracing import func_range
 
 
@@ -105,7 +106,11 @@ def _candidates(left_keys, right_keys, nulls_equal):
     per_pair = 48
     for lc, rc in zip(left_keys, right_keys):
         per_pair += _verify_width(lc) + _verify_width(rc)
-    with device_reservation(2 * in_bytes + total * per_pair) as took:
+    # reserve at the BUCKETED lane count — phase 2 allocates every array at
+    # bucket_size(total) (up to ~2x total), so the bracket must cover the
+    # padded working set, not the logical pair count
+    with device_reservation(2 * in_bytes
+                            + bucket_size(total) * per_pair) as took:
         out = _expand_and_verify(left_keys, right_keys, nulls_equal, total,
                                  state)
         # framework-wide contract: reservations bracket an op's *transient*
@@ -159,15 +164,24 @@ def _candidate_counts(left_keys, right_keys, nulls_equal):
 def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     """Phase 2: expand candidate pairs on device and verify exact equality.
     The compaction stays on device — only the verified-match *count* syncs
-    to host (sync #2); the gather maps themselves never round-trip."""
-    order, lo, cnt, nl = state
-    l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
-                       total_repeat_length=total)
-    start = jnp.cumsum(cnt) - cnt
-    within = jnp.arange(total, dtype=jnp.int32) - jnp.take(start, l_idx)
-    r_idx = jnp.take(order, jnp.take(lo, l_idx) + within)
+    to host (sync #2); the gather maps themselves never round-trip.
 
-    keep = jnp.ones(total, dtype=bool)
+    Every device array here is sized by a power-of-two bucket, not the
+    data-dependent counts (utils/shapes.py): a fresh shape costs ~0.9 s
+    through the axon remote-compile helper, so the expansion/verify chain
+    must hit the XLA op cache across differing candidate totals. Padded
+    expansion lanes carry keep=False; only the final exact-size trims
+    compile per distinct count (trivial slices)."""
+    order, lo, cnt, nl = state
+    t_b = bucket_size(total)
+    l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
+                       total_repeat_length=t_b)
+    lane = jnp.arange(t_b, dtype=jnp.int32)
+    start = jnp.cumsum(cnt) - cnt
+    within = lane - jnp.take(start, l_idx)
+    r_idx = jnp.take(order, jnp.take(lo, l_idx) + within)  # take clips
+
+    keep = lane < total
     for lc, rc in zip(left_keys, right_keys):
         keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
     if _backend() == "cpu":
@@ -180,9 +194,10 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     # accelerator: compact on device — only the verified-match count syncs;
     # the blob-sized mask and index arrays never cross the host boundary
     nkeep = int(jnp.sum(keep))  # host sync #2: verified-match count
-    sel = jnp.nonzero(keep, size=nkeep, fill_value=0)[0]
-    return (jnp.take(l_idx, sel).astype(jnp.int64),
-            jnp.take(r_idx, sel).astype(jnp.int64))
+    k_b = bucket_size(nkeep)
+    sel = jnp.nonzero(keep, size=k_b, fill_value=0)[0]
+    return (jnp.take(l_idx, sel).astype(jnp.int64)[:nkeep],
+            jnp.take(r_idx, sel).astype(jnp.int64)[:nkeep])
 
 
 @func_range()

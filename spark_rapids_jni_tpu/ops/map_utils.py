@@ -46,8 +46,8 @@ def extract_raw_map_from_json_string(col: Column) -> Column:
     lib = _declare(_load())
     c = ctypes
     n = col.size
-    data = np.ascontiguousarray(np.asarray(col.data), dtype=np.uint8)
-    offsets = np.ascontiguousarray(np.asarray(col.offsets), dtype=np.int64)
+    data = np.ascontiguousarray(col.host_data(), dtype=np.uint8)
+    offsets = np.ascontiguousarray(col.host_offsets(), dtype=np.int64)
     if col.validity is not None:
         valid = np.ascontiguousarray(np.asarray(col.validity).astype(np.uint8))
         valid_p = valid.ctypes.data_as(c.POINTER(c.c_uint8))

@@ -359,8 +359,8 @@ def _parse_one(b: bytes) -> _Parts:
 
 def _row_bytes(col: Column) -> List[Optional[bytes]]:
     assert col.dtype.id is dt.TypeId.STRING
-    data = np.asarray(col.data).tobytes()
-    offs = np.asarray(col.offsets)
+    data = col.host_data().tobytes()
+    offs = col.host_offsets()
     valid = (np.ones(col.size, dtype=bool) if col.validity is None
              else np.asarray(col.validity))
     return [data[offs[i]:offs[i + 1]] if valid[i] else None
@@ -390,8 +390,8 @@ def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
 
     lib = nat.load()
     c = ctypes
-    data = np.ascontiguousarray(np.asarray(col.data))
-    offs = np.ascontiguousarray(np.asarray(col.offsets, dtype=np.int64))
+    data = np.ascontiguousarray(col.host_data())
+    offs = np.ascontiguousarray(col.host_offsets(), dtype=np.int64)
     valid = None if col.validity is None else np.ascontiguousarray(
         np.asarray(col.validity).astype(np.uint8))
 

@@ -365,6 +365,32 @@ def _assemble_blob(fixed, mats, lenss, starts, roffs, *, spr, padded_total):
     return blob
 
 
+def _assemble_one_batch(fixed_words, fixed, padded, var_offsets, row_words,
+                        word_roffs, roffs_i32, n: int, total: int,
+                        max_row: int, spr: int) -> jnp.ndarray:
+    """Single-batch assembly with device-resident sizing (the common ≤2 GB
+    case): same fast/fallback policy as the batched loop below, but no host
+    row-size array ever materializes."""
+    if total == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    row_pad = _round_up(max_row, 16)
+    if (row_pad <= _ROWMAT_MAX_ROW_PAD
+            and n * row_pad <= _ROWMAT_MAX_BLOWUP * total):
+        return _assemble_blob_rowmat(
+            fixed_words, tuple(mat for mat, _ in padded),
+            tuple(lens for _, lens in padded),
+            tuple(var_offsets[:, s] for s in range(len(padded))),
+            row_words, word_roffs, spr=spr, row_pad=row_pad,
+            padded_words=_blob_bucket(total) // 8)[:total]
+    if fixed is None:
+        fixed = _words_to_u8(fixed_words)
+    return _assemble_blob(
+        fixed, tuple(mat for mat, _ in padded),
+        tuple(lens for _, lens in padded),
+        tuple(var_offsets[:, s] for s in range(len(padded))),
+        roffs_i32, spr=spr, padded_total=_blob_bucket(total))[:total]
+
+
 def _rows_column(blob: jnp.ndarray, row_offsets: np.ndarray) -> Column:
     child = Column(dt.INT8, int(blob.shape[0]),
                    data=jax.lax.bitcast_convert_type(blob, jnp.int8))
@@ -410,6 +436,9 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         return out
 
     # --- variable-width path -----------------------------------------------
+    if n == 0:
+        return [_rows_column(jnp.zeros((0,), jnp.uint8),
+                             np.zeros(1, dtype=np.int64))]
     lengths = jnp.stack(
         [(c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
          for c in string_cols], axis=1)                     # [n, nsc]
@@ -417,9 +446,11 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     var_offsets = (info.size_per_row
                    + jnp.cumsum(lengths, axis=1) - lengths)  # [n, nsc]
     total_str = jnp.sum(lengths, axis=1)
-    row_sizes_np = np.asarray(
-        ((info.size_per_row + total_str + JCUDF_ROW_ALIGNMENT - 1)
-         // JCUDF_ROW_ALIGNMENT) * JCUDF_ROW_ALIGNMENT, dtype=np.int64)
+    row_sizes_dev = ((info.size_per_row + total_str.astype(jnp.int64)
+                      + JCUDF_ROW_ALIGNMENT - 1)
+                     // JCUDF_ROW_ALIGNMENT) * JCUDF_ROW_ALIGNMENT
+    roffs_dev = jnp.concatenate([jnp.zeros(1, row_sizes_dev.dtype),
+                                 jnp.cumsum(row_sizes_dev)])
 
     # fixed region as uint32 words (bytes are produced inside the assembly
     # jits so the conversion fuses; tail bytes past size_per_row unused)
@@ -428,6 +459,23 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         table, info, _round_up(spr, 4), var_offsets, lengths)
     fixed = None  # byte view, materialized only if the fallback needs it
     padded = [padded_bytes(c) for c in string_cols]
+
+    # sizing syncs just (total, max_row) — one small transfer. The full
+    # row-size array only crosses to host when the table actually spans
+    # multiple 2 GB batches (device→host runs ~0.2 GB/s on the axon tunnel,
+    # docs/TPU_PERF.md, so an 8 MB sizes array costs more than the sync it
+    # replaces on every single-batch call).
+    head = np.asarray(jnp.stack([roffs_dev[-1], jnp.max(row_sizes_dev)]))
+    total_all, max_row_all = int(head[0]), int(head[1])
+    if total_all <= max_batch_bytes:
+        blob = _assemble_one_batch(
+            fixed_words, fixed, padded, var_offsets,
+            (row_sizes_dev // 8).astype(jnp.int32),
+            (roffs_dev // 8).astype(jnp.int32),
+            roffs_dev.astype(jnp.int32), n, total_all, max_row_all, spr)
+        return [_rows_column(blob, roffs_dev.astype(jnp.int32))]
+
+    row_sizes_np = np.asarray(row_sizes_dev)
     bounds = _batch_boundaries(row_sizes_np, max_batch_bytes)
 
     out = []

@@ -1,0 +1,26 @@
+"""Bucketed sizing for data-dependent shapes.
+
+On the axon TPU backend a fresh program shape costs ~0.9 s through the
+remote-compile helper (measured round 4: docs/TPU_PERF.md), and XLA keys
+its op cache by shape — so an op chain sized by a data-dependent count
+(join candidate totals, group counts, filter survivors) recompiles on
+every new value. Rounding those sizes up to a coarse bucket makes the
+op-cache key the *bucket*, so steady state hits the in-process cache and
+cold starts hit the persistent disk cache; only the final trim to the
+exact count (a trivial slice) compiles per distinct value.
+
+The reference has no analog — CUDA kernels take runtime sizes — this is
+purely an XLA-compilation-model design point (SURVEY §6 static shapes).
+"""
+
+from __future__ import annotations
+
+
+def bucket_size(n: int, floor: int = 1024) -> int:
+    """Smallest power of two >= n (>= floor). n == 0 stays 0 (empty-result
+    programs are shape-unique anyway and callers special-case them)."""
+    if n <= 0:
+        return 0
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()

@@ -1,0 +1,57 @@
+"""Bucketed-shape policy tests (utils/shapes.py) and padding-correctness
+of the ops that use it: results must be identical whether a data-dependent
+count falls just below, on, or just above a power-of-two bucket edge."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.utils.shapes import bucket_size
+
+
+def test_bucket_size_policy():
+    assert bucket_size(0) == 0
+    assert bucket_size(1) == 1024
+    assert bucket_size(1024) == 1024
+    assert bucket_size(1025) == 2048
+    assert bucket_size(3000) == 4096
+    assert bucket_size(1 << 20) == 1 << 20
+    assert bucket_size((1 << 20) + 1) == 1 << 21
+    assert bucket_size(7, floor=4) == 8
+
+
+@pytest.mark.parametrize("ngroups", [1023, 1024, 1025])
+def test_groupby_across_bucket_edges(ngroups):
+    """Group counts straddling the bucket edge: padded tail groups must
+    never leak into results (ops/groupby.py runs segment ops at the bucket
+    and trims)."""
+    n = 4 * ngroups
+    keys = np.arange(n) % ngroups
+    vals = np.arange(n, dtype=np.int64)
+    t = Table((Column.from_numpy(keys, dt.INT64),
+               Column.from_numpy(vals, dt.INT64)))
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    out = groupby_aggregate(t, [0], [(1, "sum"), (1, "count")])
+    assert out.num_rows == ngroups
+    got_keys = out.columns[0].to_pylist()
+    got_sums = out.columns[1].to_pylist()
+    got_cnts = out.columns[2].to_pylist()
+    assert got_keys == list(range(ngroups))
+    for k in (0, 1, ngroups - 1):
+        rows = [v for v in range(n) if v % ngroups == k]
+        assert got_sums[k] == sum(rows)
+        assert got_cnts[k] == len(rows)
+
+
+@pytest.mark.parametrize("nmatch", [1023, 1024, 1025])
+def test_join_across_bucket_edges(nmatch):
+    """Match counts straddling the bucket edge: padded expansion lanes and
+    compaction fill values must never appear in the gather maps."""
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    lk = np.arange(2 * nmatch)          # rows [0, nmatch) match
+    rk = np.arange(nmatch)
+    lg, rg = inner_join([Column.from_numpy(lk, dt.INT64)],
+                        [Column.from_numpy(rk, dt.INT64)])
+    got = sorted(zip(np.asarray(lg).tolist(), np.asarray(rg).tolist()))
+    assert got == [(i, i) for i in range(nmatch)]
