@@ -228,13 +228,19 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
     this composition (groupby/sort via its vendored layer); the pipeline
     itself exercises BASELINE configs[1]-style aggregation at q1's shape.
     """
+    keep = lineitem.columns[6].data <= cutoff
     if mesh is not None:
         from spark_rapids_jni_tpu.parallel.distributed import (
             distributed_groupby)
+        li = filter_table(lineitem, keep)
         group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+        mask = None
     else:
+        # predicate pushdown: the filter rides groupby's row_mask — no
+        # stream compaction, no survivor-count sync or fresh program shape
+        li = lineitem
         group = groupby_aggregate
-    li = filter_table(lineitem, lineitem.columns[6].data <= cutoff)
+        mask = keep
     qty = li.columns[0].data.astype(jnp.int64)
     price = li.columns[1].data.astype(jnp.int64)
     disc = li.columns[2].data.astype(jnp.int64)
@@ -248,9 +254,10 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
                 Column(dt.INT64, n, data=disc_price),
                 Column(dt.INT64, n, data=charge),
                 Column(dt.INT64, n, data=disc)))
-    g = group(gt, [0, 1], [(2, "sum"), (3, "sum"), (4, "sum"), (5, "sum"),
-                           (2, "mean"), (3, "mean"), (6, "mean"),
-                           (2, "count")])
+    aggs = [(2, "sum"), (3, "sum"), (4, "sum"), (5, "sum"),
+            (2, "mean"), (3, "mean"), (6, "mean"), (2, "count")]
+    g = group(gt, [0, 1], aggs) if mask is None else \
+        group(gt, [0, 1], aggs, row_mask=mask)
     return sort_table(g, [0, 1])
 
 
@@ -265,19 +272,23 @@ def run_q6(lineitem: Table, date_lo: int = 365, date_hi: int = 730,
     keep = ((sd >= date_lo) & (sd < date_hi)
             & (disc >= disc_lo) & (disc <= disc_hi)
             & (qty < qty_max))
+    if mesh is None:
+        # pushed-down form: masked sum over the full table — one fused
+        # program, zero compaction syncs
+        rev_all = (lineitem.columns[1].data.astype(jnp.int64)
+                   * lineitem.columns[2].data.astype(jnp.int64))
+        return int(jnp.sum(jnp.where(keep, rev_all, 0)))
     li = filter_table(lineitem, keep)
     rev = (li.columns[1].data.astype(jnp.int64)
            * li.columns[2].data.astype(jnp.int64))
-    if mesh is not None:
-        # one-key groupby over the mesh: same exchange path, trivial key
-        from spark_rapids_jni_tpu.parallel.distributed import (
-            distributed_groupby)
-        n = li.num_rows
-        if n == 0:
-            return 0
-        gt = Table((Column(dt.INT64, n,
-                           data=jnp.zeros((n,), dtype=jnp.int64)),
-                    Column(dt.INT64, n, data=rev)))
-        g = distributed_groupby(gt, [0], [(1, "sum")], mesh)
-        return int(g.columns[1].to_pylist()[0]) if g.num_rows else 0
-    return int(jnp.sum(rev))
+    # one-key groupby over the mesh: same exchange path, trivial key
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_groupby)
+    n = li.num_rows
+    if n == 0:
+        return 0
+    gt = Table((Column(dt.INT64, n,
+                       data=jnp.zeros((n,), dtype=jnp.int64)),
+                Column(dt.INT64, n, data=rev)))
+    g = distributed_groupby(gt, [0], [(1, "sum")], mesh)
+    return int(g.columns[1].to_pylist()[0]) if g.num_rows else 0

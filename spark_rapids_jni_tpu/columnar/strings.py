@@ -23,9 +23,10 @@ def round_up(x: int, multiple: int) -> int:
 
 def pad_width(max_len: int, multiple: int = 8) -> int:
     """Bucket a max string length to limit recompilation: next power of two,
-    at least `multiple`."""
-    w = max(multiple, 1 << (max(1, max_len) - 1).bit_length())
-    return round_up(w, multiple)
+    at least `multiple` (one bucketing policy for the whole repo —
+    utils/shapes.bucket_size; round_up guards non-power-of-two multiples)."""
+    from ..utils.shapes import bucket_size
+    return round_up(bucket_size(max(1, max_len), floor=multiple), multiple)
 
 
 def padded_bytes(col: Column, multiple: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -42,6 +42,7 @@ f64-arithmetic assembly.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 _Q_MIN, _Q_MAX = -342, 308
@@ -186,12 +187,29 @@ def f64_value_from_bits(bits):
     double-double like any device f64 — same precision/range as the value
     would have had after a host transfer, minus the transfer."""
     bits = bits.astype(jnp.uint64)
+    if jax.default_backend() != "cpu":
+        return _f64_from_bits_arith(bits)
+    # CPU: the 64-bit bitcast is available (docs/TPU_NUMERICS.md §3 is a
+    # TPU-rewriter limitation) and is the only exact route — XLA:CPU
+    # compiles f64 arithmetic flush-to-zero, so ANY multiply-based decode
+    # loses subnormals (measured: 1.0 · 2^-537 · 2^-537 == 0.0 under jit)
+    from jax import lax
+    return lax.bitcast_convert_type(bits, jnp.float64)
+
+
+def _f64_from_bits_arith(bits):
+    """Arithmetic decode for backends without a 64-bit bitcast (TPU): field
+    extraction + two half-shift ldexps. Subnormal doubles flush to signed
+    zero here — on TPU every |x| below ~1e-38 flushes anyway (double-double
+    emulation, §1), so this adds no loss the backend wasn't already
+    imposing."""
     e = ((bits >> _U64(52)) & _U64(0x7FF)).astype(jnp.int32)
     frac = bits & ((_U64(1) << _U64(52)) - _U64(1))
     negative = (bits >> _U64(63)) != 0
     mant = jnp.where(e > 0, frac | (_U64(1) << _U64(52)), frac)
-    v = jnp.ldexp(mant.astype(jnp.float64),
-                  jnp.where(e > 0, e - 1075, -1074))
+    ex = jnp.where(e > 0, e - 1075, -1074)
+    h1 = ex // 2
+    v = jnp.ldexp(jnp.ldexp(mant.astype(jnp.float64), h1), ex - h1)
     v = jnp.where(e == 0x7FF,
                   jnp.where(frac != 0, jnp.float64(jnp.nan),
                             jnp.float64(jnp.inf)), v)

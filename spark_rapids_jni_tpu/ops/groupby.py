@@ -198,24 +198,50 @@ def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
 @func_range()
 def groupby_aggregate(
         table: Table, key_indices: Sequence[int],
-        aggs: Sequence[Tuple[int, str]]) -> Table:
+        aggs: Sequence[Tuple[int, str]],
+        row_mask=None) -> Table:
     """Group by key columns and aggregate.
 
     ``aggs``: (column_index, op) with op in {sum, count, min, max, mean}.
     Returns a Table of [unique keys..., one column per agg] in group-sorted
     order.
+
+    ``row_mask`` (bool[n], optional) pushes a filter predicate down into
+    the aggregation: semantically identical to
+    ``groupby_aggregate(filter_table(table, row_mask), ...)`` but with no
+    stream compaction — masked-out rows sort to the tail as dead groups
+    and are trimmed by the same final slice that trims bucket padding, so
+    the pipeline pays zero extra host syncs or data-dependent shapes
+    (docs/TPU_PERF.md: a compaction costs a 16-64 ms sync plus a fresh
+    ~0.9 s program shape per distinct survivor count on the axon backend).
+    The Spark analog is codegen fusing GpuFilterExec into the partial
+    aggregation.
     """
     # peak ≈ input + sorted/gathered intermediates (reservation bracketing)
     with device_reservation(2 * table.device_nbytes()) as took:
         return release_barrier(
-            _groupby_aggregate(table, key_indices, aggs), took)
+            _groupby_aggregate(table, key_indices, aggs, row_mask), took)
 
 
 def _groupby_aggregate(
         table: Table, key_indices: Sequence[int],
-        aggs: Sequence[Tuple[int, str]]) -> Table:
+        aggs: Sequence[Tuple[int, str]], row_mask=None) -> Table:
     keys = [table.columns[i] for i in key_indices]
-    order = sort_order(keys)
+    dead_col = None
+    if row_mask is not None:
+        # dead rows order AFTER every live row (uint8 primary sort key) and
+        # break segment equality at the live/dead edge, so live groups form
+        # a contiguous prefix of segments and dead rows land in trailing
+        # dead groups the final trim drops
+        row_mask = jnp.asarray(row_mask, dtype=bool)
+        if row_mask.shape != (table.num_rows,):
+            raise ValueError(
+                f"boolean row_mask shape {row_mask.shape} != table rows "
+                f"({table.num_rows},)")  # mirror filter_table's contract
+        dead_col = Column(dt.BOOL8, keys[0].size,
+                          data=(~row_mask).astype(jnp.uint8))
+    cmp_keys = ([dead_col] + keys) if dead_col is not None else keys
+    order = sort_order(cmp_keys)
 
     if keys[0].size == 0:
         out_cols: List[Column] = [gather(k, order) for k in keys]
@@ -231,12 +257,23 @@ def _groupby_aggregate(
 
     same = jnp.ones(keys[0].size - 1, dtype=bool) \
         if keys[0].size > 1 else jnp.zeros(0, dtype=bool)
-    for k in keys:
+    for k in cmp_keys:
         same = same & _keys_equal_prev(k, order)
     boundary = jnp.concatenate([jnp.ones(1, dtype=jnp.int32),
                                 (~same).astype(jnp.int32)])
     seg_ids = jnp.cumsum(boundary) - 1
-    true_segments = int(seg_ids[-1]) + 1  # the op's one host sync
+    if dead_col is None:
+        true_segments = int(seg_ids[-1]) + 1  # the op's one host sync
+        live_groups = true_segments
+    else:
+        # still exactly one host sync: (total segments, live-prefix
+        # segments) cross together. Live rows sort first, so the group
+        # of the last live row bounds the live prefix.
+        n_live = jnp.sum(row_mask).astype(jnp.int32)
+        lg = jnp.where(n_live > 0,
+                       jnp.take(seg_ids, jnp.maximum(n_live - 1, 0)) + 1, 0)
+        head = np.asarray(jnp.stack([seg_ids[-1] + 1, lg]))
+        true_segments, live_groups = int(head[0]), int(head[1])
     # run every segment op at a power-of-two bucket so the XLA op cache
     # keys on the bucket, not the data-dependent group count (a fresh
     # shape costs ~0.9 s through the axon remote-compile helper —
@@ -310,7 +347,7 @@ def _groupby_aggregate(
             out_cols.append(Column(out_dtype, num_segments,
                                    data=res.astype(out_dtype.jnp_dtype),
                                    validity=any_valid))
-    return Table(tuple(_shrink(c, true_segments) for c in out_cols))
+    return Table(tuple(_shrink(c, live_groups) for c in out_cols))
 
 
 def _shrink(col: Column, n: int) -> Column:

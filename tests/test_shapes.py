@@ -44,6 +44,33 @@ def test_groupby_across_bucket_edges(ngroups):
         assert got_cnts[k] == len(rows)
 
 
+@pytest.mark.parametrize("frac", [0.0, 0.3, 1.0])
+def test_groupby_row_mask_equals_filter_then_group(frac):
+    """row_mask pushdown must be semantically identical to filter-then-
+    group — including all-dead and all-live masks, null keys, and null
+    values (ops/groupby.py dead-group trimming)."""
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    rng = np.random.default_rng(3)
+    n = 5000
+    keys = rng.integers(0, 40, n)
+    key_valid = rng.random(n) > 0.05
+    vals = rng.integers(-100, 100, n)
+    val_valid = rng.random(n) > 0.1
+    mask = rng.random(n) < frac
+    t = Table((Column.from_numpy(keys, dt.INT64,
+                                 validity=key_valid),
+               Column.from_numpy(vals, dt.INT64,
+                                 validity=val_valid)))
+    aggs = [(1, "sum"), (1, "count"), (1, "mean"), (1, "min"), (1, "max")]
+    import jax.numpy as jnp
+    got = groupby_aggregate(t, [0], aggs, row_mask=jnp.asarray(mask))
+    want = groupby_aggregate(filter_table(t, mask), [0], aggs)
+    assert got.num_rows == want.num_rows
+    for cg, cw in zip(got.columns, want.columns):
+        assert cg.to_pylist() == cw.to_pylist()
+
+
 @pytest.mark.parametrize("nmatch", [1023, 1024, 1025])
 def test_join_across_bucket_edges(nmatch):
     """Match counts straddling the bucket edge: padded expansion lanes and
