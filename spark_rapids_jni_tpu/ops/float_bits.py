@@ -187,12 +187,14 @@ def f64_value_from_bits(bits):
     double-double like any device f64 — same precision/range as the value
     would have had after a host transfer, minus the transfer."""
     bits = bits.astype(jnp.uint64)
-    if jax.default_backend() != "cpu":
+    if jax.default_backend() in ("tpu", "axon"):
+        # only the TPU X64 rewriter lacks the 64-bit bitcast
+        # (docs/TPU_NUMERICS.md §3)
         return _f64_from_bits_arith(bits)
-    # CPU: the 64-bit bitcast is available (docs/TPU_NUMERICS.md §3 is a
-    # TPU-rewriter limitation) and is the only exact route — XLA:CPU
-    # compiles f64 arithmetic flush-to-zero, so ANY multiply-based decode
-    # loses subnormals (measured: 1.0 · 2^-537 · 2^-537 == 0.0 under jit)
+    # everywhere else the bitcast is the exact route (subnormals included)
+    # — and the only one: XLA compiles f64 arithmetic flush-to-zero even on
+    # CPU, so ANY multiply-based decode loses subnormals (measured:
+    # 1.0 · 2^-537 · 2^-537 == 0.0 under jit)
     from jax import lax
     return lax.bitcast_convert_type(bits, jnp.float64)
 
