@@ -119,26 +119,39 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     customer⋈orders (date window), lineitem⋈orders, lineitem⋈supplier, the
     c_nationkey = s_nationkey co-nation predicate, then revenue per nation
     sorted descending. Returns (n_nationkey, revenue)."""
+    od = orders.columns[2].data
     if mesh is not None:
         from spark_rapids_jni_tpu.parallel.distributed import (
             distributed_groupby, distributed_inner_join)
         join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
         group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+
+        # nations in the region; suppliers in those nations
+        nat_f = filter_table(nation, nation.columns[1].data == region_code)
+        si, _ = join([Column(dt.INT64, supplier.num_rows,
+                             data=supplier.columns[1].data.astype(jnp.int64))],
+                     [nat_f.columns[0]])
+        supp_f = gather_table(supplier, jnp.asarray(si))
+
+        # orders in the date window, joined to customers (carry c_nationkey)
+        ord_f = filter_table(orders, (od >= date_lo) & (od < date_hi))
+        oi, ci = join([ord_f.columns[1]], [cust.columns[0]])
+        ord_j = gather_table(ord_f, jnp.asarray(oi))
     else:
-        join, group = inner_join, groupby_aggregate
-
-    # nations in the region; suppliers in those nations
-    nat_f = filter_table(nation, nation.columns[1].data == region_code)
-    si, _ = join([Column(dt.INT64, supplier.num_rows,
-                         data=supplier.columns[1].data.astype(jnp.int64))],
-                 [nat_f.columns[0]])
-    supp_f = gather_table(supplier, jnp.asarray(si))
-
-    # orders in the date window, joined to customers (carry c_nationkey)
-    od = orders.columns[2].data
-    ord_f = filter_table(orders, (od >= date_lo) & (od < date_hi))
-    oi, ci = join([ord_f.columns[1]], [cust.columns[0]])
-    ord_j = gather_table(ord_f, jnp.asarray(oi))
+        join = inner_join
+        # region + date filters ride the joins' mask pushdown (gather maps
+        # index the original tables — docs/TPU_PERF.md sync economy); the
+        # final aggregation below calls groupby_aggregate(row_mask=...)
+        # directly
+        si, _ = inner_join(
+            [Column(dt.INT64, supplier.num_rows,
+                    data=supplier.columns[1].data.astype(jnp.int64))],
+            [nation.columns[0]],
+            right_mask=nation.columns[1].data == region_code)
+        supp_f = gather_table(supplier, jnp.asarray(si))
+        oi, ci = inner_join([orders.columns[1]], [cust.columns[0]],
+                            left_mask=(od >= date_lo) & (od < date_hi))
+        ord_j = gather_table(orders, jnp.asarray(oi))
     cust_j = gather_table(cust, jnp.asarray(ci))
 
     # lineitem to its order (carry the customer's nation), then its supplier
@@ -152,12 +165,16 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
 
     # local-supplier predicate: customer and supplier share a nation
     same = cnat_j.columns[0].data == snat.columns[0].data
-    li_f = filter_table(li_jj, same)
-    nat_key = filter_table(snat, same).columns[0]
-    rev = (li_f.columns[2].data.astype(jnp.int64)
-           * (100 - li_f.columns[3].data.astype(jnp.int64)))
-    gt = Table((nat_key, Column(dt.INT64, int(rev.shape[0]), data=rev)))
-    g = group(gt, [0], [(1, "sum")])
+    rev_all = (li_jj.columns[2].data.astype(jnp.int64)
+               * (100 - li_jj.columns[3].data.astype(jnp.int64)))
+    gt = Table((snat.columns[0],
+                Column(dt.INT64, int(rev_all.shape[0]), data=rev_all)))
+    if mesh is not None:
+        li_rows = filter_table(gt, same)
+        g = group(li_rows, [0], [(1, "sum")])
+    else:
+        # co-nation predicate rides groupby's row_mask pushdown
+        g = groupby_aggregate(gt, [0], [(1, "sum")], row_mask=same)
     return sort_table(g, [1], ascending=[False])
 
 
@@ -177,16 +194,26 @@ def run_q3(cust: Table, orders: Table, lineitem: Table,
             distributed_groupby, distributed_inner_join)
         join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
         group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+        cust_f = filter_table(cust, cust.columns[1].data == segment_code)
+        ord_f = filter_table(orders, orders.columns[2].data < cutoff)
+        oi, _ = join([ord_f.columns[1]], [cust_f.columns[0]])
+        ord_j = gather_table(ord_f, jnp.asarray(oi))
+        li_f = filter_table(lineitem, lineitem.columns[1].data > cutoff)
+        lii, ori = join([li_f.columns[0]], [ord_j.columns[0]])
+        li_j = gather_table(li_f, jnp.asarray(lii))
+        ord_jj = gather_table(ord_j, jnp.asarray(ori))
     else:
-        join, group = inner_join, groupby_aggregate
-    cust_f = filter_table(cust, cust.columns[1].data == segment_code)
-    ord_f = filter_table(orders, orders.columns[2].data < cutoff)
-    oi, _ = join([ord_f.columns[1]], [cust_f.columns[0]])
-    ord_j = gather_table(ord_f, jnp.asarray(oi))
-    li_f = filter_table(lineitem, lineitem.columns[1].data > cutoff)
-    lii, ori = join([li_f.columns[0]], [ord_j.columns[0]])
-    li_j = gather_table(li_f, jnp.asarray(lii))
-    ord_jj = gather_table(ord_j, jnp.asarray(ori))
+        group = groupby_aggregate
+        # filters ride the joins' mask pushdown: gather maps index the
+        # ORIGINAL tables, so no compaction syncs and no index remapping
+        oi, _ = inner_join([orders.columns[1]], [cust.columns[0]],
+                           left_mask=orders.columns[2].data < cutoff,
+                           right_mask=cust.columns[1].data == segment_code)
+        ord_j = gather_table(orders, jnp.asarray(oi))
+        lii, ori = inner_join([lineitem.columns[0]], [ord_j.columns[0]],
+                              left_mask=lineitem.columns[1].data > cutoff)
+        li_j = gather_table(lineitem, jnp.asarray(lii))
+        ord_jj = gather_table(ord_j, jnp.asarray(ori))
     rev = (li_j.columns[2].data.astype(jnp.int64)
            * (100 - li_j.columns[3].data.astype(jnp.int64)))
     gt = Table((li_j.columns[0], ord_jj.columns[2], ord_jj.columns[3],

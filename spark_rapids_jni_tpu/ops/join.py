@@ -85,14 +85,25 @@ def _col_equal(lc: Column, l_idx: jnp.ndarray, rc: Column, r_idx: jnp.ndarray,
     return eq
 
 
-def _candidates(left_keys, right_keys, nulls_equal):
+def _candidates(left_keys, right_keys, nulls_equal,
+                left_mask=None, right_mask=None):
     """(l_idx, r_idx) candidate pairs with equal row hash, verified exact.
     Device-resident; the only host syncs are the two data-dependent output
     sizes (candidate count, then verified-match count)."""
+    if left_mask is not None:
+        left_mask = jnp.asarray(left_mask, dtype=bool)
+    if right_mask is not None:
+        right_mask = jnp.asarray(right_mask, dtype=bool)
+    for m, keys, side in ((left_mask, left_keys, "left"),
+                          (right_mask, right_keys, "right")):
+        if m is not None and m.shape != (keys[0].size,):
+            raise ValueError(f"boolean {side}_mask shape {m.shape} != "
+                             f"key rows ({keys[0].size},)")
     in_bytes = sum(c.device_nbytes() for c in left_keys) \
         + sum(c.device_nbytes() for c in right_keys)
     with device_reservation(2 * in_bytes) as took:
-        total, state = _candidate_counts(left_keys, right_keys, nulls_equal)
+        total, state = _candidate_counts(left_keys, right_keys, nulls_equal,
+                                         left_mask, right_mask)
         release_barrier(state, took)
     if total == 0:
         z = np.zeros(0, dtype=np.int64)
@@ -112,7 +123,7 @@ def _candidates(left_keys, right_keys, nulls_equal):
     with device_reservation(2 * in_bytes
                             + bucket_size(total) * per_pair) as took:
         out = _expand_and_verify(left_keys, right_keys, nulls_equal, total,
-                                 state)
+                                 state, left_mask, right_mask)
         # framework-wide contract: reservations bracket an op's *transient*
         # working set; the returned arrays (device gather maps here, device
         # Columns for sort/groupby) are the caller's accounting, same as
@@ -136,9 +147,16 @@ def _verify_width(col: Column) -> int:
     return col.dtype.itemsize if col.dtype.is_fixed_width else 8
 
 
-def _candidate_counts(left_keys, right_keys, nulls_equal):
+def _candidate_counts(left_keys, right_keys, nulls_equal,
+                      left_mask=None, right_mask=None):
     """Phase 1: row hashes + sorted-hash range counts. Host-syncs the
-    candidate-pair total (sync #1) so phase 2 can reserve for it."""
+    candidate-pair total (sync #1) so phase 2 can reserve for it.
+
+    Masked-out rows get per-row poison hashes (distinct bases from the
+    null poisons) so they produce no candidates — the pushed-down filter
+    shrinks the expansion exactly like a real pre-filter would, and the
+    verify phase enforces the masks exactly (hash collisions with a
+    poison value cannot leak a masked row into the output)."""
     hl = _row_hash(left_keys)
     hr = _row_hash(right_keys)
     nl, nr = hl.shape[0], hr.shape[0]
@@ -151,6 +169,13 @@ def _candidate_counts(left_keys, right_keys, nulls_equal):
         hr = jnp.where(rn, np.uint64(0x1BAD1BAD1BAD1BAD)
                        ^ (jnp.arange(nr, dtype=jnp.uint64)
                           + np.uint64(1 << 63)), hr)
+    if left_mask is not None:
+        hl = jnp.where(left_mask, hl, np.uint64(0x2BAD2BAD2BAD2BAD)
+                       ^ jnp.arange(nl, dtype=jnp.uint64))
+    if right_mask is not None:
+        hr = jnp.where(right_mask, hr, np.uint64(0x3BAD3BAD3BAD3BAD)
+                       ^ (jnp.arange(nr, dtype=jnp.uint64)
+                          + np.uint64(1 << 62)))
 
     order = jnp.argsort(hr)
     hr_sorted = jnp.take(hr, order)
@@ -161,7 +186,8 @@ def _candidate_counts(left_keys, right_keys, nulls_equal):
     return total, (order, lo, cnt, nl)
 
 
-def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
+def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
+                       left_mask=None, right_mask=None):
     """Phase 2: expand candidate pairs on device and verify exact equality.
     The compaction stays on device — only the verified-match *count* syncs
     to host (sync #2); the gather maps themselves never round-trip.
@@ -182,6 +208,12 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state):
     r_idx = jnp.take(order, jnp.take(lo, l_idx) + within)  # take clips
 
     keep = lane < total
+    # pushed-down filters are enforced HERE (exactly), not just by the
+    # phase-1 hash poisoning
+    if left_mask is not None:
+        keep = keep & jnp.take(left_mask, l_idx)
+    if right_mask is not None:
+        keep = keep & jnp.take(right_mask, r_idx)
     for lc, rc in zip(left_keys, right_keys):
         keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
     if _backend() == "cpu":
@@ -230,12 +262,21 @@ def _expand_full_outer(l_idx, r_idx, n_left: int, n_right: int):
 
 
 def inner_join(left_keys: Sequence[Column], right_keys: Sequence[Column],
-               nulls_equal: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               nulls_equal: bool = False, left_mask=None,
+               right_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather maps (left_indices, right_indices) of matching row pairs —
     backend-natural int64 index arrays: device-resident on accelerators
     (apply with table_ops.gather_table; np.asarray() only if host logic
-    needs them), host numpy on the CPU backend."""
-    return _candidates(left_keys, right_keys, nulls_equal)
+    needs them), host numpy on the CPU backend.
+
+    ``left_mask`` / ``right_mask`` (bool[n], optional) push a filter into
+    the join: identical to pre-filtering that side, except the returned
+    indices refer to the ORIGINAL tables (no compaction, no survivor-count
+    sync, no index remapping at the call site) — the same
+    compile/sync-economy argument as groupby's row_mask
+    (docs/TPU_PERF.md)."""
+    return _candidates(left_keys, right_keys, nulls_equal,
+                       left_mask, right_mask)
 
 
 @func_range()

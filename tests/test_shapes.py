@@ -71,6 +71,40 @@ def test_groupby_row_mask_equals_filter_then_group(frac):
         assert cg.to_pylist() == cw.to_pylist()
 
 
+@pytest.mark.parametrize("frac", [0.0, 0.4, 1.0])
+def test_join_mask_pushdown_equals_prefilter(frac):
+    """inner_join left/right masks must match filtering each side first —
+    modulo the documented index-space difference (masked-join indices
+    refer to the original tables), checked by mapping back through the
+    survivor index lists. Nulls included so mask poisons and null poisons
+    coexist."""
+    from spark_rapids_jni_tpu.ops.join import inner_join
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    import jax.numpy as jnp
+    rng = np.random.default_rng(9)
+    nl, nr = 4000, 1500
+    lk = rng.integers(0, 500, nl)
+    rk = rng.integers(0, 500, nr)
+    lv = rng.random(nl) > 0.05
+    rv = rng.random(nr) > 0.05
+    lm = rng.random(nl) < frac
+    rm = rng.random(nr) < frac
+    lcol = Column.from_numpy(lk, dt.INT64, validity=lv)
+    rcol = Column.from_numpy(rk, dt.INT64, validity=rv)
+    lg, rg = inner_join([lcol], [rcol], left_mask=jnp.asarray(lm),
+                        right_mask=jnp.asarray(rm))
+    got = sorted(zip(np.asarray(lg).tolist(), np.asarray(rg).tolist()))
+    lf = filter_table(Table((lcol,)), lm).columns[0]
+    rf = filter_table(Table((rcol,)), rm).columns[0]
+    lg2, rg2 = inner_join([lf], [rf])
+    lmap = np.flatnonzero(lm)
+    rmap = np.flatnonzero(rm)
+    want = sorted((int(lmap[i]), int(rmap[j]))
+                  for i, j in zip(np.asarray(lg2).tolist(),
+                                  np.asarray(rg2).tolist()))
+    assert got == want
+
+
 @pytest.mark.parametrize("nmatch", [1023, 1024, 1025])
 def test_join_across_bucket_edges(nmatch):
     """Match counts straddling the bucket edge: padded expansion lanes and
