@@ -27,6 +27,53 @@ from spark_rapids_jni_tpu.ops.sort import sort_table
 CUTOFF_DAYS = 1200  # "1995-03-15" as days into the generated date range
 
 
+def _plan_ops(mesh):
+    """One (join, group) pair per execution mode so each query keeps a
+    single plan. Both callables take the mask-pushdown signature:
+
+      join(lkeys, rkeys, left_mask=None, right_mask=None) -> (li, ri)
+      group(table, key_idx, aggs, row_mask=None) -> Table
+
+    Local mode passes masks straight down (inner_join / groupby_aggregate
+    pushdown — docs/TPU_PERF.md sync economy). Mesh mode realizes the same
+    semantics by pre-filtering the masked side and remapping the returned
+    gather maps to the ORIGINAL index space via the survivor list, so call
+    sites are mode-agnostic."""
+    if mesh is None:
+        def join(lkeys, rkeys, left_mask=None, right_mask=None):
+            return inner_join(lkeys, rkeys, left_mask=left_mask,
+                              right_mask=right_mask)
+
+        def group(table, key_idx, aggs, row_mask=None):
+            return groupby_aggregate(table, key_idx, aggs, row_mask=row_mask)
+        return join, group
+
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_groupby, distributed_inner_join)
+
+    def _side(keys, mask):
+        if mask is None:
+            return keys, None
+        t = filter_table(Table(tuple(keys)), mask)
+        return list(t.columns), np.flatnonzero(np.asarray(mask))
+
+    def join(lkeys, rkeys, left_mask=None, right_mask=None):
+        lkeys, lmap = _side(lkeys, left_mask)
+        rkeys, rmap = _side(rkeys, right_mask)
+        li, ri = distributed_inner_join(lkeys, rkeys, mesh)
+        if lmap is not None:
+            li = jnp.asarray(lmap)[jnp.asarray(li)]
+        if rmap is not None:
+            ri = jnp.asarray(rmap)[jnp.asarray(ri)]
+        return li, ri
+
+    def group(table, key_idx, aggs, row_mask=None):
+        if row_mask is not None:
+            table = filter_table(table, row_mask)
+        return distributed_groupby(table, key_idx, aggs, mesh)
+    return join, group
+
+
 def generate_q3_tables(rows: int, seed: int):
     """(customer, orders, lineitem) Tables at `rows` lineitem rows with
     TPC-H row ratios (orders = rows/4, customer = rows/40).
@@ -120,38 +167,21 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     c_nationkey = s_nationkey co-nation predicate, then revenue per nation
     sorted descending. Returns (n_nationkey, revenue)."""
     od = orders.columns[2].data
-    if mesh is not None:
-        from spark_rapids_jni_tpu.parallel.distributed import (
-            distributed_groupby, distributed_inner_join)
-        join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
-        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
+    join, group = _plan_ops(mesh)
 
-        # nations in the region; suppliers in those nations
-        nat_f = filter_table(nation, nation.columns[1].data == region_code)
-        si, _ = join([Column(dt.INT64, supplier.num_rows,
-                             data=supplier.columns[1].data.astype(jnp.int64))],
-                     [nat_f.columns[0]])
-        supp_f = gather_table(supplier, jnp.asarray(si))
+    # one plan for both modes (mask pushdown locally; the mesh wrappers
+    # pre-filter + remap to the same original-index contract).
+    # nations in the region; suppliers in those nations
+    si, _ = join([Column(dt.INT64, supplier.num_rows,
+                         data=supplier.columns[1].data.astype(jnp.int64))],
+                 [nation.columns[0]],
+                 right_mask=nation.columns[1].data == region_code)
+    supp_f = gather_table(supplier, jnp.asarray(si))
 
-        # orders in the date window, joined to customers (carry c_nationkey)
-        ord_f = filter_table(orders, (od >= date_lo) & (od < date_hi))
-        oi, ci = join([ord_f.columns[1]], [cust.columns[0]])
-        ord_j = gather_table(ord_f, jnp.asarray(oi))
-    else:
-        join = inner_join
-        # region + date filters ride the joins' mask pushdown (gather maps
-        # index the original tables — docs/TPU_PERF.md sync economy); the
-        # final aggregation below calls groupby_aggregate(row_mask=...)
-        # directly
-        si, _ = inner_join(
-            [Column(dt.INT64, supplier.num_rows,
-                    data=supplier.columns[1].data.astype(jnp.int64))],
-            [nation.columns[0]],
-            right_mask=nation.columns[1].data == region_code)
-        supp_f = gather_table(supplier, jnp.asarray(si))
-        oi, ci = inner_join([orders.columns[1]], [cust.columns[0]],
-                            left_mask=(od >= date_lo) & (od < date_hi))
-        ord_j = gather_table(orders, jnp.asarray(oi))
+    # orders in the date window, joined to customers (carry c_nationkey)
+    oi, ci = join([orders.columns[1]], [cust.columns[0]],
+                  left_mask=(od >= date_lo) & (od < date_hi))
+    ord_j = gather_table(orders, jnp.asarray(oi))
     cust_j = gather_table(cust, jnp.asarray(ci))
 
     # lineitem to its order (carry the customer's nation), then its supplier
@@ -169,12 +199,8 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
                * (100 - li_jj.columns[3].data.astype(jnp.int64)))
     gt = Table((snat.columns[0],
                 Column(dt.INT64, int(rev_all.shape[0]), data=rev_all)))
-    if mesh is not None:
-        li_rows = filter_table(gt, same)
-        g = group(li_rows, [0], [(1, "sum")])
-    else:
-        # co-nation predicate rides groupby's row_mask pushdown
-        g = groupby_aggregate(gt, [0], [(1, "sum")], row_mask=same)
+    # co-nation predicate rides the group's row_mask pushdown
+    g = group(gt, [0], [(1, "sum")], row_mask=same)
     return sort_table(g, [1], ascending=[False])
 
 
@@ -189,31 +215,18 @@ def run_q3(cust: Table, orders: Table, lineitem: Table,
     partition (parallel/distributed). Filters are embarrassingly parallel
     and the final sort sees only group-count rows, so both stay local.
     """
-    if mesh is not None:
-        from spark_rapids_jni_tpu.parallel.distributed import (
-            distributed_groupby, distributed_inner_join)
-        join = lambda l, r: distributed_inner_join(l, r, mesh)  # noqa: E731
-        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
-        cust_f = filter_table(cust, cust.columns[1].data == segment_code)
-        ord_f = filter_table(orders, orders.columns[2].data < cutoff)
-        oi, _ = join([ord_f.columns[1]], [cust_f.columns[0]])
-        ord_j = gather_table(ord_f, jnp.asarray(oi))
-        li_f = filter_table(lineitem, lineitem.columns[1].data > cutoff)
-        lii, ori = join([li_f.columns[0]], [ord_j.columns[0]])
-        li_j = gather_table(li_f, jnp.asarray(lii))
-        ord_jj = gather_table(ord_j, jnp.asarray(ori))
-    else:
-        group = groupby_aggregate
-        # filters ride the joins' mask pushdown: gather maps index the
-        # ORIGINAL tables, so no compaction syncs and no index remapping
-        oi, _ = inner_join([orders.columns[1]], [cust.columns[0]],
-                           left_mask=orders.columns[2].data < cutoff,
-                           right_mask=cust.columns[1].data == segment_code)
-        ord_j = gather_table(orders, jnp.asarray(oi))
-        lii, ori = inner_join([lineitem.columns[0]], [ord_j.columns[0]],
-                              left_mask=lineitem.columns[1].data > cutoff)
-        li_j = gather_table(lineitem, jnp.asarray(lii))
-        ord_jj = gather_table(ord_j, jnp.asarray(ori))
+    join, group = _plan_ops(mesh)
+    # one plan for both modes: filters ride the joins' mask pushdown
+    # (gather maps index the ORIGINAL tables; the mesh wrappers realize the
+    # same contract by pre-filter + survivor-list remap)
+    oi, _ = join([orders.columns[1]], [cust.columns[0]],
+                 left_mask=orders.columns[2].data < cutoff,
+                 right_mask=cust.columns[1].data == segment_code)
+    ord_j = gather_table(orders, jnp.asarray(oi))
+    lii, ori = join([lineitem.columns[0]], [ord_j.columns[0]],
+                    left_mask=lineitem.columns[1].data > cutoff)
+    li_j = gather_table(lineitem, jnp.asarray(lii))
+    ord_jj = gather_table(ord_j, jnp.asarray(ori))
     rev = (li_j.columns[2].data.astype(jnp.int64)
            * (100 - li_j.columns[3].data.astype(jnp.int64)))
     gt = Table((li_j.columns[0], ord_jj.columns[2], ord_jj.columns[3],
@@ -256,26 +269,18 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
     itself exercises BASELINE configs[1]-style aggregation at q1's shape.
     """
     keep = lineitem.columns[6].data <= cutoff
-    if mesh is not None:
-        from spark_rapids_jni_tpu.parallel.distributed import (
-            distributed_groupby)
-        li = filter_table(lineitem, keep)
-        group = lambda t, k, a: distributed_groupby(t, k, a, mesh)  # noqa: E731
-        mask = None
-    else:
-        # predicate pushdown: the filter rides groupby's row_mask — no
-        # stream compaction, no survivor-count sync or fresh program shape
-        li = lineitem
-        group = groupby_aggregate
-        mask = keep
-    qty = li.columns[0].data.astype(jnp.int64)
-    price = li.columns[1].data.astype(jnp.int64)
-    disc = li.columns[2].data.astype(jnp.int64)
-    tax = li.columns[3].data.astype(jnp.int64)
+    _, group = _plan_ops(mesh)
+    # one plan for both modes: the filter rides group's row_mask pushdown
+    # (no stream compaction, no survivor-count sync or fresh program shape
+    # locally; the mesh wrapper pre-filters with identical semantics)
+    qty = lineitem.columns[0].data.astype(jnp.int64)
+    price = lineitem.columns[1].data.astype(jnp.int64)
+    disc = lineitem.columns[2].data.astype(jnp.int64)
+    tax = lineitem.columns[3].data.astype(jnp.int64)
     disc_price = price * (100 - disc)            # cents·pct
     charge = disc_price * (100 + tax)            # cents·pct²
-    n = li.num_rows
-    gt = Table((li.columns[4], li.columns[5],
+    n = lineitem.num_rows
+    gt = Table((lineitem.columns[4], lineitem.columns[5],
                 Column(dt.INT64, n, data=qty),
                 Column(dt.INT64, n, data=price),
                 Column(dt.INT64, n, data=disc_price),
@@ -283,8 +288,7 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
                 Column(dt.INT64, n, data=disc)))
     aggs = [(2, "sum"), (3, "sum"), (4, "sum"), (5, "sum"),
             (2, "mean"), (3, "mean"), (6, "mean"), (2, "count")]
-    g = group(gt, [0, 1], aggs) if mask is None else \
-        group(gt, [0, 1], aggs, row_mask=mask)
+    g = group(gt, [0, 1], aggs, row_mask=keep)
     return sort_table(g, [0, 1])
 
 

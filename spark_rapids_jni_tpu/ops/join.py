@@ -115,6 +115,10 @@ def _candidates(left_keys, right_keys, nulls_equal,
     # vector + two int64 output maps) + the padded byte rows _col_equal
     # gathers per candidate for wide keys
     per_pair = 48
+    if left_mask is not None:
+        per_pair += 1  # bucket-lane bool from the mask gather
+    if right_mask is not None:
+        per_pair += 1
     for lc, rc in zip(left_keys, right_keys):
         per_pair += _verify_width(lc) + _verify_width(rc)
     # reserve at the BUCKETED lane count — phase 2 allocates every array at
