@@ -257,6 +257,46 @@ def _pallas_bitcompare(np, jnp):
           file=sys.stderr)
 
 
+@check("mask_pushdown_oracle")
+def _mask_pushdown(np, jnp):
+    """Round-4 filter pushdown (groupby row_mask, join left/right masks)
+    must equal explicit filter-then-op ON-CHIP — the poison hashes and
+    dead-group trimming ride bucket-padded device programs whose Mosaic/XLA
+    lowering the CPU suite can't vouch for."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.ops.join import inner_join
+
+    rng = np.random.default_rng(11)
+    n = 60000
+    keys = Column.from_numpy(rng.integers(0, 300, n), dt.INT64)
+    vals = Column.from_numpy(rng.integers(-50, 50, n), dt.INT64)
+    mask = jnp.asarray(rng.random(n) < 0.35)
+    t = Table((keys, vals))
+    aggs = [(1, "sum"), (1, "count"), (1, "min")]
+    got = groupby_aggregate(t, [0], aggs, row_mask=mask)
+    want = groupby_aggregate(filter_table(t, mask), [0], aggs)
+    assert got.num_rows == want.num_rows
+    for cg, cw in zip(got.columns, want.columns):
+        assert cg.to_pylist() == cw.to_pylist()
+
+    rk = Column.from_numpy(rng.permutation(np.arange(600))[:300], dt.INT64)
+    rmask = jnp.asarray(rng.random(300) < 0.5)
+    lg, rg = inner_join([keys], [rk], left_mask=mask, right_mask=rmask)
+    lmap = np.flatnonzero(np.asarray(mask))
+    rmap = np.flatnonzero(np.asarray(rmask))
+    lf = filter_table(Table((keys,)), mask).columns[0]
+    rf = filter_table(Table((rk,)), rmask).columns[0]
+    lg2, rg2 = inner_join([lf], [rf])
+    got_pairs = sorted(zip(np.asarray(lg).tolist(), np.asarray(rg).tolist()))
+    want_pairs = sorted((int(lmap[i]), int(rmap[j]))
+                        for i, j in zip(np.asarray(lg2).tolist(),
+                                        np.asarray(rg2).tolist()))
+    assert got_pairs == want_pairs
+
+
 @check("hbm_reservation_watermarks")
 def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real
