@@ -487,3 +487,115 @@ def test_shuffle_thread_outranks_tasks_in_wakeups():
         for t in (holder, shuffle, task):
             t.stop()
         RmmSpark.clear_event_handler()
+
+
+def test_retry_watchdog_bounded_escalation(adaptor):
+    """A task spinning in the alloc-fail → block loop must be escalated
+    (split-and-retry, then fatal) in bounded iterations — the machine never
+    lets it retry indefinitely (reference RmmSparkTest.retryWatchdog: the
+    9-of-10 filler + 2-of-10 alloc loop must not reach 10000 retries)."""
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 7).result()
+        t.do(RmmSpark.alloc, 90 * MB).result()  # filler: 9/10 of the pool
+        retries = 0
+        escalated = None
+        for _ in range(500):
+            try:
+                t.do(RmmSpark.alloc, 20 * MB).result()
+                raise AssertionError("overallocation must never succeed")
+            except TpuRetryOOM:
+                retries += 1
+                try:
+                    t.do(RmmSpark.block_thread_until_ready).result()
+                except (TpuSplitAndRetryOOM, TpuOOM) as e:
+                    retries += 1
+                    escalated = e
+                    break
+            except (TpuSplitAndRetryOOM, TpuOOM) as e:
+                escalated = e
+                break
+        # boundedness is the loop itself: escalation must arrive within
+        # the 500-iteration budget (the reference's bar is 10000)
+        assert escalated is not None, \
+            f"no escalation after {retries} retry iterations"
+        t.do(RmmSpark.dealloc, 90 * MB).result()
+        t.do(RmmSpark.task_done, 7).result()
+    finally:
+        t.stop()
+
+
+def test_allocation_inside_rollback_spill_path(adaptor):
+    """Allocating from within the spill path is legal when it fits, and an
+    oversized allocation there surfaces as OOM without corrupting the
+    ledger (reference testAllocationDuringSpill /
+    testAllocationFailedDuringSpill: the event handler allocates 1 byte —
+    fine — or 2 MB — fails — from inside the spill callback)."""
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 9).result()
+        held = []
+        spill_allocs = [0]
+
+        def attempt(n):
+            RmmSpark.alloc(n)
+            held.append(n)
+            return n
+
+        def rollback_small():
+            while held:
+                RmmSpark.dealloc(held.pop())
+            # the 1-byte-analog allocation inside the spill path: must work
+            RmmSpark.alloc(1024)
+            RmmSpark.dealloc(1024)
+            spill_allocs[0] += 1
+
+        RmmSpark.force_retry_oom(t.tid, num_ooms=1)
+        out = t.do(lambda: with_retry(
+            attempt, 60 * MB, split=lambda n: [n // 2, n - n // 2],
+            rollback=rollback_small)).result()
+        assert out == [60 * MB]
+        assert spill_allocs[0] >= 1
+        t.do(lambda: [RmmSpark.dealloc(held.pop())
+                      for _ in range(len(held))]).result()
+
+        # oversized allocation inside the spill path: surfaces as an OOM
+        # without wedging the machine or leaking the ledger
+        t.do(RmmSpark.alloc, 90 * MB).result()
+
+        def rollback_big():
+            while held:
+                RmmSpark.dealloc(held.pop())
+            RmmSpark.alloc(50 * MB)  # cannot ever fit beside the filler
+
+        with pytest.raises((TpuRetryOOM, TpuSplitAndRetryOOM, TpuOOM)):
+            t.do(lambda: with_retry(attempt, 20 * MB,
+                                    rollback=rollback_big)).result()
+        t.do(RmmSpark.dealloc, 90 * MB).result()
+        # the machine recovered: a plain allocation cycle works
+        t.do(RmmSpark.alloc, 10 * MB).result()
+        t.do(RmmSpark.dealloc, 10 * MB).result()
+        t.do(RmmSpark.task_done, 9).result()
+    finally:
+        t.stop()
+
+
+def test_reentrant_associate_thread(adaptor):
+    """Associating an already-associated dedicated task thread is legal and
+    idempotent-with-nesting the way the JVM side relies on (reference
+    testReentrantAssociateThread): a second associate + single task_done
+    cycle must leave the thread usable, not wedge the state machine."""
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 3).result()
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 3).result()
+        t.do(RmmSpark.alloc, 4 * MB).result()
+        t.do(RmmSpark.dealloc, 4 * MB).result()
+        t.do(RmmSpark.task_done, 3).result()
+        # thread can be re-dedicated afterwards
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 4).result()
+        t.do(RmmSpark.alloc, 1 * MB).result()
+        t.do(RmmSpark.dealloc, 1 * MB).result()
+        t.do(RmmSpark.task_done, 4).result()
+    finally:
+        t.stop()
