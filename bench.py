@@ -45,6 +45,15 @@ INIT_WATCHDOG_S = int(os.environ.get("BENCH_INIT_WATCHDOG_S", "420"))
 # order until the deadline, skipped ones are reported as "skipped".
 SWEEP_DEADLINE_S = float(os.environ.get("BENCH_SWEEP_DEADLINE_S", "1500"))
 
+# Mid-sweep stall watchdog (round 4): the tunnel wedged *inside* an axis
+# repeat's device call — a place neither the subprocess probe nor the init
+# watchdog guards, and the process hung with the headline + two finished
+# axes unemitted. Every repeat now heartbeats; a monitor thread turns a
+# stall into (a) a CPU re-exec if the wedge hit before the headline landed
+# (a full CPU record beats nothing) or (b) an immediate emit of the partial
+# accelerator sweep (that partial IS the round's TPU evidence).
+STALL_S = int(os.environ.get("BENCH_STALL_S", "900"))
+
 # Statistical honesty (round-3 verdict weak #6): single runs on a shared
 # 1-core container carry ±30% variance, so every axis is timed REPEATS
 # times and reported as {median, min, repeats}; deltas between rounds are
@@ -58,6 +67,79 @@ REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
 def _log(msg):
     print(f"bench: {msg}", file=sys.stderr)
     sys.stderr.flush()
+
+
+# Shared progress state for the stall watchdog. The main thread blocks
+# inside C device calls with the GIL released, so the monitor thread can
+# always run, emit, and exit/exec the process out from under it (same
+# mechanism the init watchdog already relies on).
+_STATE = {
+    "t_last": None,      # monotonic time of the last heartbeat
+    "backend": None,
+    "headline": None,    # rows/s once the headline lands
+    "axes": {},          # _sweep mutates this dict in place
+    "current_axis": None,
+    "emitted": False,
+}
+_STATE_LOCK = threading.Lock()
+
+
+def _heartbeat():
+    with _STATE_LOCK:
+        _STATE["t_last"] = time.monotonic()
+
+
+def _emit(rows_per_s, backend, axes, note=None):
+    rec = {
+        "metric": "murmur3_row_hash_4col_throughput",
+        "value": round(rows_per_s / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(rows_per_s / NOMINAL_ROWS_PER_S, 4),
+        "backend": backend,
+        "axes": axes,
+    }
+    if note:
+        rec["note"] = note
+    print(json.dumps(rec), flush=True)
+
+
+def _stall_watchdog(argv):
+    """Monitor thread: no heartbeat for STALL_S ⇒ the relay wedged inside a
+    device call. Pre-headline: re-exec CPU-pinned (full CPU record). After:
+    emit the partial accelerator sweep and exit 0."""
+    _heartbeat()  # arm immediately: a wedge during the input-transfer /
+    # device-init calls BEFORE the first in-band heartbeat must still trip
+    poll_s = max(2, min(15, STALL_S // 4))
+    while True:
+        time.sleep(poll_s)
+        with _STATE_LOCK:
+            if _STATE["emitted"]:
+                return
+            t_last = _STATE["t_last"]
+            headline = _STATE["headline"]
+            backend = _STATE["backend"]
+            cur = _STATE["current_axis"]
+        if t_last is None or time.monotonic() - t_last < STALL_S:
+            continue
+        if headline is None:
+            try:
+                _cpu_reexec(argv, f"device call wedged pre-headline "
+                            f"(> {STALL_S}s stall)")
+            except Exception as e:  # execve itself failed — don't fall
+                # through and emit a fabricated 0-value record; exit loudly
+                _log(f"cpu re-exec failed ({e}); exiting without emit")
+                os._exit(3)
+        with _STATE_LOCK:
+            if _STATE["emitted"]:
+                return
+            _STATE["emitted"] = True
+            axes = dict(_STATE["axes"])
+        if cur is not None and cur not in axes:
+            axes[cur] = {"error": f"wedged mid-axis (> {STALL_S}s stall)"}
+        _log(f"relay wedged mid-sweep (> {STALL_S}s); emitting partial")
+        _emit(headline, backend or "unknown", axes,
+              note=f"partial: relay stalled > {STALL_S}s during sweep")
+        os._exit(0)
 
 
 def _cpu_reexec(argv, reason):
@@ -178,8 +260,10 @@ def _headline():
         h = H._mm_u64(h, H._f64_bits(d, False))
         return h.astype(jnp.int32)
 
+    _heartbeat()
     out = row_hash(jnp.uint32(0), a, b, c, d)
     out.block_until_ready()  # compile + warm
+    _heartbeat()
 
     # vary an input each iteration and block per iteration: with identical
     # args the runtime elides re-execution and reports impossible throughput.
@@ -193,6 +277,7 @@ def _headline():
             out = row_hash(jnp.uint32(r * 10 + i + 1), a, b, c, d)
             out.block_until_ready()
         block_avgs.append((time.perf_counter() - t0) / 10)
+        _heartbeat()
     dt = statistics.median(block_avgs)
     return n / dt
 
@@ -221,13 +306,18 @@ def _sweep(deadline):
         ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
         ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
     ]
-    results = {}
+    results = _STATE["axes"]  # shared: the stall watchdog emits this dict
     for name, fn, rows in axes:
         left = deadline - time.monotonic()
         if left <= 0:
             results[name] = {"skipped": "sweep deadline"}
             continue
         _log(f"axis {name} ({left:.0f}s left)")
+        with _STATE_LOCK:
+            _STATE["current_axis"] = name
+        _heartbeat()
+        if os.environ.get("_BENCH_TEST_STALL") == name:
+            time.sleep(10 ** 6)  # test hook: simulate a wedged device call
         # >= 1 repeat always; later repeats stop at the deadline so a slow
         # axis degrades to fewer repeats instead of a skip. A failure on a
         # later repeat must NOT discard already-collected timings — in a
@@ -239,6 +329,7 @@ def _sweep(deadline):
             try:
                 sec, nbytes = fn()
                 secs.append(sec)
+                _heartbeat()
             except Exception as e:  # an axis must never sink the sweep
                 err = f"{type(e).__name__}: {e}"
                 _log(f"  {name} repeat {r + 1} FAILED: {e}")
@@ -265,28 +356,33 @@ def _sweep(deadline):
 
 
 def main():
-    _ensure_backend()
+    argv = list(sys.argv)
+    _ensure_backend(argv)
+    threading.Thread(target=_stall_watchdog, args=(argv,),
+                     daemon=True).start()
     import jax
     backend = jax.devices()[0].platform
+    with _STATE_LOCK:
+        _STATE["backend"] = backend
     _log(f"backend: {backend} x{len(jax.devices())}")
 
     rows_per_s = _headline()
+    with _STATE_LOCK:
+        _STATE["headline"] = rows_per_s
     _log(f"headline murmur3 hash: {rows_per_s / 1e6:.0f} Mrows/s")
 
     try:
         axes = _sweep(time.monotonic() + SWEEP_DEADLINE_S)
     except Exception as e:  # the measured headline must still be emitted
-        axes = {"error": f"{type(e).__name__}: {e}"}
+        axes = dict(_STATE["axes"])
+        axes["error"] = f"{type(e).__name__}: {e}"
         _log(f"sweep failed: {e}")
 
-    print(json.dumps({
-        "metric": "murmur3_row_hash_4col_throughput",
-        "value": round(rows_per_s / 1e6, 2),
-        "unit": "Mrows/s",
-        "vs_baseline": round(rows_per_s / NOMINAL_ROWS_PER_S, 4),
-        "backend": backend,
-        "axes": axes,
-    }))
+    with _STATE_LOCK:
+        if _STATE["emitted"]:  # the watchdog beat us to it
+            return
+        _STATE["emitted"] = True
+    _emit(rows_per_s, backend, axes)
 
 
 if __name__ == "__main__":

@@ -57,3 +57,23 @@ def test_bench_py_emits_json_line():
                         "backend", "axes"}
     assert rec["value"] > 0
     assert all(v.get("skipped") for v in rec["axes"].values())
+
+
+def test_bench_py_stall_watchdog_emits_partial():
+    """Round-4 regression: the tunnel wedged INSIDE an axis's device call and
+    the old bench hung forever with the headline + finished axes unemitted.
+    The stall watchdog must turn that hang into a partial JSON emit (post-
+    headline) with the in-flight axis marked wedged."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
+               BENCH_SWEEP_DEADLINE_S="600", BENCH_PROBE_ATTEMPTS="1",
+               BENCH_PROBE_TIMEOUT_S="120", BENCH_REPEATS="1",
+               BENCH_STALL_S="3",
+               _BENCH_TEST_STALL="row_conversion_fixed_1m")
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd=__file__.rsplit("/", 2)[0], timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0  # the headline still made it out
+    assert "partial" in rec.get("note", "")
+    assert "wedged" in rec["axes"]["row_conversion_fixed_1m"]["error"]
