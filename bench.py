@@ -289,22 +289,28 @@ def _sweep(deadline):
     from benchmarks import bench_ops as B
     B._refresh_variants()
 
+    # Zero-TPU-evidence axes lead: under a truncated or wedged window the
+    # sweep deadline is the scarce resource, and a never-measured axis is
+    # worth more than a re-measurement (q5/q6, the skewed shuffle and the
+    # 4M row-conversion points have never landed on-chip — the two captured
+    # windows spent their budget on the 1M axes and then wedged). Their
+    # compiles also seed the persistent cache for the later axes.
     axes = [
-        ("row_conversion_fixed_1m", lambda: B.bench_row_conversion(1 << 20, False), 1 << 20),
-        ("row_conversion_strings_1m", lambda: B.bench_row_conversion(1 << 20, True), 1 << 20),
+        ("tpch_q6_1m", lambda: B.bench_tpch_q6(1 << 20), 1 << 20),
+        ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
+        ("shuffle_skewed_1m", lambda: B.bench_shuffle_skewed(1 << 20), 1 << 20),
+        ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
+        ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
         ("groupby_1m", lambda: B.bench_groupby(1 << 20), 1 << 20),
         ("join_1m", lambda: B.bench_join(1 << 20), 1 << 20),
+        ("tpch_q1_1m", lambda: B.bench_tpch_q1(1 << 20), 1 << 20),
+        ("tpch_q3_1m", lambda: B.bench_tpch_q3(1 << 20), 1 << 20),
+        ("row_conversion_fixed_1m", lambda: B.bench_row_conversion(1 << 20, False), 1 << 20),
+        ("row_conversion_strings_1m", lambda: B.bench_row_conversion(1 << 20, True), 1 << 20),
         ("sort_1m", lambda: B.bench_sort(1 << 20), 1 << 20),
         ("bloom_filter_1m", lambda: B.bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: B.bench_cast_string_to_float(500_000), 500_000),
         ("parse_uri_200k", lambda: B.bench_parse_uri(200_000), 200_000),
-        ("tpch_q1_1m", lambda: B.bench_tpch_q1(1 << 20), 1 << 20),
-        ("tpch_q3_1m", lambda: B.bench_tpch_q3(1 << 20), 1 << 20),
-        ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
-        ("tpch_q6_1m", lambda: B.bench_tpch_q6(1 << 20), 1 << 20),
-        ("shuffle_skewed_1m", lambda: B.bench_shuffle_skewed(1 << 20), 1 << 20),
-        ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
-        ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
     ]
     results = _STATE["axes"]  # shared: the stall watchdog emits this dict
     for name, fn, rows in axes:
