@@ -297,6 +297,78 @@ def _mask_pushdown(np, jnp):
     assert got_pairs == want_pairs
 
 
+@check("zorder_interleave_hilbert_oracle")
+def _zorder(np, jnp):
+    """Z-order interleave vs a python bit-by-bit oracle and Hilbert-curve
+    bijectivity on-chip (zorder.cu:138-222 / :224-273 capabilities). These
+    are pure bit-twiddling device programs — exactly the kind whose XLA
+    lowering on the real backend the CPU suite can't vouch for."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
+
+    rng = np.random.default_rng(12)
+    n = 4096
+    a = rng.integers(-(2**31), 2**31, n).astype(np.int32)
+    b = rng.integers(-(2**31), 2**31, n).astype(np.int32)
+    c = rng.integers(-(2**31), 2**31, n).astype(np.int32)
+    out = interleave_bits([Column.from_numpy(x, dt.INT32) for x in (a, b, c)])
+    blob = np.asarray(out.children[0].data)
+    offs = np.asarray(out.offsets)
+    # python oracle on a sample of rows: bit k of column j lands at output
+    # bit position (31-k)*ncols + j counting from the MSB of the blob row
+    for i in rng.integers(0, n, 64):
+        row = blob[offs[i]:offs[i + 1]]
+        bits = np.unpackbits(row)
+        for j, col in enumerate((a, b, c)):
+            v = np.uint32(col[i])
+            for k in (0, 1, 7, 13, 31):  # spot bits incl. sign
+                assert bits[k * 3 + j] == ((int(v) >> (31 - k)) & 1), (i, j, k)
+
+    # Hilbert: every cell of a 2^5 x 2^5 grid maps to a distinct index in
+    # [0, 1024) and consecutive curve positions are grid neighbours
+    g = np.arange(32, dtype=np.int32)
+    xs, ys = np.meshgrid(g, g, indexing="ij")
+    hx = Column.from_numpy(xs.ravel().astype(np.int32), dt.INT32)
+    hy = Column.from_numpy(ys.ravel().astype(np.int32), dt.INT32)
+    idx = np.asarray(hilbert_index(5, [hx, hy]).data)
+    assert sorted(idx.tolist()) == list(range(1024))
+    order = np.argsort(idx)
+    dx = np.abs(np.diff(xs.ravel()[order]))
+    dy = np.abs(np.diff(ys.ravel()[order]))
+    assert np.all(dx + dy == 1)  # unit-step adjacency along the curve
+
+
+@check("histogram_percentile_oracle")
+def _histogram(np, jnp):
+    """percentile_from_histogram vs numpy expansion oracle on-chip
+    (histogram.cu:53-144 interpolation semantics)."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.histogram import (
+        create_histogram_if_valid, percentile_from_histogram)
+
+    rng = np.random.default_rng(13)
+    n = 3000
+    vals = rng.standard_normal(n) * 100
+    freqs = rng.integers(0, 6, n)  # freq-0 rows are dropped (negative raises)
+    vc = Column.from_numpy(vals, dt.FLOAT64)
+    fc = Column.from_numpy(freqs.astype(np.int64), dt.INT64)
+    hist = create_histogram_if_valid(vc, fc, output_as_lists=False)
+    pcts = [0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0]
+    got = percentile_from_histogram(hist, pcts, output_as_list=True)
+    # FLOAT64 columns carry uint64 bit patterns (docs/TPU_NUMERICS.md);
+    # host_values() decodes
+    got_vals = got.children[0].host_values().astype(np.float64)
+
+    expanded = np.sort(np.repeat(vals[freqs > 0], freqs[freqs > 0]))
+    pos = np.asarray(pcts) * (len(expanded) - 1)
+    lo, hi = np.floor(pos).astype(int), np.ceil(pos).astype(int)
+    want = expanded[lo] + (expanded[hi] - expanded[lo]) * (pos - lo)
+    assert np.allclose(got_vals, want, rtol=1e-12, atol=1e-9), (
+        got_vals, want)
+
+
 @check("hbm_reservation_watermarks")
 def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real
