@@ -301,6 +301,12 @@ def _sweep(deadline):
         ("shuffle_skewed_1m", lambda: B.bench_shuffle_skewed(1 << 20), 1 << 20),
         ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
         ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
+        # scale axes: the 1M pipeline axes are dispatch-bound on the axon
+        # backend (~10-40 ms RPC per program + 16-64 ms per host sync,
+        # docs/TPU_PERF.md) — these measure the compute-bound regime the
+        # fixed per-op costs amortize into at reference-workload sizes
+        ("tpch_q1_8m", lambda: B.bench_tpch_q1(1 << 23), 1 << 23),
+        ("groupby_16m", lambda: B.bench_groupby(1 << 24), 1 << 24),
         ("groupby_1m", lambda: B.bench_groupby(1 << 20), 1 << 20),
         ("join_1m", lambda: B.bench_join(1 << 20), 1 << 20),
         ("tpch_q1_1m", lambda: B.bench_tpch_q1(1 << 20), 1 << 20),
