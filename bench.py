@@ -342,10 +342,19 @@ def _sweep(deadline):
                 sec, nbytes = fn()
                 secs.append(sec)
                 _heartbeat()
+            except RuntimeError as e:
+                if "devices" in str(e):  # structural: single-device backend
+                    results[name] = {"skipped": str(e)}
+                    break
+                err = f"{type(e).__name__}: {e}"
+                _log(f"  {name} repeat {r + 1} FAILED: {e}")
+                break
             except Exception as e:  # an axis must never sink the sweep
                 err = f"{type(e).__name__}: {e}"
                 _log(f"  {name} repeat {r + 1} FAILED: {e}")
                 break
+        if name in results:  # structural skip recorded above
+            continue
         if not secs:
             results[name] = {"error": err or "no repeats completed"}
             continue

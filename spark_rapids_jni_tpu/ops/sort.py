@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -110,6 +111,14 @@ def sort_order(keys: Sequence[Column],
             lanes.append(nl)
     if not lanes:
         return jnp.arange(n, dtype=jnp.int32)
+    if jax.default_backend() == "cpu":
+        # Backend-natural branch (same pattern as join/groupby CPU
+        # compaction): numpy's stable lexsort is 2-3x XLA:CPU's comparator
+        # sort network at 1M rows (measured; BASELINE.md round 4) with
+        # identical semantics over the same monotone lanes. Accelerators
+        # keep the on-device sort — the lanes never leave HBM there.
+        return jnp.asarray(np.lexsort(tuple(np.asarray(l) for l in lanes))
+                           .astype(np.int32))
     return jnp.lexsort(tuple(lanes)).astype(jnp.int32)
 
 

@@ -68,7 +68,10 @@ def test_bench_py_stall_watchdog_emits_partial():
                BENCH_SWEEP_DEADLINE_S="600", BENCH_PROBE_ATTEMPTS="1",
                BENCH_PROBE_TIMEOUT_S="120", BENCH_REPEATS="1",
                BENCH_STALL_S="3",
-               _BENCH_TEST_STALL="row_conversion_fixed_1m")
+               # stall on the sweep's FIRST axis: the hook fires before any
+               # axis work, so the tiny stall threshold cannot false-trigger
+               # on a slow axis setup earlier in the order
+               _BENCH_TEST_STALL="tpch_q6_1m")
     proc = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,
         cwd=__file__.rsplit("/", 2)[0], timeout=600, env=env)
@@ -76,4 +79,4 @@ def test_bench_py_stall_watchdog_emits_partial():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["value"] > 0  # the headline still made it out
     assert "partial" in rec.get("note", "")
-    assert "wedged" in rec["axes"]["row_conversion_fixed_1m"]["error"]
+    assert "wedged" in rec["axes"]["tpch_q6_1m"]["error"]
