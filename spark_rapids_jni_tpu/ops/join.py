@@ -181,7 +181,7 @@ def _candidate_counts(left_keys, right_keys, nulls_equal,
                        ^ (jnp.arange(nr, dtype=jnp.uint64)
                           + np.uint64(1 << 62)))
 
-    if _backend() == "cpu":
+    if _backend() == "cpu" and not isinstance(hr, jax.core.Tracer):
         # backend-natural: numpy argsort is ~3x XLA:CPU's sort network at
         # 1M rows (see sort_order); the hash array is host-cheap on CPU
         order = jnp.asarray(np.argsort(np.asarray(hr), kind="stable"))

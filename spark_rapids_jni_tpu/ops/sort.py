@@ -111,7 +111,8 @@ def sort_order(keys: Sequence[Column],
             lanes.append(nl)
     if not lanes:
         return jnp.arange(n, dtype=jnp.int32)
-    if jax.default_backend() == "cpu":
+    if (jax.default_backend() == "cpu"
+            and not isinstance(lanes[0], jax.core.Tracer)):
         # Backend-natural branch (same pattern as join/groupby CPU
         # compaction): numpy's stable lexsort is 2-3x XLA:CPU's comparator
         # sort network at 1M rows (measured; BASELINE.md round 4) with
