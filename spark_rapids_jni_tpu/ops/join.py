@@ -182,8 +182,11 @@ def _candidate_counts(left_keys, right_keys, nulls_equal,
                           + np.uint64(1 << 62)))
 
     if _backend() == "cpu" and not isinstance(hr, jax.core.Tracer):
-        # backend-natural: numpy argsort is ~3x XLA:CPU's sort network at
-        # 1M rows (see sort_order); the hash array is host-cheap on CPU
+        # Backend-natural: numpy argsort is ~6x XLA:CPU's sort network at
+        # 1M rows. The searchsorted chain stays on-device even here —
+        # numpy's scalar binary searches over random needles measured 2.3x
+        # SLOWER than XLA's vectorized search (join profile, BASELINE.md
+        # round 4) — so only the sort crosses to host.
         order = jnp.asarray(np.argsort(np.asarray(hr), kind="stable"))
     else:
         order = jnp.argsort(hr)
