@@ -373,3 +373,35 @@ def test_groupby_decimal128_mean_wrapped_sum_is_null():
     assert by_key[1] is None
     assert by_key[2] == decimal.Decimal(5).scaleb(0).quantize(
         decimal.Decimal(1).scaleb(-4))
+
+
+def test_sort_order_device_branch_matches_numpy_branch(monkeypatch):
+    """The cpu backend takes the numpy lexsort branch (round 4); the device
+    jnp.lexsort branch then only runs on real accelerators. Both are stable
+    sorts over identical monotone lanes, so their permutations must be
+    IDENTICAL — pinned here by running both branches on the same mixed-key
+    table (ints+nulls, strings, float64 bits, desc/nulls-last)."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.ops import sort as S
+
+    rng = np.random.default_rng(17)
+    n = 4000
+    ints = Column.from_pylist(
+        [None if rng.random() < 0.1 else int(rng.integers(-50, 50))
+         for _ in range(n)], dt.INT64)
+    strs = Column.from_pylist(
+        ["".join(chr(97 + int(c)) for c in rng.integers(0, 4, rng.integers(0, 6)))
+         for _ in range(n)], dt.STRING)
+    floats = Column.from_numpy(
+        (rng.standard_normal(n) * 10).round(1), dt.FLOAT64)
+    for keys, asc, nf in [
+        ([ints], [True], [True]),
+        ([strs, ints], [True, False], [True, False]),
+        ([floats, strs], [False, True], [False, True]),
+    ]:
+        want = np.asarray(S.sort_order(keys, asc, nf))  # numpy branch (cpu)
+        monkeypatch.setattr(S.jax, "default_backend", lambda: "tpu")
+        got = np.asarray(S.sort_order(keys, asc, nf))   # device lexsort
+        monkeypatch.undo()
+        assert np.array_equal(got, want)
