@@ -374,8 +374,9 @@ def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real
     counters (memory/hbm.py; round-2 verdict: reservations were
     'honor-system estimates never validated against real HBM watermarks').
-    On backends without memory_stats (CPU) the audit reports 0 validated —
-    the check then only asserts the bracket plumbing ran."""
+    Where memory_stats is unreachable (CPU, and the axon tunnel — measured
+    round 4) every bracket falls back to jax.live_arrays() byte accounting,
+    so each bracket validates through one source or the other."""
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column, Table
     from spark_rapids_jni_tpu.memory import hbm
@@ -416,8 +417,10 @@ def _hbm_watermarks(np, jnp):
         assert rep["validated"] > 0, rep
     else:
         rep["device_counters"] = (
-            "unavailable (memory_stats() -> %s)"
+            "unavailable (memory_stats() -> %s); live-array fallback"
             % ("None" if stats is None else "no bytes_in_use"))
+        assert rep["validated_live"] > 0, rep
+    assert rep["validated"] + rep["validated_live"] == rep["brackets"], rep
     print(f"smoke: hbm audit: {rep}", file=sys.stderr)
 
 

@@ -14,6 +14,14 @@ with chip data: `report()` returns per-session totals plus the worst
 under-estimates (observed > reserved — the dangerous direction for a
 scheduler admitting work against the ledger). ci/tpu_smoke.py carries a
 check that runs governed ops and emits this report from the real device.
+
+Two occupancy sources, best available wins (round 4): the axon tunnel does
+not surface `memory_stats()`, so where the allocator counters are missing
+the audit falls back to the runtime's own live-buffer accounting —
+`jax.live_arrays()` byte totals. The fallback sees *retained* growth only
+(no transient-peak counter), but it exists on every backend, which turns
+the audit from "validated only when a real PJRT counter is reachable" into
+"validated on every bracket everywhere", including the CPU test suite.
 """
 
 from __future__ import annotations
@@ -26,7 +34,8 @@ import jax
 _lock = threading.Lock()
 _stats = {
     "brackets": 0,        # taken reservation brackets seen
-    "validated": 0,       # brackets with device counters available
+    "validated": 0,       # brackets validated via allocator counters
+    "validated_live": 0,  # brackets validated via live-array accounting
     "underestimates": 0,  # observed growth exceeded the reservation
     "worst": [],          # top (observed, reserved, ratio) offenders
 }
@@ -47,14 +56,26 @@ def device_memory_stats(device=None) -> Optional[dict]:
     return s if s else None
 
 
+def live_array_bytes() -> int:
+    """Bytes retained by live jax arrays on the default backend — the
+    runtime's own buffer accounting, available on every backend (the
+    fallback source where PJRT memory_stats is unreachable)."""
+    try:
+        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
 def bracket_begin() -> Optional[tuple]:
-    """Sample occupancy at reservation entry; None = cannot validate."""
+    """Sample occupancy at reservation entry; the tuple is tagged with its
+    source ("stats" = allocator counters, "live" = live-array bytes)."""
     with _lock:
         _stats["brackets"] += 1
     s = device_memory_stats()
-    if s is None or "bytes_in_use" not in s:
-        return None
-    return (int(s["bytes_in_use"]), int(s.get("peak_bytes_in_use", 0)))
+    if s is not None and "bytes_in_use" in s:
+        return ("stats", int(s["bytes_in_use"]),
+                int(s.get("peak_bytes_in_use", 0)))
+    return ("live", live_array_bytes(), 0)
 
 
 def bracket_end(mark: tuple, reserved: int) -> None:
@@ -73,15 +94,20 @@ def bracket_end(mark: tuple, reserved: int) -> None:
         jax.block_until_ready(jax.numpy.zeros(()))
     except Exception:
         pass
-    s = device_memory_stats()
-    if s is None or "bytes_in_use" not in s:
-        return
-    in_use0, peak0 = mark
-    retained = int(s["bytes_in_use"]) - in_use0
-    transient = int(s.get("peak_bytes_in_use", 0)) - peak0
-    observed = max(retained, transient, 0)
+    source, in_use0, peak0 = mark
+    if source == "stats":
+        s = device_memory_stats()
+        if s is None or "bytes_in_use" not in s:
+            return
+        retained = int(s["bytes_in_use"]) - in_use0
+        transient = int(s.get("peak_bytes_in_use", 0)) - peak0
+        observed = max(retained, transient, 0)
+    else:
+        # live-array accounting: retained growth only (transient peaks
+        # inside the bracket are invisible without an allocator counter)
+        observed = max(live_array_bytes() - in_use0, 0)
     with _lock:
-        _stats["validated"] += 1
+        _stats["validated" if source == "stats" else "validated_live"] += 1
         if observed > reserved:
             _stats["underestimates"] += 1
         if observed == 0 and reserved == 0:
@@ -101,4 +127,5 @@ def report() -> dict:
 
 def reset() -> None:
     with _lock:
-        _stats.update(brackets=0, validated=0, underestimates=0, worst=[])
+        _stats.update(brackets=0, validated=0, validated_live=0,
+                      underestimates=0, worst=[])

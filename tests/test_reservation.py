@@ -284,9 +284,10 @@ def test_mark_blocked_covers_frame_heuristic_false_negative(adaptor):
 
 
 def test_hbm_audit_brackets_counted(adaptor):
-    """rmm.validate_hbm wires the bracket audit (memory/hbm.py); on CPU the
-    PJRT counters are unavailable so validated stays 0, but brackets must
-    be counted and the bracket must still release cleanly."""
+    """rmm.validate_hbm wires the bracket audit (memory/hbm.py). On CPU the
+    PJRT allocator counters are unavailable, so every bracket must fall back
+    to the live-array accounting source (round 4) — brackets counted,
+    validated via "live", and the bracket still releases cleanly."""
     from spark_rapids_jni_tpu.memory import hbm
     from spark_rapids_jni_tpu.utils import config
 
@@ -301,4 +302,6 @@ def test_hbm_audit_brackets_counted(adaptor):
             RmmSpark.task_done(77)
     rep = hbm.report()
     assert rep["brackets"] > 0
+    assert rep["validated"] + rep["validated_live"] == rep["brackets"]
+    assert rep["validated_live"] > 0  # CPU: live-array fallback source
     assert RmmSpark.pool_used() == 0
