@@ -299,6 +299,7 @@ def _sweep(deadline):
         ("tpch_q6_1m", lambda: B.bench_tpch_q6(1 << 20), 1 << 20),
         ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
         ("shuffle_skewed_1m", lambda: B.bench_shuffle_skewed(1 << 20), 1 << 20),
+        ("parquet_decode_1m", lambda: B.bench_parquet_decode(1 << 20), 1 << 20),
         ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
         ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
         # scale axes: the 1M pipeline axes are dispatch-bound on the axon
