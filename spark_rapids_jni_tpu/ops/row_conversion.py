@@ -431,7 +431,12 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         out = []
         for b0, b1 in zip(bounds[:-1], bounds[1:]):
             blob = _words_to_u8(words[b0:b1]).reshape(-1)
-            offsets = np.arange(b1 - b0 + 1, dtype=np.int64) * row_size
+            # offsets are affine — build them ON DEVICE: a host np.arange
+            # here cost an 8 MB/1M-row host→device transfer per call
+            # (~100 ms at the tunnel's ~81 MB/s — the entire fixed-path
+            # on-chip budget; docs/TPU_PERF.md transfer table)
+            offsets = (jnp.arange(b1 - b0 + 1, dtype=jnp.int32)
+                       * np.int32(row_size))
             out.append(_rows_column(blob, offsets))
         return out
 
