@@ -444,9 +444,11 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     if n == 0:
         return [_rows_column(jnp.zeros((0,), jnp.uint8),
                              np.zeros(1, dtype=np.int64))]
-    lengths = jnp.stack(
-        [(c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
-         for c in string_cols], axis=1)                     # [n, nsc]
+    # densify once, reuse everywhere: padded_bytes memoizes (matrix,
+    # device lengths) on the column, so repeat conversions (and any prior
+    # sort/groupby on the same key) pay no fresh host-offset upload
+    padded = [padded_bytes(c) for c in string_cols]
+    lengths = jnp.stack([lens for _, lens in padded], axis=1)  # [n, nsc]
     # row-relative variable offsets: exclusive scan over string columns
     var_offsets = (info.size_per_row
                    + jnp.cumsum(lengths, axis=1) - lengths)  # [n, nsc]
@@ -463,7 +465,6 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     fixed_words = _build_fixed_words(
         table, info, _round_up(spr, 4), var_offsets, lengths)
     fixed = None  # byte view, materialized only if the fallback needs it
-    padded = [padded_bytes(c) for c in string_cols]
 
     # sizing syncs just (total, max_row) — one small transfer. The full
     # row-size array only crosses to host when the table actually spans
