@@ -426,8 +426,13 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     if not string_cols:
         row_size = _round_up(info.size_per_row, JCUDF_ROW_ALIGNMENT)
         words = _build_fixed_words(table, info, row_size, None, None)
-        bounds = _batch_boundaries(
-            np.full(n, row_size, dtype=np.int64), max_batch_bytes)
+        # uniform rows: batch boundaries are analytic — skip the O(n) host
+        # cumsum (8 MB of host traffic per 1M-row call on the hot path)
+        if n == 0 or row_size == 0:
+            bounds = [0, n]
+        else:
+            per_batch = max(max_batch_bytes // row_size, 1)
+            bounds = list(range(0, n, per_batch)) + [n]
         out = []
         for b0, b1 in zip(bounds[:-1], bounds[1:]):
             blob = _words_to_u8(words[b0:b1]).reshape(-1)
