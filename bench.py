@@ -318,6 +318,7 @@ def _sweep(deadline):
         ("bloom_filter_1m", lambda: B.bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: B.bench_cast_string_to_float(500_000), 500_000),
         ("parse_uri_200k", lambda: B.bench_parse_uri(200_000), 200_000),
+        ("get_json_object_200k", lambda: B.bench_get_json_object(200_000), 200_000),
     ]
     results = _STATE["axes"]  # shared: the stall watchdog emits this dict
     for name, fn, rows in axes:
