@@ -5,8 +5,8 @@ axon tunnel was wedged whenever a human-scale "try bench now" decision was
 made. This daemon removes the human from the loop: it probes the tunnel in
 a disposable subprocess every POLL_S seconds, logs every attempt, and on
 the FIRST healthy accelerator probe immediately runs the full capture
-stack — `python bench.py` (14-axis sweep, median-of-repeats),
-`python ci/tpu_smoke.py` (12 oracle checks incl. the compiled-Pallas
+stack — `python bench.py` (19-axis sweep, median-of-repeats),
+`python ci/tpu_smoke.py` (15 oracle checks incl. the compiled-Pallas
 bit-compare + HBM watermark audit) — then commits the artifacts
 (BENCH_tpu.json, SMOKE_tpu.json) to git at once, not at round end when the
 tunnel may be dead again.
