@@ -93,9 +93,18 @@ def main():
     q1 = run_q1(li, mesh=mesh)
     q1_rows = list(zip(*[c.to_pylist() for c in q1.columns]))
 
+    # distributed sample-sort across the two processes: the range exchange
+    # crosses the process boundary, and the contiguous-per-host mesh means
+    # each process's concatenated partitions are a contiguous slice of the
+    # global order — rank 0 holds the low ranges, rank 1 the high ones
+    from spark_rapids_jni_tpu.parallel.distributed import distributed_sort
+    srt = distributed_sort(Table((keys, payload)), [0], mesh)
+    sorted_keys = srt.columns[0].to_pylist()
+
     print(json.dumps({"rank": rank, "parts": result,
                       "psum_total_rows": total,
-                      "q1_rows": q1_rows}), flush=True)
+                      "q1_rows": q1_rows,
+                      "sorted_keys": sorted_keys}), flush=True)
 
 
 if __name__ == "__main__":

@@ -102,3 +102,16 @@ def test_two_process_exchange_matches_local():
                   zip(*[c.to_pylist() for c in local.columns]))
     got_rows = sorted(tuple(r) for o in outs for r in o["q1_rows"])
     assert got_rows == want
+
+    # distributed sample-sort: each process holds a contiguous slice of the
+    # global order (contiguous-per-host mesh → rank 0 = low ranges, rank 1
+    # = high), each slice is itself sorted, and their concatenation is
+    # exactly the sorted input
+    by_rank = {o["rank"]: o["sorted_keys"] for o in outs}
+    for r, ks in by_rank.items():
+        assert ks == sorted(ks), f"rank {r} slice not locally sorted"
+    if by_rank[0] and by_rank[1]:
+        assert by_rank[0][-1] <= by_rank[1][0], "range slices overlap"
+    merged_keys = by_rank[0] + by_rank[1]
+    assert merged_keys == sorted(
+        (np.arange(n, dtype=np.int64) % 997).tolist())
