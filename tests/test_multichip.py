@@ -554,3 +554,52 @@ def test_ragged_and_a2a_paths_agree(mesh):
                          map(tuple, parts[p].columns[1].to_pylist())))
         want = sorted((int(i), tuple(lists[i])) for i in idx)
         assert got == want, f"partition {p}"
+
+
+def test_distributed_percentile_groupby_composition(mesh):
+    """Spark's `percentile(v, p) GROUP BY k` distributed shape, composed
+    from this library's primitives exactly the way the plugin composes the
+    reference's Histogram surface (Histogram.java + exchange): partition by
+    key across the mesh, sort each partition by key, slice per-group
+    (value, freq=1) histograms via the group offsets, evaluate every
+    group's percentiles in ONE vectorized percentile_from_histogram call,
+    and compare the union across partitions against a numpy oracle."""
+    from spark_rapids_jni_tpu.ops.histogram import percentile_from_histogram
+
+    rng = np.random.default_rng(23)
+    n = 3000
+    keys_np = rng.integers(0, 37, n)
+    vals_np = (rng.standard_normal(n) * 50).round(2)
+    t = Table((Column.from_numpy(keys_np, dt.INT64),
+               Column.from_numpy(vals_np, dt.FLOAT64)))
+    pcts = [0.25, 0.5, 0.9]
+
+    got = {}
+    for part in hash_partition_exchange(t, [0], mesh):
+        if not part.num_rows:
+            continue
+        st = sort_table(part, [0])
+        k = np.asarray(st.columns[0].data)
+        # group offsets within this partition (each key lives on exactly
+        # one partition, so groups never straddle partitions)
+        bounds = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
+        offsets = np.r_[bounds, k.size].astype(np.int32)
+        hist = Column.list_of(
+            Column.struct_of([
+                st.columns[1],
+                Column.from_numpy(np.ones(k.size, dtype=np.int64),
+                                  dt.INT64),
+            ]),
+            jnp.asarray(offsets))
+        out = percentile_from_histogram(hist, pcts, output_as_list=True)
+        res = out.children[0].host_values().reshape(len(bounds), len(pcts))
+        for g, key in enumerate(k[bounds]):
+            assert int(key) not in got, "key straddled partitions"
+            got[int(key)] = res[g]
+
+    for key in np.unique(keys_np):
+        vs = np.sort(vals_np[keys_np == key])
+        pos = np.asarray(pcts) * (vs.size - 1)
+        lo, hi = np.floor(pos).astype(int), np.ceil(pos).astype(int)
+        want = vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+        assert np.allclose(got[int(key)], want, rtol=1e-12, atol=1e-9), key
