@@ -80,3 +80,32 @@ def test_bench_py_stall_watchdog_emits_partial():
     assert rec["value"] > 0  # the headline still made it out
     assert "partial" in rec.get("note", "")
     assert "wedged" in rec["axes"]["tpch_q6_1m"]["error"]
+
+
+def test_every_sweep_axis_function_runs_small():
+    """The sweep records per-axis errors without failing the run, so a
+    broken axis silently forfeits its evidence on the driver's one-shot
+    capture. Exercise every axis implementation at tiny sizes here."""
+    from benchmarks import bench_ops as B
+
+    B._refresh_variants()
+    small = [
+        (lambda: B.bench_row_conversion(2048, False), "rowconv_fixed"),
+        (lambda: B.bench_row_conversion(2048, True), "rowconv_strings"),
+        (lambda: B.bench_groupby(2048), "groupby"),
+        (lambda: B.bench_join(2048), "join"),
+        (lambda: B.bench_sort(2048), "sort"),
+        (lambda: B.bench_bloom_filter(2048), "bloom"),
+        (lambda: B.bench_cast_string_to_float(1024), "cast_float"),
+        (lambda: B.bench_parse_uri(512), "parse_uri"),
+        (lambda: B.bench_get_json_object(512), "get_json_object"),
+        (lambda: B.bench_parquet_decode(2048), "parquet_decode"),
+        (lambda: B.bench_shuffle_skewed(2048), "shuffle_skewed"),
+        (lambda: B.bench_tpch_q1(2048), "q1"),
+        (lambda: B.bench_tpch_q3(2048), "q3"),
+        (lambda: B.bench_tpch_q5(2048), "q5"),
+        (lambda: B.bench_tpch_q6(2048), "q6"),
+    ]
+    for fn, name in small:
+        sec, nbytes = fn()
+        assert sec > 0 and nbytes > 0, name
