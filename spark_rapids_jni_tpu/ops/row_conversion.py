@@ -607,8 +607,25 @@ def _convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
     words = blob_words[jnp.clip(wpos, 0, max(total_words - 1, 0))]
     valid = _extract_validity_words(words, info, len(dtypes))
 
-    # null-mask materialization: single host sync over all columns
-    any_null = np.asarray(~jnp.all(valid, axis=0))
+    # ONE host sync for the whole table: per-column any-null flags and
+    # every string column's total byte count cross together (each sync is
+    # 16-64 ms through the axon tunnel — docs/TPU_PERF.md — so per-column
+    # scalar readbacks multiply with schema width)
+    str_offsets = {}
+    str_totals = []
+    for c, d in enumerate(dtypes):
+        if d.id is TypeId.STRING:
+            o = info.column_starts[c]
+            length = words[:, o // 4 + 1].astype(jnp.int32)
+            out_offsets = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(length)])
+            str_offsets[c] = out_offsets
+            str_totals.append(out_offsets[-1].astype(jnp.int64))
+    head = np.asarray(jnp.concatenate(
+        [(~jnp.all(valid, axis=0)).astype(jnp.int64)]
+        + ([jnp.stack(str_totals)] if str_totals else [])))
+    any_null = head[:len(dtypes)].astype(bool)
+    totals = iter(head[len(dtypes):])
 
     cols: List[Column] = []
     for c, d in enumerate(dtypes):
@@ -616,10 +633,8 @@ def _convert_from_rows(rows: Column, dtypes: Sequence[DType]) -> Table:
         o = info.column_starts[c]
         if d.id is TypeId.STRING:
             off_in_row = words[:, o // 4].astype(jnp.int32)
-            length = words[:, o // 4 + 1].astype(jnp.int32)
-            out_offsets = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(length)])
-            total = int(out_offsets[-1])
+            out_offsets = str_offsets[c]
+            total = int(next(totals))
             data = (_extract_string_bytes(
                 blob, row_offsets, off_in_row, out_offsets,
                 padded_total=_blob_bucket(total))[:total]
