@@ -19,7 +19,59 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def latency_suite():
+    """Re-measure the docs/TPU_PERF.md platform-latency table on the live
+    backend, including the round-4 sync-batching validation: a stacked
+    K-scalar head transfer must cost ~one sync, not K (the premise behind
+    groupby's head, convert_from_rows' table head, and the exchange
+    rebuild). Run: python ci/tpu_profile.py --latency"""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 100, 1 << 20))
+    jnp.sum(x).block_until_ready()  # warm compiles
+
+    def med(f, n=7):
+        f()
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return round(statistics.median(ts) * 1e3, 2)
+
+    out = {}
+    out["dispatch_block_ms"] = med(
+        lambda: (x + 1).block_until_ready())
+    out["scalar_sync_ms"] = med(lambda: int(jnp.sum(x)))
+    out["scalar_sync_x8_ms"] = med(
+        lambda: [int(jnp.sum(x[i::8])) for i in range(8)])
+    out["stacked_head8_sync_ms"] = med(
+        lambda: np.asarray(jnp.stack([jnp.sum(x[i::8])
+                                      for i in range(8)])))
+    out["small_transfer_ms"] = med(lambda: np.asarray(x[:1024]))
+    big = jnp.zeros((1 << 22,), jnp.int64)  # 32 MB
+    out["d2h_32mb_ms"] = med(lambda: np.asarray(big), n=3)
+    host = np.zeros((1 << 22,), np.int64)
+    out["h2d_32mb_ms"] = med(
+        lambda: jnp.asarray(host).block_until_ready(), n=3)
+    return out
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--latency":
+        import bench
+        bench._ensure_backend()
+        import jax
+        rec = latency_suite()
+        rec["backend"] = jax.devices()[0].platform
+        import json
+        print(json.dumps(rec))
+        return 0
+
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/srjt_trace"
     rows = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 20
 
