@@ -175,6 +175,15 @@ def main():
         return 0
     n = 0
     while True:
+        # a probe's jax import burns the whole core for seconds — never
+        # contend with a solo bench run (the driver's round-end capture,
+        # or this poller's own): measured 5x headline distortion
+        busy = subprocess.run(["pgrep", "-f", "python bench.py"],
+                              capture_output=True, text=True)
+        if busy.returncode == 0:
+            log("bench.py is running — skipping probe cycle")
+            time.sleep(POLL_S)
+            continue
         n += 1
         plat = probe()
         log(f"probe #{n}: {plat or 'WEDGED (timeout/fail)'}")
