@@ -213,3 +213,37 @@ def test_two_string_columns_rowmat_path():
     back = rc.convert_from_rows(rows, [c.dtype for c in t.columns])
     assert back.columns[0].to_pylist() == a
     assert back.columns[2].to_pylist() == b
+
+
+def test_convert_from_rows_single_host_sync(monkeypatch):
+    """Round-4 contract: the shuffle-read path host-syncs ONCE per table
+    (stacked any-null flags + all string totals), not once per string
+    column — each scalar readback costs 16-64 ms through the axon tunnel
+    (docs/TPU_PERF.md). Pins the count by intercepting the module's
+    device→host conversions."""
+    cols = [
+        Column.from_pylist([1, None, 3, 4], dt.INT64),
+        Column.from_pylist(["a", "bb", None, "dddd"], dt.STRING),
+        Column.from_pylist(["x", "", "yy", "z"], dt.STRING),
+        Column.from_pylist(["", "q", "rr", None], dt.STRING),
+    ]
+    t = Table(tuple(cols))
+    batches = rc.convert_to_rows(t)
+    assert len(batches) == 1
+
+    calls = []
+    real = rc.np.asarray
+
+    def counting(a, *args, **kw):
+        if hasattr(a, "block_until_ready"):  # device→host only
+            calls.append(a)
+        return real(a, *args, **kw)
+
+    monkeypatch.setattr(rc.np, "asarray", counting)
+    try:
+        back = rc.convert_from_rows(batches[0], [c.dtype for c in cols])
+    finally:
+        monkeypatch.undo()
+    assert len(calls) == 1, f"expected 1 host sync, saw {len(calls)}"
+    for orig, got in zip(cols, back.columns):
+        assert got.to_pylist() == orig.to_pylist()
