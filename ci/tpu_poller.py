@@ -66,7 +66,9 @@ def _wait_for_quiet_cpu(max_wait_s=3600):
     on this 1-core container)."""
     t0 = time.time()
     while time.time() - t0 < max_wait_s:
-        p = subprocess.run(["pgrep", "-f", "python -m pytest"],
+        # match the script, not the interpreter: python3/venv launchers and
+        # the pytest entry-point script escape "python -m pytest" (ADVICE r4)
+        p = subprocess.run(["pgrep", "-f", "pytest"],
                            capture_output=True, text=True)
         if p.returncode != 0:
             return
@@ -174,16 +176,24 @@ def main():
         log("capture already done (marker exists); exiting")
         return 0
     n = 0
+    busy_skips = 0
     while True:
         # a probe's jax import burns the whole core for seconds — never
         # contend with a solo bench run (the driver's round-end capture,
-        # or this poller's own): measured 5x headline distortion
-        busy = subprocess.run(["pgrep", "-f", "python bench.py"],
+        # or this poller's own): measured 5x headline distortion. The
+        # substring match can false-positive on e.g. an editor with
+        # bench.py open, so the hold is capped (~1h of cycles) like the
+        # pytest wait — losing every window to a stale match is worse
+        # than one contended probe.
+        busy = subprocess.run(["pgrep", "-f", r"bench\.py"],
                               capture_output=True, text=True)
-        if busy.returncode == 0:
-            log("bench.py is running — skipping probe cycle")
+        if busy.returncode == 0 and busy_skips < max(1, 3600 // POLL_S):
+            busy_skips += 1
+            log("bench.py is running — skipping probe cycle "
+                f"({busy_skips})")
             time.sleep(POLL_S)
             continue
+        busy_skips = 0
         n += 1
         plat = probe()
         log(f"probe #{n}: {plat or 'WEDGED (timeout/fail)'}")

@@ -28,6 +28,10 @@ def latency_suite():
     import statistics
 
     import jax
+    # The --latency path never imports the package (which enables x64);
+    # without this the 32 MB buffers silently truncate to int32 and the
+    # transfer table is 2x off (ADVICE r4, medium).
+    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
     import numpy as np
 

@@ -56,14 +56,29 @@ def device_memory_stats(device=None) -> Optional[dict]:
     return s if s else None
 
 
+def _live_bytes_or_none() -> Optional[int]:
+    """live_array_bytes with failure distinguishable from empty: a bracket
+    baseline of "unknown" must not read as 0, or a later successful sample
+    attributes the whole live footprint to one bracket."""
+    try:
+        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:
+        return None
+
+
 def live_array_bytes() -> int:
     """Bytes retained by live jax arrays on the default backend — the
     runtime's own buffer accounting, available on every backend (the
     fallback source where PJRT memory_stats is unreachable)."""
-    try:
-        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
-    except Exception:
-        return 0
+    return _live_bytes_or_none() or 0
+
+
+# Set True the first time allocator counters vanish between bracket_begin
+# and bracket_end (tunnel degradation — a persistent state, not a
+# per-bracket event). Until then, healthy stats-path brackets skip the
+# live-array enumeration; after, every begin pre-arms the live baseline so
+# the fallback has something to diff against.
+_stats_dropout_seen = False
 
 
 def bracket_begin() -> Optional[tuple]:
@@ -73,9 +88,14 @@ def bracket_begin() -> Optional[tuple]:
         _stats["brackets"] += 1
     s = device_memory_stats()
     if s is not None and "bytes_in_use" in s:
+        # Carry a live baseline too once degradation has ever been seen:
+        # if the counters become unreachable before bracket_end (observed
+        # mid-run on the axon tunnel), the bracket degrades to live-array
+        # accounting instead of vanishing from both tallies (ADVICE r4).
+        live0 = _live_bytes_or_none() if _stats_dropout_seen else None
         return ("stats", int(s["bytes_in_use"]),
-                int(s.get("peak_bytes_in_use", 0)))
-    return ("live", live_array_bytes(), 0)
+                int(s.get("peak_bytes_in_use", 0)), live0)
+    return ("live", _live_bytes_or_none(), 0, None)
 
 
 def bracket_end(mark: tuple, reserved: int) -> None:
@@ -94,18 +114,34 @@ def bracket_end(mark: tuple, reserved: int) -> None:
         jax.block_until_ready(jax.numpy.zeros(()))
     except Exception:
         pass
-    source, in_use0, peak0 = mark
+    global _stats_dropout_seen
+    source, in_use0, peak0, live0 = mark
     if source == "stats":
         s = device_memory_stats()
         if s is None or "bytes_in_use" not in s:
-            return
-        retained = int(s["bytes_in_use"]) - in_use0
-        transient = int(s.get("peak_bytes_in_use", 0)) - peak0
-        observed = max(retained, transient, 0)
+            # Counters went away mid-bracket (tunnel degradation): fall
+            # back to the live-array baseline sampled at begin so the
+            # bracket still lands in exactly one tally. The first dropout
+            # bracket has no baseline armed (live0 None) — count it as
+            # validated_live with zero observed growth rather than diffing
+            # against an unknown.
+            _stats_dropout_seen = True
+            source = "live"
+            end = _live_bytes_or_none()
+            observed = (max(end - live0, 0)
+                        if live0 is not None and end is not None else 0)
+        else:
+            retained = int(s["bytes_in_use"]) - in_use0
+            transient = int(s.get("peak_bytes_in_use", 0)) - peak0
+            observed = max(retained, transient, 0)
     else:
         # live-array accounting: retained growth only (transient peaks
-        # inside the bracket are invisible without an allocator counter)
-        observed = max(live_array_bytes() - in_use0, 0)
+        # inside the bracket are invisible without an allocator counter);
+        # an unreadable sample on either side yields no signal, not a
+        # whole-footprint delta
+        end = _live_bytes_or_none()
+        observed = (max(end - in_use0, 0)
+                    if in_use0 is not None and end is not None else 0)
     with _lock:
         _stats["validated" if source == "stats" else "validated_live"] += 1
         if observed > reserved:
@@ -126,6 +162,8 @@ def report() -> dict:
 
 
 def reset() -> None:
+    global _stats_dropout_seen
     with _lock:
         _stats.update(brackets=0, validated=0, validated_live=0,
                       underestimates=0, worst=[])
+        _stats_dropout_seen = False
