@@ -24,6 +24,12 @@ from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 from spark_rapids_jni_tpu.ops.join import inner_join
 from spark_rapids_jni_tpu.ops.sort import sort_table
 
+
+def _backend() -> str:
+    """Seam for tests to force the accelerator (mask-pushdown) planning."""
+    import jax
+    return jax.default_backend()
+
 CUTOFF_DAYS = 1200  # "1995-03-15" as days into the generated date range
 
 
@@ -46,8 +52,7 @@ def _plan_ops(mesh):
         return list(t.columns), np.flatnonzero(np.asarray(mask))
 
     if mesh is None:
-        import jax
-        if jax.default_backend() != "cpu":
+        if _backend() != "cpu":
             # accelerator: push masks down — compaction costs host syncs
             # and fresh compiles there (docs/TPU_PERF.md sync economy)
             def join(lkeys, rkeys, left_mask=None, right_mask=None):

@@ -26,6 +26,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
+from .float_bits import f64_bits_from_value
 from .hashing import spark_key_values
 from .sort import gather, sort_order
 from ..utils.shapes import bucket_size
@@ -320,9 +321,9 @@ def _groupby_aggregate(
                                     indices_are_sorted=True)
             if op == "mean":
                 m = s / jnp.maximum(cnt, 1).astype(s.dtype)
-                out_cols.append(Column.from_numpy(
-                    np.asarray(m, dtype=np.float64), dt.FLOAT64,
-                    validity=np.asarray(any_valid)))
+                out_cols.append(Column(
+                    dt.FLOAT64, num_segments,
+                    data=f64_bits_from_value(m), validity=any_valid))
                 continue
             res = s
         elif op == "min":
@@ -340,9 +341,11 @@ def _groupby_aggregate(
         else:
             raise ValueError(f"unknown aggregation {op}")
         if out_dtype.id is dt.TypeId.FLOAT64:
-            out_cols.append(Column.from_numpy(
-                np.asarray(res, dtype=np.float64), dt.FLOAT64,
-                validity=np.asarray(any_valid)))
+            # device-native bit encode: the old from_numpy(np.asarray(...))
+            # route cost two D2H transfers per float output column
+            out_cols.append(Column(
+                dt.FLOAT64, num_segments,
+                data=f64_bits_from_value(res), validity=any_valid))
         else:
             out_cols.append(Column(out_dtype, num_segments,
                                    data=res.astype(out_dtype.jnp_dtype),

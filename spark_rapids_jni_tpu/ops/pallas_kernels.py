@@ -367,13 +367,13 @@ def pallas_gate(config_key: str) -> Optional[bool]:
         raise ValueError(f"{config_key} must be auto|on|off, got {mode!r}")
     if mode == "off" or (mode == "auto" and _state(config_key)["disabled"]):
         return None
-    backend = jax.default_backend()
-    if mode == "auto" and backend not in ("tpu", "axon"):
+    from ..utils.backend import is_accelerator
+    if mode == "auto" and not is_accelerator():
         # interpreted pallas (cpu) is slower than the fused XLA chain, and
         # these (16,128) uint32 tilings are TPU-specific — don't auto-route
         # other accelerators onto them
         return None
-    return backend == "cpu"
+    return jax.default_backend() == "cpu"
 
 
 def hash_pallas_route(units, n: int, for_xx: bool) -> Optional[List]:

@@ -81,6 +81,11 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
     return [data.astype(jnp.uint64)]
 
 
+def _backend() -> str:
+    """Seam for tests to force the accelerator (on-device lexsort) branch."""
+    return jax.default_backend()
+
+
 @func_range()
 def sort_order(keys: Sequence[Column],
                ascending: Optional[Sequence[bool]] = None,
@@ -111,7 +116,7 @@ def sort_order(keys: Sequence[Column],
             lanes.append(nl)
     if not lanes:
         return jnp.arange(n, dtype=jnp.int32)
-    if (jax.default_backend() == "cpu"
+    if (_backend() == "cpu"
             and not isinstance(lanes[0], jax.core.Tracer)):
         # Backend-natural branch (same pattern as join/groupby CPU
         # compaction): numpy's stable lexsort is 2-3x XLA:CPU's comparator

@@ -1,0 +1,30 @@
+"""Backend predicates shared by the tier dispatchers.
+
+Several ops keep two execution tiers (device XLA vs host/native) and pick
+by backend with an auto/on(off|device|native) config override. The
+accelerator predicate lives HERE only — adding a backend name (or
+renaming the tunnel platform) must not require hunting call sites.
+"""
+
+from __future__ import annotations
+
+_ACCELERATOR_PLATFORMS = ("tpu", "axon")
+
+
+def is_accelerator() -> bool:
+    import jax
+    return jax.default_backend() in _ACCELERATOR_PLATFORMS
+
+
+def tier_is_device(flag_key: str, device_value: str = "device",
+                   host_value: str = "native") -> bool:
+    """auto/on/off-style tier dispatch: ``device_value`` forces the
+    device tier, ``host_value`` (or "off") forces the host tier, anything
+    else ("auto"/"on") follows the backend."""
+    from . import config
+    v = config.get(flag_key)
+    if v == device_value or v == "on":
+        return True
+    if v == host_value or v == "off":
+        return False
+    return is_accelerator()

@@ -1,0 +1,181 @@
+"""Host-sync / recompile budget instrument (round-4 verdict next #2).
+
+The axon tunnel's cost model (docs/TPU_PERF.md:143-155) makes every
+data-dependent host sync a 16-64 ms serialization point and every fresh
+program compile ~0.9 s; the round-4 perf rework bought each op an explicit
+sync budget (join <= 2, groupby <= 1, row conversion <= 1 per table,
+exchange O(1) in device count). This module makes those budgets
+*assertable* so a regression can never silently re-add a sync: tests wrap
+an op call in :func:`measure` and pin the observed counts.
+
+What is counted
+---------------
+``d2h_syncs``
+    Device-value materializations — every read of
+    ``jax.Array._value`` (``int()``/``float()``/``bool()``/``.item()``/
+    ``.tolist()``/``jax.device_get``) plus ``np.asarray``/``np.array``
+    calls whose argument is a ``jax.Array``. The second seam exists
+    because XLA:CPU (the test backend) serves the buffer protocol
+    zero-copy, bypassing ``_value`` entirely, while on the tunnel the
+    same call is a full D2H round trip; counting both seams makes the CPU
+    test measure what the TPU would pay. Reentrancy is suppressed so a
+    TPU-path ``np.asarray`` -> ``__array__`` -> ``_value`` chain counts
+    once, same as CPU.
+
+``compiles`` / ``traces``
+    Backend compilations and jaxpr traces, observed via
+    ``jax.monitoring`` duration events. Steady-state op calls (same
+    shapes, warmed cache) must report zero of both — a nonzero count
+    means a data-dependent shape or python-varying constant leaked into a
+    program, exactly the 0.9 s-per-call failure mode bucketed shapes
+    (utils/shapes.py) exist to prevent.
+
+Shape reads (``arr.shape``, ``int(arr.shape[0])``) never materialize a
+value and are not counted. Host->device transfers are not counted: input
+upload is a one-time streaming cost, not a pipeline serialization point.
+
+The instrument is test-tier only — nothing here runs in production paths.
+The seams are installed once (first ``measure()``) and stay in place, but
+count only while a measurement is active; outside one they are
+pass-throughs.
+Reference analog: the dispatch discipline is the TPU translation of the
+reference keeping whole pipelines on-stream with no intermediate
+``cudaStreamSynchronize`` (src/main/cpp/src/row_conversion.cu's chunked
+kernels run back-to-back on one stream).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax._src import array as _jarray
+from jax._src import monitoring as _monitoring
+
+__all__ = ["Budget", "measure"]
+
+
+@dataclass
+class Budget:
+    d2h_syncs: int = 0
+    compiles: int = 0
+    traces: int = 0
+    # call-site labels of each sync, for failure messages ("which sync
+    # regressed" beats "3 > 2")
+    sync_sites: list = field(default_factory=list)
+
+    def _summary(self) -> str:
+        return (f"d2h_syncs={self.d2h_syncs} compiles={self.compiles} "
+                f"traces={self.traces} sites={self.sync_sites}")
+
+
+_lock = threading.Lock()
+_active: list = []          # stack of live Budget objects
+_tls = threading.local()    # .suppress: inside a counted np.asarray call
+_installed = False
+
+
+def _caller_site() -> str:
+    """Innermost package frame that triggered the sync (skip this module)."""
+    import traceback
+    for f in reversed(traceback.extract_stack(limit=16)):
+        fn = f.filename
+        if "utils/budget" in fn or "site-packages" in fn \
+                or "/jax/" in fn or "/numpy/" in fn:
+            continue
+        return f"{fn.rsplit('/', 1)[-1]}:{f.lineno}"
+    return "?"
+
+
+def _record_sync():
+    if getattr(_tls, "suppress", False):
+        return
+    site = None
+    with _lock:
+        if not _active:
+            return
+        site = _caller_site()
+        for b in _active:
+            b.d2h_syncs += 1
+            b.sync_sites.append(site)
+
+
+def _record_event(kind: str):
+    with _lock:
+        for b in _active:
+            setattr(b, kind, getattr(b, kind) + 1)
+
+
+def _install_once():
+    """Idempotent global hooks. The _value/asarray wrappers only do work
+    while a measurement is active; monitoring listeners cannot be
+    unregistered in this jax version, so they are installed once and
+    filter on the active stack themselves."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    # --- seam 1: ArrayImpl._value (int/float/bool/.item/.tolist/device_get)
+    prop = _jarray.ArrayImpl.__dict__["_value"]
+
+    def _counting_value(self):
+        _record_sync()
+        return prop.fget(self)
+
+    _jarray.ArrayImpl._value = property(_counting_value)
+
+    # --- seam 2: np.asarray / np.array on jax Arrays (the XLA:CPU
+    # buffer-protocol path that bypasses _value)
+    orig_asarray, orig_array = np.asarray, np.array
+
+    def _wrap(orig):
+        def wrapped(a, *args, **kwargs):
+            if _active and isinstance(a, jax.Array):
+                _record_sync()
+                _tls.suppress = True
+                try:
+                    return orig(a, *args, **kwargs)
+                finally:
+                    _tls.suppress = False
+            return orig(a, *args, **kwargs)
+        wrapped.__name__ = orig.__name__
+        return wrapped
+
+    np.asarray = _wrap(orig_asarray)
+    np.array = _wrap(orig_array)
+
+    # --- seam 3: compiles / traces via monitoring duration events
+    def _on_duration(name: str, secs: float, **kw):
+        if not _active:
+            return
+        if name.endswith("backend_compile_duration"):
+            _record_event("compiles")
+        elif name.endswith("jaxpr_trace_duration"):
+            _record_event("traces")
+
+    _monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+@contextmanager
+def measure():
+    """Count device syncs and compiles for the enclosed block::
+
+        with budget.measure() as b:
+            inner_join(left, right)
+        assert b.d2h_syncs <= 2, b._summary()
+
+    Nesting is allowed (both measurements observe the inner block).
+    """
+    _install_once()
+    b = Budget()
+    with _lock:
+        _active.append(b)
+    try:
+        yield b
+    finally:
+        with _lock:
+            _active.remove(b)

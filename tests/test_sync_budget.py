@@ -1,0 +1,219 @@
+"""Per-op dispatch/sync budget regression tests (round-4 verdict next #2).
+
+The axon tunnel charges 16-64 ms per data-dependent host sync and ~0.9 s
+per fresh program compile (docs/TPU_PERF.md:143-155); the round-4 perf
+rework bought each op an explicit budget. These tests pin those budgets
+with the utils/budget instrument so a regression can never silently
+re-add a sync:
+
+    join      <= 2 data-dependent syncs   (ops/join.py: candidate count,
+                                           verified-match count)
+    groupby   <= 1                        (ops/groupby.py: segment head)
+    sort      == 0 fixed-width            (lanes never leave the device)
+    rowconv   <= 1 per table each way     (ops/row_conversion.py)
+    exchange  <= 2, constant in rows/nd   (parallel/exchange.py: counts
+                                           matrix + batched sizing)
+    q1        end-to-end pipeline budget
+
+CPU-only branches (numpy lexsort, host compaction, mask materialization)
+legitimately materialize values, so every test forces the ACCELERATOR
+branch through each module's _backend() seam — the budgets here are the
+TPU-path contracts. Steady-state calls additionally assert zero
+compiles/retraces: a nonzero count means a data-dependent shape leaked
+into a program (the 0.9 s-per-call failure mode bucketed shapes exist to
+prevent).
+
+Reference analog: the reference keeps whole pipelines on-stream with no
+intermediate synchronize (src/main/cpp/src/row_conversion.cu chunked
+kernels); these budgets are the TPU translation of that discipline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.ops import join as join_mod
+from spark_rapids_jni_tpu.ops import row_conversion as rc
+from spark_rapids_jni_tpu.ops import sort as sort_mod
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.ops.sort import sort_order, sort_table
+from spark_rapids_jni_tpu.utils import budget
+
+
+@pytest.fixture
+def accel(monkeypatch):
+    """Force every backend seam onto the accelerator branch."""
+    monkeypatch.setattr(join_mod, "_backend", lambda: "tpu")
+    monkeypatch.setattr(sort_mod, "_backend", lambda: "tpu")
+
+
+def _ints(n, lo=0, hi=1000, seed=0, nulls=False):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(lo, hi, n, dtype=np.int64)
+    validity = rng.random(n) > 0.1 if nulls else None
+    return Column.from_numpy(v, dt.INT64, validity=validity)
+
+
+def _floats(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return Column.from_numpy(rng.standard_normal(n), dt.FLOAT64)
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def test_sort_fixed_width_zero_syncs(accel):
+    t = Table((_ints(4096, nulls=True), _floats(4096)))
+    sort_table(t, [0])  # warm
+    with budget.measure() as b:
+        out = sort_table(t, [0])
+        jax.block_until_ready([c.data for c in out.columns])
+    assert b.d2h_syncs == 0, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_sort_order_zero_syncs(accel):
+    keys = [_ints(4096, nulls=True)]
+    sort_order(keys)  # warm
+    with budget.measure() as b:
+        sort_order(keys).block_until_ready()
+    assert b.d2h_syncs == 0, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_sort_strings_one_sizing_sync(accel):
+    rng = np.random.default_rng(3)
+    s = Column.from_pylist(
+        ["".join(chr(97 + c) for c in rng.integers(0, 26, rng.integers(0, 12)))
+         for _ in range(1024)], dt.STRING)
+    t = Table((_ints(1024, seed=4), s))
+    sort_table(t, [0])  # warm
+    with budget.measure() as b:
+        sort_table(t, [0])
+    # one output-element-count sync for the string gather
+    assert b.d2h_syncs <= 1, b._summary()
+
+
+# ---------------------------------------------------------------------------
+# join / groupby
+# ---------------------------------------------------------------------------
+
+def test_join_at_most_two_syncs(accel):
+    lk = [_ints(8192, hi=500, seed=5)]
+    rk = [_ints(8192, hi=500, seed=6)]
+    inner_join(lk, rk)  # warm
+    with budget.measure() as b:
+        l_idx, r_idx = inner_join(lk, rk)
+        jax.block_until_ready((l_idx, r_idx))
+    assert b.d2h_syncs <= 2, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_groupby_one_sync(accel):
+    t = Table((_ints(8192, hi=100, seed=7), _floats(8192)))
+    groupby_aggregate(t, [0], [(1, "sum"), (1, "mean"), (1, "count")])  # warm
+    with budget.measure() as b:
+        out = groupby_aggregate(t, [0], [(1, "sum"), (1, "mean"),
+                                         (1, "count")])
+        jax.block_until_ready([c.data for c in out.columns])
+    assert b.d2h_syncs <= 1, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_groupby_masked_still_one_sync(accel):
+    t = Table((_ints(8192, hi=100, seed=8), _floats(8192)))
+    mask = np.arange(8192) % 3 != 0
+    groupby_aggregate(t, [0], [(1, "sum")], row_mask=mask)  # warm
+    with budget.measure() as b:
+        groupby_aggregate(t, [0], [(1, "sum")], row_mask=mask)
+    assert b.d2h_syncs <= 1, b._summary()
+
+
+# ---------------------------------------------------------------------------
+# row conversion
+# ---------------------------------------------------------------------------
+
+def test_rowconv_fixed_one_sync_each_way(accel):
+    t = Table((_ints(4096, nulls=True), _floats(4096),
+               Column.from_numpy(
+                   np.arange(4096, dtype=np.int32), dt.INT32)))
+    [rows] = rc.convert_to_rows(t)  # warm
+    rc.convert_from_rows(rows, [c.dtype for c in t.columns])  # warm
+    with budget.measure() as b:
+        [rows] = rc.convert_to_rows(t)
+    assert b.d2h_syncs <= 1, f"to_rows: {b._summary()}"
+    with budget.measure() as b2:
+        back = rc.convert_from_rows(rows, [c.dtype for c in t.columns])
+        jax.block_until_ready([c.data for c in back.columns])
+    assert b2.d2h_syncs <= 1, f"from_rows: {b2._summary()}"
+
+
+def test_rowconv_strings_bounded_syncs(accel):
+    rng = np.random.default_rng(9)
+    s = Column.from_pylist(
+        ["x" * int(k) for k in rng.integers(0, 20, 2048)], dt.STRING)
+    t = Table((_ints(2048, seed=10), s))
+    [rows] = rc.convert_to_rows(t)  # warm
+    rc.convert_from_rows(rows, [dt.INT64, dt.STRING])  # warm
+    with budget.measure() as b:
+        [rows] = rc.convert_to_rows(t)
+    assert b.d2h_syncs <= 2, f"to_rows(strings): {b._summary()}"
+    with budget.measure() as b2:
+        rc.convert_from_rows(rows, [dt.INT64, dt.STRING])
+    assert b2.d2h_syncs <= 2, f"from_rows(strings): {b2._summary()}"
+
+
+# ---------------------------------------------------------------------------
+# exchange: constant sync count in rows AND device count
+# ---------------------------------------------------------------------------
+
+def _exchange_syncs(nd, rows):
+    from jax.sharding import Mesh
+    from spark_rapids_jni_tpu.parallel.exchange import (
+        hash_partition_exchange,
+    )
+    mesh = Mesh(np.array(jax.devices()[:nd]), axis_names=("shuffle",))
+    t = Table((_ints(rows, hi=max(4, rows // 4), seed=11),
+               _ints(rows, seed=12)))
+    hash_partition_exchange(t, [0], mesh)  # warm
+    with budget.measure() as b:
+        hash_partition_exchange(t, [0], mesh)
+    return b
+
+
+def test_exchange_constant_syncs_in_rows():
+    b_small = _exchange_syncs(4, 256)
+    b_large = _exchange_syncs(4, 4096)
+    assert b_small.d2h_syncs <= 2, b_small._summary()
+    assert b_large.d2h_syncs == b_small.d2h_syncs, (
+        f"sync count scaled with rows: {b_small._summary()} -> "
+        f"{b_large._summary()}")
+
+
+def test_exchange_constant_syncs_in_devices():
+    counts = {nd: _exchange_syncs(nd, 1024).d2h_syncs for nd in (2, 4, 8)}
+    assert len(set(counts.values())) == 1, (
+        f"sync count scaled with device count: {counts}")
+
+
+# ---------------------------------------------------------------------------
+# pipeline: q1 end-to-end
+# ---------------------------------------------------------------------------
+
+def test_q1_pipeline_budget(accel, monkeypatch):
+    from benchmarks import tpch
+    monkeypatch.setattr(tpch, "_backend", lambda: "tpu")
+    lineitem = tpch.generate_q1_lineitem(8192, seed=13)
+    tpch.run_q1(lineitem)  # warm
+    with budget.measure() as b:
+        out = tpch.run_q1(lineitem)
+        jax.block_until_ready([c.data for c in out.columns])
+    # groupby head + final sort's string-free gather: the whole pipeline
+    # must stay within a handful of sizing syncs and NEVER recompile
+    assert b.d2h_syncs <= 3, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
