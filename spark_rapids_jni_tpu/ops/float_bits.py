@@ -50,33 +50,43 @@ _U64 = np.uint64
 
 
 def _build_pow10_table():
-    """128-bit fixed-point mantissas m and binary exponents e2 with
-    10^q = (m / 2^127) · 2^e2, m ∈ [2^127, 2^128). Exact for q ∈ [0, 55];
-    truncated (q > 55) or rounded up (q < 0, reciprocal) otherwise —
-    the Eisel–Lemire table contract, derived from bignum here rather than
-    transcribed."""
+    """192-bit fixed-point mantissas m and binary exponents e2 with
+    10^q = (m / 2^191) · 2^e2, m ∈ [2^191, 2^192). Exact for q ∈ [0, 82];
+    truncated (q > 82) or rounded up (q < 0, reciprocal) otherwise.
+
+    Width rationale (round-5): the classic 128-bit Eisel–Lemire table is
+    ambiguous for rare inputs (observed: 3540205410719687400e-2 came out
+    one ulp high) and real EL implementations carry a slow-path fallback
+    for exactly that case. A fallback doesn't vectorize; a wider table
+    removes the need: the 64×192-bit product carries >=191 correct
+    leading bits (table error < 1 ulp of 2^-191), above the known
+    worst-case precision (~170 bits) required to round any <=20-digit
+    decimal to binary64 — so the assembly is exact with no fallback.
+    Derived from bignum here, not transcribed."""
     hi = np.empty(_Q_MAX - _Q_MIN + 1, dtype=np.uint64)
+    mid = np.empty_like(hi)
     lo = np.empty_like(hi)
     e2 = np.empty(hi.shape, dtype=np.int32)
     for q in range(_Q_MIN, _Q_MAX + 1):
         if q >= 0:
             n = 5 ** q
             b = n.bit_length()
-            m = n << (128 - b) if b <= 128 else n >> (b - 128)
+            m = n << (192 - b) if b <= 192 else n >> (b - 192)
             e = q + b - 1
         else:
             f = 5 ** (-q)
             b = f.bit_length()
-            m = (1 << (127 + b)) // f + 1  # round up: value underestimates
+            m = (1 << (191 + b)) // f + 1  # round up: value underestimates
             e = q - b
         i = q - _Q_MIN
-        hi[i] = np.uint64(m >> 64)
+        hi[i] = np.uint64(m >> 128)
+        mid[i] = np.uint64((m >> 64) & 0xFFFFFFFFFFFFFFFF)
         lo[i] = np.uint64(m & 0xFFFFFFFFFFFFFFFF)
         e2[i] = e
-    return hi, lo, e2
+    return hi, mid, lo, e2
 
 
-_POW10_HI, _POW10_LO, _POW10_E2 = _build_pow10_table()
+_POW10_HI, _POW10_MID, _POW10_LO, _POW10_E2 = _build_pow10_table()
 
 
 def _clz64(x):
@@ -103,9 +113,30 @@ def _decimal_to_bits(digits, exp10, negative, *, mant_bits: int,
     digits = digits.astype(jnp.uint64)
     exp10 = exp10.astype(jnp.int32)
 
+    # Exact-boundary rescue: a value (or rounding tie) is exactly
+    # representable with q < 0 only when 5^|q| divides the digits (5 is
+    # coprime to 2), which caps |q| at 27 (5^28 > 2^64). The reciprocal
+    # table rounds UP, so such ties would otherwise read "above half"
+    # (observed: 3540205410719687400e-2, an exact tie, came out one ulp
+    # high). Route them through the EXACT q=0 table as digits/5^|q| with
+    # a pure binary 2^q shift — together with the 192-bit product this
+    # makes the assembly provably correctly rounded for every u64
+    # digits × q (exact cases rescued here; inexact cases clear the
+    # ~170-bit worst-case precision bound under the product's 191
+    # correct bits).
+    pow5 = jnp.asarray(np.array([5 ** k for k in range(28)],
+                                dtype=np.uint64))
+    aq = jnp.clip(-exp10, 0, 27)
+    p5 = pow5[aq]
+    rescued = (exp10 < 0) & (exp10 >= -27) & (digits % p5 == 0)
+    digits = jnp.where(rescued, digits // p5, digits)
+    e2_bin = jnp.where(rescued, exp10, 0)  # leftover exact 2^q factor
+    exp10 = jnp.where(rescued, 0, exp10)
+
     q = jnp.clip(exp10, _Q_MIN, _Q_MAX)
     idx = q - _Q_MIN
     m_hi = jnp.asarray(_POW10_HI)[idx]
+    m_mid = jnp.asarray(_POW10_MID)[idx]
     m_lo = jnp.asarray(_POW10_LO)[idx]
     e2 = jnp.asarray(_POW10_E2)[idx]
 
@@ -113,24 +144,32 @@ def _decimal_to_bits(digits, exp10, negative, *, mant_bits: int,
     l = _clz64(safe)
     w = safe << l.astype(jnp.uint64)
 
-    # full 192-bit product w × (m_hi·2^64 + m_lo): top 128 bits (uh, ul),
-    # low 64 folded into sticky
-    h1, l1 = _mul_64_64(w, m_hi)
+    # full 256-bit product w × (m_hi·2^128 + m_mid·2^64 + m_lo): top 128
+    # bits (uh, ul), lower 128 folded into sticky. The wide product is
+    # what makes the assembly exact with no ambiguity fallback (see
+    # _build_pow10_table).
+    h2, l2 = _mul_64_64(w, m_hi)
+    h1, l1 = _mul_64_64(w, m_mid)
     h0, l0 = _mul_64_64(w, m_lo)
-    ul = l1 + h0
-    carry = (ul < l1).astype(jnp.uint64)
-    uh = h1 + carry
+    limb1 = l1 + h0
+    c1 = (limb1 < l1).astype(jnp.uint64)
+    ul = l2 + h1
+    c2 = (ul < l2).astype(jnp.uint64)
+    ul = ul + c1
+    c2 = c2 + (ul < c1).astype(jnp.uint64)
+    uh = h2 + c2
 
-    msb = (uh >> _U64(63)).astype(jnp.int32)  # product top bit: 191 or 190
+    msb = (uh >> _U64(63)).astype(jnp.int32)  # product top bit: 255 or 254
     # leading mant_bits+2 product bits: kept + round, lower bits → sticky
     win_shift = (63 - (mant_bits + 2) + msb).astype(jnp.uint64)
     window = uh >> win_shift
     dropped_uh = uh & ((_U64(1) << win_shift) - _U64(1))
-    sticky = (dropped_uh != 0) | (ul != 0) | (l0 != 0)
+    sticky = (dropped_uh != 0) | (ul != 0) | (limb1 != 0) | (l0 != 0)
 
-    # unbiased exponent of the value's leading bit:
-    # value = P·2^(e2-l-127), P ≈ uh·2^128, uh's top bit at 62+msb
-    e_lead = e2 - l + 63 + msb
+    # unbiased exponent of the value's leading bit (plus any exact
+    # binary factor from the divisibility rescue):
+    # value = P·2^(e2-l-191)·2^e2_bin, P ≈ uh·2^192, uh's top bit 62+msb
+    e_lead = e2 - l + 63 + msb + e2_bin
 
     # rounding shift: 1 for normals, more for subnormals (clipped so the
     # whole window can shift out → ±0)
@@ -187,7 +226,8 @@ def f64_value_from_bits(bits):
     double-double like any device f64 — same precision/range as the value
     would have had after a host transfer, minus the transfer."""
     bits = bits.astype(jnp.uint64)
-    if jax.default_backend() in ("tpu", "axon"):
+    from ..utils.backend import is_accelerator
+    if is_accelerator():
         # only the TPU X64 rewriter lacks the 64-bit bitcast
         # (docs/TPU_NUMERICS.md §3)
         return _f64_from_bits_arith(bits)
@@ -197,6 +237,55 @@ def f64_value_from_bits(bits):
     # 1.0 · 2^-537 · 2^-537 == 0.0 under jit)
     from jax import lax
     return lax.bitcast_convert_type(bits, jnp.float64)
+
+
+def f64_bits_from_value(vals):
+    """Encode device f64 values to FLOAT64 bit-pattern storage (uint64)
+    WITHOUT a host round-trip — the inverse of f64_value_from_bits, and the
+    missing half that forced ops producing float results (groupby mean/sum)
+    through np.asarray → Column.from_numpy, i.e. two tunnel transfers per
+    output column. Backend split mirrors the decode: everywhere but TPU the
+    64-bit bitcast is the exact route; the TPU X64 rewriter lacks it
+    (docs/TPU_NUMERICS.md §3), so fields are assembled arithmetically
+    there. On TPU the input is already a double-double approximation, so
+    the arithmetic path adds no loss the backend wasn't imposing."""
+    vals = vals.astype(jnp.float64)
+    from ..utils.backend import is_accelerator
+    if is_accelerator():
+        return _f64_bits_arith(vals)
+    from jax import lax
+    return lax.bitcast_convert_type(vals, jnp.uint64)
+
+
+def _f64_bits_arith(v):
+    """Arithmetic IEEE-754 field assembly for backends without a 64-bit
+    bitcast: frexp → round-half-even 53-bit mantissa → biased-exponent /
+    fraction packing. Exact for normals/inf/zero; SUBNORMAL inputs encode
+    to signed zero — XLA compiles f64 arithmetic flush-to-zero (see
+    f64_value_from_bits), and on TPU (the only backend routed here)
+    every such magnitude flushes in the producing computation anyway, so
+    this adds no loss the backend wasn't imposing. A result that rounds
+    up *into* the normal range still lands on the smallest normal's bit
+    pattern for free, since bits = bexp<<52 | frac with bexp 0."""
+    sign = jnp.signbit(v)
+    av = jnp.abs(v)
+    m, e = jnp.frexp(av)  # av = m * 2^e, m in [0.5, 1)
+    # normal path: mant = round(m * 2^53) in [2^52, 2^53]; a round up to
+    # exactly 2^53 carries into the exponent
+    mant = jnp.round(jnp.ldexp(m, 53)).astype(jnp.uint64)
+    carry = mant == (_U64(1) << _U64(53))
+    mant = jnp.where(carry, _U64(1) << _U64(52), mant)
+    e = jnp.where(carry, e + 1, e)
+    frac_n = mant & ((_U64(1) << _U64(52)) - _U64(1))
+    bexp_n = (e + 1022).astype(jnp.uint64)  # (e-1) + 1023
+    # subnormal path (av < 2^-1022): frac = round(av * 2^1074), bexp = 0
+    frac_s = jnp.round(jnp.ldexp(av, 1074)).astype(jnp.uint64)
+    bits = jnp.where(e < -1021, frac_s, (bexp_n << _U64(52)) | frac_n)
+    bits = jnp.where(av == 0, _U64(0), bits)
+    bits = jnp.where(jnp.isinf(av), _U64(0x7FF) << _U64(52), bits)
+    bits = jnp.where(sign, bits | (_U64(1) << _U64(63)), bits)
+    # canonical quiet NaN last: sign is not meaningful on NaN outputs
+    return jnp.where(jnp.isnan(v), _U64(0x7FF8) << _U64(48), bits)
 
 
 def _f64_from_bits_arith(bits):

@@ -134,3 +134,26 @@ def test_string_to_float_end_to_end_bit_exact():
     for i, s in enumerate(strs):
         want = np.float64(float(s)).view(np.uint64)
         assert got[i] == want, (s, hex(got[i]), hex(want))
+
+
+def test_exact_tie_regressions_round5():
+    """Exact rounding ties with q < 0 (value = w/10^|q| landing exactly
+    halfway between doubles). The 128-bit up-rounded reciprocal table
+    misrounded these one ulp high (round-5 adversarial pass); the
+    192-bit table + divisibility rescue must resolve them to even."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops.float_bits import decimal_to_f64_bits
+
+    cases = [(3540205410719687400, -2), (12209032421260881000, -3)]
+    # constructed: (2m+1) * 5^2 over e-2 is an exact tie for every m
+    rng = np.random.default_rng(5)
+    for m in rng.integers(2**52, 2**53, 200, dtype=np.uint64):
+        cases.append((int((2 * m + 1) * 25), -2))
+    d = np.array([c[0] for c in cases], np.uint64)
+    e = np.array([c[1] for c in cases], np.int32)
+    got = np.asarray(decimal_to_f64_bits(
+        jnp.asarray(d), jnp.asarray(e), jnp.zeros(len(cases), bool)))
+    for i, (w, q) in enumerate(cases):
+        want = np.float64(float(f"{w}e{q}")).view(np.uint64)
+        assert got[i] == want, (w, q, hex(int(got[i])), hex(int(want)))
