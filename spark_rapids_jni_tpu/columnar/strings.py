@@ -123,3 +123,30 @@ def from_padded_bytes(mat: np.ndarray, lengths: np.ndarray,
     vmask = None if validity is None else jnp.asarray(np.asarray(validity, dtype=bool))
     return Column(dt.STRING, n, data=data, validity=vmask,
                   offsets=jnp.asarray(offsets.astype(np.int32)))
+
+
+def gather_spans(src: jnp.ndarray, starts: jnp.ndarray,
+                 lengths: jnp.ndarray, validity) -> Column:
+    """STRING column from per-row (start, length) spans over flat source
+    bytes — the shared device extraction used by the span-producing ops
+    (parse_url device tier, dictionary-string Parquet decode). One
+    output-sizing sync; everything else is a flat-byte gather."""
+    from . import dtype as dt
+    n = int(lengths.shape[0])
+    lengths = lengths.astype(jnp.int32)
+    if validity is not None:
+        lengths = jnp.where(validity, lengths, 0)
+    new_offs = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(lengths).astype(jnp.int32)])
+    total = int(new_offs[-1])  # the one output-sizing sync
+    if total:
+        row_of_el = jnp.repeat(jnp.arange(n, dtype=jnp.int32), lengths,
+                               total_repeat_length=total)
+        el_in_row = (jnp.arange(total, dtype=jnp.int32)
+                     - jnp.take(new_offs, row_of_el))
+        pos = jnp.take(starts.astype(jnp.int32), row_of_el) + el_in_row
+        data = jnp.take(src, pos)
+    else:
+        data = jnp.zeros((0,), dtype=jnp.uint8)
+    return Column(dt.STRING, n, data=data, validity=validity,
+                  offsets=new_offs)

@@ -1,0 +1,527 @@
+"""Device-tier parse_url — PROTOCOL/HOST/QUERY as vectorized byte scans.
+
+Round-4 verdict missing #2 / next #3: the host C++ tier (native/
+parse_uri.cpp) forces a device→host→device hop per call at the tunnel's
+0.1-0.2 GB/s, so on-chip parse_uri ran at 0.2x CPU. This module keeps the
+whole parse on the accelerator: the string column densifies to a padded
+``uint8[n, W]`` byte matrix (columnar/strings.padded_bytes — W bucketed,
+one sizing sync) and every per-row decision becomes a vector op across
+rows.
+
+Design (the TPU translation of the reference's thread-per-row device
+kernel, src/main/cpp/src/parse_uri.cu:877-1006):
+
+- **Span splitting** (fragment / scheme / query / authority / path /
+  opaque) is pure index arithmetic: masked first/last-match scans over
+  the byte matrix (argmax on boolean planes), no control flow.
+- **Chunk validation** — the per-class character rules + %XX escapes
+  (parse_uri.cu:92-151 skip_and_validate_special) — runs as ONE DFA pass
+  over matrix columns: a ``lax.fori_loop`` of W steps carrying per-row
+  registers (escape-skip counter, ok flag), with each step a handful of
+  [n]-wide VPU ops. Class membership is a single [classes*256] table
+  gather; the five chunk spans are disjoint per row, so one pass
+  validates them all.
+- **UTF-8 structure** (strict decode + the unicode whitespace/control
+  rejections) is branch-free shifted-window algebra over the matrix —
+  the SIMD validation shape, not a scan.
+- **Host classification** (IPv6 / IPv4 / domain trichotomy,
+  parse_uri.cu:165-404) mirrors the oracle's per-char loops as three
+  short fori_loops with [n]-wide registers.
+
+Single source of truth: the character-class sets, and the expected
+outputs, come from ops/parse_uri.py (the python oracle; its tables are
+imported, not copied). tests/test_parse_uri_device.py pins bit-identical
+agreement over the golden corpora + structured fuzz.
+
+Extraction of the winning span back to a STRING column is a flat-byte
+gather with ONE output-sizing sync — parse_uri's whole device budget is
+the densify sync + the sizing sync, no full-string D2H anywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.strings import padded_bytes
+from ..utils.tracing import func_range
+from . import parse_uri as _oracle
+
+# ---------------------------------------------------------------------------
+# class tables (built from the oracle's sets — single source of truth)
+# ---------------------------------------------------------------------------
+
+_CLS_NONE, _CLS_FRAGMENT, _CLS_QUERY, _CLS_AUTH, _CLS_PATH, _CLS_OPAQUE, \
+    _CLS_SCHEME = range(7)
+
+
+def _build_tables():
+    cls = np.zeros((7, 256), dtype=bool)
+    for cid, allowed in ((_CLS_FRAGMENT, _oracle._FRAGMENT_OK),
+                         (_CLS_QUERY, _oracle._QUERY_OK),
+                         (_CLS_AUTH, _oracle._AUTH_OK),
+                         (_CLS_PATH, _oracle._PATH_OK),
+                         (_CLS_OPAQUE, _oracle._OPAQUE_OK)):
+        cls[cid, list(allowed)] = True
+    cls[_CLS_SCHEME, list(_oracle._ALNUM | set(b"+-."))] = True
+    hexd = np.zeros(256, dtype=bool)
+    hexd[list(_oracle._HEX)] = True
+    digit = np.zeros(256, dtype=bool)
+    digit[list(_oracle._DIGIT)] = True
+    alpha = np.zeros(256, dtype=bool)
+    alpha[list(_oracle._ALPHA)] = True
+    alnum = alpha | digit
+    # escapes + the non-ASCII exemption apply to every chunk class except
+    # the scheme (ASCII alnum+-. only, '%' illegal)
+    esc_ok = np.array([False, True, True, True, True, True, False])
+    return cls, hexd, digit, alpha, alnum, esc_ok
+
+
+_CLS_TAB, _HEX_TAB, _DIGIT_TAB, _ALPHA_TAB, _ALNUM_TAB, _ESC_OK = \
+    _build_tables()
+
+
+# ---------------------------------------------------------------------------
+# masked first/last scans
+# ---------------------------------------------------------------------------
+
+def _first(mask, lo, hi):
+    """Per row: smallest j in [lo, hi) with mask[row, j]; (idx, found).
+    idx == hi where not found (a safe clamp for downstream span math)."""
+    W = mask.shape[1]
+    pos = jnp.arange(W, dtype=jnp.int32)
+    m = mask & (pos[None, :] >= lo[:, None]) & (pos[None, :] < hi[:, None])
+    found = jnp.any(m, axis=1)
+    idx = jnp.argmax(m, axis=1).astype(jnp.int32)
+    return jnp.where(found, idx, hi), found
+
+
+def _last(mask, lo, hi):
+    W = mask.shape[1]
+    pos = jnp.arange(W, dtype=jnp.int32)
+    m = mask & (pos[None, :] >= lo[:, None]) & (pos[None, :] < hi[:, None])
+    found = jnp.any(m, axis=1)
+    idx = (W - 1 - jnp.argmax(m[:, ::-1], axis=1)).astype(jnp.int32)
+    return jnp.where(found, idx, lo - 1), found
+
+
+def _byte_at(mat, idx):
+    """mat[row, idx[row]] with a 0 for out-of-range indices."""
+    n, W = mat.shape
+    safe = jnp.clip(idx, 0, W - 1)
+    b = mat[jnp.arange(n), safe]
+    return jnp.where((idx >= 0) & (idx < W), b, 0).astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# UTF-8 structural validation (shifted-window algebra)
+# ---------------------------------------------------------------------------
+
+def _utf8_ok(mat, span):
+    """Strict-UTF-8 + unicode-space/control rejection over ``span``
+    positions (bool [n, W]); matches bytes.decode + _BAD_UNICODE in the
+    oracle (_validate_chunk). ASCII bytes pass untouched; class legality
+    of ASCII is the DFA's job."""
+    z = jnp.zeros_like(mat[:, :1])
+
+    def sh(a, k):  # shift right along the byte axis by k (left-pad zeros)
+        return jnp.concatenate([jnp.zeros_like(a[:, :k]), a[:, :-k]], axis=1)
+
+    m = jnp.where(span, mat, jnp.uint8(0))
+    cont = (m >= 0x80) & (m < 0xC0)
+    lead2 = (m >= 0xC2) & (m < 0xE0)
+    lead3 = (m >= 0xE0) & (m < 0xF0)
+    lead4 = (m >= 0xF0) & (m < 0xF5)
+    bad_byte = ((m == 0xC0) | (m == 0xC1) | (m >= 0xF5))
+
+    needed = (sh(lead2, 1) | sh(lead3, 1) | sh(lead3, 2)
+              | sh(lead4, 1) | sh(lead4, 2) | sh(lead4, 3)) \
+        if mat.shape[1] >= 4 else jnp.zeros_like(cont)
+    # continuations exactly where required; a lead whose continuation
+    # falls outside the span sees cont=0 there and fails here
+    structure_ok = ~jnp.any(needed ^ cont, axis=1)
+
+    nxt = jnp.concatenate([m[:, 1:], z], axis=1)
+    nxt2 = jnp.concatenate([m[:, 2:], z, z], axis=1)
+    # overlong / surrogate / out-of-range second-byte constraints
+    pair_bad = (((m == 0xE0) & (nxt < 0xA0))
+                | ((m == 0xED) & (nxt >= 0xA0))
+                | ((m == 0xF0) & (nxt < 0x90))
+                | ((m == 0xF4) & (nxt > 0x8F)))
+    # rejected code points (oracle _BAD_UNICODE): U+0080-00A0,
+    # U+1680, U+2000-200A, U+2028, U+202F, U+205F, U+3000
+    bad_cp = (((m == 0xC2) & (nxt >= 0x80) & (nxt <= 0xA0))
+              | ((m == 0xE1) & (nxt == 0x9A) & (nxt2 == 0x80))
+              | ((m == 0xE2) & (nxt == 0x80) & (nxt2 >= 0x80)
+                 & (nxt2 <= 0x8A))
+              | ((m == 0xE2) & (nxt == 0x80) & (nxt2 == 0xA8))
+              | ((m == 0xE2) & (nxt == 0x80) & (nxt2 == 0xAF))
+              | ((m == 0xE2) & (nxt == 0x81) & (nxt2 == 0x9F))
+              | ((m == 0xE3) & (nxt == 0x80) & (nxt2 == 0x80)))
+    clean = ~jnp.any(bad_byte | pair_bad | bad_cp, axis=1)
+    return structure_ok & clean
+
+
+# ---------------------------------------------------------------------------
+# the fused chunk DFA (all classes, one pass)
+# ---------------------------------------------------------------------------
+
+def _chunks_ok(mat, class_sel, end_at, raw_pct):
+    """One W-step DFA validating every chunk span at once.
+
+    class_sel [n, W] int8: chunk class id per position (0 = unchecked).
+    end_at    [n, W] int32: the owning span's end per position (for the
+              '%XX needs two more bytes' rule).
+    raw_pct   [n, W] bool: '%' legal raw here (IPv6 zone-id authority).
+    """
+    n, W = mat.shape
+    cls_flat = jnp.asarray(_CLS_TAB.reshape(-1))
+    hex_tab = jnp.asarray(_HEX_TAB)
+    esc_tab = jnp.asarray(_ESC_OK)
+
+    def step(j, carry):
+        ok, skip = carry
+        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False)
+        cs = lax.dynamic_index_in_dim(class_sel, j, axis=1,
+                                      keepdims=False).astype(jnp.int32)
+        ce = lax.dynamic_index_in_dim(end_at, j, axis=1, keepdims=False)
+        rp = lax.dynamic_index_in_dim(raw_pct, j, axis=1, keepdims=False)
+        active = cs > 0
+        ci = c.astype(jnp.int32)
+        is_hex = hex_tab[ci]
+        in_cls = cls_flat[cs * 256 + ci]
+        esc_cls = esc_tab[cs]
+        consuming = active & (skip > 0)
+        # consumed escape bytes must be hex digits
+        ok = ok & (~consuming | is_hex)
+        pct = c == ord("%")
+        esc_start = active & ~consuming & pct & esc_cls & ~rp
+        # '%' must introduce two in-span bytes (oracle: i + 2 >= n fails)
+        ok = ok & (~esc_start | (j + 2 < ce))
+        # plain position: class member, or non-ASCII (utf8-checked
+        # separately) where the class allows it, or a raw '%'
+        plain = active & ~consuming & ~esc_start
+        ok = ok & (~plain | in_cls | ((c >= 0x80) & esc_cls)
+                   | (pct & rp & esc_cls))
+        skip = jnp.where(consuming, skip - 1,
+                         jnp.where(esc_start, 2, 0))
+        return ok, skip
+
+    ok0 = jnp.ones((n,), dtype=bool)
+    skip0 = jnp.zeros((n,), dtype=jnp.int32)
+    ok, _ = lax.fori_loop(0, W, step, (ok0, skip0))
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# host classification loops (oracle per-char semantics, [n]-wide)
+# ---------------------------------------------------------------------------
+
+def _ipv6_ok(mat, lo, hi):
+    """_validate_ipv6 over [lo, hi) spans, register-for-register."""
+    n, W = mat.shape
+    digit = jnp.asarray(_DIGIT_TAB)
+
+    def step(j, s):
+        (ok, dc, colons, periods, pcts, obr, cbr,
+         gval, gchars, ghex, prev) = s
+        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
+            .astype(jnp.int32)
+        act = (j >= lo) & (j < hi)
+
+        is_ob = c == ord("[")
+        is_cb = c == ord("]")
+        is_co = c == ord(":")
+        is_dot = c == ord(".")
+        is_pct = c == ord("%")
+        other = ~(is_ob | is_cb | is_co | is_dot | is_pct)
+
+        ok = ok & (~(act & is_ob) | (obr + 1 <= 1))
+        ok = ok & (~(act & is_cb) | ((cbr + 1 <= 1)
+                                     & ~((periods > 0)
+                                         & (ghex | (gval > 255)))))
+        nco = colons + 1
+        co_bad = ((prev == ord(":")) & dc) | (nco > 8) \
+            | ((nco == 8) & ~(dc | (prev == ord(":")))) \
+            | (periods > 0) | (pcts > 0)
+        ok = ok & (~(act & is_co) | ~co_bad)
+        np_ = periods + 1
+        dot_bad = (pcts > 0) | (np_ > 3) | ghex | (gval > 255) \
+            | ((colons != 6) & ~dc) | (colons >= 8)
+        ok = ok & (~(act & is_dot) | ~dot_bad)
+        pct_bad = (pcts + 1 > 1) | ((periods > 0) & (ghex | (gval > 255)))
+        ok = ok & (~(act & is_pct) | ~pct_bad)
+
+        is_hexl = ((c >= ord("a")) & (c <= ord("f"))) \
+            | ((c >= ord("A")) & (c <= ord("F")))
+        is_dig = digit[c]
+        grp = act & other & (pcts == 0)  # inside a zone-id anything goes
+        ok = ok & (~grp | ((gchars <= 3) & (is_hexl | is_dig)))
+        add = jnp.where(is_hexl, 10 + (c | 0x20) - ord("a"), c - ord("0"))
+        gval_n = jnp.minimum(gval * 10 + add, 1 << 20)  # cap: only >255 matters
+        reset = act & (is_co | is_dot | is_pct)
+        gval = jnp.where(grp, gval_n, jnp.where(reset, 0, gval))
+        gchars = jnp.where(grp, gchars + 1, jnp.where(reset, 0, gchars))
+        ghex = jnp.where(grp, ghex | is_hexl,
+                         jnp.where(reset, False, ghex))
+        dc = dc | (act & is_co & (prev == ord(":")))
+        colons = colons + (act & is_co)
+        periods = periods + (act & is_dot)
+        pcts = pcts + (act & is_pct)
+        obr = obr + (act & is_ob)
+        cbr = cbr + (act & is_cb)
+        prev = jnp.where(act, c, prev)
+        return (ok, dc, colons, periods, pcts, obr, cbr,
+                gval, gchars, ghex, prev)
+
+    i32z = jnp.zeros((n,), jnp.int32)
+    s0 = (hi - lo >= 2, jnp.zeros((n,), bool), i32z, i32z, i32z, i32z,
+          i32z, i32z, i32z, jnp.zeros((n,), bool), i32z)
+    out = lax.fori_loop(0, W, step, s0)
+    return out[0]
+
+
+def _ipv4_ok(mat, lo, hi):
+    """_validate_ipv4: dotted-quad, each group's numeric value <= 255."""
+    n, W = mat.shape
+    digit = jnp.asarray(_DIGIT_TAB)
+
+    def step(j, s):
+        ok, octet, chars, dots = s
+        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
+            .astype(jnp.int32)
+        act = (j >= lo) & (j < hi)
+        is_dig = digit[c]
+        is_dot = (c == ord(".")) & (j > lo)  # a leading '.' is a bad char
+        ok = ok & (~act | is_dig | is_dot)
+        ok = ok & (~(act & is_dot) | (chars > 0))
+        octet_n = jnp.minimum(octet * 10 + (c - ord("0")), 1 << 20)
+        ok = ok & (~(act & is_dig) | (octet_n <= 255))
+        octet = jnp.where(act & is_dig, octet_n,
+                          jnp.where(act & is_dot, 0, octet))
+        chars = jnp.where(act & is_dig, chars + 1,
+                          jnp.where(act & is_dot, 0, chars))
+        dots = dots + (act & is_dot)
+        return ok, octet, chars, dots
+
+    i32z = jnp.zeros((n,), jnp.int32)
+    ok, _, chars, dots = lax.fori_loop(
+        0, W, step, (jnp.ones((n,), bool), i32z, i32z, i32z))
+    return ok & (chars > 0) & (dots == 3)
+
+
+def _domain_ok(mat, lo, hi):
+    """_validate_domain, register-for-register (including its exact
+    'numeric_start' last-character semantics)."""
+    n, W = mat.shape
+    digit = jnp.asarray(_DIGIT_TAB)
+    alnum = jnp.asarray(_ALNUM_TAB)
+
+    def step(j, s):
+        ok, ldash, ldot, nstart, chars = s
+        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
+            .astype(jnp.int32)
+        act = (j >= lo) & (j < hi)
+        is_dash = c == ord("-")
+        is_dot = c == ord(".")
+        ok = ok & (~act | alnum[c] | is_dash | is_dot)
+        nstart = jnp.where(act, ldot & digit[c], nstart)
+        dash_bad = ldot | (j == lo) | (j == hi - 1)
+        ok = ok & (~(act & is_dash) | ~dash_bad)
+        dot_bad = ldash | ldot | (chars == 0)
+        ok = ok & (~(act & is_dot) | ~dot_bad)
+        plain = act & ~is_dash & ~is_dot
+        ldash = jnp.where(act, is_dash, ldash)
+        ldot = jnp.where(act, is_dot, ldot)
+        chars = jnp.where(plain, chars + 1,
+                          jnp.where(act, 0, chars))
+        return ok, ldash, ldot, nstart, chars
+
+    bz = jnp.zeros((n,), bool)
+    ok, _, _, nstart, _ = lax.fori_loop(
+        0, W, step, (jnp.ones((n,), bool), bz, bz, bz,
+                     jnp.zeros((n,), jnp.int32)))
+    return ok & ~nstart
+
+
+# ---------------------------------------------------------------------------
+# the jitted core: spans + validity verdicts for every row at once
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=())
+def _parse_core(mat, lens):
+    """Return per-row span indices and presence flags:
+    (ok, scheme_s, scheme_e, has_scheme, host_s, host_e, has_host,
+     query_s, query_e, has_query); ``ok`` False = fatal row (all null)."""
+    n, W = mat.shape
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    lens = lens.astype(jnp.int32)
+    zero = jnp.zeros((n,), jnp.int32)
+
+    eq = {c: mat == c for c in
+          (ord("#"), ord(":"), ord("/"), ord("?"), ord("@"),
+           ord("["), ord("]"))}
+
+    # -- fragment split -----------------------------------------------------
+    hash_pos, has_hash = _first(eq[ord("#")], zero, lens)
+    end0 = jnp.where(has_hash, hash_pos, lens)          # b = b[:hash]
+    frag_s, frag_e = hash_pos + 1, lens
+
+    # -- scheme split -------------------------------------------------------
+    colon, has_colon = _first(eq[ord(":")], zero, end0)
+    slash, has_slash = _first(eq[ord("/")], zero, end0)
+    has_scheme = has_colon & (~has_slash | (colon < slash))
+    scheme_s = zero
+    scheme_e = jnp.where(has_scheme, colon, zero)
+    b_start = jnp.where(has_scheme, colon + 1, zero)
+    scheme_ok = ~has_scheme | (
+        (scheme_e > 0) & jnp.asarray(_ALPHA_TAB)[
+            _byte_at(mat, scheme_s).astype(jnp.int32)])
+    # (rest-of-scheme chars validate through the DFA class table)
+
+    empty_b = b_start >= end0
+
+    first_b = _byte_at(mat, b_start)
+    hierarchical = (first_b == ord("/")) | ~has_scheme
+
+    # -- query split (hierarchical only) ------------------------------------
+    question, has_q = _first(eq[ord("?")], b_start, end0)
+    has_query = hierarchical & has_q
+    query_s, query_e = question + 1, end0
+    b2_end = jnp.where(has_query, question, end0)
+
+    # -- authority / path ---------------------------------------------------
+    second_b = _byte_at(mat, b_start + 1)
+    has_marker = hierarchical & (b2_end - b_start >= 2) \
+        & (first_b == ord("/")) & (second_b == ord("/"))
+    rest_start = b_start + 2
+    next_slash, has_ns = _first(eq[ord("/")], rest_start, b2_end)
+    auth_s = rest_start
+    auth_e = jnp.where(has_ns, next_slash, b2_end)
+    has_auth = has_marker & (auth_e > auth_s)
+    path_s = jnp.where(has_marker,
+                       jnp.where(has_ns, next_slash, b2_end), b_start)
+    path_e = b2_end
+
+    # -- userinfo / host:port ----------------------------------------------
+    amp, has_amp = _first(eq[ord("@")], auth_s, auth_e)
+    ui_bracket, _ = _first(eq[ord("[")] | eq[ord("]")], auth_s,
+                           jnp.where(has_amp, amp, auth_s))
+    userinfo_bad = has_auth & has_amp \
+        & (ui_bracket < jnp.where(has_amp, amp, auth_s))
+    hp_s = jnp.where(has_amp, amp + 1, auth_s)
+    close_br, has_cbr = _last(eq[ord("]")], hp_s, auth_e)
+    last_colon, has_lc = _last(eq[ord(":")], hp_s, auth_e)
+    # port split only when the colon is past the first char and beyond any
+    # ']' (port contents deliberately unvalidated — oracle :334-338)
+    split = has_lc & (last_colon > hp_s) & (last_colon > close_br)
+    host_s = hp_s
+    host_e = jnp.where(split, last_colon, auth_e)
+
+    # -- chunk validation (one DFA pass over disjoint spans) ----------------
+    def span_mask(s, e, cond):
+        return (pos >= s[:, None]) & (pos < e[:, None]) \
+            & cond[:, None] & (pos < lens[:, None])
+
+    opaque_row = ~hierarchical & ~empty_b
+    sel = jnp.zeros((n, W), jnp.int8)
+    end_at = jnp.zeros((n, W), jnp.int32)
+
+    for s, e, cond, cid in (
+            (frag_s, frag_e, has_hash, _CLS_FRAGMENT),
+            (scheme_s, scheme_e, has_scheme, _CLS_SCHEME),
+            (query_s, query_e, has_query, _CLS_QUERY),
+            (auth_s, auth_e, has_auth, _CLS_AUTH),
+            (path_s, path_e, hierarchical & ~empty_b, _CLS_PATH),
+            (b_start, end0, opaque_row, _CLS_OPAQUE)):
+        msk = span_mask(s, e, cond)
+        sel = jnp.where(msk, jnp.int8(cid), sel)
+        end_at = jnp.where(msk, e[:, None], end_at)
+
+    ipv6ish = has_auth & (auth_e - auth_s > 2) \
+        & (_byte_at(mat, auth_s) == ord("["))
+    raw_pct = span_mask(auth_s, auth_e, ipv6ish)
+
+    dfa_ok = _chunks_ok(mat, sel, end_at, raw_pct)
+    utf8ok = _utf8_ok(mat, sel > 0)
+
+    # -- host trichotomy ----------------------------------------------------
+    host_len = host_e - host_s
+    hfirst = _byte_at(mat, host_s)
+    hlast = _byte_at(mat, host_e - 1)
+    bracketed = (host_len > 0) & (hfirst == ord("["))
+    v6ok = _ipv6_ok(mat, host_s, host_e)
+    brk_inside, has_brk = _first(eq[ord("[")] | eq[ord("]")],
+                                 host_s, host_e)
+    ldot, has_ldot = _last(mat == ord("."), host_s, host_e)
+    after_dot = _byte_at(mat, ldot + 1)
+    looks_ipv4 = has_ldot & (ldot != host_e - 1) \
+        & jnp.asarray(_DIGIT_TAB)[after_dot.astype(jnp.int32)]
+    v4ok = _ipv4_ok(mat, host_s, host_e)
+    domok = _domain_ok(mat, host_s, host_e)
+
+    host_fatal = jnp.where(
+        bracketed, (hlast != ord("]")) | ~v6ok,
+        (host_len > 0) & has_brk)
+    host_valid = jnp.where(
+        bracketed, v6ok & (hlast == ord("]")),
+        (host_len > 0) & ~has_brk
+        & jnp.where(looks_ipv4, v4ok, domok & ~looks_ipv4))
+    host_fatal = has_auth & host_fatal
+    has_host = has_auth & host_valid
+
+    # -- verdict ------------------------------------------------------------
+    ok = dfa_ok & utf8ok & scheme_ok & ~empty_b & ~userinfo_bad \
+        & ~host_fatal
+    has_scheme = ok & has_scheme
+    has_host = ok & has_host & hierarchical
+    has_query = ok & has_query
+    return (ok, scheme_s, scheme_e, has_scheme, host_s, host_e, has_host,
+            query_s, query_e, has_query)
+
+
+# ---------------------------------------------------------------------------
+# public entries: span -> STRING column (one sizing sync)
+# ---------------------------------------------------------------------------
+
+_PARTS = {"PROTOCOL": 0, "HOST": 1, "QUERY": 2}
+
+
+def _extract(col: Column, s, e, present) -> Column:
+    """Flat-byte gather of per-row spans into a STRING column (shared
+    gather_spans path — one output-sizing sync). ``s``/``e`` are indices
+    into the padded row; source bytes come from the original flat data
+    via the row's offset."""
+    from ..columnar.strings import gather_spans
+    offs = jnp.asarray(col.offsets, dtype=jnp.int32)[:-1]
+    if col.validity is not None:
+        present = present & col.validity
+    return gather_spans(col.data, offs + s, e - s, present)
+
+
+@func_range()
+def parse_uri_device(col: Column, part: str) -> Column:
+    """Device-resident parse_url(url, part) for part in PROTOCOL / HOST /
+    QUERY. Bit-identical to the host tiers (ops/parse_uri.py oracle,
+    native/parse_uri.cpp); budget: densify sizing sync + output sizing
+    sync, nothing else leaves the device."""
+    if part not in _PARTS:
+        raise ValueError(f"unsupported part {part!r}")
+    if col.size == 0:
+        return Column(dt.STRING, 0, data=jnp.zeros((0,), jnp.uint8),
+                      validity=jnp.zeros((0,), bool),
+                      offsets=jnp.zeros((1,), jnp.int32))
+    mat, lens = padded_bytes(col)
+    (ok, ss, se, has_s, hs, he, has_h, qs, qe, has_q) = _parse_core(mat,
+                                                                    lens)
+    if part == "PROTOCOL":
+        return _extract(col, ss, se, has_s)
+    if part == "HOST":
+        return _extract(col, hs, he, has_h)
+    return _extract(col, qs, qe, has_q)

@@ -79,6 +79,13 @@ _register("hashing.pallas", "SRJT_HASH_PALLAS", "auto", str,
 _register("rowconv.pallas", "SRJT_ROWCONV_PALLAS", "auto", str,
           "JCUDF fixed-region word assembly via the pallas VMEM kernel: "
           "auto (accelerator only) | on (interpreted on CPU; tests) | off")
+_register("parse_uri.tier", "SRJT_PARSE_URI_TIER", "auto", str,
+          "parse_url PROTOCOL/HOST/QUERY execution tier: auto "
+          "(device on accelerators, native C++ on CPU) | device | native")
+_register("parquet.device_decode", "SRJT_PARQUET_DEVICE_DECODE", "auto",
+          str, "Parquet decode stage 1 on-device (RLE/dict/PLAIN as XLA; "
+          "only encoded page bytes cross the link): auto (accelerators) "
+          "| on | off")
 
 
 def get(key: str) -> Any:
