@@ -140,11 +140,16 @@ def bench_parse_uri(rows: int):
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column
     from spark_rapids_jni_tpu.ops.parse_uri import parse_uri_to_host
-    urls = [f"https://host{i % 97}.example.com:8080/path/p{i}?q={i}&r=2"
-            for i in range(rows)]
-    col = Column.from_pylist(urls, dt.STRING)
-    nbytes = sum(len(u) for u in urls)
-    sec = _time(lambda: parse_uri_to_host(col))  # host tier: no elision risk
+    cols = []
+    nbytes = 0
+    for s in range(_NVARIANTS):
+        urls = [f"https://host{(i + s) % 97}.example.com:8080/"
+                f"path/p{i + s}?q={i}&r=2" for i in range(rows)]
+        nbytes = sum(len(u) for u in urls)
+        cols.append(Column.from_pylist(urls, dt.STRING))
+    # variants cycled: the device tier re-dispatches the same program, and
+    # identical buffers would risk axon-side elision (host tier never did)
+    sec = _time(lambda i: parse_uri_to_host(cols[i % _NVARIANTS]))
     return sec, nbytes
 
 

@@ -1324,6 +1324,171 @@ void pqd_free_out(pqd_out_t* out) {
   out->reps = nullptr;
 }
 
+// ---------------------------------------------------------------------------
+// Page extraction for the device-decode tier (round-5): walk page headers
+// and decompress payloads WITHOUT decoding values. The python side ships
+// the blob to the accelerator once and expands RLE/bit-packed levels +
+// dictionary indices and reinterprets PLAIN fixed-width values as XLA ops
+// (parquet/device_decode.py), so only encoded page bytes — not full-width
+// decoded columns — cross the host↔device link. Flat columns only; the
+// caller falls back to pqd_decode_chunk2 for anything else.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int ptype;            // 0 = data page (v1 or v2), 2 = dictionary page
+  int encoding;         // value encoding (ENC_*)
+  long long num_values; // entries in this page (dict: dictionary size)
+  long long def_off, def_len;  // raw RLE-hybrid def-level bytes in the blob
+  long long val_off, val_len;  // value-section bytes in the blob
+} pqd_page_meta_t;
+
+int pqd_extract_pages(void* hp, int rg, int leaf_i, const uint8_t* bytes,
+                      long long len, uint8_t** blob_out,
+                      long long* blob_bytes, pqd_page_meta_t** pages_out,
+                      long long* n_pages_out, char** err_out) {
+  auto* h = (decode_handle*)hp;
+  try {
+    if (leaf_i < 0 || leaf_i >= (int)h->leaves.size())
+      throw std::runtime_error("leaf index out of range");
+    long long off, chunk_len, nv;
+    int codec;
+    if (pqd_chunk_range(hp, rg, leaf_i, &off, &chunk_len, &nv, &codec) != 0)
+      throw std::runtime_error("bad row group / leaf");
+    if (len < chunk_len) throw std::runtime_error("short chunk buffer");
+    auto& leaf = h->leaves[leaf_i];
+    if (leaf.max_rep != 0)
+      throw std::runtime_error("extract: flat columns only");
+    chunk_decoder dec(leaf, codec, nv);  // codec dispatch for decompress()
+
+    std::vector<uint8_t> blob;
+    std::vector<pqd_page_meta_t> pages;
+    const uint8_t* buf = bytes;
+    size_t pos = 0;
+    int64_t seen = 0;
+    while (seen < nv) {
+      if (pos >= (size_t)chunk_len)
+        throw std::runtime_error("chunk: ran out of pages");
+      reader rd{buf + pos, (size_t)chunk_len - pos};
+      tvalue ph = rd.read_value(T_STRUCT);
+      pos += rd.pos;
+      int ptype = (int)i_of(ph, PH_TYPE, -1);
+      int64_t comp = i_of(ph, PH_COMP_SIZE, 0);
+      int64_t uncomp = i_of(ph, PH_UNCOMP_SIZE, 0);
+      if (comp < 0 || (size_t)comp > (size_t)chunk_len - pos)
+        throw std::runtime_error("page: truncated payload");
+      const uint8_t* payload = buf + pos;
+      pos += (size_t)comp;
+
+      if (ptype == PAGE_DICT) {
+        auto* dh = get(ph, PH_DICT);
+        if (!dh) throw std::runtime_error("dict page without header");
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        dec.decompress(payload, (size_t)comp, (size_t)uncomp, dbuf, data,
+                       dlen);
+        pqd_page_meta_t m{};
+        m.ptype = 2;
+        m.encoding = (int)i_of(*dh, 2 /* encoding */, ENC_PLAIN);
+        m.num_values = i_of(*dh, DICT_NUM_VALUES, 0);
+        m.val_off = (long long)blob.size();
+        m.val_len = (long long)dlen;
+        blob.insert(blob.end(), data, data + dlen);
+        pages.push_back(m);
+        continue;
+      }
+      if (ptype == PAGE_DATA) {
+        auto* dh = get(ph, PH_DATA_V1);
+        if (!dh) throw std::runtime_error("data page without header");
+        int64_t n = i_of(*dh, DPH_NUM_VALUES, 0);
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        dec.decompress(payload, (size_t)comp, (size_t)uncomp, dbuf, data,
+                       dlen);
+        size_t base = blob.size();
+        blob.insert(blob.end(), data, data + dlen);
+        pqd_page_meta_t m{};
+        m.ptype = 0;
+        m.encoding = (int)i_of(*dh, DPH_ENCODING, ENC_PLAIN);
+        m.num_values = n;
+        size_t cursor = 0;
+        if (leaf.max_def > 0) {  // v1 def section: u32 length + hybrid
+          if (dlen < 4)
+            throw std::runtime_error("page: truncated level length");
+          uint32_t nb;
+          memcpy(&nb, data, 4);
+          if (nb > dlen - 4)
+            throw std::runtime_error("page: truncated levels");
+          m.def_off = (long long)(base + 4);
+          m.def_len = nb;
+          cursor = 4 + (size_t)nb;
+        }
+        m.val_off = (long long)(base + cursor);
+        m.val_len = (long long)(dlen - cursor);
+        pages.push_back(m);
+        seen += n;
+        continue;
+      }
+      if (ptype == PAGE_DATA_V2) {
+        auto* dh = get(ph, PH_DATA_V2);
+        if (!dh) throw std::runtime_error("v2 page without header");
+        int64_t n = i_of(*dh, DP2_NUM_VALUES, 0);
+        int64_t def_bytes = i_of(*dh, DP2_DEF_BYTES, 0);
+        int64_t rep_bytes = i_of(*dh, DP2_REP_BYTES, 0);
+        auto* icf = get(*dh, DP2_IS_COMPRESSED);
+        bool is_comp = icf ? icf->b : true;
+        if (rep_bytes != 0)
+          throw std::runtime_error("v2: rep levels on a flat column");
+        if (def_bytes < 0 || def_bytes > comp)
+          throw std::runtime_error("v2: bad level bytes");
+        pqd_page_meta_t m{};
+        m.ptype = 0;
+        m.encoding = (int)i_of(*dh, DP2_ENCODING, ENC_PLAIN);
+        m.num_values = n;
+        if (leaf.max_def > 0 && def_bytes > 0) {
+          // v2 levels ride uncompressed ahead of the value section,
+          // with no u32 prefix
+          m.def_off = (long long)blob.size();
+          m.def_len = def_bytes;
+          blob.insert(blob.end(), payload, payload + def_bytes);
+        }
+        const uint8_t* vsrc = payload + def_bytes;
+        size_t vcomp = (size_t)(comp - def_bytes);
+        size_t vuncomp = (size_t)(uncomp - def_bytes);
+        std::vector<uint8_t> dbuf;
+        const uint8_t* data;
+        size_t dlen;
+        if (is_comp) {
+          dec.decompress(vsrc, vcomp, vuncomp, dbuf, data, dlen);
+        } else {
+          data = vsrc;
+          dlen = vcomp;
+        }
+        m.val_off = (long long)blob.size();
+        m.val_len = (long long)dlen;
+        blob.insert(blob.end(), data, data + dlen);
+        pages.push_back(m);
+        seen += n;
+        continue;
+      }
+      // index / unknown pages: payload already skipped
+    }
+
+    *blob_bytes = (long long)blob.size();
+    *blob_out = (uint8_t*)malloc(blob.size() ? blob.size() : 1);
+    if (!blob.empty()) memcpy(*blob_out, blob.data(), blob.size());
+    *n_pages_out = (long long)pages.size();
+    size_t pb = pages.size() * sizeof(pqd_page_meta_t);
+    *pages_out = (pqd_page_meta_t*)malloc(pb ? pb : 1);
+    if (!pages.empty()) memcpy(*pages_out, pages.data(), pb);
+    return 0;
+  } catch (std::exception& e) {
+    if (err_out) *err_out = strdup(e.what());
+    return -1;
+  }
+}
+
 void pqd_free(void* p) { free(p); }
 void pqd_close(void* hp) { delete (decode_handle*)hp; }
 

@@ -1,0 +1,456 @@
+"""Device-side Parquet decode stage 1 (round-4 verdict next #4).
+
+The host tier (native/parquet_decode.cpp) decodes pages fully on host and
+ships FULL-WIDTH columns over the link; at the tunnel's 0.1-0.2 GB/s that
+transfer dominates lineitem-shaped reads. This tier inverts the split the
+way the reference's GPU decode does (SURVEY §7 phase 3 item 11; the
+reference ships nvcomp in its jar because it treats decode bandwidth as
+accelerator work): the host only parses page headers and decompresses
+(pqd_extract_pages), the ENCODED page bytes ship to the device once, and
+the decode itself runs as XLA ops:
+
+- **RLE/bit-packed hybrid expansion** (def levels + dictionary indices):
+  run headers are walked on host (a few bytes per run — metadata, not
+  data); expansion is branch-free device algebra — per-entry run lookup
+  via searchsorted, bit extraction via a 5-byte gather window, shift,
+  mask. No scans, no loops.
+- **PLAIN fixed-width reinterpret**: byte-gather + shift assembly into
+  i32/i64/u64 lanes (FLOAT64 column storage IS u64 bit patterns, so a
+  DOUBLE column needs zero numeric conversion).
+- **Dictionary gather**: expanded indices -> jnp.take over the device
+  dictionary; BYTE_ARRAY dictionaries gather flat string bytes with the
+  segment-element pattern (one output-sizing sync).
+- **Null scatter**: validity = def == max_def; dense values scatter to
+  row slots via cumsum positions.
+
+Coverage (everything else falls back to the host tier per column, keyed
+off the page inventory): flat columns; PLAIN fixed-width (INT32/INT64/
+FLOAT/DOUBLE/BOOLEAN), PLAIN_DICTIONARY/RLE_DICTIONARY over fixed-width
+or BYTE_ARRAY dictionaries; v1 + v2 data pages; any codec the native
+tier decompresses. Validated against pyarrow + the host tier in
+tests/test_parquet_device_decode.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.dtype import TypeId
+from ..utils.shapes import bucket_size
+
+_ENC_PLAIN, _ENC_PLAIN_DICT, _ENC_RLE, _ENC_RLE_DICT = 0, 2, 3, 8
+
+# parquet physical types (mirrors reader.py)
+_PT_BOOLEAN, _PT_INT32, _PT_INT64, _PT_INT96 = 0, 1, 2, 3
+_PT_FLOAT, _PT_DOUBLE, _PT_BYTE_ARRAY, _PT_FLBA = 4, 5, 6, 7
+
+
+class _PageMeta(ctypes.Structure):
+    _fields_ = [
+        ("ptype", ctypes.c_int),
+        ("encoding", ctypes.c_int),
+        ("num_values", ctypes.c_longlong),
+        ("def_off", ctypes.c_longlong),
+        ("def_len", ctypes.c_longlong),
+        ("val_off", ctypes.c_longlong),
+        ("val_len", ctypes.c_longlong),
+    ]
+
+
+@dataclass
+class _Page:
+    ptype: int
+    encoding: int
+    num_values: int
+    def_off: int
+    def_len: int
+    val_off: int
+    val_len: int
+
+
+def extract_pages(lib, handle, rg: int, leaf_idx: int,
+                  chunk: np.ndarray) -> Tuple[np.ndarray, List[_Page]]:
+    """Host step: page headers + decompression only. Returns the
+    decompressed page blob and per-page metadata."""
+    c = ctypes
+    blob_p = c.POINTER(c.c_uint8)()
+    blob_len = c.c_longlong()
+    pages_p = c.POINTER(_PageMeta)()
+    n_pages = c.c_longlong()
+    err = c.c_char_p()
+    if chunk.size == 0:
+        chunk = np.zeros(1, dtype=np.uint8)
+    rc = lib.pqd_extract_pages(
+        handle, rg, leaf_idx,
+        chunk.ctypes.data_as(c.POINTER(c.c_uint8)), len(chunk),
+        c.byref(blob_p), c.byref(blob_len), c.byref(pages_p),
+        c.byref(n_pages), c.byref(err))
+    if rc != 0:
+        msg = err.value.decode() if err.value else "unknown"
+        lib.pqd_free(err)
+        raise RuntimeError(f"extract_pages failed: {msg}")
+    try:
+        blob = (np.ctypeslib.as_array(blob_p, shape=(blob_len.value,)).copy()
+                if blob_len.value else np.zeros(0, np.uint8))
+        pages = [
+            _Page(p.ptype, p.encoding, p.num_values, p.def_off, p.def_len,
+                  p.val_off, p.val_len)
+            for p in (pages_p[i] for i in range(n_pages.value))]
+    finally:
+        lib.pqd_free(blob_p)
+        lib.pqd_free(pages_p)
+    return blob, pages
+
+
+# ---------------------------------------------------------------------------
+# RLE-hybrid: host run walk + device expansion
+# ---------------------------------------------------------------------------
+
+def _walk_runs(blob: np.ndarray, off: int, length: int, n: int,
+               bit_width: int):
+    """Parse run headers of one hybrid section (touches a few bytes per
+    run). Returns (kinds, counts, values, bit_starts) numpy arrays.
+
+    The walk runs to the END of the section, not to ``n`` entries: a
+    dictionary-index stream holds only the STORED (non-null) entries — a
+    data-dependent count the host never needs to know. Expansion output
+    length stays ``n`` (an upper bound); positions past the real tail
+    hold padding the null scatter never selects."""
+    kinds, counts, values, bit_starts = [], [], [], []
+    pos, end, produced = off, off + length, 0
+    while pos < end and produced < n:
+        header = 0
+        shift = 0
+        while True:
+            if pos >= end:
+                raise ValueError("rle: truncated varint")
+            b = int(blob[pos])
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+            if shift > 63:
+                raise ValueError("rle: varint overflow")
+        if header & 1:
+            groups = header >> 1
+            count = groups * 8
+            kinds.append(1)
+            counts.append(count)
+            values.append(0)
+            bit_starts.append(pos * 8)
+            pos += groups * bit_width  # final run may pad past the tail;
+            pos = min(pos, end)        # the device byte gather clips
+        else:
+            count = header >> 1
+            if count <= 0:
+                raise ValueError("rle: zero-length run")
+            nbytes = (bit_width + 7) // 8
+            if pos + nbytes > end:
+                raise ValueError("rle: truncated run value")
+            v = 0
+            for i in range(nbytes):
+                v |= int(blob[pos + i]) << (8 * i)
+            pos += nbytes
+            kinds.append(0)
+            counts.append(count)
+            values.append(v)
+            bit_starts.append(0)
+        produced += count
+    if not kinds:  # empty section (all-null page): one zero run
+        kinds, counts, values, bit_starts = [0], [max(1, n)], [0], [0]
+    return (np.asarray(kinds, np.int32), np.asarray(counts, np.int64),
+            np.asarray(values, np.int32), np.asarray(bit_starts, np.int64))
+
+
+def _expand_runs(blob_dev, kinds, counts, values, bit_starts, n: int,
+                 bit_width: int):
+    """Device expansion of one hybrid section to int32[n] — pure gather
+    algebra, no loops. Run arrays are padded to a bucketed length so the
+    compiled program is reused across pages."""
+    n_runs = kinds.shape[0]
+    nb = bucket_size(max(1, n_runs), floor=8)
+    pad = nb - n_runs
+    if pad:
+        kinds = np.pad(kinds, (0, pad))
+        counts = np.pad(counts, (0, pad))
+        values = np.pad(values, (0, pad))
+        bit_starts = np.pad(bit_starts, (0, pad))
+    out_starts = np.zeros(nb, np.int64)
+    np.cumsum(counts[:-1], out=out_starts[1:])
+    return _expand_runs_jit(blob_dev, jnp.asarray(kinds),
+                            jnp.asarray(values),
+                            jnp.asarray(bit_starts),
+                            jnp.asarray(out_starts), n, bit_width)
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def _expand_runs_jit(blob, kinds, values, bit_starts, out_starts, n: int,
+                     bit_width: int):
+    idx = jnp.arange(n, dtype=jnp.int64)
+    rid = jnp.searchsorted(out_starts, idx, side="right") - 1
+    within = idx - jnp.take(out_starts, rid)
+    # literal (bit-packed) entries: 5-byte window covers any alignment of
+    # bit_width <= 32
+    bitpos = jnp.take(bit_starts, rid) + within * bit_width
+    byte0 = bitpos >> 3
+    sh = (bitpos & 7).astype(jnp.uint32)
+    nbytes = blob.shape[0]
+    word = jnp.zeros(n, dtype=jnp.uint64)
+    for b in range(5):
+        byte = jnp.clip(byte0 + b, 0, max(0, nbytes - 1))
+        word = word | (jnp.take(blob, byte).astype(jnp.uint64)
+                       << jnp.uint64(8 * b))
+    lit = ((word >> sh.astype(jnp.uint64))
+           & jnp.uint64((1 << bit_width) - 1)).astype(jnp.int32)
+    rle = jnp.take(values, rid)
+    return jnp.where(jnp.take(kinds, rid) == 1, lit, rle)
+
+
+# ---------------------------------------------------------------------------
+# PLAIN fixed-width assembly
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _plain_fixed_jit(blob, off, n: int, elem_size: int):
+    idx = jnp.arange(n, dtype=jnp.int64) * elem_size + off
+    out = jnp.zeros(n, dtype=jnp.uint64)
+    for b in range(elem_size):
+        out = out | (jnp.take(blob, idx + b).astype(jnp.uint64)
+                     << jnp.uint64(8 * b))
+    return out
+
+
+def _plain_fixed(blob, off: int, n: int, elem_size: int):
+    """Reinterpret n little-endian elem_size-byte values from the blob as
+    uint64 lanes. ``off`` is traced (every page sits at a different blob
+    offset — a static offset would compile one program per page)."""
+    return _plain_fixed_jit(blob, jnp.int64(off), n, elem_size)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _plain_bool_jit(blob, off, n: int):
+    idx = jnp.arange(n, dtype=jnp.int64)
+    byte = jnp.take(blob, off + (idx >> 3))
+    return ((byte >> (idx & 7).astype(jnp.uint8)) & 1).astype(jnp.uint64)
+
+
+def _plain_bool(blob, off: int, n: int):
+    return _plain_bool_jit(blob, jnp.int64(off), n)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _scatter_nulls(dense, defs, max_def: int, n: int):
+    """Spread dense (non-null-only) values into row slots; nulls get 0."""
+    valid = defs == max_def
+    posn = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    m = dense.shape[0]
+    safe = jnp.clip(posn, 0, max(0, m - 1))
+    vals = jnp.where(valid, jnp.take(dense, safe),
+                     jnp.zeros((), dense.dtype))
+    return vals, valid
+
+
+# ---------------------------------------------------------------------------
+# leaf orchestration
+# ---------------------------------------------------------------------------
+
+_SUPPORTED_PHYS = {_PT_BOOLEAN, _PT_INT32, _PT_INT64, _PT_FLOAT, _PT_DOUBLE}
+_ELEM_SIZE = {_PT_INT32: 4, _PT_INT64: 8, _PT_FLOAT: 4, _PT_DOUBLE: 8,
+              _PT_BOOLEAN: 1}
+
+
+def pages_supported(leaf, pages: List[_Page]) -> bool:
+    """Can this chunk's page inventory run on the device tier?"""
+    if leaf.max_rep != 0:
+        return False
+    has_dict = any(p.ptype == 2 for p in pages)
+    has_dict_data = any(p.ptype != 2 and p.encoding in
+                        (_ENC_PLAIN_DICT, _ENC_RLE_DICT) for p in pages)
+    has_plain_data = any(p.ptype != 2 and p.encoding == _ENC_PLAIN
+                         for p in pages)
+    if has_dict_data and has_plain_data:
+        # dictionary-fallback chunks (writer hit the dict-size cap
+        # mid-chunk and switched to PLAIN) mix index pages and value
+        # pages; the device assembly handles one stream kind per chunk —
+        # host tier decodes these
+        return False
+    for p in pages:
+        if p.ptype == 2:
+            if p.encoding not in (_ENC_PLAIN, _ENC_PLAIN_DICT):
+                return False
+            continue
+        if p.encoding in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+            if not has_dict:
+                return False
+            continue
+        if p.encoding == _ENC_PLAIN:
+            if leaf.physical not in _SUPPORTED_PHYS:
+                return False  # PLAIN BYTE_ARRAY: variable stride -> host
+            continue
+        return False
+    if leaf.physical == _PT_BYTE_ARRAY and not has_dict:
+        return False
+    if leaf.physical in (_PT_INT96, _PT_FLBA):
+        return False
+    if leaf.dtype.id is TypeId.DECIMAL128:
+        return False
+    return True
+
+
+def _decode_dictionary(leaf, blob: np.ndarray, blob_dev, page: _Page):
+    """Dictionary values: fixed-width dicts assemble on device from the
+    already-shipped blob; a BYTE_ARRAY dict (small by construction)
+    parses its length-prefixed layout on host and ships flat bytes +
+    offsets."""
+    nd = page.num_values
+    if leaf.physical == _PT_BYTE_ARRAY:
+        offs = np.zeros(nd + 1, np.int64)
+        pos = page.val_off
+        parts = []
+        for i in range(nd):
+            ln = int(np.frombuffer(blob[pos:pos + 4].tobytes(),
+                                   np.uint32)[0])
+            pos += 4
+            parts.append(blob[pos:pos + ln])
+            pos += ln
+            offs[i + 1] = offs[i] + ln
+        flat = (np.concatenate(parts) if parts
+                else np.zeros(0, np.uint8))
+        return ("bytes", jnp.asarray(flat),
+                jnp.asarray(offs.astype(np.int32)))
+    es = _ELEM_SIZE[leaf.physical]
+    if leaf.physical == _PT_BOOLEAN:
+        vals = _plain_bool(blob_dev, page.val_off, nd)
+    else:
+        vals = _plain_fixed(blob_dev, page.val_off, nd, es)
+    return ("fixed", vals, None)
+
+
+def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
+                       rows: int) -> Column:
+    """Full device decode of one flat column chunk. ``blob`` ships to the
+    device once; everything after is XLA (plus the one string-sizing
+    sync for BYTE_ARRAY dictionary outputs)."""
+    blob_dev = jnp.asarray(blob)  # the ONE host->device data transfer
+    dictionary = None
+    val_parts: List[jnp.ndarray] = []
+    def_parts: List[jnp.ndarray] = []
+    idx_parts: List[jnp.ndarray] = []  # dict-index pages
+    any_dict_data = False
+
+    for p in pages:
+        if p.ptype == 2:
+            dictionary = _decode_dictionary(leaf, blob, blob_dev, p)
+            continue
+        n = p.num_values
+        if leaf.max_def > 0 and p.def_len > 0:
+            bw = max(1, (leaf.max_def).bit_length())
+            runs = _walk_runs(blob, p.def_off, p.def_len, n, bw)
+            defs = _expand_runs(blob_dev, *runs, n, bw)
+        else:
+            defs = jnp.zeros(n, jnp.int32)
+        def_parts.append(defs)
+        # stored (non-null-only) entries align PER PAGE: each page's value
+        # stream restarts its dense numbering, so the null scatter runs on
+        # the page's own defs before concatenation
+        if p.encoding in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+            any_dict_data = True
+            ibw = int(blob[p.val_off])
+            if ibw == 0:
+                idx_parts.append(jnp.zeros(n, jnp.int32))
+                continue
+            runs = _walk_runs(blob, p.val_off + 1, p.val_len - 1, n, ibw)
+            # expansion is padded past the (data-dependent) stored count;
+            # padded entries are never selected by the scatter below
+            dense_idx = _expand_runs(blob_dev, *runs, n, ibw)
+            idx_rows, _ = _scatter_nulls(dense_idx, defs, leaf.max_def, n)
+            idx_parts.append(idx_rows)
+        else:
+            es = _ELEM_SIZE[leaf.physical]
+            if leaf.physical == _PT_BOOLEAN:
+                # bit-packed bools: the stored count is data-dependent
+                # (valid rows only); expand n bits — the scatter never
+                # selects past the real tail
+                dense = _plain_bool(blob_dev, p.val_off, n)
+            else:
+                # stored varies with the page's null count; BUCKET the
+                # assembly length so varying null densities reuse one
+                # compiled program (~0.9 s per fresh program on the
+                # tunnel) — the scatter reads only the valid prefix
+                stored = p.val_len // es
+                dense = _plain_fixed(blob_dev, p.val_off,
+                                     bucket_size(max(1, stored), floor=8),
+                                     es)
+            vals, _ = _scatter_nulls(dense, defs, leaf.max_def, n)
+            val_parts.append(vals)
+
+    defs_all = jnp.concatenate(def_parts) if def_parts else \
+        jnp.zeros(0, jnp.int32)
+    validity = defs_all == leaf.max_def if leaf.max_def > 0 else None
+
+    if any_dict_data:
+        idx_rows = jnp.concatenate(idx_parts)  # row-aligned per page
+        kind, payload, offs = dictionary
+        if kind == "fixed":
+            if payload.shape[0] == 0:  # all-null column: empty dictionary
+                data = jnp.zeros(idx_rows.shape, payload.dtype)
+            else:
+                data = jnp.take(payload, jnp.clip(idx_rows, 0,
+                                                  payload.shape[0] - 1))
+            return _finish_fixed(leaf, rows, data, validity)
+        return _finish_string_dict(leaf, rows, idx_rows, payload, offs,
+                                   validity)
+
+    data = (jnp.concatenate(val_parts) if val_parts
+            else jnp.zeros(0, jnp.uint64))
+    return _finish_fixed(leaf, rows, data, validity)
+
+
+def _finish_fixed(leaf, rows: int, lanes: jnp.ndarray,
+                  validity) -> Column:
+    """uint64 lanes (or int32 dict indices gathered to uint64 lanes) ->
+    typed Column. FLOAT64 keeps raw bit patterns (storage invariant)."""
+    d = leaf.dtype
+    lanes = lanes.astype(jnp.uint64)
+    if d.id is TypeId.FLOAT64:
+        data = lanes  # bit-pattern storage: zero conversion
+    elif d.id is TypeId.FLOAT32:
+        data = jax.lax.bitcast_convert_type(
+            lanes.astype(jnp.uint32), jnp.float32)
+    elif d.id is TypeId.BOOL8:
+        data = lanes.astype(jnp.bool_)
+    else:
+        # sign-correct narrowing: i32-width sources sign-extend via int32
+        if leaf.physical == _PT_INT32:
+            lanes = lanes.astype(jnp.uint32).astype(jnp.int32)
+        data = lanes.astype(d.jnp_dtype)
+    return Column(d, rows, data=data, validity=validity)
+
+
+def _finish_string_dict(leaf, rows: int, idx, flat, offs,
+                        validity) -> Column:
+    """STRING column from dictionary gather: per-row (start, length)
+    spans from the dict offsets, then the shared gather_spans path (one
+    output-sizing sync)."""
+    from ..columnar.strings import gather_spans
+    lens_d = offs[1:] - offs[:-1]
+    nd = lens_d.shape[0]
+    if nd == 0:  # all-null column: empty dictionary
+        return Column(dt.STRING, rows, data=jnp.zeros((0,), jnp.uint8),
+                      validity=validity,
+                      offsets=jnp.zeros(rows + 1, jnp.int32))
+    safe_idx = jnp.clip(idx, 0, max(0, nd - 1))
+    return gather_spans(flat, jnp.take(offs[:-1], safe_idx),
+                        jnp.take(lens_d, safe_idx), validity)
