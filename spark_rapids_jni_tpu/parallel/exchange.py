@@ -266,6 +266,31 @@ def _cap_bucket(cap: int) -> int:
     return pad_width(cap, 16)
 
 
+def _exchange_plan(counts_mat: np.ndarray, nd: int):
+    """Dense-vs-ragged selection from the destination-count matrix.
+
+    Dense: ONE all_to_all where every src->dst pair pays the GLOBAL max
+    slot count (grid rows = nd * cap). Ragged: nd-1 sequential ppermute
+    rounds where round r (traffic s -> (s+r) % nd) pays only that round's
+    own max (grid rows = sum(caps)) — one hot pair inflates one round,
+    not the whole grid. Ragged is chosen on a >= 2x grid/wire saving:
+    the round-count overhead (nd-1 collective dispatches vs 1) must be
+    bought back by moved bytes, and at near-uniform traffic sum(caps)
+    ~= nd * cap so dense always wins. Scale behavior at nd in {8, 16,
+    32} is pinned by tests/test_exchange_scale.py; the crossover
+    accounting lives in ARCHITECTURE.md.
+
+    Returns (ragged, cap, caps): global cap and the per-round capacity
+    tuple (both bucketed — _cap_bucket keys the program cache)."""
+    cap = _cap_bucket(int(counts_mat.max(initial=0)))
+    src = np.arange(nd)
+    caps = tuple(
+        _cap_bucket(int(counts_mat[src, (src + r) % nd].max(initial=0)))
+        for r in range(nd))
+    ragged = sum(caps) * 2 <= nd * cap  # >= 2x grid/wire saving
+    return ragged, cap, caps
+
+
 def _exchange_program(mesh: Mesh, per_dev: int, cap: int, nd: int,
                       shapes: Tuple) -> "jax.stages.Wrapped":
     axis = _mesh_axis(mesh)
@@ -415,12 +440,7 @@ def hash_partition_exchange(
     # collective instead of nd-1) when traffic is near-uniform.
     counts_mat = _host_global(
         _counts_program(mesh, per_dev, nd)(dest_d, live_d)).reshape(nd, nd)
-    cap = _cap_bucket(int(counts_mat.max(initial=0)))
-    src = np.arange(nd)
-    caps = tuple(
-        _cap_bucket(int(counts_mat[src, (src + r) % nd].max(initial=0)))
-        for r in range(nd))
-    ragged = sum(caps) * 2 <= nd * cap  # >= 2x grid/wire saving
+    ragged, cap, caps = _exchange_plan(counts_mat, nd)
 
     buffers: List[jnp.ndarray] = []
     metas = []

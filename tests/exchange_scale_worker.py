@@ -1,0 +1,99 @@
+"""Subprocess worker for exchange scale tests (tests/test_exchange_scale.py).
+
+Runs hash_partition_exchange on an nd-device virtual CPU mesh (nd passed
+as argv[1]; the parent sets XLA_FLAGS for the device count) across three
+traffic shapes — uniform, one hot pair, all-to-one — and prints one JSON
+line: per-scenario plan choice (ragged/dense), grid rows, and correctness
+(every row lands on its destination partition, nothing lost).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_jni_tpu.columnar import dtype as dt  # noqa: E402
+from spark_rapids_jni_tpu.columnar.column import Column, Table  # noqa: E402
+from spark_rapids_jni_tpu.parallel import exchange as ex  # noqa: E402
+
+
+def _scenario_dest(name: str, n: int, nd: int, rng) -> np.ndarray:
+    if name == "uniform":
+        return rng.integers(0, nd, n)
+    if name == "hot_pair":
+        # 90% of device 0's rows all target partition 1; everything else
+        # spreads thinly — exactly one (src, dst) pair dominates
+        per_dev = -(-n // nd)
+        dest = rng.integers(0, nd, n)
+        hot = np.arange(min(per_dev, n))
+        take = hot[: int(len(hot) * 0.9)]
+        dest[take] = 1
+        return dest
+    if name == "all_to_one":
+        return np.zeros(n, dtype=np.int64)
+    raise ValueError(name)
+
+
+def main() -> int:
+    nd = int(sys.argv[1])
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    devs = jax.devices()
+    assert len(devs) >= nd, f"need {nd} devices, have {len(devs)}"
+    mesh = Mesh(np.array(devs[:nd]), axis_names=("shuffle",))
+    rng = np.random.default_rng(11)
+
+    plans = {}
+    orig_plan = ex._exchange_plan
+
+    def spy_plan(counts_mat, nd_):
+        ragged, cap, caps = orig_plan(counts_mat, nd_)
+        plans["last"] = {"ragged": bool(ragged), "cap": int(cap),
+                         "dense_grid": int(nd_ * cap),
+                         "ragged_grid": int(sum(caps))}
+        return ragged, cap, caps
+
+    ex._exchange_plan = spy_plan
+
+    out = {"nd": nd, "scenarios": {}}
+    for name in ("uniform", "hot_pair", "all_to_one"):
+        dest = _scenario_dest(name, n, nd, rng)
+        keys = rng.integers(0, 1 << 30, n)
+        t = Table((Column.from_numpy(keys, dt.INT64),
+                   Column.from_numpy(np.arange(n, dtype=np.int64),
+                                     dt.INT64)))
+        parts = ex.hash_partition_exchange(t, [0], mesh,
+                                           dest=jnp.asarray(dest))
+        got_rows = 0
+        routed_ok = True
+        seen = []
+        for p, part in enumerate(parts):
+            ids = np.asarray(part.columns[1].data)
+            got_rows += len(ids)
+            seen.append(ids)
+            if not np.all(dest[ids] == p):
+                routed_ok = False
+        all_ids = np.sort(np.concatenate(seen)) if seen else np.array([])
+        out["scenarios"][name] = {
+            **plans["last"],
+            "rows_in": n,
+            "rows_out": int(got_rows),
+            "routed_ok": bool(routed_ok),
+            "ids_exact": bool(np.array_equal(all_ids, np.arange(n))),
+        }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

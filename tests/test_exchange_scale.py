@@ -1,0 +1,106 @@
+"""Exchange skew/scale behavior beyond 8 devices (round-4 verdict next #6).
+
+Two layers:
+
+- `_exchange_plan` is a pure function of the counts matrix, so the
+  ragged-vs-dense selection and its grid accounting are pinned directly
+  at nd in {8, 16, 32, 64} with no devices at all.
+- Real execution at nd in {16, 32} runs in a subprocess with its own
+  `--xla_force_host_platform_device_count` (the suite's conftest pins 8
+  for everything else), asserting plan choice, routing, and row
+  preservation per traffic shape (uniform / one hot pair / all-to-one).
+
+The crossover note (nd-1 ppermute rounds vs one all_to_all, and why
+all-to-one traffic stays dense) lives in ARCHITECTURE.md.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.parallel.exchange import _cap_bucket, _exchange_plan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# plan-level (pure, deviceless)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nd", [8, 16, 32, 64])
+def test_uniform_traffic_stays_dense(nd):
+    counts = np.full((nd, nd), 100)
+    ragged, cap, caps = _exchange_plan(counts, nd)
+    assert not ragged
+    assert cap == _cap_bucket(100) and all(c == cap for c in caps)
+
+
+@pytest.mark.parametrize("nd", [8, 16, 32, 64])
+def test_one_hot_pair_goes_ragged(nd):
+    counts = np.full((nd, nd), 10)
+    counts[0, 1] = 100_000  # one src->dst pair dominates
+    ragged, cap, caps = _exchange_plan(counts, nd)
+    assert ragged
+    # the hot pair inflates exactly one round; the saving grows with nd
+    assert sum(caps) <= nd * cap / 2
+    assert sorted(caps)[-1] == _cap_bucket(100_000)
+    assert sorted(caps)[-2] == _cap_bucket(10)
+
+
+@pytest.mark.parametrize("nd", [8, 16, 32])
+def test_all_to_one_stays_dense(nd):
+    # every source sends its full slice to partition 0: EVERY round has
+    # one full-size pair, so per-round caps equal the global cap and
+    # ragged's nd-1 rounds would buy nothing
+    counts = np.zeros((nd, nd), dtype=np.int64)
+    counts[:, 0] = 5000
+    ragged, cap, caps = _exchange_plan(counts, nd)
+    assert not ragged
+    assert all(c == cap for c in caps)
+
+
+def test_skew_threshold_is_2x():
+    nd = 8
+    counts = np.full((nd, nd), 64)  # bucketed cap 64 on every round
+    ragged, cap, caps = _exchange_plan(counts, nd)
+    assert not ragged and sum(caps) == nd * cap
+    # shrink all but one round under the bucket floor: saving crosses 2x
+    counts[:] = 1
+    counts[0, 1] = 64
+    ragged, cap, caps = _exchange_plan(counts, nd)
+    assert ragged
+    assert sum(caps) == _cap_bucket(64) + (nd - 1) * _cap_bucket(1)
+
+
+# ---------------------------------------------------------------------------
+# execution-level at nd = 16 / 32 (subprocess with its own device count)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nd", [16, 32])
+def test_exchange_executes_at_scale(nd):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={nd}",
+               PYTHONPATH=REPO)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "exchange_scale_worker.py"),
+         str(nd)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["nd"] == nd
+    sc = rec["scenarios"]
+    for name, s in sc.items():
+        assert s["rows_out"] == s["rows_in"], (name, s)
+        assert s["routed_ok"] and s["ids_exact"], (name, s)
+    assert not sc["uniform"]["ragged"], sc["uniform"]
+    assert sc["hot_pair"]["ragged"], sc["hot_pair"]
+    assert sc["hot_pair"]["ragged_grid"] * 2 \
+        <= sc["hot_pair"]["dense_grid"], sc["hot_pair"]
+    assert not sc["all_to_one"]["ragged"], sc["all_to_one"]
