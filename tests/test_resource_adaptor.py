@@ -599,3 +599,47 @@ def test_reentrant_associate_thread(adaptor):
         t.do(RmmSpark.task_done, 4).result()
     finally:
         t.stop()
+
+
+def test_engine_exception_inside_governed_bracket(adaptor):
+    """testCudfException adaptor-path counterpart (RmmSparkTest.java —
+    engine exceptions classified distinctly from OOMs): a non-OOM engine
+    error injected INSIDE a governed reservation bracket must surface as
+    the engine-exception class (not MemoryError), release the bracket's
+    reservation on unwind, leave the thread RUNNING, and count ZERO
+    retry/split metrics — then the task keeps working."""
+    from spark_rapids_jni_tpu.memory.reservation import device_reservation
+
+    t = TaskThread("t1")
+    try:
+        t.do(RmmSpark.current_thread_is_dedicated_to_task, 77).result()
+        base_used = RmmSpark.pool_used()
+
+        def governed_op():
+            # the injected exception fires at the bracket's reserve step
+            with device_reservation(8 * MB):
+                raise AssertionError("bracket body must not run")
+
+        RmmSpark.force_exception(t.tid, num=1)
+        with pytest.raises(RetryStateException):
+            t.do(governed_op).result()
+        # classified distinctly from OOM:
+        assert not issubclass(RetryStateException, MemoryError)
+        # bracket unwound: nothing left reserved, thread back to RUNNING
+        assert RmmSpark.pool_used() == base_used
+        assert RmmSpark.get_state_of(t.tid) == ThreadState.RUNNING
+        # engine errors are NOT retries: metrics stay zero
+        assert RmmSpark.get_and_reset_num_retry(77) == 0
+        assert RmmSpark.get_and_reset_num_split_retry(77) == 0
+
+        # the task continues: a real governed bracket now succeeds
+        def working_op():
+            with device_reservation(8 * MB) as took:
+                assert took
+                return RmmSpark.pool_used()
+
+        assert t.do(working_op).result() >= base_used + 8 * MB
+        assert RmmSpark.pool_used() == base_used
+        t.do(RmmSpark.task_done, 77).result()
+    finally:
+        t.stop()
