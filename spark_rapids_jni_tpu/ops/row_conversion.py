@@ -43,7 +43,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
-from ..columnar.strings import pad_width, padded_bytes
+from ..columnar.strings import densify_offsets, pad_width, padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from ..utils.tracing import func_range
 
@@ -230,11 +230,20 @@ def _words_to_u8(words: jnp.ndarray) -> jnp.ndarray:
     return b.reshape(words.shape[0], words.shape[1] * 4)
 
 
-def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
+def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int,
+                      pad_blowup: Optional[int] = None) -> List[int]:
     """Split rows into batches whose total size fits an int32-offset column
     (build_batches, row_conversion.cu:1458). Returns boundary row indices
     [0, ..., num_rows]. Greedy fill via cumsum + searchsorted — a handful of
-    host ops per *batch*, not per row."""
+    host ops per *batch*, not per row.
+
+    ``pad_blowup`` (round-5 skew guard) additionally caps each batch's
+    PADDED matrix footprint: rows densify to [n_b, bucket(max_row_b)], so
+    one jumbo row inside a batch of small rows inflates the whole batch
+    matrix. When (b - s) * bucket(max) exceeds pad_blowup * batch_bytes +
+    a fixed floor, the batch is cut just before its largest row — the
+    jumbo row lands in a (near-)singleton batch whose matrix is its own
+    size, and the small rows keep a tight width."""
     n = len(row_sizes)
     if n == 0:
         return [0, 0]
@@ -242,11 +251,21 @@ def _batch_boundaries(row_sizes: np.ndarray, max_batch_bytes: int) -> List[int]:
     np.cumsum(row_sizes, out=cum[1:])
     bounds = [0]
     while bounds[-1] < n:
-        b = int(np.searchsorted(cum, cum[bounds[-1]] + max_batch_bytes,
+        s = bounds[-1]
+        b = int(np.searchsorted(cum, cum[s] + max_batch_bytes,
                                 side="right")) - 1
-        if b == bounds[-1]:
+        if b == s:
             b += 1  # a single row larger than the cap gets its own batch
-        bounds.append(min(b, n))
+        b = min(b, n)
+        if pad_blowup is not None:
+            while b > s + 1:
+                w = _round_up(int(row_sizes[s:b].max()), 16)
+                if (b - s) * w <= pad_blowup * int(cum[b] - cum[s]) \
+                        + _MAT_BYTES_FLOOR:
+                    break
+                am = s + int(np.argmax(row_sizes[s:b]))
+                b = am if am > s else s + 1
+        bounds.append(b)
     return bounds
 
 
@@ -275,6 +294,12 @@ def _blob_bucket(total: int) -> int:
 # blob-proportional.
 _ROWMAT_MAX_BLOWUP = 8
 _ROWMAT_MAX_ROW_PAD = 4096
+# Column-matrix blowup guard (round-5): padded_bytes pads a string column
+# to its GLOBAL max length, so one jumbo string would inflate [n, W] for
+# every row on BOTH assembly paths. Beyond blowup x blob bytes + this
+# floor, densification goes per batch with batch-local widths (and batch
+# boundaries isolate jumbo rows — _batch_boundaries pad_blowup).
+_MAT_BYTES_FLOOR = 64 << 20
 
 
 @partial(jax.jit, static_argnames=("spr", "row_pad", "padded_words"))
@@ -449,11 +474,14 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     if n == 0:
         return [_rows_column(jnp.zeros((0,), jnp.uint8),
                              np.zeros(1, dtype=np.int64))]
-    # densify once, reuse everywhere: padded_bytes memoizes (matrix,
-    # device lengths) on the column, so repeat conversions (and any prior
-    # sort/groupby on the same key) pay no fresh host-offset upload
-    padded = [padded_bytes(c) for c in string_cols]
-    lengths = jnp.stack([lens for _, lens in padded], axis=1)  # [n, nsc]
+    # Lengths come straight from the offset runs (no padding needed);
+    # whether the columns ALSO densify globally (memoized, reused by
+    # sort/groupby) or per batch is decided below by the column-matrix
+    # blowup guard.
+    lens_cols = [jnp.asarray(c.offsets, dtype=jnp.int32)[1:]
+                 - jnp.asarray(c.offsets, dtype=jnp.int32)[:-1]
+                 for c in string_cols]
+    lengths = jnp.stack(lens_cols, axis=1)  # [n, nsc]
     # row-relative variable offsets: exclusive scan over string columns
     var_offsets = (info.size_per_row
                    + jnp.cumsum(lengths, axis=1) - lengths)  # [n, nsc]
@@ -471,14 +499,25 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         table, info, _round_up(spr, 4), var_offsets, lengths)
     fixed = None  # byte view, materialized only if the fallback needs it
 
-    # sizing syncs just (total, max_row) — one small transfer. The full
-    # row-size array only crosses to host when the table actually spans
-    # multiple 2 GB batches (device→host runs ~0.2 GB/s on the axon tunnel,
-    # docs/TPU_PERF.md, so an 8 MB sizes array costs more than the sync it
-    # replaces on every single-batch call).
-    head = np.asarray(jnp.stack([roffs_dev[-1], jnp.max(row_sizes_dev)]))
+    # sizing syncs just (total, max_row, per-column max len) — ONE small
+    # transfer. The full row-size array only crosses to host when the
+    # table spans multiple 2 GB batches or trips the column-matrix guard
+    # (device→host runs ~0.2 GB/s on the axon tunnel, docs/TPU_PERF.md,
+    # so an 8 MB sizes array costs more than the sync it replaces on
+    # every single-batch call).
+    head = np.asarray(jnp.concatenate([
+        jnp.stack([roffs_dev[-1], jnp.max(row_sizes_dev)]),
+        jnp.max(lengths, axis=0).astype(row_sizes_dev.dtype)]))
     total_all, max_row_all = int(head[0]), int(head[1])
-    if total_all <= max_batch_bytes:
+    max_lens = [int(v) for v in head[2:]]
+    # column-matrix blowup guard: global densification pads every column
+    # to its global max — fine (and memoized for reuse) unless a jumbo
+    # string makes n x bucket(max_len) dwarf the actual blob
+    mats_global_ok = (
+        sum(n * pad_width(ml) for ml in max_lens)
+        <= _ROWMAT_MAX_BLOWUP * total_all + _MAT_BYTES_FLOOR)
+    if total_all <= max_batch_bytes and mats_global_ok:
+        padded = [padded_bytes(c) for c in string_cols]
         blob = _assemble_one_batch(
             fixed_words, fixed, padded, var_offsets,
             (row_sizes_dev // 8).astype(jnp.int32),
@@ -487,7 +526,11 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         return [_rows_column(blob, roffs_dev.astype(jnp.int32))]
 
     row_sizes_np = np.asarray(row_sizes_dev)
-    bounds = _batch_boundaries(row_sizes_np, max_batch_bytes)
+    bounds = _batch_boundaries(
+        row_sizes_np, max_batch_bytes,
+        pad_blowup=None if mats_global_ok else _ROWMAT_MAX_BLOWUP)
+    padded = [padded_bytes(c) for c in string_cols] if mats_global_ok \
+        else None
 
     out = []
     for b0, b1 in zip(bounds[:-1], bounds[1:]):
@@ -501,6 +544,23 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
             out.append(_rows_column(jnp.zeros((0,), jnp.uint8), row_offsets))
             continue
         max_row = int(sizes.max())
+        if padded is not None:
+            mats_b = tuple(mat[b0:b1] for mat, _ in padded)
+            lens_b = tuple(lens[b0:b1] for _, lens in padded)
+        else:
+            # column-matrix guard tripped: densify with BATCH-LOCAL
+            # widths (the jumbo rows sit in their own batches thanks to
+            # _batch_boundaries' pad_blowup cut, so every batch matrix
+            # stays proportional to its own bytes)
+            mats_b, lens_b = [], []
+            for c in string_cols:
+                offs_b = jnp.asarray(c.offsets, dtype=jnp.int32)[b0:b1 + 1]
+                ho = c.host_offsets()
+                ml = int((ho[b0 + 1:b1 + 1] - ho[b0:b1]).max()) if nb else 0
+                m_b, l_b = densify_offsets(c.data, offs_b, pad_width(ml))
+                mats_b.append(m_b)
+                lens_b.append(l_b)
+            mats_b, lens_b = tuple(mats_b), tuple(lens_b)
         # multiple-of-16 bucket (not pow2): the [n, row_pad] matrix is the
         # dominant allocation, and pow2 rounding nearly doubles it at e.g.
         # max_row=72; at most 256 distinct specializations below the 4K cap
@@ -511,10 +571,8 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
             row_words = jnp.asarray(sizes // 8, dtype=jnp.int32)
             word_roffs = jnp.asarray(row_offsets // 8, dtype=jnp.int32)
             blob = _assemble_blob_rowmat(
-                fixed_words[b0:b1],
-                tuple(mat[b0:b1] for mat, _ in padded),
-                tuple(lens[b0:b1] for _, lens in padded),
-                tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
+                fixed_words[b0:b1], mats_b, lens_b,
+                tuple(var_offsets[b0:b1, s] for s in range(len(mats_b))),
                 row_words, word_roffs, spr=spr, row_pad=row_pad,
                 padded_words=_blob_bucket(total) // 8)[:total]
         else:
@@ -523,10 +581,8 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
                 fixed = _words_to_u8(fixed_words)
             roffs = jnp.asarray(row_offsets, dtype=jnp.int32)
             blob = _assemble_blob(
-                fixed[b0:b1],
-                tuple(mat[b0:b1] for mat, _ in padded),
-                tuple(lens[b0:b1] for _, lens in padded),
-                tuple(var_offsets[b0:b1, s] for s in range(len(padded))),
+                fixed[b0:b1], mats_b, lens_b,
+                tuple(var_offsets[b0:b1, s] for s in range(len(mats_b))),
                 roffs, spr=spr, padded_total=_blob_bucket(total))[:total]
         out.append(_rows_column(blob, row_offsets))
     return out
