@@ -191,9 +191,45 @@ def run_capture():
         log("capture: could not commit after 10 attempts — NOT writing done "
             "marker; will retry on next healthy window")
         return False
+    # governed memory-pressure scenario LAST — bench+smoke evidence is
+    # already committed, so deliberately exhausting real HBM can at worst
+    # cost this window, not the round's evidence (round-4 verdict next #5)
+    log("capture: running ci/tpu_pressure.py (governed pressure vs real HBM)")
+    pressure_line = None
+    try:
+        p = subprocess.run([sys.executable, "ci/tpu_pressure.py"], cwd=REPO,
+                           timeout=900, capture_output=True, text=True)
+        for ln in (p.stdout or "").splitlines():
+            try:
+                j = json.loads(ln)
+                if "real_alloc_failures" in j:
+                    pressure_line = j
+            except ValueError:
+                continue
+        if pressure_line:
+            with open(os.path.join(REPO, "PRESSURE_tpu.json"), "w") as f:
+                json.dump(pressure_line, f, indent=1)
+            subprocess.run(["git", "add", "--", "PRESSURE_tpu.json"],
+                           cwd=REPO, capture_output=True)
+            subprocess.run(
+                ["git", "commit", "-m",
+                 f"On-chip governed pressure run: "
+                 f"{pressure_line.get('real_alloc_failures')} real allocator "
+                 f"failures survived, {pressure_line.get('splits')} splits, "
+                 f"clean_unwind={pressure_line.get('clean_unwind')}",
+                 "--", "PRESSURE_tpu.json"],
+                cwd=REPO, capture_output=True)
+            log(f"capture: pressure {pressure_line}")
+        else:
+            log(f"capture: pressure emitted no JSON (rc={p.returncode}); "
+                f"stderr tail: {(p.stderr or '')[-200:]}")
+    except subprocess.TimeoutExpired:
+        log("capture: tpu_pressure.py timed out (earlier evidence is safe)")
+
     with open(DONE, "w") as f:
         json.dump({"backend": backend, "time": time.strftime("%FT%T"),
-                   "bench": bench_line, "smoke": smoke_line}, f, indent=1)
+                   "bench": bench_line, "smoke": smoke_line,
+                   "pressure": pressure_line}, f, indent=1)
     return True
 
 

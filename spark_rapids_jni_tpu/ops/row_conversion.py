@@ -280,6 +280,18 @@ def _row_of_position(boundaries: jnp.ndarray, total: int) -> jnp.ndarray:
     return jnp.cumsum(marks).astype(jnp.int32)
 
 
+def _padded_seeded(col, offs_dev, max_len: int):
+    """padded_bytes with the column max ALREADY known (it rode the sizing
+    head), so densification costs no extra per-column sync; the result is
+    memoized under padded_bytes' own cache for reuse by sort/groupby."""
+    cached = getattr(col, "_padded_cache", None)
+    if cached is not None and cached[0] == 8:
+        return cached[1], cached[2]
+    mat, lens = densify_offsets(col.data, offs_dev, pad_width(max_len))
+    object.__setattr__(col, "_padded_cache", (8, mat, lens))
+    return mat, lens
+
+
 def _blob_bucket(total: int) -> int:
     """Round a blob byte length up to a compile-cache bucket (shared policy:
     next power of two with a 64 KB floor) so the jitted assembly/extraction
@@ -478,10 +490,10 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     # whether the columns ALSO densify globally (memoized, reused by
     # sort/groupby) or per batch is decided below by the column-matrix
     # blowup guard.
-    lens_cols = [jnp.asarray(c.offsets, dtype=jnp.int32)[1:]
-                 - jnp.asarray(c.offsets, dtype=jnp.int32)[:-1]
+    offs_cols = [jnp.asarray(c.offsets, dtype=jnp.int32)
                  for c in string_cols]
-    lengths = jnp.stack(lens_cols, axis=1)  # [n, nsc]
+    lengths = jnp.stack([o[1:] - o[:-1] for o in offs_cols],
+                        axis=1)  # [n, nsc]
     # row-relative variable offsets: exclusive scan over string columns
     var_offsets = (info.size_per_row
                    + jnp.cumsum(lengths, axis=1) - lengths)  # [n, nsc]
@@ -517,7 +529,8 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
         sum(n * pad_width(ml) for ml in max_lens)
         <= _ROWMAT_MAX_BLOWUP * total_all + _MAT_BYTES_FLOOR)
     if total_all <= max_batch_bytes and mats_global_ok:
-        padded = [padded_bytes(c) for c in string_cols]
+        padded = [_padded_seeded(c, o, ml) for c, o, ml in
+                  zip(string_cols, offs_cols, max_lens)]
         blob = _assemble_one_batch(
             fixed_words, fixed, padded, var_offsets,
             (row_sizes_dev // 8).astype(jnp.int32),
@@ -529,7 +542,8 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
     bounds = _batch_boundaries(
         row_sizes_np, max_batch_bytes,
         pad_blowup=None if mats_global_ok else _ROWMAT_MAX_BLOWUP)
-    padded = [padded_bytes(c) for c in string_cols] if mats_global_ok \
+    padded = [_padded_seeded(c, o, ml) for c, o, ml in
+              zip(string_cols, offs_cols, max_lens)] if mats_global_ok \
         else None
 
     out = []
@@ -553,13 +567,13 @@ def _convert_to_rows(table, max_batch_bytes, info, n, string_cols):
             # _batch_boundaries' pad_blowup cut, so every batch matrix
             # stays proportional to its own bytes)
             mats_b, lens_b = [], []
-            for c in string_cols:
-                offs_b = jnp.asarray(c.offsets, dtype=jnp.int32)[b0:b1 + 1]
+            for s, (c, offs_d) in enumerate(zip(string_cols, offs_cols)):
                 ho = c.host_offsets()
-                ml = int((ho[b0 + 1:b1 + 1] - ho[b0:b1]).max()) if nb else 0
-                m_b, l_b = densify_offsets(c.data, offs_b, pad_width(ml))
+                ml = int((ho[b0 + 1:b1 + 1] - ho[b0:b1]).max())
+                m_b, _ = densify_offsets(c.data, offs_d[b0:b1 + 1],
+                                         pad_width(ml))
                 mats_b.append(m_b)
-                lens_b.append(l_b)
+                lens_b.append(lengths[b0:b1, s])
             mats_b, lens_b = tuple(mats_b), tuple(lens_b)
         # multiple-of-16 bucket (not pow2): the [n, row_pad] matrix is the
         # dominant allocation, and pow2 rounding nearly doubles it at e.g.
