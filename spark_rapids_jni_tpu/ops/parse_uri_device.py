@@ -517,9 +517,15 @@ def parse_uri_device(col: Column, part: str) -> Column:
         return Column(dt.STRING, 0, data=jnp.zeros((0,), jnp.uint8),
                       validity=jnp.zeros((0,), bool),
                       offsets=jnp.zeros((1,), jnp.int32))
-    mat, lens = padded_bytes(col)
-    (ok, ss, se, has_s, hs, he, has_h, qs, qe, has_q) = _parse_core(mat,
-                                                                    lens)
+    # memoize the core on the (immutable) column: Spark queries routinely
+    # ask several parts of the same url column, and the span computation
+    # is identical for all of them
+    spans = getattr(col, "_uri_spans_cache", None)
+    if spans is None:
+        mat, lens = padded_bytes(col)
+        spans = _parse_core(mat, lens)
+        object.__setattr__(col, "_uri_spans_cache", spans)
+    (ok, ss, se, has_s, hs, he, has_h, qs, qe, has_q) = spans
     if part == "PROTOCOL":
         return _extract(col, ss, se, has_s)
     if part == "HOST":
