@@ -69,6 +69,17 @@ int pqd_decode_chunk(void* h, int rg, int leaf, const uint8_t* bytes,
 int pqd_decode_chunk2(void* h, int rg, int leaf, const uint8_t* bytes,
                       long long len, int want_levels, pqd_out_t* out,
                       char** err_out);
+typedef struct {
+  int ptype;
+  int encoding;
+  long long num_values;
+  long long def_off, def_len;
+  long long val_off, val_len;
+} pqd_page_meta_t;
+int pqd_extract_pages(void* h, int rg, int leaf, const uint8_t* bytes,
+                      long long len, uint8_t** blob_out,
+                      long long* blob_bytes, pqd_page_meta_t** pages_out,
+                      long long* n_pages_out, char** err_out);
 void pqd_free_out(pqd_out_t* out);
 void pqd_free(void* p);
 void pqd_close(void* h);
@@ -236,6 +247,19 @@ void fuzz_decode(const std::string& footer, const std::string& chunk) {
           pqd_free_out(&out);
         if (derr) pqd_free(derr);
       }
+      // round-5 device-decode page extractor: same mutated inputs must
+      // never read out of bounds or leak whichever way they fail
+      uint8_t* blob = nullptr;
+      pqd_page_meta_t* pages = nullptr;
+      long long blob_len = 0, n_pages = 0;
+      char* xerr = nullptr;
+      if (pqd_extract_pages(h, rg, leaf, (const uint8_t*)chunk.data(),
+                            (long long)chunk.size(), &blob, &blob_len,
+                            &pages, &n_pages, &xerr) == 0) {
+        pqd_free(blob);
+        pqd_free(pages);
+      }
+      if (xerr) pqd_free(xerr);
     }
   }
   pqd_close(h);

@@ -1,17 +1,17 @@
-"""Worker program for the real 2-process multi-host test.
+"""Worker program for the real multi-process multi-host tests.
 
-Each of two processes (spawned by tests/test_multihost.py) pins JAX to 4
-virtual CPU devices, joins the cluster through cluster.initialize (real
+Each of N processes (spawned by tests/test_multihost.py; N and the
+per-process device count ride argv) pins JAX to its virtual CPU devices, joins the cluster through cluster.initialize (real
 jax.distributed bootstrap over a localhost coordinator — the same call a
 pod worker makes), builds the IDENTICAL input table, and runs
-hash_partition_exchange over the 8-device GLOBAL mesh. The all_to_all
-therefore genuinely crosses process boundaries over the distributed
-runtime's wire, not a single-process simulation.
+hash_partition_exchange over the nproc x local_devs GLOBAL mesh. The
+all_to_all therefore genuinely crosses process boundaries over the
+distributed runtime's wire, not a single-process simulation.
 
 Prints one JSON line: this process's local partitions as
-{partition index: {"rows": k, "key_sum": s, "payload": [...first 5]}},
-plus a psum-verified global row count. The parent asserts the union of
-both processes' partitions equals a single-process 8-device reference run.
+{partition index: {"rows": k, "key_sum": s, "payload_sum": s2}}, plus a
+psum-verified global row count. The parent asserts the union of all
+processes' partitions equals a single-process reference run.
 """
 
 import json
@@ -30,14 +30,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     rank = int(sys.argv[1])
     port = sys.argv[2]
+    nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    local_devs = int(sys.argv[4]) if len(sys.argv) > 4 else 4
     from spark_rapids_jni_tpu.parallel import cluster
 
-    cluster.initialize(coordinator=f"127.0.0.1:{port}", num_processes=2,
-                       process_id=rank)
+    cluster.initialize(coordinator=f"127.0.0.1:{port}",
+                       num_processes=nproc, process_id=rank)
     info = cluster.process_info()
-    assert info["process_count"] == 2, info
-    assert info["global_devices"] == 8, info
-    assert info["local_devices"] == 4, info
+    assert info["process_count"] == nproc, info
+    assert info["global_devices"] == nproc * local_devs, info
+    assert info["local_devices"] == local_devs, info
 
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column, Table
@@ -60,7 +62,7 @@ def main():
         }
 
     # cross-process collective proof: psum of local partition row counts
-    # over the global mesh must equal n on BOTH processes. Each process
+    # over the global mesh must equal n on EVERY process. Each process
     # contributes its count on its first local device slot; device_put to a
     # cross-process sharding materializes only local shards, so the two
     # processes' different host values combine into one global array.
@@ -72,8 +74,8 @@ def main():
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
     local_rows = sum(v["rows"] for v in result.values())
-    # this process's 4-slot piece of the global [8] array: count on slot 0
-    local_piece = np.zeros(4, np.int32)
+    # this process's local_devs-slot piece of the global array: slot 0
+    local_piece = np.zeros(local_devs, np.int32)
     local_piece[0] = local_rows
 
     def tot(x):
@@ -93,10 +95,10 @@ def main():
     q1 = run_q1(li, mesh=mesh)
     q1_rows = list(zip(*[c.to_pylist() for c in q1.columns]))
 
-    # distributed sample-sort across the two processes: the range exchange
-    # crosses the process boundary, and the contiguous-per-host mesh means
+    # distributed sample-sort across the processes: the range exchange
+    # crosses process boundaries, and the contiguous-per-host mesh means
     # each process's concatenated partitions are a contiguous slice of the
-    # global order — rank 0 holds the low ranges, rank 1 the high ones
+    # global order — ranks ascend through the key ranges
     from spark_rapids_jni_tpu.parallel.distributed import distributed_sort
     srt = distributed_sort(Table((keys, payload)), [0], mesh)
     sorted_keys = srt.columns[0].to_pylist()
