@@ -223,48 +223,55 @@ def _chunks_ok(mat, class_sel, end_at, raw_pct):
 # host classification loops (oracle per-char semantics, [n]-wide)
 # ---------------------------------------------------------------------------
 
-def _ipv6_ok(mat, lo, hi):
-    """_validate_ipv6 over [lo, hi) spans, register-for-register."""
+def _host_checks(mat, lo, hi):
+    """The oracle's three host classifiers — _validate_ipv6 /
+    _validate_ipv4 / _validate_domain — fused into ONE W-step loop over
+    the host span ([n]-wide registers for all three at once). On the
+    tunnel backend the serial loop count is the latency driver, so one
+    pass beats three; semantics stay register-for-register with the
+    oracle (including _validate_domain's exact last-character
+    'numeric_start' behavior). Returns (v6ok, v4ok, domok)."""
     n, W = mat.shape
     digit = jnp.asarray(_DIGIT_TAB)
+    alnum = jnp.asarray(_ALNUM_TAB)
 
     def step(j, s):
-        (ok, dc, colons, periods, pcts, obr, cbr,
-         gval, gchars, ghex, prev) = s
+        (ok6, dc, colons, periods, pcts, obr, cbr, gval, gchars, ghex,
+         prev, ok4, octet, chars4, dots4,
+         okd, ldash, ldot, nstart, charsd) = s
         c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
             .astype(jnp.int32)
         act = (j >= lo) & (j < hi)
+        is_dig = digit[c]
+        is_dot = c == ord(".")
 
+        # ---- ipv6 ----
         is_ob = c == ord("[")
         is_cb = c == ord("]")
         is_co = c == ord(":")
-        is_dot = c == ord(".")
         is_pct = c == ord("%")
         other = ~(is_ob | is_cb | is_co | is_dot | is_pct)
-
-        ok = ok & (~(act & is_ob) | (obr + 1 <= 1))
-        ok = ok & (~(act & is_cb) | ((cbr + 1 <= 1)
-                                     & ~((periods > 0)
-                                         & (ghex | (gval > 255)))))
+        ok6 = ok6 & (~(act & is_ob) | (obr + 1 <= 1))
+        ok6 = ok6 & (~(act & is_cb) | ((cbr + 1 <= 1)
+                                       & ~((periods > 0)
+                                           & (ghex | (gval > 255)))))
         nco = colons + 1
         co_bad = ((prev == ord(":")) & dc) | (nco > 8) \
             | ((nco == 8) & ~(dc | (prev == ord(":")))) \
             | (periods > 0) | (pcts > 0)
-        ok = ok & (~(act & is_co) | ~co_bad)
+        ok6 = ok6 & (~(act & is_co) | ~co_bad)
         np_ = periods + 1
         dot_bad = (pcts > 0) | (np_ > 3) | ghex | (gval > 255) \
             | ((colons != 6) & ~dc) | (colons >= 8)
-        ok = ok & (~(act & is_dot) | ~dot_bad)
+        ok6 = ok6 & (~(act & is_dot) | ~dot_bad)
         pct_bad = (pcts + 1 > 1) | ((periods > 0) & (ghex | (gval > 255)))
-        ok = ok & (~(act & is_pct) | ~pct_bad)
-
+        ok6 = ok6 & (~(act & is_pct) | ~pct_bad)
         is_hexl = ((c >= ord("a")) & (c <= ord("f"))) \
             | ((c >= ord("A")) & (c <= ord("F")))
-        is_dig = digit[c]
         grp = act & other & (pcts == 0)  # inside a zone-id anything goes
-        ok = ok & (~grp | ((gchars <= 3) & (is_hexl | is_dig)))
+        ok6 = ok6 & (~grp | ((gchars <= 3) & (is_hexl | is_dig)))
         add = jnp.where(is_hexl, 10 + (c | 0x20) - ord("a"), c - ord("0"))
-        gval_n = jnp.minimum(gval * 10 + add, 1 << 20)  # cap: only >255 matters
+        gval_n = jnp.minimum(gval * 10 + add, 1 << 20)  # only >255 matters
         reset = act & (is_co | is_dot | is_pct)
         gval = jnp.where(grp, gval_n, jnp.where(reset, 0, gval))
         gchars = jnp.where(grp, gchars + 1, jnp.where(reset, 0, gchars))
@@ -277,77 +284,48 @@ def _ipv6_ok(mat, lo, hi):
         obr = obr + (act & is_ob)
         cbr = cbr + (act & is_cb)
         prev = jnp.where(act, c, prev)
-        return (ok, dc, colons, periods, pcts, obr, cbr,
-                gval, gchars, ghex, prev)
 
-    i32z = jnp.zeros((n,), jnp.int32)
-    s0 = (hi - lo >= 2, jnp.zeros((n,), bool), i32z, i32z, i32z, i32z,
-          i32z, i32z, i32z, jnp.zeros((n,), bool), i32z)
-    out = lax.fori_loop(0, W, step, s0)
-    return out[0]
-
-
-def _ipv4_ok(mat, lo, hi):
-    """_validate_ipv4: dotted-quad, each group's numeric value <= 255."""
-    n, W = mat.shape
-    digit = jnp.asarray(_DIGIT_TAB)
-
-    def step(j, s):
-        ok, octet, chars, dots = s
-        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
-            .astype(jnp.int32)
-        act = (j >= lo) & (j < hi)
-        is_dig = digit[c]
-        is_dot = (c == ord(".")) & (j > lo)  # a leading '.' is a bad char
-        ok = ok & (~act | is_dig | is_dot)
-        ok = ok & (~(act & is_dot) | (chars > 0))
+        # ---- ipv4 ----
+        v4_dot = is_dot & (j > lo)  # a leading '.' is a bad char
+        ok4 = ok4 & (~act | is_dig | v4_dot)
+        ok4 = ok4 & (~(act & v4_dot) | (chars4 > 0))
         octet_n = jnp.minimum(octet * 10 + (c - ord("0")), 1 << 20)
-        ok = ok & (~(act & is_dig) | (octet_n <= 255))
+        ok4 = ok4 & (~(act & is_dig) | (octet_n <= 255))
         octet = jnp.where(act & is_dig, octet_n,
-                          jnp.where(act & is_dot, 0, octet))
-        chars = jnp.where(act & is_dig, chars + 1,
-                          jnp.where(act & is_dot, 0, chars))
-        dots = dots + (act & is_dot)
-        return ok, octet, chars, dots
+                          jnp.where(act & v4_dot, 0, octet))
+        chars4 = jnp.where(act & is_dig, chars4 + 1,
+                           jnp.where(act & v4_dot, 0, chars4))
+        dots4 = dots4 + (act & v4_dot)
 
-    i32z = jnp.zeros((n,), jnp.int32)
-    ok, _, chars, dots = lax.fori_loop(
-        0, W, step, (jnp.ones((n,), bool), i32z, i32z, i32z))
-    return ok & (chars > 0) & (dots == 3)
-
-
-def _domain_ok(mat, lo, hi):
-    """_validate_domain, register-for-register (including its exact
-    'numeric_start' last-character semantics)."""
-    n, W = mat.shape
-    digit = jnp.asarray(_DIGIT_TAB)
-    alnum = jnp.asarray(_ALNUM_TAB)
-
-    def step(j, s):
-        ok, ldash, ldot, nstart, chars = s
-        c = lax.dynamic_index_in_dim(mat, j, axis=1, keepdims=False) \
-            .astype(jnp.int32)
-        act = (j >= lo) & (j < hi)
+        # ---- domain ----
         is_dash = c == ord("-")
-        is_dot = c == ord(".")
-        ok = ok & (~act | alnum[c] | is_dash | is_dot)
-        nstart = jnp.where(act, ldot & digit[c], nstart)
+        okd = okd & (~act | alnum[c] | is_dash | is_dot)
+        nstart = jnp.where(act, ldot & is_dig, nstart)
         dash_bad = ldot | (j == lo) | (j == hi - 1)
-        ok = ok & (~(act & is_dash) | ~dash_bad)
-        dot_bad = ldash | ldot | (chars == 0)
-        ok = ok & (~(act & is_dot) | ~dot_bad)
+        okd = okd & (~(act & is_dash) | ~dash_bad)
+        ddot_bad = ldash | ldot | (charsd == 0)
+        okd = okd & (~(act & is_dot) | ~ddot_bad)
         plain = act & ~is_dash & ~is_dot
         ldash = jnp.where(act, is_dash, ldash)
         ldot = jnp.where(act, is_dot, ldot)
-        chars = jnp.where(plain, chars + 1,
-                          jnp.where(act, 0, chars))
-        return ok, ldash, ldot, nstart, chars
+        charsd = jnp.where(plain, charsd + 1,
+                           jnp.where(act, 0, charsd))
 
+        return (ok6, dc, colons, periods, pcts, obr, cbr, gval, gchars,
+                ghex, prev, ok4, octet, chars4, dots4,
+                okd, ldash, ldot, nstart, charsd)
+
+    i32z = jnp.zeros((n,), jnp.int32)
     bz = jnp.zeros((n,), bool)
-    ok, _, _, nstart, _ = lax.fori_loop(
-        0, W, step, (jnp.ones((n,), bool), bz, bz, bz,
-                     jnp.zeros((n,), jnp.int32)))
-    return ok & ~nstart
+    bo = jnp.ones((n,), bool)
+    s0 = (hi - lo >= 2, bz, i32z, i32z, i32z, i32z, i32z, i32z, i32z, bz,
+          i32z, bo, i32z, i32z, i32z,
+          bo, bz, bz, bz, i32z)
+    out = lax.fori_loop(0, W, step, s0)
+    v6ok = out[0]
+    v4ok = out[11] & (out[13] > 0) & (out[14] == 3)
+    domok = out[15] & ~out[18]
+    return v6ok, v4ok, domok
 
 
 # ---------------------------------------------------------------------------
@@ -456,15 +434,13 @@ def _parse_core(mat, lens):
     hfirst = _byte_at(mat, host_s)
     hlast = _byte_at(mat, host_e - 1)
     bracketed = (host_len > 0) & (hfirst == ord("["))
-    v6ok = _ipv6_ok(mat, host_s, host_e)
+    v6ok, v4ok, domok = _host_checks(mat, host_s, host_e)
     brk_inside, has_brk = _first(eq[ord("[")] | eq[ord("]")],
                                  host_s, host_e)
     ldot, has_ldot = _last(mat == ord("."), host_s, host_e)
     after_dot = _byte_at(mat, ldot + 1)
     looks_ipv4 = has_ldot & (ldot != host_e - 1) \
         & jnp.asarray(_DIGIT_TAB)[after_dot.astype(jnp.int32)]
-    v4ok = _ipv4_ok(mat, host_s, host_e)
-    domok = _domain_ok(mat, host_s, host_e)
 
     host_fatal = jnp.where(
         bracketed, (hlast != ord("]")) | ~v6ok,
