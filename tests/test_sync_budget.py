@@ -217,3 +217,36 @@ def test_q1_pipeline_budget(accel, monkeypatch):
     # must stay within a handful of sizing syncs and NEVER recompile
     assert b.d2h_syncs <= 3, b._summary()
     assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_q3_pipeline_budget(accel, monkeypatch):
+    """q3 = filter + 2 joins + groupby + top-k sort: two joins at <= 2
+    data-dependent syncs each, one groupby head, sizing for the gathers —
+    the end-to-end ceiling is the sum of the op contracts, and a steady-
+    state run must never recompile."""
+    from benchmarks import tpch
+    monkeypatch.setattr(tpch, "_backend", lambda: "tpu")
+    cust, orders, lineitem = tpch.generate_q3_tables(8192, seed=14)
+    tpch.run_q3(cust, orders, lineitem)  # warm
+    with budget.measure() as b:
+        out = tpch.run_q3(cust, orders, lineitem)
+        jax.block_until_ready([c.data for c in out.columns])
+    # measured exactly: 2 joins x 2 + 1 groupby head (the sync_sites
+    # in the failure message name each one)
+    assert b.d2h_syncs <= 5, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_q5_pipeline_budget(accel, monkeypatch):
+    """q5 = 4 joins + co-nation predicate + groupby + sort: the widest
+    local pipeline; ceiling = 4 joins x 2 + groupby 1 + sizing slack."""
+    from benchmarks import tpch
+    monkeypatch.setattr(tpch, "_backend", lambda: "tpu")
+    tables = tpch.generate_q5_tables(8192, seed=15)
+    tpch.run_q5(*tables)  # warm
+    with budget.measure() as b:
+        out = tpch.run_q5(*tables)
+        jax.block_until_ready([c.data for c in out.columns])
+    # measured exactly: 4 joins x 2 + 1 groupby head
+    assert b.d2h_syncs <= 9, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
