@@ -250,3 +250,43 @@ def test_q5_pipeline_budget(accel, monkeypatch):
     # measured exactly: 4 joins x 2 + 1 groupby head
     assert b.d2h_syncs <= 9, b._summary()
     assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+# ---------------------------------------------------------------------------
+# the instrument itself
+# ---------------------------------------------------------------------------
+
+def test_instrument_counts_each_materialization_once():
+    x = jnp.arange(100) + 1
+    with budget.measure() as b:
+        int(jnp.sum(x))          # 1
+        float(jnp.float32(2.5) + 0)  # 2
+        bool(jnp.any(x > 0))     # 3
+        np.asarray(x)            # 4 (buffer-protocol path on cpu)
+        np.asarray(np.arange(3))  # host array: free
+        _ = x.shape[0]           # shape read: free
+    assert b.d2h_syncs == 4, b._summary()
+    assert len(b.sync_sites) == 4
+
+
+def test_instrument_nested_measures_both_observe():
+    x = jnp.arange(10)
+    with budget.measure() as outer:
+        int(jnp.sum(x))
+        with budget.measure() as inner:
+            np.asarray(x)
+        int(jnp.max(x))
+    assert inner.d2h_syncs == 1, inner._summary()
+    assert outer.d2h_syncs == 3, outer._summary()
+
+
+def test_instrument_counts_fresh_compiles_only():
+    f = jax.jit(lambda v: v * 7 + 1)
+    x = jnp.arange(64)
+    with budget.measure() as b1:
+        f(x).block_until_ready()
+    assert b1.compiles >= 1 and b1.traces >= 1, b1._summary()
+    with budget.measure() as b2:
+        f(x).block_until_ready()
+    assert b2.compiles == 0 and b2.traces == 0, b2._summary()
+    assert b2.d2h_syncs == 0
