@@ -181,11 +181,20 @@ def get_json_object_with_instructions(
 
 @func_range()
 def get_json_object(col: Column, path: str) -> Column:
-    """Spark `get_json_object(col, path)`; invalid path → all-null column."""
+    """Spark `get_json_object(col, path)`; invalid path → all-null column.
+
+    Tier dispatch (get_json.tier flag): on accelerators, KEY/INDEX paths
+    run the hybrid device tier (ops/get_json_device.py — on-device
+    validate+navigate, host PDA normalizes the narrowed spans); the host
+    PDA handles everything else and the CPU backend."""
     ops = parse_path(path)
     if ops is None:
         return Column(dt.STRING, col.size,
                       data=np.zeros(0, dtype=np.uint8),
                       validity=np.zeros(col.size, dtype=bool),
                       offsets=np.zeros(col.size + 1, dtype=np.int32))
+    from ..utils.backend import tier_is_device
+    if tier_is_device("get_json.tier"):
+        from .get_json_device import get_json_object_device
+        return get_json_object_device(col, ops)
     return get_json_object_with_instructions(col, ops)

@@ -86,6 +86,10 @@ _register("parquet.device_decode", "SRJT_PARQUET_DEVICE_DECODE", "auto",
           str, "Parquet decode stage 1 on-device (RLE/dict/PLAIN as XLA; "
           "only encoded page bytes cross the link): auto (accelerators) "
           "| on | off")
+_register("get_json.tier", "SRJT_GET_JSON_TIER", "auto", str,
+          "get_json_object execution: auto (device scan+navigate on "
+          "accelerators for KEY/INDEX paths, host PDA normalizes the "
+          "narrowed spans) | device | native")
 
 
 def get(key: str) -> Any:

@@ -1,0 +1,179 @@
+"""Hybrid device tier for get_json_object vs the host PDA (round 5).
+
+The device tier validates + navigates on-device and hands the NARROWED
+spans to the host PDA for Spark normalization; the host tier on the full
+documents is the oracle. Coverage: directed semantics (null-literal
+key-vs-index distinction, strict whole-document validation, container
+spans), mutation fuzz, wildcard/unsupported fallback, dispatch flag, and
+the transfer-budget shape (span bytes, not documents, cross the link).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.get_json_device import (
+    get_json_object_device,
+    supported_steps,
+)
+from spark_rapids_jni_tpu.ops.get_json_object import (
+    get_json_object,
+    get_json_object_with_instructions,
+    parse_path,
+)
+from spark_rapids_jni_tpu.utils import config
+
+DOCS = [
+    '{"a": {"x" :  [1,  2] , "y": "s"} }',
+    '{"a": "hello \\n \\"q\\" world"}',
+    '{"a": [1, {"b": 2}, 3]}', '{"a": 1e3}', '{"a": [ ]}',
+    '{"a": null}', '{"b": 1, "a": {"c": [true, false]}}',
+    '{"a": {"a": {"b": 7}}}', '{"a": [[1,2],[3]]}',
+    '{"a":"x"} trailing', '{"a": 00123}', '{"a": [1,2,}', '',
+    None, 'null', '123', '"str"', '[1,2,3]', '{"a" : -1.5e-3}',
+    '{"aa": 1, "a": 2}', '{ }', '{"a":{}}', '{"a":[{"a":[{"a":5}]}]}',
+    '[null]', '[1,]', '{"a":1,}', '{"a": "\\q"}', '{"a": "\\u00"}',
+    '{"a": .5}', '{"a": 5e}', '[truex]', '{"\\u0061": 5}',
+]
+PATHS = ["$", "$.a", "$.aa", "$.a.x", "$.a.c[1]", "$.a[1]", "$.a[1].b",
+         "$[0]", "$[1]", "$.a.a.b", "$.a[0][1]", "$.a[0].a[0].a"]
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_directed_matches_host(path):
+    col = Column.from_pylist(DOCS, dt.STRING)
+    ops = parse_path(path)
+    want = get_json_object_with_instructions(col, ops).to_pylist()
+    got = get_json_object_device(col, ops).to_pylist()
+    for d, g, w in zip(DOCS, got, want):
+        assert g == w, f"{path} on {d!r}: device={g!r} host={w!r}"
+
+
+def test_fuzz_matches_host():
+    r = random.Random(555)
+    keys = ["a", "b", "key", "k.q", "中"]
+
+    def rand_json(depth):
+        roll = r.random()
+        if depth <= 0 or roll < 0.35:
+            return r.choice([None, True, False,
+                             r.randint(-10**12, 10**12),
+                             r.random() * 10**r.randint(-6, 6),
+                             "s" * r.randint(0, 4), "e\t\"p\\q", "é中",
+                             0, -0.5, [], {}])
+        if roll < 0.7:
+            return {r.choice(keys): rand_json(depth - 1)
+                    for _ in range(r.randint(0, 3))}
+        return [rand_json(depth - 1) for _ in range(r.randint(0, 3))]
+
+    docs = []
+    for _ in range(600):
+        s = json.dumps(rand_json(3), ensure_ascii=r.random() < 0.5)
+        if r.random() < 0.4:
+            s = s.replace(",", " , ").replace(":", " :  ")
+        if r.random() < 0.3 and s:
+            i = r.randrange(len(s))
+            s = s[:i] + r.choice(["", "}", "{", ",", '"', "x", "]",
+                                  "[", ":", "\\"]) + s[i + 1:]
+        docs.append(s)
+    col = Column.from_pylist(docs, dt.STRING)
+    for path in ["$", "$.a", "$.key", "$['k.q']", "$.a.b", "$[0]",
+                 "$.a[1]", "$.中"]:
+        ops = parse_path(path)
+        want = get_json_object_with_instructions(col, ops).to_pylist()
+        got = get_json_object_device(col, ops).to_pylist()
+        for d, g, w in zip(docs, got, want):
+            assert g == w, f"{path} on {d!r}: device={g!r} host={w!r}"
+
+
+def test_wildcard_and_invalid_paths_fall_back():
+    col = Column.from_pylist(['{"a": [1, 2]}'], dt.STRING)
+    ops = parse_path("$.a[*]")
+    assert supported_steps(ops) is None  # wildcard -> host tier
+    got = get_json_object_device(col, ops).to_pylist()
+    want = get_json_object_with_instructions(col, ops).to_pylist()
+    assert got == want
+
+
+def test_dispatch_flag():
+    col = Column.from_pylist(['{"a": {"b": 5}}'] * 3, dt.STRING)
+    with config.override("get_json.tier", "device"):
+        dev = get_json_object(col, "$.a.b").to_pylist()
+    with config.override("get_json.tier", "native"):
+        nat = get_json_object(col, "$.a.b").to_pylist()
+    assert dev == nat == ["5", "5", "5"]
+
+
+def test_span_narrowing_is_the_transfer():
+    """The device tier's point: the host PDA sees only the narrowed
+    spans. With certified rows, the finishing input's total bytes must
+    be the span bytes, far below the documents'."""
+    docs = ['{"pad": "%s", "a": 7}' % ("x" * 500)] * 50
+    col = Column.from_pylist(docs, dt.STRING)
+    ops = parse_path("$.a")
+    got = get_json_object_device(col, ops)
+    assert got.to_pylist() == ["7"] * 50
+    # span column built inside the tier is 1 byte/row vs ~520: assert
+    # indirectly via the output (already checked) and via the budget
+    from spark_rapids_jni_tpu.utils import budget
+    get_json_object_device(col, ops)  # warm
+    with budget.measure() as b:
+        get_json_object_device(col, ops)
+    # padded-bytes cache is warm; budget = span sizing + the host
+    # finishing transfers on the small span column
+    assert b.d2h_syncs <= 6, b._summary()
+
+
+def test_key_shadowing_value_does_not_hide_key():
+    """A string VALUE whose content equals the looked-up key must not
+    shadow the real key (round-5 review finding): the colon check is
+    part of the match, not a post-hoc filter."""
+    docs = ['{"a":"b","b":1}', '{"a":"b" , "b": {"c": 2}}',
+            '{"x":":","b":3}', '{"b": "b"}', '{"a":"a:","a:":9}']
+    col = Column.from_pylist(docs, dt.STRING)
+    for p in ["$.b", "$.a", "$['a:']"]:
+        ops = parse_path(p)
+        want = get_json_object_with_instructions(col, ops).to_pylist()
+        got = get_json_object_device(col, ops).to_pylist()
+        assert got == want, (p, got, want)
+
+
+def test_bare_literal_documents_validate_on_device():
+    """'true'/'false'/'null' root documents must pass device validation
+    (not silently fall back) and match the host results."""
+    from spark_rapids_jni_tpu.columnar.strings import padded_bytes
+    from spark_rapids_jni_tpu.ops.get_json_device import _validate
+    docs = ["true", "false", "null", " true ", "truex", "nul"]
+    col = Column.from_pylist(docs, dt.STRING)
+    v = np.asarray(_validate(*padded_bytes(col)))
+    assert list(v) == [True, True, True, True, False, False]
+    ops = parse_path("$")
+    want = get_json_object_with_instructions(col, ops).to_pylist()
+    got = get_json_object_device(col, ops).to_pylist()
+    assert got == want
+
+
+def test_partial_fallback_only_reevaluates_uncertified_rows(monkeypatch):
+    """One malformed row must not trigger a full-column host re-pass."""
+    from spark_rapids_jni_tpu.ops import get_json_device as gjd
+    from spark_rapids_jni_tpu.ops import get_json_object as gjo
+    docs = ['{"a": %d}' % i for i in range(50)] + ['{"a": \\bad}']
+    col = Column.from_pylist(docs, dt.STRING)
+    calls = []
+    real = gjo.get_json_object_with_instructions
+
+    def spy(c, ops):
+        calls.append(c.size)
+        return real(c, ops)
+
+    # the tier imports the finisher from its home module at call time
+    monkeypatch.setattr(gjo, "get_json_object_with_instructions", spy)
+    got = gjd.get_json_object_device(col, parse_path("$.a"))
+    assert got.to_pylist() == [str(i) for i in range(50)] + [None]
+    # finishing pass over spans (size 51) + fallback over the ONE
+    # uncertified row, never the whole column again
+    assert sorted(calls) == [1, 51], calls
