@@ -430,6 +430,39 @@ def _parse_uri_device(np, jnp):
           f"(ratio {t_nat / t_dev:.2f}x)", file=sys.stderr)
 
 
+@check("get_json_device_vs_host")
+def _get_json_device(np, jnp):
+    """The hybrid JSON tier's device half (grammar DFA + navigation)
+    must agree with the host PDA ON THE CHIP: same edge corpus, plus a
+     20k-row span-narrowing run showing the tier end-to-end."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.get_json_device import (
+        get_json_object_device)
+    from spark_rapids_jni_tpu.ops.get_json_object import (
+        get_json_object_with_instructions, parse_path)
+
+    docs = ['{"a": {"x": [1, 2], "y": "s"}}', '{"a": 1e3}',
+            '{"a": null}', '{"a":"b","b":1}', 'true', '{"a": [1,2,}',
+            '{"a": "\\u00e9"}', '[null]', '{"a":[{"b":7}]}', None,
+            '{"pad": "' + "x" * 200 + '", "a": 9}']
+    col = Column.from_pylist(docs, dt.STRING)
+    for p in ["$.a", "$", "$.b", "$.a[0].b"]:
+        ops = parse_path(p)
+        want = get_json_object_with_instructions(col, ops).to_pylist()
+        got = get_json_object_device(col, ops).to_pylist()
+        assert got == want, (p, got, want)
+
+    big = Column.from_pylist(
+        ['{"pad": "%s", "k": %d}' % ("y" * 120, i) for i in range(20000)],
+        dt.STRING)
+    ops = parse_path("$.k")
+    out = get_json_object_device(big, ops).to_pylist()
+    assert out[17] == "17" and out[-1] == "19999", out[:3]
+    print("smoke: get_json hybrid tier: 20k rows narrowed on-chip",
+          file=sys.stderr)
+
+
 @check("hbm_reservation_watermarks")
 def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real
