@@ -73,6 +73,7 @@ typedef struct {
   int ptype;
   int encoding;
   long long num_values;
+  long long rep_off, rep_len;
   long long def_off, def_len;
   long long val_off, val_len;
 } pqd_page_meta_t;

@@ -1338,6 +1338,7 @@ typedef struct {
   int ptype;            // 0 = data page (v1 or v2), 2 = dictionary page
   int encoding;         // value encoding (ENC_*)
   long long num_values; // entries in this page (dict: dictionary size)
+  long long rep_off, rep_len;  // raw RLE-hybrid rep-level bytes (LIST)
   long long def_off, def_len;  // raw RLE-hybrid def-level bytes in the blob
   long long val_off, val_len;  // value-section bytes in the blob
 } pqd_page_meta_t;
@@ -1356,8 +1357,8 @@ int pqd_extract_pages(void* hp, int rg, int leaf_i, const uint8_t* bytes,
       throw std::runtime_error("bad row group / leaf");
     if (len < chunk_len) throw std::runtime_error("short chunk buffer");
     auto& leaf = h->leaves[leaf_i];
-    if (leaf.max_rep != 0)
-      throw std::runtime_error("extract: flat columns only");
+    if (leaf.max_rep > 1)
+      throw std::runtime_error("extract: flat or one-level LIST only");
     chunk_decoder dec(leaf, codec, nv);  // codec dispatch for decompress()
 
     std::vector<uint8_t> blob;
@@ -1413,16 +1414,27 @@ int pqd_extract_pages(void* hp, int rg, int leaf_i, const uint8_t* bytes,
         m.encoding = (int)i_of(*dh, DPH_ENCODING, ENC_PLAIN);
         m.num_values = n;
         size_t cursor = 0;
-        if (leaf.max_def > 0) {  // v1 def section: u32 length + hybrid
+        if (leaf.max_rep == 1) {  // v1 rep section precedes def section
           if (dlen < 4)
+            throw std::runtime_error("page: truncated rep length");
+          uint32_t rb;
+          memcpy(&rb, data, 4);
+          if (rb > dlen - 4)
+            throw std::runtime_error("page: truncated rep levels");
+          m.rep_off = (long long)(base + 4);
+          m.rep_len = rb;
+          cursor = 4 + (size_t)rb;
+        }
+        if (leaf.max_def > 0) {  // v1 def section: u32 length + hybrid
+          if (dlen - cursor < 4)
             throw std::runtime_error("page: truncated level length");
           uint32_t nb;
-          memcpy(&nb, data, 4);
-          if (nb > dlen - 4)
+          memcpy(&nb, data + cursor, 4);
+          if (nb > dlen - cursor - 4)
             throw std::runtime_error("page: truncated levels");
-          m.def_off = (long long)(base + 4);
+          m.def_off = (long long)(base + cursor + 4);
           m.def_len = nb;
-          cursor = 4 + (size_t)nb;
+          cursor += 4 + (size_t)nb;
         }
         m.val_off = (long long)(base + cursor);
         m.val_len = (long long)(dlen - cursor);
@@ -1438,24 +1450,31 @@ int pqd_extract_pages(void* hp, int rg, int leaf_i, const uint8_t* bytes,
         int64_t rep_bytes = i_of(*dh, DP2_REP_BYTES, 0);
         auto* icf = get(*dh, DP2_IS_COMPRESSED);
         bool is_comp = icf ? icf->b : true;
-        if (rep_bytes != 0)
+        if (leaf.max_rep == 0 && rep_bytes != 0)
           throw std::runtime_error("v2: rep levels on a flat column");
-        if (def_bytes < 0 || def_bytes > comp)
+        if (rep_bytes < 0 || def_bytes < 0 || rep_bytes > comp ||
+            def_bytes > comp - rep_bytes)
           throw std::runtime_error("v2: bad level bytes");
         pqd_page_meta_t m{};
         m.ptype = 0;
         m.encoding = (int)i_of(*dh, DP2_ENCODING, ENC_PLAIN);
         m.num_values = n;
-        if (leaf.max_def > 0 && def_bytes > 0) {
+        if (leaf.max_rep == 1 && rep_bytes > 0) {
           // v2 levels ride uncompressed ahead of the value section,
-          // with no u32 prefix
+          // rep section first, no u32 prefixes
+          m.rep_off = (long long)blob.size();
+          m.rep_len = rep_bytes;
+          blob.insert(blob.end(), payload, payload + rep_bytes);
+        }
+        if (leaf.max_def > 0 && def_bytes > 0) {
           m.def_off = (long long)blob.size();
           m.def_len = def_bytes;
-          blob.insert(blob.end(), payload, payload + def_bytes);
+          blob.insert(blob.end(), payload + rep_bytes,
+                      payload + rep_bytes + def_bytes);
         }
-        const uint8_t* vsrc = payload + def_bytes;
-        size_t vcomp = (size_t)(comp - def_bytes);
-        size_t vuncomp = (size_t)(uncomp - def_bytes);
+        const uint8_t* vsrc = payload + rep_bytes + def_bytes;
+        size_t vcomp = (size_t)(comp - rep_bytes - def_bytes);
+        size_t vuncomp = (size_t)(uncomp - rep_bytes - def_bytes);
         std::vector<uint8_t> dbuf;
         const uint8_t* data;
         size_t dlen;

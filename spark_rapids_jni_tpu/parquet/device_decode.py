@@ -58,6 +58,8 @@ class _PageMeta(ctypes.Structure):
         ("ptype", ctypes.c_int),
         ("encoding", ctypes.c_int),
         ("num_values", ctypes.c_longlong),
+        ("rep_off", ctypes.c_longlong),
+        ("rep_len", ctypes.c_longlong),
         ("def_off", ctypes.c_longlong),
         ("def_len", ctypes.c_longlong),
         ("val_off", ctypes.c_longlong),
@@ -70,6 +72,8 @@ class _Page:
     ptype: int
     encoding: int
     num_values: int
+    rep_off: int
+    rep_len: int
     def_off: int
     def_len: int
     val_off: int
@@ -101,8 +105,8 @@ def extract_pages(lib, handle, rg: int, leaf_idx: int,
         blob = (np.ctypeslib.as_array(blob_p, shape=(blob_len.value,)).copy()
                 if blob_len.value else np.zeros(0, np.uint8))
         pages = [
-            _Page(p.ptype, p.encoding, p.num_values, p.def_off, p.def_len,
-                  p.val_off, p.val_len)
+            _Page(p.ptype, p.encoding, p.num_values, p.rep_off, p.rep_len,
+                  p.def_off, p.def_len, p.val_off, p.val_len)
             for p in (pages_p[i] for i in range(n_pages.value))]
     finally:
         lib.pqd_free(blob_p)
@@ -273,7 +277,7 @@ _ELEM_SIZE = {_PT_INT32: 4, _PT_INT64: 8, _PT_FLOAT: 4, _PT_DOUBLE: 8,
 
 def pages_supported(leaf, pages: List[_Page]) -> bool:
     """Can this chunk's page inventory run on the device tier?"""
-    if leaf.max_rep != 0:
+    if leaf.max_rep > 1:
         return False
     has_dict = any(p.ptype == 2 for p in pages)
     has_dict_data = any(p.ptype != 2 and p.encoding in
@@ -339,16 +343,20 @@ def _decode_dictionary(leaf, blob: np.ndarray, blob_dev, page: _Page):
 
 
 def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
-                       rows: int) -> Column:
-    """Full device decode of one flat column chunk. ``blob`` ships to the
-    device once; everything after is XLA (plus the one string-sizing
-    sync for BYTE_ARRAY dictionary outputs)."""
+                       rows: int, list_rows: int = 0) -> Column:
+    """Full device decode of one column chunk (flat, or one-level LIST
+    when ``list_rows`` > 0 — the row-group's row count, host-known from
+    the footer). ``blob`` ships to the device once; everything after is
+    XLA (plus the sizing syncs for BYTE_ARRAY dictionary outputs and
+    LIST element counts)."""
     blob_dev = jnp.asarray(blob)  # the ONE host->device data transfer
     dictionary = None
     val_parts: List[jnp.ndarray] = []
     def_parts: List[jnp.ndarray] = []
+    rep_parts: List[jnp.ndarray] = []
     idx_parts: List[jnp.ndarray] = []  # dict-index pages
     any_dict_data = False
+    is_list = leaf.max_rep == 1
 
     for p in pages:
         if p.ptype == 2:
@@ -362,6 +370,12 @@ def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
         else:
             defs = jnp.zeros(n, jnp.int32)
         def_parts.append(defs)
+        if is_list:
+            if p.rep_len > 0:
+                runs = _walk_runs(blob, p.rep_off, p.rep_len, n, 1)
+                rep_parts.append(_expand_runs(blob_dev, *runs, n, 1))
+            else:
+                rep_parts.append(jnp.zeros(n, jnp.int32))
         # stored (non-null-only) entries align PER PAGE: each page's value
         # stream restarts its dense numbering, so the null scatter runs on
         # the page's own defs before concatenation
@@ -400,6 +414,16 @@ def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
         jnp.zeros(0, jnp.int32)
     validity = defs_all == leaf.max_def if leaf.max_def > 0 else None
 
+    if is_list:
+        elem = leaf.elem_dtype
+
+        class _ElemLeaf:  # shim: the finishers read .dtype/.physical
+            dtype = elem
+            physical = leaf.physical
+        eleaf = _ElemLeaf()
+    else:
+        eleaf = leaf
+
     if any_dict_data:
         idx_rows = jnp.concatenate(idx_parts)  # row-aligned per page
         kind, payload, offs = dictionary
@@ -409,13 +433,57 @@ def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
             else:
                 data = jnp.take(payload, jnp.clip(idx_rows, 0,
                                                   payload.shape[0] - 1))
-            return _finish_fixed(leaf, rows, data, validity)
-        return _finish_string_dict(leaf, rows, idx_rows, payload, offs,
-                                   validity)
+            entries = _finish_fixed(eleaf, rows, data, validity)
+        else:
+            entries = _finish_string_dict(eleaf, rows, idx_rows, payload,
+                                          offs, validity)
+    else:
+        data = (jnp.concatenate(val_parts) if val_parts
+                else jnp.zeros(0, jnp.uint64))
+        entries = _finish_fixed(eleaf, rows, data, validity)
 
-    data = (jnp.concatenate(val_parts) if val_parts
-            else jnp.zeros(0, jnp.uint64))
-    return _finish_fixed(leaf, rows, data, validity)
+    if not is_list:
+        return entries
+    return _finish_list(leaf, entries, defs_all,
+                        jnp.concatenate(rep_parts) if rep_parts
+                        else jnp.zeros(0, jnp.int32), list_rows)
+
+
+def _finish_list(leaf, entries: Column, defs_all, reps_all,
+                 list_rows: int) -> Column:
+    """One-level LIST assembly from entry-aligned levels (the host
+    decoder's fold_list_levels semantics, vectorized): an entry with
+    rep == 0 STARTS a list row, valid iff def >= rep_def - 1; an entry
+    is an ELEMENT SLOT iff def >= rep_def; element presence (child
+    validity) is def == max_def and already encoded in ``entries``."""
+    from ..ops.sort import gather
+
+    R = reps_all == 0
+    E = defs_all >= leaf.rep_def
+    lvalid_all = jnp.take(defs_all, jnp.nonzero(
+        R, size=list_rows, fill_value=0)[0]) >= leaf.rep_def - 1
+    # ONE sizing sync carries all three scalars: element count (child
+    # shape), the rep==0 row count (validated against the footer's row
+    # count — a crafted rep stream must error, not silently truncate
+    # through nonzero's size=), and the all-valid flag
+    head = np.asarray(jnp.stack([
+        jnp.sum(E), jnp.sum(R), jnp.sum(lvalid_all)]))
+    n_elems, n_rows, n_lvalid = int(head[0]), int(head[1]), int(head[2])
+    if n_rows != list_rows:
+        raise ValueError(
+            f"list levels corrupt: {n_rows} rep==0 entries vs "
+            f"{list_rows} footer rows")
+    row_starts = jnp.nonzero(R, size=list_rows)[0].astype(jnp.int32)
+    slot_pos = jnp.nonzero(E, size=n_elems)[0].astype(jnp.int32)
+    child = gather(entries, slot_pos)
+    ecum_excl = jnp.cumsum(E.astype(jnp.int32)) - E.astype(jnp.int32)
+    offsets = jnp.concatenate([
+        jnp.take(ecum_excl, row_starts),
+        jnp.full((1,), n_elems, jnp.int32)]).astype(jnp.int32)
+    lmask = None if n_lvalid == list_rows else \
+        (jnp.take(defs_all, row_starts) >= leaf.rep_def - 1)
+    return Column(dt.LIST, list_rows, validity=lmask, offsets=offsets,
+                  children=(child,))
 
 
 def _finish_fixed(leaf, rows: int, lanes: jnp.ndarray,

@@ -143,6 +143,7 @@ class LeafSchema:
     max_rep: int
     elem_dtype: Optional[DType] = None
     nodes: list = None   # root→leaf PathNodes (parquet/nested.py)
+    rep_def: int = 0     # def level at the repeated ancestor (lists)
 
 
 @dataclass
@@ -284,7 +285,8 @@ class ParquetReader:
                                 else parts)
             out.append(LeafSchema(i, name, dtype, info.physical,
                                   info.type_length, info.max_def,
-                                  info.max_rep, elem_dtype, nodes))
+                                  info.max_rep, elem_dtype, nodes,
+                                  info.rep_def))
         return out
 
     def _build_plans(self) -> List[ColumnPlan]:
@@ -506,7 +508,7 @@ class ParquetReader:
             want = plan.kind == "nested"
             with open(self._path, "rb") as f:
                 if device_tier and plan.kind == "simple" \
-                        and plan.leaves[0].max_rep == 0:
+                        and plan.leaves[0].max_rep <= 1:
                     dev = self._extract_leaf_pages(f, groups,
                                                    plan.leaves[0])
                     if dev is not None:
@@ -592,7 +594,9 @@ class ParquetReader:
                 return None  # e.g. unsupported structure
             if not dd.pages_supported(leaf, pages):
                 return None
-            out.append((blob, pages, nv))
+            lrows = (self._lib.pqd_rg_num_rows(self._h, g)
+                     if leaf.max_rep == 1 else 0)
+            out.append((blob, pages, nv, lrows))
         return out
 
     def _ship_device(self, leaf, parts) -> Column:
@@ -604,7 +608,7 @@ class ParquetReader:
         # plus the resident blob). Dictionary strings additionally
         # materialize rows x avg-dict-entry flat bytes via gather_spans.
         est = 0
-        for b, pages, nv in parts:
+        for b, pages, nv, _lr in parts:
             est += int(nv) * 17 + int(b.nbytes)
             if leaf.physical == _PT_BYTE_ARRAY:
                 for p in pages:
@@ -613,8 +617,8 @@ class ParquetReader:
                                   // p.num_values)
                         est += int(nv) * int(avg)
         with device_reservation(est) as took:
-            cols = [dd.decode_leaf_device(leaf, blob, pages, rows)
-                    for blob, pages, rows in parts]
+            cols = [dd.decode_leaf_device(leaf, blob, pages, rows, lrows)
+                    for blob, pages, rows, lrows in parts]
             col = cols[0] if len(cols) == 1 else concat_columns(cols)
             release_barrier(col, took)
         return col

@@ -126,3 +126,39 @@ def test_device_sync_budget(tmp_path):
         with budget.measure() as b:
             read_parquet(path)
     assert b.d2h_syncs <= 2, b._summary()
+
+
+def test_one_level_lists_on_device(tmp_path):
+    """Stage-2 lite (round 5): one-level LIST columns decode on-device —
+    rep levels expand with the same hybrid machinery as def levels; list
+    offsets/validity come from rep==0 boundaries and the rep_def
+    threshold (fold_list_levels semantics, vectorized)."""
+    rng = np.random.default_rng(5)
+    n = 3000
+    li = [None if rng.random() < 0.1 else
+          ([] if rng.random() < 0.15 else
+           [None if rng.random() < 0.2 else int(v)
+            for v in rng.integers(-1000, 1000, rng.integers(1, 6))])
+          for _ in range(n)]
+    ls = [None if rng.random() < 0.1 else
+          [f"v{int(v)}" for v in rng.integers(0, 30, rng.integers(0, 4))]
+          for _ in range(n)]
+    t = pa.table({
+        "li": pa.array(li, type=pa.list_(pa.int64())),
+        "ls": pa.array(ls, type=pa.list_(pa.string())),
+        "ld": pa.array(
+            [x if x is None else
+             [None if v is None else float(v) for v in x] for x in li],
+            type=pa.list_(pa.float64())),
+        "flat": pa.array(np.arange(n)),
+    })
+    _roundtrip(tmp_path, t, row_group_size=700)
+
+
+def test_lists_v2_pages_and_codecs(tmp_path):
+    rng = np.random.default_rng(6)
+    n = 1200
+    li = [[int(v) for v in rng.integers(0, 50, rng.integers(0, 5))]
+          for _ in range(n)]
+    t = pa.table({"li": pa.array(li, type=pa.list_(pa.int32()))})
+    _roundtrip(tmp_path, t, data_page_version="2.0", compression="ZSTD")
