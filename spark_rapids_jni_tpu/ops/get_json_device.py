@@ -608,7 +608,64 @@ def _navigate(mat, lens, steps: Tuple):
         for i, byte in enumerate(b"null"):
             is_null = is_null & (_byte_at(mat, s + i) == byte)
         found = found & ~is_null
-    return found, certified, s, e
+    # str_token rides along so the canonical check reuses the masks
+    # instead of re-running the O(n*W) parity scans
+    return found, certified, s, e, str_token
+
+
+@jax.jit
+def _span_is_canonical(mat, lens, s, e, str_token):
+    """bool[n]: Spark's normalization is the IDENTITY on this span — no
+    whitespace (outside-string ws strips; in-string ws is conservatively
+    excluded too), no escapes, and numbers only as plain ints (< 19
+    digits, no '.'/exponent, no '-0') — so the raw span equals the PDA's
+    output byte-for-byte. ``str_token`` comes from _navigate (one mask
+    pass per query, not two)."""
+    n, W = mat.shape
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    in_len = pos < lens[:, None]
+    span = (pos >= s[:, None]) & (pos < e[:, None]) & in_len
+    ws = jnp.asarray(_WS_TAB)[mat.astype(jnp.int32)]
+    dig = jnp.asarray(_DIGIT_TAB)[mat.astype(jnp.int32)]
+    bad = span & (ws | (mat == ord("\\")))
+    outside = span & ~str_token
+    nxt = jnp.concatenate([mat[:, 1:], jnp.zeros((n, 1), mat.dtype)],
+                          axis=1)
+    prev = jnp.concatenate([jnp.zeros((n, 1), mat.dtype), mat[:, :-1]],
+                           axis=1)
+    # a digit running into '.'/'e'/'E' marks a float/exponent token
+    bad = bad | (outside & dig & ((nxt == ord(".")) | (nxt == ord("e"))
+                                  | (nxt == ord("E"))))
+    # '-0' is valid JSON whose canonical double form may differ
+    bad = bad | (outside & (mat == ord("0")) & (prev == ord("-")))
+    # digit runs >= 19 can exceed i64 and re-format
+    D = outside & dig
+    idx = jnp.broadcast_to(pos, (n, W))
+    last_not = lax.associative_scan(jnp.maximum,
+                                    jnp.where(~D, idx, -1), axis=1)
+    bad = bad | (D & ((idx - last_not) >= 19))
+    return ~jnp.any(bad, axis=1)
+
+
+def _select_strings(mask, a: Column, b: Column) -> Column:
+    """Row-wise select between two aligned STRING columns — device
+    gather over their concatenated payloads (no host round trip)."""
+    from ..columnar.strings import gather_spans
+    na = int(a.data.shape[0])
+    ao = jnp.asarray(a.offsets, jnp.int32)
+    bo = jnp.asarray(b.offsets, jnp.int32)
+    la = ao[1:] - ao[:-1]
+    lb = bo[1:] - bo[:-1]
+    av = a.validity if a.validity is not None else \
+        jnp.ones((a.size,), bool)
+    bv = b.validity if b.validity is not None else \
+        jnp.ones((b.size,), bool)
+    data = jnp.concatenate([a.data, b.data]) if na or b.data.shape[0] \
+        else jnp.zeros((0,), jnp.uint8)
+    starts = jnp.where(mask, ao[:-1], na + bo[:-1])
+    lens_out = jnp.where(mask, la, lb)
+    validity = jnp.where(mask, av, bv)
+    return gather_spans(data, starts, lens_out, validity)
 
 
 # ---------------------------------------------------------------------------
@@ -648,18 +705,41 @@ def get_json_object_device(col: Column, ops: Sequence) -> Column:
 
     mat, lens = padded_bytes(col)
     valid_doc = _validate(mat, lens)
-    found, certified, s, e = _navigate(mat, lens, steps)
+    found, certified, s, e, str_token = _navigate(mat, lens, steps)
     base_valid = col.validity if col.validity is not None else \
         jnp.ones((col.size,), bool)
     certified = certified & valid_doc | ~base_valid  # null rows: trivially done
     present = found & valid_doc & certified & base_valid
 
+    # CANONICAL fast path: when a span contains no escapes, no
+    # whitespace, and only plain-integer numbers, Spark's normalization
+    # is the identity — the narrowed span IS the result and the host PDA
+    # has nothing to do. Compact machine-written JSON (the production
+    # norm) takes this path for the entire column.
+    canonical = present & _span_is_canonical(mat, lens, s, e, str_token)
+
     # device -> host: ONE gather of the narrowed spans (the point of the
-    # tier: span bytes, not documents, cross the link)
+    # tier: span bytes, not documents, cross the link). Canonical rows
+    # gather into the output column directly; the rest go through the
+    # PDA with canonical rows zero-length (a "" span normalizes to null
+    # at ~zero cost, keeping one finishing call + an aligned merge).
     offs = jnp.asarray(col.offsets, dtype=jnp.int32)[:-1]
-    spans = gather_spans(col.data, offs + s, e - s, present)
-    # host finishing: the native PDA normalizes each span as its own doc
-    fin = get_json_object_with_instructions(spans, [])
+    spans = gather_spans(col.data, offs + s,
+                         jnp.where(canonical, 0, e - s), present)
+    fin_host = get_json_object_with_instructions(spans, [])
+    can_np = np.asarray(canonical)
+    if bool(can_np.any()):
+        # a string-scalar result unquotes (PDA returns the content);
+        # containers/ints/literals pass through verbatim
+        is_strval = _byte_at(mat, s) == ord('"')
+        ds = jnp.where(is_strval, s + 1, s)
+        de = jnp.where(is_strval, e - 1, e)
+        dev_vals = gather_spans(col.data, offs + ds,
+                                jnp.where(canonical, de - ds, 0),
+                                canonical)
+        fin = _select_strings(canonical, dev_vals, fin_host)
+    else:
+        fin = fin_host
 
     cert_np = np.asarray(certified)
     if bool(cert_np.all()):
