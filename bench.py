@@ -291,44 +291,67 @@ def _headline():
     return n / dt
 
 
+_BENCH_OPS = None
+
+
+def _B():
+    """Lazy benchmarks.bench_ops import so axis_table() is cheap to call
+    for its NAMES (ci/tpu_window2.py derives its axis list from it without
+    paying the jax import)."""
+    global _BENCH_OPS
+    if _BENCH_OPS is None:
+        from benchmarks import bench_ops as B
+        B._refresh_variants()
+        _BENCH_OPS = B
+    return _BENCH_OPS
+
+
+def axis_table():
+    """The sweep's axis list — THE single source of truth (order included).
+
+    Consumed by _sweep here, by ci/axis_runner.py (name -> thunk), and by
+    ci/tpu_window2.py (capture order); keeping one table prevents the
+    three-way drift a review flagged when each site carried its own copy.
+    """
+    # Priority reflects what is still unproven on-chip after round-5
+    # window 1 (BENCH_tpu.json): the post-rework composed ops lead —
+    # groupby/join/q1/row-conversion are the axes the round-4 verdict
+    # calls "the whole ballgame" and the relay wedge cost them in both
+    # captured windows. The scale axes follow (the compute-bound regime
+    # the dispatch-bound 1M axes amortize into at reference-workload
+    # sizes; ~10-40 ms RPC per program + 16-64 ms per host sync,
+    # docs/TPU_PERF.md). q5/q6 re-measures come late (already captured
+    # in window 1), and parquet_decode runs DEAD LAST: window 1 wedged
+    # inside it, and an axis that can wedge the relay must never again
+    # cost the axes behind it.
+    return [
+        ("groupby_1m", lambda: _B().bench_groupby(1 << 20), 1 << 20),
+        ("join_1m", lambda: _B().bench_join(1 << 20), 1 << 20),
+        ("tpch_q1_1m", lambda: _B().bench_tpch_q1(1 << 20), 1 << 20),
+        ("row_conversion_fixed_1m", lambda: _B().bench_row_conversion(1 << 20, False), 1 << 20),
+        ("row_conversion_strings_1m", lambda: _B().bench_row_conversion(1 << 20, True), 1 << 20),
+        ("tpch_q1_8m", lambda: _B().bench_tpch_q1(1 << 23), 1 << 23),
+        ("groupby_16m", lambda: _B().bench_groupby(1 << 24), 1 << 24),
+        ("tpch_q3_1m", lambda: _B().bench_tpch_q3(1 << 20), 1 << 20),
+        ("row_conversion_fixed_4m", lambda: _B().bench_row_conversion(1 << 22, False), 1 << 22),
+        ("row_conversion_strings_4m", lambda: _B().bench_row_conversion(1 << 22, True), 1 << 22),
+        ("sort_1m", lambda: _B().bench_sort(1 << 20), 1 << 20),
+        ("bloom_filter_1m", lambda: _B().bench_bloom_filter(1 << 20), 1 << 20),
+        ("cast_string_to_float_500k", lambda: _B().bench_cast_string_to_float(500_000), 500_000),
+        ("parse_uri_200k", lambda: _B().bench_parse_uri(200_000), 200_000),
+        ("get_json_object_200k", lambda: _B().bench_get_json_object(200_000), 200_000),
+        ("tpch_q6_1m", lambda: _B().bench_tpch_q6(1 << 20), 1 << 20),
+        ("tpch_q5_1m", lambda: _B().bench_tpch_q5(1 << 20), 1 << 20),
+        ("shuffle_skewed_1m", lambda: _B().bench_shuffle_skewed(1 << 20), 1 << 20),
+        ("parquet_decode_1m", lambda: _B().bench_parquet_decode(1 << 20), 1 << 20),
+    ]
+
+
 def _sweep(deadline):
     """Run every benchmark axis (benchmarks/bench_ops.py implementations)
     until the deadline; per-axis failures and skips are recorded, never
     fatal. Returns {axis: {rows, seconds, mrows_per_s, gb_per_s} | {...}}."""
-    from benchmarks import bench_ops as B
-    B._refresh_variants()
-
-    # Zero-TPU-evidence axes lead: under a truncated or wedged window the
-    # sweep deadline is the scarce resource, and a never-measured axis is
-    # worth more than a re-measurement (q5/q6, the skewed shuffle and the
-    # 4M row-conversion points have never landed on-chip — the two captured
-    # windows spent their budget on the 1M axes and then wedged). Their
-    # compiles also seed the persistent cache for the later axes.
-    axes = [
-        ("tpch_q6_1m", lambda: B.bench_tpch_q6(1 << 20), 1 << 20),
-        ("tpch_q5_1m", lambda: B.bench_tpch_q5(1 << 20), 1 << 20),
-        ("shuffle_skewed_1m", lambda: B.bench_shuffle_skewed(1 << 20), 1 << 20),
-        ("parquet_decode_1m", lambda: B.bench_parquet_decode(1 << 20), 1 << 20),
-        ("row_conversion_fixed_4m", lambda: B.bench_row_conversion(1 << 22, False), 1 << 22),
-        ("row_conversion_strings_4m", lambda: B.bench_row_conversion(1 << 22, True), 1 << 22),
-        # scale axes: the 1M pipeline axes are dispatch-bound on the axon
-        # backend (~10-40 ms RPC per program + 16-64 ms per host sync,
-        # docs/TPU_PERF.md) — these measure the compute-bound regime the
-        # fixed per-op costs amortize into at reference-workload sizes
-        ("tpch_q1_8m", lambda: B.bench_tpch_q1(1 << 23), 1 << 23),
-        ("groupby_16m", lambda: B.bench_groupby(1 << 24), 1 << 24),
-        ("groupby_1m", lambda: B.bench_groupby(1 << 20), 1 << 20),
-        ("join_1m", lambda: B.bench_join(1 << 20), 1 << 20),
-        ("tpch_q1_1m", lambda: B.bench_tpch_q1(1 << 20), 1 << 20),
-        ("tpch_q3_1m", lambda: B.bench_tpch_q3(1 << 20), 1 << 20),
-        ("row_conversion_fixed_1m", lambda: B.bench_row_conversion(1 << 20, False), 1 << 20),
-        ("row_conversion_strings_1m", lambda: B.bench_row_conversion(1 << 20, True), 1 << 20),
-        ("sort_1m", lambda: B.bench_sort(1 << 20), 1 << 20),
-        ("bloom_filter_1m", lambda: B.bench_bloom_filter(1 << 20), 1 << 20),
-        ("cast_string_to_float_500k", lambda: B.bench_cast_string_to_float(500_000), 500_000),
-        ("parse_uri_200k", lambda: B.bench_parse_uri(200_000), 200_000),
-        ("get_json_object_200k", lambda: B.bench_get_json_object(200_000), 200_000),
-    ]
+    axes = axis_table()
     results = _STATE["axes"]  # shared: the stall watchdog emits this dict
     for name, fn, rows in axes:
         left = deadline - time.monotonic()

@@ -1,0 +1,76 @@
+"""One benchmark axis in a disposable process (window-2 capture unit).
+
+Usage: python ci/axis_runner.py <axis_name> [repeats]
+
+Why a process per axis: both captured TPU windows (round 4 and round 5
+window 1) died MID-AXIS — the relay wedges inside a device call, where no
+in-process watchdog can recover the thread (it is stuck in C with the GIL
+released). bench.py answers that with a stall watchdog that emits the
+partial sweep; this runner inverts the design so the parent never needs
+recovery at all: each axis runs in its own process, the parent enforces a
+wall-clock budget with SIGKILL, and an axis that wedges costs exactly its
+budget while every completed axis is already durable (committed by
+ci/tpu_window2.py). The persistent XLA compile cache (enabled at package
+import) makes the per-process re-init cost ~72 ms/program, not ~0.9 s.
+
+Protocol per axis matches bench.py (median of N repeats, first repeat pays
+compile); emits ONE JSON line on stdout. Exit 3 = no accelerator (parent
+skips, nothing recorded). Exit 0 = the JSON line is a real measurement.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+# launched as `python ci/axis_runner.py`, so sys.path[0] is ci/ — put the
+# repo root first like every other ci/ script (tpu_smoke.py, tpu_pressure.py)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    axis = sys.argv[1]
+    repeats = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    # No subprocess pre-probe here: the parent daemon probed the tunnel
+    # seconds ago, and a redundant 240 s probe inside the axis budget
+    # would turn healthy-but-slow axes into spurious 'wedged'
+    # classifications. If the tunnel wedged in between, the in-process
+    # init below hangs and the parent's SIGKILL budget handles it.
+    import bench
+    import jax
+    backend = jax.devices()[0].platform
+    if backend == "cpu":
+        print(json.dumps({"axis": axis, "backend": "cpu"}))
+        return 3
+
+    # single source of truth for names/thunks/rows: bench.axis_table()
+    axes = {n: (f, r) for n, f, r in bench.axis_table()}
+    fn, rows = axes[axis]
+
+    secs, nbytes = [], 0
+    for _ in range(repeats):
+        t = time.monotonic()
+        sec, nbytes = fn()
+        secs.append(sec)
+        print(f"axis_runner: {axis} repeat {len(secs)} {sec:.3f}s "
+              f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
+    secs.sort()
+    med = statistics.median(secs)
+    print(json.dumps({
+        "axis": axis,
+        "backend": backend,
+        "rows": rows,
+        "seconds": round(med, 5),
+        "seconds_min": round(secs[0], 5),
+        "repeats": len(secs),
+        "mrows_per_s": round(rows / med / 1e6, 2),
+        "mrows_per_s_best": round(rows / secs[0] / 1e6, 2),
+        "gb_per_s": round(nbytes / med / 1e9, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,25 @@ def _script_running(*needles):
     return False
 
 
+def commit_paths(files, msg, attempts=10, sleep_s=30):
+    """Pathspec'd add+commit with index.lock retry (the main build session
+    commits concurrently). Pathspec'd so the commit never sweeps up
+    whatever the concurrent session has staged mid-commit. Shared by this
+    daemon and ci/tpu_window2.py."""
+    for attempt in range(attempts):
+        subprocess.run(["git", "add", "--"] + files, cwd=REPO,
+                       capture_output=True, text=True)
+        cm = subprocess.run(["git", "commit", "-m", msg, "--"] + files,
+                            cwd=REPO, capture_output=True, text=True)
+        if cm.returncode == 0:
+            log(f"committed: {msg}")
+            return True
+        log(f"git commit attempt {attempt + 1} failed: "
+            f"{(cm.stderr or cm.stdout)[-200:]}")
+        time.sleep(sleep_s)
+    return False
+
+
 def _wait_for_quiet_cpu(max_wait_s=3600):
     """Hold the capture while a pytest run owns the core: the bench must
     run SOLO or its host-side phases absorb the contention (±2x observed
@@ -97,8 +116,13 @@ def _wait_for_quiet_cpu(max_wait_s=3600):
         # capped (editor false-positives), so a capture could otherwise
         # start while the driver's own round-end bench still runs and
         # commit contention-distorted evidence. A real bench exits, so
-        # max_wait_s still bounds this.
-        if not _script_running("pytest", "py.test", "bench.py"):
+        # max_wait_s still bounds this. The window-2 daemon's measurement
+        # processes (ci/tpu_window2.py) are held on too — two capture
+        # daemons measuring concurrently on the 1-core container would
+        # commit mutually-distorted medians as on-chip evidence.
+        if not _script_running("pytest", "py.test", "bench.py",
+                               "axis_runner.py", "tpu_smoke.py",
+                               "tpu_pressure.py"):
             return
         log("capture: pytest/bench is running — holding for a solo window")
         time.sleep(60)
@@ -120,7 +144,7 @@ def run_capture():
     for ln in (b.stdout or "").splitlines():
         try:
             j = json.loads(ln)
-            if "metric" in j:
+            if isinstance(j, dict) and "metric" in j:
                 bench_line = j
         except ValueError:
             continue
@@ -147,10 +171,31 @@ def run_capture():
         for ln in (s.stdout or "").splitlines():
             try:
                 j = json.loads(ln)
-                if "checks" in j:
+                if isinstance(j, dict) and "checks" in j:
                     smoke_line = j
             except ValueError:
                 continue
+        if smoke_line and smoke_line.get("backend") == "cpu":
+            # the tunnel died between bench and smoke (observed round-5
+            # window 1): a CPU fallback record must never replace an
+            # on-chip SMOKE_tpu.json — park it in capture/ (timestamped,
+            # force-added: capture/ is gitignored) so the evidence is
+            # durable and successive fallbacks cannot overwrite each other
+            park = os.path.join(
+                "capture",
+                f"smoke_cpu_fallback_{time.strftime('%Y%m%dT%H%M%S')}.json")
+            with open(os.path.join(REPO, park), "w") as f:
+                json.dump(smoke_line, f, indent=1)
+            subprocess.run(["git", "add", "-f", "--", park], cwd=REPO,
+                           capture_output=True)
+            subprocess.run(
+                ["git", "commit", "-m",
+                 f"Park CPU-fallback smoke record ({park}): tunnel died "
+                 f"between bench and smoke", "--", park],
+                cwd=REPO, capture_output=True)
+            log(f"capture: smoke fell back to CPU — parked+committed {park}, "
+                "SMOKE_tpu.json untouched")
+            smoke_line = None
         if smoke_line:
             with open(os.path.join(REPO, "SMOKE_tpu.json"), "w") as f:
                 json.dump(smoke_line, f, indent=1)
@@ -170,21 +215,7 @@ def run_capture():
            + (f", smoke {smoke_line.get('passed')}/"
               f"{smoke_line.get('passed', 0) + smoke_line.get('failed', 0)}"
               if smoke_line else ""))
-    committed = False
-    for attempt in range(10):  # index.lock contention with the main session
-        subprocess.run(["git", "add", "--"] + files, cwd=REPO,
-                       capture_output=True, text=True)
-        # pathspec'd commit: must not sweep up whatever the concurrent main
-        # session has staged mid-commit
-        cm = subprocess.run(["git", "commit", "-m", msg, "--"] + files,
-                            cwd=REPO, capture_output=True, text=True)
-        if cm.returncode == 0:
-            log(f"capture: committed ({msg})")
-            committed = True
-            break
-        log(f"capture: git commit attempt {attempt + 1} failed: "
-            f"{(cm.stderr or cm.stdout)[-200:]}")
-        time.sleep(30)
+    committed = commit_paths(files, msg, attempts=10)
     if not committed:
         # evidence exists only in the working tree; stay alive and retry the
         # whole capture on the next healthy probe rather than declaring done
@@ -202,7 +233,7 @@ def run_capture():
         for ln in (p.stdout or "").splitlines():
             try:
                 j = json.loads(ln)
-                if "real_alloc_failures" in j:
+                if isinstance(j, dict) and "real_alloc_failures" in j:
                     pressure_line = j
             except ValueError:
                 continue

@@ -64,14 +64,17 @@ def test_bench_py_stall_watchdog_emits_partial():
     the old bench hung forever with the headline + finished axes unemitted.
     The stall watchdog must turn that hang into a partial JSON emit (post-
     headline) with the in-flight axis marked wedged."""
+    import bench
+    first_axis = bench.axis_table()[0][0]
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
                BENCH_SWEEP_DEADLINE_S="600", BENCH_PROBE_ATTEMPTS="1",
                BENCH_PROBE_TIMEOUT_S="120", BENCH_REPEATS="1",
                BENCH_STALL_S="3",
-               # stall on the sweep's FIRST axis: the hook fires before any
+               # stall on the sweep's FIRST axis (derived, so axis-order
+               # changes can't break this test): the hook fires before any
                # axis work, so the tiny stall threshold cannot false-trigger
                # on a slow axis setup earlier in the order
-               _BENCH_TEST_STALL="tpch_q6_1m")
+               _BENCH_TEST_STALL=first_axis)
     proc = subprocess.run(
         [sys.executable, "bench.py"], capture_output=True, text=True,
         cwd=__file__.rsplit("/", 2)[0], timeout=600, env=env)
@@ -79,7 +82,7 @@ def test_bench_py_stall_watchdog_emits_partial():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["value"] > 0  # the headline still made it out
     assert "partial" in rec.get("note", "")
-    assert "wedged" in rec["axes"]["tpch_q6_1m"]["error"]
+    assert "wedged" in rec["axes"][first_axis]["error"]
 
 
 def test_every_sweep_axis_function_runs_small():
