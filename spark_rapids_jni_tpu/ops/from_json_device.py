@@ -1,0 +1,272 @@
+"""Device tier for `from_json` raw-map extraction: on-device pair spans.
+
+Reference analog: map_utils.cu:649 runs the whole tokenize + extract on
+the accelerator. This tier is the TPU expression of the same split the
+get_json hybrid uses (ops/get_json_device.py): the O(bytes) scan work —
+string masks, depth, grammar validation, and locating every top-level
+``key: value`` pair — runs as vectorized [n, W] planes on the device, and
+the packed span BYTES (keys + values, typically a large fraction of a
+raw-map's source, but never the punctuation/whitespace/nesting overhead)
+are the only data that crosses the link. The host does offset arithmetic
+only; there is no host-side parsing on the certified path.
+
+Output contract matches the host tier (ops/map_utils.py): per row, the
+top-level pairs of a JSON OBJECT as LIST<STRUCT<key STRING, value
+STRING>> — keys and string values unescaped, container values kept as
+raw source spans, scalar values as literal text; null / invalid /
+non-object rows become null rows.
+
+Certification: a row containing ANY backslash routes to the host tier
+(native PDA) — unescaping is the one transform spans cannot express.
+That is deliberately coarser than "escape inside a key/string-value
+span" (a backslash inside a *nested* container value would be span-safe)
+but machine-written JSON rarely escapes, and a conservative reroute only
+costs throughput on those rows, never correctness. The differential fuzz
+in tests/test_from_json_device.py pins tier equivalence.
+
+Host-sync budget: 3 — the head transfer (counts/validity/certification,
+one stacked array) plus one output-sizing sync inside each of the two
+span gathers (keys, values). All shapes are bucketed (utils/shapes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+from ..columnar.strings import gather_spans, padded_bytes
+from ..utils.shapes import bucket_size
+from ..utils.tracing import func_range
+from .get_json_device import _depth, _string_masks, _validate
+
+_BIG = jnp.int32(1 << 30)
+
+
+def _rev_min_scan(vals):
+    return lax.associative_scan(jnp.minimum, vals[:, ::-1], axis=1)[:, ::-1]
+
+
+def _fwd_max_scan(vals):
+    return lax.associative_scan(jnp.maximum, vals, axis=1)
+
+
+@jax.jit
+def _scan_objects(mat, lens):
+    """Row-level head: (valid_and_object, pair_count, has_backslash)."""
+    real_quote, str_token, escaped, in_len = _string_masks(mat, lens)
+    valid_doc = _validate(mat, lens)
+    d, opens, closes = _depth(mat, str_token, in_len)
+    n, W = mat.shape
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    ws = ((mat == 0x20) | (mat == 0x09) | (mat == 0x0A) | (mat == 0x0D))
+    nonws = ~ws & in_len
+    first_nb = jnp.argmax(nonws, axis=1).astype(jnp.int32)
+    has_nb = jnp.any(nonws, axis=1)
+    first_byte = mat[jnp.arange(n), jnp.clip(first_nb, 0, W - 1)]
+    is_obj = has_nb & (first_byte == ord("{"))
+    dep1 = (d == 1) & ~str_token & in_len
+    colon = (mat == ord(":")) & dep1
+    counts = jnp.sum(colon, axis=1).astype(jnp.int32)
+    has_bs = jnp.any((mat == ord("\\")) & in_len, axis=1)
+    return valid_doc & is_obj, counts, has_bs
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _pair_plan(mat, lens, row_take, P: int):
+    """Span planes for the first P top-level pairs of each taken row.
+
+    Returns flat [n*P] (key_start, key_len, val_start, val_len) in row
+    coordinates; lengths are 0 for dead pairs and rows not in
+    ``row_take``, so a downstream flat-byte gather packs exactly the
+    live spans in (row, pair) order.
+    """
+    real_quote, str_token, escaped, in_len = _string_masks(mat, lens)
+    d, opens, closes = _depth(mat, str_token, in_len)
+    n, W = mat.shape
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+    pos2d = jnp.broadcast_to(pos, (n, W))
+    rows2d = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                              (n, W))
+    ws = ((mat == 0x20) | (mat == 0x09) | (mat == 0x0A) | (mat == 0x0D))
+    nonws = ~ws & in_len
+    dep1 = (d == 1) & ~str_token & in_len
+    colon = (mat == ord(":")) & dep1
+
+    # pair p's colon position, via cumsum-slot scatter (no sort)
+    slots = jnp.where(colon,
+                      jnp.minimum(jnp.cumsum(colon, axis=1) - 1, P), P)
+    ci_grid = jnp.full((n, P + 1), 0, jnp.int32) \
+        .at[rows2d, slots].set(pos2d, mode="drop")[:, :P]
+    live = ci_grid > 0  # colon can never sit at byte 0 of a valid object
+    ci = jnp.where(live, ci_grid, 1)
+    rowsP = jnp.arange(n, dtype=jnp.int32)[:, None]
+
+    # key span: close quote = last real quote before the colon; open
+    # quote = the preceding real quote by rank (string-token quotes pair
+    # consecutively because of the parity construction in _string_masks)
+    last_q = _fwd_max_scan(jnp.where(real_quote, pos2d, -1))
+    kq_close = jnp.take_along_axis(last_q, ci - 1, axis=1)
+    qrank = jnp.cumsum(real_quote.astype(jnp.int32), axis=1) - 1
+    qslots = jnp.where(real_quote, jnp.minimum(qrank, W - 1), W)
+    qidx_by_rank = jnp.zeros((n, W + 1), jnp.int32) \
+        .at[rows2d, qslots].set(pos2d, mode="drop")
+    close_rank = jnp.take_along_axis(qrank, jnp.clip(kq_close, 0, W - 1),
+                                     axis=1)
+    kq_open = jnp.take_along_axis(
+        qidx_by_rank, jnp.clip(close_rank - 1, 0, W), axis=1)
+    key_s = kq_open + 1
+    key_len = jnp.maximum(kq_close - key_s, 0)
+
+    # value span: first non-ws after the colon .. last non-ws before the
+    # next depth-1 separator (',' at depth 1, or the root '}')
+    nxt_nb = _rev_min_scan(jnp.where(nonws, pos2d, _BIG))
+    val_s = jnp.take_along_axis(nxt_nb, jnp.clip(ci + 1, 0, W - 1), axis=1)
+    sep = ((mat == ord(",")) & dep1) | (closes & (d == 0))
+    nxt_sep = _rev_min_scan(jnp.where(sep, pos2d, _BIG))
+    sep_i = jnp.take_along_axis(nxt_sep, jnp.clip(ci + 1, 0, W - 1), axis=1)
+    prev_nb = _fwd_max_scan(jnp.where(nonws, pos2d, -1))
+    val_e = jnp.take_along_axis(
+        prev_nb, jnp.clip(sep_i - 1, 0, W - 1), axis=1) + 1
+    # string values: the span is the unescaped content (quotes stripped);
+    # certification guarantees no escapes, so content IS the raw bytes
+    vb = jnp.take_along_axis(mat, jnp.clip(val_s, 0, W - 1), axis=1)
+    is_strv = vb == ord('"')
+    val_s = jnp.where(is_strv, val_s + 1, val_s)
+    val_e = jnp.where(is_strv, val_e - 1, val_e)
+    val_len = jnp.maximum(val_e - val_s, 0)
+
+    take = live & row_take[:, None]
+    key_len = jnp.where(take, key_len, 0)
+    val_len = jnp.where(take, val_len, 0)
+    return (key_s.reshape(-1), key_len.reshape(-1),
+            val_s.reshape(-1), val_len.reshape(-1))
+
+
+def _grouped_slots(list_offs, rows_idx, counts):
+    """Final pair-slot index for each (row, within-row pair), vectorized:
+    repeat(list_offs[row]) + within-row arange."""
+    tot = int(counts.sum())
+    if tot == 0:
+        return np.zeros(0, np.int64)
+    starts = np.repeat(list_offs[rows_idx], counts)
+    within = np.arange(tot) - np.repeat(np.cumsum(counts) - counts, counts)
+    return starts + within
+
+
+def _fill_bytes(dst, dst_offs, slots, src, src_offs, src_sel):
+    """dst[dst_offs[slots[i]] : +len] = src bytes of selected entry i."""
+    lens = (src_offs[1:] - src_offs[:-1])[src_sel]
+    tot = int(lens.sum())
+    if tot == 0:
+        return
+    dst_start = np.repeat(dst_offs[slots], lens)
+    src_start = np.repeat(src_offs[:-1][src_sel], lens)
+    within = np.arange(tot) - np.repeat(np.cumsum(lens) - lens, lens)
+    dst[dst_start + within] = src[src_start + within]
+
+
+@func_range()
+def extract_raw_map_device(col: Column) -> Column:
+    """Hybrid from_json: device pair-span extraction, host-tier fallback
+    for rows with escapes. See module docstring."""
+    from .map_utils import _extract_raw_map_host as host_tier
+
+    n = col.size
+    if n == 0:
+        return host_tier(col)
+    mat, lens = padded_bytes(col)
+    rowok_d, counts_d, has_bs_d = _scan_objects(mat, lens)
+    base_valid = (np.ones(n, bool) if col.validity is None
+                  else np.asarray(col.validity).astype(bool))
+    head = np.asarray(jnp.stack([counts_d,
+                                 rowok_d.astype(jnp.int32),
+                                 has_bs_d.astype(jnp.int32)]))  # one sync
+    counts_h = head[0].astype(np.int64)
+    rowok = head[1].astype(bool) & base_valid
+    has_bs = head[2].astype(bool)
+    cert = rowok & ~has_bs
+    fb = rowok & has_bs
+
+    P = bucket_size(int(counts_h[cert].max()) if cert.any() else 0, floor=8)
+    if P:
+        ks, kl, vs, vl = _pair_plan(mat, lens, jnp.asarray(cert), P)
+        base = jnp.repeat(jnp.asarray(col.offsets, jnp.int32)[:-1], P)
+        keys_packed = gather_spans(col.data, base + ks, kl, None)
+        vals_packed = gather_spans(col.data, base + vs, vl, None)
+        kb = np.asarray(keys_packed.data)
+        k_offs = np.asarray(keys_packed.offsets).astype(np.int64)
+        vb = np.asarray(vals_packed.data)
+        v_offs = np.asarray(vals_packed.offsets).astype(np.int64)
+        grid = (np.arange(P)[None, :]
+                < np.where(cert, counts_h, 0)[:, None])
+        live_flat = grid.ravel()
+    else:
+        kb = vb = np.zeros(0, np.uint8)
+        k_offs = v_offs = np.zeros(1, np.int64)
+        live_flat = np.zeros(0, bool)
+
+    # fallback rows (escapes): the native PDA evaluates just those rows
+    fb_pairs = {}
+    if fb.any():
+        idxs = np.flatnonzero(fb)
+        hd = col.host_data().tobytes()
+        ho = col.host_offsets()
+        sub = Column.from_pylist(
+            [hd[ho[i]:ho[i + 1]].decode("utf-8", "surrogateescape")
+             for i in idxs], dt.STRING)
+        for j, row_pairs in enumerate(host_tier(sub).to_pylist()):
+            fb_pairs[idxs[j]] = row_pairs or []
+
+    counts_f = np.where(cert, counts_h, 0)
+    for i, pairs in fb_pairs.items():
+        counts_f[i] = len(pairs)
+    list_offs = np.concatenate([[0], np.cumsum(counts_f)]).astype(np.int64)
+    m = int(list_offs[-1])
+
+    # per-pair final lengths: certified pairs vectorized, fallback looped
+    key_lens_f = np.zeros(m, np.int64)
+    val_lens_f = np.zeros(m, np.int64)
+    cert_rows = np.flatnonzero(cert)
+    cslots = _grouped_slots(list_offs, cert_rows, counts_f[cert_rows])
+    k_lens_flat = k_offs[1:] - k_offs[:-1]
+    v_lens_flat = v_offs[1:] - v_offs[:-1]
+    key_lens_f[cslots] = k_lens_flat[live_flat]
+    val_lens_f[cslots] = v_lens_flat[live_flat]
+    fb_enc = {}
+    for i, pairs in fb_pairs.items():
+        enc = [(k.encode("utf-8", "surrogateescape"),
+                v.encode("utf-8", "surrogateescape") if v is not None
+                else b"") for (k, v) in pairs]
+        fb_enc[i] = enc
+        s = list_offs[i]
+        for j, (ke, ve) in enumerate(enc):
+            key_lens_f[s + j] = len(ke)
+            val_lens_f[s + j] = len(ve)
+
+    key_offs_f = np.concatenate([[0], np.cumsum(key_lens_f)])
+    val_offs_f = np.concatenate([[0], np.cumsum(val_lens_f)])
+    key_blob = np.zeros(int(key_offs_f[-1]), np.uint8)
+    val_blob = np.zeros(int(val_offs_f[-1]), np.uint8)
+    _fill_bytes(key_blob, key_offs_f, cslots, kb, k_offs, live_flat)
+    _fill_bytes(val_blob, val_offs_f, cslots, vb, v_offs, live_flat)
+    for i, enc in fb_enc.items():
+        s = list_offs[i]
+        for j, (ke, ve) in enumerate(enc):
+            key_blob[key_offs_f[s + j]:key_offs_f[s + j] + len(ke)] = \
+                np.frombuffer(ke, np.uint8)
+            val_blob[val_offs_f[s + j]:val_offs_f[s + j] + len(ve)] = \
+                np.frombuffer(ve, np.uint8)
+
+    keys = Column(dt.STRING, m, data=jnp.asarray(key_blob),
+                  offsets=jnp.asarray(key_offs_f.astype(np.int32)))
+    vals = Column(dt.STRING, m, data=jnp.asarray(val_blob),
+                  offsets=jnp.asarray(val_offs_f.astype(np.int32)))
+    struct = Column.struct_of([keys, vals])
+    return Column.list_of(struct, jnp.asarray(list_offs.astype(np.int32)),
+                          validity=jnp.asarray(rowok))

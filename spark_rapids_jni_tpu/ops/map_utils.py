@@ -41,7 +41,23 @@ def _declare(lib):
 
 @func_range()
 def extract_raw_map_from_json_string(col: Column) -> Column:
-    """LIST<STRUCT<key STRING, value STRING>> of each row's top-level pairs."""
+    """LIST<STRUCT<key STRING, value STRING>> of each row's top-level pairs.
+
+    Tier dispatch mirrors parse_url/get_json_object: on accelerator
+    backends the pair-span extraction runs on-device
+    (ops/from_json_device.py) so documents never round-trip through the
+    host; the native PDA below is the CPU tier and the per-row fallback
+    for rows the device cannot certify (escapes).
+    """
+    from ..utils.backend import tier_is_device
+    if tier_is_device("from_json.tier"):
+        from .from_json_device import extract_raw_map_device
+        return extract_raw_map_device(col)
+    return _extract_raw_map_host(col)
+
+
+def _extract_raw_map_host(col: Column) -> Column:
+    """The native-PDA (host) tier; also the device tier's fallback."""
     assert col.dtype.id is dt.TypeId.STRING
     lib = _declare(_load())
     c = ctypes

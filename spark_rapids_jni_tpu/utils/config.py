@@ -90,6 +90,10 @@ _register("get_json.tier", "SRJT_GET_JSON_TIER", "auto", str,
           "get_json_object execution: auto (device scan+navigate on "
           "accelerators for KEY/INDEX paths, host PDA normalizes the "
           "narrowed spans) | device | native")
+_register("from_json.tier", "SRJT_FROM_JSON_TIER", "auto", str,
+          "from_json raw-map execution: auto (device pair-span extraction "
+          "on accelerators, rows with escapes fall back to the native "
+          "PDA) | device | native")
 
 
 def get(key: str) -> Any:

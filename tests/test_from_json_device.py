@@ -1,0 +1,127 @@
+"""Device tier for from_json raw-map extraction: differential vs the
+native-PDA host tier (ops/from_json_device.py vs ops/map_utils.py).
+
+The device tier's correctness claim is tier EQUIVALENCE: for every row,
+the on-device pair-span extraction (or its per-row escape fallback) must
+produce exactly what the native PDA produces. Reference behavior anchor:
+MapUtils.java:47-53 / map_utils.cu:649 (keys + string values unescaped,
+container values raw spans, scalars literal text, invalid/non-object
+rows null).
+"""
+
+import json
+import random
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.from_json_device import extract_raw_map_device
+from spark_rapids_jni_tpu.ops.map_utils import (
+    _extract_raw_map_host, extract_raw_map_from_json_string)
+from spark_rapids_jni_tpu.utils import config
+
+
+def _both(docs):
+    col = Column.from_pylist(docs, dt.STRING)
+    dev = extract_raw_map_device(col).to_pylist()
+    host = _extract_raw_map_host(col).to_pylist()
+    return dev, host
+
+
+EDGES = [
+    '{"a":1,"b":"x"}',
+    None,
+    "{}",
+    "[1,2]",                      # non-object -> null
+    "notjson",
+    '{"n":{"m":[1,2]},"s":"tail"}',
+    '{ "k" : [ 1 , 2 ] , "q" : null }',
+    '{"":""}',                     # empty key, empty string value
+    '{"u":"éß中"}',  # multi-byte utf-8
+    '{"deep":{"x":{"y":"z,w"}},"t":true}',
+    '  {"ws":  42  }  ',
+    '{"dup":1,"dup":2}',           # duplicate keys preserved in order
+    '{"esc":"a\\nb"}',             # escape -> host fallback row
+    '{"k\\"q":1}',                 # escaped quote in KEY -> fallback
+    '{"num":-1.5e-3,"z":0}',
+    '{"a":1',                      # truncated -> null
+    '{"a":1}}',                    # trailing garbage -> null
+    '{"a" 1}',                     # missing colon -> null
+    '{"s":"unterminated}',         # unterminated string -> null
+    "",                            # empty string -> null
+    '{"arr":[{"inner":1},{"inner":2}],"last":"v"}',
+]
+
+
+def test_edges_match_host_tier():
+    dev, host = _both(EDGES)
+    for i, (d, h) in enumerate(zip(dev, host)):
+        assert d == h, f"row {i} ({EDGES[i]!r}): device {d!r} host {h!r}"
+
+
+def test_public_entry_dispatches_by_tier():
+    docs = ['{"a":1}', '{"b":"s"}']
+    col = Column.from_pylist(docs, dt.STRING)
+    with config.override("from_json.tier", "device"):
+        dev = extract_raw_map_from_json_string(col).to_pylist()
+    with config.override("from_json.tier", "native"):
+        host = extract_raw_map_from_json_string(col).to_pylist()
+    assert dev == host == [[("a", "1")], [("b", "s")]]
+
+
+def test_all_null_and_empty_column():
+    dev, host = _both([None, None, None])
+    assert dev == host == [None, None, None]
+    col = Column.from_pylist([], dt.STRING)
+    assert extract_raw_map_device(col).to_pylist() == []
+
+
+def test_wide_object_crosses_pair_bucket():
+    # > 8 pairs forces the pair plan past the bucket floor
+    doc = "{" + ",".join(f'"k{i}":{i}' for i in range(23)) + "}"
+    dev, host = _both([doc, "{}", doc])
+    assert dev == host
+    assert len(dev[0]) == 23
+
+
+def _rand_value(rng, depth, escapes):
+    kind = rng.randrange(7 if depth < 2 else 5)
+    if kind == 0:
+        return rng.choice([0, 1, -7, 123456, -1.5, 2.25e-3, 1e9])
+    if kind == 1:
+        chars = "abcXYZ09 _,:{}[]" + ("\\\n\"\té" if escapes else "中")
+        return "".join(rng.choice(chars) for _ in range(rng.randrange(9)))
+    if kind == 2:
+        return rng.choice([True, False, None])
+    if kind == 3:
+        return rng.choice(["", " ", "x" * 40])
+    if kind == 4:
+        return rng.choice([7, "s"])
+    if kind == 5:
+        return [_rand_value(rng, depth + 1, escapes)
+                for _ in range(rng.randrange(4))]
+    return {f"n{j}": _rand_value(rng, depth + 1, escapes)
+            for j in range(rng.randrange(4))}
+
+
+@pytest.mark.parametrize("seed,escapes", [(1, False), (2, False), (3, True)])
+def test_fuzz_differential(seed, escapes):
+    rng = random.Random(seed)
+    docs = []
+    for _ in range(250):
+        r = rng.random()
+        if r < 0.08:
+            docs.append(None)
+        elif r < 0.16:
+            docs.append(rng.choice(
+                ["[1]", "12", '"s"', "tru", "{", "", "{]", '{"a":}']))
+        else:
+            obj = {f"k{j}" + ("ß" if rng.random() < 0.1 else ""):
+                   _rand_value(rng, 0, escapes)
+                   for j in range(rng.randrange(6))}
+            sep = rng.choice([(",", ":"), (", ", " : "), (",\n", ":\t")])
+            docs.append(json.dumps(obj, ensure_ascii=False, separators=sep))
+    dev, host = _both(docs)
+    for i, (d, h) in enumerate(zip(dev, host)):
+        assert d == h, f"seed {seed} row {i} ({docs[i]!r}):\n  {d!r}\n  {h!r}"
