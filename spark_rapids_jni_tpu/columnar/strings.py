@@ -126,12 +126,26 @@ def from_padded_bytes(mat: np.ndarray, lengths: np.ndarray,
 
 
 def gather_spans(src: jnp.ndarray, starts: jnp.ndarray,
-                 lengths: jnp.ndarray, validity) -> Column:
+                 lengths: jnp.ndarray, validity,
+                 pad_to_bucket: bool = False) -> Column:
     """STRING column from per-row (start, length) spans over flat source
     bytes — the shared device extraction used by the span-producing ops
     (parse_url device tier, dictionary-string Parquet decode). One
-    output-sizing sync; everything else is a flat-byte gather."""
+    output-sizing sync; everything else is a flat-byte gather.
+
+    ``pad_to_bucket=True`` sizes the gather program at
+    bucket_size(total) and returns the data buffer zero-padded to that
+    bucket (offsets stay exact). The repeat/gather program then caches
+    per BUCKET instead of per exact byte total — without it, every
+    distinct total compiles a fresh program (~0.9 s cold / 72 ms warm
+    through the axon remote-compile helper, docs/TPU_PERF.md), a
+    per-call cost in production where totals are never twice the same.
+    Callers that only materialize the bytes host-side (from_json device
+    assembly) trim with ``data[:offsets[-1]]`` for free; callers that
+    hand the column on device-side keep the default exact sizing.
+    """
     from . import dtype as dt
+    from ..utils.shapes import bucket_size
     n = int(lengths.shape[0])
     lengths = lengths.astype(jnp.int32)
     if validity is not None:
@@ -139,13 +153,19 @@ def gather_spans(src: jnp.ndarray, starts: jnp.ndarray,
     new_offs = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                 jnp.cumsum(lengths).astype(jnp.int32)])
     total = int(new_offs[-1])  # the one output-sizing sync
-    if total:
+    gather_n = bucket_size(total) if pad_to_bucket else total
+    if gather_n:
         row_of_el = jnp.repeat(jnp.arange(n, dtype=jnp.int32), lengths,
-                               total_repeat_length=total)
-        el_in_row = (jnp.arange(total, dtype=jnp.int32)
+                               total_repeat_length=gather_n)
+        el_in_row = (jnp.arange(gather_n, dtype=jnp.int32)
                      - jnp.take(new_offs, row_of_el))
         pos = jnp.take(starts.astype(jnp.int32), row_of_el) + el_in_row
-        data = jnp.take(src, pos)
+        # overflow elements (bucket padding) repeat the last row's tail;
+        # zero them so padded buffers are deterministic. The bound must
+        # be the DEVICE scalar (new_offs[-1]) — a python-int total would
+        # bake into the program and defeat the per-bucket caching
+        in_out = jnp.arange(gather_n, dtype=jnp.int32) < new_offs[-1]
+        data = jnp.where(in_out, jnp.take(src, pos), 0).astype(jnp.uint8)
     else:
         data = jnp.zeros((0,), dtype=jnp.uint8)
     return Column(dt.STRING, n, data=data, validity=validity,

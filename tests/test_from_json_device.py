@@ -51,6 +51,8 @@ EDGES = [
     '{"s":"unterminated}',         # unterminated string -> null
     "",                            # empty string -> null
     '{"arr":[{"inner":1},{"inner":2}],"last":"v"}',
+    b'{"a":"\xff"}',                # non-UTF8 bytes, certified path
+    b'{"a":"\xff","b":"x\\n"}',     # non-UTF8 bytes + escape -> fallback
 ]
 
 

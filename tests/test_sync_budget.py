@@ -253,6 +253,40 @@ def test_q5_pipeline_budget(accel, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# from_json device tier
+# ---------------------------------------------------------------------------
+
+def _json_docs(n, seed):
+    rng = np.random.default_rng(seed)
+    docs = ['{"k%d":%d,"s":"v%d","t":true}'
+            % (i % 7, int(rng.integers(1000)), i) for i in range(n)]
+    # fixed-length sentinel pins the padded-bytes width bucket so warm
+    # and measured variants share every [n, W] program shape (the same
+    # two-variant discipline bench_ops._time uses)
+    docs[0] = '{"sentinel":"%s"}' % ("x" * 24)
+    return Column.from_pylist(docs, dt.STRING)
+
+
+def test_from_json_device_constant_sync_budget():
+    """The certified path's budget (module docstring: 8 — padded-bytes
+    max readback, stacked head, 2 gather sizings, 4 blob/offset pulls)
+    must not scale with rows or pairs, and steady state never
+    recompiles."""
+    from spark_rapids_jni_tpu.ops.from_json_device import (
+        extract_raw_map_device)
+    counts = {}
+    for n in (2048, 8192):
+        extract_raw_map_device(_json_docs(n, seed=n))  # warm this shape
+        with budget.measure() as b:
+            extract_raw_map_device(_json_docs(n, seed=n + 1))
+        assert b.d2h_syncs <= 8, b._summary()
+        assert b.compiles == 0 and b.traces == 0, b._summary()
+        counts[n] = b.d2h_syncs
+    assert len(set(counts.values())) == 1, (
+        f"sync count scaled with rows: {counts}")
+
+
+# ---------------------------------------------------------------------------
 # the instrument itself
 # ---------------------------------------------------------------------------
 
