@@ -340,6 +340,7 @@ def axis_table():
         ("cast_string_to_float_500k", lambda: _B().bench_cast_string_to_float(500_000), 500_000),
         ("parse_uri_200k", lambda: _B().bench_parse_uri(200_000), 200_000),
         ("get_json_object_200k", lambda: _B().bench_get_json_object(200_000), 200_000),
+        ("from_json_200k", lambda: _B().bench_from_json(200_000), 200_000),
         ("tpch_q6_1m", lambda: _B().bench_tpch_q6(1 << 20), 1 << 20),
         ("tpch_q5_1m", lambda: _B().bench_tpch_q5(1 << 20), 1 << 20),
         ("shuffle_skewed_1m", lambda: _B().bench_shuffle_skewed(1 << 20), 1 << 20),

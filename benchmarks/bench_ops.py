@@ -308,14 +308,20 @@ def bench_get_json_object(rows: int):
     docs = [(f'{{"a": {{"b": [{i}, {i * 2}]}}, "name": "row{i % 997}", '
              f'"tags": ["x", "y{i % 13}"], "active": {str(i % 2 == 0).lower()}}}')
             for i in range(rows)]
-    col = Column.from_pylist(docs, dt.STRING)
+    # variant rotation (same doc multiset, rotated s places): identical
+    # shapes/byte totals share programs, distinct buffers defeat axon
+    # re-execution elision (5-30x inflation on repeated identical args)
+    cols = [Column.from_pylist(docs[s:] + docs[:s], dt.STRING)
+            for s in range(_NVARIANTS)]
     nbytes = sum(len(d) for d in docs)
-    sec = _time(lambda: get_json_object(col, "$.a.b[1]"))  # host tier
+    sec = _time(lambda i: get_json_object(cols[i % _NVARIANTS], "$.a.b[1]"),
+                warmup=_NVARIANTS)
     return sec, nbytes
 
 
 def bench_from_json(rows: int):
-    """from_json raw-map extraction, native host tokenizer tier."""
+    """from_json raw-map extraction — tiered dispatch (device pair-span
+    tier on accelerators, native host tokenizer on cpu)."""
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column
     from spark_rapids_jni_tpu.ops.map_utils import (
@@ -323,9 +329,11 @@ def bench_from_json(rows: int):
 
     docs = [(f'{{"k{i % 31}": "v{i}", "n": "{i}", "flag": "{i % 2}"}}')
             for i in range(rows)]
-    col = Column.from_pylist(docs, dt.STRING)
+    cols = [Column.from_pylist(docs[s:] + docs[:s], dt.STRING)
+            for s in range(_NVARIANTS)]
     nbytes = sum(len(d) for d in docs)
-    sec = _time(lambda: extract_raw_map_from_json_string(col))
+    sec = _time(lambda i: extract_raw_map_from_json_string(
+        cols[i % _NVARIANTS]), warmup=_NVARIANTS)
     return sec, nbytes
 
 

@@ -127,22 +127,24 @@ def from_padded_bytes(mat: np.ndarray, lengths: np.ndarray,
 
 def gather_spans(src: jnp.ndarray, starts: jnp.ndarray,
                  lengths: jnp.ndarray, validity,
-                 pad_to_bucket: bool = False) -> Column:
+                 pad_to_bucket: bool = False, trim: bool = True) -> Column:
     """STRING column from per-row (start, length) spans over flat source
     bytes — the shared device extraction used by the span-producing ops
     (parse_url device tier, dictionary-string Parquet decode). One
     output-sizing sync; everything else is a flat-byte gather.
 
     ``pad_to_bucket=True`` sizes the gather program at
-    bucket_size(total) and returns the data buffer zero-padded to that
-    bucket (offsets stay exact). The repeat/gather program then caches
-    per BUCKET instead of per exact byte total — without it, every
-    distinct total compiles a fresh program (~0.9 s cold / 72 ms warm
-    through the axon remote-compile helper, docs/TPU_PERF.md), a
-    per-call cost in production where totals are never twice the same.
-    Callers that only materialize the bytes host-side (from_json device
-    assembly) trim with ``data[:offsets[-1]]`` for free; callers that
-    hand the column on device-side keep the default exact sizing.
+    bucket_size(total): the repeat/gather program then caches per BUCKET
+    instead of per exact byte total — without it, every distinct total
+    compiles a fresh program (~0.9 s cold / 72 ms warm through the axon
+    remote-compile helper, docs/TPU_PERF.md), a per-call cost in
+    production where totals are never twice the same. With the default
+    ``trim=True`` a trivial exact slice follows (one cheap program per
+    total — the join/groupby final-slice discipline) so the result keeps
+    the exact-size data invariant; ``trim=False`` returns the buffer
+    still bucket-padded (offsets stay exact) for callers that only
+    materialize the bytes host-side (from_json device assembly) and trim
+    with ``data[:offsets[-1]]`` for free.
     """
     from . import dtype as dt
     from ..utils.shapes import bucket_size
@@ -166,7 +168,30 @@ def gather_spans(src: jnp.ndarray, starts: jnp.ndarray,
         # bake into the program and defeat the per-bucket caching
         in_out = jnp.arange(gather_n, dtype=jnp.int32) < new_offs[-1]
         data = jnp.where(in_out, jnp.take(src, pos), 0).astype(jnp.uint8)
+        if pad_to_bucket and trim and gather_n != total:
+            data = data[:total]
     else:
         data = jnp.zeros((0,), dtype=jnp.uint8)
     return Column(dt.STRING, n, data=data, validity=validity,
                   offsets=new_offs)
+
+
+def bucket_padded_data(col: Column) -> jnp.ndarray:
+    """``col.data`` zero-padded to bucket_size(total bytes), so device
+    programs gathering FROM the buffer key on the bucket rather than the
+    exact byte total (which is never twice the same in production and
+    would compile a fresh program chain per call). Zero-padding is
+    semantics-free: offsets bound all content reads. Host-cached columns
+    pad in numpy (no device program at all); device-resident ones pay
+    one trivial concat per exact length, which buys bucket-keyed caching
+    for every heavy program behind it."""
+    from ..utils.shapes import bucket_size
+    nb = int(col.data.shape[0])
+    nb_b = bucket_size(nb)
+    if nb_b == nb:
+        return col.data
+    if getattr(col, "_host_data_cache", None) is not None:
+        hd = np.asarray(col.host_data(), dtype=np.uint8)
+        return jnp.asarray(np.concatenate([hd,
+                                           np.zeros(nb_b - nb, np.uint8)]))
+    return jnp.concatenate([col.data, jnp.zeros(nb_b - nb, jnp.uint8)])

@@ -32,8 +32,9 @@ count and pair count. Steady-state retraces/recompiles: zero for
 host-cached sources (every shape is bucketed via utils/shapes — source
 byte total, padded width W, pair count P, gather output totals); a
 device-resident source additionally pays ONE trivial zero-pad program
-per distinct byte total (`_bucket_padded_src`) — never the heavy scan
-chain, which stays bucket-keyed. Pinned by tests/test_sync_budget.py.
+per distinct byte total (columnar/strings.bucket_padded_data) — never
+the heavy scan chain, which stays bucket-keyed. Pinned by
+tests/test_sync_budget.py.
 """
 
 from __future__ import annotations
@@ -47,7 +48,8 @@ from jax import lax
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column
-from ..columnar.strings import gather_spans, padded_bytes
+from ..columnar.strings import (bucket_padded_data, gather_spans,
+                                padded_bytes)
 from ..utils.shapes import bucket_size
 from ..utils.tracing import func_range
 from .get_json_device import _depth, _string_masks, _validate
@@ -180,26 +182,6 @@ def _fill_bytes(dst, dst_offs, slots, src, src_offs, src_sel):
     dst[dst_start + within] = src[src_start + within]
 
 
-def _bucket_padded_src(col: Column) -> jnp.ndarray:
-    """Source bytes zero-padded to bucket_size(total) so every downstream
-    device program (densify, span gathers) keys on the BUCKET — an
-    exact-length source would compile a fresh program chain per distinct
-    document-column byte total (~0.9 s cold through the axon helper).
-    Zero-padding is semantics-free: offsets bound all content reads.
-    Host-cached columns pad in numpy (no device program at all); device-
-    resident ones pay one trivial concat per exact length, which buys
-    bucket-keyed caching for the whole heavy chain behind it."""
-    nb = int(col.data.shape[0])
-    nb_b = bucket_size(nb)
-    if nb_b == nb:
-        return col.data
-    if getattr(col, "_host_data_cache", None) is not None:
-        hd = np.asarray(col.host_data(), dtype=np.uint8)
-        return jnp.asarray(np.concatenate([hd,
-                                           np.zeros(nb_b - nb, np.uint8)]))
-    return jnp.concatenate([col.data, jnp.zeros(nb_b - nb, jnp.uint8)])
-
-
 @func_range()
 def extract_raw_map_device(col: Column) -> Column:
     """Hybrid from_json: device pair-span extraction, host-tier fallback
@@ -209,7 +191,7 @@ def extract_raw_map_device(col: Column) -> Column:
     n = col.size
     if n == 0:
         return host_tier(col)
-    shadow = Column(dt.STRING, n, data=_bucket_padded_src(col),
+    shadow = Column(dt.STRING, n, data=bucket_padded_data(col),
                     offsets=col.offsets, validity=col.validity)
     mat, lens = padded_bytes(shadow)
     real_quote, in_len, d, closes, nonws, dep1, colon = _planes(mat, lens)
@@ -235,9 +217,9 @@ def extract_raw_map_device(col: Column) -> Column:
         # (a distinct exact total would compile fresh every call); the
         # bucket slack is trimmed host-side below for free
         keys_packed = gather_spans(shadow.data, base + ks, kl, None,
-                                   pad_to_bucket=True)
+                                   pad_to_bucket=True, trim=False)
         vals_packed = gather_spans(shadow.data, base + vs, vl, None,
-                                   pad_to_bucket=True)
+                                   pad_to_bucket=True, trim=False)
         k_offs = np.asarray(keys_packed.offsets).astype(np.int64)
         v_offs = np.asarray(vals_packed.offsets).astype(np.int64)
         kb = np.asarray(keys_packed.data)[:k_offs[-1]]

@@ -649,8 +649,16 @@ def _span_is_canonical(mat, lens, s, e, str_token):
 
 def _select_strings(mask, a: Column, b: Column) -> Column:
     """Row-wise select between two aligned STRING columns — device
-    gather over their concatenated payloads (no host round trip)."""
-    from ..columnar.strings import gather_spans
+    gather over their concatenated payloads (no host round trip). Both
+    payloads are bucket-padded before the concat and the output gather
+    runs pad_to_bucket, so the heavy programs key on byte-total BUCKETS
+    (exact totals are never twice the same in production) and only the
+    trivial exact-trim slice compiles per total."""
+    from ..columnar.strings import bucket_padded_data, gather_spans
+    a = Column(a.dtype, a.size, data=bucket_padded_data(a),
+               validity=a.validity, offsets=a.offsets)
+    b = Column(b.dtype, b.size, data=bucket_padded_data(b),
+               validity=b.validity, offsets=b.offsets)
     na = int(a.data.shape[0])
     ao = jnp.asarray(a.offsets, jnp.int32)
     bo = jnp.asarray(b.offsets, jnp.int32)
@@ -665,7 +673,8 @@ def _select_strings(mask, a: Column, b: Column) -> Column:
     starts = jnp.where(mask, ao[:-1], na + bo[:-1])
     lens_out = jnp.where(mask, la, lb)
     validity = jnp.where(mask, av, bv)
-    return gather_spans(data, starts, lens_out, validity)
+    return gather_spans(data, starts, lens_out, validity,
+                        pad_to_bucket=True)
 
 
 # ---------------------------------------------------------------------------
@@ -703,7 +712,20 @@ def get_json_object_device(col: Column, ops: Sequence) -> Column:
     if steps is None or col.size == 0:
         return get_json_object_with_instructions(col, ops)
 
-    mat, lens = padded_bytes(col)
+    # bucket-pad the source so the densify + span-gather programs key
+    # on the byte-total BUCKET, not the exact total (which would compile
+    # a fresh chain per production call — columnar/strings). The shadow
+    # is memoized on the (immutable) column: queries routinely extract
+    # several paths from one doc column, and a per-call shadow would
+    # defeat padded_bytes' densify cache and re-upload the source each
+    # call (same reasoning as parse_uri_device's span cache).
+    shadow = getattr(col, "_gjd_shadow_cache", None)
+    if shadow is None:
+        from ..columnar.strings import bucket_padded_data
+        shadow = Column(col.dtype, col.size, data=bucket_padded_data(col),
+                        offsets=col.offsets, validity=col.validity)
+        object.__setattr__(col, "_gjd_shadow_cache", shadow)
+    mat, lens = padded_bytes(shadow)
     valid_doc = _validate(mat, lens)
     found, certified, s, e, str_token = _navigate(mat, lens, steps)
     base_valid = col.validity if col.validity is not None else \
@@ -724,8 +746,9 @@ def get_json_object_device(col: Column, ops: Sequence) -> Column:
     # PDA with canonical rows zero-length (a "" span normalizes to null
     # at ~zero cost, keeping one finishing call + an aligned merge).
     offs = jnp.asarray(col.offsets, dtype=jnp.int32)[:-1]
-    spans = gather_spans(col.data, offs + s,
-                         jnp.where(canonical, 0, e - s), present)
+    spans = gather_spans(shadow.data, offs + s,
+                         jnp.where(canonical, 0, e - s), present,
+                         pad_to_bucket=True)
     fin_host = get_json_object_with_instructions(spans, [])
     can_np = np.asarray(canonical)
     if bool(can_np.any()):
@@ -734,9 +757,11 @@ def get_json_object_device(col: Column, ops: Sequence) -> Column:
         is_strval = _byte_at(mat, s) == ord('"')
         ds = jnp.where(is_strval, s + 1, s)
         de = jnp.where(is_strval, e - 1, e)
-        dev_vals = gather_spans(col.data, offs + ds,
+        # trim=False: _select_strings bucket-pads its inputs anyway, so
+        # keeping the padded buffer avoids a pointless exact-trim slice
+        dev_vals = gather_spans(shadow.data, offs + ds,
                                 jnp.where(canonical, de - ds, 0),
-                                canonical)
+                                canonical, pad_to_bucket=True, trim=False)
         fin = _select_strings(canonical, dev_vals, fin_host)
     else:
         fin = fin_host

@@ -472,13 +472,17 @@ _PARTS = {"PROTOCOL": 0, "HOST": 1, "QUERY": 2}
 def _extract(col: Column, s, e, present) -> Column:
     """Flat-byte gather of per-row spans into a STRING column (shared
     gather_spans path — one output-sizing sync). ``s``/``e`` are indices
-    into the padded row; source bytes come from the original flat data
-    via the row's offset."""
+    into the padded row; source bytes come from the bucket-padded flat
+    data via the row's offset. pad_to_bucket keys both the source read
+    and the output gather on byte-total BUCKETS (the default trim keeps
+    the result exact-sized for downstream consumers)."""
     from ..columnar.strings import gather_spans
     offs = jnp.asarray(col.offsets, dtype=jnp.int32)[:-1]
     if col.validity is not None:
         present = present & col.validity
-    return gather_spans(col.data, offs + s, e - s, present)
+    src = getattr(col, "_uri_padsrc_cache", None)
+    src = col.data if src is None else src
+    return gather_spans(src, offs + s, e - s, present, pad_to_bucket=True)
 
 
 @func_range()
@@ -498,7 +502,16 @@ def parse_uri_device(col: Column, part: str) -> Column:
     # is identical for all of them
     spans = getattr(col, "_uri_spans_cache", None)
     if spans is None:
-        mat, lens = padded_bytes(col)
+        # bucket-pad the source so the densify + span programs key on
+        # the byte-total BUCKET, not the exact total (which would
+        # compile a fresh chain per production call — see
+        # columnar/strings.bucket_padded_data)
+        from ..columnar.strings import bucket_padded_data
+        padsrc = bucket_padded_data(col)
+        object.__setattr__(col, "_uri_padsrc_cache", padsrc)
+        shadow = Column(dt.STRING, col.size, data=padsrc,
+                        offsets=col.offsets, validity=col.validity)
+        mat, lens = padded_bytes(shadow)
         spans = _parse_core(mat, lens)
         object.__setattr__(col, "_uri_spans_cache", spans)
     (ok, ss, se, has_s, hs, he, has_h, qs, qe, has_q) = spans

@@ -286,6 +286,53 @@ def test_from_json_device_constant_sync_budget():
         f"sync count scaled with rows: {counts}")
 
 
+def test_parse_uri_device_budget():
+    """Device parse_url: 2 constant syncs (densify max + output sizing);
+    steady state compiles at most the trivial exact-trim slice (one per
+    distinct output total — the heavy scan chain is bucket-keyed)."""
+    from spark_rapids_jni_tpu.ops.parse_uri_device import parse_uri_device
+
+    def urls(n, seed):
+        rng = np.random.default_rng(seed)
+        u = ["https://h%d.example.com/p/%d?q=%d"
+             % (int(rng.integers(90)), i, i) for i in range(n)]
+        u[0] = "https://fixed.example.com/" + "x" * 30  # pin the W bucket
+        return Column.from_pylist(u, dt.STRING)
+
+    parse_uri_device(urls(2048, seed=7), "HOST")  # warm
+    for seed in (8, 9):
+        with budget.measure() as b:
+            parse_uri_device(urls(2048, seed), "HOST")
+        assert b.d2h_syncs <= 2, b._summary()
+        assert b.compiles <= 1 and b.traces <= 1, b._summary()
+
+
+def test_get_json_device_budget():
+    """Hybrid get_json_object: constant syncs; steady state compiles at
+    most the trivial exact-trim slices (heavy chain is bucket-keyed —
+    source padding, densify, span gathers, and the canonical-row merge
+    concat all key on byte-total buckets)."""
+    from spark_rapids_jni_tpu.ops.get_json_device import (
+        get_json_object_device)
+    from spark_rapids_jni_tpu.ops.get_json_object import parse_path
+
+    ops = parse_path("$.a.b[1]")
+
+    def docs(n, seed):
+        rng = np.random.default_rng(seed)
+        d = ['{"a":{"b":[%d,%d]},"n":"r%d"}'
+             % (int(rng.integers(100)), i, i) for i in range(n)]
+        d[0] = '{"sentinel":"%s"}' % ("x" * 24)  # pin the W bucket
+        return Column.from_pylist(d, dt.STRING)
+
+    get_json_object_device(docs(2048, seed=4), ops)  # warm
+    for seed in (5, 6):
+        with budget.measure() as b:
+            get_json_object_device(docs(2048, seed), ops)
+        assert b.d2h_syncs <= 9, b._summary()
+        assert b.compiles <= 2 and b.traces <= 2, b._summary()
+
+
 # ---------------------------------------------------------------------------
 # the instrument itself
 # ---------------------------------------------------------------------------
