@@ -463,6 +463,36 @@ def _get_json_device(np, jnp):
           file=sys.stderr)
 
 
+@check("from_json_device_vs_host")
+def _from_json_device(np, jnp):
+    """The from_json device tier's pair-span extraction must agree with
+    the native PDA ON THE CHIP: edge corpus incl. escapes (per-row
+    fallback), non-objects, unicode, plus a 20k-row end-to-end run."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column
+    from spark_rapids_jni_tpu.ops.from_json_device import (
+        extract_raw_map_device)
+    from spark_rapids_jni_tpu.ops.map_utils import _extract_raw_map_host
+
+    docs = ['{"a":1,"b":"x"}', None, "{}", "[1,2]", "bad",
+            '{"n":{"m":[1,2]},"s":"t"}', '{ "k" : [ 1 , 2 ] }',
+            '{"esc":"a\\nb"}', '{"u":"é"}', '{"dup":1,"dup":2}',
+            '{"pad": "' + "x" * 200 + '", "a": 9}']
+    col = Column.from_pylist(docs, dt.STRING)
+    want = _extract_raw_map_host(col).to_pylist()
+    got = extract_raw_map_device(col).to_pylist()
+    assert got == want, (got, want)
+
+    big = Column.from_pylist(
+        ['{"pad": "%s", "k": %d, "s": "v%d"}' % ("y" * 80, i, i)
+         for i in range(20000)], dt.STRING)
+    out = extract_raw_map_device(big).to_pylist()
+    assert out[17] == [("pad", "y" * 80), ("k", "17"), ("s", "v17")], out[17]
+    assert out[-1][1] == ("k", "19999"), out[-1]
+    print("smoke: from_json device tier: 20k rows extracted on-chip",
+          file=sys.stderr)
+
+
 @check("hbm_reservation_watermarks")
 def _hbm_watermarks(np, jnp):
     """Audit reservation estimates against the PJRT allocator's real
