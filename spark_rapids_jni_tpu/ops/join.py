@@ -85,11 +85,33 @@ def _col_equal(lc: Column, l_idx: jnp.ndarray, rc: Column, r_idx: jnp.ndarray,
     return eq
 
 
+# speculative transient-byte cap: above this the wasted padded expansion
+# (est lanes vs a possibly tiny actual total) costs more HBM than the
+# saved 64 ms sync is worth, and at that scale the sync is amortized
+# anyway. Byte-based, not lane-based: wide STRING/DECIMAL128 keys
+# multiply the per-lane cost by the padded key width
+_SPEC_MAX_BYTES = 1 << 30
+
+
 def _candidates(left_keys, right_keys, nulls_equal,
                 left_mask=None, right_mask=None):
     """(l_idx, r_idx) candidate pairs with equal row hash, verified exact.
-    Device-resident; the only host syncs are the two data-dependent output
-    sizes (candidate count, then verified-match count)."""
+    Device-resident. Host-sync economy (the axon tunnel charges ~64 ms per
+    data-dependent sync, docs/TPU_PERF.md):
+
+    - accelerator common case: ONE sync. The expansion bucket is
+      SPECULATED from the static input shapes (bucket_size of 2x
+      max(nl, nr) — holds for FK-PK / near-unique-build joins, the
+      production norm),
+      phase 2 runs at that bucket with the candidate total as a device
+      scalar bound, and (candidate total, verified-match count) transfer
+      together. If the speculation held (total <= est), only the device
+      compaction remains.
+    - overflow (dup-heavy keys, total > est) or speculative transient
+      bytes over _SPEC_MAX_BYTES: the exact two-sync path — same count
+      the contract always allowed.
+    - cpu: exact path with host compaction (syncs are free there).
+    """
     if left_mask is not None:
         left_mask = jnp.asarray(left_mask, dtype=bool)
     if right_mask is not None:
@@ -101,19 +123,10 @@ def _candidates(left_keys, right_keys, nulls_equal,
                              f"key rows ({keys[0].size},)")
     in_bytes = sum(c.device_nbytes() for c in left_keys) \
         + sum(c.device_nbytes() for c in right_keys)
-    with device_reservation(2 * in_bytes) as took:
-        total, state = _candidate_counts(left_keys, right_keys, nulls_equal,
-                                         left_mask, right_mask)
-        release_barrier(state, took)
-    if total == 0:
-        z = np.zeros(0, dtype=np.int64)
-        return (z, z) if _backend() == "cpu" else (jnp.asarray(z),
-                                                   jnp.asarray(z))
-    # expansion working set is data-dependent: re-bracket now that the
-    # candidate-pair count is known (phase-1 arrays stay live → included);
-    # per-pair: 24 B of expansion indices + 24 B of device compaction (sel
-    # vector + two int64 output maps) + the padded byte rows _col_equal
-    # gathers per candidate for wide keys
+    # per-pair transient bytes of the expansion/verify/compaction chain:
+    # 24 B of expansion indices + 24 B of device compaction (sel vector +
+    # two int64 output maps) + the padded byte rows _col_equal gathers per
+    # candidate for wide keys
     per_pair = 48
     if left_mask is not None:
         per_pair += 1  # bucket-lane bool from the mask gather
@@ -121,6 +134,49 @@ def _candidates(left_keys, right_keys, nulls_equal,
         per_pair += 1
     for lc, rc in zip(left_keys, right_keys):
         per_pair += _verify_width(lc) + _verify_width(rc)
+
+    nl, nr = left_keys[0].size, right_keys[0].size
+    # 2x headroom: totals sit marginally above max(nl, nr) whenever the
+    # build side carries a few duplicate keys — without the factor, a
+    # near-unique build side overflows the speculation it was meant for
+    est = bucket_size(2 * max(nl, nr))
+    if _backend() != "cpu" and 0 < est * per_pair <= _SPEC_MAX_BYTES:
+        with device_reservation(2 * in_bytes + est * per_pair) as took:
+            total_dev, state = _candidate_counts(
+                left_keys, right_keys, nulls_equal, left_mask, right_mask)
+            l_idx, r_idx, keep = _expansion_lanes(
+                left_keys, right_keys, nulls_equal, est, total_dev,
+                state, left_mask, right_mask)
+            # THE one sync: both data-dependent counts in one transfer
+            pair = np.asarray(jnp.stack([total_dev.astype(jnp.int64),
+                                         jnp.sum(keep).astype(jnp.int64)]))
+            total, nkeep = int(pair[0]), int(pair[1])
+            if total == 0:
+                z = jnp.zeros(0, jnp.int64)
+                return release_barrier((z, z), took)
+            if total <= est:
+                return release_barrier(
+                    _compact_device(l_idx, r_idx, keep, nkeep), took)
+            # overflow: free the est-lane speculative arrays BEFORE the
+            # exact path re-brackets — holding them through phase 2 would
+            # put ~est*per_pair live bytes outside the next reservation's
+            # accounting (the allocator could then OOM outside the
+            # retry/rollback taxonomy)
+            del l_idx, r_idx, keep, pair
+            release_barrier(state, took)
+        # speculation overflowed (dup-heavy join): the total is already on
+        # host, so the exact path below costs one more sync (the verified
+        # count), matching the op's documented two-sync ceiling
+    else:
+        with device_reservation(2 * in_bytes) as took:
+            total_dev, state = _candidate_counts(
+                left_keys, right_keys, nulls_equal, left_mask, right_mask)
+            release_barrier(state, took)
+        total = int(total_dev)  # host sync #1: candidate-pair count
+    if total == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return (z, z) if _backend() == "cpu" else (jnp.asarray(z),
+                                                   jnp.asarray(z))
     # reserve at the BUCKETED lane count — phase 2 allocates every array at
     # bucket_size(total) (up to ~2x total), so the bracket must cover the
     # padded working set, not the logical pair count
@@ -153,8 +209,10 @@ def _verify_width(col: Column) -> int:
 
 def _candidate_counts(left_keys, right_keys, nulls_equal,
                       left_mask=None, right_mask=None):
-    """Phase 1: row hashes + sorted-hash range counts. Host-syncs the
-    candidate-pair total (sync #1) so phase 2 can reserve for it.
+    """Phase 1: row hashes + sorted-hash range counts. Returns the
+    candidate-pair total as a DEVICE scalar — the caller decides whether
+    it syncs alone (exact path) or rides the combined transfer
+    (speculative path).
 
     Masked-out rows get per-row poison hashes (distinct bases from the
     null poisons) so they produce no candidates — the pushed-down filter
@@ -194,15 +252,19 @@ def _candidate_counts(left_keys, right_keys, nulls_equal,
     lo = jnp.searchsorted(hr_sorted, hl, side="left")
     hi = jnp.searchsorted(hr_sorted, hl, side="right")
     cnt = (hi - lo).astype(jnp.int32)
-    total = int(jnp.sum(cnt))  # host sync #1: candidate-pair count
-    return total, (order, lo, cnt, nl)
+    # total stays a DEVICE scalar: the speculative accelerator path reads
+    # it together with the verified-match count in one combined transfer;
+    # the exact path syncs it alone (host sync #1)
+    total_dev = jnp.sum(cnt)
+    return total_dev, (order, lo, cnt, nl)
 
 
-def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
-                       left_mask=None, right_mask=None):
-    """Phase 2: expand candidate pairs on device and verify exact equality.
-    The compaction stays on device — only the verified-match *count* syncs
-    to host (sync #2); the gather maps themselves never round-trip.
+def _expansion_lanes(left_keys, right_keys, nulls_equal, t_b, total_bound,
+                     state, left_mask=None, right_mask=None):
+    """Expand candidate pairs into t_b padded lanes and verify exact
+    equality. ``total_bound`` may be a device scalar (speculative path)
+    or a python int (exact path) — either way dead lanes carry
+    keep=False. Returns (l_idx, r_idx, keep), all [t_b] device arrays.
 
     Every device array here is sized by a power-of-two bucket, not the
     data-dependent counts (utils/shapes.py): a fresh shape costs ~0.9 s
@@ -211,7 +273,6 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
     expansion lanes carry keep=False; only the final exact-size trims
     compile per distinct count (trivial slices)."""
     order, lo, cnt, nl = state
-    t_b = bucket_size(total)
     l_idx = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), cnt,
                        total_repeat_length=t_b)
     lane = jnp.arange(t_b, dtype=jnp.int32)
@@ -219,7 +280,7 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
     within = lane - jnp.take(start, l_idx)
     r_idx = jnp.take(order, jnp.take(lo, l_idx) + within)  # take clips
 
-    keep = lane < total
+    keep = lane < total_bound
     # pushed-down filters are enforced HERE (exactly), not just by the
     # phase-1 hash poisoning
     if left_mask is not None:
@@ -228,6 +289,27 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
         keep = keep & jnp.take(right_mask, r_idx)
     for lc, rc in zip(left_keys, right_keys):
         keep = keep & _col_equal(lc, l_idx, rc, r_idx, nulls_equal)
+    return l_idx, r_idx, keep
+
+
+def _compact_device(l_idx, r_idx, keep, nkeep: int):
+    """Device compaction of the verified lanes — the blob-sized mask and
+    index arrays never cross the host boundary; only the trivial exact
+    trim compiles per distinct count."""
+    k_b = bucket_size(nkeep)
+    sel = jnp.nonzero(keep, size=k_b, fill_value=0)[0]
+    return (jnp.take(l_idx, sel).astype(jnp.int64)[:nkeep],
+            jnp.take(r_idx, sel).astype(jnp.int64)[:nkeep])
+
+
+def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
+                       left_mask=None, right_mask=None):
+    """Exact phase 2 at bucket_size(total) lanes. On CPU the compaction is
+    host numpy; on accelerators only the verified-match *count* syncs to
+    host (sync #2) — the gather maps themselves never round-trip."""
+    l_idx, r_idx, keep = _expansion_lanes(
+        left_keys, right_keys, nulls_equal, bucket_size(total), total,
+        state, left_mask, right_mask)
     if _backend() == "cpu":
         # host compaction: numpy boolean indexing beats XLA:CPU nonzero,
         # and there is no transfer cost to avoid; return host arrays so the
@@ -235,13 +317,8 @@ def _expand_and_verify(left_keys, right_keys, nulls_equal, total, state,
         keep_h = np.asarray(keep)
         return (np.asarray(l_idx)[keep_h].astype(np.int64),
                 np.asarray(r_idx)[keep_h].astype(np.int64))
-    # accelerator: compact on device — only the verified-match count syncs;
-    # the blob-sized mask and index arrays never cross the host boundary
     nkeep = int(jnp.sum(keep))  # host sync #2: verified-match count
-    k_b = bucket_size(nkeep)
-    sel = jnp.nonzero(keep, size=k_b, fill_value=0)[0]
-    return (jnp.take(l_idx, sel).astype(jnp.int64)[:nkeep],
-            jnp.take(r_idx, sel).astype(jnp.int64)[:nkeep])
+    return _compact_device(l_idx, r_idx, keep, nkeep)
 
 
 @func_range()

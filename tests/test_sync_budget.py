@@ -6,8 +6,11 @@ rework bought each op an explicit budget. These tests pin those budgets
 with the utils/budget instrument so a regression can never silently
 re-add a sync:
 
-    join      <= 2 data-dependent syncs   (ops/join.py: candidate count,
-                                           verified-match count)
+    join      <= 1 speculative / <= 2     (ops/join.py: combined
+                                           (total, verified) transfer when
+                                           the FK-PK speculation holds;
+                                           candidate count + verified
+                                           count on overflow)
     groupby   <= 1                        (ops/groupby.py: segment head)
     sort      == 0 fixed-width            (lanes never leave the device)
     rowconv   <= 1 per table each way     (ops/row_conversion.py)
@@ -104,6 +107,8 @@ def test_sort_strings_one_sizing_sync(accel):
 # ---------------------------------------------------------------------------
 
 def test_join_at_most_two_syncs(accel):
+    # dup-heavy keys (total >> 2*max(nl,nr)): the speculative bucket
+    # overflows and the exact two-sync path runs — the op's ceiling
     lk = [_ints(8192, hi=500, seed=5)]
     rk = [_ints(8192, hi=500, seed=6)]
     inner_join(lk, rk)  # warm
@@ -112,6 +117,39 @@ def test_join_at_most_two_syncs(accel):
         jax.block_until_ready((l_idx, r_idx))
     assert b.d2h_syncs <= 2, b._summary()
     assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_join_fkpk_single_sync(accel):
+    """FK-PK shape (near-unique build side): the speculative expansion
+    bucket holds, so (candidate total, verified count) ride ONE combined
+    transfer — the join's only data-dependent sync."""
+    lk = [_ints(8192, hi=2048, seed=15)]
+    rk = [_ints(2048, hi=2048, seed=16)]
+    inner_join(lk, rk)  # warm
+    with budget.measure() as b:
+        l_idx, r_idx = inner_join(lk, rk)
+        jax.block_until_ready((l_idx, r_idx))
+    assert b.d2h_syncs <= 1, b._summary()
+    assert b.compiles == 0 and b.traces == 0, b._summary()
+
+
+def test_join_speculative_matches_exact_path():
+    """The speculative and exact paths must produce identical gather maps
+    (same lane construction prefix, same compaction order) — checked by
+    running the same join on the cpu path (exact) and the forced
+    accelerator path (speculative) at a shape where speculation holds."""
+    import spark_rapids_jni_tpu.ops.join as jm
+    lk = [_ints(4096, hi=1024, seed=21, nulls=True)]
+    rk = [_ints(1024, hi=1024, seed=22, nulls=True)]
+    li_cpu, ri_cpu = inner_join(lk, rk)
+    orig = jm._backend
+    jm._backend = lambda: "tpu"
+    try:
+        li_dev, ri_dev = inner_join(lk, rk)
+    finally:
+        jm._backend = orig
+    np.testing.assert_array_equal(np.asarray(li_cpu), np.asarray(li_dev))
+    np.testing.assert_array_equal(np.asarray(ri_cpu), np.asarray(ri_dev))
 
 
 def test_groupby_one_sync(accel):
@@ -220,10 +258,10 @@ def test_q1_pipeline_budget(accel, monkeypatch):
 
 
 def test_q3_pipeline_budget(accel, monkeypatch):
-    """q3 = filter + 2 joins + groupby + top-k sort: two joins at <= 2
-    data-dependent syncs each, one groupby head, sizing for the gathers —
-    the end-to-end ceiling is the sum of the op contracts, and a steady-
-    state run must never recompile."""
+    """q3 = filter + 2 joins + groupby + top-k sort: two FK-PK joins at
+    ONE speculative sync each, one groupby head — the end-to-end ceiling
+    is the sum of the op contracts, and a steady-state run must never
+    recompile."""
     from benchmarks import tpch
     monkeypatch.setattr(tpch, "_backend", lambda: "tpu")
     cust, orders, lineitem = tpch.generate_q3_tables(8192, seed=14)
@@ -231,15 +269,15 @@ def test_q3_pipeline_budget(accel, monkeypatch):
     with budget.measure() as b:
         out = tpch.run_q3(cust, orders, lineitem)
         jax.block_until_ready([c.data for c in out.columns])
-    # measured exactly: 2 joins x 2 + 1 groupby head (the sync_sites
-    # in the failure message name each one)
-    assert b.d2h_syncs <= 5, b._summary()
+    # measured exactly: 2 speculative joins x 1 + 1 groupby head (the
+    # sync_sites in the failure message name each one)
+    assert b.d2h_syncs <= 3, b._summary()
     assert b.compiles == 0 and b.traces == 0, b._summary()
 
 
 def test_q5_pipeline_budget(accel, monkeypatch):
     """q5 = 4 joins + co-nation predicate + groupby + sort: the widest
-    local pipeline; ceiling = 4 joins x 2 + groupby 1 + sizing slack."""
+    local pipeline; ceiling = 4 speculative joins x 1 + groupby 1."""
     from benchmarks import tpch
     monkeypatch.setattr(tpch, "_backend", lambda: "tpu")
     tables = tpch.generate_q5_tables(8192, seed=15)
@@ -247,8 +285,8 @@ def test_q5_pipeline_budget(accel, monkeypatch):
     with budget.measure() as b:
         out = tpch.run_q5(*tables)
         jax.block_until_ready([c.data for c in out.columns])
-    # measured exactly: 4 joins x 2 + 1 groupby head
-    assert b.d2h_syncs <= 9, b._summary()
+    # measured exactly: 4 speculative joins x 1 + 1 groupby head
+    assert b.d2h_syncs <= 5, b._summary()
     assert b.compiles == 0 and b.traces == 0, b._summary()
 
 
