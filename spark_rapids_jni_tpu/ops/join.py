@@ -24,7 +24,7 @@ equality; cudf null_equality::EQUAL).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
