@@ -257,30 +257,97 @@ def f64_bits_from_value(vals):
     return lax.bitcast_convert_type(vals, jnp.uint64)
 
 
+# Exact powers of two for the arithmetic paths below. Every entry is
+# exactly representable as a float32 (the double-double hi component), so
+# multiplying by a gathered entry is an exact scale on the TPU emulation.
+# jnp.ldexp/frexp/signbit on f64 all lower through a 64-bit
+# bitcast-convert, which the X64 rewriter rejects (docs/TPU_NUMERICS.md
+# §3) — that is why these paths gather from a table instead.
+_EXP2_LO, _EXP2_HI = -126, 127
+_EXP2_TABLE = np.ldexp(1.0, np.arange(_EXP2_LO, _EXP2_HI + 1))
+
+
+def _exp2i(k):
+    """2.0**k as exact table gather; k clipped to f32's exponent range —
+    callers only reach the clip when the result over/underflows anyway."""
+    return jnp.asarray(_EXP2_TABLE)[jnp.clip(k, _EXP2_LO, _EXP2_HI)
+                                    - _EXP2_LO]
+
+
+def _dd_to_u53(x):
+    """Convert non-negative f64 lanes holding values in [0, 2^53] to u64
+    with round-to-nearest, WITHOUT convert_element_type on the full value:
+    the X64 rewriter lowers f64↔64-bit-int converts through the single
+    float32 hi component (measured on-chip: ~2^28 ulp of error at 2^53
+    magnitudes), so the value is peeled into three ≤18-bit integer chunks
+    — small enough that even an hi-only convert is exact — and
+    reassembled in exact u64 arithmetic. On CPU this is exactly
+    round(x)."""
+    t2 = jnp.floor(x * 1.4551915228366852e-11)        # x * 2^-36, ≤ 2^17
+    x1 = x - t2 * 68719476736.0                       # exact: x - t2·2^36
+    t1 = jnp.floor(x1 * 3.814697265625e-06)           # x1 * 2^-18, < 2^18
+    x0 = x1 - t1 * 262144.0                           # < 2^18 + fraction
+    n2 = t2.astype(jnp.int32).astype(jnp.uint64)
+    n1 = t1.astype(jnp.int32).astype(jnp.uint64)
+    n0 = jnp.round(x0).astype(jnp.int32).astype(jnp.uint64)
+    # + (not |): a round-up of x0 to exactly 2^18 must carry upward
+    return (n2 << _U64(36)) + (n1 << _U64(18)) + n0
+
+
+def _u53_to_dd(mant):
+    """Convert u64 lanes holding ≤53-bit integers to f64 without the
+    hi-only convert_element_type (see _dd_to_u53): three ≤18-bit chunks
+    convert exactly, and their scaled sum rounds once to the backend's
+    f64 precision (~49 bits on the TPU emulation, exact on CPU)."""
+    c2 = (mant >> _U64(36)).astype(jnp.int32).astype(jnp.float64)
+    c1 = ((mant >> _U64(18)) & _U64(0x3FFFF)).astype(
+        jnp.int32).astype(jnp.float64)
+    c0 = (mant & _U64(0x3FFFF)).astype(jnp.int32).astype(jnp.float64)
+    return (c2 * 68719476736.0 + c1 * 262144.0) + c0
+
+
 def _f64_bits_arith(v):
     """Arithmetic IEEE-754 field assembly for backends without a 64-bit
-    bitcast: frexp → round-half-even 53-bit mantissa → biased-exponent /
-    fraction packing. Exact for normals/inf/zero; SUBNORMAL inputs encode
-    to signed zero — XLA compiles f64 arithmetic flush-to-zero (see
-    f64_value_from_bits), and on TPU (the only backend routed here)
-    every such magnitude flushes in the producing computation anyway, so
-    this adds no loss the backend wasn't imposing. A result that rounds
-    up *into* the normal range still lands on the smallest normal's bit
-    pattern for free, since bits = bexp<<52 | frac with bexp 0."""
-    sign = jnp.signbit(v)
+    bitcast (TPU): exponent from a float32-view frexp (32-bit bitcast —
+    supported), mantissa by exact power-of-two table scaling, then
+    biased-exponent / fraction packing in u64. Values below the emulation's
+    ~2^-126 floor encode to signed zero — on TPU (the only backend routed
+    here) every such magnitude flushes in the producing computation anyway,
+    so this adds no loss the backend wasn't imposing."""
+    # sign incl. -0.0 without jnp.signbit: 1/±0 = ±inf is pure arithmetic
+    sign = jnp.where(v == 0.0, 1.0 / v < 0.0, v < 0.0)
     av = jnp.abs(v)
-    m, e = jnp.frexp(av)  # av = m * 2^e, m in [0.5, 1)
-    # normal path: mant = round(m * 2^53) in [2^52, 2^53]; a round up to
-    # exactly 2^53 carries into the exponent
-    mant = jnp.round(jnp.ldexp(m, 53)).astype(jnp.uint64)
+    # binary exponent from the f32 view (32-bit bitcast — supported). Two
+    # wrinkles: the f64→f32 convert rounds, so e can be off by one
+    # (corrected exactly below with *0.5 / *2.0), and f32 SUBNORMAL views
+    # (av < 2^-126, reachable on TPU down to ~2^-149) break frexp's field
+    # extraction — so tiny values are pre-scaled by an exact 2^100 first.
+    small = av < _EXP2_TABLE[-100 - _EXP2_LO]
+    av32 = jnp.where(small, av * _EXP2_TABLE[100 - _EXP2_LO],
+                     av).astype(jnp.float32)
+    _, e32 = jnp.frexp(av32)
+    e = e32.astype(jnp.int32) - jnp.where(small, 100, 0)
+    h = e // 2
+    m = av * _exp2i(-h) * _exp2i(-(e - h))  # av * 2^-e → [0.5, 1) ± 1 step
+    too_hi = m >= 1.0
+    m = jnp.where(too_hi, m * 0.5, m)
+    e = jnp.where(too_hi, e + 1, e)
+    too_lo = m < 0.5
+    m = jnp.where(too_lo, m * 2.0, m)
+    e = jnp.where(too_lo, e - 1, e)
+    # mant = round(m * 2^53) in [2^52, 2^53]; exact on CPU (m carries at
+    # most 53 significant bits, so the product is an integer); a round up
+    # to exactly 2^53 carries into the exponent
+    mant = _dd_to_u53(m * 9007199254740992.0)
     carry = mant == (_U64(1) << _U64(53))
     mant = jnp.where(carry, _U64(1) << _U64(52), mant)
     e = jnp.where(carry, e + 1, e)
     frac_n = mant & ((_U64(1) << _U64(52)) - _U64(1))
-    bexp_n = (e + 1022).astype(jnp.uint64)  # (e-1) + 1023
-    # subnormal path (av < 2^-1022): frac = round(av * 2^1074), bexp = 0
-    frac_s = jnp.round(jnp.ldexp(av, 1074)).astype(jnp.uint64)
-    bits = jnp.where(e < -1021, frac_s, (bexp_n << _U64(52)) | frac_n)
+    bexp_n = jnp.clip(e + 1022, 0, 0x7FE).astype(jnp.uint64)  # (e-1)+1023
+    bits = (bexp_n << _U64(52)) | frac_n
+    # below binary64's normal range: signed zero (unreachable from real
+    # TPU values — the emulation flushed them long before this encode)
+    bits = jnp.where(e < -1021, _U64(0), bits)
     bits = jnp.where(av == 0, _U64(0), bits)
     bits = jnp.where(jnp.isinf(av), _U64(0x7FF) << _U64(52), bits)
     bits = jnp.where(sign, bits | (_U64(1) << _U64(63)), bits)
@@ -290,8 +357,9 @@ def _f64_bits_arith(v):
 
 def _f64_from_bits_arith(bits):
     """Arithmetic decode for backends without a 64-bit bitcast (TPU): field
-    extraction + two half-shift ldexps. Subnormal doubles flush to signed
-    zero here — on TPU every |x| below ~1e-38 flushes anyway (double-double
+    extraction + two exact table-gathered power-of-two scales. Bit patterns
+    outside the emulation's float32 exponent range under/overflow to
+    0/inf — on TPU every |x| below ~1e-38 flushes anyway (double-double
     emulation, §1), so this adds no loss the backend wasn't already
     imposing."""
     e = ((bits >> _U64(52)) & _U64(0x7FF)).astype(jnp.int32)
@@ -299,8 +367,15 @@ def _f64_from_bits_arith(bits):
     negative = (bits >> _U64(63)) != 0
     mant = jnp.where(e > 0, frac | (_U64(1) << _U64(52)), frac)
     ex = jnp.where(e > 0, e - 1075, -1074)
+    # v = mant * 2^ex; in-range values (ex ∈ [-179, 75]) split into two
+    # un-clipped exact factors. Out-of-range patterns get explicit masks
+    # mirroring what the f32-range emulation imposes — under the table
+    # clip alone a CPU run of this path would decode them to garbage
+    # finite values instead
     h1 = ex // 2
-    v = jnp.ldexp(jnp.ldexp(mant.astype(jnp.float64), h1), ex - h1)
+    v = _u53_to_dd(mant) * _exp2i(h1) * _exp2i(ex - h1)
+    v = jnp.where(ex < -180, jnp.float64(0.0), v)     # flush (incl. all
+    v = jnp.where(ex > 76, jnp.float64(jnp.inf), v)   # f64 subnormals)
     v = jnp.where(e == 0x7FF,
                   jnp.where(frac != 0, jnp.float64(jnp.nan),
                             jnp.float64(jnp.inf)), v)

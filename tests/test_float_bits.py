@@ -157,3 +157,84 @@ def test_exact_tie_regressions_round5():
     for i, (w, q) in enumerate(cases):
         want = np.float64(float(f"{w}e{q}")).view(np.uint64)
         assert got[i] == want, (w, q, hex(int(got[i])), hex(int(want)))
+
+
+def test_arith_f64_encode_decode_round5():
+    """The TPU-path arithmetic encode/decode (_f64_bits_arith /
+    _f64_from_bits_arith) must be bit-exact on CPU inside its documented
+    domain — it avoids jnp.signbit/frexp/ldexp and f64↔u64
+    convert_element_type entirely (all lower through 64-bit bitcasts or an
+    hi-f32-only convert the TPU X64 rewriter breaks on; round-5 on-chip
+    capture failure), so the chunked reassembly, carry propagation, and
+    range masks need their own pins: a silent regression here would only
+    surface as wrong groupby float outputs on real hardware."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops.float_bits import (_f64_bits_arith,
+                                                     _f64_from_bits_arith)
+
+    rng = np.random.default_rng(7)
+    vals = np.concatenate([
+        rng.uniform(-1e6, 1e6, 3000),
+        rng.standard_normal(3000) * 10.0 ** rng.integers(-37, 37, 3000),
+        # f32-subnormal-view range: exercises the 2^100 pre-scale branch
+        rng.standard_normal(500) * 2.0 ** rng.integers(-140, -120, 500),
+        np.array([0.0, -0.0, 1.0, -1.0, np.inf, -np.inf, np.nan,
+                  1e-38, -1e-38, 3e38, -3e38, 0.5, 2.0, 1 / 3,
+                  2.0 ** -126, 2.0 ** -149, 3.4e38,
+                  float(2 ** 53), float(2 ** 53 - 1),
+                  # mantissa all-ones: the _dd_to_u53 carry chain
+                  np.ldexp(float(2 ** 53 - 1), -30)]),
+    ]).astype(np.float64)
+    ref = vals.view(np.uint64)
+    nan = np.isnan(vals)
+
+    bits = np.asarray(_f64_bits_arith(jnp.asarray(vals)))
+    assert np.array_equal(bits[~nan], ref[~nan])
+    assert np.all(bits[nan] == np.uint64(0x7FF8) << np.uint64(48))
+    # -0.0 encodes its sign (the 1/v trick)
+    assert bits[np.where(vals == 0)[0]].tolist().count(1 << 63) == 1
+
+    dec = np.asarray(_f64_from_bits_arith(jnp.asarray(ref)))
+    # documented flush zone: |v| below 2^-128 (decode mask ex < -180)
+    # decodes to signed zero; [2^-128, 2^-127) still decodes exactly
+    flush = (np.abs(vals) < 2.0 ** -128) & (vals != 0) & ~nan
+    keep = ~nan & ~flush
+    assert np.array_equal(dec[keep], vals[keep])
+    assert np.all(dec[flush] == 0.0)
+    assert np.array_equal(np.signbit(dec[flush]), np.signbit(vals[flush]))
+    assert np.isnan(dec[nan]).all()
+    neg0 = _f64_from_bits_arith(
+        jnp.asarray(np.array([0x8000000000000000], np.uint64)))
+    assert np.signbit(np.asarray(neg0))[0]
+
+    # round-trip stability: encode∘decode is idempotent on bit patterns
+    rt1 = np.asarray(_f64_bits_arith(jnp.asarray(dec)))
+    rt2 = np.asarray(_f64_from_bits_arith(jnp.asarray(rt1)))
+    assert np.array_equal(np.asarray(_f64_bits_arith(jnp.asarray(rt2))),
+                          rt1)
+
+
+def test_dd_chunk_helpers_round5():
+    """_dd_to_u53 / _u53_to_dd: exact on CPU for every 53-bit integer
+    magnitude, including the round-up carry at chunk boundaries."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.ops.float_bits import _dd_to_u53, _u53_to_dd
+
+    rng = np.random.default_rng(8)
+    mants = np.concatenate([
+        rng.integers(2 ** 52, 2 ** 53, 2000, dtype=np.uint64),
+        np.array([2 ** 52, 2 ** 53 - 1, 2 ** 53,
+                  (2 ** 18 - 1) | (2 ** 52),       # low chunk all-ones
+                  (2 ** 36 - 1) | (2 ** 52)],      # two chunks all-ones
+                 np.uint64),
+    ])
+    back = np.asarray(_dd_to_u53(jnp.asarray(mants.astype(np.float64))))
+    assert np.array_equal(back, mants)
+    vals = np.asarray(_u53_to_dd(jnp.asarray(mants)))
+    assert np.array_equal(vals, mants.astype(np.float64))
+    # fractional inputs round to nearest (x.5 may go either way at dd
+    # precision; the exact-integer contract above is the load-bearing one)
+    frac = np.asarray(_dd_to_u53(jnp.asarray(np.array([4503599627370498.75]))))
+    assert frac[0] == 4503599627370499
