@@ -57,8 +57,9 @@ STALL_S = int(os.environ.get("BENCH_STALL_S", "900"))
 # Statistical honesty (round-3 verdict weak #6): single runs on a shared
 # 1-core container carry ±30% variance, so every axis is timed REPEATS
 # times and reported as {median, min, repeats}; deltas between rounds are
-# meaningful against medians only. The first timed run still pays compile
-# (cached thereafter), so min <= median is the steady-state signal.
+# meaningful against medians only. One UNTIMED warm-up run precedes the
+# timed repeats (headline and sweep alike), so compile + first-touch never
+# pollute the median and min <= median is a pure steady-state signal.
 # The headline keeps a floor of 3 blocks regardless (it is the one number
 # the driver records as `value`; a single-block headline is never OK).
 REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
@@ -369,13 +370,18 @@ def _sweep(deadline):
         # axis degrades to fewer repeats instead of a skip. A failure on a
         # later repeat must NOT discard already-collected timings — in a
         # one-shot TPU capture window those are the round's only evidence.
+        # Round r == 0 is an UNTIMED warm-up: compile + first-touch land
+        # there, so every timed repeat (and the *_best fields) measures
+        # steady state.
         secs, nbytes, err = [], 0, None
-        for r in range(REPEATS):
+        for r in range(REPEATS + 1):
             if secs and time.monotonic() >= deadline:
                 break
+            lbl = f"repeat {r}" if r else "warm-up"
             try:
                 sec, nbytes = fn()
-                secs.append(sec)
+                if r:
+                    secs.append(sec)
                 _heartbeat()
             except RuntimeError as e:
                 if "devices" in str(e) and not secs:
@@ -386,11 +392,11 @@ def _sweep(deadline):
                     results[name] = {"skipped": str(e)}
                     break
                 err = f"{type(e).__name__}: {e}"
-                _log(f"  {name} repeat {r + 1} FAILED: {e}")
+                _log(f"  {name} {lbl} FAILED: {e}")
                 break
             except Exception as e:  # an axis must never sink the sweep
                 err = f"{type(e).__name__}: {e}"
-                _log(f"  {name} repeat {r + 1} FAILED: {e}")
+                _log(f"  {name} {lbl} FAILED: {e}")
                 break
         if name in results:  # structural skip recorded above
             continue

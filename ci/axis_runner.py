@@ -13,8 +13,8 @@ budget while every completed axis is already durable (committed by
 ci/tpu_window2.py). The persistent XLA compile cache (enabled at package
 import) makes the per-process re-init cost ~72 ms/program, not ~0.9 s.
 
-Protocol per axis matches bench.py (median of N repeats, first repeat pays
-compile); emits ONE JSON line on stdout. Exit 3 = no accelerator (parent
+Protocol per axis matches bench.py (one untimed warm-up pays compile and
+first-touch, then median of N timed repeats); emits ONE JSON line on stdout. Exit 3 = no accelerator (parent
 skips, nothing recorded). Exit 0 = the JSON line is a real measurement.
 """
 
@@ -48,6 +48,14 @@ def main():
     # single source of truth for names/thunks/rows: bench.axis_table()
     axes = {n: (f, r) for n, f, r in bench.axis_table()}
     fn, rows = axes[axis]
+
+    # one untimed warm-up so every TIMED repeat measures steady state —
+    # compile + first-touch costs land here, not in the median (the
+    # *_best/min fields below then compare like with like)
+    t = time.monotonic()
+    fn()
+    print(f"axis_runner: {axis} warm-up (wall {time.monotonic() - t:.1f}s)",
+          file=sys.stderr)
 
     secs, nbytes = [], 0
     for _ in range(repeats):

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Chaos lane: fault-storm robustness tests only (tests/test_chaos.py).
+#
+# The chaos tests are tier-1 members (they are fast and not marked slow, so
+# the default `-m 'not slow'` run already includes them); this lane exists
+# to iterate on fault configs / the supervisor without paying for the full
+# suite, and as the `make chaos` entry point. FAULT_RATE_SMOKE=1 extras can
+# ride along later; keep this runnable on the 8-device virtual CPU mesh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -89,9 +89,13 @@ def run_axis(axis):
         log(f"axis {axis}: foreign measurement running — holding for "
             f"solo window")
         time.sleep(40)
+    # unfiltered tracebacks in the child: a failed axis's stderr is the only
+    # evidence the window leaves behind, and JAX's frame filtering has eaten
+    # the decisive frame more than once
+    env = dict(os.environ, JAX_TRACEBACK_FILTERING="off")
     try:
         p = subprocess.run(
-            [sys.executable, "ci/axis_runner.py", axis], cwd=REPO,
+            [sys.executable, "ci/axis_runner.py", axis], cwd=REPO, env=env,
             timeout=AXIS_TIMEOUT_S, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         log(f"axis {axis}: WEDGED (> {AXIS_TIMEOUT_S}s), killed")
@@ -105,8 +109,15 @@ def run_axis(axis):
         except ValueError:
             continue
     if line is None:
-        tail = ((p.stderr or "").strip().splitlines() or ["?"])[-1]
-        log(f"axis {axis}: no JSON (rc={p.returncode}): {tail[-200:]}")
+        # preserve the FULL stderr, not a 200-char tail: round-5 window 1
+        # lost the root cause of the relay wedge to exactly this truncation
+        stderr = (p.stderr or "").strip()
+        err_path = os.path.join(REPO, "ci", f"tpu_window2_{axis}.stderr")
+        with open(err_path, "w") as f:
+            f.write(stderr + "\n")
+        tail = (stderr.splitlines() or ["?"])[-1]
+        log(f"axis {axis}: no JSON (rc={p.returncode}): {tail[-200:]} "
+            f"[full stderr: {err_path}]")
         return "error"
     if "mrows_per_s" not in line:
         log(f"axis {axis}: backend={line.get('backend')} — not capturing")

@@ -123,14 +123,21 @@ def _list_parts(col: Column) -> Tuple[WireCol, Optional[Column]]:
 
 def call(op_name: str, args_json: str,
          wire_cols: Sequence[WireCol]) -> Tuple[List[WireCol], str]:
-    """Engine entry point (called by native/engine_bridge.cpp)."""
+    """Engine entry point (called by native/engine_bridge.cpp).
+
+    Every op dispatch runs under the fault-domain supervisor
+    (faultinj/guard.py): a JSON fault config targeting the op name
+    ("hash.murmur3") fires here, and real runtime failures classify into
+    the same recovery domains (transient backoff / poison re-dispatch /
+    retry-OOM protocol)."""
+    from .faultinj.guard import guarded_dispatch
     fn = _OPS.get(op_name)
     if fn is None:
         raise KeyError(f"unknown engine op: {op_name!r} "
                        f"(have: {sorted(_OPS)})")
     args = json.loads(args_json) if args_json else {}
     cols = [wire_to_col(w) for w in wire_cols]
-    out = fn(args, cols)
+    out = guarded_dispatch(op_name, fn, args, cols)
     meta = {}
     if isinstance(out, tuple):
         out, meta = out

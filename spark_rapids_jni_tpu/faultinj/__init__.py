@@ -22,14 +22,30 @@ from .injector import (
     install,
     uninstall,
 )
+from .guard import (
+    FaultStormError,
+    ProgramPoisonedError,
+    classify,
+    degraded,
+    degraded_mode,
+    guarded_dispatch,
+    metrics,
+)
 
 __all__ = [
     "DeviceAssertError",
     "DeviceTrapError",
     "FaultInjector",
+    "FaultStormError",
     "InjectedApiError",
+    "ProgramPoisonedError",
+    "classify",
+    "degraded",
+    "degraded_mode",
     "fault_point",
     "get_injector",
+    "guarded_dispatch",
     "install",
+    "metrics",
     "uninstall",
 ]

@@ -1,0 +1,266 @@
+"""Fault-domain supervisor: classified, recoverable dispatch.
+
+The reference ships ``libcufaultinj.so`` because a production executor must
+survive device traps, transient runtime errors, and OOM mid-query — and the
+plugin's answer to each is DIFFERENT (faultinj/README.md + the spark-rapids
+retry framework): OOM rolls back to a spillable state and re-enters the
+RmmSpark retry/split protocol, transient API errors are retried in place,
+and a device trap poisons the CUDA context so work must be re-dispatched or
+degraded to the CPU. This module is that classification table for the TPU
+port, applied uniformly at every dispatch surface:
+
+  ============================  =======================================
+  domain                        handling
+  ============================  =======================================
+  RESOURCE_EXHAUSTED            raise into the RmmSpark retry protocol
+                                (TpuRetryOOM — callers under
+                                memory.retry.with_retry or the
+                                TaskExecutor ladder roll back + retry)
+  TRANSIENT (UNAVAILABLE /      bounded exponential backoff with jitter,
+  DEADLINE / InjectedApiError)  retried in place; FaultStormError after
+                                ``faultinj.max_transient_retries``
+  POISON (DeviceTrapError /     current program is poisoned: bounded
+  DeviceAssertError)            re-dispatch (``faultinj.max_poison_
+                                redispatch``), then the error propagates
+                                to the TaskExecutor degradation ladder
+  FATAL (everything else)       propagate unchanged
+  ============================  =======================================
+
+Dispatch surfaces guarded (the api names a JSON fault config can target,
+in addition to the injector's patched op entry points):
+
+  * ``bridge.py``      — every engine op, by its op name ("hash.murmur3")
+  * ``transport.py``   — "h2d", "d2h", "spill", "unspill"
+  * ``exchange.py``    — "exchange_counts", "exchange_alltoall"
+  * ``reader.py``      — "parquet_page_decode", "parquet_device_decode"
+
+Real runtime exceptions classify through the same table as injected ones
+(an XLA ``RESOURCE_EXHAUSTED`` status string routes into the retry
+protocol exactly like an injected OOM), so chaos configs exercise the
+identical recovery paths production failures take.
+
+Degraded mode: after the TaskExecutor's ladder gives up on the device
+(N consecutive poison/storm failures), the task re-runs inside
+``degraded()`` — fault injection is suppressed (the host path does not
+touch the failing device) and ``utils.backend.tier_is_device`` resolves
+"auto" tiers to the host/native tier. Metrics for every domain are kept
+here and surfaced through ``RmmSpark.get_fault_domain_metrics`` and
+xprof spans (utils/tracing.py) so chaos runs are observable.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict
+
+from ..memory.exceptions import OffHeapOOM, TpuOOM, TpuRetryOOM
+from ..utils.tracing import trace_range
+from .injector import (
+    DeviceAssertError,
+    DeviceTrapError,
+    InjectedApiError,
+    get_injector,
+)
+
+# -- fault domains -----------------------------------------------------------
+
+RESOURCE_EXHAUSTED = "resource_exhausted"
+TRANSIENT = "transient"
+POISON = "poison"
+FATAL = "fatal"
+
+# substrings of real runtime-error messages that mark a domain (XLA/PJRT
+# surface gRPC-style status names inside RuntimeError text)
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "DEADLINE",
+                      "ABORTED")
+_EXHAUSTED_MARKERS = ("RESOURCE_EXHAUSTED", "OUT_OF_MEMORY", "out of memory")
+
+
+class FaultStormError(RuntimeError):
+    """Transient-fault retry budget exhausted at one dispatch point."""
+
+    def __init__(self, api: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{api}: still failing after {attempts} transient retries "
+            f"(last: {type(last).__name__}: {last})")
+        self.api = api
+        self.attempts = attempts
+        self.last = last
+
+
+class ProgramPoisonedError(RuntimeError):
+    """Device trap/assert persisted through every re-dispatch of a
+    program — the TaskExecutor ladder decides degradation from here."""
+
+    def __init__(self, api: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{api}: program poisoned after {attempts} re-dispatches "
+            f"(last: {type(last).__name__}: {last})")
+        self.api = api
+        self.attempts = attempts
+        self.last = last
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception (injected or real) to its fault domain."""
+    if isinstance(exc, (TpuOOM, OffHeapOOM, MemoryError)):
+        return RESOURCE_EXHAUSTED
+    if isinstance(exc, (DeviceTrapError, DeviceAssertError)):
+        return POISON
+    if isinstance(exc, (FaultStormError, ProgramPoisonedError)):
+        return FATAL  # budgets already spent at an inner guard — never
+        # re-absorb an exhausted storm into a fresh retry loop
+    if isinstance(exc, InjectedApiError):
+        return TRANSIENT
+    if isinstance(exc, (RuntimeError, OSError)):
+        msg = str(exc)
+        if any(m in msg for m in _EXHAUSTED_MARKERS):
+            return RESOURCE_EXHAUSTED
+        if any(m in msg for m in _TRANSIENT_MARKERS):
+            return TRANSIENT
+    return FATAL
+
+
+# -- metrics -----------------------------------------------------------------
+
+class FaultDomainMetrics:
+    """Process-wide fault-domain counters (reference analog: the RmmSpark
+    per-task retry metrics, RmmSpark.java:533-590 — these cover the domains
+    the native state machine cannot see: transient backoff, poisoning,
+    degradation). Thread-safe; surfaced via RmmSpark.get_fault_domain_metrics
+    so chaos runs read one metrics facade."""
+
+    _FIELDS = ("guarded_calls", "injected_faults", "transient_retries",
+               "backoff_time_ns", "poisoned_programs", "redispatches",
+               "resource_exhausted", "degradations", "task_retries")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {f: 0 for f in self._FIELDS}
+
+    def bump(self, field: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[field] += by
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def reset(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._c)
+            for f in self._c:
+                self._c[f] = 0
+            return out
+
+
+metrics = FaultDomainMetrics()
+
+# -- degraded mode -----------------------------------------------------------
+
+_tls = threading.local()
+
+
+def degraded_mode() -> bool:
+    """True while the calling thread runs on the degradation ladder's
+    host/CPU fallback path (fault injection suppressed, auto tiers host)."""
+    return getattr(_tls, "degraded", 0) > 0
+
+
+class degraded:
+    """Context manager marking this thread degraded (re-entrant)."""
+
+    def __enter__(self):
+        _tls.degraded = getattr(_tls, "degraded", 0) + 1
+        return self
+
+    def __exit__(self, *a):
+        _tls.degraded = getattr(_tls, "degraded", 1) - 1
+        return False
+
+
+# -- guarded dispatch --------------------------------------------------------
+
+_jitter = random.Random()
+
+
+def _backoff_s(attempt: int, base: float, cap: float) -> float:
+    """Bounded exponential backoff with full jitter (AWS-style: uniform in
+    (0, min(cap, base·2^attempt)]) — concurrent tasks hitting one transient
+    fault must not retry in lockstep."""
+    span = min(cap, base * (2.0 ** attempt))
+    return _jitter.uniform(0, span) if span > 0 else 0.0
+
+
+def _limits():
+    from ..utils import config
+    return (int(config.get("faultinj.max_transient_retries")),
+            float(config.get("faultinj.backoff_base_s")),
+            float(config.get("faultinj.backoff_max_s")),
+            int(config.get("faultinj.max_poison_redispatch")))
+
+
+def guarded_dispatch(api_name: str, fn: Callable[..., Any], *args,
+                     **kwargs) -> Any:
+    """Run one device dispatch under the fault-domain supervisor.
+
+    Consults the installed ``FaultInjector``'s rules for ``api_name``
+    before every attempt (so a JSON config targeting this name actually
+    fires here), classifies anything raised — injected or real — and
+    applies the domain's recovery: transient errors back off and retry in
+    place, poison errors re-dispatch a bounded number of times, resource
+    exhaustion re-raises into the RmmSpark retry protocol as TpuRetryOOM,
+    fatal errors propagate. ``fn`` must be effect-free up to its return
+    value (true of every guarded surface: pure dispatches and idempotent
+    transfers), since recovery re-runs it.
+    """
+    max_transient, base_s, cap_s, max_poison = _limits()
+    metrics.bump("guarded_calls")
+    inj = get_injector()
+    suppressed = degraded_mode()
+    transient_seen = 0
+    poison_seen = 0
+    while True:
+        try:
+            if inj is not None and not suppressed:
+                inj.check(api_name)
+            return fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            domain = classify(e)
+            injected = isinstance(
+                e, (InjectedApiError, DeviceTrapError, DeviceAssertError))
+            if injected:
+                metrics.bump("injected_faults")
+            if domain == RESOURCE_EXHAUSTED:
+                metrics.bump("resource_exhausted")
+                if isinstance(e, (TpuOOM, OffHeapOOM)):
+                    raise  # already speaks the retry protocol's taxonomy
+                # a real runtime OOM (XLA RESOURCE_EXHAUSTED) enters the
+                # same rollback/split protocol as a reservation denial
+                raise TpuRetryOOM(
+                    f"{api_name}: {type(e).__name__}: {e}") from e
+            if domain == TRANSIENT:
+                transient_seen += 1
+                if transient_seen > max_transient:
+                    raise FaultStormError(api_name, transient_seen - 1,
+                                          e) from e
+                delay = _backoff_s(transient_seen - 1, base_s, cap_s)
+                metrics.bump("transient_retries")
+                metrics.bump("backoff_time_ns", int(delay * 1e9))
+                with trace_range(f"fault:transient:{api_name}"):
+                    if delay:
+                        time.sleep(delay)
+                continue
+            if domain == POISON:
+                poison_seen += 1
+                metrics.bump("poisoned_programs")
+                if poison_seen > max_poison:
+                    raise ProgramPoisonedError(api_name, poison_seen - 1,
+                                               e) from e
+                metrics.bump("redispatches")
+                with trace_range(f"fault:redispatch:{api_name}"):
+                    pass
+                continue
+            raise  # FATAL

@@ -532,6 +532,20 @@ class RmmSpark:
         return cls._metric(task_id, _METRIC_MAX_RESERVED, True)
 
     @classmethod
+    def get_fault_domain_metrics(cls) -> dict:
+        """Process-wide fault-domain counters (faultinj/guard.py): guarded
+        calls, injected faults, transient retries, backoff ns, poisoned
+        programs, re-dispatches, resource-exhausted routings, task retries
+        and degradations. Available without a native adaptor installed."""
+        from ..faultinj.guard import metrics
+        return metrics.snapshot()
+
+    @classmethod
+    def reset_fault_domain_metrics(cls) -> None:
+        from ..faultinj.guard import metrics
+        metrics.reset()
+
+    @classmethod
     def pool_used(cls) -> int:
         return cls._adp().pool_used()
 

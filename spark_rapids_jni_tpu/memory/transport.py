@@ -30,6 +30,14 @@ from ..columnar.column import Column, Table
 from ..utils.tracing import trace_range
 
 
+def _guarded(api: str, fn):
+    """Per-transfer fault-domain guard (faultinj/guard.py): a JSON fault
+    config naming "h2d"/"d2h"/"spill"/"unspill" fires on the transfer it
+    names; real link failures classify into the same recovery domains."""
+    from ..faultinj.guard import guarded_dispatch
+    return guarded_dispatch(api, fn)
+
+
 def to_device(obj):
     """Host-built Column/Table → device-resident (one transfer per buffer).
 
@@ -42,13 +50,21 @@ def to_device(obj):
     if isinstance(obj, Table):
         return Table(tuple(to_device(c) for c in obj.columns))
     c: Column = obj
-    with trace_range("h2d"):
-        return Column(
-            c.dtype, c.size,
-            data=None if c.data is None else jnp.asarray(c.data),
-            validity=None if c.validity is None else jnp.asarray(c.validity),
-            offsets=None if c.offsets is None else jnp.asarray(c.offsets),
-            children=tuple(to_device(ch) for ch in c.children))
+    # children upload (and guard) individually, BEFORE this column's own
+    # guarded transfer — a retry re-runs one column's upload, not a subtree
+    children = tuple(to_device(ch) for ch in c.children)
+
+    def _upload():
+        with trace_range("h2d"):
+            return Column(
+                c.dtype, c.size,
+                data=None if c.data is None else jnp.asarray(c.data),
+                validity=None if c.validity is None
+                else jnp.asarray(c.validity),
+                offsets=None if c.offsets is None
+                else jnp.asarray(c.offsets),
+                children=children)
+    return _guarded("h2d", _upload)
 
 
 def to_host(obj):
@@ -58,13 +74,19 @@ def to_host(obj):
     if isinstance(obj, Table):
         return Table(tuple(to_host(c) for c in obj.columns))
     c: Column = obj
-    with trace_range("d2h"):
-        return Column(
-            c.dtype, c.size,
-            data=None if c.data is None else np.asarray(c.data),
-            validity=None if c.validity is None else np.asarray(c.validity),
-            offsets=None if c.offsets is None else np.asarray(c.offsets),
-            children=tuple(to_host(ch) for ch in c.children))
+    children = tuple(to_host(ch) for ch in c.children)
+
+    def _download():
+        with trace_range("d2h"):
+            return Column(
+                c.dtype, c.size,
+                data=None if c.data is None else np.asarray(c.data),
+                validity=None if c.validity is None
+                else np.asarray(c.validity),
+                offsets=None if c.offsets is None
+                else np.asarray(c.offsets),
+                children=children)
+    return _guarded("d2h", _download)
 
 
 class SpillableTable:
@@ -98,7 +120,7 @@ class SpillableTable:
                 return 0
             freed = self._table.device_nbytes()
             with trace_range("spill"):
-                self._table = to_host(self._table)
+                self._table = _guarded("spill", lambda: to_host(self._table))
             self._on_device = False
             return freed
 
@@ -107,7 +129,8 @@ class SpillableTable:
         with self._lock:
             if not self._on_device:
                 with trace_range("unspill"):
-                    self._table = to_device(self._table)
+                    self._table = _guarded(
+                        "unspill", lambda: to_device(self._table))
                 self._on_device = True
             table = self._table
         if self._on_promote is not None:

@@ -310,10 +310,15 @@ def _f64_bits_arith(v):
     """Arithmetic IEEE-754 field assembly for backends without a 64-bit
     bitcast (TPU): exponent from a float32-view frexp (32-bit bitcast —
     supported), mantissa by exact power-of-two table scaling, then
-    biased-exponent / fraction packing in u64. Values below the emulation's
-    ~2^-126 floor encode to signed zero — on TPU (the only backend routed
-    here) every such magnitude flushes in the producing computation anyway,
-    so this adds no loss the backend wasn't imposing."""
+    biased-exponent / fraction packing in u64.
+
+    Flush floor (shared contract with _f64_from_bits_arith): the supported
+    round-trip domain bottoms out at the emulation's ~2^-126 normal floor.
+    Encode flushes |v| < 2^-150 to signed zero (below even the pre-scaled
+    f32-subnormal view's resolution); decode flushes ex < -180 (~2^-128).
+    Magnitudes between the floors are best-effort — on TPU (the only
+    backend routed here) they were flushed by the producing computation
+    long before this encode, so nothing real lands there."""
     # sign incl. -0.0 without jnp.signbit: 1/±0 = ±inf is pure arithmetic
     sign = jnp.where(v == 0.0, 1.0 / v < 0.0, v < 0.0)
     av = jnp.abs(v)
@@ -348,8 +353,17 @@ def _f64_bits_arith(v):
     # below binary64's normal range: signed zero (unreachable from real
     # TPU values — the emulation flushed them long before this encode)
     bits = jnp.where(e < -1021, _U64(0), bits)
+    # defensive floor: below 2^-150 the pre-scaled f32-subnormal view has
+    # no resolution left and the frexp fields above are garbage — pin to
+    # signed zero rather than emit a garbage finite pattern
+    bits = jnp.where(av < 2.0 ** -150, _U64(0), bits)
     bits = jnp.where(av == 0, _U64(0), bits)
-    bits = jnp.where(jnp.isinf(av), _U64(0x7FF) << _U64(52), bits)
+    # av32 == inf covers finite f64 magnitudes whose f32 convert rounds to
+    # inf (above ~2^128): outside the emulation's range, so they ARE inf
+    # under this backend's arithmetic — encode them as such instead of
+    # letting frexp-on-inf garbage through
+    bits = jnp.where(jnp.isinf(av) | jnp.isinf(av32),
+                     _U64(0x7FF) << _U64(52), bits)
     bits = jnp.where(sign, bits | (_U64(1) << _U64(63)), bits)
     # canonical quiet NaN last: sign is not meaningful on NaN outputs
     return jnp.where(jnp.isnan(v), _U64(0x7FF8) << _U64(48), bits)
@@ -357,11 +371,15 @@ def _f64_bits_arith(v):
 
 def _f64_from_bits_arith(bits):
     """Arithmetic decode for backends without a 64-bit bitcast (TPU): field
-    extraction + two exact table-gathered power-of-two scales. Bit patterns
-    outside the emulation's float32 exponent range under/overflow to
-    0/inf — on TPU every |x| below ~1e-38 flushes anyway (double-double
-    emulation, §1), so this adds no loss the backend wasn't already
-    imposing."""
+    extraction + two exact table-gathered power-of-two scales.
+
+    Flush floor (shared contract with _f64_bits_arith): the supported
+    round-trip domain bottoms out at the emulation's ~2^-126 normal floor.
+    Decode flushes patterns with ex < -180 (~2^-128, incl. all f64
+    subnormals) to 0 and ex > 76 to inf; encode flushes |v| < 2^-150.
+    Magnitudes between the floors are best-effort — on TPU every such |x|
+    flushes in the double-double emulation (§1) anyway, so this adds no
+    loss the backend wasn't already imposing."""
     e = ((bits >> _U64(52)) & _U64(0x7FF)).astype(jnp.int32)
     frac = bits & ((_U64(1) << _U64(52)) - _U64(1))
     negative = (bits >> _U64(63)) != 0

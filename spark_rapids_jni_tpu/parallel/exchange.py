@@ -438,8 +438,14 @@ def hash_partition_exchange(
     # feed the skew-proportional ragged program; the single all_to_all
     # program pays the GLOBAL max for every pair and only wins (one
     # collective instead of nd-1) when traffic is near-uniform.
-    counts_mat = _host_global(
-        _counts_program(mesh, per_dev, nd)(dest_d, live_d)).reshape(nd, nd)
+    # Both shard_map launches run under the fault-domain supervisor
+    # (faultinj/guard.py): fault configs target "exchange_counts" /
+    # "exchange_alltoall", and a real collective failure (UNAVAILABLE,
+    # RESOURCE_EXHAUSTED) classifies into the same recovery domains.
+    from ..faultinj.guard import guarded_dispatch
+    counts_mat = _host_global(guarded_dispatch(
+        "exchange_counts", _counts_program(mesh, per_dev, nd),
+        dest_d, live_d)).reshape(nd, nd)
     ragged, cap, caps = _exchange_plan(counts_mat, nd)
 
     buffers: List[jnp.ndarray] = []
@@ -461,8 +467,9 @@ def hash_partition_exchange(
                                                shapes)
             _EXCHANGE_CACHE[sig] = program
         zone = sum(caps)
-        out = program(dest_d, live_d, jnp.asarray(counts_mat, jnp.int32),
-                      *buffers)
+        out = guarded_dispatch(
+            "exchange_alltoall", program, dest_d, live_d,
+            jnp.asarray(counts_mat, jnp.int32), *buffers)
     else:
         sig = (mesh, per_dev, cap, shapes)
         program = _EXCHANGE_CACHE.get(sig)
@@ -470,7 +477,8 @@ def hash_partition_exchange(
             program = _exchange_program(mesh, per_dev, cap, nd, shapes)
             _EXCHANGE_CACHE[sig] = program
         zone = nd * cap
-        out = program(dest_d, live_d, *buffers)
+        out = guarded_dispatch("exchange_alltoall", program, dest_d, live_d,
+                               *buffers)
 
     # Device-resident rebuild. Partition row counts need NO extra sync:
     # phase 1's counts matrix already gives k_p as destination-column sums

@@ -32,23 +32,103 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
+from ..faultinj import guard
+from ..faultinj.injector import DeviceAssertError, DeviceTrapError
+from ..memory.exceptions import (
+    CpuRetryOOM,
+    TpuOOM,
+    TpuRetryOOM,
+)
 from ..memory.rmm_spark import RmmSpark
 from ..utils.tracing import trace_range
 
 _SENTINEL = object()
 
+# failures the degradation ladder counts as "the device is unhealthy":
+# traps/asserts that escaped an unguarded path, plus the guard's own
+# exhausted-budget verdicts (a storm or a poisoned program at any surface)
+_DEVICE_FAILURES = (DeviceTrapError, DeviceAssertError,
+                    guard.FaultStormError, guard.ProgramPoisonedError)
+
 
 class _TaskWorker:
     """Dedicated worker thread for one task id (the reference's
-    per-task-thread model: RmmSpark.java startDedicatedTaskThread)."""
+    per-task-thread model: RmmSpark.java startDedicatedTaskThread).
 
-    def __init__(self, task_id: int, register: bool):
+    Every submission runs under the degradation ladder (_supervise):
+    retry-OOM rolls back to spillable state and retries within the
+    ``task.retry_budget``; after ``task.degrade_after`` consecutive device
+    failures the task is downgraded to the host/CPU compute path
+    (guard.degraded mode: injection suppressed, auto tiers resolve host)
+    for the rest of its life, with a tracing span and a degradation
+    counter recording the downgrade.
+    """
+
+    def __init__(self, task_id: int, register: bool, spill_store=None):
         self.task_id = task_id
+        self.degraded = False
         self._register = register
+        self._spill_store = spill_store
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
             target=self._run, name=f"task-exec-{task_id}", daemon=True)
         self._thread.start()
+
+    def _rollback(self):
+        """Roll back to a spillable state between attempts (the TpuRetryOOM
+        contract): demote every registered buffer, then re-enter the
+        scheduler's gate when one is installed."""
+        if self._spill_store is not None:
+            self._spill_store.spill_all()
+        if RmmSpark.is_installed():
+            try:
+                RmmSpark.block_thread_until_ready()
+            except (TpuOOM, RuntimeError):
+                # an escalation here re-manifests at the next reservation;
+                # the retry budget still bounds the loop
+                pass
+
+    def _supervise(self, fn, args, kwargs):
+        """Run one submission under the per-task retry/degradation ladder."""
+        from ..utils import config
+        budget = int(config.get("task.retry_budget"))
+        degrade_after = int(config.get("task.degrade_after"))
+        attempts = 0
+        device_failures = 0
+        label = getattr(fn, "__name__", None) or repr(fn)
+        while True:
+            try:
+                if self.degraded:
+                    with guard.degraded(), \
+                            trace_range(f"task{self.task_id}:degraded:"
+                                        f"{label}"):
+                        return fn(*args, **kwargs)
+                with trace_range(f"task{self.task_id}:{label}"):
+                    return fn(*args, **kwargs)
+            except (TpuRetryOOM, CpuRetryOOM):
+                # memory pressure: not a device-health signal — rollback
+                # and retry under the budget (split escalation is the
+                # caller's protocol via memory.retry.with_retry)
+                attempts += 1
+                device_failures = 0
+                if attempts > budget:
+                    raise
+                guard.metrics.bump("task_retries")
+                self._rollback()
+            except _DEVICE_FAILURES:
+                attempts += 1
+                device_failures += 1
+                if (degrade_after > 0 and not self.degraded
+                        and device_failures >= degrade_after):
+                    self.degraded = True
+                    guard.metrics.bump("degradations")
+                    with trace_range(f"task{self.task_id}:degrade"):
+                        pass
+                    continue  # the downgrade itself is not a retry spend
+                if attempts > budget:
+                    raise
+                guard.metrics.bump("task_retries")
+                self._rollback()
 
     def _run(self):
         registered = False
@@ -67,9 +147,7 @@ class _TaskWorker:
                 if not fut.set_running_or_notify_cancel():
                     continue
                 try:
-                    label = getattr(fn, "__name__", None) or repr(fn)
-                    with trace_range(f"task{self.task_id}:{label}"):
-                        fut.set_result(fn(*args, **kwargs))
+                    fut.set_result(self._supervise(fn, args, kwargs))
                 except BaseException as e:  # noqa: BLE001 — to the future
                     fut.set_exception(e)
         finally:
@@ -104,13 +182,23 @@ class TaskExecutor:
     worker; distinct tasks run concurrently (device dispatch is async, host
     phases interleave), same-task ops keep submission order — exactly the
     per-stream ordering contract CUDA streams give the reference.
+
+    ``spill_store`` (optional): a :class:`memory.transport.SpillStore` the
+    degradation ladder rolls back through between retry attempts.
     """
 
-    def __init__(self, mark_tasks_done: bool = True):
+    def __init__(self, mark_tasks_done: bool = True, spill_store=None):
         self._workers: Dict[int, _TaskWorker] = {}
         self._lock = threading.Lock()
         self._mark_done = mark_tasks_done
+        self._spill_store = spill_store
         self._closed = False
+
+    def degraded_task_ids(self):
+        """Task ids currently downgraded to the host/CPU compute path."""
+        with self._lock:
+            return sorted(tid for tid, w in self._workers.items()
+                          if w.degraded)
 
     def submit(self, task_id: int, fn: Callable[..., Any], *args,
                **kwargs) -> Future:
@@ -120,7 +208,8 @@ class TaskExecutor:
             w = self._workers.get(task_id)
             if w is None:
                 register = RmmSpark.is_installed()
-                w = _TaskWorker(task_id, register)
+                w = _TaskWorker(task_id, register,
+                                spill_store=self._spill_store)
                 self._workers[task_id] = w
             # enqueue under the lock: a concurrent task_done()/close() could
             # otherwise slip its stop sentinel ahead of this item and leave

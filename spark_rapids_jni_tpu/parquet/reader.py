@@ -371,15 +371,24 @@ class ParquetReader:
         raw = f.read(length)
         buf = np.frombuffer(raw, dtype=np.uint8)
         out = _OutC()
-        err = ctypes.c_char_p()
-        rc = self._lib.pqd_decode_chunk2(
-            self._h, rg, leaf.index,
-            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
-            1 if want_levels else 0, ctypes.byref(out), ctypes.byref(err))
-        if rc != 0:
-            msg = err.value.decode() if err.value else "unknown error"
-            self._lib.pqd_free(err)
-            raise RuntimeError(f"decode {leaf.name!r} rg={rg} failed: {msg}")
+
+        def _native_decode():
+            err = ctypes.c_char_p()
+            rc = self._lib.pqd_decode_chunk2(
+                self._h, rg, leaf.index,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+                1 if want_levels else 0, ctypes.byref(out), ctypes.byref(err))
+            if rc != 0:
+                msg = err.value.decode() if err.value else "unknown error"
+                self._lib.pqd_free(err)
+                raise RuntimeError(
+                    f"decode {leaf.name!r} rg={rg} failed: {msg}")
+
+        # per-page-stream decode under the fault-domain supervisor: fault
+        # configs target "parquet_page_decode"; the native decode fills
+        # `out` only on rc==0, so a retried attempt starts clean
+        from ..faultinj.guard import guarded_dispatch
+        guarded_dispatch("parquet_page_decode", _native_decode)
         try:
             rows = out.rows
             values = np.ctypeslib.as_array(out.values,
@@ -616,8 +625,11 @@ class ParquetReader:
                         avg = max(1, (p.val_len - 4 * p.num_values)
                                   // p.num_values)
                         est += int(nv) * int(avg)
+        from ..faultinj.guard import guarded_dispatch
         with device_reservation(est) as took:
-            cols = [dd.decode_leaf_device(leaf, blob, pages, rows, lrows)
+            cols = [guarded_dispatch("parquet_device_decode",
+                                     dd.decode_leaf_device,
+                                     leaf, blob, pages, rows, lrows)
                     for blob, pages, rows, lrows in parts]
             col = cols[0] if len(cols) == 1 else concat_columns(cols)
             release_barrier(col, took)

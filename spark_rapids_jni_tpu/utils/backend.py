@@ -27,4 +27,9 @@ def tier_is_device(flag_key: str, device_value: str = "device",
         return True
     if v == host_value or v == "off":
         return False
+    # degraded task (faultinj/guard.py ladder): auto tiers resolve to the
+    # host path — the device is presumed unhealthy for this thread
+    from ..faultinj.guard import degraded_mode
+    if degraded_mode():
+        return False
     return is_accelerator()

@@ -70,6 +70,23 @@ _register("native.so_override", "SRJT_NATIVE_SO_OVERRIDE", "", str,
           "(sanitizer tier, ci/sanitize.sh)")
 _register("faultinj.config", "FAULT_INJECTOR_CONFIG_PATH", "", str,
           "fault-injection JSON config path (ref: cufaultinj LD_PRELOAD arg)")
+_register("faultinj.max_transient_retries", "SRJT_FAULT_MAX_TRANSIENT", 5,
+          int, "in-place retries per dispatch for TRANSIENT faults "
+          "(UNAVAILABLE/DEADLINE/InjectedApiError) before FaultStormError")
+_register("faultinj.backoff_base_s", "SRJT_FAULT_BACKOFF_BASE_S", 0.005,
+          float, "transient-fault backoff base; attempt k sleeps "
+          "uniform(0, min(max, base*2^k)) — full jitter")
+_register("faultinj.backoff_max_s", "SRJT_FAULT_BACKOFF_MAX_S", 0.25, float,
+          "transient-fault backoff cap per sleep")
+_register("faultinj.max_poison_redispatch", "SRJT_FAULT_MAX_POISON", 2, int,
+          "re-dispatches of a poisoned program (DeviceTrap/DeviceAssert) "
+          "before ProgramPoisonedError reaches the degradation ladder")
+_register("task.retry_budget", "SRJT_TASK_RETRY_BUDGET", 4, int,
+          "TaskExecutor per-submission retry budget across all fault "
+          "domains (rollback-to-spillable between attempts)")
+_register("task.degrade_after", "SRJT_TASK_DEGRADE_AFTER", 3, int,
+          "consecutive device failures before a task degrades to the "
+          "host/CPU compute path (0 disables degradation)")
 _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "input variants cycled by benchmarks to defeat identical-args "
           "elision")

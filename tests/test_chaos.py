@@ -1,0 +1,198 @@
+"""Chaos harness: pipelines under fault storms must yield exactly-once
+results.
+
+A TPC-H-style pipeline (hash -> join -> groupby -> sort, with a spill/
+promote round-trip and explicit transfers so every guarded dispatch
+surface participates) runs under JSON fault configs injecting transient
+faults on the hashing + transport api names at 0% / 30% / 100% rates.
+The supervisor (faultinj/guard.py) must absorb every injected fault
+within its retry budget and the results must be BIT-IDENTICAL to the
+fault-free run; at 100% with an unbounded trap rule, the TaskExecutor
+degradation ladder must downgrade the task to the host path and still
+produce the fault-free answer, with the downgrade visible in
+RmmSpark.get_fault_domain_metrics().
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import bridge
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.table_ops import gather_table
+from spark_rapids_jni_tpu.faultinj import install, uninstall
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.memory.transport import (
+    SpillStore,
+    to_host,
+)
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.utils import config
+
+pytestmark = pytest.mark.chaos
+
+N = 512
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    yield
+    uninstall()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    # real backoff curves are seconds-scale; the chaos tests only need the
+    # ordering semantics, not the wall clock
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002):
+        yield
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "chaos.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def _transient_cfg(percent, count):
+    """Transient (injectionType 2 -> InjectedApiError) faults on the
+    hashing op name and every transport surface."""
+    rule = {"percent": percent, "injectionType": 2,
+            "substituteReturnCode": 700, "interceptionCount": count}
+    return {"xlaRuntimeFaults": {
+        name: dict(rule)
+        for name in ("hash.murmur3", "h2d", "d2h", "spill", "unspill")}}
+
+
+def _pipeline():
+    """Deterministic fact/dim pipeline over every guarded surface.
+
+    Returns plain host values (lists + raw hash bytes) so equality between
+    runs is bit-equality, not approximate.
+    """
+    rng = np.random.default_rng(7)
+    f_keys = rng.integers(0, 40, N).tolist()
+    f_vals = rng.integers(-1000, 1000, N).tolist()
+    d_keys = list(range(40))
+    d_pay = rng.integers(1, 9, 40).tolist()
+
+    fact = Table((Column.from_pylist(f_keys, dt.INT64),
+                  Column.from_pylist(f_vals, dt.INT64)))
+    dim = Table((Column.from_pylist(d_keys, dt.INT64),
+                 Column.from_pylist(d_pay, dt.INT64)))
+
+    # guarded op dispatch ("hash.murmur3" fires in bridge.call)
+    hashed, _ = bridge.call("hash.murmur3", json.dumps({"seed": 42}),
+                            [bridge.col_to_wire(fact.columns[0])])
+
+    # join + payload gather, then groupby + sort (the compute core)
+    li, ri = inner_join([fact.columns[0]], [dim.columns[0]])
+    lt = gather_table(fact, li)
+    rt = gather_table(Table((dim.columns[1],)), ri)
+    joined = Table((lt.columns[0], lt.columns[1], rt.columns[0]))
+    agg = groupby_aggregate(joined, [0], [(1, "sum"), (2, "sum"),
+                                          (1, "count")])
+    out = sort_table(agg, [0])
+
+    # spill -> promote round-trip ("spill"/"d2h" then "unspill"/"h2d")
+    store = SpillStore()
+    st = store.register(out)
+    st.spill()
+    out = st.get()
+
+    host = to_host(out)  # "d2h" per column
+    return ([c.to_pylist() for c in host.columns], hashed)
+
+
+def test_pipeline_fault_free_baseline_and_guard_metrics():
+    baseline = _pipeline()
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["guarded_calls"] > 0
+    assert m["injected_faults"] == 0
+    assert m["transient_retries"] == 0
+    # self-consistency: a repeat run is bit-identical even with no faults
+    RmmSpark.reset_fault_domain_metrics()
+    assert _pipeline() == baseline
+
+
+def test_pipeline_exactly_once_at_0_percent(tmp_path):
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, _transient_cfg(0, 10_000)), seed=0)
+    assert _pipeline() == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_faults"] == 0
+
+
+def test_pipeline_exactly_once_at_30_percent(tmp_path):
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, _transient_cfg(30, 10_000)), seed=0)
+    assert _pipeline() == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    # the storm really happened AND the supervisor really absorbed it
+    assert m["injected_faults"] > 0
+    assert m["transient_retries"] == m["injected_faults"]
+    assert m["backoff_time_ns"] > 0
+
+
+def test_pipeline_exactly_once_at_100_percent_bounded(tmp_path):
+    # 100% rate with a bounded interception budget (below the per-site
+    # transient retry budget): every guarded call retries through the
+    # whole storm, then the drained rule lets it through
+    baseline = _pipeline()
+    with config.override("faultinj.max_transient_retries", 5):
+        install(write_cfg(tmp_path, _transient_cfg(100, 4)), seed=0)
+        assert _pipeline() == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_faults"] == 5 * 4  # 4 per rule, 5 rules, all retried
+    assert m["transient_retries"] == m["injected_faults"]
+
+
+def test_degradation_ladder_fires_at_100_percent_unbounded(tmp_path):
+    """Unbounded 100% trap storm on the hash op: the guard's poison budget
+    exhausts, the TaskExecutor ladder counts consecutive device failures,
+    downgrades the task to the host path (injection suppressed there), and
+    the degraded run still yields the fault-free answer."""
+    from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "hash.murmur3": {"percent": 100, "injectionType": 0,
+                             "interceptionCount": 10_000}}}), seed=0)
+    store = SpillStore()
+    with config.override("faultinj.max_poison_redispatch", 1), \
+            config.override("task.retry_budget", 4), \
+            config.override("task.degrade_after", 2), \
+            TaskExecutor(spill_store=store) as ex:
+        fut = ex.submit(1, _pipeline)
+        assert fut.result(timeout=120) == baseline
+        assert ex.degraded_task_ids() == [1]
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["degradations"] == 1
+    assert m["poisoned_programs"] > 0
+    assert m["task_retries"] >= 1
+
+
+def test_retry_budget_exhaustion_is_loud(tmp_path):
+    """An unbounded transient storm must NOT spin forever or return a
+    partial result: it surfaces as FaultStormError once the per-site
+    budget is spent."""
+    from spark_rapids_jni_tpu.faultinj import FaultStormError
+
+    install(write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "hash.murmur3": {"percent": 100, "injectionType": 2,
+                             "substituteReturnCode": 700,
+                             "interceptionCount": 10_000}}}), seed=0)
+    with config.override("faultinj.max_transient_retries", 3):
+        with pytest.raises(FaultStormError):
+            _pipeline()
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["transient_retries"] == 3
