@@ -9,5 +9,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# chaos runs start from a clean lint state: a fault storm exercising an
+# UN-guarded dispatch path (SRJT003) or an undeclared config key (SRJT004)
+# would debug as a supervisor bug when it is a wiring bug. AST rules only —
+# the jaxpr auditor warms a backend, which this lane does itself anyway.
+SRJT_LINT_NO_JAXPR=1 bash ci/lint.sh
+
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

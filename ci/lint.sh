@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# srjt-lint lane: block-on-new-findings static analysis.
+#
+# Runs the AST rule catalog (SRJT001-008) and the jaxpr auditor
+# (SRJTX01-05) over the package. Findings recorded in ci/lint_baseline.json
+# warn; anything new exits non-zero. SRJT_LINT_NO_JAXPR=1 skips the jaxpr
+# engine (pure-AST mode; no jax import — used by environments without a
+# working backend). See docs/STATIC_ANALYSIS.md for the rule catalog,
+# suppression syntax and baseline workflow.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=()
+if [[ "${SRJT_LINT_NO_JAXPR:-0}" == "1" ]]; then
+    ARGS+=(--no-jaxpr)
+fi
+
+exec env JAX_PLATFORMS=cpu python -m spark_rapids_jni_tpu.analysis \
+    "${ARGS[@]}" "$@"

@@ -38,10 +38,9 @@ _jax.config.update("jax_enable_x64", True)
 # across processes — 954 ms → 72 ms for the same shape in a fresh process.
 # On CPU backends compiles are cheap; only slow ones are worth the disk IO.
 # Opt out with SRJT_COMPILE_CACHE=0, or point it at a different directory.
-_cache = _os.environ.get(
-    "SRJT_COMPILE_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache",
-                  "spark_rapids_jni_tpu", "xla"))
+from .utils import config as _config  # noqa: E402
+
+_cache = _config.get("compile.cache_dir")
 if _cache not in ("0", ""):
     _jax.config.update("jax_compilation_cache_dir", _cache)
     # cache-everything only when an accelerator platform is explicitly

@@ -1,0 +1,34 @@
+"""srjt-lint: TPU-invariant static analysis for this engine.
+
+The reference ships correctness tooling alongside its kernels (sanitizer
+builds, cufaultinj, fuzz + leak lanes) because a columnar engine's worst
+bugs are invisible to unit tests: a silent host sync is a perf cliff, a
+narrowed dtype is wrong nulls at scale, an unguarded dispatch is a crash
+only under faults. This package is the TPU port's equivalent — two engines
+that enforce the invariants the docs state and the code relies on:
+
+  * an AST pass (stdlib ``ast``, no dependencies) over the whole package
+    with the SRJT00x rule catalog (docs/STATIC_ANALYSIS.md);
+  * a jaxpr auditor that traces registered device ops at tiny shapes and
+    scans the emitted jaxpr for forbidden primitives (SRJTX0x).
+
+Entry points::
+
+    python -m spark_rapids_jni_tpu.analysis --format json
+    make lint            # block-on-new-findings mode (ci/lint.sh)
+
+Findings already recorded in ``ci/lint_baseline.json`` warn; anything new
+fails. Per-line suppression: ``# srjt: noqa[SRJT001]`` (or bare
+``# srjt: noqa`` for every rule on that line).
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    ProjectContext,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from .rules import ALL_RULES, FILE_RULES, PROJECT_RULES  # noqa: F401

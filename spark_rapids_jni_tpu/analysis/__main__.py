@@ -1,0 +1,95 @@
+"""srjt-lint CLI: ``python -m spark_rapids_jni_tpu.analysis``.
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = analyzer error. ``make lint`` / ci/lint.sh run this in
+block-on-new-findings mode; ``--write-baseline`` accepts the current
+findings (review the diff of ci/lint_baseline.json like code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (ProjectContext, analyze_paths, load_baseline,
+                   match_baseline, write_baseline)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "ci", "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_jni_tpu.analysis",
+        description="srjt-lint: TPU-invariant static analysis "
+                    "(AST rules SRJT001-008 + jaxpr audit SRJTX01-05)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline JSON (default ci/lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="every finding fails, baselined or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings as the baseline")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr auditor (no jax import; pure AST)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule IDs to keep (e.g. "
+                         "SRJT004,SRJTX01); default all")
+    try:
+        args = ap.parse_args(argv)
+        paths = args.paths or [os.path.join(_REPO_ROOT,
+                                            "spark_rapids_jni_tpu")]
+        ctx = ProjectContext.from_package()
+        findings = analyze_paths(paths, ctx)
+        if not args.no_jaxpr:
+            from .jaxpr_audit import run_jaxpr_audit
+            findings = findings + run_jaxpr_audit()
+        if args.rules:
+            keep = {r.strip().upper() for r in args.rules.split(",")}
+            findings = [f for f in findings if f.rule in keep]
+
+        if args.write_baseline:
+            write_baseline(args.baseline, findings)
+            print(f"baseline written: {args.baseline} "
+                  f"({len(findings)} findings accepted)")
+            return 0
+
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+        new, old, stale = match_baseline(findings, baseline)
+
+        if args.format == "json":
+            print(json.dumps({
+                "new": [f.to_json() for f in new],
+                "baselined": [f.to_json() for f in old],
+                "stale_baseline": stale,
+                "counts": {"new": len(new), "baselined": len(old),
+                           "stale_baseline": len(stale)},
+            }, indent=1))
+        else:
+            for f in old:
+                print(f"warning: {f.render()}")
+            for f in new:
+                print(f"error: {f.render()}")
+            for e in stale:
+                print(f"note: baseline entry no longer matches "
+                      f"(fixed? prune it): {e['rule']} {e['path']} "
+                      f"{e.get('snippet', '')!r}")
+            print(f"srjt-lint: {len(new)} new, {len(old)} baselined, "
+                  f"{len(stale)} stale baseline "
+                  f"entr{'y' if len(stale) == 1 else 'ies'}")
+        return 1 if new else 0
+    except BrokenPipeError:
+        return 2
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"srjt-lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

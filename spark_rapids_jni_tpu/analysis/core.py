@@ -1,0 +1,278 @@
+"""Analyzer core: finding model, noqa, baseline, file walking, runner.
+
+Deliberately dependency-free (stdlib ``ast``/``re``/``json`` only) so the
+lint lane runs before — and independently of — a working jax install; the
+jaxpr auditor is the only part that imports the engine, and the CLI gates
+it behind ``--jaxpr``/``--no-jaxpr``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# package root = spark_rapids_jni_tpu/
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+_NOQA_RE = re.compile(r"#\s*srjt:\s*noqa(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          # "SRJT004" / "SRJTX01"
+    path: str          # repo-relative, "/"-separated
+    line: int          # 1-based; 0 for whole-module findings
+    message: str
+    snippet: str = ""  # stripped source line (fingerprint anchor)
+    occurrence: int = 0  # index among same (rule, path, snippet)
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity across unrelated line moves: the line *content*
+        anchors the finding, not its number, so inserting code above a
+        baselined finding does not resurrect it as "new"."""
+        raw = f"{self.rule}|{self.path}|{self.snippet}|{self.occurrence}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "snippet": self.snippet,
+            "fingerprint": self.fingerprint, "baselined": self.baselined,
+        }
+
+    def render(self) -> str:
+        mark = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}: {self.rule}{mark} {self.message}"
+
+
+class ProjectContext:
+    """Repo-level facts the rules check against (declared config keys,
+    registered env names, metrics counter fields). Parsed from the real
+    modules by default; tests construct one explicitly so rule fixtures
+    don't depend on the live registry's contents."""
+
+    def __init__(self, config_keys: Optional[set] = None,
+                 config_envs: Optional[set] = None,
+                 metrics_fields: Optional[set] = None):
+        self.config_keys = config_keys if config_keys is not None else set()
+        self.config_envs = config_envs if config_envs is not None else set()
+        self.metrics_fields = (metrics_fields if metrics_fields is not None
+                               else set())
+
+    @classmethod
+    def from_package(cls, pkg_root: str = _PKG_ROOT) -> "ProjectContext":
+        ctx = cls()
+        cfg = os.path.join(pkg_root, "utils", "config.py")
+        guard = os.path.join(pkg_root, "faultinj", "guard.py")
+        if os.path.exists(cfg):
+            with open(cfg) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "_register"
+                        and len(node.args) >= 2
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[1], ast.Constant)):
+                    ctx.config_keys.add(node.args[0].value)
+                    ctx.config_envs.add(node.args[1].value)
+        if os.path.exists(guard):
+            with open(guard) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Assign) and node.targets
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == "_FIELDS"
+                        and isinstance(node.value, (ast.Tuple, ast.List))):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant):
+                            ctx.metrics_fields.add(el.value)
+        return ctx
+
+
+def noqa_rules_for_line(lines: Sequence[str], line_no: int) -> Optional[set]:
+    """Suppressions on one physical line: None = no noqa, empty set = bare
+    ``# srjt: noqa`` (suppresses every rule), else the named rules."""
+    if not (1 <= line_no <= len(lines)):
+        return None
+    m = _NOQA_RE.search(lines[line_no - 1])
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def apply_noqa(findings: Iterable[Finding],
+               lines: Sequence[str]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        rules = noqa_rules_for_line(lines, f.line)
+        if rules is not None and (not rules or f.rule in rules):
+            continue
+        kept.append(f)
+    return kept
+
+
+def _finalize(findings: List[Finding]) -> List[Finding]:
+    """Order findings and assign occurrence indices (fingerprint input)."""
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    seen: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.snippet)
+        f.occurrence = seen.get(key, 0)
+        seen[key] = f.occurrence + 1
+    return findings
+
+
+def _rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(_REPO_ROOT + os.sep):
+        ap = ap[len(_REPO_ROOT) + 1:]
+    return ap.replace(os.sep, "/")
+
+
+def analyze_source(source: str, path: str, ctx: ProjectContext,
+                   rules: Optional[Sequence] = None) -> List[Finding]:
+    """Run the per-file rules over one source blob (fixture entry point)."""
+    from .rules import FILE_RULES
+    rules = FILE_RULES if rules is None else rules
+    rel = _rel(path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("SRJT000", rel, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    findings: List[Finding] = []
+    for rule in rules:
+        for f in rule(tree, rel, lines, ctx):
+            if not f.snippet and 1 <= f.line <= len(lines):
+                f.snippet = lines[f.line - 1].strip()
+            findings.append(f)
+    return _finalize(apply_noqa(findings, lines))
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def analyze_paths(paths: Sequence[str],
+                  ctx: Optional[ProjectContext] = None,
+                  rules: Optional[Sequence] = None,
+                  project_rules: Optional[Sequence] = None) -> List[Finding]:
+    """AST pass over every .py under ``paths``: per-file rules first, then
+    the cross-file rules (name-drift needs the whole corpus)."""
+    from .rules import FILE_RULES, PROJECT_RULES
+    ctx = ctx or ProjectContext.from_package()
+    rules = FILE_RULES if rules is None else rules
+    project_rules = PROJECT_RULES if project_rules is None else project_rules
+    findings: List[Finding] = []
+    modules = []  # (rel, tree, lines) for project rules
+    for fp in iter_python_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = _rel(fp)
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding("SRJT000", rel, e.lineno or 0,
+                                    f"syntax error: {e.msg}"))
+            continue
+        lines = source.splitlines()
+        per_file: List[Finding] = []
+        for rule in rules:
+            per_file.extend(rule(tree, rel, lines, ctx))
+        for f in per_file:
+            if not f.snippet and 1 <= f.line <= len(lines):
+                f.snippet = lines[f.line - 1].strip()
+        findings.extend(apply_noqa(per_file, lines))
+        modules.append((rel, tree, lines))
+    for prule in project_rules:
+        extra = prule(modules, ctx)
+        by_path = {rel: lines for rel, _, lines in modules}
+        for f in extra:
+            lines = by_path.get(f.path, [])
+            if not f.snippet and 1 <= f.line <= len(lines):
+                f.snippet = lines[f.line - 1].strip()
+        keep = []
+        for f in extra:
+            lines = by_path.get(f.path, [])
+            rules_noqa = noqa_rules_for_line(lines, f.line)
+            if rules_noqa is not None and (not rules_noqa
+                                           or f.rule in rules_noqa):
+                continue
+            keep.append(f)
+        findings.extend(keep)
+    return _finalize(findings)
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, Dict]:
+    """fingerprint -> baseline entry. Missing file = empty baseline."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def match_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, Dict]) -> Tuple[List[Finding],
+                                                       List[Finding],
+                                                       List[Dict]]:
+    """Split into (new, baselined, stale-baseline-entries)."""
+    new, old = [], []
+    seen = set()
+    for f in findings:
+        fp = f.fingerprint
+        if fp in baseline:
+            f.baselined = True
+            old.append(f)
+            seen.add(fp)
+        else:
+            new.append(f)
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, old, stale
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Accept the current findings as the baseline. Every entry carries its
+    human-readable context so reviewers can audit what was accepted."""
+    data = {
+        "comment": "srjt-lint accepted findings — new findings still fail; "
+                   "see docs/STATIC_ANALYSIS.md for the workflow",
+        "findings": [
+            {"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+             "line": f.line, "message": f.message, "snippet": f.snippet}
+            for f in sorted(findings, key=lambda x: (x.path, x.line, x.rule))
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")

@@ -1,0 +1,217 @@
+"""Jaxpr auditor: trace registered device ops at tiny shapes, scan the
+emitted program for primitives that violate TPU invariants.
+
+The AST rules see what the *source* says; this engine sees what XLA will
+actually be asked to run. Column is a registered pytree, so whole ops
+trace through ``jax.make_jaxpr`` with their buffers abstracted — at
+4-row symbolic shapes the trace is milliseconds, and every primitive in
+the closed jaxpr (including nested pjit/scan/cond bodies) is visible.
+
+Audited properties:
+  SRJTX01  ``convert_element_type`` to f64 — a device f64 materialization
+           (lossy storage, docs/TPU_NUMERICS.md §1)
+  SRJTX02  ``pure_callback`` / ``io_callback`` — a host callback spliced
+           into a device program (hidden sync on every execution)
+  SRJTX03  ``device_put`` inside the traced program — an op should
+           consume device-resident inputs, not re-stage them mid-program
+  SRJTX04  ``bitcast_convert_type`` on a 64-bit element type — does not
+           compile in the X64 rewriter (docs/TPU_NUMERICS.md §3)
+  SRJTX05  op not traceable at symbolic shapes (a data-dependent host
+           sync inside the kernel) — reported only for ops registered
+           with ``expect_traceable=True``
+
+The registry below covers the bridge ops whose compute is a single
+device program over fixed-width inputs. String/JSON/URI ops and the
+chunked parquet reader are *deliberately* absent: their host tiers and
+host-sized staging are architectural (see "sizing on host, data on
+device", parallel/exchange.py) and their device kernels are audited
+transitively through the ops here that share them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+from .core import Finding
+
+_F64_NAMES = ("float64", "f64")
+
+
+@dataclasses.dataclass
+class AuditSpec:
+    """One auditable op: a builder returning (callable, example_args)."""
+
+    name: str                      # bridge op name ("hash.murmur3")
+    build: Callable                # () -> (fn, args tuple)
+    expect_traceable: bool = True
+    allow_callbacks: bool = False  # debug-style ops may host-call
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in a (closed) jaxpr, recursing into sub-jaxprs held in
+    eqn params (pjit/closed_call bodies, scan/while/cond branches)."""
+    core_jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in core_jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else [v]):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub)
+
+
+def _dtype_is_f64(d) -> bool:
+    return any(n in str(d) for n in _F64_NAMES)
+
+
+def _dtype_is_64bit(d) -> bool:
+    return str(d) in ("float64", "int64", "uint64") or "64" in str(d)
+
+
+def scan_jaxpr(name: str, jaxpr, allow_callbacks: bool = False,
+               path: str = "jaxpr") -> List[Finding]:
+    """Scan one (closed) jaxpr for forbidden primitives."""
+    findings = []
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim == "convert_element_type" \
+                and _dtype_is_f64(eqn.params.get("new_dtype")):
+            findings.append(Finding(
+                "SRJTX01", path, 0,
+                f"op {name!r}: convert_element_type -> f64 in the traced "
+                f"program — f64 device storage is lossy on TPU "
+                f"(docs/TPU_NUMERICS.md §1)", snippet=name))
+        elif "callback" in prim and not allow_callbacks:
+            findings.append(Finding(
+                "SRJTX02", path, 0,
+                f"op {name!r}: `{prim}` in the traced program — host "
+                f"callback forces a device→host→device round-trip every "
+                f"execution", snippet=name))
+        elif prim == "device_put":
+            findings.append(Finding(
+                "SRJTX03", path, 0,
+                f"op {name!r}: device_put inside the traced program — "
+                f"inputs should be device-resident before dispatch "
+                f"(memory/transport.py owns staging)", snippet=name))
+        elif prim == "bitcast_convert_type":
+            operand = eqn.invars[0].aval if eqn.invars else None
+            new = eqn.params.get("new_dtype")
+            if (operand is not None and _dtype_is_64bit(operand.dtype)) \
+                    or (new is not None and _dtype_is_64bit(new)):
+                findings.append(Finding(
+                    "SRJTX04", path, 0,
+                    f"op {name!r}: bitcast_convert_type on a 64-bit "
+                    f"element type — rejected by the X64 rewriter "
+                    f"(docs/TPU_NUMERICS.md §3)", snippet=name))
+    return findings
+
+
+def audit_callable(name: str, fn: Callable, *args,
+                   expect_traceable: bool = True,
+                   allow_callbacks: bool = False) -> List[Finding]:
+    """Trace ``fn(*args)`` abstractly and scan the jaxpr (test entry
+    point — the known-dirty fixtures in tests/test_analysis.py audit
+    plain functions through this)."""
+    import jax
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any trace failure is the signal
+        if not expect_traceable:
+            return []
+        return [Finding(
+            "SRJTX05", "jaxpr", 0,
+            f"op {name!r}: not traceable at symbolic shapes "
+            f"({type(e).__name__}) — a data-dependent host sync lives "
+            f"inside the kernel", snippet=name)]
+    return scan_jaxpr(name, jaxpr, allow_callbacks=allow_callbacks)
+
+
+# ---------------------------------------------------------------------------
+# registry: bridge ops with single-program device compute
+# ---------------------------------------------------------------------------
+
+def _tiny_fixed(dtype, values):
+    import jax.numpy as jnp
+    from ..columnar.column import Column
+    arr = jnp.asarray(values)
+    return Column(dtype, int(arr.shape[0]), data=arr)
+
+
+def _build_murmur3():
+    from ..columnar import dtype as dt
+    from ..columnar.column import Table
+    from ..ops.hashing import murmur_hash3_32
+    col = _tiny_fixed(dt.INT32, [1, 2, 3, 4])
+    return (lambda c: murmur_hash3_32(Table((c,))).data), (col,)
+
+
+def _build_xxhash64():
+    from ..columnar import dtype as dt
+    from ..columnar.column import Table
+    from ..ops.hashing import xxhash64
+    col = _tiny_fixed(dt.INT64, [1, 2, 3, 4])
+    return (lambda c: xxhash64(Table((c,))).data), (col,)
+
+
+def _build_rebase(direction: str):
+    from ..columnar import dtype as dt
+    from ..ops import datetime_rebase as dr
+    fn = (dr.rebase_gregorian_to_julian if direction == "g2j"
+          else dr.rebase_julian_to_gregorian)
+    col = _tiny_fixed(dt.TIMESTAMP_MICROSECONDS, [0, 1, 2, 3])
+    return (lambda c: fn(c).data), (col,)
+
+
+def _build_decimal(op: str):
+    import jax.numpy as jnp
+    from ..columnar import dtype as dt
+    from ..columnar.column import Column
+    from ..ops import decimal128 as d128
+    limbs = jnp.ones((4, 4), dtype=jnp.uint32)
+    a = Column(dt.DType(dt.TypeId.DECIMAL128, 2), 4, data=limbs)
+    b = Column(dt.DType(dt.TypeId.DECIMAL128, 2), 4, data=limbs)
+    if op == "add":
+        fn = lambda x, y: [c.data for c in  # noqa: E731
+                           d128.add_decimal128(x, y, 2).columns]
+    else:
+        fn = lambda x, y: [c.data for c in  # noqa: E731
+                           d128.multiply_decimal128(x, y, 2).columns]
+    return fn, (a, b)
+
+
+def _build_hilbert():
+    from ..columnar import dtype as dt
+    from ..ops.zorder import hilbert_index
+    a = _tiny_fixed(dt.INT32, [0, 1, 2, 3])
+    b = _tiny_fixed(dt.INT32, [3, 2, 1, 0])
+    return (lambda x, y: hilbert_index(8, [x, y]).data), (a, b)
+
+
+DEFAULT_AUDITS: Sequence[AuditSpec] = (
+    AuditSpec("hash.murmur3", _build_murmur3),
+    AuditSpec("hash.xxhash64", _build_xxhash64),
+    AuditSpec("datetime.rebase[g2j]", lambda: _build_rebase("g2j")),
+    AuditSpec("datetime.rebase[j2g]", lambda: _build_rebase("j2g")),
+    AuditSpec("decimal.add", lambda: _build_decimal("add")),
+    AuditSpec("decimal.multiply", lambda: _build_decimal("mul")),
+    AuditSpec("zorder.hilbert", _build_hilbert),
+)
+
+
+def run_jaxpr_audit(specs: Optional[Sequence[AuditSpec]] = None
+                    ) -> List[Finding]:
+    """Audit every registered op; one finding per violated invariant."""
+    findings: List[Finding] = []
+    for spec in (DEFAULT_AUDITS if specs is None else specs):
+        try:
+            fn, args = spec.build()
+        except Exception as e:  # noqa: BLE001 — surface, don't crash lint
+            findings.append(Finding(
+                "SRJTX05", "jaxpr", 0,
+                f"op {spec.name!r}: audit fixture failed to build "
+                f"({type(e).__name__}: {e})", snippet=spec.name))
+            continue
+        findings.extend(audit_callable(
+            spec.name, fn, *args, expect_traceable=spec.expect_traceable,
+            allow_callbacks=spec.allow_callbacks))
+    return findings

@@ -1,0 +1,658 @@
+"""The SRJT rule catalog (AST engine).
+
+Each rule is a function ``rule(tree, rel_path, lines, ctx) -> [Finding]``;
+``FILE_RULES`` run per module, ``PROJECT_RULES`` run once over the whole
+parsed corpus (drift detection needs every spelling). The invariants these
+rules enforce are stated in docs/TPU_NUMERICS.md, faultinj/guard.py and
+utils/config.py — docs/STATIC_ANALYSIS.md maps each rule to its invariant.
+
+Rule IDs:
+  SRJT001  implicit host sync inside jit-compiled code
+  SRJT002  forbidden 64-bit float dtype / 64-bit bitcast on device code
+  SRJT003  raw dispatch on a guarded surface not routed via guarded_dispatch
+  SRJT004  config key not declared via _register / SRJT env-var drift
+  SRJT005  @jax.jit recompile & retrace hazards
+  SRJT006  columnar op drops the validity mask
+  SRJT007  use of a buffer after donation
+  SRJT008  tracing span / fault-metrics counter name drift
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter, defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, ProjectContext
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node) -> Optional[str]:
+    """'jax.numpy.asarray' for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_stack(tree):
+    """Yield (node, ancestors) depth-first; ancestors[0] is the module."""
+    stack = [(tree, [])]
+    while stack:
+        node, anc = stack.pop()
+        yield node, anc
+        child_anc = anc + [node]
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            stack.append((child, child_anc))
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+_JIT_NAMES = ("jax.jit", "jit", "jnp.jit")
+_PARTIAL_NAMES = ("partial", "functools.partial")
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Dict]:
+    """If ``call`` is a jax.jit(...) (possibly through functools.partial),
+    return its keyword payload: static argnums/names, donate argnums."""
+    fn = _dotted(call.func)
+    if fn in _PARTIAL_NAMES and call.args \
+            and _dotted(call.args[0]) in _JIT_NAMES:
+        kwargs = call.keywords
+    elif fn in _JIT_NAMES:
+        kwargs = call.keywords
+    else:
+        return None
+    info = {"static_argnums": [], "static_argnames": [],
+            "donate_argnums": [], "node": call}
+    for kw in kwargs:
+        vals = []
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for el in elts:
+            if isinstance(el, ast.Constant):
+                vals.append(el.value)
+        if kw.arg in info:
+            info[kw.arg] = vals
+    return info
+
+
+def _jit_decorator_info(fn: ast.FunctionDef) -> Optional[Dict]:
+    """jit payload if ``fn`` is jit-decorated (plain or via partial)."""
+    for dec in fn.decorator_list:
+        if _dotted(dec) in _JIT_NAMES:
+            return {"static_argnums": [], "static_argnames": [],
+                    "donate_argnums": [], "node": dec}
+        if isinstance(dec, ast.Call):
+            info = _jit_call_info(dec)
+            if info is not None:
+                return info
+    return None
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def _static_params(fn, info: Dict) -> set:
+    names = set(info["static_argnames"])
+    params = _param_names(fn)
+    for i in info["static_argnums"]:
+        if isinstance(i, int) and 0 <= i < len(params):
+            names.add(params[i])
+    return names
+
+
+# ---------------------------------------------------------------------------
+# SRJT001 — implicit host sync inside jit-compiled code
+# ---------------------------------------------------------------------------
+
+# np.frombuffer is deliberately absent: it requires a host buffer, so a
+# tracer argument errors loudly at trace time instead of silently syncing
+_HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get",
+}
+_HOST_SYNC_METHODS = {"tolist", "item"}
+_SHAPE_ATTRS = {"shape", "ndim", "size", "itemsize", "dtype"}
+
+
+def _is_shape_expr(node) -> bool:
+    """True for expressions that concretize *static* metadata, not data:
+    x.shape[0], len(x), x.ndim — these never force a device sync."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+            return True
+        if isinstance(n, ast.Call) and _dotted(n.func) == "len":
+            return True
+    return False
+
+
+def rule_srjt001(tree, rel, lines, ctx) -> List[Finding]:
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # innermost enclosing jitted function (or a def nested inside one)
+        jit_fn = None
+        static = set()
+        for a in anc:
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _jit_decorator_info(a)
+                if info is not None:
+                    jit_fn, static = a, _static_params(a, info)
+        if jit_fn is None:
+            continue
+        dn = _dotted(node.func)
+        what = None
+        if dn in _HOST_SYNC_CALLS:
+            # literal args (lookup tables built at trace time) never sync
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue
+            what = dn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _HOST_SYNC_METHODS):
+            what = f".{node.func.attr}()"
+        elif dn in ("float", "int", "bool") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _is_shape_expr(arg):
+                continue
+            if isinstance(arg, ast.Name) and arg.id in static:
+                continue  # static args are Python values at trace time
+            what = f"{dn}()"
+        if what is not None:
+            findings.append(Finding(
+                "SRJT001", rel, node.lineno,
+                f"implicit host sync `{what}` inside jit-compiled "
+                f"`{jit_fn.name}` — device round-trip on every call "
+                f"(docs/TPU_PERF.md: ~16 ms d2h floor on the tunnel)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT002 — forbidden 64-bit float dtype / 64-bit bitcast on device code
+# ---------------------------------------------------------------------------
+
+_F64_ATTRS = ("jnp.float64", "jax.numpy.float64")
+_BITCAST = ("lax.bitcast_convert_type", "jax.lax.bitcast_convert_type")
+_64BIT_DTYPE_STRS = {"float64", "f8", "int64", "i8", "uint64", "u8"}
+_64BIT_DTYPE_DOTS = {"jnp.float64", "jnp.int64", "jnp.uint64",
+                     "np.float64", "np.int64", "np.uint64",
+                     "jax.numpy.float64", "jax.numpy.int64",
+                     "jax.numpy.uint64"}
+# float_bits.py IS the sanctioned f64-bit-pattern layer (and the doc's own
+# exemption); its integer emulation is why everyone else must not do this
+_SRJT002_EXEMPT = ("ops/float_bits.py",)
+
+
+def rule_srjt002(tree, rel, lines, ctx) -> List[Finding]:
+    if any(rel.endswith(e) for e in _SRJT002_EXEMPT):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        dn = _dotted(node) if isinstance(node, ast.Attribute) else None
+        if dn in _F64_ATTRS:
+            findings.append(Finding(
+                "SRJT002", rel, node.lineno,
+                "f64 on a device path: TPU f64 storage is lossy "
+                "(~49-bit mantissa, f32 exponent range) — store uint64 "
+                "bit patterns instead (docs/TPU_NUMERICS.md §1)"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn in _BITCAST:
+            dtype_args = list(node.args[1:]) + [k.value for k in
+                                                node.keywords]
+            for a in dtype_args:
+                s = _const_str(a)
+                d = _dotted(a)
+                if (s in _64BIT_DTYPE_STRS) or (d in _64BIT_DTYPE_DOTS):
+                    findings.append(Finding(
+                        "SRJT002", rel, node.lineno,
+                        "bitcast_convert_type on a 64-bit element type "
+                        "does not compile in the X64 rewriter — take bit "
+                        "views on host via np.view "
+                        "(docs/TPU_NUMERICS.md §3)"))
+                    break
+            continue
+        # jnp.*(..., dtype="float64"/np.float64) — device array built as f64
+        if fn and (fn.startswith("jnp.") or fn.startswith("jax.numpy.")):
+            for kw in node.keywords:
+                if kw.arg != "dtype":
+                    continue
+                s = _const_str(kw.value)
+                d = _dotted(kw.value)
+                if s in ("float64", "f8") or d in ("np.float64",
+                                                   "numpy.float64"):
+                    findings.append(Finding(
+                        "SRJT002", rel, node.lineno,
+                        "device array created with dtype=float64 — lossy "
+                        "on TPU; carry uint64 bit patterns "
+                        "(docs/TPU_NUMERICS.md §1)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT003 — raw dispatch on a guarded surface
+# ---------------------------------------------------------------------------
+
+# the dispatch surfaces PR 1 routed through faultinj.guarded_dispatch; new
+# dispatch code in these modules must go through the supervisor too
+_SURFACE_BASENAMES = ("bridge.py", "transport.py", "exchange.py",
+                      "reader.py", "device_decode.py")
+_GUARD_FNS = ("guarded_dispatch", "_guarded")
+_DISPATCH_PRIMS = ("jax.device_put", "jax.block_until_ready")
+
+
+def _guarded_fn_names(tree) -> set:
+    """Names of functions passed as the dispatch thunk to guarded_dispatch
+    (positional arg 1) or a ``_guarded(api, fn)`` style local wrapper."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            if fn and fn.split(".")[-1] in _GUARD_FNS and len(node.args) >= 2:
+                if isinstance(node.args[1], ast.Name):
+                    names.add(node.args[1].id)
+    return names
+
+
+def rule_srjt003(tree, rel, lines, ctx) -> List[Finding]:
+    base = rel.rsplit("/", 1)[-1]
+    if base not in _SURFACE_BASENAMES:
+        return []
+    guarded = _guarded_fn_names(tree)
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        protected = False
+        jitted_locals = set()
+        for a in anc:
+            if (isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and a.name in guarded):
+                protected = True
+            if isinstance(a, ast.Call):
+                afn = _dotted(a.func)
+                if afn and afn.split(".")[-1] in _GUARD_FNS:
+                    protected = True  # inline lambda thunk
+            # locals bound to a jitted program in any enclosing function
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for st in ast.walk(a):
+                    if (isinstance(st, ast.Assign)
+                            and isinstance(st.value, ast.Call)
+                            and _jit_call_info(st.value) is not None):
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                jitted_locals.add(t.id)
+        if protected:
+            continue
+        fn = _dotted(node.func)
+        hit = None
+        if fn in _DISPATCH_PRIMS:
+            hit = fn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"):
+            hit = ".block_until_ready()"
+        elif isinstance(node.func, ast.Call) \
+                and _jit_call_info(node.func) is not None:
+            hit = "jax.jit(...)(...)"
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in jitted_locals:
+            hit = f"{node.func.id}(...) [jitted program]"
+        if hit is not None:
+            findings.append(Finding(
+                "SRJT003", rel, node.lineno,
+                f"raw dispatch `{hit}` on a guarded surface — route "
+                f"through faultinj.guarded_dispatch so fault domains "
+                f"classify and recover it (faultinj/guard.py)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT004 — undeclared config keys / env-var name drift
+# ---------------------------------------------------------------------------
+
+_CFG_CALLS = ("config.get", "config.set", "config.override",
+              "_config.get", "_config.set", "_config.override")
+_ENV_PREFIXES = ("SRJT_", "SPARK_RAPIDS_TPU", "FAULT_INJECTOR")
+
+
+def rule_srjt004(tree, rel, lines, ctx) -> List[Finding]:
+    if rel.endswith("utils/config.py"):
+        return []  # the registry itself
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn in _CFG_CALLS and node.args:
+            key = _const_str(node.args[0])
+            if key is not None and key not in ctx.config_keys:
+                findings.append(Finding(
+                    "SRJT004", rel, node.lineno,
+                    f"config key {key!r} is not declared via _register in "
+                    f"utils/config.py — undeclared keys raise KeyError at "
+                    f"runtime and are invisible to config.describe()"))
+            continue
+        if fn and fn.split(".")[-1] == "tier_is_device" and node.args:
+            key = _const_str(node.args[0])
+            if key is not None and key not in ctx.config_keys:
+                findings.append(Finding(
+                    "SRJT004", rel, node.lineno,
+                    f"tier flag {key!r} is not declared via _register in "
+                    f"utils/config.py"))
+            continue
+        # os.environ.get("SRJT_X") / os.getenv("SRJT_X") name drift
+        name = None
+        if fn in ("os.environ.get", "_os.environ.get", "os.getenv",
+                  "_os.getenv") and node.args:
+            name = _const_str(node.args[0])
+        if name and name.startswith(_ENV_PREFIXES) \
+                and name not in ctx.config_envs:
+            findings.append(Finding(
+                "SRJT004", rel, node.lineno,
+                f"env var {name!r} read directly but not registered in "
+                f"utils/config.py — drift from the declared flag surface "
+                f"(typo'd names fail silently)"))
+    # subscript form: os.environ["SRJT_X"]
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Subscript)
+                and _dotted(node.value) in ("os.environ", "_os.environ")):
+            name = _const_str(node.slice)
+            if name and name.startswith(_ENV_PREFIXES) \
+                    and name not in ctx.config_envs:
+                findings.append(Finding(
+                    "SRJT004", rel, node.lineno,
+                    f"env var {name!r} accessed directly but not "
+                    f"registered in utils/config.py — drift from the "
+                    f"declared flag surface"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT005 — jit recompile & retrace hazards
+# ---------------------------------------------------------------------------
+
+def rule_srjt005(tree, rel, lines, ctx) -> List[Finding]:
+    findings = []
+    # (a) jit built and invoked per call: jax.jit(f)(x) inline, or a local
+    #     bound to jax.jit(...) and invoked in the same function (storing
+    #     into a module-level cache dict or returning it is fine)
+    for node, anc in _walk_stack(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Call) \
+                and _jit_call_info(node.func) is not None:
+            in_fn = any(isinstance(a, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) for a in anc)
+            if in_fn:
+                findings.append(Finding(
+                    "SRJT005", rel, node.lineno,
+                    "jax.jit(...)() built and invoked in one expression "
+                    "inside a function — retraces and recompiles on every "
+                    "call; hoist to module scope or a keyed cache"))
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_jits = {}
+        for st in ast.walk(node):
+            if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                    and _jit_call_info(st.value) is not None:
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        local_jits[t.id] = st.lineno
+        if local_jits:
+            for st in ast.walk(node):
+                if isinstance(st, ast.Call) and isinstance(st.func, ast.Name)\
+                        and st.func.id in local_jits:
+                    findings.append(Finding(
+                        "SRJT005", rel, local_jits[st.func.id],
+                        f"`{st.func.id} = jax.jit(...)` rebuilt on every "
+                        f"call of `{node.name}` and invoked locally — "
+                        f"recompiles per call; hoist or cache by shape key"))
+                    local_jits.pop(st.func.id)
+                    break
+    # (b)+(c): decorator payload sanity on jit-decorated defs
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _jit_decorator_info(node)
+        if info is None:
+            continue
+        params = _param_names(node)
+        for i in info["static_argnums"]:
+            if isinstance(i, int) and not (-len(params) <= i < len(params)):
+                findings.append(Finding(
+                    "SRJT005", rel, node.lineno,
+                    f"static_argnums={i} is out of range for "
+                    f"`{node.name}` ({len(params)} parameters) — the "
+                    f"intended arg is traced, recompiling per value"))
+        for nm in info["static_argnames"]:
+            if isinstance(nm, str) and nm not in params:
+                findings.append(Finding(
+                    "SRJT005", rel, node.lineno,
+                    f"static_argnames={nm!r} names no parameter of "
+                    f"`{node.name}` — the intended arg is traced, "
+                    f"recompiling per value"))
+        for d in node.args.defaults + [d for d in node.args.kw_defaults
+                                       if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    "SRJT005", rel, d.lineno,
+                    f"mutable default on jit-compiled `{node.name}` — "
+                    f"unhashable as a static argument and shared across "
+                    f"traces"))
+        # (d) Python control flow on a traced (non-static) parameter
+        static = _static_params(node, info)
+        traced = [p for p in params if p not in static and p != "self"]
+        for st in ast.walk(node):
+            test = None
+            if isinstance(st, (ast.If, ast.While)):
+                test = st.test
+            if test is not None and isinstance(test, ast.Name) \
+                    and test.id in traced:
+                findings.append(Finding(
+                    "SRJT005", rel, st.lineno,
+                    f"Python `if {test.id}:` on traced argument of "
+                    f"jit-compiled `{node.name}` — value-dependent trace; "
+                    f"mark it static or use lax.cond/jnp.where"))
+            if isinstance(st, ast.Call) and _dotted(st.func) == "range" \
+                    and st.args and isinstance(st.args[0], ast.Name) \
+                    and st.args[0].id in traced:
+                findings.append(Finding(
+                    "SRJT005", rel, st.lineno,
+                    f"range({st.args[0].id}) over a traced argument of "
+                    f"jit-compiled `{node.name}` — shape/value-dependent "
+                    f"loop; mark it static or use lax.fori_loop"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT006 — columnar op drops the validity mask
+# ---------------------------------------------------------------------------
+
+_VALIDITY_TOKENS = ("validity", "valid_mask", "valid")
+
+
+def rule_srjt006(tree, rel, lines, ctx) -> List[Finding]:
+    if "/ops/" not in "/" + rel:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        params = set(_param_names(node))
+        if not params:
+            continue
+        # does the body read a parameter's .data buffer?
+        reads_data = any(
+            isinstance(n, ast.Attribute) and n.attr == "data"
+            and isinstance(n.value, ast.Name) and n.value.id in params
+            for n in ast.walk(node))
+        if not reads_data:
+            continue
+        mentions_validity = any(
+            isinstance(n, ast.Attribute) and n.attr in _VALIDITY_TOKENS
+            or isinstance(n, ast.Name) and n.id in _VALIDITY_TOKENS
+            or isinstance(n, ast.keyword) and n.arg in _VALIDITY_TOKENS
+            for n in ast.walk(node))
+        if mentions_validity:
+            continue
+        for ret in ast.walk(node):
+            if not isinstance(ret, ast.Return) or ret.value is None:
+                continue
+            for call in ast.walk(ret.value):
+                if isinstance(call, ast.Call) \
+                        and _dotted(call.func) in ("Column",
+                                                   "column.Column"):
+                    findings.append(Finding(
+                        "SRJT006", rel, call.lineno,
+                        f"`{node.name}` reads input .data but returns a "
+                        f"Column without propagating any validity mask — "
+                        f"null rows silently become valid garbage"))
+                    break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT007 — use of a buffer after donation
+# ---------------------------------------------------------------------------
+
+def _donated_jits(tree) -> Dict[str, List[int]]:
+    """name -> donated positions, for ``g = jax.jit(f, donate_argnums=..)``
+    assignments anywhere in the module."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            info = _jit_call_info(node.value)
+            if info and info["donate_argnums"]:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = [i for i in info["donate_argnums"]
+                                     if isinstance(i, int)]
+    return out
+
+
+def rule_srjt007(tree, rel, lines, ctx) -> List[Finding]:
+    donated = _donated_jits(tree)
+    if not donated:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # donation events: (buffer name, call line)
+        events: List[Tuple[str, int]] = []
+        for st in ast.walk(node):
+            if isinstance(st, ast.Call) and isinstance(st.func, ast.Name) \
+                    and st.func.id in donated:
+                for pos in donated[st.func.id]:
+                    if pos < len(st.args) and isinstance(st.args[pos],
+                                                         ast.Name):
+                        events.append((st.args[pos].id, st.lineno))
+        for buf, at in events:
+            # >= at: `buf = g(buf)` rebinds on the call's own line — the
+            # idiomatic safe way to consume a donated buffer
+            rebound = [n.lineno for n in ast.walk(node)
+                       if isinstance(n, ast.Name) and n.id == buf
+                       and isinstance(n.ctx, ast.Store) and n.lineno >= at]
+            bound_at = min(rebound) if rebound else None
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and n.id == buf \
+                        and isinstance(n.ctx, ast.Load) and n.lineno > at \
+                        and (bound_at is None or n.lineno < bound_at):
+                    findings.append(Finding(
+                        "SRJT007", rel, n.lineno,
+                        f"`{buf}` used after being donated at line {at} — "
+                        f"donated buffers are deallocated by XLA; reading "
+                        f"one returns garbage or crashes"))
+                    break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJT008 — tracing span / fault-metrics counter name drift
+# ---------------------------------------------------------------------------
+
+def rule_srjt008_counters(tree, rel, lines, ctx) -> List[Finding]:
+    if not ctx.metrics_fields or rel.endswith("faultinj/guard.py"):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "bump" and node.args:
+            field = _const_str(node.args[0])
+            if field is not None and field not in ctx.metrics_fields:
+                findings.append(Finding(
+                    "SRJT008", rel, node.lineno,
+                    f"metrics counter {field!r} is not a "
+                    f"FaultDomainMetrics field — the bump raises KeyError "
+                    f"under load (fields: faultinj/guard.py _FIELDS)"))
+    return findings
+
+
+def _span_literals(tree) -> List[Tuple[str, int]]:
+    """(name, line) for every literal / f-string-prefixed trace span."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if not fn or fn.split(".")[-1] not in ("trace_range", "func_range"):
+            continue
+        if not node.args:
+            continue
+        a = node.args[0]
+        s = _const_str(a)
+        if s is None and isinstance(a, ast.JoinedStr) and a.values \
+                and isinstance(a.values[0], ast.Constant):
+            s = str(a.values[0].value)  # constant prefix = span family
+        if s:
+            out.append((s, node.lineno))
+    return out
+
+
+def project_rule_srjt008_spans(modules, ctx) -> List[Finding]:
+    """Cross-file: span names that differ only by case or -/_ spelling are
+    drift — xprof groups by exact string, so "H2D" and "h2d" chart as two
+    unrelated spans."""
+    occurrences: Dict[str, List[Tuple[str, str, int]]] = defaultdict(list)
+    for rel, tree, _lines in modules:
+        for name, line in _span_literals(tree):
+            norm = name.lower().replace("-", "_")
+            occurrences[norm].append((name, rel, line))
+    findings = []
+    for norm, occ in sorted(occurrences.items()):
+        spellings = Counter(name for name, _, _ in occ)
+        if len(spellings) <= 1:
+            continue
+        canonical = max(sorted(spellings), key=lambda s: spellings[s])
+        for name, rel, line in occ:
+            if name != canonical:
+                findings.append(Finding(
+                    "SRJT008", rel, line,
+                    f"trace span {name!r} drifts from {canonical!r} "
+                    f"(same name, different spelling) — xprof charts "
+                    f"them as unrelated spans"))
+    return findings
+
+
+FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
+              rule_srjt005, rule_srjt006, rule_srjt007,
+              rule_srjt008_counters)
+PROJECT_RULES = (project_rule_srjt008_spans,)
+ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -31,7 +31,8 @@ in addition to the injector's patched op entry points):
 
   * ``bridge.py``      — every engine op, by its op name ("hash.murmur3")
   * ``transport.py``   — "h2d", "d2h", "spill", "unspill"
-  * ``exchange.py``    — "exchange_counts", "exchange_alltoall"
+  * ``exchange.py``    — "exchange_counts", "exchange_alltoall",
+                         "exchange_stage" (sharded staging device_puts)
   * ``reader.py``      — "parquet_page_decode", "parquet_device_decode"
 
 Real runtime exceptions classify through the same table as injected ones
@@ -71,11 +72,16 @@ TRANSIENT = "transient"
 POISON = "poison"
 FATAL = "fatal"
 
-# substrings of real runtime-error messages that mark a domain (XLA/PJRT
-# surface gRPC-style status names inside RuntimeError text)
-_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "DEADLINE",
-                      "ABORTED")
-_EXHAUSTED_MARKERS = ("RESOURCE_EXHAUSTED", "OUT_OF_MEMORY", "out of memory")
+# substrings of real runtime-error messages that mark a domain. XLA/PJRT
+# surface gRPC-style status names inside RuntimeError text in BOTH
+# spellings depending on the layer ("RESOURCE_EXHAUSTED: ..." from the
+# PJRT C API, "Resource exhausted: ..." / "Unavailable:" from the status
+# formatting path), so matching is case-insensitive: every variant of a
+# status must land in the same fault domain.
+_TRANSIENT_MARKERS = ("unavailable", "deadline_exceeded", "deadline",
+                      "aborted")
+_EXHAUSTED_MARKERS = ("resource_exhausted", "resource exhausted",
+                      "out_of_memory", "out of memory")
 
 
 class FaultStormError(RuntimeError):
@@ -115,7 +121,7 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, InjectedApiError):
         return TRANSIENT
     if isinstance(exc, (RuntimeError, OSError)):
-        msg = str(exc)
+        msg = str(exc).lower()
         if any(m in msg for m in _EXHAUSTED_MARKERS):
             return RESOURCE_EXHAUSTED
         if any(m in msg for m in _TRANSIENT_MARKERS):

@@ -446,12 +446,12 @@ def _format_java(digits, e10, sign, is_nan, is_inf, is_zero):
 
     Returns (byte matrix u8[n, W], lengths i64[n]).
     """
-    digits = np.asarray(digits)
-    e10 = np.asarray(e10).astype(np.int64)
-    sign = np.asarray(sign)
-    is_nan = np.asarray(is_nan)
-    is_inf = np.asarray(is_inf)
-    is_zero = np.asarray(is_zero)
+    # one batched d2h for all six Ryu outputs: device_get issues the async
+    # copies together and blocks once, where six sequential np.asarray
+    # syncs each pay the tunnel's ~16 ms d2h floor (docs/TPU_PERF.md)
+    digits, e10, sign, is_nan, is_inf, is_zero = jax.device_get(
+        (digits, e10, sign, is_nan, is_inf, is_zero))
+    e10 = e10.astype(np.int64)
     n = digits.shape[0]
 
     dmat, k = _digit_chars(digits)
@@ -578,13 +578,8 @@ def format_number(col: Column, d: int) -> Column:
     DecimalFormat semantics). Row assembly is per-row host code: grouping and
     fixed-scale rounding are display formatting, off the query hot path.
     Reference entry: format_float (format_float.cu:111)."""
-    digits, e10, sign, is_nan, is_inf, is_zero = _ryu_core_for(col)
-    digits = np.asarray(digits)
-    e10 = np.asarray(e10)
-    sign = np.asarray(sign)
-    is_nan = np.asarray(is_nan)
-    is_inf = np.asarray(is_inf)
-    is_zero = np.asarray(is_zero)
+    digits, e10, sign, is_nan, is_inf, is_zero = jax.device_get(
+        _ryu_core_for(col))  # batched d2h, not six sequential syncs
     parts = []
     for i in range(digits.shape[0]):
         if is_nan[i]:

@@ -430,8 +430,18 @@ def hash_partition_exchange(
 
     axis = _mesh_axis(mesh)
     sharding = NamedSharding(mesh, P(axis))
-    dest_d = jax.device_put(_pad(dest), sharding)
-    live_d = jax.device_put(live, sharding)
+
+    # staging transfers run under the supervisor too ("exchange_stage"):
+    # a device_put can hit RESOURCE_EXHAUSTED/UNAVAILABLE exactly like a
+    # program launch, and must classify into the same recovery domains
+    from ..faultinj.guard import guarded_dispatch
+
+    def _stage(a: jnp.ndarray) -> jnp.ndarray:
+        return guarded_dispatch("exchange_stage", jax.device_put, a,
+                                sharding)
+
+    dest_d = _stage(_pad(dest))
+    live_d = _stage(live)
 
     # phase 1: destination-count matrix -> slot capacities (host sizing
     # sync). Per-ROUND capacities (offset r = traffic s -> (s+r) % nd)
@@ -442,7 +452,6 @@ def hash_partition_exchange(
     # (faultinj/guard.py): fault configs target "exchange_counts" /
     # "exchange_alltoall", and a real collective failure (UNAVAILABLE,
     # RESOURCE_EXHAUSTED) classifies into the same recovery domains.
-    from ..faultinj.guard import guarded_dispatch
     counts_mat = _host_global(guarded_dispatch(
         "exchange_counts", _counts_program(mesh, per_dev, nd),
         dest_d, live_d)).reshape(nd, nd)
@@ -454,8 +463,7 @@ def hash_partition_exchange(
     for col in table.columns:
         bufs, meta = _col_to_buffers(col)
         spans.append((len(buffers), len(buffers) + len(bufs)))
-        buffers.extend(
-            jax.device_put(_pad(b), sharding) for b in bufs)
+        buffers.extend(_stage(_pad(b)) for b in bufs)
         metas.append(meta)
 
     shapes = tuple((b.shape[1:], str(b.dtype)) for b in buffers)

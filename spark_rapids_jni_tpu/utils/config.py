@@ -52,6 +52,12 @@ def _register(key: str, env: str, default: Any, parse, doc: str):
 # ---- the flag surface (one line per tunable; reference analog in doc) ------
 _register("trace.enabled", "SPARK_RAPIDS_TPU_TRACE", False, _parse_bool,
           "xprof trace annotations on ops (ref: ai.rapids.cudf.nvtx.enabled)")
+_register("compile.cache_dir", "SRJT_COMPILE_CACHE",
+          os.path.join(os.path.expanduser("~"), ".cache",
+                       "spark_rapids_jni_tpu", "xla"), str,
+          "persistent XLA compilation cache directory; '0' or '' disables "
+          "(read once at package import — see spark_rapids_jni_tpu/"
+          "__init__.py)")
 _register("rmm.watchdog_period_s", "SRJT_RMM_WATCHDOG_PERIOD_S", 0.1, float,
           "deadlock watchdog poll period "
           "(ref: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod, 100ms)")

@@ -1,0 +1,676 @@
+"""srjt-lint: fixture coverage for every SRJT rule + the jaxpr auditor.
+
+Each rule gets (a) a minimal source snippet that MUST trigger it — these
+tests fail if the rule is disabled or regresses — and (b) the same snippet
+with a ``# srjt: noqa[...]`` suppression that must silence it. The jaxpr
+auditor is exercised over a known-clean registered op and known-dirty
+synthetic kernels (f64 materialization, host callback, trace-time sync).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from spark_rapids_jni_tpu.analysis import (
+    Finding,
+    ProjectContext,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from spark_rapids_jni_tpu.analysis.rules import (
+    FILE_RULES,
+    project_rule_srjt008_spans,
+    rule_srjt001,
+)
+
+CTX = ProjectContext(
+    config_keys={"ok.key", "trace.enabled"},
+    config_envs={"SRJT_KNOWN"},
+    metrics_fields={"guarded_calls", "task_retries"},
+)
+
+
+def run(src: str, path: str = "pkg/mod.py", rules=None):
+    return analyze_source(textwrap.dedent(src), path, CTX, rules)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# SRJT001 — implicit host sync inside jit
+# ---------------------------------------------------------------------------
+
+SRC_001 = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        y = np.asarray(x)
+        return y
+"""
+
+
+def test_srjt001_triggers():
+    fs = run(SRC_001)
+    assert rules_of(fs) == {"SRJT001"}
+    assert "np.asarray" in fs[0].message
+
+
+def test_srjt001_noqa():
+    assert run(SRC_001.replace("np.asarray(x)",
+                               "np.asarray(x)  # srjt: noqa[SRJT001]")) == []
+
+
+def test_srjt001_requires_jit_context():
+    # the same sync outside a jitted function is the HOST tier working as
+    # designed — not a finding
+    assert run("""
+        import numpy as np
+
+        def host_path(x):
+            return np.asarray(x)
+    """) == []
+
+
+def test_srjt001_static_and_shape_args_ok():
+    assert run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            m = int(n) + int(x.shape[0])
+            return x[:m]
+    """) == []
+
+
+def test_srjt001_tolist_and_device_get():
+    fs = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.tolist(), jax.device_get(x)
+    """)
+    assert len(fs) == 2 and rules_of(fs) == {"SRJT001"}
+
+
+# ---------------------------------------------------------------------------
+# SRJT002 — f64 / 64-bit bitcast on device paths
+# ---------------------------------------------------------------------------
+
+def test_srjt002_f64_astype():
+    fs = run("""
+        import jax.numpy as jnp
+
+        def g(x):
+            return x.astype(jnp.float64)
+    """)
+    assert rules_of(fs) == {"SRJT002"}
+
+
+def test_srjt002_dtype_kwarg():
+    fs = run("""
+        import jax.numpy as jnp
+
+        def g(n):
+            return jnp.zeros((n,), dtype="float64")
+    """)
+    assert rules_of(fs) == {"SRJT002"}
+
+
+def test_srjt002_64bit_bitcast():
+    fs = run("""
+        from jax import lax
+        import jax.numpy as jnp
+
+        def g(x):
+            return lax.bitcast_convert_type(x, jnp.uint64)
+    """)
+    assert rules_of(fs) == {"SRJT002"}
+    assert "X64 rewriter" in fs[0].message
+
+
+def test_srjt002_exempt_module_and_noqa():
+    src = """
+        import jax.numpy as jnp
+
+        def g(x):
+            return x.astype(jnp.float64)
+    """
+    assert run(src, path="pkg/ops/float_bits.py") == []
+    assert run(src.replace(
+        "x.astype(jnp.float64)",
+        "x.astype(jnp.float64)  # srjt: noqa[SRJT002]")) == []
+
+
+def test_srjt002_host_numpy_f64_allowed():
+    # np.float64 on the host is fine; the invariant is device storage
+    assert run("""
+        import numpy as np
+
+        def g(x):
+            return np.asarray(x, dtype=np.float64)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT003 — raw dispatch on a guarded surface
+# ---------------------------------------------------------------------------
+
+SRC_003 = """
+    import jax
+
+    def send(x):
+        return jax.device_put(x)
+"""
+
+
+def test_srjt003_triggers_on_surface():
+    fs = run(SRC_003, path="pkg/memory/transport.py")
+    assert rules_of(fs) == {"SRJT003"}
+
+
+def test_srjt003_ignores_non_surface():
+    assert run(SRC_003, path="pkg/ops/misc.py") == []
+
+
+def test_srjt003_guarded_thunk_ok():
+    assert run("""
+        import jax
+        from ..faultinj.guard import guarded_dispatch
+
+        def send(x):
+            def _up():
+                return jax.device_put(x)
+            return guarded_dispatch("h2d", _up)
+    """, path="pkg/memory/transport.py") == []
+
+
+def test_srjt003_inline_lambda_ok():
+    assert run("""
+        import jax
+        from ..faultinj.guard import guarded_dispatch
+
+        def send(x):
+            return guarded_dispatch("h2d", lambda: jax.device_put(x))
+    """, path="pkg/memory/transport.py") == []
+
+
+def test_srjt003_noqa():
+    assert run(SRC_003.replace(
+        "jax.device_put(x)",
+        "jax.device_put(x)  # srjt: noqa[SRJT003]"),
+        path="pkg/memory/transport.py") == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT004 — undeclared config keys / env drift
+# ---------------------------------------------------------------------------
+
+def test_srjt004_undeclared_key():
+    fs = run("""
+        from ..utils import config
+
+        def f():
+            return config.get("nope.key")
+    """)
+    assert rules_of(fs) == {"SRJT004"}
+    assert "nope.key" in fs[0].message
+
+
+def test_srjt004_declared_key_ok():
+    assert run("""
+        from ..utils import config
+
+        def f():
+            with config.override("ok.key", 1):
+                return config.get("trace.enabled")
+    """) == []
+
+
+def test_srjt004_env_drift():
+    fs = run("""
+        import os
+
+        def f():
+            return os.environ.get("SRJT_TYPO_VAR")
+    """)
+    assert rules_of(fs) == {"SRJT004"}
+
+
+def test_srjt004_registered_env_ok():
+    assert run("""
+        import os
+
+        def f():
+            return os.environ.get("SRJT_KNOWN"), os.environ.get("HOME")
+    """) == []
+
+
+def test_srjt004_noqa():
+    assert run("""
+        from ..utils import config
+
+        def f():
+            return config.get("nope.key")  # srjt: noqa[SRJT004]
+    """) == []
+
+
+def test_srjt004_live_registry_covers_repo_keys():
+    # the real registry parse must see the declared surface (guards against
+    # the from_package AST scrape silently matching nothing)
+    ctx = ProjectContext.from_package()
+    assert "trace.enabled" in ctx.config_keys
+    assert "compile.cache_dir" in ctx.config_keys
+    assert "SRJT_COMPILE_CACHE" in ctx.config_envs
+    assert "guarded_calls" in ctx.metrics_fields
+
+
+# ---------------------------------------------------------------------------
+# SRJT005 — jit recompile hazards
+# ---------------------------------------------------------------------------
+
+def test_srjt005_jit_per_call():
+    fs = run("""
+        import jax
+
+        def f(x):
+            return jax.jit(lambda a: a + 1)(x)
+    """)
+    assert rules_of(fs) == {"SRJT005"}
+
+
+def test_srjt005_local_jit_invoked():
+    fs = run("""
+        import jax
+
+        def f(x):
+            g = jax.jit(helper)
+            return g(x)
+    """)
+    assert rules_of(fs) == {"SRJT005"}
+
+
+def test_srjt005_module_scope_jit_ok():
+    assert run("""
+        import jax
+
+        g = jax.jit(helper)
+
+        def f(x):
+            return g(x)
+    """) == []
+
+
+def test_srjt005_static_argnames_mismatch():
+    fs = run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("nn",))
+        def f(x, n):
+            return x * n
+    """)
+    assert rules_of(fs) == {"SRJT005"}
+    assert "'nn'" in fs[0].message
+
+
+def test_srjt005_static_argnums_out_of_range():
+    fs = run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(5,))
+        def f(x, n):
+            return x * n
+    """)
+    assert rules_of(fs) == {"SRJT005"}
+
+
+def test_srjt005_traced_python_control_flow():
+    fs = run("""
+        import jax
+
+        @jax.jit
+        def f(x, flag):
+            if flag:
+                return x + 1
+            return x
+    """)
+    assert rules_of(fs) == {"SRJT005"}
+
+
+def test_srjt005_noqa_and_cache_store_ok():
+    assert run("""
+        import jax
+
+        def f(x):
+            return jax.jit(lambda a: a + 1)(x)  # srjt: noqa[SRJT005]
+    """) == []
+    # storing into a module-level cache dict is the sanctioned pattern
+    assert run("""
+        import jax
+
+        _CACHE = {}
+
+        def build(sig):
+            _CACHE[sig] = jax.jit(helper)
+            return _CACHE[sig]
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT006 — validity-mask drop in ops/
+# ---------------------------------------------------------------------------
+
+SRC_006 = """
+    from ..columnar.column import Column
+
+    def double(col):
+        return Column(col.dtype, col.size, data=col.data * 2)
+"""
+
+
+def test_srjt006_triggers():
+    fs = run(SRC_006, path="pkg/ops/myop.py")
+    assert rules_of(fs) == {"SRJT006"}
+
+
+def test_srjt006_propagated_mask_ok():
+    assert run("""
+        from ..columnar.column import Column
+
+        def double(col):
+            return Column(col.dtype, col.size, data=col.data * 2,
+                          validity=col.validity)
+    """, path="pkg/ops/myop.py") == []
+
+
+def test_srjt006_only_in_ops():
+    assert run(SRC_006, path="pkg/parallel/myop.py") == []
+
+
+def test_srjt006_noqa():
+    assert run(SRC_006.replace(
+        "data=col.data * 2)",
+        "data=col.data * 2)  # srjt: noqa[SRJT006]"),
+        path="pkg/ops/myop.py") == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT007 — use after donation
+# ---------------------------------------------------------------------------
+
+SRC_007 = """
+    import jax
+
+    g = jax.jit(helper, donate_argnums=(0,))
+
+    def f(buf):
+        out = g(buf)
+        return buf + out
+"""
+
+
+def test_srjt007_triggers():
+    fs = run(SRC_007)
+    assert rules_of(fs) == {"SRJT007"}
+    assert "donated" in fs[0].message
+
+
+def test_srjt007_rebound_buffer_ok():
+    assert run("""
+        import jax
+
+        g = jax.jit(helper, donate_argnums=(0,))
+
+        def f(buf):
+            buf = g(buf)
+            return buf + 1
+    """) == []
+
+
+def test_srjt007_noqa():
+    assert run(SRC_007.replace(
+        "return buf + out",
+        "return buf + out  # srjt: noqa[SRJT007]")) == []
+
+
+# ---------------------------------------------------------------------------
+# SRJT008 — counter / span name drift
+# ---------------------------------------------------------------------------
+
+def test_srjt008_unknown_counter():
+    fs = run("""
+        from ..faultinj.guard import metrics
+
+        def f():
+            metrics.bump("guarded_callz")
+    """)
+    assert rules_of(fs) == {"SRJT008"}
+
+
+def test_srjt008_known_counter_ok():
+    assert run("""
+        from ..faultinj.guard import metrics
+
+        def f():
+            metrics.bump("guarded_calls")
+            metrics.bump("task_retries", 3)
+    """) == []
+
+
+def test_srjt008_span_drift_cross_file(tmp_path):
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        from ..utils.tracing import trace_range
+
+        def f():
+            with trace_range("h2d"):
+                pass
+
+        def f2():
+            with trace_range("h2d"):
+                pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from ..utils.tracing import trace_range
+
+        def g():
+            with trace_range("H2D"):
+                pass
+    """))
+    fs = analyze_paths([str(tmp_path)], CTX)
+    assert rules_of(fs) == {"SRJT008"}
+    assert all("'H2D'" in f.message for f in fs)
+    assert all(f.path.endswith("b.py") for f in fs)
+
+
+def test_srjt008_counter_noqa():
+    assert run("""
+        from ..faultinj.guard import metrics
+
+        def f():
+            metrics.bump("guarded_callz")  # srjt: noqa[SRJT008]
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / engine mechanics
+# ---------------------------------------------------------------------------
+
+def test_bare_noqa_suppresses_every_rule():
+    assert run(SRC_001.replace("np.asarray(x)",
+                               "np.asarray(x)  # srjt: noqa")) == []
+
+
+def test_noqa_for_other_rule_does_not_suppress():
+    fs = run(SRC_001.replace("np.asarray(x)",
+                             "np.asarray(x)  # srjt: noqa[SRJT002]"))
+    assert rules_of(fs) == {"SRJT001"}
+
+
+def test_rule_disabled_means_no_finding():
+    # the per-rule fixtures above fail when their rule is removed from the
+    # catalog; conversely an explicit reduced catalog must not flag
+    other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
+    assert run(SRC_001, rules=other_rules) == []
+    assert len(FILE_RULES) == 8
+
+
+def test_syntax_error_is_reported_not_raised():
+    fs = run("def broken(:\n")
+    assert rules_of(fs) == {"SRJT000"}
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    fs = run(SRC_001)
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+    baseline = load_baseline(str(bl_path))
+    new, old, stale = match_baseline(run(SRC_001), baseline)
+    assert new == [] and len(old) == 1 and stale == []
+    assert old[0].baselined
+
+
+def test_baseline_survives_line_moves(tmp_path):
+    fs = run(SRC_001)
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+    shifted = "import os\n\n" + textwrap.dedent(SRC_001)
+    new, old, _ = match_baseline(
+        analyze_source(shifted, "pkg/mod.py", CTX),
+        load_baseline(str(bl_path)))
+    assert new == [] and len(old) == 1
+
+
+def test_new_finding_not_masked_by_baseline(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), run(SRC_001))
+    two = textwrap.dedent(SRC_001) + textwrap.dedent("""
+        @jax.jit
+        def g(x):
+            return x.tolist()
+    """)
+    new, old, _ = match_baseline(
+        analyze_source(two, "pkg/mod.py", CTX),
+        load_baseline(str(bl_path)))
+    assert len(old) == 1 and len(new) == 1
+    assert ".tolist()" in new[0].message
+
+
+def test_repo_baseline_entries_all_documented():
+    baseline = load_baseline("ci/lint_baseline.json")
+    assert baseline, "repo baseline should exist"
+    for entry in baseline.values():
+        assert entry.get("reason", "").startswith("accepted:"), entry
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_clean_and_violating(tmp_path, capsys):
+    from spark_rapids_jni_tpu.analysis.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    rc = main([str(clean), "--no-jaxpr", "--no-baseline",
+               "--format", "json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["counts"]["new"] == 0
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(SRC_001))
+    rc = main([str(bad), "--no-jaxpr", "--no-baseline",
+               "--format", "json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"]["new"] == 1
+    assert out["new"][0]["rule"] == "SRJT001"
+
+
+def test_cli_repo_is_clean_ast():
+    # the acceptance gate: the analyzer runs clean over the repo (modulo
+    # the documented baseline)
+    from spark_rapids_jni_tpu.analysis.__main__ import main
+    assert main(["--no-jaxpr", "--format", "json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# jaxpr auditor
+# ---------------------------------------------------------------------------
+
+def test_jaxpr_known_clean_registered_op():
+    from spark_rapids_jni_tpu.analysis.jaxpr_audit import (
+        DEFAULT_AUDITS, audit_callable)
+    spec = next(s for s in DEFAULT_AUDITS if s.name == "hash.murmur3")
+    fn, args = spec.build()
+    assert audit_callable(spec.name, fn, *args) == []
+
+
+def test_jaxpr_known_dirty_f64():
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.analysis.jaxpr_audit import audit_callable
+
+    def dirty(x):
+        return x.astype(jnp.float64) * 2.0
+
+    fs = audit_callable("dirty.f64", dirty,
+                        jnp.arange(4, dtype=jnp.int32))
+    assert rules_of(fs) == {"SRJTX01"}
+
+
+def test_jaxpr_known_dirty_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_jni_tpu.analysis.jaxpr_audit import audit_callable
+
+    def dirty(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    fs = audit_callable("dirty.cb", dirty, jnp.arange(4, dtype=jnp.int32))
+    assert rules_of(fs) == {"SRJTX02"}
+
+
+def test_jaxpr_untraceable_is_srjtx05():
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_jni_tpu.analysis.jaxpr_audit import audit_callable
+
+    def dirty(x):
+        return jnp.asarray(np.asarray(x) + 1)
+
+    fs = audit_callable("dirty.sync", dirty, jnp.arange(4))
+    assert rules_of(fs) == {"SRJTX05"}
+    # and the same op declared host-tier is not a finding
+    assert audit_callable("host.op", dirty, jnp.arange(4),
+                          expect_traceable=False) == []
+
+
+@pytest.mark.slow
+def test_jaxpr_full_registry_clean():
+    from spark_rapids_jni_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+    assert run_jaxpr_audit() == []
+
+
+def test_finding_fingerprint_stability():
+    a = Finding("SRJT001", "p.py", 10, "msg", snippet="x = 1")
+    b = Finding("SRJT001", "p.py", 99, "msg", snippet="x = 1")
+    assert a.fingerprint == b.fingerprint
+    c = Finding("SRJT002", "p.py", 10, "msg", snippet="x = 1")
+    assert a.fingerprint != c.fingerprint
