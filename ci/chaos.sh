@@ -15,5 +15,16 @@ cd "$(dirname "$0")/.."
 # the jaxpr auditor warms a backend, which this lane does itself anyway.
 SRJT_LINT_NO_JAXPR=1 bash ci/lint.sh
 
+# stage 1 — bit-flip corruption storms (injectionType 3): 100% flip rates
+# at the spill/unspill/disk-promote/parquet-page/exchange-shard surfaces.
+# Pass criteria baked into the tests: every flip detected
+# (corruption_detected == flips injected), zero corrupted bytes reach a
+# returned Table, recovered results bit-identical to the clean run.
+# `make corrupt` runs just this stage.
+env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 2 — exception-fault storms over the whole chaos-marked suite
+# (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

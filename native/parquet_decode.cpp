@@ -33,13 +33,40 @@
 #include <vector>
 
 #include <zlib.h>
-#include <zstd.h>
+#include <dlfcn.h>
 
 #include "thrift_compact.hpp"
 
 namespace {
 
 using namespace tcompact;
+
+// ---- zstd via dlopen -------------------------------------------------------
+// The two symbols this decoder needs are resolved from the runtime library
+// so the build requires neither zstd.h nor the -dev link symlink; a page
+// using CODEC_ZSTD on a machine without libzstd fails with a clear error
+// instead of the whole library failing to build.
+typedef size_t (*zstd_decompress_fn)(void*, size_t, const void*, size_t);
+typedef unsigned (*zstd_iserror_fn)(size_t);
+
+struct zstd_api {
+  zstd_decompress_fn decompress = nullptr;
+  zstd_iserror_fn is_error = nullptr;
+  zstd_api() {
+    void* h = dlopen("libzstd.so.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libzstd.so", RTLD_NOW | RTLD_GLOBAL);
+    if (h) {
+      decompress = reinterpret_cast<zstd_decompress_fn>(
+          dlsym(h, "ZSTD_decompress"));
+      is_error = reinterpret_cast<zstd_iserror_fn>(dlsym(h, "ZSTD_isError"));
+    }
+  }
+};
+
+zstd_api& zstd() {
+  static zstd_api api;
+  return api;
+}
 
 // ---- parquet.thrift field ids ----------------------------------------------
 // FileMetaData
@@ -57,7 +84,7 @@ constexpr int16_t CMD_TYPE = 1, CMD_CODEC = 4, CMD_NUM_VALUES = 5,
                   CMD_DICT_PAGE = 11;
 // PageHeader
 constexpr int16_t PH_TYPE = 1, PH_UNCOMP_SIZE = 2, PH_COMP_SIZE = 3,
-                  PH_DATA_V1 = 5, PH_DICT = 7, PH_DATA_V2 = 8;
+                  PH_CRC = 4, PH_DATA_V1 = 5, PH_DICT = 7, PH_DATA_V2 = 8;
 // DataPageHeader (v1)
 constexpr int16_t DPH_NUM_VALUES = 1, DPH_ENCODING = 2;
 // DictionaryPageHeader
@@ -390,7 +417,29 @@ struct leaf_info {
 struct decode_handle {
   tvalue meta;
   std::vector<leaf_info> leaves;
+  // verify PageHeader.crc on every page that carries one (parquet.thrift
+  // field 4); toggled via pqd_set_verify_crc (config parquet.verify_crc)
+  bool verify_crc = true;
 };
+
+// PageHeader.crc is the CRC-32 of the page payload exactly as stored —
+// the compressed bytes after the header, v2's uncompressed level sections
+// included — so a silent flip anywhere between writer and decode surfaces
+// here instead of as garbled values (or worse, plausible wrong ones).
+static void verify_page_crc(const tvalue& ph, const uint8_t* payload,
+                            size_t comp) {
+  auto* f = get(ph, PH_CRC);
+  if (!f) return;  // writers may omit the field; nothing to check
+  uint32_t want = (uint32_t)f->i;
+  uint32_t got = (uint32_t)crc32(crc32(0L, Z_NULL, 0), payload, (uInt)comp);
+  if (got != want) {
+    char msg[96];
+    snprintf(msg, sizeof msg,
+             "page crc mismatch (corruption): stored=0x%08x computed=0x%08x",
+             want, got);
+    throw std::runtime_error(msg);
+  }
+}
 
 static std::string json_escape(const std::string& s) {
   std::string out;
@@ -528,6 +577,9 @@ struct chunk_decoder {
   // nested-reconstruction mode (any max_rep, STRUCT paths)
   bool want_levels = false;
 
+  // check PageHeader.crc per page (decode_handle.verify_crc)
+  bool verify_crc = true;
+
   chunk_decoder(const leaf_info& l, int codec_, int64_t nv)
       : leaf(l), codec(codec_), num_values(nv) {
     emit_decimal128 = leaf.physical == PT_FLBA;
@@ -594,9 +646,11 @@ struct chunk_decoder {
       if (rc != Z_STREAM_END || got != uncomp)
         throw std::runtime_error("gzip: bad stream");
     } else if (codec == CODEC_ZSTD) {
+      if (!zstd().decompress || !zstd().is_error)
+        throw std::runtime_error("zstd: runtime library unavailable");
       buf.resize(uncomp);
-      size_t got = ZSTD_decompress(buf.data(), uncomp, src, comp);
-      if (ZSTD_isError(got) || got != uncomp)
+      size_t got = zstd().decompress(buf.data(), uncomp, src, comp);
+      if (zstd().is_error(got) || got != uncomp)
         throw std::runtime_error("zstd: bad stream");
     } else if (codec == CODEC_LZ4_RAW) {
       buf.reserve(uncomp);
@@ -999,6 +1053,7 @@ struct chunk_decoder {
         throw std::runtime_error("page: truncated payload");
       const uint8_t* payload = buf + pos;
       pos += (size_t)comp;
+      if (verify_crc) verify_page_crc(ph, payload, (size_t)comp);
 
       if (ptype == PAGE_DICT) {
         auto* dh = get(ph, PH_DICT);
@@ -1179,6 +1234,12 @@ int pqd_num_leaves(void* hp) {
   return (int)((decode_handle*)hp)->leaves.size();
 }
 
+// Toggle PageHeader.crc verification for every subsequent decode/extract
+// on this handle (config parquet.verify_crc; default on).
+void pqd_set_verify_crc(void* hp, int on) {
+  ((decode_handle*)hp)->verify_crc = on != 0;
+}
+
 int pqd_leaf_info(void* hp, int leaf, pqd_leaf_t* out) {
   auto* h = (decode_handle*)hp;
   if (leaf < 0 || leaf >= (int)h->leaves.size()) return -1;
@@ -1235,6 +1296,7 @@ int pqd_decode_chunk2(void* hp, int rg, int leaf, const uint8_t* bytes,
     if (len < chunk_len) throw std::runtime_error("short chunk buffer");
     chunk_decoder dec(h->leaves[leaf], codec, nv);
     dec.want_levels = want_levels != 0;
+    dec.verify_crc = h->verify_crc;
     dec.decode_chunk(bytes, (size_t)chunk_len);
 
     out->rows = dec.out.rows;
@@ -1379,6 +1441,7 @@ int pqd_extract_pages(void* hp, int rg, int leaf_i, const uint8_t* bytes,
         throw std::runtime_error("page: truncated payload");
       const uint8_t* payload = buf + pos;
       pos += (size_t)comp;
+      if (h->verify_crc) verify_page_crc(ph, payload, (size_t)comp);
 
       if (ptype == PAGE_DICT) {
         auto* dh = get(ph, PH_DICT);

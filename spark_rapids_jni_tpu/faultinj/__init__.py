@@ -9,7 +9,10 @@ TPU equivalent: XLA/PJRT has no CUPTI, but the framework's device-entry
 points are known functions — the injector wraps them at install time and
 consults the same JSON schema (``FAULT_INJECTOR_CONFIG_PATH``) before each
 call. injectionType 0/1 raise device-style errors; type 2 raises
-``InjectedApiError(substituteReturnCode)``.
+``InjectedApiError(substituteReturnCode)``; type 3 flips one bit of a
+transiting payload (via the ``memory/integrity.py`` hooks at the
+spill/unspill/disk/parquet/exchange surfaces) so the checksum detectors
+are provable end-to-end — see ``CorruptionError`` there.
 """
 
 from .injector import (

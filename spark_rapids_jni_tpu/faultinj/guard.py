@@ -23,6 +23,13 @@ port, applied uniformly at every dispatch surface:
   DeviceAssertError)            re-dispatch (``faultinj.max_poison_
                                 redispatch``), then the error propagates
                                 to the TaskExecutor degradation ladder
+  CORRUPTION                    checksum/fingerprint verification failed
+  (CorruptionError /            (memory/integrity.py): the bytes in hand
+  DATA_LOSS statuses)           are wrong, so retry-in-place can only
+                                re-return them — count the detection and
+                                propagate for discard-and-reconstruct
+                                from source (re-read / re-exchange /
+                                re-materialize upstream)
   FATAL (everything else)       propagate unchanged
   ============================  =======================================
 
@@ -30,10 +37,16 @@ Dispatch surfaces guarded (the api names a JSON fault config can target,
 in addition to the injector's patched op entry points):
 
   * ``bridge.py``      — every engine op, by its op name ("hash.murmur3")
-  * ``transport.py``   — "h2d", "d2h", "spill", "unspill"
+  * ``transport.py``   — "h2d", "d2h", "spill", "unspill", "spill_disk",
+                         "unspill_disk" (checksummed disk spill tier)
   * ``exchange.py``    — "exchange_counts", "exchange_alltoall",
-                         "exchange_stage" (sharded staging device_puts)
+                         "exchange_stage" (sharded staging device_puts),
+                         "exchange_verify" (shard checksum comparison)
   * ``reader.py``      — "parquet_page_decode", "parquet_device_decode"
+
+Payload bit-flip surfaces (``injectionType: 3`` rules consumed by the
+memory/integrity.py hooks, not by exception checkpoints): "spill",
+"unspill", "disk_promote", "parquet_page", "exchange_shard".
 
 Real runtime exceptions classify through the same table as injected ones
 (an XLA ``RESOURCE_EXHAUSTED`` status string routes into the retry
@@ -70,6 +83,7 @@ from .injector import (
 RESOURCE_EXHAUSTED = "resource_exhausted"
 TRANSIENT = "transient"
 POISON = "poison"
+CORRUPTION = "corruption"
 FATAL = "fatal"
 
 # substrings of real runtime-error messages that mark a domain. XLA/PJRT
@@ -82,6 +96,11 @@ _TRANSIENT_MARKERS = ("unavailable", "deadline_exceeded", "deadline",
                       "aborted")
 _EXHAUSTED_MARKERS = ("resource_exhausted", "resource exhausted",
                       "out_of_memory", "out of memory")
+# real-runtime corruption spellings: gRPC DATA_LOSS statuses, plus the
+# native parquet decoder's page-crc verdict ("page crc mismatch
+# (corruption)") — both mean the payload bytes are wrong, not the call
+_CORRUPTION_MARKERS = ("data_loss", "data loss", "crc mismatch",
+                       "(corruption)")
 
 
 class FaultStormError(RuntimeError):
@@ -111,6 +130,9 @@ class ProgramPoisonedError(RuntimeError):
 
 def classify(exc: BaseException) -> str:
     """Map an exception (injected or real) to its fault domain."""
+    from ..memory.integrity import CorruptionError
+    if isinstance(exc, CorruptionError):
+        return CORRUPTION
     if isinstance(exc, (TpuOOM, OffHeapOOM, MemoryError)):
         return RESOURCE_EXHAUSTED
     if isinstance(exc, (DeviceTrapError, DeviceAssertError)):
@@ -124,6 +146,8 @@ def classify(exc: BaseException) -> str:
         msg = str(exc).lower()
         if any(m in msg for m in _EXHAUSTED_MARKERS):
             return RESOURCE_EXHAUSTED
+        if any(m in msg for m in _CORRUPTION_MARKERS):
+            return CORRUPTION
         if any(m in msg for m in _TRANSIENT_MARKERS):
             return TRANSIENT
     return FATAL
@@ -140,7 +164,8 @@ class FaultDomainMetrics:
 
     _FIELDS = ("guarded_calls", "injected_faults", "transient_retries",
                "backoff_time_ns", "poisoned_programs", "redispatches",
-               "resource_exhausted", "degradations", "task_retries")
+               "resource_exhausted", "degradations", "task_retries",
+               "corruption_detected", "quarantined_buffers")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -269,4 +294,13 @@ def guarded_dispatch(api_name: str, fn: Callable[..., Any], *args,
                 with trace_range(f"fault:redispatch:{api_name}"):
                     pass
                 continue
+            if domain == CORRUPTION:
+                # never retry-in-place: the corrupted copy would simply be
+                # re-verified (and re-fail) — count the detection and hand
+                # the error up for discard-and-reconstruct (TaskExecutor
+                # re-materializes from source; readers re-read the file)
+                metrics.bump("corruption_detected")
+                with trace_range(f"fault:corruption:{api_name}"):
+                    pass
+                raise
             raise  # FATAL

@@ -17,7 +17,10 @@ JSON config schema mirrors the reference exactly (faultinj/README.md:61-170):
 
 ``cudaRuntimeFaults``/``cudaDriverFaults`` sections are accepted as aliases
 so reference configs can be reused verbatim. injectionType: 0 = device trap,
-1 = device assert, 2 = substitute return code. ``interceptionCount`` bounds
+1 = device assert, 2 = substitute return code, 3 = payload bit-flip (XOR a
+random bit of a transiting buffer — fired via the payload-aware hooks in
+memory/integrity.py at the spill/disk/exchange/parquet surfaces, never via
+``check``, since an API-entry checkpoint has no buffer). ``interceptionCount`` bounds
 how many consecutive matched calls are sampled; ``percent`` is the
 per-sample probability. ``dynamic: true`` re-reads the config when its
 mtime changes (the reference uses an inotify thread; polling on call entry
@@ -61,6 +64,9 @@ class _Rule:
         self.substitute = int(cfg.get("substituteReturnCode", 0))
 
     def maybe_fire(self, api: str, rng: random.Random):
+        if self.injection_type == 3:
+            return  # payload bit-flips fire via bitflip_rng, which owns
+            # the budget — an exception checkpoint has no buffer to flip
         if self.count_remaining <= 0:
             return
         self.count_remaining -= 1
@@ -131,6 +137,23 @@ class FaultInjector:
             if rule is None:
                 return
             rule.maybe_fire(api, self._rng)
+
+    def bitflip_rng(self, api: str) -> Optional[random.Random]:
+        """injectionType 3 sampling for one payload-bearing call: when a
+        bit-flip rule targets ``api`` (or ``*``) and its budget + percent
+        roll fire, return the injector's RNG for the caller to pick the
+        buffer/bit (memory/integrity.py hooks). None = no flip."""
+        self._maybe_reload()
+        with self._lock:
+            rule = self._rules.get(api) or self._rules.get("*")
+            if rule is None or rule.injection_type != 3:
+                return None
+            if rule.count_remaining <= 0:
+                return None
+            rule.count_remaining -= 1
+            if self._rng.uniform(0, 100) >= rule.percent:
+                return None
+            return self._rng
 
     def wrap(self, fn, api: str):
         def wrapper(*a, **kw):

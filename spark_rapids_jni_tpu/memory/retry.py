@@ -8,6 +8,7 @@ and internal callers can exercise the full roll-back / split protocol.
 
 from __future__ import annotations
 
+import sys
 from typing import Callable, List, TypeVar
 
 from .exceptions import (
@@ -53,7 +54,13 @@ def with_retry(
             raise
         pieces = split(pending[0])
         if not pieces or len(pieces) < 2:
-            raise
+            # a split that can't divide is terminal: surface it as such
+            # (chained to the OOM that demanded it) rather than silently
+            # re-raising the original as if no split had been attempted
+            n = len(pieces) if pieces else 0
+            raise TpuSplitAndRetryOOM(
+                f"split produced {n} piece(s); cannot subdivide further"
+            ) from sys.exc_info()[1]
         pending[0:1] = list(pieces)
 
     RmmSpark.start_retry_block()

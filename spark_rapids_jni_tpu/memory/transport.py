@@ -17,10 +17,21 @@ Capability parity with two reference-side layers:
 TPU notes: device→host is exact for every dtype because FLOAT64 columns
 store uint64 bit patterns (docs/TPU_NUMERICS.md); promotion re-uploads with
 one ``jnp.asarray`` per buffer.
+
+Integrity (docs/ARCHITECTURE.md "Integrity & corruption containment"):
+spilled tables are crc32-fingerprinted at demotion and re-verified at
+promote (``spill.verify_fingerprints``); a mismatch quarantines the buffer
+and raises ``CorruptionError`` so the task-executor ladder re-materializes
+from upstream instead of returning poisoned rows. Past
+``spill.host_limit_bytes`` the store demotes least-recently-used host
+tables to a checksummed disk tier (``spill.disk_dir``): files are written
+atomically (tmp + fsync + rename), verified buffer-by-buffer on promote,
+and torn/orphaned files are cleaned at store construction.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -28,6 +39,15 @@ import numpy as np
 
 from ..columnar.column import Column, Table
 from ..utils.tracing import trace_range
+from .integrity import (
+    CorruptionError,
+    clean_spill_dir,
+    maybe_flip_table,
+    read_table_file,
+    table_fingerprint,
+    verify_table,
+    write_table_file,
+)
 
 
 def _guarded(api: str, fn):
@@ -89,57 +109,198 @@ def to_host(obj):
     return _guarded("d2h", _download)
 
 
-class SpillableTable:
-    """A Table that can be demoted to host memory and promoted back.
+def _host_table_nbytes(table: Optional[Table]) -> int:
+    """Total bytes of a host-resident table's buffers."""
+    if table is None:
+        return 0
 
-    States: DEVICE (get() is free) ⇄ HOST (get() re-uploads). Thread-safe;
-    spill() is idempotent.
+    def col_bytes(c: Column) -> int:
+        n = 0
+        for b in (c.data, c.validity, c.offsets):
+            if b is not None:
+                n += np.asarray(b).nbytes
+        return n + sum(col_bytes(ch) for ch in c.children)
+    return sum(col_bytes(c) for c in table.columns)
+
+
+def _verify_enabled() -> bool:
+    from ..utils import config
+    return bool(config.get("spill.verify_fingerprints"))
+
+
+class SpillableTable:
+    """A Table that can be demoted to host memory (and on to disk) and
+    promoted back.
+
+    States: DEVICE (get() is free) ⇄ HOST (get() re-uploads) ⇄ DISK
+    (get() reads + verifies the checksummed spill file first), plus the
+    terminal QUARANTINED state a failed integrity check leaves behind —
+    its bytes are gone on purpose; the owner must rebuild from source.
+    Thread-safe; spill() is idempotent.
+
+    Integrity: at spill time the host table is crc32-fingerprinted
+    (memory/integrity.py); ``get()`` re-verifies before re-upload. A
+    mismatch — real bit rot or an ``injectionType: 3`` chaos flip on the
+    "spill"/"unspill" surfaces — quarantines this table, bumps the
+    ``corruption_detected``/``quarantined_buffers`` counters, and raises
+    :class:`CorruptionError`.
     """
+
+    DEVICE, HOST, DISK, QUARANTINED = "device", "host", "disk", "quarantined"
 
     def __init__(self, table: Table):
         self._lock = threading.Lock()
-        self._table = table
-        self._on_device = True
+        self._table: Optional[Table] = table
+        self._state = self.DEVICE
+        self._fingerprint = None
+        self._disk_path: Optional[str] = None
         self._on_promote = None  # set by SpillStore.register (LRU touch)
+        self._on_spill = None    # set by SpillStore.register (host limit)
 
     @property
     def device_nbytes(self) -> int:
         """Bytes currently occupying HBM (0 when spilled)."""
         with self._lock:
-            return self._table.device_nbytes() if self._on_device else 0
+            return (self._table.device_nbytes()
+                    if self._state == self.DEVICE else 0)
+
+    @property
+    def host_nbytes(self) -> int:
+        """Bytes currently occupying host RAM (0 unless host-resident)."""
+        with self._lock:
+            return (_host_table_nbytes(self._table)
+                    if self._state == self.HOST else 0)
 
     @property
     def is_spilled(self) -> bool:
         with self._lock:
-            return not self._on_device
+            return self._state != self.DEVICE
+
+    @property
+    def is_on_disk(self) -> bool:
+        with self._lock:
+            return self._state == self.DISK
+
+    @property
+    def is_quarantined(self) -> bool:
+        with self._lock:
+            return self._state == self.QUARANTINED
+
+    def _quarantine(self) -> None:
+        """Discard this table's bytes after a failed integrity check (the
+        corrupted copy must never be promotable) and count it. Caller
+        holds no locks; the CorruptionError that got us here propagates."""
+        from ..faultinj.guard import metrics
+        with self._lock:
+            if self._state == self.QUARANTINED:
+                return  # idempotent: count each table's quarantine once
+            self._table = None
+            self._fingerprint = None
+            path, self._disk_path = self._disk_path, None
+            self._state = self.QUARANTINED
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        metrics.bump("quarantined_buffers")
 
     def spill(self) -> int:
-        """Demote to host; returns HBM bytes released (0 if already host)."""
+        """Demote to host; returns HBM bytes released (0 if not device-
+        resident). Fingerprints the host bytes for promote-time verify."""
         with self._lock:
-            if not self._on_device:
+            if self._state != self.DEVICE:
                 return 0
             freed = self._table.device_nbytes()
             with trace_range("spill"):
                 self._table = _guarded("spill", lambda: to_host(self._table))
-            self._on_device = False
+                self._fingerprint = (table_fingerprint(self._table)
+                                     if _verify_enabled() else None)
+                # chaos surface "spill": a flip landing after the
+                # fingerprint models bit rot while the table sits in host
+                # RAM — caught by the verify in get()
+                self._table, _ = maybe_flip_table("spill", self._table)
+            self._state = self.HOST
+        if self._on_spill is not None:
+            self._on_spill(self)  # outside the lock: store takes its own
+        return freed
+
+    def spill_to_disk(self, path: str) -> int:
+        """Demote a host-resident table to a checksummed disk file
+        (atomic tmp + fsync + rename); returns host bytes released.
+        Device-resident tables spill to host first."""
+        self.spill()
+        with self._lock:
+            if self._state != self.HOST:
+                return 0
+            freed = _host_table_nbytes(self._table)
+            table = self._table
+            with trace_range("spill_disk"):
+                _guarded("spill_disk", lambda: write_table_file(path, table))
+            self._disk_path = path
+            self._table = None
+            self._state = self.DISK
             return freed
 
-    def get(self) -> Table:
-        """The device-resident table, promoting (re-uploading) if spilled."""
-        with self._lock:
-            if not self._on_device:
-                with trace_range("unspill"):
-                    self._table = _guarded(
-                        "unspill", lambda: to_device(self._table))
-                self._on_device = True
+    def _promote_locked(self) -> None:
+        """DISK/HOST → DEVICE under self._lock. Raises CorruptionError
+        (after the guard counted the detection) on any integrity failure;
+        the caller quarantines."""
+        if self._state == self.DISK:
+            path = self._disk_path
+            with trace_range("unspill_disk"):
+                # the disk surface's chaos flip ("disk_promote") lands on
+                # the raw payload inside read_table_file, before the
+                # per-buffer crc verify
+                self._table = _guarded(
+                    "unspill_disk",
+                    lambda: read_table_file(path, inject_api="disk_promote"))
+            self._disk_path = None
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._state = self.HOST
+        if self._state == self.HOST:
+            fp = self._fingerprint
             table = self._table
+
+            def _verified_upload():
+                t, _ = maybe_flip_table("unspill", table)
+                if fp is not None:
+                    verify_table(t, fp, context="unspill")
+                return to_device(t)
+
+            with trace_range("unspill"):
+                self._table = _guarded("unspill", _verified_upload)
+            self._fingerprint = None
+            self._state = self.DEVICE
+
+    def get(self) -> Table:
+        """The device-resident table, promoting (re-uploading) if spilled.
+
+        Raises :class:`CorruptionError` when promote-time verification
+        fails (the table is then quarantined) or when this table was
+        already quarantined by an earlier failure."""
+        try:
+            with self._lock:
+                if self._state == self.QUARANTINED:
+                    raise CorruptionError(
+                        "spillable table is quarantined (a previous "
+                        "integrity check failed); rebuild from source")
+                self._promote_locked()
+                table = self._table
+        except CorruptionError:
+            self._quarantine()
+            raise
         if self._on_promote is not None:
             self._on_promote(self)  # outside the lock: store takes its own
         return table
 
 
 class SpillStore:
-    """Registry of spillable tables with a spill-to-fit policy.
+    """Registry of spillable tables with a spill-to-fit policy and an
+    optional checksummed disk tier.
 
     The reference's RapidsBufferCatalog equivalent at reservation
     granularity: when the retry protocol demands rollback, the task's
@@ -147,12 +308,37 @@ class SpillStore:
     refreshes a table's recency) until the requested bytes are released.
     ``rollback_cb`` plugs directly into
     ``memory.retry.with_retry(rollback=...)``.
+
+    Disk tier (the plugin's host→disk spill store analog): when
+    ``disk_dir`` is set (default: config ``spill.disk_dir``) and the bytes
+    held by host-resident spilled tables exceed ``host_limit_bytes``
+    (config ``spill.host_limit_bytes``; 0 = unlimited), the store demotes
+    least-recently-used host tables to atomically-written, per-buffer
+    crc32-checksummed spill files. Construction sweeps the directory for
+    orphaned spill files and torn ``.tmp`` leftovers from a crashed
+    predecessor (``recovered_files`` counts them).
     """
 
-    def __init__(self):
+    def __init__(self, disk_dir: Optional[str] = None,
+                 host_limit_bytes: Optional[int] = None):
+        from ..utils import config
         self._lock = threading.Lock()
         self._seq = 0
+        self._file_seq = 0
         self._entries: Dict[int, Tuple[int, SpillableTable]] = {}
+        if disk_dir is None:
+            disk_dir = config.get("spill.disk_dir") or None
+        if host_limit_bytes is None:
+            host_limit_bytes = int(config.get("spill.host_limit_bytes"))
+        self._disk_dir = disk_dir
+        self._host_limit = host_limit_bytes
+        self.recovered_files = 0
+        if self._disk_dir:
+            os.makedirs(self._disk_dir, exist_ok=True)
+            # startup recovery: a crash mid-write leaves *.tmp (torn) and a
+            # crash mid-run leaves complete-but-ownerless spill files; both
+            # are dead weight — their tables re-materialize from upstream
+            self.recovered_files = clean_spill_dir(self._disk_dir)
 
     def _touch(self, st: SpillableTable) -> None:
         with self._lock:
@@ -167,6 +353,7 @@ class SpillStore:
             self._seq += 1
             self._entries[id(st)] = (self._seq, st)
         st._on_promote = self._touch
+        st._on_spill = self._host_pressure
         return st
 
     def unregister(self, st: SpillableTable) -> None:
@@ -178,6 +365,34 @@ class SpillStore:
             entries = list(self._entries.values())
         return sum(st.device_nbytes for _, st in entries)
 
+    def host_bytes(self) -> int:
+        """Bytes held by host-resident (spilled, not yet disk) tables."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return sum(st.host_nbytes for _, st in entries)
+
+    def _next_path(self) -> str:
+        with self._lock:
+            self._file_seq += 1
+            seq = self._file_seq
+        return os.path.join(self._disk_dir,
+                            f"srjt-spill-{os.getpid()}-{seq}.spill")
+
+    def _host_pressure(self, _st: SpillableTable) -> None:
+        """Post-spill hook: demote LRU host tables to disk while the host
+        tier is over budget (no-op unless both knobs are configured)."""
+        if not self._disk_dir or self._host_limit <= 0:
+            return
+        while self.host_bytes() > self._host_limit:
+            with self._lock:
+                order = sorted(self._entries.values(), key=lambda e: e[0])
+            victim = next((st for _, st in order if st.host_nbytes > 0),
+                          None)
+            if victim is None:
+                return
+            if victim.spill_to_disk(self._next_path()) <= 0:
+                return  # raced to another state; avoid spinning
+
     def spill_to_fit(self, bytes_needed: int) -> int:
         """Spill least-recently-promoted-first until ``bytes_needed`` HBM
         bytes have been released (or everything is spilled). Returns freed
@@ -188,6 +403,8 @@ class SpillStore:
         for _, st in order:
             if freed >= bytes_needed:
                 break
+            if st.is_quarantined:
+                continue  # nothing left to release; owner must rebuild
             freed += st.spill()
         return freed
 

@@ -23,6 +23,19 @@ shuffle + JCUDF rows fill this role there, SURVEY.md §5.8):
      returned as device-resident Tables. The only host syncs are sizing
      scalars (per-partition row counts, list/string totals), per the
      repo-wide "sizing on host, data on device" doctrine.
+
+Integrity (``exchange.verify_checksum``, docs/ARCHITECTURE.md): every
+shard block carries a checksum companion through the collective — the
+sender folds each destination block's lanes into a (sum, position-weighted
+sum) uint64 pair inside the same program, the pair rides the same
+all_to_all/ppermute as the data, and the receiver recomputes the fold over
+what actually landed. One host comparison per exchange raises
+:class:`CorruptionError` (fault domain CORRUPTION) before any Table is
+rebuilt, so corrupted rows can never escape into results; recovery is
+re-running the exchange from the still-intact source table. The chaos
+bit-flip (``injectionType: 3``, surface "exchange_shard") is a traced
+operand XORing one landed bit between the two folds — simulated wire
+corruption, provably caught.
 """
 
 from __future__ import annotations
@@ -266,6 +279,58 @@ def _cap_bucket(cap: int) -> int:
     return pad_width(cap, 16)
 
 
+# ---------------------------------------------------------------------------
+# shard checksum companion (exchange.verify_checksum)
+# ---------------------------------------------------------------------------
+
+def _lanes64(x: jnp.ndarray) -> jnp.ndarray:
+    """Reinterpret any buffer dtype as uint64 checksum lanes. Bools widen;
+    floats bitcast to same-width uints first — a NaN payload must checksum
+    by its exact bit pattern, not its float semantics."""
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint64)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = lax.bitcast_convert_type(
+            x, jnp.dtype(f"uint{x.dtype.itemsize * 8}"))
+    return x.astype(jnp.uint64)
+
+
+def _block_checksum(lanes: jnp.ndarray) -> jnp.ndarray:
+    """[blocks, flat] uint64 lanes -> [blocks, 2] checksums: a plain sum
+    (any single bit flip changes it mod 2^64) plus a position-weighted sum
+    (catches transposed elements whose plain sums agree). Overflow wraps
+    mod 2^64 identically on both sides of the wire, which is all a
+    companion checksum needs."""
+    w = jnp.arange(lanes.shape[1], dtype=jnp.uint64) + 1
+    return jnp.stack([jnp.sum(lanes, axis=1),
+                      jnp.sum(lanes * w[None, :], axis=1)], axis=1)
+
+
+def _flip_landed(landed: jnp.ndarray, k_buf: int,
+                 flip: jnp.ndarray) -> jnp.ndarray:
+    """Chaos wire-flip (injectionType 3): XOR one bit of buffer ``flip[0]``
+    at flat element ``flip[1]`` AFTER transit and BEFORE the receive-side
+    checksum fold — simulated interconnect corruption. ``flip[0] == -1``
+    disables; the operand is traced, so clean and storm runs share one
+    compiled program. Float buffers are left alone (XOR could fabricate a
+    NaN the compaction gather then canonicalizes) — every table ships at
+    least one integer/bool lane (validity), so coverage holds."""
+    if not (landed.dtype == jnp.bool_
+            or jnp.issubdtype(landed.dtype, jnp.integer)):
+        return landed
+    flat = landed.reshape(-1)
+    hit = flip[0] == k_buf
+    pos = jnp.clip(flip[1], 0, flat.shape[0] - 1)
+    cur = flat[pos]
+    if landed.dtype == jnp.bool_:
+        new = jnp.where(hit, jnp.logical_not(cur), cur)
+    else:
+        one = jnp.asarray(1, landed.dtype)
+        new = jnp.where(hit, cur ^ (one << flip[2].astype(landed.dtype)),
+                        cur)
+    return flat.at[pos].set(new).reshape(landed.shape)
+
+
 def _exchange_plan(counts_mat: np.ndarray, nd: int):
     """Dense-vs-ragged selection from the destination-count matrix.
 
@@ -292,10 +357,14 @@ def _exchange_plan(counts_mat: np.ndarray, nd: int):
 
 
 def _exchange_program(mesh: Mesh, per_dev: int, cap: int, nd: int,
-                      shapes: Tuple) -> "jax.stages.Wrapped":
+                      shapes: Tuple, verify: bool) -> "jax.stages.Wrapped":
     axis = _mesh_axis(mesh)
 
-    def local(dest_l, live_l, *bufs_l):
+    def local(dest_l, live_l, *ops):
+        if verify:
+            flip, bufs_l = ops[0], ops[1:]
+        else:
+            bufs_l = ops
         # dead rows route to bucket nd: out of the [nd, cap] grid, so the
         # scatter drops them (mode='drop') and they never ride the wire
         d = jnp.where(live_l, dest_l, nd)
@@ -316,25 +385,45 @@ def _exchange_program(mesh: Mesh, per_dev: int, cap: int, nd: int,
         k = jnp.sum(recv_occ).astype(jnp.int32).reshape(1)
 
         received = [k]
-        for b in bufs_l:
+        sent_cs = jnp.zeros((nd, 2), jnp.uint64)
+        recv_cs = jnp.zeros((nd, 2), jnp.uint64)
+        for k_buf, b in enumerate(bufs_l):
             slot = jnp.zeros((nd, cap) + b.shape[1:], dtype=b.dtype)
             slot = slot.at[d_s, rank].set(jnp.take(b, order, axis=0),
                                           mode="drop")
+            if verify:
+                sent_cs = sent_cs + _block_checksum(
+                    _lanes64(slot).reshape(nd, -1))
             landed = lax.all_to_all(slot, axis, 0, 0) \
                 .reshape((nd * cap,) + b.shape[1:])
+            if verify:
+                landed = _flip_landed(landed, k_buf, flip)
+                recv_cs = recv_cs + _block_checksum(
+                    _lanes64(landed).reshape(nd, -1))
             received.append(jnp.take(landed, corder, axis=0))
+        if verify:
+            # each sender's per-destination checksum rides the SAME
+            # collective shape as the data ([nd, 1, 2] row to device j),
+            # landing as row s = what source s claims it sent me
+            arrived = lax.all_to_all(sent_cs.reshape(nd, 1, 2), axis,
+                                     0, 0).reshape(nd, 2)
+            received += [arrived, recv_cs]
         return tuple(received)
 
+    n_out = 1 + len(shapes) + (2 if verify else 0)
+    in_specs = ((P(axis), P(axis), P()) if verify
+                else (P(axis), P(axis)))
     return jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=tuple(P(axis) for _ in range(2 + len(shapes))),
-        out_specs=tuple(P(axis) for _ in range(1 + len(shapes))),
+        in_specs=in_specs + tuple(P(axis) for _ in range(len(shapes))),
+        out_specs=tuple(P(axis) for _ in range(n_out)),
     ))
 
 
 def _exchange_program_ragged(mesh: Mesh, per_dev: int,
                              caps: Tuple[int, ...], nd: int,
-                             shapes: Tuple) -> "jax.stages.Wrapped":
+                             shapes: Tuple,
+                             verify: bool) -> "jax.stages.Wrapped":
     """Skew-proportional exchange: nd-1 ring ppermute rounds with
     PER-ROUND capacities instead of one all_to_all with the global max.
 
@@ -352,7 +441,11 @@ def _exchange_program_ragged(mesh: Mesh, per_dev: int,
     """
     axis = _mesh_axis(mesh)
 
-    def local(dest_l, live_l, counts, *bufs_l):
+    def local(dest_l, live_l, counts, *ops):
+        if verify:
+            flip, bufs_l = ops[0], ops[1:]
+        else:
+            bufs_l = ops
         i = lax.axis_index(axis)
         d = jnp.where(live_l, dest_l, nd)
         order = jnp.argsort(d, stable=True)
@@ -373,7 +466,9 @@ def _exchange_program_ragged(mesh: Mesh, per_dev: int,
         k = jnp.sum(recv_occ).astype(jnp.int32).reshape(1)
 
         received = [k]
-        for b in bufs_l:
+        sent_rows = [jnp.zeros((2,), jnp.uint64) for _ in range(nd)]
+        recv_rows = [jnp.zeros((2,), jnp.uint64) for _ in range(nd)]
+        for k_buf, b in enumerate(bufs_l):
             taken = jnp.take(b, order, axis=0)
             blocks = []
             for r in range(nd):
@@ -381,19 +476,42 @@ def _exchange_program_ragged(mesh: Mesh, per_dev: int,
                 idx = jnp.where(d_s == dest_r, rank, caps[r])
                 slot = jnp.zeros((caps[r],) + b.shape[1:], dtype=b.dtype)
                 slot = slot.at[idx].set(taken, mode="drop")
+                cs = (_block_checksum(_lanes64(slot).reshape(1, -1))[0]
+                      if verify else None)
                 if r:
                     perm = [(j, (j + r) % nd) for j in range(nd)]
                     slot = lax.ppermute(slot, axis, perm)
+                    if verify:
+                        # the checksum companion rides the SAME ring hop
+                        # as its block
+                        cs = lax.ppermute(cs, axis, perm)
                 blocks.append(slot)
+                if verify:
+                    sent_rows[r] = sent_rows[r] + cs
             landed = jnp.concatenate(blocks, axis=0)
+            if verify:
+                landed = _flip_landed(landed, k_buf, flip)
+                lanes = _lanes64(landed).reshape(landed.shape[0], -1)
+                off = 0
+                for r in range(nd):
+                    seg = lanes[off:off + caps[r]].reshape(1, -1)
+                    recv_rows[r] = recv_rows[r] + _block_checksum(seg)[0]
+                    off += caps[r]
             received.append(jnp.take(landed, corder, axis=0))
+        if verify:
+            # rows indexed by ROUND here (round r <=> source (i - r) % nd);
+            # the host only needs elementwise equality, so the layout just
+            # has to agree between the two matrices — and it does
+            received += [jnp.stack(sent_rows), jnp.stack(recv_rows)]
         return tuple(received)
 
+    n_out = 1 + len(shapes) + (2 if verify else 0)
+    in_specs = ((P(axis), P(axis), P(), P()) if verify
+                else (P(axis), P(axis), P()))
     return jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P()) + tuple(
-            P(axis) for _ in range(len(shapes))),
-        out_specs=tuple(P(axis) for _ in range(1 + len(shapes))),
+        in_specs=in_specs + tuple(P(axis) for _ in range(len(shapes))),
+        out_specs=tuple(P(axis) for _ in range(n_out)),
     ))
 
 
@@ -466,27 +584,70 @@ def hash_partition_exchange(
         buffers.extend(_stage(_pad(b)) for b in bufs)
         metas.append(meta)
 
+    from ..utils import config
+    verify = bool(config.get("exchange.verify_checksum"))
+    zone = sum(caps) if ragged else nd * cap
+
+    extra: Tuple[jnp.ndarray, ...] = ()
+    if verify:
+        # chaos surface "exchange_shard": pick (buffer, landed flat
+        # element, bit) for the in-program wire flip; (-1, 0, 0) = clean.
+        # Only integer/bool lanes are flippable (see _flip_landed).
+        from ..memory.integrity import CorruptionError, bitflip_spec
+        elem = [int(np.prod(b.shape[1:], dtype=np.int64)) for b in buffers]
+        cand = [i for i, b in enumerate(buffers)
+                if b.dtype == jnp.bool_
+                or jnp.issubdtype(b.dtype, jnp.integer)]
+        spec = bitflip_spec(
+            "exchange_shard", cand, [zone * elem[i] for i in cand],
+            [np.dtype(buffers[i].dtype).itemsize * 8 for i in cand])
+        extra = (jnp.asarray(spec if spec is not None else (-1, 0, 0),
+                             jnp.int32),)
+
     shapes = tuple((b.shape[1:], str(b.dtype)) for b in buffers)
     if ragged:
-        sig = (mesh, per_dev, caps, shapes)
+        sig = (mesh, per_dev, caps, shapes, verify)
         program = _EXCHANGE_CACHE.get(sig)
         if program is None:
             program = _exchange_program_ragged(mesh, per_dev, caps, nd,
-                                               shapes)
+                                               shapes, verify)
             _EXCHANGE_CACHE[sig] = program
-        zone = sum(caps)
         out = guarded_dispatch(
             "exchange_alltoall", program, dest_d, live_d,
-            jnp.asarray(counts_mat, jnp.int32), *buffers)
+            jnp.asarray(counts_mat, jnp.int32), *extra, *buffers)
     else:
-        sig = (mesh, per_dev, cap, shapes)
+        sig = (mesh, per_dev, cap, shapes, verify)
         program = _EXCHANGE_CACHE.get(sig)
         if program is None:
-            program = _exchange_program(mesh, per_dev, cap, nd, shapes)
+            program = _exchange_program(mesh, per_dev, cap, nd, shapes,
+                                        verify)
             _EXCHANGE_CACHE[sig] = program
-        zone = nd * cap
         out = guarded_dispatch("exchange_alltoall", program, dest_d, live_d,
-                               *buffers)
+                               *extra, *buffers)
+
+    mismatch_d = None
+    if verify:
+        # receive-side verification BEFORE any rebuild: what each source
+        # said it sent vs what the receiver's own fold says landed. The
+        # scalar verdict is reduced on device and rides the rebuild's one
+        # batched sizing sync, so the clean path pays zero extra d2h
+        # transfers; the full matrices are fetched only on the corruption
+        # path, for the error message. A mismatch raises CorruptionError
+        # through the guard (counted once per exchange) before any
+        # partition Table is built from the landing zone.
+        mismatch_d = (out[-2] != out[-1]).any()
+
+    def _check_shards(flag: bool):
+        def _verify_shards():
+            if flag:
+                sent_mat = _host_global(out[-2]).reshape(nd, nd, 2)
+                recv_mat = _host_global(out[-1]).reshape(nd, nd, 2)
+                bad = np.argwhere(np.any(sent_mat != recv_mat, axis=2))
+                raise CorruptionError(
+                    "exchange: shard checksum mismatch (corruption) at "
+                    f"(device, block) {bad[:4].tolist()}; discarding the "
+                    "landing zone — re-run the exchange from source")
+        guarded_dispatch("exchange_verify", _verify_shards)
 
     # Device-resident rebuild. Partition row counts need NO extra sync:
     # phase 1's counts matrix already gives k_p as destination-column sums
@@ -516,6 +677,8 @@ def hash_partition_exchange(
     if jax.process_count() == 1:
         all_bufs = []
         flat: List[jnp.ndarray] = []
+        if mismatch_d is not None:
+            flat.append(jnp.asarray(mismatch_d, jnp.int64))
         for p in range(nd):
             k = int(ks[p])
             bufs_p = [out[1 + i][p * zone:p * zone + k]
@@ -525,6 +688,9 @@ def hash_partition_exchange(
             all_bufs.append(bufs_p)
         vals = (np.asarray(jnp.stack(flat)) if flat
                 else np.zeros(0, np.int64))  # ONE sync for all partitions
+        if mismatch_d is not None:
+            _check_shards(bool(vals[0]))
+            vals = vals[1:]
         sizes = iter(vals.tolist())
         return [_consume(bufs_p, sizes) for bufs_p in all_bufs]
 
@@ -534,6 +700,10 @@ def hash_partition_exchange(
     # Returns (global partition index, Table) pairs in mesh order; see
     # parallel/cluster.py for the bootstrap. Sizing is batched per
     # partition (cross-device stacking is not possible eagerly).
+    if mismatch_d is not None:
+        # sizing below is per-partition anyway: verify eagerly with one
+        # replicated-scalar sync (the reduction output is fully addressable)
+        _check_shards(bool(_host_global(mismatch_d)))
     flat_devs = list(mesh.devices.flat)
     shard_by_dev = [
         {s.device: s.data for s in out[1 + i].addressable_shards}

@@ -39,6 +39,7 @@ from ..memory.exceptions import (
     TpuOOM,
     TpuRetryOOM,
 )
+from ..memory.integrity import CorruptionError
 from ..memory.rmm_spark import RmmSpark
 from ..utils.tracing import trace_range
 
@@ -109,6 +110,18 @@ class _TaskWorker:
                 # memory pressure: not a device-health signal — rollback
                 # and retry under the budget (split escalation is the
                 # caller's protocol via memory.retry.with_retry)
+                attempts += 1
+                device_failures = 0
+                if attempts > budget:
+                    raise
+                guard.metrics.bump("task_retries")
+                self._rollback()
+            except CorruptionError:
+                # a verified-corrupt buffer beneath this op was already
+                # quarantined by its detector; the only recovery is
+                # re-materializing from upstream, which re-running the
+                # submission does (sources are still intact). Counts
+                # against the same budget — never retry-in-place.
                 attempts += 1
                 device_failures = 0
                 if attempts > budget:
@@ -189,6 +202,10 @@ class TaskExecutor:
 
     def __init__(self, mark_tasks_done: bool = True, spill_store=None):
         self._workers: Dict[int, _TaskWorker] = {}
+        # workers whose join timed out in task_done(): popped from
+        # _workers but their task not yet marked done — close() gives
+        # them a second chance so the scheduler slot isn't leaked
+        self._zombies: Dict[int, _TaskWorker] = {}
         self._lock = threading.Lock()
         self._mark_done = mark_tasks_done
         self._spill_store = spill_store
@@ -228,7 +245,17 @@ class TaskExecutor:
             if w is None:
                 return
             w.stop()
-        if w.join(timeout) and self._mark_done and RmmSpark.is_installed():
+        if w.join(timeout):
+            self._mark_task_done(task_id)
+        else:
+            # the worker outlived the timeout with the task still
+            # unmarked: remember it instead of dropping it on the floor,
+            # so close() can mark the task done once it has really exited
+            with self._lock:
+                self._zombies[task_id] = w
+
+    def _mark_task_done(self, task_id: int):
+        if self._mark_done and RmmSpark.is_installed():
             try:
                 RmmSpark.task_done(task_id)
             except RuntimeError:
@@ -241,12 +268,16 @@ class TaskExecutor:
             self._workers.clear()
             for w in workers.values():
                 w.stop()
+            # workers whose task_done() join timed out earlier: their
+            # threads may have exited since, so try to retire them too
+            zombies = dict(self._zombies)
+            self._zombies.clear()
         for task_id, w in workers.items():
-            if w.join(timeout) and self._mark_done and RmmSpark.is_installed():
-                try:
-                    RmmSpark.task_done(task_id)
-                except RuntimeError:
-                    pass
+            if w.join(timeout):
+                self._mark_task_done(task_id)
+        for task_id, w in zombies.items():
+            if w.join(timeout):
+                self._mark_task_done(task_id)
 
     def __enter__(self):
         return self

@@ -86,7 +86,7 @@ def _load():
         from ..utils.nativeload import load_native
         lib = load_native("parquet_decode.cpp", "libsparkpqd.so",
                           extra_deps=["thrift_compact.hpp"],
-                          link=["-lz", "-lzstd"])
+                          link=["-lz", "-ldl"])
         c = ctypes
         lib.pqd_open.restype = c.c_void_p
         lib.pqd_open.argtypes = [c.POINTER(c.c_uint8), c.c_longlong,
@@ -97,6 +97,8 @@ def _load():
         lib.pqd_rg_num_rows.argtypes = [c.c_void_p, c.c_int]
         lib.pqd_num_leaves.restype = c.c_int
         lib.pqd_num_leaves.argtypes = [c.c_void_p]
+        lib.pqd_set_verify_crc.restype = None
+        lib.pqd_set_verify_crc.argtypes = [c.c_void_p, c.c_int]
         lib.pqd_leaf_info.restype = c.c_int
         lib.pqd_leaf_info.argtypes = [c.c_void_p, c.c_int, c.POINTER(_LeafC)]
         lib.pqd_chunk_range.restype = c.c_int
@@ -247,6 +249,9 @@ class ParquetReader:
             self._lib.pqd_free(err)
             raise RuntimeError(f"parquet open failed: {msg}")
         self._h = h
+        from ..utils import config
+        self._lib.pqd_set_verify_crc(
+            self._h, 1 if config.get("parquet.verify_crc") else 0)
         self._leaves = self._read_schema()
         self._plans = self._build_plans()
         if columns is not None:
@@ -360,35 +365,65 @@ class ParquetReader:
         return sum(self._chunk_range(rg, l.index)[1] for l in self._selected)
 
     # ---- decode -----------------------------------------------------------
+
+    # re-reads of a chunk whose page crc verification failed: the file may
+    # be fine and the copy in hand flipped in transit (page cache, DMA, an
+    # injected chaos flip) — a fresh read from source is the CORRUPTION
+    # domain's recovery. Persistent mismatches mean the file itself is bad
+    # and the CorruptionError propagates.
+    _CRC_REREADS = 2
+
     def _decode_leaf(self, f, rg: int, leaf: LeafSchema,
                      want_levels: bool = False):
         """Decode one (row group, leaf) into host numpy buffers.
 
         want_levels (nested plans): the tuple's ``lists`` slot instead
         carries the raw (defs, reps) streams for tree reconstruction."""
-        off, length, _, _ = self._chunk_range(rg, leaf.index)
-        f.seek(off)
-        raw = f.read(length)
-        buf = np.frombuffer(raw, dtype=np.uint8)
-        out = _OutC()
-
-        def _native_decode():
-            err = ctypes.c_char_p()
-            rc = self._lib.pqd_decode_chunk2(
-                self._h, rg, leaf.index,
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
-                1 if want_levels else 0, ctypes.byref(out), ctypes.byref(err))
-            if rc != 0:
-                msg = err.value.decode() if err.value else "unknown error"
-                self._lib.pqd_free(err)
-                raise RuntimeError(
-                    f"decode {leaf.name!r} rg={rg} failed: {msg}")
-
-        # per-page-stream decode under the fault-domain supervisor: fault
-        # configs target "parquet_page_decode"; the native decode fills
-        # `out` only on rc==0, so a retried attempt starts clean
         from ..faultinj.guard import guarded_dispatch
-        guarded_dispatch("parquet_page_decode", _native_decode)
+        from ..faultinj.injector import get_injector
+        from ..memory.integrity import CorruptionError, maybe_flip_arrays
+        off, length, _, _ = self._chunk_range(rg, leaf.index)
+        last: Optional[CorruptionError] = None
+        for _attempt in range(1 + self._CRC_REREADS):
+            f.seek(off)
+            raw = f.read(length)
+            buf = np.frombuffer(raw, dtype=np.uint8)
+            # chaos surface "parquet_page": one bit of the transiting chunk
+            # bytes flips between the file read and the native decode — the
+            # per-page crc verify must convert it into CorruptionError
+            if get_injector() is not None:
+                wbuf = np.frombuffer(bytearray(raw), dtype=np.uint8)
+                if maybe_flip_arrays("parquet_page", [wbuf]):
+                    buf = wbuf
+            out = _OutC()
+
+            def _native_decode(buf=buf, out=out):
+                err = ctypes.c_char_p()
+                rc = self._lib.pqd_decode_chunk2(
+                    self._h, rg, leaf.index,
+                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    len(buf), 1 if want_levels else 0, ctypes.byref(out),
+                    ctypes.byref(err))
+                if rc != 0:
+                    msg = err.value.decode() if err.value else "unknown error"
+                    self._lib.pqd_free(err)
+                    text = f"decode {leaf.name!r} rg={rg} failed: {msg}"
+                    if "(corruption)" in msg:
+                        raise CorruptionError(text)
+                    raise RuntimeError(text)
+
+            # per-page-stream decode under the fault-domain supervisor:
+            # fault configs target "parquet_page_decode"; the native decode
+            # fills `out` only on rc==0, so a retried attempt starts clean
+            try:
+                guarded_dispatch("parquet_page_decode", _native_decode)
+            except CorruptionError as e:
+                last = e  # detection already counted by the guard;
+                continue  # recovery = discard and re-read from source
+            return self._unpack_out(leaf, out, want_levels)
+        raise last
+
+    def _unpack_out(self, leaf: LeafSchema, out, want_levels: bool):
         try:
             rows = out.rows
             values = np.ctypeslib.as_array(out.values,
@@ -599,7 +634,14 @@ class ParquetReader:
             try:
                 blob, pages = dd.extract_pages(self._lib, self._h, g,
                                                leaf.index, buf)
-            except RuntimeError:
+            except RuntimeError as e:
+                if "(corruption)" in str(e):
+                    # the device tier saw a bad page crc: count the
+                    # detection here (this call is not under a guard) and
+                    # fall back to the host path, which re-reads the chunk
+                    # from source — the CORRUPTION domain's recovery
+                    from ..faultinj.guard import metrics
+                    metrics.bump("corruption_detected")
                 return None  # e.g. unsupported structure
             if not dd.pages_supported(leaf, pages):
                 return None

@@ -93,6 +93,29 @@ _register("task.retry_budget", "SRJT_TASK_RETRY_BUDGET", 4, int,
 _register("task.degrade_after", "SRJT_TASK_DEGRADE_AFTER", 3, int,
           "consecutive device failures before a task degrades to the "
           "host/CPU compute path (0 disables degradation)")
+_register("spill.disk_dir", "SRJT_SPILL_DISK_DIR", "", str,
+          "disk spill tier directory for SpillStore ('' disables): host "
+          "buffers past spill.host_limit_bytes demote to checksummed "
+          "files written atomically (ref: the plugin's "
+          "spark.rapids.memory.host.spillStorageSize disk tier)")
+_register("spill.host_limit_bytes", "SRJT_SPILL_HOST_LIMIT_BYTES", 0, int,
+          "host-tier byte budget for spilled tables before demotion to "
+          "the disk tier; 0 = unlimited (disk tier idle)")
+_register("spill.verify_fingerprints", "SRJT_SPILL_VERIFY", True,
+          _parse_bool,
+          "crc32-fingerprint spilled tables at demotion and verify at "
+          "promote; a mismatch quarantines the buffer and raises "
+          "CorruptionError (fault domain CORRUPTION)")
+_register("parquet.verify_crc", "SRJT_PARQUET_VERIFY_CRC", True,
+          _parse_bool,
+          "verify PageHeader.crc on every parquet page when present "
+          "(ref: cudf reader's page checksum verification); a bad page "
+          "surfaces as CorruptionError and the reader re-reads it")
+_register("exchange.verify_checksum", "SRJT_EXCHANGE_VERIFY_CHECKSUM",
+          True, _parse_bool,
+          "carry a per-shard checksum companion through the exchange "
+          "all_to_all and verify on the receive side before tables are "
+          "rebuilt; a mismatch raises CorruptionError")
 _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "input variants cycled by benchmarks to defeat identical-args "
           "elision")

@@ -101,6 +101,83 @@ def test_dynamic_reload(tmp_path):
         fault_point("op")
 
 
+def test_dynamic_reload_replaces_rule_set(tmp_path):
+    """A reload is a replacement, not a merge: rules dropped from the file
+    stop firing and newly-named rules start firing."""
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "old_op": {"percent": 100, "injectionType": 0,
+                       "interceptionCount": 1000}}}))
+    install(str(p), seed=0)
+    with pytest.raises(DeviceTrapError):
+        fault_point("old_op")
+    fault_point("new_op")  # not configured yet
+    time.sleep(0.06)
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "new_op": {"percent": 100, "injectionType": 1,
+                       "interceptionCount": 1000}}}))
+    import os
+    os.utime(p, (time.time(), time.time() + 1))
+    time.sleep(0.06)
+    fault_point("old_op")  # dropped from the config: no longer fires
+    with pytest.raises(DeviceAssertError):
+        fault_point("new_op")
+
+
+def test_dynamic_false_ignores_file_changes(tmp_path):
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps({
+        "xlaRuntimeFaults": {
+            "op": {"percent": 0, "injectionType": 0,
+                   "interceptionCount": 1000}}}))
+    install(str(p), seed=0)
+    fault_point("op")
+    time.sleep(0.06)
+    p.write_text(json.dumps({
+        "xlaRuntimeFaults": {
+            "op": {"percent": 100, "injectionType": 0,
+                   "interceptionCount": 1000}}}))
+    import os
+    os.utime(p, (time.time(), time.time() + 1))
+    time.sleep(0.06)
+    fault_point("op")  # static config: the 100% rewrite must not load
+
+
+def test_dynamic_reload_switches_to_bitflip_rule(tmp_path):
+    """A reload can retarget a surface to injectionType 3: exception
+    checkpoints stop firing and the payload hooks start flipping."""
+    import os
+
+    import numpy as np
+
+    from spark_rapids_jni_tpu.memory.integrity import maybe_flip_arrays
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "surf": {"percent": 100, "injectionType": 0,
+                     "interceptionCount": 1000}}}))
+    install(str(p), seed=0)
+    with pytest.raises(DeviceTrapError):
+        fault_point("surf")
+    time.sleep(0.06)
+    p.write_text(json.dumps({
+        "dynamic": True,
+        "xlaRuntimeFaults": {
+            "surf": {"percent": 100, "injectionType": 3,
+                     "interceptionCount": 2}}}))
+    os.utime(p, (time.time(), time.time() + 1))
+    time.sleep(0.06)
+    fault_point("surf")  # bit-flip rules never raise at checkpoints
+    arr = np.zeros(32, dtype=np.uint8)
+    assert maybe_flip_arrays("surf", [arr]) == 1
+    assert arr.any()
+
+
 def test_uninstall_restores(tmp_path):
     path = write_cfg(tmp_path, {
         "xlaRuntimeFaults": {
