@@ -54,6 +54,15 @@ SWEEP_DEADLINE_S = float(os.environ.get("BENCH_SWEEP_DEADLINE_S", "1500"))
 # accelerator sweep (that partial IS the round's TPU evidence).
 STALL_S = int(os.environ.get("BENCH_STALL_S", "900"))
 
+# Per-axis deadline (round 5): the round-4 TPU capture lost parquet_decode_1m
+# to a >900s mid-axis wedge and only the process-level stall watchdog saved
+# the partial sweep. Each axis now runs under its own Deadline
+# (spark_rapids_jni_tpu.faultinj.watchdog): a wedged axis records
+# {"error": "wedged: axis deadline exceeded"} and the sweep CONTINUES on
+# to the next axis
+# instead of forfeiting everything after the wedge.
+AXIS_DEADLINE_S = float(os.environ.get("BENCH_AXIS_DEADLINE_S", str(STALL_S)))
+
 # Statistical honesty (round-3 verdict weak #6): single runs on a shared
 # 1-core container carry ±30% variance, so every axis is timed REPEATS
 # times and reported as {median, min, repeats}; deltas between rounds are
@@ -352,7 +361,14 @@ def axis_table():
 def _sweep(deadline):
     """Run every benchmark axis (benchmarks/bench_ops.py implementations)
     until the deadline; per-axis failures and skips are recorded, never
-    fatal. Returns {axis: {rows, seconds, mrows_per_s, gb_per_s} | {...}}."""
+    fatal. Each axis additionally runs under its own Deadline (min of
+    AXIS_DEADLINE_S and the sweep time left): a wedged device call inside
+    one axis is detected by the hang watchdog, cancelled, recorded as
+    {"error": "deadline exceeded"}, and the sweep moves on. Returns
+    {axis: {rows, seconds, mrows_per_s, gb_per_s} | {...}}."""
+    from spark_rapids_jni_tpu.faultinj.watchdog import (
+        Deadline, DeadlineExceededError, StallCancelledError,
+        deadline_sleep)
     axes = axis_table()
     results = _STATE["axes"]  # shared: the stall watchdog emits this dict
     for name, fn, rows in axes:
@@ -364,8 +380,6 @@ def _sweep(deadline):
         with _STATE_LOCK:
             _STATE["current_axis"] = name
         _heartbeat()
-        if os.environ.get("_BENCH_TEST_STALL") == name:
-            time.sleep(10 ** 6)  # test hook: simulate a wedged device call
         # >= 1 repeat always; later repeats stop at the deadline so a slow
         # axis degrades to fewer repeats instead of a skip. A failure on a
         # later repeat must NOT discard already-collected timings — in a
@@ -374,30 +388,50 @@ def _sweep(deadline):
         # there, so every timed repeat (and the *_best fields) measures
         # steady state.
         secs, nbytes, err = [], 0, None
-        for r in range(REPEATS + 1):
-            if secs and time.monotonic() >= deadline:
-                break
-            lbl = f"repeat {r}" if r else "warm-up"
-            try:
-                sec, nbytes = fn()
-                if r:
-                    secs.append(sec)
-                _heartbeat()
-            except RuntimeError as e:
-                if "devices" in str(e) and not secs:
-                    # structural (single-device backend) — but only when no
-                    # repeat has landed: a later-repeat failure must fall
-                    # through to the median path with the collected timings
-                    # (ADVICE r4)
-                    results[name] = {"skipped": str(e)}
-                    break
-                err = f"{type(e).__name__}: {e}"
-                _log(f"  {name} {lbl} FAILED: {e}")
-                break
-            except Exception as e:  # an axis must never sink the sweep
-                err = f"{type(e).__name__}: {e}"
-                _log(f"  {name} {lbl} FAILED: {e}")
-                break
+        try:
+            with Deadline(min(AXIS_DEADLINE_S, left), f"axis:{name}"):
+                if os.environ.get("_BENCH_TEST_STALL") == name:
+                    # test hook: a wedged device call — cancellable, so
+                    # the axis deadline (not an external kill) unwedges it
+                    deadline_sleep(10 ** 6)
+                for r in range(REPEATS + 1):
+                    if secs and time.monotonic() >= deadline:
+                        break
+                    lbl = f"repeat {r}" if r else "warm-up"
+                    try:
+                        sec, nbytes = fn()
+                        if r:
+                            secs.append(sec)
+                        _heartbeat()
+                    except (DeadlineExceededError, StallCancelledError):
+                        raise  # axis verdict, not a repeat failure
+                    except RuntimeError as e:
+                        if "devices" in str(e) and not secs:
+                            # structural (single-device backend) — but only
+                            # when no repeat has landed: a later-repeat
+                            # failure must fall through to the median path
+                            # with the collected timings (ADVICE r4)
+                            results[name] = {"skipped": str(e)}
+                            break
+                        err = f"{type(e).__name__}: {e}"
+                        _log(f"  {name} {lbl} FAILED: {e}")
+                        break
+                    except Exception as e:  # never sink the sweep
+                        err = f"{type(e).__name__}: {e}"
+                        _log(f"  {name} {lbl} FAILED: {e}")
+                        break
+        except (DeadlineExceededError, StallCancelledError):
+            # the fix for the round-4 wedge: one stalled axis costs
+            # AXIS_DEADLINE_S, not the rest of the sweep
+            # "wedged" is load-bearing: the driver (and the round-4 smoke
+            # test) greps for it to distinguish a hung device call from an
+            # axis that merely errored
+            results[name] = {"error": "wedged: axis deadline exceeded "
+                                      f"(> {min(AXIS_DEADLINE_S, left):.0f}s)"}
+            _log(f"  {name} DEADLINE EXCEEDED "
+                 f"({min(AXIS_DEADLINE_S, left):.0f}s); continuing")
+            _heartbeat()  # the stall is handled: don't also trip _STALL_S
+            continue
         if name in results:  # structural skip recorded above
             continue
         if not secs:

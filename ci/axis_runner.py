@@ -16,6 +16,14 @@ import) makes the per-process re-init cost ~72 ms/program, not ~0.9 s.
 Protocol per axis matches bench.py (one untimed warm-up pays compile and
 first-touch, then median of N timed repeats); emits ONE JSON line on stdout. Exit 3 = no accelerator (parent
 skips, nothing recorded). Exit 0 = the JSON line is a real measurement.
+
+In-process deadline (first line of defense, under the parent's SIGKILL):
+the whole axis runs inside a Deadline of AXIS_RUNNER_DEADLINE_S (default:
+bench.AXIS_DEADLINE_S) — a stall the hang watchdog can cancel (a wedged
+guarded dispatch, a stuck cooperative wait) exits cleanly with exit 4 and
+{"axis": ..., "error": "deadline exceeded"} on stdout, preserving stderr
+diagnostics; only a truly uncancellable C-level wedge costs the parent's
+SIGKILL. Exit 4 = deadline exceeded (parent records the error, continues).
 """
 
 import json
@@ -49,21 +57,34 @@ def main():
     axes = {n: (f, r) for n, f, r in bench.axis_table()}
     fn, rows = axes[axis]
 
-    # one untimed warm-up so every TIMED repeat measures steady state —
-    # compile + first-touch costs land here, not in the median (the
-    # *_best/min fields below then compare like with like)
-    t = time.monotonic()
-    fn()
-    print(f"axis_runner: {axis} warm-up (wall {time.monotonic() - t:.1f}s)",
-          file=sys.stderr)
+    from spark_rapids_jni_tpu.faultinj.watchdog import (
+        Deadline, DeadlineExceededError, StallCancelledError)
+    budget = float(os.environ.get("AXIS_RUNNER_DEADLINE_S",
+                                  str(bench.AXIS_DEADLINE_S)))
 
     secs, nbytes = [], 0
-    for _ in range(repeats):
-        t = time.monotonic()
-        sec, nbytes = fn()
-        secs.append(sec)
-        print(f"axis_runner: {axis} repeat {len(secs)} {sec:.3f}s "
-              f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
+    try:
+        with Deadline(budget, f"axis:{axis}"):
+            # one untimed warm-up so every TIMED repeat measures steady
+            # state — compile + first-touch costs land here, not in the
+            # median (the *_best/min fields then compare like with like)
+            t = time.monotonic()
+            fn()
+            print(f"axis_runner: {axis} warm-up "
+                  f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
+
+            for _ in range(repeats):
+                t = time.monotonic()
+                sec, nbytes = fn()
+                secs.append(sec)
+                print(f"axis_runner: {axis} repeat {len(secs)} {sec:.3f}s "
+                      f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
+    except (DeadlineExceededError, StallCancelledError) as e:
+        print(f"axis_runner: {axis} DEADLINE EXCEEDED ({budget:.0f}s): {e}",
+              file=sys.stderr)
+        print(json.dumps({"axis": axis, "backend": backend,
+                          "error": "deadline exceeded"}))
+        return 4
     secs.sort()
     med = statistics.median(secs)
     print(json.dumps({

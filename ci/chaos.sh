@@ -24,7 +24,18 @@ SRJT_LINT_NO_JAXPR=1 bash ci/lint.sh
 env JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 2 — exception-fault storms over the whole chaos-marked suite
+# stage 2 — hang/delay storms (injectionType 4): permanent hangs at the
+# bridge/transport/spill-disk/exchange/parquet surfaces plus an
+# uncancellable wedge that must end in a lost-worker requeue. The outer
+# `timeout` is part of the pass criteria: the storm must complete within
+# the deadline envelope HEADLESSLY — if the watchdog ever stops
+# cancelling, the wedge survives to the kill and the lane fails loudly
+# instead of hanging CI. `make hang` runs just this stage.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_watchdog.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 3 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

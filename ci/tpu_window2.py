@@ -119,6 +119,11 @@ def run_axis(axis):
         log(f"axis {axis}: no JSON (rc={p.returncode}): {tail[-200:]} "
             f"[full stderr: {err_path}]")
         return "error"
+    if "error" in line:
+        # axis_runner's in-process deadline (exit 4) caught the wedge before
+        # our outer timeout did — same verdict, cheaper detection
+        log(f"axis {axis}: WEDGED in-process: {line['error']}")
+        return "wedged"
     if "mrows_per_s" not in line:
         log(f"axis {axis}: backend={line.get('backend')} — not capturing")
         return "cpu"

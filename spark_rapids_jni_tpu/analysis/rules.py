@@ -15,6 +15,7 @@ Rule IDs:
   SRJT006  columnar op drops the validity mask
   SRJT007  use of a buffer after donation
   SRJT008  tracing span / fault-metrics counter name drift
+  SRJT009  unbounded blocking wait on a guarded/dispatch surface
 """
 
 from __future__ import annotations
@@ -651,8 +652,67 @@ def project_rule_srjt008_spans(modules, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT009 — unbounded blocking wait on a guarded/dispatch surface
+# ---------------------------------------------------------------------------
+
+# modules where a blocking wait sits under (or implements) the dispatch
+# path: the deadline/watchdog subsystem (faultinj/watchdog.py) can only
+# cancel work that waits WITH a timeout — an argument-less join()/wait()/
+# get() here is a hang the escalation ladder cannot reach
+_WAIT_SURFACE_BASENAMES = _SURFACE_BASENAMES + (
+    "task_executor.py", "rmm_spark.py", "watchdog.py", "guard.py")
+# receivers that name a queue: .get() is only a blocking wait on these
+# (config.get / dict.get / rules.get are lookups, never blocking)
+_QUEUEISH_RECEIVERS = ("q", "_q", "queue", "_queue", "work_queue", "inbox")
+
+
+def _timeout_bounded(call: ast.Call) -> bool:
+    """True when the call carries any timeout-shaped bound: a ``timeout=``
+    keyword, or (method calls) a positional argument — join(5)/wait(0.05)
+    take the timeout positionally, and a str.join(parts) false-positive is
+    excluded the same way."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return bool(call.args)
+
+
+def rule_srjt009(tree, rel, lines, ctx) -> List[Finding]:
+    base = rel.rsplit("/", 1)[-1]
+    if base not in _WAIT_SURFACE_BASENAMES:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = None
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            recv = _dotted(node.func.value) or ""
+            leaf = recv.split(".")[-1] if recv else "..."
+            if meth in ("join", "wait", "result") \
+                    and not _timeout_bounded(node):
+                hit = f"{leaf}.{meth}()"
+            elif (meth == "get" and not _timeout_bounded(node)
+                    and leaf in _QUEUEISH_RECEIVERS):
+                hit = f"{leaf}.get()"
+        elif isinstance(node.func, ast.Name) and node.func.id == "wait":
+            # concurrent.futures.wait: the futures land positionally, so
+            # only an explicit timeout= keyword bounds it
+            if not any(kw.arg == "timeout" for kw in node.keywords):
+                hit = "wait(...)"
+        if hit is not None:
+            findings.append(Finding(
+                "SRJT009", rel, node.lineno,
+                f"unbounded blocking wait `{hit}` on a dispatch surface — "
+                f"derive a timeout from the active deadline "
+                f"(faultinj.watchdog.derive_timeout) so a stall stays "
+                f"cancellable instead of wedging the process"))
+    return findings
+
+
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
-              rule_srjt008_counters)
+              rule_srjt008_counters, rule_srjt009)
 PROJECT_RULES = (project_rule_srjt008_spans,)
 ALL_RULES = FILE_RULES + PROJECT_RULES

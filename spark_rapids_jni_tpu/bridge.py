@@ -129,12 +129,17 @@ def call(op_name: str, args_json: str,
     (faultinj/guard.py): a JSON fault config targeting the op name
     ("hash.murmur3") fires here, and real runtime failures classify into
     the same recovery domains (transient backoff / poison re-dispatch /
-    retry-OOM protocol)."""
+    retry-OOM protocol). The caller's Deadline (faultinj/watchdog.py)
+    bounds the dispatch too: the pre-marshal checkpoint stops a cancelled
+    task before building columns, and the supervisor's retry loop derives
+    its backoff from the remaining budget."""
+    from .faultinj import watchdog
     from .faultinj.guard import guarded_dispatch
     fn = _OPS.get(op_name)
     if fn is None:
         raise KeyError(f"unknown engine op: {op_name!r} "
                        f"(have: {sorted(_OPS)})")
+    watchdog.checkpoint()  # chunk boundary: before column marshalling
     args = json.loads(args_json) if args_json else {}
     cols = [wire_to_col(w) for w in wire_cols]
     out = guarded_dispatch(op_name, fn, args, cols)

@@ -12,7 +12,10 @@ call. injectionType 0/1 raise device-style errors; type 2 raises
 ``InjectedApiError(substituteReturnCode)``; type 3 flips one bit of a
 transiting payload (via the ``memory/integrity.py`` hooks at the
 spill/unspill/disk/parquet/exchange surfaces) so the checksum detectors
-are provable end-to-end — see ``CorruptionError`` there.
+are provable end-to-end — see ``CorruptionError`` there; type 4 injects a
+``delayMs`` sleep or (``delayMs < 0``) a permanent hang at the call site
+so the deadline/watchdog subsystem (``watchdog.py``) is provable the same
+way — stalls are detected, diagnosed, and cancelled, never waited on.
 """
 
 from .injector import (
@@ -34,14 +37,25 @@ from .guard import (
     guarded_dispatch,
     metrics,
 )
+from .watchdog import (
+    CancelToken,
+    Deadline,
+    DeadlineExceededError,
+    StallCancelledError,
+)
+from . import watchdog
 
 __all__ = [
+    "CancelToken",
+    "Deadline",
+    "DeadlineExceededError",
     "DeviceAssertError",
     "DeviceTrapError",
     "FaultInjector",
     "FaultStormError",
     "InjectedApiError",
     "ProgramPoisonedError",
+    "StallCancelledError",
     "classify",
     "degraded",
     "degraded_mode",
@@ -51,4 +65,5 @@ __all__ = [
     "install",
     "metrics",
     "uninstall",
+    "watchdog",
 ]

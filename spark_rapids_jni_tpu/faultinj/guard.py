@@ -17,8 +17,8 @@ port, applied uniformly at every dispatch surface:
                                 memory.retry.with_retry or the
                                 TaskExecutor ladder roll back + retry)
   TRANSIENT (UNAVAILABLE /      bounded exponential backoff with jitter,
-  DEADLINE / InjectedApiError)  retried in place; FaultStormError after
-                                ``faultinj.max_transient_retries``
+  plain ABORTED /               retried in place; FaultStormError after
+  InjectedApiError)             ``faultinj.max_transient_retries``
   POISON (DeviceTrapError /     current program is poisoned: bounded
   DeviceAssertError)            re-dispatch (``faultinj.max_poison_
                                 redispatch``), then the error propagates
@@ -30,6 +30,13 @@ port, applied uniformly at every dispatch surface:
                                 propagate for discard-and-reconstruct
                                 from source (re-read / re-exchange /
                                 re-materialize upstream)
+  STALL (DeadlineExceeded /     the call outlived its time budget or was
+  StallCancelled /              cancelled by the hang watchdog
+  DEADLINE_EXCEEDED /           (faultinj/watchdog.py): bounded
+  ABORTED-with-timeout)         re-dispatch (``watchdog.max_stall_
+                                retries``) while deadline budget remains,
+                                else propagate into the cancellation →
+                                degradation → worker-lost ladder
   FATAL (everything else)       propagate unchanged
   ============================  =======================================
 
@@ -71,6 +78,7 @@ from typing import Any, Callable, Dict
 
 from ..memory.exceptions import OffHeapOOM, TpuOOM, TpuRetryOOM
 from ..utils.tracing import trace_range
+from . import watchdog
 from .injector import (
     DeviceAssertError,
     DeviceTrapError,
@@ -84,6 +92,7 @@ RESOURCE_EXHAUSTED = "resource_exhausted"
 TRANSIENT = "transient"
 POISON = "poison"
 CORRUPTION = "corruption"
+STALL = "stall"
 FATAL = "fatal"
 
 # substrings of real runtime-error messages that mark a domain. XLA/PJRT
@@ -92,8 +101,7 @@ FATAL = "fatal"
 # PJRT C API, "Resource exhausted: ..." / "Unavailable:" from the status
 # formatting path), so matching is case-insensitive: every variant of a
 # status must land in the same fault domain.
-_TRANSIENT_MARKERS = ("unavailable", "deadline_exceeded", "deadline",
-                      "aborted")
+_TRANSIENT_MARKERS = ("unavailable", "aborted")
 _EXHAUSTED_MARKERS = ("resource_exhausted", "resource exhausted",
                       "out_of_memory", "out of memory")
 # real-runtime corruption spellings: gRPC DATA_LOSS statuses, plus the
@@ -101,6 +109,11 @@ _EXHAUSTED_MARKERS = ("resource_exhausted", "resource exhausted",
 # (corruption)") — both mean the payload bytes are wrong, not the call
 _CORRUPTION_MARKERS = ("data_loss", "data loss", "crc mismatch",
                        "(corruption)")
+# a DEADLINE_EXCEEDED status (either spelling) means the call outlived a
+# time budget — the hang watchdog's domain, not a plain transient retry;
+# ABORTED joins it only when the text says the abort was a timeout
+_STALL_MARKERS = ("deadline_exceeded", "deadline exceeded", "deadline")
+_TIMEOUT_WORDS = ("timeout", "timed out")
 
 
 class FaultStormError(RuntimeError):
@@ -133,6 +146,9 @@ def classify(exc: BaseException) -> str:
     from ..memory.integrity import CorruptionError
     if isinstance(exc, CorruptionError):
         return CORRUPTION
+    if isinstance(exc, (watchdog.DeadlineExceededError,
+                        watchdog.StallCancelledError)):
+        return STALL
     if isinstance(exc, (TpuOOM, OffHeapOOM, MemoryError)):
         return RESOURCE_EXHAUSTED
     if isinstance(exc, (DeviceTrapError, DeviceAssertError)):
@@ -148,6 +164,10 @@ def classify(exc: BaseException) -> str:
             return RESOURCE_EXHAUSTED
         if any(m in msg for m in _CORRUPTION_MARKERS):
             return CORRUPTION
+        if any(m in msg for m in _STALL_MARKERS):
+            return STALL
+        if "aborted" in msg and any(w in msg for w in _TIMEOUT_WORDS):
+            return STALL  # ABORTED raised *because* a wait timed out
         if any(m in msg for m in _TRANSIENT_MARKERS):
             return TRANSIENT
     return FATAL
@@ -165,7 +185,10 @@ class FaultDomainMetrics:
     _FIELDS = ("guarded_calls", "injected_faults", "transient_retries",
                "backoff_time_ns", "poisoned_programs", "redispatches",
                "resource_exhausted", "degradations", "task_retries",
-               "corruption_detected", "quarantined_buffers")
+               "corruption_detected", "quarantined_buffers",
+               "injected_delays", "deadline_exceeded", "stall_detected",
+               "stall_cancelled", "stall_retries", "diagnostics_bundles",
+               "workers_lost")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -230,7 +253,8 @@ def _limits():
     return (int(config.get("faultinj.max_transient_retries")),
             float(config.get("faultinj.backoff_base_s")),
             float(config.get("faultinj.backoff_max_s")),
-            int(config.get("faultinj.max_poison_redispatch")))
+            int(config.get("faultinj.max_poison_redispatch")),
+            int(config.get("watchdog.max_stall_retries")))
 
 
 def guarded_dispatch(api_name: str, fn: Callable[..., Any], *args,
@@ -246,61 +270,95 @@ def guarded_dispatch(api_name: str, fn: Callable[..., Any], *args,
     fatal errors propagate. ``fn`` must be effect-free up to its return
     value (true of every guarded surface: pure dispatches and idempotent
     transfers), since recovery re-runs it.
+
+    Deadline/watchdog integration (faultinj/watchdog.py): every attempt
+    registers an in-flight record (the watchdog's per-dispatch heartbeat)
+    and starts with a cooperative checkpoint, so a cancel or an expired
+    deadline surfaces at the retry boundary; backoff sleeps are
+    cancellable and capped by the remaining budget. STALL-classified
+    failures re-dispatch at most ``watchdog.max_stall_retries`` times
+    while budget remains, then propagate to the degradation ladder.
     """
-    max_transient, base_s, cap_s, max_poison = _limits()
+    max_transient, base_s, cap_s, max_poison, max_stall = _limits()
     metrics.bump("guarded_calls")
     inj = get_injector()
     suppressed = degraded_mode()
     transient_seen = 0
     poison_seen = 0
-    while True:
-        try:
-            if inj is not None and not suppressed:
-                inj.check(api_name)
-            return fn(*args, **kwargs)
-        except BaseException as e:  # noqa: BLE001 — classified below
-            domain = classify(e)
-            injected = isinstance(
-                e, (InjectedApiError, DeviceTrapError, DeviceAssertError))
-            if injected:
-                metrics.bump("injected_faults")
-            if domain == RESOURCE_EXHAUSTED:
-                metrics.bump("resource_exhausted")
-                if isinstance(e, (TpuOOM, OffHeapOOM)):
-                    raise  # already speaks the retry protocol's taxonomy
-                # a real runtime OOM (XLA RESOURCE_EXHAUSTED) enters the
-                # same rollback/split protocol as a reservation denial
-                raise TpuRetryOOM(
-                    f"{api_name}: {type(e).__name__}: {e}") from e
-            if domain == TRANSIENT:
-                transient_seen += 1
-                if transient_seen > max_transient:
-                    raise FaultStormError(api_name, transient_seen - 1,
-                                          e) from e
-                delay = _backoff_s(transient_seen - 1, base_s, cap_s)
-                metrics.bump("transient_retries")
-                metrics.bump("backoff_time_ns", int(delay * 1e9))
-                with trace_range(f"fault:transient:{api_name}"):
-                    if delay:
-                        time.sleep(delay)
-                continue
-            if domain == POISON:
-                poison_seen += 1
-                metrics.bump("poisoned_programs")
-                if poison_seen > max_poison:
-                    raise ProgramPoisonedError(api_name, poison_seen - 1,
-                                               e) from e
-                metrics.bump("redispatches")
-                with trace_range(f"fault:redispatch:{api_name}"):
-                    pass
-                continue
-            if domain == CORRUPTION:
-                # never retry-in-place: the corrupted copy would simply be
-                # re-verified (and re-fail) — count the detection and hand
-                # the error up for discard-and-reconstruct (TaskExecutor
-                # re-materializes from source; readers re-read the file)
-                metrics.bump("corruption_detected")
-                with trace_range(f"fault:corruption:{api_name}"):
-                    pass
-                raise
-            raise  # FATAL
+    stall_seen = 0
+    with watchdog.ensure_deadline(f"dispatch:{api_name}"):
+        while True:
+            handle = watchdog.begin_dispatch(api_name)
+            try:
+                watchdog.checkpoint()  # cancel/deadline at retry boundary
+                if inj is not None and not suppressed:
+                    inj.check(api_name)
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                domain = classify(e)
+                injected = isinstance(
+                    e, (InjectedApiError, DeviceTrapError,
+                        DeviceAssertError))
+                if injected:
+                    metrics.bump("injected_faults")
+                if domain == RESOURCE_EXHAUSTED:
+                    metrics.bump("resource_exhausted")
+                    if isinstance(e, (TpuOOM, OffHeapOOM)):
+                        raise  # already speaks the retry protocol's
+                        # taxonomy
+                    # a real runtime OOM (XLA RESOURCE_EXHAUSTED) enters
+                    # the same rollback/split protocol as a denial
+                    raise TpuRetryOOM(
+                        f"{api_name}: {type(e).__name__}: {e}") from e
+                if domain == TRANSIENT:
+                    transient_seen += 1
+                    if transient_seen > max_transient:
+                        raise FaultStormError(api_name, transient_seen - 1,
+                                              e) from e
+                    delay = _backoff_s(transient_seen - 1, base_s, cap_s)
+                    delay = watchdog.derive_timeout(delay) or 0.0
+                    metrics.bump("transient_retries")
+                    metrics.bump("backoff_time_ns", int(delay * 1e9))
+                    with trace_range(f"fault:transient:{api_name}"):
+                        if delay:
+                            watchdog.deadline_sleep(delay)
+                    continue
+                if domain == POISON:
+                    poison_seen += 1
+                    metrics.bump("poisoned_programs")
+                    if poison_seen > max_poison:
+                        raise ProgramPoisonedError(api_name,
+                                                   poison_seen - 1,
+                                                   e) from e
+                    metrics.bump("redispatches")
+                    with trace_range(f"fault:redispatch:{api_name}"):
+                        pass
+                    continue
+                if domain == CORRUPTION:
+                    # never retry-in-place: the corrupted copy would
+                    # simply be re-verified (and re-fail) — count the
+                    # detection and hand the error up for discard-and-
+                    # reconstruct (TaskExecutor re-materializes from
+                    # source; readers re-read the file)
+                    metrics.bump("corruption_detected")
+                    with trace_range(f"fault:corruption:{api_name}"):
+                        pass
+                    raise
+                if domain == STALL:
+                    # a cancelled dispatch or spent budget cannot be
+                    # retried in place; an RPC-level DEADLINE_EXCEEDED
+                    # while the task still has budget gets a bounded
+                    # re-dispatch
+                    stall_seen += 1
+                    dl = watchdog.current_deadline()
+                    spent = dl is not None and (dl.token.cancelled()
+                                                or dl.expired())
+                    if spent or stall_seen > max_stall:
+                        raise
+                    metrics.bump("stall_retries")
+                    with trace_range(f"fault:stall:{api_name}"):
+                        pass
+                    continue
+                raise  # FATAL
+            finally:
+                watchdog.end_dispatch(handle)

@@ -20,8 +20,12 @@ so reference configs can be reused verbatim. injectionType: 0 = device trap,
 1 = device assert, 2 = substitute return code, 3 = payload bit-flip (XOR a
 random bit of a transiting buffer — fired via the payload-aware hooks in
 memory/integrity.py at the spill/disk/exchange/parquet surfaces, never via
-``check``, since an API-entry checkpoint has no buffer). ``interceptionCount`` bounds
-how many consecutive matched calls are sampled; ``percent`` is the
+``check``, since an API-entry checkpoint has no buffer), 4 = delay/hang
+(sleep ``delayMs`` milliseconds at the call site, or hang until the watchdog
+cancels when ``delayMs`` is negative — executed by
+``faultinj.watchdog.injected_delay`` outside the injector lock so a hung
+surface never wedges other threads' rule checks). ``interceptionCount``
+bounds how many consecutive matched calls are sampled; ``percent`` is the
 per-sample probability. ``dynamic: true`` re-reads the config when its
 mtime changes (the reference uses an inotify thread; polling on call entry
 is equivalent for a shim).
@@ -62,20 +66,29 @@ class _Rule:
         self.injection_type = int(cfg.get("injectionType", 0))
         self.count_remaining = int(cfg.get("interceptionCount", 0))
         self.substitute = int(cfg.get("substituteReturnCode", 0))
+        # injectionType 4: sleep this long at the call site; < 0 = hang
+        # until the watchdog cancels (faultinj/watchdog.py)
+        self.delay_ms = float(cfg.get("delayMs", 0))
 
-    def maybe_fire(self, api: str, rng: random.Random):
+    def maybe_fire(self, api: str, rng: random.Random) -> Optional[float]:
+        """Sample one matched call. Types 0-2 raise; type 4 returns the
+        delay in seconds for the caller to execute OUTSIDE the injector
+        lock (a hang held under the lock would wedge every other thread's
+        rule check); None = nothing fired."""
         if self.injection_type == 3:
-            return  # payload bit-flips fire via bitflip_rng, which owns
-            # the budget — an exception checkpoint has no buffer to flip
+            return None  # payload bit-flips fire via bitflip_rng, which
+            # owns the budget — an exception checkpoint has no buffer
         if self.count_remaining <= 0:
-            return
+            return None
         self.count_remaining -= 1
         if rng.uniform(0, 100) >= self.percent:
-            return
+            return None
         if self.injection_type == 0:
             raise DeviceTrapError(f"injected trap at {api}")
         if self.injection_type == 1:
             raise DeviceAssertError(f"injected device assert at {api}")
+        if self.injection_type == 4:
+            return -1.0 if self.delay_ms < 0 else self.delay_ms / 1000.0
         raise InjectedApiError(self.substitute, api)
 
 
@@ -130,13 +143,17 @@ class FaultInjector:
     # -- interception ---------------------------------------------------
 
     def check(self, api: str):
-        """Consult the rules for one API call (may raise)."""
+        """Consult the rules for one API call (may raise, may block on an
+        injectionType 4 delay/hang — the block happens outside the lock)."""
         self._maybe_reload()
         with self._lock:
             rule = self._rules.get(api) or self._rules.get("*")
             if rule is None:
                 return
-            rule.maybe_fire(api, self._rng)
+            delay_s = rule.maybe_fire(api, self._rng)
+        if delay_s is not None:
+            from . import watchdog
+            watchdog.injected_delay(api, delay_s)
 
     def bitflip_rng(self, api: str) -> Optional[random.Random]:
         """injectionType 3 sampling for one payload-bearing call: when a

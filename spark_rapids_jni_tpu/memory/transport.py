@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..columnar.column import Column, Table
+from ..faultinj import watchdog
 from ..utils.tracing import trace_range
 from .integrity import (
     CorruptionError,
@@ -68,7 +70,13 @@ def to_device(obj):
     import jax.numpy as jnp
 
     if isinstance(obj, Table):
-        return Table(tuple(to_device(c) for c in obj.columns))
+        cols = []
+        for c in obj.columns:
+            # per-column chunk boundary: a cancelled/expired deadline
+            # stops a multi-column upload between columns, not mid-copy
+            watchdog.checkpoint()
+            cols.append(to_device(c))
+        return Table(tuple(cols))
     c: Column = obj
     # children upload (and guard) individually, BEFORE this column's own
     # guarded transfer — a retry re-runs one column's upload, not a subtree
@@ -92,7 +100,11 @@ def to_host(obj):
     buffer). The result is still a Column/Table; ops that need device data
     will transfer back, so use this only at spill/IO boundaries."""
     if isinstance(obj, Table):
-        return Table(tuple(to_host(c) for c in obj.columns))
+        cols = []
+        for c in obj.columns:
+            watchdog.checkpoint()  # chunk boundary, same as to_device
+            cols.append(to_host(c))
+        return Table(tuple(cols))
     c: Column = obj
     children = tuple(to_host(ch) for ch in c.children)
 
@@ -339,6 +351,7 @@ class SpillStore:
             # crash mid-run leaves complete-but-ownerless spill files; both
             # are dead weight — their tables re-materialize from upstream
             self.recovered_files = clean_spill_dir(self._disk_dir)
+        _STORES.add(self)  # weak: the watchdog's stall bundles snapshot us
 
     def _touch(self, st: SpillableTable) -> None:
         with self._lock:
@@ -417,3 +430,51 @@ class SpillStore:
         def rollback():
             self.spill_all()
         return rollback
+
+    def state(self) -> Dict[str, Any]:
+        """One store's live summary for a watchdog diagnostics bundle:
+        table count per tier plus byte totals — enough to tell a
+        spill-storm stall from a wedged transfer at a glance.
+
+        Runs on the watchdog thread at the moment of a stall, so it must
+        NEVER block: a wedged spill/promote holds its table's lock, and a
+        blocking read here would make the diagnostics join the very
+        deadlock they are documenting. A table whose lock is busy is
+        reported under tier "busy" with its bytes skipped."""
+        with self._lock:
+            entries = [st for _, st in self._entries.values()]
+        tiers = {SpillableTable.DEVICE: 0, SpillableTable.HOST: 0,
+                 SpillableTable.DISK: 0, SpillableTable.QUARANTINED: 0,
+                 "busy": 0}
+        device_bytes = host_bytes = 0
+        for st in entries:
+            if not st._lock.acquire(blocking=False):
+                tiers["busy"] += 1
+                continue
+            try:
+                tiers[st._state] += 1
+                if st._state == SpillableTable.DEVICE:
+                    device_bytes += st._table.device_nbytes()
+                elif st._state == SpillableTable.HOST:
+                    host_bytes += _host_table_nbytes(st._table)
+            finally:
+                st._lock.release()
+        return {
+            "tables": len(entries),
+            "tiers": tiers,
+            "device_bytes": device_bytes,
+            "host_bytes": host_bytes,
+            "host_limit_bytes": self._host_limit,
+            "disk_dir": self._disk_dir or None,
+            "recovered_files": self.recovered_files,
+        }
+
+
+# live stores, weakly held: a stall's diagnostics bundle snapshots every
+# store still alive without keeping closed ones reachable
+_STORES: "weakref.WeakSet[SpillStore]" = weakref.WeakSet()
+
+
+def spill_state() -> List[Dict[str, Any]]:
+    """Summaries of every live SpillStore (watchdog diagnostics bundles)."""
+    return [s.state() for s in list(_STORES)]

@@ -552,6 +552,7 @@ def hash_partition_exchange(
     # staging transfers run under the supervisor too ("exchange_stage"):
     # a device_put can hit RESOURCE_EXHAUSTED/UNAVAILABLE exactly like a
     # program launch, and must classify into the same recovery domains
+    from ..faultinj import watchdog
     from ..faultinj.guard import guarded_dispatch
 
     def _stage(a: jnp.ndarray) -> jnp.ndarray:
@@ -575,10 +576,16 @@ def hash_partition_exchange(
         dest_d, live_d)).reshape(nd, nd)
     ragged, cap, caps = _exchange_plan(counts_mat, nd)
 
+    # stage boundary: the sizing sync above is the exchange's first
+    # blocking collective — a cancelled/expired deadline stops here
+    # rather than launching the (much larger) all_to_all
+    watchdog.checkpoint()
+
     buffers: List[jnp.ndarray] = []
     metas = []
     spans: List[Tuple[int, int]] = []
     for col in table.columns:
+        watchdog.checkpoint()  # per-column staging chunk boundary
         bufs, meta = _col_to_buffers(col)
         spans.append((len(buffers), len(buffers) + len(bufs)))
         buffers.extend(_stage(_pad(b)) for b in bufs)
@@ -624,6 +631,10 @@ def hash_partition_exchange(
             _EXCHANGE_CACHE[sig] = program
         out = guarded_dispatch("exchange_alltoall", program, dest_d, live_d,
                                *extra, *buffers)
+
+    # stage boundary: collective launched; stop before the rebuild if the
+    # deadline died while it ran
+    watchdog.checkpoint()
 
     mismatch_d = None
     if verify:

@@ -27,12 +27,13 @@ Usage::
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
-from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, Dict, List, Optional
 
-from ..faultinj import guard
+from ..faultinj import guard, watchdog
 from ..faultinj.injector import DeviceAssertError, DeviceTrapError
 from ..memory.exceptions import (
     CpuRetryOOM,
@@ -51,6 +52,12 @@ _SENTINEL = object()
 _DEVICE_FAILURES = (DeviceTrapError, DeviceAssertError,
                     guard.FaultStormError, guard.ProgramPoisonedError)
 
+# stall verdicts from the deadline/watchdog subsystem: the task's budget
+# expired or the watchdog cancelled it mid-dispatch — same ladder as a
+# device failure (a wedged device and a trapped one are equally unhealthy)
+_STALL_FAILURES = (watchdog.DeadlineExceededError,
+                   watchdog.StallCancelledError)
+
 
 class _TaskWorker:
     """Dedicated worker thread for one task id (the reference's
@@ -65,11 +72,17 @@ class _TaskWorker:
     counter recording the downgrade.
     """
 
-    def __init__(self, task_id: int, register: bool, spill_store=None):
+    def __init__(self, task_id: int, register: bool, spill_store=None,
+                 on_lost=None):
         self.task_id = task_id
         self.degraded = False
+        # set by the watchdog's lost-worker path: the thread ignored a
+        # cancel past watchdog.lost_after_s; exit as soon as it wakes
+        self.lost = False
         self._register = register
         self._spill_store = spill_store
+        self._on_lost = on_lost
+        self._current = None  # the item being executed (requeue on lost)
         self._q: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
             target=self._run, name=f"task-exec-{task_id}", daemon=True)
@@ -89,23 +102,77 @@ class _TaskWorker:
                 # the retry budget still bounds the loop
                 pass
 
-    def _supervise(self, fn, args, kwargs):
+    def _attempt_deadline(self, snap, stalled: bool):
+        """Deadline context for one supervised attempt.
+
+        First attempts adopt the submitter's snapshot (absolute expiry:
+        queue time counts) or arm ``task.budget_s``. After a stall the
+        prior budget is spent and its token cancelled, so a retry must run
+        under a FRESH deadline or it would fail at the first checkpoint —
+        the per-attempt ``with`` has already exited by then, so the fresh
+        deadline never nests with (and never inherits) the expired one.
+        """
+        from ..utils import config
+        budget_s = float(config.get("task.budget_s"))
+        what = f"task{self.task_id}"
+        if stalled:
+            fresh = budget_s if budget_s > 0 else (snap[0] if snap else 0.0)
+            if fresh > 0:
+                return watchdog.Deadline(fresh, what)
+            return contextlib.nullcontext()
+        if snap is not None:
+            return watchdog.Deadline.adopt(snap)
+        if budget_s > 0:
+            return watchdog.Deadline(budget_s, what)
+        return contextlib.nullcontext()
+
+    def _run_attempt(self, fn, args, kwargs, label):
+        """One attempt, registered with the watchdog as a task-body
+        dispatch — a stall in unguarded code (a wedged relay, a plain
+        sleep) is still detected, diagnosed, and cancelled."""
+        handle = watchdog.begin_dispatch(f"task{self.task_id}:{label}")
+        try:
+            if self.degraded:
+                with guard.degraded(), \
+                        trace_range(f"task{self.task_id}:degraded:"
+                                    f"{label}"):
+                    return fn(*args, **kwargs)
+            with trace_range(f"task{self.task_id}:{label}"):
+                return fn(*args, **kwargs)
+        finally:
+            watchdog.end_dispatch(handle)
+
+    def _supervise(self, fn, args, kwargs, snap=None):
         """Run one submission under the per-task retry/degradation ladder."""
         from ..utils import config
         budget = int(config.get("task.retry_budget"))
         degrade_after = int(config.get("task.degrade_after"))
         attempts = 0
         device_failures = 0
+        stalled = False
         label = getattr(fn, "__name__", None) or repr(fn)
         while True:
             try:
-                if self.degraded:
-                    with guard.degraded(), \
-                            trace_range(f"task{self.task_id}:degraded:"
-                                        f"{label}"):
-                        return fn(*args, **kwargs)
-                with trace_range(f"task{self.task_id}:{label}"):
-                    return fn(*args, **kwargs)
+                with self._attempt_deadline(snap, stalled):
+                    return self._run_attempt(fn, args, kwargs, label)
+            except _STALL_FAILURES:
+                # the budget expired or the watchdog cancelled us: same
+                # ladder as a device failure (degrade, then give up), but
+                # flag the stall so the next attempt gets a fresh budget
+                stalled = True
+                attempts += 1
+                device_failures += 1
+                if (degrade_after > 0 and not self.degraded
+                        and device_failures >= degrade_after):
+                    self.degraded = True
+                    guard.metrics.bump("degradations")
+                    with trace_range(f"task{self.task_id}:degrade"):
+                        pass
+                    continue  # the downgrade itself is not a retry spend
+                if attempts > budget:
+                    raise
+                guard.metrics.bump("task_retries")
+                self._rollback()
             except (TpuRetryOOM, CpuRetryOOM):
                 # memory pressure: not a device-health signal — rollback
                 # and retry under the budget (split escalation is the
@@ -143,6 +210,20 @@ class _TaskWorker:
                 guard.metrics.bump("task_retries")
                 self._rollback()
 
+    def _resolve(self, fut: Future, value, exc) -> None:
+        """Resolve a future that the lost-worker path may have resolved
+        first (the re-queued attempt races a wedged original that finally
+        woke up — first writer wins, the loser's outcome is dropped)."""
+        if fut.done():
+            return
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except InvalidStateError:
+            pass
+
     def _run(self):
         registered = False
         if self._register:
@@ -151,18 +232,37 @@ class _TaskWorker:
                 registered = True
             except RuntimeError:
                 pass  # no event handler installed: ops run ungoverned
+        if self._on_lost is not None:
+            watchdog.set_lost_handler(lambda: self._on_lost(self))
         try:
             while True:
-                item = self._q.get()
+                if self.lost:
+                    break  # retired by the watchdog; a fresh worker owns
+                    # the queue's remaining items now
+                try:
+                    # bounded get: a lost worker that wakes mid-idle still
+                    # notices within one poll (SRJT009: no unbounded waits
+                    # on dispatch surfaces)
+                    item = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
                 if item is _SENTINEL:
                     break
-                fut, fn, args, kwargs = item
-                if not fut.set_running_or_notify_cancel():
+                fut, fn, args, kwargs, snap, requeues = item
+                if requeues == 0 and not fut.set_running_or_notify_cancel():
                     continue
+                if fut.done():
+                    continue  # lost path already failed it
+                self._current = item
                 try:
-                    fut.set_result(self._supervise(fn, args, kwargs))
-                except BaseException as e:  # noqa: BLE001 — to the future
-                    fut.set_exception(e)
+                    try:
+                        result = self._supervise(fn, args, kwargs, snap)
+                    except BaseException as e:  # noqa: BLE001 — future
+                        self._resolve(fut, None, e)
+                    else:
+                        self._resolve(fut, result, None)
+                finally:
+                    self._current = None
         finally:
             if registered:
                 try:
@@ -170,9 +270,9 @@ class _TaskWorker:
                 except RuntimeError:
                     pass
 
-    def submit(self, fn, args, kwargs) -> Future:
+    def submit(self, fn, args, kwargs, snap=None) -> Future:
         fut: Future = Future()
-        self._q.put((fut, fn, args, kwargs))
+        self._q.put((fut, fn, args, kwargs, snap, 0))
         return fut
 
     def stop(self):
@@ -206,6 +306,10 @@ class TaskExecutor:
         # _workers but their task not yet marked done — close() gives
         # them a second chance so the scheduler slot isn't leaked
         self._zombies: Dict[int, _TaskWorker] = {}
+        # workers the watchdog declared lost (cancel ignored past
+        # watchdog.lost_after_s): replaced in _workers by a fresh worker,
+        # joined best-effort at close() if they ever wake
+        self._lost: List[_TaskWorker] = []
         self._lock = threading.Lock()
         self._mark_done = mark_tasks_done
         self._spill_store = spill_store
@@ -219,6 +323,10 @@ class TaskExecutor:
 
     def submit(self, task_id: int, fn: Callable[..., Any], *args,
                **kwargs) -> Future:
+        # capture the submitter's deadline (if any) so the worker thread
+        # runs the task body under the same absolute budget + cancel token
+        dl = watchdog.current_deadline()
+        snap = dl.snapshot() if dl is not None else None
         with self._lock:
             if self._closed:
                 raise RuntimeError("TaskExecutor is closed")
@@ -226,12 +334,76 @@ class TaskExecutor:
             if w is None:
                 register = RmmSpark.is_installed()
                 w = _TaskWorker(task_id, register,
-                                spill_store=self._spill_store)
+                                spill_store=self._spill_store,
+                                on_lost=self._worker_lost)
                 self._workers[task_id] = w
             # enqueue under the lock: a concurrent task_done()/close() could
             # otherwise slip its stop sentinel ahead of this item and leave
             # the returned Future pending forever
-            return w.submit(fn, args, kwargs)
+            return w.submit(fn, args, kwargs, snap)
+
+    def _worker_lost(self, worker: _TaskWorker):
+        """Watchdog callback (runs on the watchdog thread): ``worker``
+        ignored its cooperative cancel past ``watchdog.lost_after_s`` —
+        the final rung of the escalation ladder. Retire it, re-queue its
+        in-flight submission on a fresh worker (degraded: the lost
+        worker's surface is presumed wedged, the retry takes the host
+        path) against ``task.retry_budget``, and migrate any queued items.
+        Consistent with ``task_done`` zombie tracking: the lost worker is
+        joined best-effort at close() and its task is only marked done via
+        its replacement."""
+        from ..utils import config
+        worker.lost = True
+        with self._lock:
+            if self._workers.get(worker.task_id) is not worker:
+                return  # already replaced (duplicate lost-fire guard)
+            del self._workers[worker.task_id]
+            self._lost.append(worker)
+            item = worker._current
+            pending = []
+            while True:
+                try:
+                    pending.append(worker._q.get_nowait())
+                except queue.Empty:
+                    break
+            pending = [it for it in pending if it is not _SENTINEL]
+            budget = int(config.get("task.retry_budget"))
+            requeue = None
+            if item is not None and not item[0].done():
+                fut, fn, args, kwargs, snap, requeues = item
+                if requeues + 1 > budget:
+                    self._fail(fut, watchdog.StallCancelledError(
+                        f"task {worker.task_id} worker declared lost; "
+                        f"retry budget ({budget}) exhausted"))
+                else:
+                    # the old snapshot's budget is spent and its token
+                    # cancelled — the retry arms task.budget_s afresh
+                    requeue = (fut, fn, args, kwargs, None, requeues + 1)
+            if requeue is None and not pending:
+                return
+            if self._closed:
+                orphans = pending if requeue is None else [requeue] + pending
+                for it in orphans:
+                    self._fail(it[0], RuntimeError(
+                        "TaskExecutor closed while its worker was lost"))
+                return
+            w = _TaskWorker(worker.task_id, RmmSpark.is_installed(),
+                            spill_store=self._spill_store,
+                            on_lost=self._worker_lost)
+            w.degraded = True
+            self._workers[worker.task_id] = w
+            if requeue is not None:
+                w._q.put(requeue)
+            for it in pending:
+                w._q.put(it)
+
+    @staticmethod
+    def _fail(fut: Future, exc: BaseException):
+        if not fut.done():
+            try:
+                fut.set_exception(exc)
+            except InvalidStateError:
+                pass
 
     def task_done(self, task_id: int, timeout: Optional[float] = 30.0):
         """Drain and retire one task's worker (Spark task completion).
@@ -245,6 +417,9 @@ class TaskExecutor:
             if w is None:
                 return
             w.stop()
+        # an active Deadline bounds the drain too (the join's budget is
+        # whatever the caller's task has left)
+        timeout = watchdog.derive_timeout(timeout)
         if w.join(timeout):
             self._mark_task_done(task_id)
         else:
@@ -272,12 +447,19 @@ class TaskExecutor:
             # threads may have exited since, so try to retire them too
             zombies = dict(self._zombies)
             self._zombies.clear()
+            lost = list(self._lost)
+            self._lost.clear()
+        timeout = watchdog.derive_timeout(timeout)
         for task_id, w in workers.items():
             if w.join(timeout):
                 self._mark_task_done(task_id)
         for task_id, w in zombies.items():
             if w.join(timeout):
                 self._mark_task_done(task_id)
+        for w in lost:
+            # best-effort only — a truly wedged thread never joins, and
+            # its task was already retired via its replacement worker
+            w.join(timeout)
 
     def __enter__(self):
         return self

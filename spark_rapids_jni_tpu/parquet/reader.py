@@ -18,6 +18,7 @@ Decode validation is against pyarrow in tests/test_parquet_decode.py.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import threading
@@ -30,6 +31,7 @@ import numpy as np
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
+from ..faultinj import watchdog
 from ..memory.reservation import device_reservation, release_barrier
 
 _lock = threading.Lock()
@@ -385,6 +387,7 @@ class ParquetReader:
         off, length, _, _ = self._chunk_range(rg, leaf.index)
         last: Optional[CorruptionError] = None
         for _attempt in range(1 + self._CRC_REREADS):
+            watchdog.checkpoint()  # re-read boundary: stop if cancelled
             f.seek(off)
             raw = f.read(length)
             buf = np.frombuffer(raw, dtype=np.uint8)
@@ -548,18 +551,28 @@ class ParquetReader:
 
         device_tier = self._device_tier_enabled()
 
+        # the caller's deadline rides into the pool threads: adopt() shares
+        # the absolute expiry and cancel token, so a decode hang inside a
+        # worker is registered with (and cancellable by) the watchdog
+        # instead of wedging a non-daemon pool thread forever
+        _dl = watchdog.current_deadline()
+        _snap = _dl.snapshot() if _dl is not None else None
+
         def decode_plan(plan: ColumnPlan):
-            want = plan.kind == "nested"
-            with open(self._path, "rb") as f:
-                if device_tier and plan.kind == "simple" \
-                        and plan.leaves[0].max_rep <= 1:
-                    dev = self._extract_leaf_pages(f, groups,
-                                                   plan.leaves[0])
-                    if dev is not None:
-                        return {"device": dev}
-                return {leaf.index: [self._decode_leaf(f, g, leaf, want)
-                                     for g in groups]
-                        for leaf in plan.leaves}
+            ctx = (watchdog.Deadline.adopt(_snap) if _snap is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                want = plan.kind == "nested"
+                with open(self._path, "rb") as f:
+                    if device_tier and plan.kind == "simple" \
+                            and plan.leaves[0].max_rep <= 1:
+                        dev = self._extract_leaf_pages(f, groups,
+                                                       plan.leaves[0])
+                        if dev is not None:
+                            return {"device": dev}
+                    return {leaf.index: [self._decode_leaf(f, g, leaf, want)
+                                         for g in groups]
+                            for leaf in plan.leaves}
 
         def ship(plan: ColumnPlan, by_leaf):
             if "device" in by_leaf:
@@ -600,13 +613,24 @@ class ParquetReader:
             for _ in range(workers):
                 admit()
             while futures:
-                done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                # bounded wait (SRJT009): the timeout derives from the
+                # active deadline's remaining budget, and an empty wake
+                # runs the cancel/deadline checkpoint — a wedged decode
+                # worker can no longer hang the whole read forever
+                done, _ = wait(list(futures),
+                               timeout=watchdog.derive_timeout(1.0),
+                               return_when=FIRST_COMPLETED)
+                if not done:
+                    watchdog.checkpoint()
+                    continue
                 # ship every completed plan (dropping its host buffers)
                 # BEFORE admitting replacements, so resident decoded bytes
                 # never exceed ~workers plans
                 for fut in done:
                     i, plan = futures.pop(fut)
-                    cols[i] = ship(plan, fut.result())
+                    # fut came from wait()'s done set: result() cannot
+                    # block here, it only unwraps
+                    cols[i] = ship(plan, fut.result())  # srjt: noqa[SRJT009]
                 for _ in range(len(done)):
                     admit()
         return Table(tuple(cols))

@@ -87,6 +87,32 @@ _register("faultinj.backoff_max_s", "SRJT_FAULT_BACKOFF_MAX_S", 0.25, float,
 _register("faultinj.max_poison_redispatch", "SRJT_FAULT_MAX_POISON", 2, int,
           "re-dispatches of a poisoned program (DeviceTrap/DeviceAssert) "
           "before ProgramPoisonedError reaches the degradation ladder")
+_register("watchdog.enabled", "SRJT_WATCHDOG_ENABLED", True, _parse_bool,
+          "hang watchdog: monitor in-flight guarded dispatches against "
+          "their deadlines; on a stall capture diagnostics + cancel "
+          "(faultinj/watchdog.py; ref: Spark task-level timeouts)")
+_register("watchdog.poll_period_s", "SRJT_WATCHDOG_POLL_PERIOD_S", 0.05,
+          float, "watchdog scan period for stalled dispatches")
+_register("watchdog.default_budget_s", "SRJT_WATCHDOG_DEFAULT_BUDGET_S",
+          0.0, float,
+          "implicit per-dispatch deadline when the caller carries none; "
+          "0 = only explicit Deadline contexts are enforced")
+_register("watchdog.diagnostics_dir", "SRJT_WATCHDOG_DIAG_DIR", "", str,
+          "directory for per-stall diagnostics bundles (JSON: all-thread "
+          "stacks, fault-domain metrics, active dispatch/spill/exchange "
+          "state); '' keeps bundles only in the in-memory ring")
+_register("watchdog.max_stall_retries", "SRJT_WATCHDOG_MAX_STALL_RETRIES",
+          1, int,
+          "re-dispatches of a STALL-classified failure (XLA "
+          "DEADLINE_EXCEEDED / ABORTED-timeout) while budget remains, "
+          "before the error propagates to the degradation ladder")
+_register("watchdog.lost_after_s", "SRJT_WATCHDOG_LOST_AFTER_S", 5.0,
+          float,
+          "grace after a cooperative cancel before a non-responding "
+          "worker thread is declared lost and its task re-queued")
+_register("task.budget_s", "SRJT_TASK_BUDGET_S", 0.0, float,
+          "per-submission wall-clock deadline for TaskExecutor task "
+          "bodies; 0 = inherit only the submitter's Deadline (if any)")
 _register("task.retry_budget", "SRJT_TASK_RETRY_BUDGET", 4, int,
           "TaskExecutor per-submission retry budget across all fault "
           "domains (rollback-to-spillable between attempts)")

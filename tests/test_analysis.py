@@ -503,6 +503,77 @@ def test_srjt008_counter_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT009 — unbounded blocking wait on a guarded/dispatch surface
+# ---------------------------------------------------------------------------
+
+SRC_009 = """
+    import threading
+
+    def drain(worker, ev, q):
+        worker.join()
+        ev.wait()
+        item = q.get()
+        return item
+"""
+
+
+def test_srjt009_triggers():
+    fs = run(SRC_009, path="pkg/task_executor.py")
+    assert rules_of(fs) == {"SRJT009"}
+    assert len(fs) == 3  # join + wait + queue get
+    assert any(".join()" in f.message for f in fs)
+    assert any(".wait()" in f.message for f in fs)
+    assert any("q.get()" in f.message for f in fs)
+
+
+def test_srjt009_scoped_to_dispatch_surfaces():
+    # the same waits elsewhere (ops, tests, utils) are not dispatch-path
+    # hangs and stay unflagged
+    assert run(SRC_009, path="pkg/sort.py") == []
+
+
+def test_srjt009_bounded_waits_ok():
+    assert run("""
+        def drain(worker, ev, q, futures, wait, derive_timeout):
+            worker.join(5.0)
+            ev.wait(timeout=derive_timeout(1.0))
+            item = q.get(timeout=0.5)
+            done, _ = wait(list(futures), timeout=1.0)
+            return item, done
+    """, path="pkg/transport.py") == []
+
+
+def test_srjt009_bare_wait_requires_timeout_kw():
+    # concurrent.futures.wait takes the futures positionally, so only an
+    # explicit timeout= keyword counts as bounded
+    fs = run("""
+        from concurrent.futures import wait
+
+        def f(futures):
+            done, _ = wait(list(futures))
+    """, path="pkg/reader.py")
+    assert rules_of(fs) == {"SRJT009"}
+
+
+def test_srjt009_lookup_gets_and_str_join_ok():
+    # dict/config .get() is a lookup, not a blocking wait; str.join takes
+    # its iterable positionally — neither may fire
+    assert run("""
+        def f(config, rules, parts):
+            a = config.get("trace.enabled")
+            b = rules.get("x")
+            return ",".join(parts), a, b
+    """, path="pkg/bridge.py") == []
+
+
+def test_srjt009_noqa():
+    assert run("""
+        def f(worker):
+            worker.join()  # srjt: noqa[SRJT009]
+    """, path="pkg/task_executor.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -522,7 +593,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 8
+    assert len(FILE_RULES) == 9
 
 
 def test_syntax_error_is_reported_not_raised():

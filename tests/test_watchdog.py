@@ -1,0 +1,502 @@
+"""Hang chaos: deadline propagation + watchdog escalation end to end.
+
+Mirror of test_chaos.py for the STALL fault domain: the same TPC-H-style
+pipeline runs under injectionType 4 (delay/hang) storms at 0% / 30% /
+100% rates. Finite delays inside the budget must be absorbed with
+bit-identical results; permanent hangs (delayMs < 0) must be DETECTED by
+the watchdog (stall_detected == injected hangs), DIAGNOSED (one bundle
+per stall, written to watchdog.diagnostics_dir), CANCELLED through the
+shared token, and RECOVERED from — retry/degradation still yields the
+fault-free answer. A worker that ignores the cancel past
+watchdog.lost_after_s is declared lost and its task re-queued on a fresh
+degraded worker. Every blocking surface participates: bridge dispatch,
+transport h2d/d2h/spill/unspill, the disk spill tier, the exchange
+collectives, and parquet page decode (including its pool threads, which
+adopt the caller's deadline).
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+from jax.sharding import Mesh
+
+from spark_rapids_jni_tpu import bridge
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.table_ops import gather_table
+from spark_rapids_jni_tpu.faultinj import guard, install, uninstall, watchdog
+from spark_rapids_jni_tpu.faultinj.watchdog import (
+    Deadline,
+    DeadlineExceededError,
+    StallCancelledError,
+)
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.memory.transport import (
+    SpillableTable,
+    SpillStore,
+    to_host,
+)
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.join import inner_join
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.parallel import hash_partition_exchange
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.parquet import read_parquet
+from spark_rapids_jni_tpu.utils import config
+
+pytestmark = pytest.mark.chaos
+
+N = 512
+
+# every injectable surface the chaos pipeline crosses (same set as
+# test_chaos._transient_cfg, now hit with delays/hangs instead of faults)
+DELAY_APIS = ("hash.murmur3", "h2d", "d2h", "spill", "unspill")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    watchdog.reset()
+    yield
+    uninstall()
+    watchdog.reset()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_watchdog():
+    # the real poll period trades latency for overhead; the tests only
+    # need ordering semantics, so poll fast and keep backoff near-zero
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002), \
+            config.override("watchdog.poll_period_s", 0.02):
+        yield
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "hangs.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def delay_cfg(percent, count, delay_ms, apis=DELAY_APIS):
+    """injectionType 4 (delay/hang) rules: delay_ms >= 0 sleeps that long
+    under the active deadline; delay_ms < 0 hangs until the watchdog
+    cancels the dispatch."""
+    rule = {"percent": percent, "injectionType": 4, "delayMs": delay_ms,
+            "interceptionCount": count}
+    return {"xlaRuntimeFaults": {api: dict(rule) for api in apis}}
+
+
+def hang_cfg(apis, count=1):
+    return delay_cfg(100, count, -1, apis)
+
+
+def metrics():
+    return RmmSpark.get_fault_domain_metrics()
+
+
+def _pipeline():
+    """Deterministic fact/dim pipeline over every guarded surface (same
+    body as test_chaos._pipeline: host values out, so equality between
+    runs is bit-equality)."""
+    rng = np.random.default_rng(7)
+    f_keys = rng.integers(0, 40, N).tolist()
+    f_vals = rng.integers(-1000, 1000, N).tolist()
+    d_keys = list(range(40))
+    d_pay = rng.integers(1, 9, 40).tolist()
+
+    fact = Table((Column.from_pylist(f_keys, dt.INT64),
+                  Column.from_pylist(f_vals, dt.INT64)))
+    dim = Table((Column.from_pylist(d_keys, dt.INT64),
+                 Column.from_pylist(d_pay, dt.INT64)))
+
+    hashed, _ = bridge.call("hash.murmur3", json.dumps({"seed": 42}),
+                            [bridge.col_to_wire(fact.columns[0])])
+
+    li, ri = inner_join([fact.columns[0]], [dim.columns[0]])
+    lt = gather_table(fact, li)
+    rt = gather_table(Table((dim.columns[1],)), ri)
+    joined = Table((lt.columns[0], lt.columns[1], rt.columns[0]))
+    agg = groupby_aggregate(joined, [0], [(1, "sum"), (2, "sum"),
+                                          (1, "count")])
+    out = sort_table(agg, [0])
+
+    store = SpillStore()
+    st = store.register(out)
+    st.spill()
+    out = st.get()
+
+    host = to_host(out)
+    return ([c.to_pylist() for c in host.columns], hashed)
+
+
+# ---------------------------------------------------------------------------
+# deadline primitives
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_raises_and_counts_once():
+    with Deadline(0.01, "unit") as dl:
+        time.sleep(0.03)
+        with pytest.raises(DeadlineExceededError):
+            watchdog.checkpoint()
+        with pytest.raises(DeadlineExceededError):
+            dl.check()
+    # deadline_exceeded counts deadlines, not checkpoints
+    assert metrics()["deadline_exceeded"] == 1
+
+
+def test_nested_deadline_tighter_wins_and_shares_token():
+    with Deadline(30, "outer") as outer:
+        with Deadline(0.05, "inner") as inner:
+            assert inner.token is outer.token
+            assert inner.expires_at <= outer.expires_at
+            assert watchdog.current_deadline() is inner
+        assert watchdog.current_deadline() is outer
+        # a wide nested budget never extends the enclosing one
+        with Deadline(3600, "wide") as wide:
+            assert wide.expires_at == outer.expires_at
+    assert watchdog.current_deadline() is None
+
+
+def test_snapshot_adopt_cross_thread_shares_expiry_and_token():
+    out = {}
+    with Deadline(0.25, "origin") as dl:
+        snap = dl.snapshot()
+
+        def worker():
+            with Deadline.adopt(snap) as adopted:
+                out["expires_at"] = adopted.expires_at
+                out["token"] = adopted.token
+                out["left"] = adopted.remaining()
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    assert out["expires_at"] == dl.expires_at  # absolute: queue time counts
+    assert out["token"] is dl.token            # one cancel reaches both
+    assert out["left"] <= 0.25
+
+
+def test_derive_timeout_is_min_of_default_and_remaining():
+    assert watchdog.derive_timeout(12.5) == 12.5  # no deadline: passthrough
+    assert watchdog.derive_timeout(None) is None
+    with Deadline(0.5, "t"):
+        assert 0 < watchdog.derive_timeout(30) <= 0.5
+        assert 0 < watchdog.derive_timeout(None) <= 0.5
+    with Deadline(0.0, "spent"):
+        # floored at zero: an expired deadline polls, it never blocks
+        assert watchdog.derive_timeout(30) == 0.0
+
+
+def test_deadline_sleep_interrupted_by_cancel():
+    with Deadline(30, "sleeper") as dl:
+        threading.Timer(0.05, lambda: dl.token.cancel("test cancel")).start()
+        t0 = time.monotonic()
+        with pytest.raises(StallCancelledError):
+            watchdog.deadline_sleep(10)
+        assert time.monotonic() - t0 < 5
+
+
+# ---------------------------------------------------------------------------
+# STALL classification
+# ---------------------------------------------------------------------------
+
+def test_classify_routes_stalls_not_transients():
+    assert guard.classify(DeadlineExceededError("x", 1.0)) == guard.STALL
+    assert guard.classify(StallCancelledError("y")) == guard.STALL
+    assert guard.classify(
+        RuntimeError("XLA: DEADLINE_EXCEEDED: collective wait")) == guard.STALL
+    assert guard.classify(
+        RuntimeError("Deadline Exceeded while awaiting")) == guard.STALL
+    # ABORTED raised *because* a wait timed out is a stall...
+    assert guard.classify(
+        RuntimeError("ABORTED: collective timed out")) == guard.STALL
+    # ...but a plain ABORTED is still the retryable transient domain
+    assert guard.classify(
+        RuntimeError("ABORTED: link flap")) == guard.TRANSIENT
+
+
+def test_rpc_deadline_exceeded_retries_in_place_with_budget_left():
+    """An RPC-level DEADLINE_EXCEEDED while the task still has budget gets
+    a bounded re-dispatch (stall_retries), not a task failure."""
+    calls = []
+
+    def flaky():
+        if not calls:
+            calls.append(1)
+            raise RuntimeError("DEADLINE_EXCEEDED: collective permute "
+                               "timed out")
+        return "ok"
+
+    with Deadline(30, "rpc"):
+        assert guard.guarded_dispatch("rpc.fake", flaky) == "ok"
+    assert metrics()["stall_retries"] == 1
+
+
+def test_rpc_deadline_exceeded_with_spent_budget_is_fatal():
+    def always():
+        raise RuntimeError("DEADLINE_EXCEEDED: collective permute timed out")
+
+    with pytest.raises(RuntimeError):
+        with Deadline(0.0, "spent"):
+            guard.guarded_dispatch("rpc.fake", always)
+    assert metrics()["stall_retries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# finite-delay storms (0% / 30% / 100%): absorbed, bit-identical
+# ---------------------------------------------------------------------------
+
+def test_pipeline_bit_identical_at_0_percent_delays(tmp_path):
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, delay_cfg(0, 10_000, 2)), seed=0)
+    assert _pipeline() == baseline
+    assert metrics()["injected_delays"] == 0
+    assert metrics()["stall_detected"] == 0
+
+
+def test_pipeline_bit_identical_at_30_percent_delays(tmp_path):
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, delay_cfg(30, 10_000, 1)), seed=0)
+    assert _pipeline() == baseline
+    m = metrics()
+    assert m["injected_delays"] > 0      # the storm really happened
+    assert m["stall_detected"] == 0      # delays are not stalls
+    assert m["transient_retries"] == 0   # and they cost no retries
+
+
+def test_pipeline_bit_identical_at_100_percent_delays_under_budget(tmp_path):
+    """Finite delays that fit the budget complete: no stall, no cancel,
+    same bits — the deadline only bounds them (deadline_sleep)."""
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, delay_cfg(100, 1, 5)), seed=0)
+    with Deadline(60, "delay-storm"):
+        assert _pipeline() == baseline
+    m = metrics()
+    assert m["injected_delays"] == len(DELAY_APIS)  # one per drained rule
+    assert m["stall_detected"] == 0
+    assert m["deadline_exceeded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# hang storms (delayMs < 0): detect, diagnose, cancel, recover
+# ---------------------------------------------------------------------------
+
+def test_hang_storm_every_pipeline_surface_recovers_bit_identical(tmp_path):
+    """THE acceptance run: a 100% hang storm, one permanent hang at every
+    pipeline surface. Each hang is detected (stall_detected == injected
+    hangs), diagnosed (>= 1 bundle per stall, written to disk), cancelled,
+    and retried under a fresh per-attempt budget until the drained rules
+    let the pipeline through — bit-identical to the fault-free run."""
+    baseline = _pipeline()
+    diag = tmp_path / "diag"
+    install(write_cfg(tmp_path, hang_cfg(DELAY_APIS)), seed=0)
+    t0 = time.monotonic()
+    with config.override("task.budget_s", 0.35), \
+            config.override("task.retry_budget", 8), \
+            config.override("task.degrade_after", 0), \
+            config.override("watchdog.diagnostics_dir", str(diag)), \
+            TaskExecutor() as ex:
+        fut = ex.submit(1, _pipeline)
+        assert fut.result(timeout=60) == baseline
+    # envelope: 5 stalls cost ~5 budgets + recovery runs, nowhere near
+    # the unbounded wedge this subsystem exists to prevent
+    assert time.monotonic() - t0 < 30
+    m = metrics()
+    assert m["injected_delays"] == len(DELAY_APIS)
+    assert m["stall_detected"] == len(DELAY_APIS)   # every hang detected
+    assert m["stall_cancelled"] == len(DELAY_APIS)  # every hang cancelled
+    assert m["diagnostics_bundles"] >= len(DELAY_APIS)
+    assert m["workers_lost"] == 0  # cooperative cancels: nobody went lost
+    assert len(list(diag.glob("stall-*.json"))) >= len(DELAY_APIS)
+
+
+def test_hang_storm_unbounded_degrades_to_host_path(tmp_path):
+    """An unbounded hang storm on one surface: after task.degrade_after
+    consecutive stalls the ladder downgrades the task to the host path
+    (injection suppressed there) and still yields the fault-free answer."""
+    baseline = _pipeline()
+    install(write_cfg(tmp_path, hang_cfg(("hash.murmur3",), count=10_000)),
+            seed=0)
+    with config.override("task.budget_s", 0.3), \
+            config.override("task.retry_budget", 6), \
+            config.override("task.degrade_after", 2), \
+            TaskExecutor() as ex:
+        fut = ex.submit(1, _pipeline)
+        assert fut.result(timeout=60) == baseline
+        assert ex.degraded_task_ids() == [1]
+    m = metrics()
+    assert m["stall_detected"] == 2  # two stalls bought the downgrade
+    assert m["degradations"] == 1
+    assert m["task_retries"] >= 1
+
+
+def test_hang_disk_tier_cancelled_then_clean(tmp_path):
+    t = Table((Column.from_pylist(
+        np.random.default_rng(3).integers(-100, 100, 64).tolist(),
+        dt.INT64),))
+    st = SpillableTable(t)
+    base = [c.to_pylist() for c in to_host(st.get()).columns]
+    install(write_cfg(tmp_path, hang_cfg(("spill_disk", "unspill_disk"))),
+            seed=0)
+    path = str(tmp_path / "t.spill")
+    with pytest.raises((DeadlineExceededError, StallCancelledError)):
+        with Deadline(0.3, "disk-spill"):
+            st.spill_to_disk(path)
+    # the cancelled demotion left the table host-resident and promotable;
+    # the drained rule lets the retry write the spill file
+    assert st.spill_to_disk(path) > 0
+    assert st.is_on_disk
+    with pytest.raises((DeadlineExceededError, StallCancelledError)):
+        with Deadline(0.3, "disk-promote"):
+            st.get()
+    out = st.get()  # drained: read + verify + re-upload succeeds
+    assert [c.to_pylist() for c in to_host(out).columns] == base
+    m = metrics()
+    assert m["injected_delays"] == 2
+    assert m["stall_detected"] == 2
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provide 8 virtual devices"
+    return Mesh(np.array(devs[:8]), axis_names=("shuffle",))
+
+
+def _exchange_values(parts):
+    return [[c.to_pylist() for c in p.columns] for p in parts]
+
+
+def test_hang_exchange_cancelled_then_clean(tmp_path, mesh):
+    rng = np.random.default_rng(3)
+    t = Table((Column.from_pylist(rng.integers(0, 97, 515).tolist(),
+                                  dt.INT64),
+               Column.from_pylist(rng.integers(-5, 5, 515).tolist(),
+                                  dt.INT64)))
+    baseline = _exchange_values(hash_partition_exchange(t, [0], mesh))
+    RmmSpark.reset_fault_domain_metrics()
+    install(write_cfg(tmp_path, hang_cfg(("exchange_counts",))), seed=0)
+    with pytest.raises((DeadlineExceededError, StallCancelledError)):
+        with Deadline(0.4, "exchange-hang"):
+            hash_partition_exchange(t, [0], mesh)
+    m = metrics()
+    assert m["injected_delays"] == 1
+    assert m["stall_detected"] == 1
+    again = _exchange_values(hash_partition_exchange(t, [0], mesh))
+    assert again == baseline
+
+
+def test_hang_parquet_page_decode_cancelled_then_clean(tmp_path):
+    rng = np.random.default_rng(5)
+    table = pa.table({
+        "a": pa.array(rng.integers(-10**9, 10**9, 4000), pa.int64()),
+        "b": pa.array(rng.integers(0, 10**6, 4000), pa.int64()),
+    })
+    path = str(tmp_path / "hang.parquet")
+    pq.write_table(table, path, compression="snappy")
+    install(write_cfg(tmp_path, hang_cfg(("parquet_page_decode",))), seed=0)
+    # two plans -> the sliding-window pool path: the hang lands in a pool
+    # thread, which adopted the caller's deadline, so the watchdog can
+    # cancel it there (a non-daemon pool thread must never wedge forever)
+    with pytest.raises((DeadlineExceededError, StallCancelledError)):
+        with Deadline(0.4, "pq-hang"):
+            read_parquet(path)
+    m = metrics()
+    assert m["injected_delays"] == 1
+    assert m["stall_detected"] == 1
+    out = read_parquet(path)  # drained: clean read
+    assert out[0].to_pylist() == table.column("a").to_pylist()
+    assert out[1].to_pylist() == table.column("b").to_pylist()
+
+
+def test_uncancellable_wedge_declares_worker_lost_and_requeues():
+    """The last rung: a task body that ignores the cancel token past
+    watchdog.lost_after_s is declared lost; its submission re-queues on a
+    fresh degraded worker and still resolves."""
+    calls = []
+
+    def body():
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(1.5)  # deaf to the cancel token on purpose
+            return "first"
+        return "recovered"
+
+    with config.override("task.budget_s", 0.2), \
+            config.override("watchdog.lost_after_s", 0.2), \
+            config.override("task.retry_budget", 3), \
+            config.override("task.degrade_after", 0), \
+            TaskExecutor() as ex:
+        fut = ex.submit(7, body)
+        assert fut.result(timeout=30) == "recovered"
+        # the replacement worker runs degraded: the lost worker's surface
+        # is presumed wedged
+        assert ex.degraded_task_ids() == [7]
+    m = metrics()
+    assert m["stall_detected"] == 1
+    assert m["stall_cancelled"] == 1
+    assert m["workers_lost"] == 1
+
+
+def test_diagnostics_bundle_contents(tmp_path):
+    install(write_cfg(tmp_path, hang_cfg(("hash.murmur3",))), seed=0)
+    diag = tmp_path / "diag"
+    col = Column.from_pylist([1, 2, 3], dt.INT64)
+    with config.override("watchdog.diagnostics_dir", str(diag)):
+        with pytest.raises((DeadlineExceededError, StallCancelledError)):
+            with Deadline(0.3, "bundle-test"):
+                bridge.call("hash.murmur3", json.dumps({"seed": 42}),
+                            [bridge.col_to_wire(col)])
+    bundles = watchdog.last_bundles()
+    assert len(bundles) == 1
+    b = bundles[0]
+    assert b["kind"] == "srjt-watchdog-stall"
+    assert b["api"] == "hash.murmur3"
+    assert b["budget_s"] == pytest.approx(0.3)
+    # the hung thread's stack names the hang site (injected_delay)
+    assert any("injected_delay" in "".join(frames)
+               for frames in b["stacks"].values())
+    assert b["fault_domain_metrics"]["injected_delays"] == 1
+    assert any(d["api"] == "hash.murmur3" for d in b["active_dispatches"])
+    assert isinstance(b["spill_stores"], list)
+    assert "exchange_cache" in b["exchange_programs"]
+    files = list(diag.glob("stall-*-hash_murmur3.json"))
+    assert len(files) == 1
+    with open(files[0]) as f:
+        assert json.load(f)["api"] == "hash.murmur3"
+
+
+# ---------------------------------------------------------------------------
+# bench sweep: a wedged axis costs its deadline, not the sweep
+# ---------------------------------------------------------------------------
+
+def test_bench_sweep_axis_deadline_continues(monkeypatch):
+    import os
+    import sys
+    monkeypatch.syspath_prepend(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    def axis_table():
+        return [("stall_me", lambda: (0.001, 8), 10),
+                ("ok_axis", lambda: (0.002, 16), 20)]
+
+    monkeypatch.setattr(bench, "axis_table", axis_table)
+    monkeypatch.setattr(bench, "AXIS_DEADLINE_S", 0.2)
+    monkeypatch.setenv("_BENCH_TEST_STALL", "stall_me")
+    monkeypatch.setitem(bench._STATE, "axes", {})
+    monkeypatch.setitem(bench._STATE, "emitted", False)
+    results = bench._sweep(time.monotonic() + 60)
+    # the wedged axis is recorded as exceeded, and the NEXT axis still ran
+    assert "deadline exceeded" in results["stall_me"]["error"]
+    assert "wedged" in results["stall_me"]["error"]  # driver greps for this
+    assert "error" not in results["ok_axis"]
+    assert results["ok_axis"]["seconds"] > 0
