@@ -523,16 +523,24 @@ def main():
         runs.append(("parquet_decode", "lineitem-shaped snappy", prows,
                      lambda: bench_parquet_decode(prows)))
 
+    from spark_rapids_jni_tpu.faultinj import breaker
+
     for name, config, rows, fn in runs:
         sec, nbytes = fn()
-        print(json.dumps({
+        row = {
             "bench": name,
             "config": config,
             "rows": rows,
             "seconds": round(sec, 6),
             "rows_per_s": round(rows / sec, 1),
             "gb_per_s": round(nbytes / sec / 1e9, 4),
-        }), flush=True)
+        }
+        # a tripped breaker means the numbers above measured the degraded
+        # path, not the surface — record it so sweeps are interpretable
+        tripped = breaker.states(non_closed_only=True)
+        if tripped:
+            row["breakers"] = tripped
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -35,7 +35,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_watchdog.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 3 — exception-fault storms over the whole chaos-marked suite
+# stage 3 — crash storms (injectionType 5): 100% worker-kill rates at the
+# sandboxed native surfaces (parquet page decode, parse_uri, opt-in bridge
+# ops). Pass criteria baked into the tests: every injected crash detected
+# (crash_detected == injected crashes), the supervisor respawns the worker
+# and replays to a bit-identical result, the executor process never dies,
+# and a post-storm drain() reports a clean verdict. The outer `timeout` is
+# again part of the contract — if worker-death detection ever breaks, the
+# storm wedges and the kill fails the lane loudly. `make crash` runs just
+# this stage.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_crash.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 4 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -16,6 +16,8 @@ Rule IDs:
   SRJT007  use of a buffer after donation
   SRJT008  tracing span / fault-metrics counter name drift
   SRJT009  unbounded blocking wait on a guarded/dispatch surface
+  SRJT010  native library load / handle acquisition outside the
+           sanctioned loader modules
 """
 
 from __future__ import annotations
@@ -661,7 +663,8 @@ def project_rule_srjt008_spans(modules, ctx) -> List[Finding]:
 # cancel work that waits WITH a timeout — an argument-less join()/wait()/
 # get() here is a hang the escalation ladder cannot reach
 _WAIT_SURFACE_BASENAMES = _SURFACE_BASENAMES + (
-    "task_executor.py", "rmm_spark.py", "watchdog.py", "guard.py")
+    "task_executor.py", "rmm_spark.py", "watchdog.py", "guard.py",
+    "sandbox.py")
 # receivers that name a queue: .get() is only a blocking wait on these
 # (config.get / dict.get / rules.get are lookups, never blocking)
 _QUEUEISH_RECEIVERS = ("q", "_q", "queue", "_queue", "work_queue", "inbox")
@@ -711,8 +714,56 @@ def rule_srjt009(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT010 — native library load outside the sanctioned loader modules
+# ---------------------------------------------------------------------------
+
+# the only modules allowed to open a native handle: the two loaders, the
+# embedded-bridge host, and the crash-containment sandbox tier (whose
+# whole point is owning worker-side dlopens). Everything else must route
+# through utils.nativeload.load_native FROM one of these files — a stray
+# ctypes.CDLL elsewhere dodges the build cache, the signature tables, and
+# the sandbox (a crash there is executor death again).
+_SRJT010_SANCTIONED = (
+    "memory/native.py", "utils/nativeload.py", "bridge.py",
+    "faultinj/sandbox.py", "faultinj/_sandbox_targets.py",
+    "faultinj/_sandbox_worker.py")
+
+# raw ctypes loader spellings (module-qualified and bare-imported)
+_SRJT010_RAW_LOADS = (
+    "ctypes.CDLL", "CDLL", "ctypes.PyDLL", "PyDLL",
+    "ctypes.cdll.LoadLibrary", "cdll.LoadLibrary",
+    "ctypes.windll.LoadLibrary", "windll.LoadLibrary")
+
+
+def rule_srjt010(tree, rel, lines, ctx) -> List[Finding]:
+    if any(rel.endswith(p) for p in _SRJT010_SANCTIONED):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn in _SRJT010_RAW_LOADS:
+            findings.append(Finding(
+                "SRJT010", rel, node.lineno,
+                f"raw native library load `{fn}(...)` outside the "
+                f"sanctioned loaders ({', '.join(_SRJT010_SANCTIONED)}) "
+                f"— route through utils.nativeload.load_native so the "
+                f"handle gets the shared signature tables and the "
+                f"crash-containment sandbox can host its dispatches"))
+        elif fn is not None and fn.split(".")[-1] == "load_native":
+            findings.append(Finding(
+                "SRJT010", rel, node.lineno,
+                f"native handle acquired via `{fn}(...)` outside the "
+                f"sanctioned loader modules — new native surfaces belong "
+                f"behind a dedicated loader (baseline the existing ones, "
+                f"do not add more)"))
+    return findings
+
+
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
-              rule_srjt008_counters, rule_srjt009)
+              rule_srjt008_counters, rule_srjt009, rule_srjt010)
 PROJECT_RULES = (project_rule_srjt008_spans,)
 ALL_RULES = FILE_RULES + PROJECT_RULES

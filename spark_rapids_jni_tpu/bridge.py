@@ -133,16 +133,45 @@ def call(op_name: str, args_json: str,
     bounds the dispatch too: the pre-marshal checkpoint stops a cancelled
     task before building columns, and the supervisor's retry loop derives
     its backoff from the remaining budget."""
-    from .faultinj import watchdog
+    from .faultinj import sandbox, watchdog
     from .faultinj.guard import guarded_dispatch
     fn = _OPS.get(op_name)
     if fn is None:
         raise KeyError(f"unknown engine op: {op_name!r} "
                        f"(have: {sorted(_OPS)})")
     watchdog.checkpoint()  # chunk boundary: before column marshalling
+    if sandbox.active(op_name, kind="bridge"):
+        # crash containment for opted-in ops (sandbox.bridge_ops): the
+        # whole marshal→dispatch→unmarshal runs in the package-importing
+        # "bridge" worker — wire columns are flat bytes, so the wire
+        # format IS the pickle payload
+        return guarded_dispatch(
+            op_name, sandbox.sandbox_call, op_name,
+            sandbox.mod_target("spark_rapids_jni_tpu.bridge",
+                               "_sandboxed_op"),
+            op_name, args_json, [tuple(w) for w in wire_cols],
+            group="bridge")
     args = json.loads(args_json) if args_json else {}
     cols = [wire_to_col(w) for w in wire_cols]
     out = guarded_dispatch(op_name, fn, args, cols)
+    meta = {}
+    if isinstance(out, tuple):
+        out, meta = out
+    return [c if isinstance(c, tuple) else col_to_wire(c) for c in out], \
+        json.dumps(meta)
+
+
+def _sandboxed_op(op_name: str, args_json: str,
+                  wire_cols: Sequence[WireCol]) -> Tuple[List[WireCol], str]:
+    """Worker-side half of a sandboxed bridge op: same marshal/dispatch/
+    unmarshal as ``call``, minus the supervisor (the PARENT's
+    guarded_dispatch owns retries — a fault here relays to it verbatim)."""
+    fn = _OPS.get(op_name)
+    if fn is None:
+        raise KeyError(f"unknown engine op: {op_name!r}")
+    args = json.loads(args_json) if args_json else {}
+    cols = [wire_to_col(w) for w in wire_cols]
+    out = fn(args, cols)
     meta = {}
     if isinstance(out, tuple):
         out, meta = out

@@ -15,7 +15,11 @@ spill/unspill/disk/parquet/exchange surfaces) so the checksum detectors
 are provable end-to-end — see ``CorruptionError`` there; type 4 injects a
 ``delayMs`` sleep or (``delayMs < 0``) a permanent hang at the call site
 so the deadline/watchdog subsystem (``watchdog.py``) is provable the same
-way — stalls are detected, diagnosed, and cancelled, never waited on.
+way — stalls are detected, diagnosed, and cancelled, never waited on; type
+5 kills the sandbox worker hosting the call (``sandbox.py``) so the
+crash-containment tier — CRASH fault domain, worker respawn, replay,
+quarantine, per-surface circuit breakers (``breaker.py``) — is provable
+under real process death.
 """
 
 from .injector import (
@@ -43,10 +47,14 @@ from .watchdog import (
     DeadlineExceededError,
     StallCancelledError,
 )
-from . import watchdog
+from .sandbox import QuarantinedInputError, WorkerCrashError
+from . import breaker, watchdog
 
 __all__ = [
     "CancelToken",
+    "QuarantinedInputError",
+    "WorkerCrashError",
+    "breaker",
     "Deadline",
     "DeadlineExceededError",
     "DeviceAssertError",

@@ -37,6 +37,15 @@ port, applied uniformly at every dispatch surface:
                                 retries``) while deadline budget remains,
                                 else propagate into the cancellation →
                                 degradation → worker-lost ladder
+  CRASH (WorkerCrashError)      a sandbox worker process died (signal /
+                                nonzero exit / hung-and-killed —
+                                faultinj/sandbox.py): never retry in
+                                place (the dead worker cannot answer) —
+                                count the detection and propagate; the
+                                sandbox respawns the worker lazily, the
+                                TaskExecutor replays against the task
+                                retry budget, and repeat offenders are
+                                quarantined like CORRUPTION
   FATAL (everything else)       propagate unchanged
   ============================  =======================================
 
@@ -93,6 +102,7 @@ TRANSIENT = "transient"
 POISON = "poison"
 CORRUPTION = "corruption"
 STALL = "stall"
+CRASH = "crash"
 FATAL = "fatal"
 
 # substrings of real runtime-error messages that mark a domain. XLA/PJRT
@@ -144,6 +154,11 @@ class ProgramPoisonedError(RuntimeError):
 def classify(exc: BaseException) -> str:
     """Map an exception (injected or real) to its fault domain."""
     from ..memory.integrity import CorruptionError
+    from .sandbox import WorkerCrashError
+    if isinstance(exc, WorkerCrashError):
+        return CRASH  # before CorruptionError: QuarantinedInputError is a
+        # CorruptionError on purpose (quarantine rides that handling), but
+        # a raw worker death is its own domain
     if isinstance(exc, CorruptionError):
         return CORRUPTION
     if isinstance(exc, (watchdog.DeadlineExceededError,
@@ -188,7 +203,9 @@ class FaultDomainMetrics:
                "corruption_detected", "quarantined_buffers",
                "injected_delays", "deadline_exceeded", "stall_detected",
                "stall_cancelled", "stall_retries", "diagnostics_bundles",
-               "workers_lost")
+               "workers_lost", "injected_crashes", "crash_detected",
+               "worker_respawns", "quarantined_inputs", "breaker_opened",
+               "breaker_closed", "breaker_short_circuits", "drains")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -342,6 +359,17 @@ def guarded_dispatch(api_name: str, fn: Callable[..., Any], *args,
                     # source; readers re-read the file)
                     metrics.bump("corruption_detected")
                     with trace_range(f"fault:corruption:{api_name}"):
+                        pass
+                    raise
+                if domain == CRASH:
+                    # the worker that held the native state is dead —
+                    # retry-in-place would dispatch into a void. Count the
+                    # containment and propagate: the sandbox respawns on
+                    # the next call and the TaskExecutor replays the task
+                    # against its retry budget (quarantine after
+                    # sandbox.max_replays crashes of one input).
+                    metrics.bump("crash_detected")
+                    with trace_range(f"fault:crash:{api_name}"):
                         pass
                     raise
                 if domain == STALL:

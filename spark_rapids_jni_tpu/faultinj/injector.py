@@ -24,7 +24,13 @@ memory/integrity.py at the spill/disk/exchange/parquet surfaces, never via
 (sleep ``delayMs`` milliseconds at the call site, or hang until the watchdog
 cancels when ``delayMs`` is negative — executed by
 ``faultinj.watchdog.injected_delay`` outside the injector lock so a hung
-surface never wedges other threads' rule checks). ``interceptionCount``
+surface never wedges other threads' rule checks), 5 = worker crash (kill
+the sandbox worker hosting the call — ``crashMode`` picks "abort"
+(SIGABRT), "kill" (SIGKILL) or "exit" (os._exit with
+``substituteReturnCode``); sampled parent-side by ``crash_spec`` and
+executed inside the worker by faultinj/sandbox.py, so the injected fault
+is real process death). An unrecognized ``injectionType`` raises a
+ValueError naming the rule at load time. ``interceptionCount``
 bounds how many consecutive matched calls are sampled; ``percent`` is the
 per-sample probability. ``dynamic: true`` re-reads the config when its
 mtime changes (the reference uses an inotify thread; polling on call entry
@@ -60,24 +66,40 @@ class InjectedApiError(RuntimeError):
         self.api = api
 
 
+_KNOWN_TYPES = (
+    "0=device trap, 1=device assert, 2=substituted api error, "
+    "3=payload bit-flip, 4=delay/hang, 5=worker crash")
+
+
 class _Rule:
-    def __init__(self, cfg: dict):
+    def __init__(self, name: str, cfg: dict):
         self.percent = float(cfg.get("percent", 0))
         self.injection_type = int(cfg.get("injectionType", 0))
+        if self.injection_type not in (0, 1, 2, 3, 4, 5):
+            # an unrecognized type would otherwise be constructed and
+            # silently never fire — a chaos config typo must fail loudly
+            raise ValueError(
+                f"fault config rule {name!r}: unknown injectionType "
+                f"{self.injection_type} (known types: {_KNOWN_TYPES})")
         self.count_remaining = int(cfg.get("interceptionCount", 0))
         self.substitute = int(cfg.get("substituteReturnCode", 0))
         # injectionType 4: sleep this long at the call site; < 0 = hang
         # until the watchdog cancels (faultinj/watchdog.py)
         self.delay_ms = float(cfg.get("delayMs", 0))
+        # injectionType 5: how the sandbox worker dies — "abort"
+        # (SIGABRT, the native-trap analog), "kill" (SIGKILL), or "exit"
+        # (os._exit with substituteReturnCode)
+        self.crash_mode = str(cfg.get("crashMode", "abort"))
 
     def maybe_fire(self, api: str, rng: random.Random) -> Optional[float]:
         """Sample one matched call. Types 0-2 raise; type 4 returns the
         delay in seconds for the caller to execute OUTSIDE the injector
         lock (a hang held under the lock would wedge every other thread's
         rule check); None = nothing fired."""
-        if self.injection_type == 3:
-            return None  # payload bit-flips fire via bitflip_rng, which
-            # owns the budget — an exception checkpoint has no buffer
+        if self.injection_type in (3, 5):
+            return None  # payload bit-flips fire via bitflip_rng and
+            # worker crashes via crash_spec — each owns its budget; an
+            # exception checkpoint has no buffer and no worker to kill
         if self.count_remaining <= 0:
             return None
         self.count_remaining -= 1
@@ -117,7 +139,7 @@ class FaultInjector:
         rules: Dict[str, _Rule] = {}
         for section in _SECTION_KEYS:
             for name, rule_cfg in (cfg.get(section) or {}).items():
-                rules[name] = _Rule(rule_cfg)
+                rules[name] = _Rule(name, rule_cfg)
         with self._lock:
             self._rules = rules
             self._dynamic = bool(cfg.get("dynamic", False))
@@ -171,6 +193,26 @@ class FaultInjector:
             if self._rng.uniform(0, 100) >= rule.percent:
                 return None
             return self._rng
+
+    def crash_spec(self, api: str) -> Optional[dict]:
+        """injectionType 5 sampling for one sandboxed call: when a crash
+        rule targets ``api`` (or ``*``) and its budget + percent roll
+        fire, return the crash directive ({"mode", "code"}) for
+        faultinj/sandbox.py to ship to the worker — the directive is
+        sampled HERE in the parent but executed INSIDE the worker
+        (os.abort/SIGKILL/exit), so the injected fault is real process
+        death, not a simulated exception. None = no crash."""
+        self._maybe_reload()
+        with self._lock:
+            rule = self._rules.get(api) or self._rules.get("*")
+            if rule is None or rule.injection_type != 5:
+                return None
+            if rule.count_remaining <= 0:
+                return None
+            rule.count_remaining -= 1
+            if self._rng.uniform(0, 100) >= rule.percent:
+                return None
+            return {"mode": rule.crash_mode, "code": rule.substitute or 1}
 
     def wrap(self, fn, api: str):
         def wrapper(*a, **kw):

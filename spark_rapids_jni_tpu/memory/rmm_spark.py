@@ -381,6 +381,37 @@ class RmmSpark:
             cls.get_current_thread_id(), task_id)
 
     @classmethod
+    def thread_id_of(cls, thread: threading.Thread) -> Optional[int]:
+        """Registered tid of another python thread, or None when it never
+        registered. Keyed to the Thread OBJECT (same aliasing guard as
+        ``get_current_thread_id``): a fresh thread that inherited a dead
+        thread's ident is not that thread."""
+        ident = thread.ident
+        if ident is None:
+            return None
+        with cls._lock:
+            entry = cls._tid_map.get(ident)
+            if entry is None:
+                return None
+            ref, tid = entry
+            return tid if ref() is thread else None
+
+    @classmethod
+    def remove_thread_association_for(cls, thread: threading.Thread,
+                                      task_id: int = -1) -> bool:
+        """Release ANOTHER thread's association (the lost-worker path: a
+        thread the watchdog declared lost never runs its own cleanup, and
+        the native deadlock sweep would count its tid as BLOCKED forever).
+        Safe against the thread waking later — the adaptor treats removal
+        of an unknown tid as a no-op. Returns False when the thread never
+        registered."""
+        tid = cls.thread_id_of(thread)
+        if tid is None:
+            return False
+        cls._adp().remove_thread_association(tid, task_id)
+        return True
+
+    @classmethod
     def task_done(cls, task_id: int) -> None:
         cls._adp().task_done(task_id)
 

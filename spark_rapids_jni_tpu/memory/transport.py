@@ -431,6 +431,38 @@ class SpillStore:
             self.spill_all()
         return rollback
 
+    def flush(self, fsync: bool = True) -> Dict[str, Any]:
+        """Drain-time flush (TaskExecutor.drain step 3): spill everything
+        off the device, demote every host-resident table to the
+        checksummed disk tier, and fsync the spill directory so a SIGKILL
+        right after the drain loses nothing that was ever spilled. A
+        no-disk-tier store just spills (nothing durable to write)."""
+        spilled = self.spill_all()
+        demoted = 0
+        fsynced = False
+        if self._disk_dir:
+            with self._lock:
+                order = sorted(self._entries.values(), key=lambda e: e[0])
+            for _, st in order:
+                if st.host_nbytes > 0 and \
+                        st.spill_to_disk(self._next_path()) > 0:
+                    demoted += 1
+            if fsync:
+                # the spill files themselves fsync on write (atomic
+                # rename path); the DIRECTORY entry needs its own sync
+                # for the names to survive power loss
+                try:
+                    fd = os.open(self._disk_dir, os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                    fsynced = True
+                except OSError:
+                    fsynced = False
+        return {"device_bytes_spilled": spilled,
+                "demoted_to_disk": demoted, "fsynced": fsynced}
+
     def state(self) -> Dict[str, Any]:
         """One store's live summary for a watchdog diagnostics bundle:
         table count per tier plus byte totals — enough to tell a

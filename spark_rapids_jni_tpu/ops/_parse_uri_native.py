@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import ctypes
 
+from ..faultinj._sandbox_targets import declare_puri
 from ..utils.nativeload import load_native
 
 _lib = None
@@ -14,16 +15,12 @@ def load() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     lib = load_native("parse_uri.cpp", "libsparkpuri.so", link=["-lpthread"])
-    c = ctypes
-    u8p, i64p = c.POINTER(c.c_uint8), c.POINTER(c.c_int64)
-    lib.puri_parse.restype = c.c_int
-    lib.puri_parse.argtypes = [
-        u8p, i64p, u8p, c.c_long, c.c_int,
-        u8p, i64p, u8p, c.c_int,
-        c.POINTER(u8p), c.POINTER(i64p), c.POINTER(u8p),
-        c.POINTER(c.c_int64),
-    ]
-    lib.puri_free.restype = None
-    lib.puri_free.argtypes = [c.c_void_p]
-    _lib = lib
+    # signatures shared with the sandbox worker's own dlopen of this .so
+    _lib = declare_puri(lib)
     return _lib
+
+
+def so_path() -> str:
+    """Built .so path for the crash-containment sandbox (the worker
+    dlopens it by path; the parent's loader already compiled it)."""
+    return load()._name

@@ -384,12 +384,8 @@ _PART_PROTOCOL, _PART_HOST, _PART_QUERY = 0, 1, 2
 
 def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
                   key_literal: Optional[bytes] = None) -> Column:
-    import ctypes
-
     from . import _parse_uri_native as nat
 
-    lib = nat.load()
-    c = ctypes
     data = np.ascontiguousarray(col.host_data())
     offs = np.ascontiguousarray(col.host_offsets(), dtype=np.int64)
     valid = None if col.validity is None else np.ascontiguousarray(
@@ -412,37 +408,22 @@ def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
             np.ascontiguousarray(
                 np.asarray(key_col.validity).astype(np.uint8))
 
-    u8p = c.POINTER(c.c_uint8)
-    i64p = c.POINTER(c.c_int64)
-    out_data = u8p()
-    out_offs = i64p()
-    out_valid = u8p()
-    total = c.c_int64()
-    if data.size == 0:
-        data = np.zeros(1, dtype=np.uint8)
-    rc = lib.puri_parse(
-        data.ctypes.data_as(u8p), offs.ctypes.data_as(i64p),
-        valid.ctypes.data_as(u8p) if valid is not None else None,
-        col.size, part,
-        key_data.ctypes.data_as(u8p) if key_data is not None else None,
-        key_offs.ctypes.data_as(i64p) if key_offs is not None else None,
-        key_valid.ctypes.data_as(u8p) if key_valid is not None else None,
-        key_broadcast,
-        c.byref(out_data), c.byref(out_offs), c.byref(out_valid),
-        c.byref(total))
-    if rc != 0:
-        raise RuntimeError(f"parse_uri native tier failed ({rc})")
-    try:
-        n = col.size
-        offsets = np.ctypeslib.as_array(out_offs, shape=(n + 1,)).copy()
-        validity = np.ctypeslib.as_array(out_valid, shape=(n,)).copy() \
-            .astype(bool) if n else np.zeros(0, dtype=bool)
-        blob = (np.ctypeslib.as_array(out_data, shape=(total.value,)).copy()
-                if total.value else np.zeros(0, dtype=np.uint8))
-    finally:
-        lib.puri_free(out_data)
-        lib.puri_free(out_offs)
-        lib.puri_free(out_valid)
+    from ..faultinj import _sandbox_targets, sandbox
+    n = col.size
+    if sandbox.active("parse_uri"):
+        # crash containment: the ctypes call runs in a sandbox worker that
+        # dlopens the already-built .so by path; numpy buffers pickle over
+        # the pipe and a native crash classifies as a CRASH fault
+        from ..faultinj.guard import guarded_dispatch
+        blob, offsets, validity = guarded_dispatch(
+            "parse_uri", sandbox.sandbox_call, "parse_uri",
+            sandbox.file_target("parse_uri_target"), nat.so_path(),
+            data, offs, valid, n, part, key_data, key_offs, key_valid,
+            key_broadcast)
+    else:
+        blob, offsets, validity = _sandbox_targets.parse_uri_buffers(
+            nat.load(), data, offs, valid, n, part, key_data, key_offs,
+            key_valid, key_broadcast)
 
     import jax.numpy as jnp
     vmask = None if bool(validity.all()) else jnp.asarray(validity)

@@ -29,11 +29,14 @@ from __future__ import annotations
 
 import contextlib
 import queue
+import signal
 import threading
+import time
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional
 
 from ..faultinj import guard, watchdog
+from ..faultinj.sandbox import WorkerCrashError
 from ..faultinj.injector import DeviceAssertError, DeviceTrapError
 from ..memory.exceptions import (
     CpuRetryOOM,
@@ -183,12 +186,16 @@ class _TaskWorker:
                     raise
                 guard.metrics.bump("task_retries")
                 self._rollback()
-            except CorruptionError:
+            except (CorruptionError, WorkerCrashError):
                 # a verified-corrupt buffer beneath this op was already
                 # quarantined by its detector; the only recovery is
                 # re-materializing from upstream, which re-running the
                 # submission does (sources are still intact). Counts
                 # against the same budget — never retry-in-place.
+                # A crashed sandbox worker replays the same way: the
+                # worker respawns lazily on the next dispatch, and an
+                # input that keeps killing workers quarantines into a
+                # CorruptionError after sandbox.max_replays.
                 attempts += 1
                 device_failures = 0
                 if attempts > budget:
@@ -314,6 +321,7 @@ class TaskExecutor:
         self._mark_done = mark_tasks_done
         self._spill_store = spill_store
         self._closed = False
+        self.last_drain: Optional[Dict[str, Any]] = None
 
     def degraded_task_ids(self):
         """Task ids currently downgraded to the host/CPU compute path."""
@@ -359,6 +367,17 @@ class TaskExecutor:
                 return  # already replaced (duplicate lost-fire guard)
             del self._workers[worker.task_id]
             self._lost.append(worker)
+            # release the lost thread's RmmSpark association NOW: a wedged
+            # thread never runs its own cleanup, and the native deadlock
+            # sweep would count the dead tid as BLOCKED forever. The
+            # adaptor treats a repeat removal (the thread finally waking
+            # and cleaning up after itself) as a no-op.
+            if RmmSpark.is_installed():
+                try:
+                    RmmSpark.remove_thread_association_for(
+                        worker._thread, worker.task_id)
+                except RuntimeError:
+                    pass
             item = worker._current
             pending = []
             while True:
@@ -380,12 +399,17 @@ class TaskExecutor:
                     # cancelled — the retry arms task.budget_s afresh
                     requeue = (fut, fn, args, kwargs, None, requeues + 1)
             if requeue is None and not pending:
+                # no replacement worker will ever exist for this task:
+                # retire its scheduler slot here, or task_done() (which
+                # no longer finds the worker) would leak it
+                self._mark_task_done(worker.task_id)
                 return
             if self._closed:
                 orphans = pending if requeue is None else [requeue] + pending
                 for it in orphans:
                     self._fail(it[0], RuntimeError(
                         "TaskExecutor closed while its worker was lost"))
+                self._mark_task_done(worker.task_id)
                 return
             w = _TaskWorker(worker.task_id, RmmSpark.is_installed(),
                             spill_store=self._spill_store,
@@ -436,9 +460,31 @@ class TaskExecutor:
             except RuntimeError:
                 pass
 
-    def close(self, timeout: Optional[float] = 30.0):
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful executor drain (the executor-lifecycle verdict):
+
+        1. stop admission (``submit`` raises from here on);
+        2. run every in-flight/queued submission to completion under a
+           drain Deadline (``drain.timeout_s``) — workers that beat the
+           deadline retire their scheduler slots, stragglers are kept as
+           zombies so a later drain/close can still retire them;
+        3. flush the SpillStore: demote host-resident spilled tables to
+           the checksummed disk tier and fsync, so a following SIGKILL
+           loses nothing that was ever spilled;
+        4. terminate sandbox workers (their native state is per-call
+           reconstructible, nothing to save);
+        5. report a verdict dict (also kept on ``self.last_drain``).
+
+        Idempotent: a second drain finds no workers and reports
+        ``already_closed``. ``close()`` delegates here.
+        """
+        from ..utils import config
+        if timeout is None:
+            timeout = float(config.get("drain.timeout_s"))
+        t0 = time.monotonic()
         with self._lock:
-            self._closed = True
+            already_closed = self._closed
+            self._closed = True  # admission stops before the first join
             workers = dict(self._workers)
             self._workers.clear()
             for w in workers.values():
@@ -449,17 +495,69 @@ class TaskExecutor:
             self._zombies.clear()
             lost = list(self._lost)
             self._lost.clear()
-        timeout = watchdog.derive_timeout(timeout)
-        for task_id, w in workers.items():
-            if w.join(timeout):
-                self._mark_task_done(task_id)
-        for task_id, w in zombies.items():
-            if w.join(timeout):
-                self._mark_task_done(task_id)
-        for w in lost:
-            # best-effort only — a truly wedged thread never joins, and
-            # its task was already retired via its replacement worker
-            w.join(timeout)
+        completed = 0
+        stragglers: List[int] = []
+        ctx = (watchdog.Deadline(timeout, "drain")
+               if timeout and timeout > 0 else contextlib.nullcontext())
+        with ctx:
+            for group in (workers, zombies):
+                for task_id, w in group.items():
+                    if w.join(watchdog.derive_timeout(timeout)):
+                        self._mark_task_done(task_id)
+                        completed += 1
+                    else:
+                        stragglers.append(task_id)
+                        with self._lock:
+                            self._zombies[task_id] = w
+            still_lost = 0
+            for w in lost:
+                # best-effort, short bound — a truly wedged thread never
+                # joins, and its task was already retired when it was
+                # declared lost (or via its replacement worker)
+                if not w.join(watchdog.derive_timeout(0.1)):
+                    still_lost += 1
+        spill = None
+        if self._spill_store is not None:
+            try:
+                spill = self._spill_store.flush(fsync=True)
+            except OSError as e:
+                spill = {"error": f"{type(e).__name__}: {e}"}
+        from ..faultinj import sandbox
+        sandbox_stopped = sandbox.shutdown_all()
+        guard.metrics.bump("drains")
+        verdict = {
+            "clean": (not stragglers
+                      and (spill is None or "error" not in spill)),
+            "already_closed": already_closed,
+            "tasks_completed": completed,
+            "stragglers": stragglers,
+            "lost_workers": still_lost,
+            "spill": spill,
+            "sandbox_workers_stopped": sandbox_stopped,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+        self.last_drain = verdict
+        return verdict
+
+    def close(self, timeout: Optional[float] = 30.0):
+        self.drain(timeout=timeout)
+
+    def install_sigterm_drain(self, chain: bool = True):
+        """Drain on SIGTERM (the executor-decommission signal): install a
+        handler that runs ``drain()`` and then, when ``chain`` and a prior
+        python-level handler existed, invokes it (so an outer framework's
+        shutdown still runs). Main-thread only (signal module contract);
+        returns the previous handler."""
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            self.drain()
+            if chain and callable(prev) and prev not in (
+                    signal.SIG_IGN, signal.SIG_DFL):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _handler)
+        return prev
 
     def __enter__(self):
         return self

@@ -32,6 +32,12 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..faultinj import watchdog
+from ..faultinj._sandbox_targets import (
+    LeafC as _LeafC,
+    OutC as _OutC,
+    declare_pqd,
+    unpack_out,
+)
 from ..memory.reservation import device_reservation, release_barrier
 
 _lock = threading.Lock()
@@ -47,39 +53,6 @@ _CT_UINT_8, _CT_UINT_16, _CT_UINT_32, _CT_UINT_64 = 11, 12, 13, 14
 _CT_INT_8, _CT_INT_16, _CT_INT_32, _CT_INT_64 = 15, 16, 17, 18
 
 
-class _LeafC(ctypes.Structure):
-    _fields_ = [
-        ("path", ctypes.c_char_p),
-        ("physical", ctypes.c_int),
-        ("type_length", ctypes.c_int),
-        ("converted", ctypes.c_int),
-        ("scale", ctypes.c_int),
-        ("precision", ctypes.c_int),
-        ("max_def", ctypes.c_int),
-        ("max_rep", ctypes.c_int),
-        ("rep_def", ctypes.c_int),
-        ("path_json", ctypes.c_char_p),
-    ]
-
-
-class _OutC(ctypes.Structure):
-    _fields_ = [
-        ("values", ctypes.POINTER(ctypes.c_uint8)),
-        ("values_bytes", ctypes.c_longlong),
-        ("offsets", ctypes.POINTER(ctypes.c_int32)),
-        ("validity", ctypes.POINTER(ctypes.c_uint8)),
-        ("rows", ctypes.c_longlong),
-        ("null_count", ctypes.c_longlong),
-        ("list_offsets", ctypes.POINTER(ctypes.c_int32)),
-        ("list_validity", ctypes.POINTER(ctypes.c_uint8)),
-        ("list_rows", ctypes.c_longlong),
-        ("list_null_count", ctypes.c_longlong),
-        ("defs", ctypes.POINTER(ctypes.c_int32)),
-        ("reps", ctypes.POINTER(ctypes.c_int32)),
-        ("n_levels", ctypes.c_longlong),
-    ]
-
-
 def _load():
     global _lib
     with _lock:
@@ -89,33 +62,10 @@ def _load():
         lib = load_native("parquet_decode.cpp", "libsparkpqd.so",
                           extra_deps=["thrift_compact.hpp"],
                           link=["-lz", "-ldl"])
+        # shared signature set (faultinj/_sandbox_targets.py) — the sandbox
+        # worker declares the same table against its own dlopen of this .so
+        declare_pqd(lib)
         c = ctypes
-        lib.pqd_open.restype = c.c_void_p
-        lib.pqd_open.argtypes = [c.POINTER(c.c_uint8), c.c_longlong,
-                                 c.POINTER(c.c_char_p)]
-        lib.pqd_num_row_groups.restype = c.c_int
-        lib.pqd_num_row_groups.argtypes = [c.c_void_p]
-        lib.pqd_rg_num_rows.restype = c.c_longlong
-        lib.pqd_rg_num_rows.argtypes = [c.c_void_p, c.c_int]
-        lib.pqd_num_leaves.restype = c.c_int
-        lib.pqd_num_leaves.argtypes = [c.c_void_p]
-        lib.pqd_set_verify_crc.restype = None
-        lib.pqd_set_verify_crc.argtypes = [c.c_void_p, c.c_int]
-        lib.pqd_leaf_info.restype = c.c_int
-        lib.pqd_leaf_info.argtypes = [c.c_void_p, c.c_int, c.POINTER(_LeafC)]
-        lib.pqd_chunk_range.restype = c.c_int
-        lib.pqd_chunk_range.argtypes = [
-            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_longlong),
-            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
-            c.POINTER(c.c_int)]
-        lib.pqd_decode_chunk.restype = c.c_int
-        lib.pqd_decode_chunk.argtypes = [
-            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_uint8), c.c_longlong,
-            c.POINTER(_OutC), c.POINTER(c.c_char_p)]
-        lib.pqd_decode_chunk2.restype = c.c_int
-        lib.pqd_decode_chunk2.argtypes = [
-            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_uint8), c.c_longlong,
-            c.c_int, c.POINTER(_OutC), c.POINTER(c.c_char_p)]
         from .device_decode import _PageMeta
         lib.pqd_extract_pages.restype = c.c_int
         lib.pqd_extract_pages.argtypes = [
@@ -123,12 +73,6 @@ def _load():
             c.c_longlong, c.POINTER(c.POINTER(c.c_uint8)),
             c.POINTER(c.c_longlong), c.POINTER(c.POINTER(_PageMeta)),
             c.POINTER(c.c_longlong), c.POINTER(c.c_char_p)]
-        lib.pqd_free_out.restype = None
-        lib.pqd_free_out.argtypes = [c.POINTER(_OutC)]
-        lib.pqd_free.restype = None
-        lib.pqd_free.argtypes = [c.c_void_p]
-        lib.pqd_close.restype = None
-        lib.pqd_close.argtypes = [c.c_void_p]
         _lib = lib
         return _lib
 
@@ -241,6 +185,9 @@ class ParquetReader:
         self._lib = _load()
         with open(path, "rb") as f:
             footer = _read_footer_bytes(f)
+        # kept for the crash-containment sandbox: native handles are
+        # process-local, so a sandbox worker re-opens from these bytes
+        self._footer = footer
         buf = np.frombuffer(footer, dtype=np.uint8)
         err = ctypes.c_char_p()
         h = self._lib.pqd_open(
@@ -381,6 +328,7 @@ class ParquetReader:
 
         want_levels (nested plans): the tuple's ``lists`` slot instead
         carries the raw (defs, reps) streams for tree reconstruction."""
+        from ..faultinj import sandbox
         from ..faultinj.guard import guarded_dispatch
         from ..faultinj.injector import get_injector
         from ..memory.integrity import CorruptionError, maybe_flip_arrays
@@ -398,6 +346,39 @@ class ParquetReader:
                 wbuf = np.frombuffer(bytearray(raw), dtype=np.uint8)
                 if maybe_flip_arrays("parquet_page", [wbuf]):
                     buf = wbuf
+            if sandbox.active("parquet_page_decode"):
+                # crash containment: the decode runs in a sandbox worker
+                # that re-opens the file from the footer bytes; a native
+                # SIGSEGV there is a recoverable CRASH, not executor death
+                from ..utils import config
+                verify = bool(config.get("parquet.verify_crc"))
+
+                def _sandbox_decode(buf=buf):
+                    try:
+                        return sandbox.sandbox_call(
+                            "parquet_page_decode",
+                            sandbox.file_target("parquet_decode_chunk"),
+                            self._lib._name, self._footer, rg, leaf.index,
+                            buf.tobytes(), leaf.physical, leaf.max_rep,
+                            want_levels, verify,
+                            quarantine_key=(
+                                f"{self._path}:{rg}:{leaf.index}"))
+                    except sandbox.WorkerCrashError:
+                        raise
+                    except RuntimeError as e:
+                        if ("(corruption)" in str(e)
+                                and not isinstance(e, CorruptionError)):
+                            # the standalone worker module stays free of
+                            # the integrity taxonomy; restore it here
+                            raise CorruptionError(str(e)) from e
+                        raise
+
+                try:
+                    return guarded_dispatch("parquet_page_decode",
+                                            _sandbox_decode)
+                except CorruptionError as e:
+                    last = e
+                    continue  # discard and re-read from source
             out = _OutC()
 
             def _native_decode(buf=buf, out=out):
@@ -427,37 +408,10 @@ class ParquetReader:
         raise last
 
     def _unpack_out(self, leaf: LeafSchema, out, want_levels: bool):
-        try:
-            rows = out.rows
-            values = np.ctypeslib.as_array(out.values,
-                                           shape=(out.values_bytes,)).copy()
-            offsets = None
-            if leaf.physical == _PT_BYTE_ARRAY:
-                offsets = np.ctypeslib.as_array(out.offsets,
-                                                shape=(rows + 1,)).copy()
-            validity = None
-            if out.null_count > 0:
-                validity = np.ctypeslib.as_array(out.validity,
-                                                 shape=(rows,)).copy()
-            lists = None
-            if want_levels:
-                nl = out.n_levels
-                lists = (np.ctypeslib.as_array(out.defs, shape=(nl,)).copy()
-                         if nl else np.zeros(0, np.int32),
-                         np.ctypeslib.as_array(out.reps, shape=(nl,)).copy()
-                         if nl else np.zeros(0, np.int32))
-            elif leaf.max_rep == 1:
-                lrows = out.list_rows
-                loffs = np.ctypeslib.as_array(
-                    out.list_offsets, shape=(lrows + 1,)).copy()
-                lvalid = None
-                if out.list_null_count > 0:
-                    lvalid = np.ctypeslib.as_array(
-                        out.list_validity, shape=(lrows,)).copy()
-                lists = (lrows, loffs, lvalid)
-            return rows, values, offsets, validity, lists
-        finally:
-            self._lib.pqd_free_out(ctypes.byref(out))
+        # shared with the sandbox worker (faultinj/_sandbox_targets.py):
+        # both paths produce the identical host-buffer tuple
+        return unpack_out(self._lib, out, leaf.physical, leaf.max_rep,
+                          want_levels)
 
     @staticmethod
     def _to_column(leaf: LeafSchema, rows: int, values: np.ndarray,

@@ -166,6 +166,48 @@ _register("from_json.tier", "SRJT_FROM_JSON_TIER", "auto", str,
           "from_json raw-map execution: auto (device pair-span extraction "
           "on accelerators, rows with escapes fall back to the native "
           "PDA) | device | native")
+_register("sandbox.enabled", "SRJT_SANDBOX_ENABLED", False, _parse_bool,
+          "host crash-prone native dispatch surfaces in supervised worker "
+          "subprocesses (faultinj/sandbox.py) so a SIGSEGV in native code "
+          "is a recoverable CRASH fault instead of executor death; off = "
+          "in-process dispatch (bit-identical, faster)")
+_register("sandbox.surfaces", "SRJT_SANDBOX_SURFACES",
+          "parquet_page_decode,parse_uri", str,
+          "csv of guarded api names routed through the sandbox when "
+          "sandbox.enabled (native-library surfaces; workers load targets "
+          "by file path, no jax startup per respawn)")
+_register("sandbox.bridge_ops", "SRJT_SANDBOX_BRIDGE_OPS", "", str,
+          "csv of bridge op names (e.g. 'hash.murmur3') dispatched in the "
+          "heavier package-importing sandbox worker; '' = no bridge ops "
+          "sandboxed")
+_register("sandbox.max_replays", "SRJT_SANDBOX_MAX_REPLAYS", 3, int,
+          "crashes one input may cause before it is quarantined "
+          "(QuarantinedInputError, handled like CORRUPTION); 0 disables "
+          "quarantine")
+_register("sandbox.call_timeout_s", "SRJT_SANDBOX_CALL_TIMEOUT_S", 0.0,
+          float,
+          "hard per-call cap on a sandbox worker response in addition to "
+          "the caller's Deadline; a silent worker is killed and the call "
+          "classifies CRASH; 0 = Deadline/watchdog only")
+_register("breaker.enabled", "SRJT_BREAKER_ENABLED", True, _parse_bool,
+          "per-surface circuit breakers (faultinj/breaker.py): a surface "
+          "failing breaker.threshold times within breaker.window_s opens "
+          "and routes to its degraded path without paying the retry "
+          "ladder per call")
+_register("breaker.threshold", "SRJT_BREAKER_THRESHOLD", 5, int,
+          "failures within breaker.window_s that open a surface's "
+          "breaker; 0 disables")
+_register("breaker.window_s", "SRJT_BREAKER_WINDOW_S", 30.0, float,
+          "sliding window for breaker failure counting; 0 = unwindowed "
+          "(failures never age out)")
+_register("breaker.cooldown_s", "SRJT_BREAKER_COOLDOWN_S", 5.0, float,
+          "time an open breaker waits before going half-open and "
+          "admitting one probe (probe success closes it, failure re-opens "
+          "with a fresh cooldown)")
+_register("drain.timeout_s", "SRJT_DRAIN_TIMEOUT_S", 30.0, float,
+          "deadline for TaskExecutor.drain(): stop admission, run "
+          "in-flight tasks to completion, flush+fsync the SpillStore, "
+          "stop sandbox workers, report a verdict")
 
 
 def get(key: str) -> Any:

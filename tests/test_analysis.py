@@ -574,6 +574,47 @@ def test_srjt009_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT010 — native library load outside the sanctioned loader modules
+# ---------------------------------------------------------------------------
+
+SRC_010 = """
+    import ctypes
+    from spark_rapids_jni_tpu.utils.nativeload import load_native
+
+    def grab():
+        h1 = ctypes.CDLL("libfoo.so")
+        h2 = load_native("bar", [])
+        return h1, h2
+"""
+
+
+def test_srjt010_triggers():
+    fs = run(SRC_010, path="pkg/new_surface.py")
+    assert rules_of(fs) == {"SRJT010"}
+    assert len(fs) == 2  # raw CDLL + out-of-loader load_native
+    assert any("ctypes.CDLL" in f.message for f in fs)
+    assert any("load_native" in f.message for f in fs)
+
+
+def test_srjt010_sanctioned_loaders_exempt():
+    # the loaders themselves, the bridge host, and the sandbox tier own
+    # their dlopens — no findings there
+    for path in ("pkg/utils/nativeload.py", "pkg/memory/native.py",
+                 "pkg/bridge.py", "pkg/faultinj/sandbox.py",
+                 "pkg/faultinj/_sandbox_worker.py"):
+        assert run(SRC_010, path=path) == []
+
+
+def test_srjt010_noqa():
+    assert run(SRC_010.replace(
+        'ctypes.CDLL("libfoo.so")',
+        'ctypes.CDLL("libfoo.so")  # srjt: noqa[SRJT010]').replace(
+        'load_native("bar", [])',
+        'load_native("bar", [])  # srjt: noqa[SRJT010]'),
+        path="pkg/new_surface.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -593,7 +634,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 9
+    assert len(FILE_RULES) == 10
 
 
 def test_syntax_error_is_reported_not_raised():

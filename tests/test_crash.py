@@ -1,0 +1,414 @@
+"""Crash chaos: process-isolated native dispatch under injectionType-5
+storms.
+
+Mirror of test_chaos.py / test_watchdog.py for the CRASH fault domain:
+the sandboxed native surfaces (parquet page decode, parse_uri, opt-in
+bridge ops) run under fault configs that KILL the hosting worker process
+(os.abort / SIGKILL / nonzero exit) at 100% rates. Every injected crash
+must be DETECTED (crash_detected == injected_crashes), the worker
+respawned, the submission replayed by the TaskExecutor against
+task.retry_budget, and the results BIT-IDENTICAL to the fault-free run —
+the executor process itself never dies. An input that keeps killing
+workers quarantines after sandbox.max_replays; a surface that keeps
+killing workers trips its circuit breaker (open → half-open probe →
+closed / re-open), collapsing per-call cost to a state read while open.
+A post-storm drain() must report a clean verdict.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu import bridge
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.faultinj import (
+    QuarantinedInputError,
+    WorkerCrashError,
+    breaker,
+    classify,
+    guard,
+    install,
+    uninstall,
+    watchdog,
+)
+from spark_rapids_jni_tpu.faultinj import sandbox
+from spark_rapids_jni_tpu.faultinj.watchdog import Deadline
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.ops.parse_uri import parse_uri_to_host
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.parquet import read_parquet
+from spark_rapids_jni_tpu.utils import config
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    watchdog.reset()
+    breaker.reset_all()
+    sandbox.reset_quarantine()
+    sandbox.shutdown_all()
+    yield
+    uninstall()
+    sandbox.shutdown_all()
+    sandbox.reset_quarantine()
+    breaker.reset_all()
+    watchdog.reset()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002):
+        yield
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "crash.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def crash_cfg(apis, count=2, mode="abort", code=None, percent=100):
+    """injectionType 5 rules: the parent samples the rule, the directive
+    executes inside the sandbox worker (real process death)."""
+    rule = {"percent": percent, "injectionType": 5,
+            "interceptionCount": count, "crashMode": mode}
+    if code is not None:
+        rule["substituteReturnCode"] = code
+    return {"xlaRuntimeFaults": {api: dict(rule) for api in apis}}
+
+
+def metrics():
+    return RmmSpark.get_fault_domain_metrics()
+
+
+def _pq_file(tmp_path, rows=4000):
+    rng = np.random.default_rng(11)
+    table = pa.table({"v": pa.array(rng.integers(-10**9, 10**9, rows),
+                                    pa.int64())})
+    path = str(tmp_path / "crash.parquet")
+    pq.write_table(table, path, write_page_checksum=True,
+                   compression="snappy")
+    return path, table.column("v").to_pylist()
+
+
+def _urls_col(n=64):
+    urls = [f"https://host{i}.example.com:80{i % 10}/p/{i}?q={i}"
+            for i in range(n)]
+    return Column.from_pylist(urls, dt.STRING)
+
+
+# ---------------------------------------------------------------------------
+# sandbox dispatch: bit-identical, worker reuse, exception relay
+# ---------------------------------------------------------------------------
+
+def test_sandboxed_reads_bit_identical_and_worker_reused(tmp_path):
+    """The sandbox route must change WHERE the native code runs, not what
+    it returns — and consecutive calls share one worker process."""
+    path, want = _pq_file(tmp_path)
+    col = _urls_col()
+    want_hosts = parse_uri_to_host(col).to_pylist()  # in-process reference
+
+    with config.override("sandbox.enabled", True):
+        assert read_parquet(path)[0].to_pylist() == want
+        pid1 = sandbox.get_worker("native")._proc.pid
+        assert read_parquet(path)[0].to_pylist() == want
+        assert parse_uri_to_host(col).to_pylist() == want_hosts
+        assert sandbox.get_worker("native")._proc.pid == pid1
+
+    m = metrics()
+    assert m["crash_detected"] == 0
+    assert m["worker_respawns"] == 0
+
+
+def test_worker_exception_relays_and_worker_survives():
+    """A worker that ANSWERS with an exception is a healthy surface: the
+    error re-raises in the parent, the process stays up, and the breaker
+    records a success, not a failure."""
+    with config.override("sandbox.enabled", True):
+        with pytest.raises(Exception):
+            # bogus .so path: dlopen fails inside the worker, relays back
+            sandbox.sandbox_call(
+                "parse_uri", sandbox.file_target("parse_uri_target"),
+                "/nonexistent/libnope.so", np.zeros(1, np.uint8),
+                np.array([0, 0], np.int64), None, 0, 0,
+                None, None, None, 0)
+        w = sandbox.get_worker("native")
+        assert w.alive()
+        assert breaker.get_breaker("parse_uri").state() == "closed"
+    assert metrics()["crash_detected"] == 0
+
+
+def test_crash_modes_report_signal_and_exit_code():
+    """abort → SIGABRT, kill → SIGKILL, exit → the configured code; the
+    death verdict carries the signum/exitcode for diagnostics."""
+    w = sandbox.get_worker("native")
+    probe = sandbox.file_target("probe_target")
+
+    with pytest.raises(WorkerCrashError) as ei:
+        w.call("p", probe, (1,), None, crash={"mode": "abort", "code": 1})
+    assert ei.value.signum == signal.SIGABRT
+
+    with pytest.raises(WorkerCrashError) as ei:
+        w.call("p", probe, (1,), None, crash={"mode": "kill", "code": 1})
+    assert ei.value.signum == signal.SIGKILL
+
+    with pytest.raises(WorkerCrashError) as ei:
+        w.call("p", probe, (1,), None, crash={"mode": "exit", "code": 3})
+    assert ei.value.exitcode == 3
+    assert classify(ei.value) == guard.CRASH
+
+
+# ---------------------------------------------------------------------------
+# 100% crash storms under the TaskExecutor replay ladder
+# ---------------------------------------------------------------------------
+
+def test_crash_storm_bit_identical_and_drain_clean(tmp_path):
+    """THE acceptance storm: 100% injectionType-5 on every sandboxed
+    native surface. Each crash is real process death; the executor
+    replays to bit-identical results, never dies, and a post-storm
+    drain() reports a clean verdict."""
+    path, want = _pq_file(tmp_path)
+    col = _urls_col()
+    want_hosts = parse_uri_to_host(col).to_pylist()
+
+    install(write_cfg(tmp_path, crash_cfg(
+        ("parquet_page_decode", "parse_uri"), count=2)), seed=0)
+    with config.override("sandbox.enabled", True), TaskExecutor() as ex:
+        f_pq = ex.submit(1, read_parquet, path)
+        f_uri = ex.submit(2, parse_uri_to_host, col)
+        assert f_pq.result(timeout=60)[0].to_pylist() == want
+        assert f_uri.result(timeout=60).to_pylist() == want_hosts
+
+        m = metrics()
+        assert m["injected_crashes"] == 4          # 2 per surface
+        assert m["crash_detected"] == m["injected_crashes"]
+        assert m["worker_respawns"] == 4           # one respawn per death
+        assert m["task_retries"] >= 4
+
+        # the executor is alive and admitting
+        assert ex.submit(3, lambda: 42).result(timeout=30) == 42
+
+        verdict = ex.drain()
+        assert verdict["clean"]
+        assert not verdict["already_closed"]
+        assert verdict["stragglers"] == []
+        assert verdict["sandbox_workers_stopped"] >= 0
+    assert metrics()["drains"] >= 1
+
+
+def test_bridge_op_crash_storm_replays(tmp_path):
+    """Opt-in bridge sandboxing: a crash inside a sandboxed op replays on
+    a fresh heavy worker to a bit-identical wire result."""
+    col = Column.from_pylist(list(range(256)), dt.INT64)
+    args = json.dumps({"seed": 42})
+    clean, _ = bridge.call("hash.murmur3", args, [bridge.col_to_wire(col)])
+
+    install(write_cfg(tmp_path, crash_cfg(("hash.murmur3",), count=1)),
+            seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("sandbox.bridge_ops", "hash.murmur3"), \
+            TaskExecutor() as ex:
+        fut = ex.submit(1, bridge.call, "hash.murmur3", args,
+                        [bridge.col_to_wire(col)])
+        stormed, _ = fut.result(timeout=120)
+    assert stormed == clean
+    m = metrics()
+    assert m["injected_crashes"] == 1
+    assert m["crash_detected"] == 1
+
+
+def test_quarantine_after_max_replays(tmp_path):
+    """An input that crashes sandbox.max_replays workers in a row is
+    quarantined — the next dispatch refuses it up front with a
+    CorruptionError subclass instead of burning another worker."""
+    path, _ = _pq_file(tmp_path)
+    install(write_cfg(tmp_path, crash_cfg(("parquet_page_decode",),
+                                          count=100)), seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("sandbox.max_replays", 2), \
+            config.override("breaker.threshold", 100):
+        for _ in range(2):
+            with pytest.raises(WorkerCrashError):
+                read_parquet(path)
+        with pytest.raises(QuarantinedInputError):
+            read_parquet(path)
+    m = metrics()
+    assert m["quarantined_inputs"] == 1
+    assert m["crash_detected"] == 2  # the quarantined dispatch burned none
+
+
+def test_hung_worker_killed_and_classified_crash():
+    """A worker that stops responding is not waited on: the caller's
+    Deadline escalates, the worker is killed, and the failure classifies
+    CRASH (recoverable) — an unbounded native wedge becomes a fault."""
+    with config.override("watchdog.poll_period_s", 0.02):
+        with pytest.raises(WorkerCrashError) as ei:
+            with Deadline(0.3, "sandbox-hang"):
+                sandbox.sandbox_call(
+                    "probe_hang", sandbox.file_target("sleep_target"), 30.0)
+    assert "hung worker" in str(ei.value)
+    assert classify(ei.value) == guard.CRASH
+    assert not sandbox.get_worker("native").alive()
+    assert breaker.get_breaker("probe_hang").state() != "closed" or \
+        breaker.get_breaker("probe_hang")._failures  # failure recorded
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers: trip, half-open probe, per-surface isolation
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_open_and_cost_collapses(tmp_path):
+    """Sustained crashes trip the surface's breaker: callers route to the
+    in-process path (still correct answers), workers stop being burned,
+    and the per-call cost is a short-circuit counter, not a respawn."""
+    col = _urls_col()
+    want_hosts = parse_uri_to_host(col).to_pylist()
+    install(write_cfg(tmp_path, crash_cfg(("parse_uri",), count=100)),
+            seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("breaker.threshold", 3), \
+            config.override("breaker.cooldown_s", 300.0):
+        for _ in range(3):
+            with pytest.raises(WorkerCrashError):
+                parse_uri_to_host(col)
+        m = metrics()
+        assert m["breaker_opened"] == 1
+        assert breaker.lookup("parse_uri").state() == "open"
+        respawns_at_open = m["worker_respawns"]
+
+        # open breaker: every call takes the degraded in-process path —
+        # correct results, zero new workers, short-circuits counted
+        for _ in range(5):
+            assert parse_uri_to_host(col).to_pylist() == want_hosts
+        m = metrics()
+        assert m["worker_respawns"] == respawns_at_open
+        assert m["breaker_short_circuits"] >= 5
+    assert breaker.states(non_closed_only=True) == {"parse_uri": "open"}
+
+
+def test_breaker_half_open_probe_success_closes(tmp_path):
+    """After the cooldown the breaker admits one probe; a healthy worker
+    closes it and the sandboxed path is re-enabled."""
+    col = _urls_col()
+    want_hosts = parse_uri_to_host(col).to_pylist()
+    install(write_cfg(tmp_path, crash_cfg(("parse_uri",), count=1)),
+            seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 0.15):
+        with pytest.raises(WorkerCrashError):
+            parse_uri_to_host(col)
+        assert breaker.lookup("parse_uri").state() == "open"
+        assert metrics()["breaker_opened"] == 1
+
+        time.sleep(0.2)  # cooldown elapses → half-open admits the probe
+        assert parse_uri_to_host(col).to_pylist() == want_hosts
+        assert breaker.lookup("parse_uri").state() == "closed"
+        assert metrics()["breaker_closed"] == 1
+        # device/sandbox path re-enabled: the next call routes sandboxed
+        assert sandbox.active("parse_uri")
+        assert parse_uri_to_host(col).to_pylist() == want_hosts
+        assert sandbox.get_worker("native").alive()
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown(tmp_path):
+    """A failed half-open probe re-opens the breaker and re-arms the full
+    cooldown — one crash, not a threshold's worth, keeps it open."""
+    col = _urls_col(16)
+    install(write_cfg(tmp_path, crash_cfg(("parse_uri",), count=100)),
+            seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 0.2):
+        with pytest.raises(WorkerCrashError):
+            parse_uri_to_host(col)
+        assert breaker.lookup("parse_uri").state() == "open"
+
+        time.sleep(0.25)
+        with pytest.raises(WorkerCrashError):  # the probe crashes too
+            parse_uri_to_host(col)
+        assert breaker.lookup("parse_uri").state() == "open"
+        assert metrics()["breaker_opened"] == 2
+        # fresh cooldown: immediately after the failed probe the surface
+        # short-circuits again (no second probe admitted yet)
+        assert not sandbox.active("parse_uri")
+        assert parse_uri_to_host(col).size == 16  # degraded path works
+
+
+def test_breaker_state_is_per_surface(tmp_path):
+    """A crashing parse_uri must not take parquet decode down with it."""
+    path, want = _pq_file(tmp_path)
+    col = _urls_col(16)
+    install(write_cfg(tmp_path, crash_cfg(("parse_uri",), count=100)),
+            seed=0)
+    with config.override("sandbox.enabled", True), \
+            config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 300.0):
+        with pytest.raises(WorkerCrashError):
+            parse_uri_to_host(col)
+        assert breaker.lookup("parse_uri").state() == "open"
+        # parquet still routes through its (healthy) sandbox worker
+        assert sandbox.active("parquet_page_decode")
+        assert read_parquet(path)[0].to_pylist() == want
+        assert breaker.get_breaker("parquet_page_decode").state() == "closed"
+
+
+# ---------------------------------------------------------------------------
+# graceful drain / executor lifecycle
+# ---------------------------------------------------------------------------
+
+def test_drain_stops_admission_and_reports_verdict():
+    results = []
+
+    def slowish(i):
+        time.sleep(0.05)
+        results.append(i)
+        return i
+
+    ex = TaskExecutor()
+    futs = [ex.submit(i % 3, slowish, i) for i in range(6)]
+    verdict = ex.drain()
+    # every in-flight/queued submission ran to completion
+    assert sorted(f.result(timeout=1) for f in futs) == list(range(6))
+    assert sorted(results) == list(range(6))
+    assert verdict["clean"]
+    assert verdict["tasks_completed"] >= 1
+    assert verdict["stragglers"] == []
+    assert verdict["lost_workers"] == 0
+    assert ex.last_drain is verdict
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit(9, lambda: 1)
+    # idempotent: the second drain reports already_closed
+    assert ex.drain()["already_closed"]
+    assert metrics()["drains"] >= 2
+
+
+def test_sigterm_triggers_drain_and_chains_handler():
+    seen = []
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append("outer"))
+        ex = TaskExecutor()
+        ex.submit(1, lambda: 7)
+        ex.install_sigterm_drain(chain=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        # signal delivery is synchronous in the main thread on return
+        # from the kill syscall; the handler ran drain() then chained
+        assert ex.last_drain is not None
+        assert ex.last_drain["clean"]
+        assert seen == ["outer"]
+        with pytest.raises(RuntimeError, match="closed"):
+            ex.submit(2, lambda: 1)
+    finally:
+        signal.signal(signal.SIGTERM, orig)

@@ -178,6 +178,22 @@ def test_dynamic_reload_switches_to_bitflip_rule(tmp_path):
     assert arr.any()
 
 
+def test_unknown_injection_type_fails_loudly(tmp_path):
+    """A chaos-config typo must not construct a rule that silently never
+    fires: the load rejects unknown injectionTypes, naming the rule and
+    the known types."""
+    path = write_cfg(tmp_path, {
+        "xlaRuntimeFaults": {
+            "some_surface": {"percent": 100, "injectionType": 9,
+                             "interceptionCount": 1}}})
+    with pytest.raises(ValueError) as ei:
+        install(path, seed=0)
+    msg = str(ei.value)
+    assert "some_surface" in msg
+    assert "injectionType 9" in msg
+    assert "5=worker crash" in msg  # the full known-type list is spelled out
+
+
 def test_uninstall_restores(tmp_path):
     path = write_cfg(tmp_path, {
         "xlaRuntimeFaults": {

@@ -111,3 +111,38 @@ def test_closed_executor_rejects_submits():
     ex.close()
     with pytest.raises(RuntimeError, match="closed"):
         ex.submit(1, lambda: 1)
+
+
+def test_lost_worker_releases_rmm_thread_association():
+    """Regression: a worker declared lost never runs its own cleanup, so
+    unless the executor releases its RmmSpark thread association the
+    native deadlock sweep counts the dead tid as BLOCKED forever. The
+    lost-worker path must erase the association WHILE the wedged thread
+    is still sleeping."""
+    from spark_rapids_jni_tpu.utils import config
+
+    tids = []
+
+    def wedge():
+        tids.append(RmmSpark.get_current_thread_id())
+        if len(tids) == 1:
+            time.sleep(1.5)  # deaf to the cancel token on purpose
+            return "wedged"
+        return "recovered"
+
+    RmmSpark.set_event_handler(pool_bytes=64 * MB, watchdog_period_s=0.02)
+    try:
+        with config.override("task.budget_s", 0.2), \
+                config.override("watchdog.lost_after_s", 0.2), \
+                config.override("watchdog.poll_period_s", 0.02), \
+                config.override("task.retry_budget", 3), \
+                TaskExecutor() as ex:
+            fut = ex.submit(21, wedge)
+            assert fut.result(timeout=30) == "recovered"
+            # the lost worker's thread is STILL asleep here — but its
+            # association must already be gone (TS_UNKNOWN = -1), not
+            # BLOCKED, or the native deadlock sweep misfires on a corpse
+            assert len(tids) >= 1
+            assert RmmSpark.get_state_of(tids[0]) == -1
+    finally:
+        RmmSpark.clear_event_handler()
