@@ -448,6 +448,9 @@ def _sweep(deadline):
             "mrows_per_s_best": round(rows / secs[0] / 1e6, 2),
             "gb_per_s": round(nbytes / med / 1e9, 3),
         }
+        # plan-engine benches record their compile/execute split and
+        # cache hit/miss counts (last repeat = steady state: hits only)
+        results[name].update(_B().pop_extra())
         if err:
             results[name]["repeat_error"] = err
         _log(f"  {name}: {results[name]['mrows_per_s']} Mrows/s "

@@ -55,6 +55,46 @@ def _time(fn, warmup=1, iters=3):
 # run take effect (clamped to >= 1 — zero variants would index nothing).
 _NVARIANTS = 2
 
+# Extra row fields from the last bench run. The tpch benches that execute
+# through the whole-plan compiler record the compile-vs-execute time split
+# and plan-cache hit/miss counts here; every row emitter (main() below,
+# bench.py _sweep, ci/axis_runner.py) merges them via pop_extra().
+LAST_EXTRA = {}
+
+
+def pop_extra() -> dict:
+    """Return and clear the last bench run's extra row fields."""
+    out = dict(LAST_EXTRA)
+    LAST_EXTRA.clear()
+    return out
+
+
+def _with_plan_extra(timed):
+    """Run a timed bench thunk, capturing plan-engine metric deltas.
+
+    Populates LAST_EXTRA only when the thunk actually executed fused
+    plans (mesh runs and eager fallbacks leave the counters untouched,
+    so rows stay honest about which engine produced the number)."""
+    from spark_rapids_jni_tpu.plan import plan_metrics
+    LAST_EXTRA.clear()
+    before = plan_metrics.snapshot()
+    result = timed()
+    after = plan_metrics.snapshot()
+    if after["plan_executes"] > before["plan_executes"]:
+        LAST_EXTRA.update({
+            "engine": "plan",
+            "compile_s": round(after["compile_s"] - before["compile_s"], 6),
+            "execute_s": round(after["execute_s"] - before["execute_s"], 6),
+            "plan_cache_hits":
+                after["plan_cache_hits"] - before["plan_cache_hits"],
+            "plan_cache_misses":
+                after["plan_cache_misses"] - before["plan_cache_misses"],
+        })
+        fallbacks = after["plan_fallbacks"] - before["plan_fallbacks"]
+        if fallbacks:
+            LAST_EXTRA["plan_fallbacks"] = fallbacks
+    return result
+
 
 def _refresh_variants() -> None:
     global _NVARIANTS
@@ -261,7 +301,7 @@ def bench_tpch_q1(rows: int, mesh_devices: int = 0):
         out = run_q1(datasets[i % _NVARIANTS], mesh=mesh)
         return [c.data for c in out.columns]
 
-    sec = _time(run, warmup=_NVARIANTS)
+    sec = _with_plan_extra(lambda: _time(run, warmup=_NVARIANTS))
     # q1 touches the full lineitem row: 2 int64 + 5 int32 per row
     return sec, rows * (2 * 8 + 5 * 4)
 
@@ -273,8 +313,9 @@ def bench_tpch_q6(rows: int, mesh_devices: int = 0):
     mesh = _query_mesh(mesh_devices)
     datasets = [generate_q1_lineitem(rows, seed=s)
                 for s in range(_NVARIANTS)]
-    sec = _time(lambda i: run_q6(datasets[i % _NVARIANTS], mesh=mesh),
-                warmup=_NVARIANTS)
+    sec = _with_plan_extra(
+        lambda: _time(lambda i: run_q6(datasets[i % _NVARIANTS], mesh=mesh),
+                      warmup=_NVARIANTS))
     # q6 touches qty i64 + price i64 + disc i32 + shipdate i32
     return sec, rows * (2 * 8 + 2 * 4)
 
@@ -293,7 +334,7 @@ def bench_tpch_q5(rows: int, mesh_devices: int = 0):
         out = run_q5(*datasets[i % _NVARIANTS], mesh=mesh)
         return [c.data for c in out.columns]
 
-    sec = _time(run, warmup=_NVARIANTS)
+    sec = _with_plan_extra(lambda: _time(run, warmup=_NVARIANTS))
     nbytes = rows * 28
     return sec, nbytes
 
@@ -535,6 +576,9 @@ def main():
             "rows_per_s": round(rows / sec, 1),
             "gb_per_s": round(nbytes / sec / 1e9, 4),
         }
+        # plan-engine split (compile_s/execute_s, cache hits/misses) for
+        # benches that ran through the whole-plan compiler
+        row.update(pop_extra())
         # a tripped breaker means the numbers above measured the degraded
         # path, not the surface — record it so sweeps are interpretable
         tripped = breaker.states(non_closed_only=True)

@@ -23,12 +23,30 @@ from spark_rapids_jni_tpu.columnar.table_ops import (
 from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 from spark_rapids_jni_tpu.ops.join import inner_join
 from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Project, Scan, Sort,
+                                       col, execute_plan, i64, lit)
 
 
 def _backend() -> str:
     """Seam for tests to force the accelerator (mask-pushdown) planning."""
     import jax
     return jax.default_backend()
+
+
+def _use_plan(engine: str, rows: int, mesh) -> bool:
+    """Engine selection for the local queries. ``"plan"`` forces the fused
+    whole-plan path, ``"eager"`` forces op-by-op, ``"auto"`` (the default)
+    fuses only at or above the ``plan.min_rows`` amortization floor —
+    below it a fresh (plan, shape) XLA compile costs more than the saved
+    per-op dispatches and syncs. Mesh runs always take the distributed
+    eager path (the plan IR is single-device)."""
+    if mesh is not None or engine == "eager":
+        return False
+    if engine == "plan":
+        return True
+    from spark_rapids_jni_tpu.utils import config
+    return rows >= int(config.get("plan.min_rows"))
+
 
 CUTOFF_DAYS = 1200  # "1995-03-15" as days into the generated date range
 
@@ -205,11 +223,17 @@ def generate_q5_tables(rows: int, seed: int):
 
 def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
            nation: Table, region_code: int = 2, date_lo: int = 700,
-           date_hi: int = 1065, mesh=None) -> Table:
+           date_hi: int = 1065, mesh=None, engine: str = "auto") -> Table:
     """TPC-H q5 shape: local-supplier-volume — region-filtered nations,
     customer⋈orders (date window), lineitem⋈orders, lineitem⋈supplier, the
     c_nationkey = s_nationkey co-nation predicate, then revenue per nation
-    sorted descending. Returns (n_nationkey, revenue)."""
+    sorted descending. Returns (n_nationkey, revenue).
+
+    The post-join tail (co-nation filter, revenue groupby, desc sort) runs
+    through the whole-plan compiler when local and at or above the
+    ``plan.min_rows`` floor (``engine="plan"`` forces it);
+    ``engine="eager"`` forces the op-by-op path (the equivalence
+    oracle)."""
     od = orders.columns[2].data
     join, group = _plan_ops(mesh)
 
@@ -241,8 +265,19 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     same = cnat_j.columns[0].data == snat.columns[0].data
     rev_all = (li_jj.columns[2].data.astype(jnp.int64)
                * (100 - li_jj.columns[3].data.astype(jnp.int64)))
+    nrows = int(rev_all.shape[0])
+    if _use_plan(engine, nrows, mesh):
+        # post-join tail as ONE fused XLA program (filter -> groupby ->
+        # sort-desc), one guarded dispatch, one host sync
+        gt3 = Table((snat.columns[0],
+                     Column(dt.INT64, nrows, data=rev_all),
+                     Column(dt.BOOL8, nrows,
+                            data=same.astype(jnp.uint8))))
+        tail = Sort(GroupBy(Filter(Scan(3), col(2)), (0,), ((1, "sum"),)),
+                    (1,), ascending=(False,))
+        return execute_plan(tail, gt3)
     gt = Table((snat.columns[0],
-                Column(dt.INT64, int(rev_all.shape[0]), data=rev_all)))
+                Column(dt.INT64, nrows, data=rev_all)))
     # co-nation predicate rides the group's row_mask pushdown
     g = group(gt, [0], [(1, "sum")], row_mask=same)
     return sort_table(g, [1], ascending=[False])
@@ -301,17 +336,47 @@ def generate_q1_lineitem(rows: int, seed: int) -> Table:
     ))
 
 
-def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
+def _q1_plan(cutoff: int):
+    """q1 as a logical plan: filter -> project -> groupby -> sort. The
+    projection mirrors the eager body's int64 cents/pct math
+    expression-for-expression (bit-identity by shared evaluator)."""
+    filt = Filter(Scan(7), col(6) <= lit(cutoff))
+    disc_price = i64(col(1)) * (lit(100) - i64(col(2)))
+    charge = disc_price * (lit(100) + i64(col(3)))
+    proj = Project(filt, (
+        col(4), col(5),                  # returnflag, linestatus keys
+        i64(col(0)),                     # qty
+        i64(col(1)),                     # price
+        disc_price, charge,
+        i64(col(2)),                     # disc
+    ))
+    gb = GroupBy(proj, (0, 1),
+                 ((2, "sum"), (3, "sum"), (4, "sum"), (5, "sum"),
+                  (2, "mean"), (3, "mean"), (6, "mean"), (2, "count")))
+    return Sort(gb, (0, 1))
+
+
+def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None,
+           engine: str = "auto") -> Table:
     """TPC-H q1 shape: pricing summary report. Filter shipdate <= cutoff,
     group by (returnflag, linestatus): sum qty, sum base price, sum
     discounted price, sum charge, avg qty, avg price, avg discount, count.
     Money/derived sums stay in exact int64 (cents × pct scales); averages
     are FLOAT64. Sorted by the two group keys.
 
+    Local execution at or above the ``plan.min_rows`` floor fuses the
+    whole pipeline into one jitted XLA program (``plan/``): one guarded
+    dispatch, one host sync, compile-once-per-shape. ``engine="plan"``
+    forces fusion at any size; ``engine="eager"`` keeps the op-by-op path
+    (mask pushdown into the groupby) — the oracle the plan equivalence
+    tests compare against.
+
     Reference-role note: the reference library supplies the kernels for
     this composition (groupby/sort via its vendored layer); the pipeline
     itself exercises BASELINE configs[1]-style aggregation at q1's shape.
     """
+    if _use_plan(engine, lineitem.num_rows, mesh):
+        return execute_plan(_q1_plan(cutoff), lineitem)
     keep = lineitem.columns[6].data <= cutoff
     _, group = _plan_ops(mesh)
     # one plan for both modes: the filter rides group's row_mask pushdown
@@ -338,9 +403,25 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None) -> Table:
 
 def run_q6(lineitem: Table, date_lo: int = 365, date_hi: int = 730,
            disc_lo: int = 5, disc_hi: int = 7, qty_max: int = 24,
-           mesh=None) -> int:
+           mesh=None, engine: str = "auto") -> int:
     """TPC-H q6 shape: forecast-revenue-change — one filtered sum.
-    Returns revenue in cents·pct as an exact python int."""
+    Returns revenue in cents·pct as an exact python int.
+
+    Locally at or above the ``plan.min_rows`` floor this runs as a
+    constant-key fused plan (filter -> project a literal key + revenue ->
+    single-group sum): exact int64 arithmetic makes it equal to the eager
+    masked sum (``engine="eager"``; ``engine="plan"`` forces fusion)."""
+    if _use_plan(engine, lineitem.num_rows, mesh):
+        p = GroupBy(
+            Project(Filter(Scan(7),
+                           (col(6) >= lit(date_lo)) & (col(6) < lit(date_hi))
+                           & (col(2) >= lit(disc_lo))
+                           & (col(2) <= lit(disc_hi))
+                           & (col(0) < lit(qty_max))),
+                    (i64(lit(0)), i64(col(1)) * i64(col(2)))),
+            (0,), ((1, "sum"),))
+        g = execute_plan(p, lineitem)
+        return int(np.asarray(g.columns[1].data)[0]) if g.num_rows else 0
     sd = lineitem.columns[6].data
     disc = lineitem.columns[2].data
     qty = lineitem.columns[0].data

@@ -87,7 +87,7 @@ def main():
         return 4
     secs.sort()
     med = statistics.median(secs)
-    print(json.dumps({
+    row = {
         "axis": axis,
         "backend": backend,
         "rows": rows,
@@ -97,7 +97,11 @@ def main():
         "mrows_per_s": round(rows / med / 1e6, 2),
         "mrows_per_s_best": round(rows / secs[0] / 1e6, 2),
         "gb_per_s": round(nbytes / med / 1e9, 3),
-    }))
+    }
+    # plan-engine axes record their compile/execute split and cache
+    # hit/miss counts (last repeat = steady state: hits only)
+    row.update(bench._B().pop_extra())
+    print(json.dumps(row))
     return 0
 
 

@@ -18,6 +18,7 @@ Rule IDs:
   SRJT009  unbounded blocking wait on a guarded/dispatch surface
   SRJT010  native library load / handle acquisition outside the
            sanctioned loader modules
+  SRJT011  host sync or dispatch guard inside a plan-registered op core
 """
 
 from __future__ import annotations
@@ -762,8 +763,69 @@ def rule_srjt010(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT011 — host sync / dispatch guard inside a plan-registered op core
+# ---------------------------------------------------------------------------
+
+# The whole-plan compiler (plan/compile.py) traces @plan_core functions
+# into ONE fused XLA program. A host sync inside a core would either fail
+# at trace time or silently split the program; a guarded_dispatch inside
+# one would nest retry scopes (double-retry on TRANSIENT) under the
+# executor's single plan_execute boundary. The pure-core contract is
+# stated in plan/registry.py.
+
+
+def _plan_core_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dn = _dotted(target)
+        if dn is not None and dn.split(".")[-1] == "plan_core":
+            return True
+    return False
+
+
+def rule_srjt011(tree, rel, lines, ctx) -> List[Finding]:
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        core = None
+        for a in anc:
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _plan_core_decorated(a):
+                core = a
+        if core is None:
+            continue
+        dn = _dotted(node.func)
+        what = None
+        if dn is not None and dn.split(".")[-1] == "guarded_dispatch":
+            what = "guarded_dispatch(...)"
+        elif dn in _HOST_SYNC_CALLS:
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue  # literal args never touch a device buffer
+            what = dn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _HOST_SYNC_METHODS):
+            what = f".{node.func.attr}()"
+        elif dn in ("float", "int", "bool") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _is_shape_expr(arg):
+                continue
+            what = f"{dn}()"
+        if what is not None:
+            findings.append(Finding(
+                "SRJT011", rel, node.lineno,
+                f"`{what}` inside plan core `{core.name}` — plan-registered "
+                f"op cores must stay pure jnp: they trace into one fused "
+                f"XLA program, and the guard/retry/sync boundary is the "
+                f"single guarded_dispatch(\"plan_execute\") in "
+                f"plan/executor.py (contract: plan/registry.py)"))
+    return findings
+
+
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
-              rule_srjt008_counters, rule_srjt009, rule_srjt010)
+              rule_srjt008_counters, rule_srjt009, rule_srjt010,
+              rule_srjt011)
 PROJECT_RULES = (project_rule_srjt008_spans,)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -19,6 +19,7 @@ import numpy as np
 
 from . import dtype as dt
 from .column import Column, Table
+from ..plan.registry import plan_core
 
 
 def gather_column(col: Column, idx, out_of_bounds_null: bool = False) -> Column:
@@ -114,13 +115,22 @@ def slice_table(table: Table, start: int, end: int) -> Table:
     return gather_table(table, idx)
 
 
+@plan_core("mask_indices")
+def mask_indices_core(mask, size: int) -> jnp.ndarray:
+    """int32 row indices where ``mask`` is True, in row order, given the
+    STATIC surviving-row count ``size``. Pure device op: callers that
+    already know the count (the plan executor trims with the fused
+    program's own live counter) compose this under one jit with no sync."""
+    return jnp.nonzero(mask, size=size, fill_value=0)[0].astype(jnp.int32)
+
+
 def filter_mask_indices(mask) -> jnp.ndarray:
     """int32 row indices where ``mask`` is True, in row order. One host sync
     (the surviving-row count — a data-dependent output shape, same contract
     as join gather-map sizing)."""
     mask = jnp.asarray(mask, dtype=bool)
     m = int(jnp.sum(mask))
-    return jnp.nonzero(mask, size=m, fill_value=0)[0].astype(jnp.int32)
+    return mask_indices_core(mask, m)
 
 
 def filter_table(table: Table, mask) -> Table:

@@ -59,6 +59,11 @@ in addition to the injector's patched op entry points):
                          "exchange_stage" (sharded staging device_puts),
                          "exchange_verify" (shard checksum comparison)
   * ``reader.py``      — "parquet_page_decode", "parquet_device_decode"
+  * ``parse_uri.py``   — "parse_uri" (one guard over both the sandboxed
+                         and the in-process native path)
+  * ``plan/executor.py`` — "plan_execute" (the whole-plan compiler's
+                         single fused-program boundary; op cores inside
+                         the program are pure and carry no guards)
 
 Payload bit-flip surfaces (``injectionType: 3`` rules consumed by the
 memory/integrity.py hooks, not by exception checkpoints): "spill",

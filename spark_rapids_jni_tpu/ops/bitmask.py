@@ -13,6 +13,8 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ..plan.registry import plan_core
+
 
 def bitmask_bitwise_or(masks: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """OR of equal-length bool masks (utilities.cu:22 takes packed words;
@@ -27,6 +29,7 @@ def bitmask_bitwise_or(masks: Sequence[jnp.ndarray]) -> jnp.ndarray:
     return out
 
 
+@plan_core("pack_bool_mask")
 def pack_bool_mask(mask: jnp.ndarray) -> jnp.ndarray:
     """bool[n] -> uint32[ceil(n/32)] packed validity words (cudf layout)."""
     n = mask.shape[0]
@@ -38,6 +41,7 @@ def pack_bool_mask(mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(bits << shifts[None, :], axis=1, dtype=jnp.uint32)
 
 
+@plan_core("unpack_bool_mask")
 def unpack_bool_mask(words: jnp.ndarray, n: int) -> jnp.ndarray:
     """uint32[nwords] packed validity words -> bool[n]."""
     shifts = jnp.arange(32, dtype=jnp.uint32)

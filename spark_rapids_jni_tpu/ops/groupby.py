@@ -26,9 +26,10 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
+from ..plan.registry import plan_core
 from .float_bits import f64_bits_from_value
 from .hashing import spark_key_values
-from .sort import gather, sort_order
+from .sort import gather, sort_lanes, sort_order
 from ..utils.shapes import bucket_size
 from ..utils.tracing import func_range
 
@@ -52,6 +53,20 @@ def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
         vals = spark_key_values(col)
         same_val = jnp.take(vals, idx) == jnp.take(vals, pidx)
     return (v_cur & v_prev & same_val) | (~v_cur & ~v_prev)
+
+
+def _segment_structure(cmp_keys, order):
+    """(boundary i32[n], seg_ids i32[n]) over the sorted rows — pure jnp,
+    shared verbatim by the eager op and the fused plan core so both paths
+    segment identically. Callers guarantee n >= 1."""
+    n = cmp_keys[0].size
+    same = jnp.ones(n - 1, dtype=bool) if n > 1 else jnp.zeros(0, dtype=bool)
+    for k in cmp_keys:
+        same = same & _keys_equal_prev(k, order)
+    boundary = jnp.concatenate([jnp.ones(1, dtype=jnp.int32),
+                                (~same).astype(jnp.int32)])
+    seg_ids = jnp.cumsum(boundary) - 1
+    return boundary, seg_ids
 
 
 def _decimal128_segment_sum(vcol: Column, order, valid, seg_ids,
@@ -155,6 +170,52 @@ def _decimal128_segment_mean(vcol: Column, order, valid, seg_ids,
                   validity=(cnt > 0) & ~overflow)
 
 
+def _segment_agg_fixed(vcol: Column, order, valid, seg_ids,
+                       num_segments: int, cnt, op: str) -> Column:
+    """One non-decimal aggregation over sorted segments — the pure jnp
+    body shared by the eager op and the fused plan core. ``valid`` is the
+    per-sorted-row contribution mask (null mask, optionally ANDed with a
+    pushed-down row mask by the fused core); masked rows contribute the
+    op's identity, so the caller's ``cnt`` (segment_sum of ``valid``)
+    already carries the null/mask semantics."""
+    out_dtype = _agg_out_dtype(vcol.dtype, op)  # validates op/type pair
+    if op == "count":
+        return Column(dt.INT64, num_segments, data=cnt)
+    vals, is_float = _agg_values(vcol)
+    vals = jnp.take(vals, order)
+    any_valid = cnt > 0
+    if op in ("sum", "mean"):
+        z = jnp.where(valid, vals, jnp.zeros_like(vals))
+        s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments,
+                                indices_are_sorted=True)
+        if op == "mean":
+            m = s / jnp.maximum(cnt, 1).astype(s.dtype)
+            return Column(dt.FLOAT64, num_segments,
+                          data=f64_bits_from_value(m), validity=any_valid)
+        res = s
+    elif op == "min":
+        big = (jnp.asarray(np.inf, vals.dtype) if is_float
+               else jnp.iinfo(jnp.int64).max)
+        z = jnp.where(valid, vals, big)
+        res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments,
+                                  indices_are_sorted=True)
+    elif op == "max":
+        small = (jnp.asarray(-np.inf, vals.dtype) if is_float
+                 else jnp.iinfo(jnp.int64).min)
+        z = jnp.where(valid, vals, small)
+        res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments,
+                                  indices_are_sorted=True)
+    else:
+        raise ValueError(f"unknown aggregation {op}")
+    if out_dtype.id is dt.TypeId.FLOAT64:
+        # device-native bit encode: the old from_numpy(np.asarray(...))
+        # route cost two D2H transfers per float output column
+        return Column(dt.FLOAT64, num_segments,
+                      data=f64_bits_from_value(res), validity=any_valid)
+    return Column(out_dtype, num_segments,
+                  data=res.astype(out_dtype.jnp_dtype), validity=any_valid)
+
+
 def _agg_values(col: Column) -> Tuple[jnp.ndarray, bool]:
     """(numeric device array, is_float) for aggregation. Floats accumulate in
     f64: Spark promotes float to double before summing."""
@@ -256,13 +317,7 @@ def _groupby_aggregate(
                     np.zeros((0,), dtype=od.np_dtype), od))
         return Table(tuple(out_cols))
 
-    same = jnp.ones(keys[0].size - 1, dtype=bool) \
-        if keys[0].size > 1 else jnp.zeros(0, dtype=bool)
-    for k in cmp_keys:
-        same = same & _keys_equal_prev(k, order)
-    boundary = jnp.concatenate([jnp.ones(1, dtype=jnp.int32),
-                                (~same).astype(jnp.int32)])
-    seg_ids = jnp.cumsum(boundary) - 1
+    boundary, seg_ids = _segment_structure(cmp_keys, order)
     if dead_col is None:
         true_segments = int(seg_ids[-1]) + 1  # the op's one host sync
         live_groups = true_segments
@@ -312,45 +367,70 @@ def _groupby_aggregate(
                     vcol, order, valid, seg_ids, num_segments, cnt > 0,
                     is_min=(op == "min")))
             continue
-        vals, is_float = _agg_values(vcol)
-        vals = jnp.take(vals, order)
-        any_valid = cnt > 0
-        if op in ("sum", "mean"):
-            z = jnp.where(valid, vals, jnp.zeros_like(vals))
-            s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments,
-                                    indices_are_sorted=True)
-            if op == "mean":
-                m = s / jnp.maximum(cnt, 1).astype(s.dtype)
-                out_cols.append(Column(
-                    dt.FLOAT64, num_segments,
-                    data=f64_bits_from_value(m), validity=any_valid))
-                continue
-            res = s
-        elif op == "min":
-            big = (jnp.asarray(np.inf, vals.dtype) if is_float
-                   else jnp.iinfo(jnp.int64).max)
-            z = jnp.where(valid, vals, big)
-            res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments,
-                                      indices_are_sorted=True)
-        elif op == "max":
-            small = (jnp.asarray(-np.inf, vals.dtype) if is_float
-                     else jnp.iinfo(jnp.int64).min)
-            z = jnp.where(valid, vals, small)
-            res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments,
-                                      indices_are_sorted=True)
-        else:
-            raise ValueError(f"unknown aggregation {op}")
-        if out_dtype.id is dt.TypeId.FLOAT64:
-            # device-native bit encode: the old from_numpy(np.asarray(...))
-            # route cost two D2H transfers per float output column
-            out_cols.append(Column(
-                dt.FLOAT64, num_segments,
-                data=f64_bits_from_value(res), validity=any_valid))
-        else:
-            out_cols.append(Column(out_dtype, num_segments,
-                                   data=res.astype(out_dtype.jnp_dtype),
-                                   validity=any_valid))
+        out_cols.append(_segment_agg_fixed(vcol, order, valid, seg_ids,
+                                           num_segments, cnt, op))
     return Table(tuple(_shrink(c, live_groups) for c in out_cols))
+
+
+@plan_core("groupby")
+def groupby_core(keys: List[Column], aggs: Sequence[Tuple[Column, str]],
+                 row_mask, num_segments: int):
+    """Pure jnp heart of sort-based groupby-aggregate for the fused
+    planner: same lanes, same stable lexsort, same segment math as the
+    eager op (literally shared helpers), but with a STATIC group-slot
+    count so the whole pipeline traces into one XLA program.
+
+    ``keys``: fixed-width key Columns (size n >= 1). ``aggs``: (value
+    Column, op) pairs. ``row_mask``: optional bool[n] filter pushdown.
+    ``num_segments``: static slot count G (a power-of-two bucket).
+
+    Returns ``(out_cols, live_groups, overflow)``: G-slot padded Columns
+    [keys..., one per agg] whose slots beyond ``live_groups`` (i32 device
+    scalar) are garbage the executor trims, and ``overflow`` (bool device
+    scalar) set when the true live group count exceeded G — the padded
+    results are then meaningless and the executor re-runs the query on
+    the eager op chain. Dead (masked) and overflowed rows contribute each
+    op's identity via the ``valid`` mask, so live slots are bit-identical
+    to the eager op's output.
+    """
+    n = keys[0].size
+    dead_col = None
+    if row_mask is not None:
+        dead_col = Column(dt.BOOL8, n, data=(~row_mask).astype(jnp.uint8))
+    cmp_keys = ([dead_col] + keys) if dead_col is not None else keys
+    lanes = sort_lanes(cmp_keys)
+    order = (jnp.lexsort(tuple(lanes)).astype(jnp.int32) if lanes
+             else jnp.arange(n, dtype=jnp.int32))
+    boundary, seg_ids = _segment_structure(cmp_keys, order)
+    if row_mask is None:
+        live_groups = (seg_ids[-1] + 1).astype(jnp.int32)
+    else:
+        # live rows sort first, so the segment of the last live row
+        # bounds the live prefix (same identity the eager op syncs)
+        n_live = jnp.sum(row_mask).astype(jnp.int32)
+        live_groups = jnp.where(
+            n_live > 0,
+            jnp.take(seg_ids, jnp.maximum(n_live - 1, 0)) + 1,
+            0).astype(jnp.int32)
+    overflow = live_groups > num_segments
+    # clamp keeps segment ids in-bucket when segments overflow G; every
+    # row landing in a clamped slot is masked out of the aggregation
+    seg_c = jnp.minimum(seg_ids, num_segments - 1)
+    row_ok = seg_ids < num_segments
+    if row_mask is not None:
+        row_ok = row_ok & jnp.take(row_mask, order)
+    first_in_seg = jnp.nonzero(boundary, size=num_segments,
+                               fill_value=0)[0]
+    rep_rows = jnp.take(order, first_in_seg)
+    out_cols = [gather(k, rep_rows) for k in keys]
+    for vcol, op in aggs:
+        valid = jnp.take(vcol.valid_mask(), order) & row_ok
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg_c,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+        out_cols.append(_segment_agg_fixed(vcol, order, valid, seg_c,
+                                           num_segments, cnt, op))
+    return out_cols, live_groups, overflow
 
 
 def _shrink(col: Column, n: int) -> Column:

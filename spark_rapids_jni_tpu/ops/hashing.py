@@ -38,6 +38,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.dtype import DType, TypeId
 from ..columnar.strings import pad_width, padded_bytes
+from ..plan.registry import plan_core
 from ..utils.tracing import func_range
 
 DEFAULT_MURMUR_SEED = 42  # Hash.java:33
@@ -328,6 +329,7 @@ def _f64_bits(bits, normalize_zero: bool):
     return bits
 
 
+@plan_core("spark_key_values")
 def spark_key_values(col: Column) -> jnp.ndarray:
     """Comparable device representation of a join/group key column: float
     bits normalized (canonical NaN, -0.0→0.0) so equality agrees with the

@@ -35,6 +35,7 @@ from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
 from .hashing import spark_key_values, xxhash64
+from ..plan.registry import plan_core
 from ..utils.shapes import bucket_size
 from ..utils.tracing import func_range
 
@@ -48,6 +49,7 @@ def _row_hash(cols: Sequence[Column]) -> jnp.ndarray:
     return xxhash64(Table(tuple(cols))).data.astype(jnp.uint64)
 
 
+@plan_core("join_any_null")
 def _any_null(cols: Sequence[Column]) -> jnp.ndarray:
     n = cols[0].size
     out = jnp.zeros(n, dtype=bool)

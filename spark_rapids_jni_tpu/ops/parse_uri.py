@@ -382,6 +382,27 @@ def _emit(parts: List[Optional[bytes]]) -> Column:
 _PART_PROTOCOL, _PART_HOST, _PART_QUERY = 0, 1, 2
 
 
+def _native_parse_buffers(nat, data, offs, valid, n, part, key_data,
+                          key_offs, key_valid, key_broadcast):
+    """Buffers-in/buffers-out native dispatch core: picks the sandboxed
+    worker (crash containment — a native crash classifies as a CRASH
+    fault) or the in-process ctypes call. Deliberately guard-free: the
+    single ``guarded_dispatch("parse_uri", ...)`` boundary in
+    ``_native_parse`` wraps BOTH paths, so classification/retry policy
+    lives in one place and the core stays effect-free (retry-safe)."""
+    from ..faultinj import _sandbox_targets, sandbox
+    if sandbox.active("parse_uri"):
+        # the ctypes call runs in a sandbox worker that dlopens the
+        # already-built .so by path; numpy buffers pickle over the pipe
+        return sandbox.sandbox_call(
+            "parse_uri", sandbox.file_target("parse_uri_target"),
+            nat.so_path(), data, offs, valid, n, part, key_data, key_offs,
+            key_valid, key_broadcast)
+    return _sandbox_targets.parse_uri_buffers(
+        nat.load(), data, offs, valid, n, part, key_data, key_offs,
+        key_valid, key_broadcast)
+
+
 def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
                   key_literal: Optional[bytes] = None) -> Column:
     from . import _parse_uri_native as nat
@@ -408,22 +429,11 @@ def _native_parse(col: Column, part: int, key_col: Optional[Column] = None,
             np.ascontiguousarray(
                 np.asarray(key_col.validity).astype(np.uint8))
 
-    from ..faultinj import _sandbox_targets, sandbox
+    from ..faultinj.guard import guarded_dispatch
     n = col.size
-    if sandbox.active("parse_uri"):
-        # crash containment: the ctypes call runs in a sandbox worker that
-        # dlopens the already-built .so by path; numpy buffers pickle over
-        # the pipe and a native crash classifies as a CRASH fault
-        from ..faultinj.guard import guarded_dispatch
-        blob, offsets, validity = guarded_dispatch(
-            "parse_uri", sandbox.sandbox_call, "parse_uri",
-            sandbox.file_target("parse_uri_target"), nat.so_path(),
-            data, offs, valid, n, part, key_data, key_offs, key_valid,
-            key_broadcast)
-    else:
-        blob, offsets, validity = _sandbox_targets.parse_uri_buffers(
-            nat.load(), data, offs, valid, n, part, key_data, key_offs,
-            key_valid, key_broadcast)
+    blob, offsets, validity = guarded_dispatch(
+        "parse_uri", _native_parse_buffers, nat, data, offs, valid, n,
+        part, key_data, key_offs, key_valid, key_broadcast)
 
     import jax.numpy as jnp
     vmask = None if bool(validity.all()) else jnp.asarray(validity)

@@ -23,6 +23,7 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
+from ..plan.registry import plan_core
 from .hashing import _f32_bits, _f64_bits
 from ..utils.tracing import func_range
 
@@ -86,16 +87,17 @@ def _backend() -> str:
     return jax.default_backend()
 
 
-@func_range()
-def sort_order(keys: Sequence[Column],
+@plan_core("sort_lanes")
+def sort_lanes(keys: Sequence[Column],
                ascending: Optional[Sequence[bool]] = None,
-               nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
-    """Stable order indices sorting by ``keys[0]`` (primary) then rest.
-
-    Defaults follow Spark SQL: ascending with NULLS FIRST (descending keys
-    default to NULLS LAST via the caller's flags).
-    """
-    n = keys[0].size
+               nulls_first: Optional[Sequence[bool]] = None
+               ) -> List[jnp.ndarray]:
+    """Monotone unsigned lexsort lanes for a key set, in ``jnp.lexsort``
+    operand order (minor lane first, primary key LAST). Pure jnp — the
+    fused-plan sort/groupby cores build on these lanes inside one jitted
+    program, and ``sort_order`` feeds the identical lanes to whichever
+    stable lexsort the backend branch picks, so eager and fused paths
+    produce the same permutation by construction."""
     if ascending is None:
         ascending = [True] * len(keys)
     if nulls_first is None:
@@ -114,6 +116,20 @@ def sort_order(keys: Sequence[Column],
                            jnp.uint8(1 if nf else 0),
                            jnp.uint8(0 if nf else 1))
             lanes.append(nl)
+    return lanes
+
+
+@func_range()
+def sort_order(keys: Sequence[Column],
+               ascending: Optional[Sequence[bool]] = None,
+               nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
+    """Stable order indices sorting by ``keys[0]`` (primary) then rest.
+
+    Defaults follow Spark SQL: ascending with NULLS FIRST (descending keys
+    default to NULLS LAST via the caller's flags).
+    """
+    n = keys[0].size
+    lanes = sort_lanes(keys, ascending, nulls_first)
     if not lanes:
         return jnp.arange(n, dtype=jnp.int32)
     if (_backend() == "cpu"

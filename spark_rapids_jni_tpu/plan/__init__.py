@@ -1,0 +1,45 @@
+"""Whole-plan compilation: logical plans fused into single XLA programs.
+
+See docs/ARCHITECTURE.md "Whole-plan compilation". Exports are lazy
+(PEP 562): op modules import ``plan.registry`` directly and must not drag
+executor/compile (which import the ops back) into their import cycle.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "plan_core": ".registry",
+    "registered_cores": ".registry",
+    "Expr": ".expr",
+    "col": ".expr",
+    "lit": ".expr",
+    "i64": ".expr",
+    "PlanError": ".nodes",
+    "PlanNode": ".nodes",
+    "Scan": ".nodes",
+    "Filter": ".nodes",
+    "Project": ".nodes",
+    "GroupBy": ".nodes",
+    "Sort": ".nodes",
+    "Limit": ".nodes",
+    "fingerprint": ".nodes",
+    "ProgramCache": ".compile",
+    "plan_metrics": ".compile",
+    "execute_plan": ".executor",
+    "unsupported_reason": ".executor",
+    "run_eager": ".interpreter",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod, __name__), name)
+
+
+def __dir__():
+    return __all__

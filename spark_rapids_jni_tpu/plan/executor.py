@@ -1,0 +1,116 @@
+"""Plan execution: one guarded dispatch around one fused XLA program.
+
+This is where the guard/fault-domain/deadline machinery that used to
+wrap every individual op now lives for planned queries — a single
+``guarded_dispatch("plan_execute", ...)`` brackets the whole fused
+program (reservation, injection point, fault classification, retry,
+watchdog). The op cores inside the program are pure by contract
+(plan/registry.py), so a retry after a TRANSIENT fault re-runs the
+program from the same immutable inputs and lands on bit-identical
+results.
+
+Host traffic per query is exactly one sync: the 2-element ``head``
+vector (live row count, overflow flag). Trimming to the live rows
+happens after that sync — a static prefix slice when the fused state is
+prefix-compacted (post GroupBy/Sort), else a nonzero-gather.
+
+Fallbacks go through ``run_eager`` (plan/interpreter.py) and bump
+``plan_fallbacks``: unsupported input column types, empty input, and
+group-budget overflow detected on device (``plan_overflows``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column, Table
+from ..columnar.table_ops import gather_table, mask_indices_core
+from ..faultinj.guard import guarded_dispatch
+from ..memory.reservation import device_reservation, release_barrier
+from .compile import CompiledPlan, ProgramCache, plan_metrics
+from .interpreter import run_eager
+from .nodes import PlanNode
+
+_default_cache = ProgramCache()
+
+
+def default_cache() -> ProgramCache:
+    return _default_cache
+
+
+def unsupported_reason(plan: PlanNode, table: Table) -> Optional[str]:
+    """Why this (plan, table) can't run fused — None when it can.
+    Conservative by design: anything not provably supported falls back
+    to the eager path rather than risking wrong fused results."""
+    if table.num_rows == 0:
+        return "empty input"
+    for i, c in enumerate(table.columns):
+        if not c.dtype.is_fixed_width:
+            return f"column {i} is {c.dtype.id.value} (not fixed-width)"
+        if c.dtype.is_decimal:
+            return f"column {i} is decimal (eager-only aggregation path)"
+    return None
+
+
+def _trim_prefix(cols, live: int) -> Table:
+    out = []
+    for c in cols:
+        v = c.validity[:live] if c.validity is not None else None
+        out.append(Column(c.dtype, live, data=c.data[:live], validity=v))
+    return Table(tuple(out))
+
+
+def execute_plan(plan: PlanNode, table: Table,
+                 donate_input: bool = False,
+                 cache: Optional[ProgramCache] = None) -> Table:
+    """Run ``plan`` over ``table`` as one fused XLA program (eager
+    fallback when unsupported). ``donate_input=True`` lets XLA reuse the
+    input buffers for intermediates — only safe when the caller is done
+    with the table AND is willing to lose in-flight retry (a fault
+    mid-program after donation cannot re-run; the guard surfaces it)."""
+    cache = cache if cache is not None else _default_cache
+    reason = unsupported_reason(plan, table)
+    if reason is not None:
+        plan_metrics.inc("plan_fallbacks")
+        return run_eager(plan, table)
+
+    prog: CompiledPlan = cache.get_or_compile(plan, table,
+                                              donate=donate_input)
+
+    def run():
+        # peak ≈ input + intermediates the fuser keeps live; 2x input is
+        # the same envelope the eager sort/join brackets use
+        with device_reservation(2 * table.device_nbytes()) as took:
+            out = prog.compiled(tuple(table.columns))
+            return release_barrier(out, took)
+
+    t0 = time.perf_counter()
+    cols, mask, head = guarded_dispatch("plan_execute", run)
+    head_h = np.asarray(head)           # THE host sync for the query
+    plan_metrics.add_time("execute_s", time.perf_counter() - t0)
+    plan_metrics.inc("plan_executes")
+    live, overflow = int(head_h[0]), bool(head_h[1])
+
+    if overflow:
+        # true group count exceeded the static budget: fused output is
+        # truncated garbage — recompute eagerly (data-dependent shapes)
+        plan_metrics.inc("plan_overflows")
+        plan_metrics.inc("plan_fallbacks")
+        if donate_input:
+            raise RuntimeError(
+                "plan group-budget overflow after input donation: the "
+                "input was consumed by the fused program and the eager "
+                "fallback cannot run. Raise plan.max_groups or disable "
+                "donation for this query.")
+        return run_eager(plan, table)
+
+    if mask is None:
+        return Table(tuple(cols))
+    if prog.prefix:
+        return _trim_prefix(cols, live)
+    idx = mask_indices_core(mask, live)
+    return gather_table(Table(tuple(cols)), idx)

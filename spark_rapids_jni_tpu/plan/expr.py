@@ -1,0 +1,247 @@
+"""Tiny expression IR for plan Filter predicates and Project columns.
+
+Scope is deliberately narrow — the integer/boolean arithmetic the TPC-H
+pipelines need (money stays in int64 cents, predicates are integer
+compares): column refs, integer/bool literals, +,-,* (evaluated in int64,
+matching the eager pipelines' ``astype(jnp.int64)`` discipline),
+comparisons, and &,|,~ on booleans. FLOAT64 columns (uint64 bit-pattern
+storage — docs/TPU_NUMERICS.md) may only pass through a bare ``col(i)``
+projection; any arithmetic on one is a loud TypeError at plan-lower time
+rather than silently-wrong bit math.
+
+Null semantics: the result of any operator is null when ANY operand is
+null (strict propagation — note this is stricter than Kleene logic for
+``&``/``|``; Spark's ``null AND false = false`` does not apply here, and
+the planner's Filter drops null-predicate rows, matching SQL WHERE).
+Both the fused compiler and the eager interpreter evaluate through this
+one module, so the two paths agree bit-for-bit by construction.
+
+Expressions are frozen dataclasses with deterministic reprs — the plan
+fingerprint (plan/nodes.py) hashes them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column
+
+
+class _Val(NamedTuple):
+    """Evaluated expression: device data (array or scalar), optional
+    validity, and the logical dtype carried for Project output columns."""
+
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray]
+    dtype: dt.DType
+
+
+# dtypes whose .data participates in int64 expression arithmetic
+_INTLIKE = (
+    dt.TypeId.BOOL8, dt.TypeId.INT8, dt.TypeId.INT16, dt.TypeId.INT32,
+    dt.TypeId.INT64, dt.TypeId.UINT8, dt.TypeId.UINT16, dt.TypeId.UINT32,
+    dt.TypeId.TIMESTAMP_DAYS, dt.TypeId.TIMESTAMP_SECONDS,
+    dt.TypeId.TIMESTAMP_MILLISECONDS, dt.TypeId.TIMESTAMP_MICROSECONDS,
+)
+
+_ARITH = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}
+_CMP = {"lt": jnp.less, "le": jnp.less_equal, "gt": jnp.greater,
+        "ge": jnp.greater_equal, "eq": jnp.equal, "ne": jnp.not_equal}
+_BOOL = {"and", "or"}
+
+
+def _wrap(v) -> "Expr":
+    if isinstance(v, Expr):
+        return v
+    if isinstance(v, (bool, int)):
+        return Lit(v)
+    raise TypeError(f"cannot use {type(v).__name__} in a plan expression")
+
+
+class Expr:
+    """Base class; operator overloads build the tree. ``==`` builds a
+    comparison node (dataclass equality is disabled on purpose) — plan
+    identity goes through the fingerprint, not ``__eq__``."""
+
+    def __add__(self, o):
+        return BinOp("add", self, _wrap(o))
+
+    def __sub__(self, o):
+        return BinOp("sub", self, _wrap(o))
+
+    def __mul__(self, o):
+        return BinOp("mul", self, _wrap(o))
+
+    def __radd__(self, o):
+        return BinOp("add", _wrap(o), self)
+
+    def __rsub__(self, o):
+        return BinOp("sub", _wrap(o), self)
+
+    def __rmul__(self, o):
+        return BinOp("mul", _wrap(o), self)
+
+    def __lt__(self, o):
+        return BinOp("lt", self, _wrap(o))
+
+    def __le__(self, o):
+        return BinOp("le", self, _wrap(o))
+
+    def __gt__(self, o):
+        return BinOp("gt", self, _wrap(o))
+
+    def __ge__(self, o):
+        return BinOp("ge", self, _wrap(o))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return BinOp("eq", self, _wrap(o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return BinOp("ne", self, _wrap(o))
+
+    def __and__(self, o):
+        return BinOp("and", self, _wrap(o))
+
+    def __or__(self, o):
+        return BinOp("or", self, _wrap(o))
+
+    def __invert__(self):
+        return Not(self)
+
+    __hash__ = None  # type: ignore[assignment]
+
+
+@dataclasses.dataclass(frozen=True, eq=False, repr=True)
+class Col(Expr):
+    """Reference to input column ``index`` of the node's child."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False, repr=True)
+class Lit(Expr):
+    """Integer or boolean literal (broadcast at evaluation)."""
+
+    value: int
+
+
+@dataclasses.dataclass(frozen=True, eq=False, repr=True)
+class Cast64(Expr):
+    """Widen an integer-family operand to INT64."""
+
+    operand: Expr
+
+
+@dataclasses.dataclass(frozen=True, eq=False, repr=True)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclasses.dataclass(frozen=True, eq=False, repr=True)
+class Not(Expr):
+    operand: Expr
+
+
+def col(index: int) -> Col:
+    return Col(index)
+
+
+def lit(value: int) -> Lit:
+    return Lit(value)
+
+
+def i64(e) -> Cast64:
+    return Cast64(_wrap(e))
+
+
+def _merge_valid(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _intlike(v: _Val, what: str) -> jnp.ndarray:
+    if v.dtype.id not in _INTLIKE:
+        raise TypeError(
+            f"plan expression {what} requires an integer/bool operand, got "
+            f"{v.dtype.id.value} (f64 math is not supported in fused plans "
+            f"— precompute, or keep FLOAT64 columns as bare col(i) "
+            f"passthroughs)")
+    return v.data.astype(jnp.int64)
+
+
+def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
+    """Evaluate over (possibly traced) Columns. Shared verbatim by the
+    fused compiler and the eager interpreter — the bit-identity contract
+    between the two paths rests on there being exactly one evaluator."""
+    if isinstance(e, Col):
+        c = cols[e.index]
+        if c.dtype.is_nested or c.dtype.id is dt.TypeId.STRING:
+            raise TypeError(f"plan expressions cannot reference "
+                            f"{c.dtype.id.value} column {e.index}")
+        return _Val(c.data, c.validity, c.dtype)
+    if isinstance(e, Lit):
+        if isinstance(e.value, bool):
+            return _Val(jnp.asarray(e.value, dtype=bool), None, dt.BOOL8)
+        return _Val(jnp.asarray(e.value, dtype=jnp.int64), None, dt.INT64)
+    if isinstance(e, Cast64):
+        v = eval_expr(e.operand, cols)
+        return _Val(_intlike(v, "i64()"), v.validity, dt.INT64)
+    if isinstance(e, Not):
+        v = eval_expr(e.operand, cols)
+        if v.dtype.id is not dt.TypeId.BOOL8:
+            raise TypeError("~ requires a boolean operand")
+        return _Val(~v.data.astype(bool), v.validity, dt.BOOL8)
+    if isinstance(e, BinOp):
+        lv = eval_expr(e.left, cols)
+        rv = eval_expr(e.right, cols)
+        validity = _merge_valid(lv.validity, rv.validity)
+        if e.op in _ARITH:
+            data = _ARITH[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
+            return _Val(data, validity, dt.INT64)
+        if e.op in _CMP:
+            data = _CMP[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
+            return _Val(data, validity, dt.BOOL8)
+        if e.op in _BOOL:
+            if (lv.dtype.id is not dt.TypeId.BOOL8
+                    or rv.dtype.id is not dt.TypeId.BOOL8):
+                raise TypeError(f"{e.op} requires boolean operands")
+            l, r = lv.data.astype(bool), rv.data.astype(bool)
+            return _Val(l & r if e.op == "and" else l | r,
+                        validity, dt.BOOL8)
+        raise TypeError(f"unknown expression op {e.op!r}")
+    raise TypeError(f"not a plan expression: {e!r}")
+
+
+def materialize(v: _Val, size: int) -> Column:
+    """Build an output Column from an evaluated Project expression —
+    scalars (literals) broadcast to the row count; BOOL8 results store
+    uint8 per the columnar convention."""
+    data = v.data
+    if data.ndim == 0:
+        data = jnp.broadcast_to(data, (size,))
+    if v.dtype.id is dt.TypeId.BOOL8:
+        data = data.astype(jnp.uint8)
+    validity = v.validity
+    if validity is not None and validity.ndim == 0:
+        validity = jnp.broadcast_to(validity, (size,))
+    return Column(v.dtype, size, data=data, validity=validity)
+
+
+def predicate_mask(v: _Val) -> jnp.ndarray:
+    """bool[n] keep-mask from a Filter predicate evaluation: null
+    predicate rows are dropped (SQL WHERE)."""
+    if v.dtype.id is not dt.TypeId.BOOL8:
+        raise TypeError("filter predicate must be boolean")
+    keep = v.data.astype(bool)
+    if v.validity is not None:
+        keep = keep & v.validity
+    return keep

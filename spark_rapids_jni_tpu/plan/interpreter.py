@@ -1,0 +1,55 @@
+"""Eager (op-by-op) plan execution — the reference semantics.
+
+Runs the same plan through the existing public ops, one dispatch per
+node, materializing every intermediate. This is (a) the fallback path
+when a plan can't be fused (unsupported column types, group-budget
+overflow), and (b) the oracle the equivalence tests compare the fused
+program against: both paths evaluate expressions through
+``plan/expr.eval_expr`` and aggregate through the shared segment cores
+in ops/groupby.py, so their results must match bit-for-bit.
+
+One deliberate semantic note: eager Filter compacts rows immediately
+(``filter_table``) while the fused path carries a mask — identical
+results because every downstream op is stable (stable lexsorts preserve
+live-row relative order; segment sums accumulate in sorted-row order).
+"""
+
+from __future__ import annotations
+
+from ..columnar.column import Table
+from ..columnar.table_ops import filter_table, slice_table
+from ..ops.groupby import groupby_aggregate
+from ..ops.sort import sort_table
+from . import expr as ex
+from .nodes import (Filter, GroupBy, Limit, PlanError, PlanNode, Project,
+                    Scan, Sort, linearize)
+
+
+def run_eager(plan: PlanNode, table: Table) -> Table:
+    nodes = linearize(plan)
+    scan = nodes[0]
+    assert isinstance(scan, Scan)
+    if table.num_columns != scan.ncols:
+        raise PlanError(f"plan expects {scan.ncols} columns, "
+                        f"got {table.num_columns}")
+    for node in nodes[1:]:
+        if isinstance(node, Filter):
+            keep = ex.predicate_mask(
+                ex.eval_expr(node.predicate, table.columns))
+            table = filter_table(table, keep)
+        elif isinstance(node, Project):
+            n = table.num_rows
+            table = Table(tuple(
+                ex.materialize(ex.eval_expr(e, table.columns), n)
+                for e in node.exprs))
+        elif isinstance(node, GroupBy):
+            table = groupby_aggregate(table, list(node.keys),
+                                      list(node.aggs))
+        elif isinstance(node, Sort):
+            table = sort_table(table, list(node.keys),
+                               node.ascending, node.nulls_first)
+        elif isinstance(node, Limit):
+            table = slice_table(table, 0, min(node.count, table.num_rows))
+        else:
+            raise PlanError(f"unknown plan node {type(node).__name__}")
+    return table

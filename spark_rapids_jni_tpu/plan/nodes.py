@@ -1,0 +1,216 @@
+"""Logical plan IR for whole-plan compilation.
+
+A plan is a linear pipeline of frozen dataclass nodes rooted at ``Scan``:
+
+    Scan -> [Filter | Project]* -> [GroupBy] -> [Sort] -> [Limit]
+
+Each node composes the existing op layer's pure cores (ops/groupby.py
+``groupby_core``, ops/sort.py ``sort_lanes``, plan/expr.py) — the plan
+layer adds no new math, it only decides what gets fused into one XLA
+program. The grammar above is the fusable subset: Filter never
+materializes a compaction inside the fused program (it carries a
+keep-mask that downstream nodes consume — GroupBy pushes masked rows
+into a dead segment, Sort orders them last), so every intermediate
+keeps the input's static shape and XLA can donate/fuse freely.
+
+Identity: ``fingerprint(plan)`` is a sha1 over a canonical repr built
+from node/expression structure only (no data, no shapes). The compiled
+ProgramCache keys on (fingerprint, input shape signature) so the
+``_NVARIANTS`` bench datasets — same plan, same shapes, different data —
+hit one compilation, and jax's persistent compile cache
+(``compile.cache_dir``) carries it across processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional, Sequence, Tuple
+
+from . import expr as ex
+
+
+class PlanError(ValueError):
+    """Malformed plan (bad structure or node arguments)."""
+
+
+class PlanNode:
+    """Base marker. Nodes are frozen dataclasses; ``child`` is the
+    upstream node (None only for Scan)."""
+
+    child: Optional["PlanNode"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Scan(PlanNode):
+    """Pipeline source: the input Table handed to execute_plan. ``ncols``
+    is declared up front so expression column refs validate at build
+    time."""
+
+    ncols: int
+    child: None = None
+
+    def __post_init__(self):
+        if self.ncols < 1:
+            raise PlanError("Scan needs at least one column")
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(PlanNode):
+    """Keep rows where ``predicate`` is true (null predicate drops the
+    row — SQL WHERE). Fused lowering carries this as a mask; no
+    compaction happens inside the program."""
+
+    child: PlanNode
+    predicate: ex.Expr
+
+    def __post_init__(self):
+        if not isinstance(self.predicate, ex.Expr):
+            raise PlanError("Filter predicate must be a plan expression")
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(PlanNode):
+    """Replace the column set with ``exprs`` (evaluated against the
+    child's columns)."""
+
+    child: PlanNode
+    exprs: Tuple[ex.Expr, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "exprs", tuple(self.exprs))
+        if not self.exprs:
+            raise PlanError("Project needs at least one expression")
+        for e in self.exprs:
+            if not isinstance(e, ex.Expr):
+                raise PlanError("Project entries must be plan expressions")
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupBy(PlanNode):
+    """Sort-based hash-groupby-aggregate over ``keys`` (column indices of
+    the child). ``aggs`` are (value column index, op) with op in
+    sum/mean/min/max/count. Output columns are keys then aggs, in order —
+    same contract as ops/groupby.groupby_aggregate."""
+
+    child: PlanNode
+    keys: Tuple[int, ...]
+    aggs: Tuple[Tuple[int, str], ...]
+
+    _OPS = ("sum", "mean", "min", "max", "count")
+
+    def __post_init__(self):
+        object.__setattr__(self, "keys", tuple(self.keys))
+        object.__setattr__(self, "aggs",
+                           tuple((int(i), str(op)) for i, op in self.aggs))
+        if not self.keys:
+            raise PlanError("GroupBy needs at least one key column")
+        if not self.aggs:
+            raise PlanError("GroupBy needs at least one aggregation")
+        for _, op in self.aggs:
+            if op not in self._OPS:
+                raise PlanError(f"unknown aggregation {op!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(PlanNode):
+    """Stable multi-key sort by ``keys`` (column indices). Defaults match
+    ops/sort.sort_order: ascending, nulls first on ascending keys."""
+
+    child: PlanNode
+    keys: Tuple[int, ...]
+    ascending: Optional[Tuple[bool, ...]] = None
+    nulls_first: Optional[Tuple[bool, ...]] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "keys", tuple(self.keys))
+        if self.ascending is not None:
+            object.__setattr__(self, "ascending", tuple(self.ascending))
+            if len(self.ascending) != len(self.keys):
+                raise PlanError("Sort ascending length mismatch")
+        if self.nulls_first is not None:
+            object.__setattr__(self, "nulls_first", tuple(self.nulls_first))
+            if len(self.nulls_first) != len(self.keys):
+                raise PlanError("Sort nulls_first length mismatch")
+        if not self.keys:
+            raise PlanError("Sort needs at least one key column")
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(PlanNode):
+    """First ``count`` rows. Only valid where the fused state is
+    prefix-compacted (after GroupBy/Sort) — checked at lower time."""
+
+    child: PlanNode
+    count: int
+
+    def __post_init__(self):
+        if self.count < 0:
+            raise PlanError("Limit count must be non-negative")
+
+
+def linearize(plan: PlanNode) -> Tuple[PlanNode, ...]:
+    """Scan-first node sequence; validates the chain is rooted at Scan."""
+    nodes = []
+    node: Optional[PlanNode] = plan
+    while node is not None:
+        nodes.append(node)
+        if isinstance(node, Scan):
+            break
+        node = node.child
+        if node is None:
+            raise PlanError(f"{type(nodes[-1]).__name__} has no child; "
+                            f"plans must be rooted at Scan")
+    if not isinstance(nodes[-1], Scan):
+        raise PlanError("plan is not rooted at Scan")
+    return tuple(reversed(nodes))
+
+
+def _expr_repr(e: ex.Expr) -> str:
+    if isinstance(e, ex.Col):
+        return f"c{e.index}"
+    if isinstance(e, ex.Lit):
+        # bool is an int subclass; keep the two distinct in the canon
+        return f"lb{int(e.value)}" if isinstance(e.value, bool) \
+            else f"l{e.value}"
+    if isinstance(e, ex.Cast64):
+        return f"i64({_expr_repr(e.operand)})"
+    if isinstance(e, ex.Not):
+        return f"not({_expr_repr(e.operand)})"
+    if isinstance(e, ex.BinOp):
+        return f"{e.op}({_expr_repr(e.left)},{_expr_repr(e.right)})"
+    raise PlanError(f"not a plan expression: {e!r}")
+
+
+def _node_repr(n: PlanNode) -> str:
+    if isinstance(n, Scan):
+        return f"scan[{n.ncols}]"
+    if isinstance(n, Filter):
+        return f"filter[{_expr_repr(n.predicate)}]"
+    if isinstance(n, Project):
+        return "project[" + ";".join(_expr_repr(e) for e in n.exprs) + "]"
+    if isinstance(n, GroupBy):
+        aggs = ";".join(f"{i}:{op}" for i, op in n.aggs)
+        return f"groupby[{','.join(map(str, n.keys))}|{aggs}]"
+    if isinstance(n, Sort):
+        asc = "" if n.ascending is None else \
+            "|a" + "".join("1" if a else "0" for a in n.ascending)
+        nf = "" if n.nulls_first is None else \
+            "|n" + "".join("1" if f else "0" for f in n.nulls_first)
+        return f"sort[{','.join(map(str, n.keys))}{asc}{nf}]"
+    if isinstance(n, Limit):
+        return f"limit[{n.count}]"
+    raise PlanError(f"unknown plan node {type(n).__name__}")
+
+
+def canonical_repr(plan: PlanNode) -> str:
+    """Deterministic structural repr — the fingerprint preimage. Data- and
+    shape-free by construction: only node kinds, column indices, literal
+    values, and flags appear."""
+    return ">".join(_node_repr(n) for n in linearize(plan))
+
+
+def fingerprint(plan: PlanNode) -> str:
+    """sha1 hex of the canonical plan structure; the compile-cache key
+    component that is stable across processes and datasets."""
+    return hashlib.sha1(canonical_repr(plan).encode()).hexdigest()

@@ -58,6 +58,20 @@ _register("compile.cache_dir", "SRJT_COMPILE_CACHE",
           "persistent XLA compilation cache directory; '0' or '' disables "
           "(read once at package import — see spark_rapids_jni_tpu/"
           "__init__.py)")
+_register("plan.max_groups", "SRJT_PLAN_MAX_GROUPS", 4096, int,
+          "whole-plan compilation: static group-slot budget for a fused "
+          "hash-groupby-aggregate (plan/compile.py). The fused program "
+          "pads its group dimension to bucket_size(min(this, rows)) so "
+          "the compiled shape is data-independent; a query whose true "
+          "group count exceeds the budget detects the overflow on device "
+          "and falls back to the op-by-op eager path (plan_fallbacks "
+          "metric)")
+_register("plan.min_rows", "SRJT_PLAN_MIN_ROWS", 262144, int,
+          "whole-plan compilation: input-row amortization floor for the "
+          "auto engine (benchmarks/tpch.py). At or above it a local query "
+          "fuses into one jitted program; below it a fresh (plan, shape) "
+          "compile costs more than the saved per-op dispatches/syncs, so "
+          "auto takes the eager path. engine=\"plan\"/\"eager\" override")
 _register("rmm.watchdog_period_s", "SRJT_RMM_WATCHDOG_PERIOD_S", 0.1, float,
           "deadlock watchdog poll period "
           "(ref: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod, 100ms)")

@@ -615,6 +615,72 @@ def test_srjt010_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT011 — host sync / dispatch guard inside a plan-registered op core
+# ---------------------------------------------------------------------------
+
+SRC_011 = """
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.plan.registry import plan_core
+    from spark_rapids_jni_tpu.faultinj.guard import guarded_dispatch
+
+    @plan_core("bad_op")
+    def bad_core(col):
+        m = int(jnp.sum(col.data))
+        host = np.asarray(col.data)
+        out = guarded_dispatch("bad_op", lambda: host)
+        return m, out
+"""
+
+
+def test_srjt011_triggers():
+    fs = run(SRC_011)
+    assert rules_of(fs) == {"SRJT011"}
+    # int() on a device sum, np.asarray, and the nested guard all flag
+    assert len(fs) == 3
+    assert any("guarded_dispatch" in f.message for f in fs)
+    assert any("np.asarray" in f.message for f in fs)
+    assert all("plan_execute" in f.message for f in fs)
+
+
+def test_srjt011_pure_core_clean():
+    src = """
+        import jax.numpy as jnp
+        from spark_rapids_jni_tpu.plan.registry import plan_core
+
+        @plan_core("good_op")
+        def good_core(col, mask):
+            n = col.data.shape[0]          # static metadata: fine
+            k = int(col.data.shape[0])     # shape expr: fine
+            z = jnp.where(mask, col.data, jnp.zeros(n, col.data.dtype))
+            return jnp.cumsum(z)
+    """
+    assert run(src) == []
+
+
+def test_srjt011_only_applies_to_registered_cores():
+    # same syncs in an undecorated helper are SRJT001/… territory, not 011
+    src = """
+        import numpy as np
+
+        def eager_helper(col):
+            return np.asarray(col.data)
+    """
+    assert run(src) == []
+
+
+def test_srjt011_noqa():
+    assert run(SRC_011.replace(
+        "int(jnp.sum(col.data))",
+        "int(jnp.sum(col.data))  # srjt: noqa[SRJT011]").replace(
+        "np.asarray(col.data)",
+        "np.asarray(col.data)  # srjt: noqa[SRJT011]").replace(
+        'guarded_dispatch("bad_op", lambda: host)',
+        'guarded_dispatch("bad_op", lambda: host)'
+        '  # srjt: noqa[SRJT011]')) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -634,7 +700,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 10
+    assert len(FILE_RULES) == 11
 
 
 def test_syntax_error_is_reported_not_raised():

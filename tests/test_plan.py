@@ -1,0 +1,297 @@
+"""Whole-plan compilation: equivalence and fault-storm suites.
+
+Equivalence: every fused plan result must be BIT-IDENTICAL to the
+op-by-op eager path (``plan.run_eager`` and the tpch ``engine="eager"``
+pipelines) — data AND validity masks. The fused program carries filters
+as masks and pads group slots, so these tests are the proof that the
+mask/pad/trim bookkeeping is invisible in the results.
+
+Fault storms: the plan executor's single ``guarded_dispatch
+("plan_execute")`` boundary must classify injected TRANSIENT / STALL /
+CORRUPTION faults, retry or propagate per fault-domain policy, and land
+on bit-identical results afterwards — the op cores are pure, so a
+re-dispatch re-runs the whole fused program from immutable inputs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from benchmarks import tpch
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.faultinj import install, uninstall
+from spark_rapids_jni_tpu.memory.integrity import CorruptionError
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Limit, PlanError,
+                                       Project, Scan, Sort, col,
+                                       execute_plan, fingerprint, i64, lit,
+                                       plan_metrics, run_eager)
+from spark_rapids_jni_tpu.plan.compile import ProgramCache
+from spark_rapids_jni_tpu.utils import config
+
+N = 4096
+
+
+def _table(n=N, seed=3, nulls=True):
+    """Mixed-dtype lineitem-ish table: int64 key-ish cols, int32 codes,
+    optional validity on both a key and a value column."""
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+
+    def c(arr, d, null_p=0.0):
+        v = None
+        if nulls and null_p > 0:
+            v = jnp.asarray(rng.random(n) >= null_p)
+        return Column(d, n, data=jnp.asarray(arr), validity=v)
+
+    return Table((
+        c(rng.integers(0, 7, n).astype(np.int32), dt.INT32, 0.1),
+        c(rng.integers(0, 3, n).astype(np.int8), dt.INT8),
+        c(rng.integers(1, 1000, n), dt.INT64, 0.2),
+        c(rng.integers(0, 11, n).astype(np.int32), dt.INT32),
+        c(rng.integers(0, 2500, n).astype(np.int32), dt.INT32),
+    ))
+
+
+def assert_tables_bit_identical(a: Table, b: Table):
+    assert a.num_rows == b.num_rows
+    assert a.num_columns == b.num_columns
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        da, db = np.asarray(ca.data), np.asarray(cb.data)
+        assert da.dtype == db.dtype, f"col {i} dtype"
+        assert np.array_equal(da, db), f"col {i} data"
+        va = (np.ones(a.num_rows, bool) if ca.validity is None
+              else np.asarray(ca.validity))
+        vb = (np.ones(b.num_rows, bool) if cb.validity is None
+              else np.asarray(cb.validity))
+        assert np.array_equal(va, vb), f"col {i} validity"
+
+
+PLANS = {
+    "groupby_sort": lambda: Sort(
+        GroupBy(Scan(5), (0, 1),
+                ((2, "sum"), (2, "mean"), (3, "min"), (3, "max"),
+                 (2, "count"))), (0, 1)),
+    "filter_groupby_sort": lambda: Sort(
+        GroupBy(Filter(Scan(5), col(4) < lit(1800)), (0,),
+                ((2, "sum"), (2, "mean"), (2, "count"))), (0,)),
+    "project_filter_groupby": lambda: Sort(
+        GroupBy(
+            Project(Filter(Scan(5), (col(3) >= lit(2)) & (col(4) < lit(2000))),
+                    (col(0), i64(col(2)) * (lit(100) - i64(col(3))),
+                     i64(col(2)))),
+            (0,), ((1, "sum"), (2, "mean"))), (0,)),
+    "sort_desc_nulls": lambda: Sort(Scan(5), (2, 0),
+                                    ascending=(False, True)),
+    "filter_project_trim": lambda: Project(
+        Filter(Scan(5), col(1) == lit(1)),
+        (i64(col(2)) + lit(7), col(0), col(3))),
+    "sort_limit": lambda: Limit(Sort(Scan(5), (2,), ascending=(False,)), 50),
+    "groupby_limit": lambda: Limit(
+        Sort(GroupBy(Filter(Scan(5), col(4) < lit(1250)), (0, 1),
+                     ((2, "sum"),)), (0, 1)), 5),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PLANS))
+def test_fused_bit_identical_to_eager(name):
+    t = _table()
+    plan = PLANS[name]()
+    assert_tables_bit_identical(execute_plan(plan, t), run_eager(plan, t))
+
+
+def test_fused_bit_identical_without_nulls():
+    t = _table(nulls=False)
+    plan = PLANS["project_filter_groupby"]()
+    assert_tables_bit_identical(execute_plan(plan, t), run_eager(plan, t))
+
+
+def test_q1_plan_matches_eager_engine():
+    li = tpch.generate_q1_lineitem(50_000, 11)
+    assert_tables_bit_identical(tpch.run_q1(li, engine="plan"),
+                                tpch.run_q1(li, engine="eager"))
+
+
+def test_q6_plan_matches_eager_engine():
+    li = tpch.generate_q1_lineitem(50_000, 12)
+    assert (tpch.run_q6(li, engine="plan")
+            == tpch.run_q6(li, engine="eager"))
+    # empty-survivor filter: fused returns the 0 sum, same as eager
+    assert (tpch.run_q6(li, date_lo=9000, date_hi=9001, engine="plan")
+            == tpch.run_q6(li, date_lo=9000, date_hi=9001, engine="eager")
+            == 0)
+
+
+def test_q5_plan_matches_eager_engine():
+    tabs = tpch.generate_q5_tables(60_000, 13)
+    assert_tables_bit_identical(tpch.run_q5(*tabs, engine="plan"),
+                                tpch.run_q5(*tabs, engine="eager"))
+
+
+def test_auto_engine_respects_min_rows_floor():
+    # below the floor: no fused execution; at/above (forced low): fused
+    li = tpch.generate_q1_lineitem(4_096, 14)
+    plan_metrics.reset()
+    tpch.run_q1(li)
+    assert plan_metrics.snapshot()["plan_executes"] == 0
+    with config.override("plan.min_rows", 1_000):
+        tpch.run_q1(li)
+    assert plan_metrics.snapshot()["plan_executes"] == 1
+
+
+def test_group_budget_overflow_falls_back_to_eager():
+    # every row its own group (4096 > the 1024-slot bucket floor), budget
+    # pinned low: the fused program must detect overflow on device
+    import jax.numpy as jnp
+    t = Table((Column(dt.INT64, N, data=jnp.asarray(np.arange(N))),
+               Column(dt.INT64, N,
+                      data=jnp.asarray(np.arange(N) * 3 + 1))))
+    plan = Sort(GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count"))), (0,))
+    plan_metrics.reset()
+    with config.override("plan.max_groups", 2):
+        fused = execute_plan(plan, t, cache=ProgramCache())
+    snap = plan_metrics.snapshot()
+    assert snap["plan_overflows"] == 1
+    assert snap["plan_fallbacks"] == 1
+    assert_tables_bit_identical(fused, run_eager(plan, t))
+
+
+def test_unsupported_input_falls_back_to_eager():
+    # a string column is not fusable: executor must take the eager path
+    import jax.numpy as jnp
+    s = Column.from_pylist(["a", "bb", "a", "ccc"], dt.STRING)
+    k = Column(dt.INT64, 4, data=jnp.asarray(np.array([1, 2, 1, 2])))
+    t = Table((k, s))
+    plan = Sort(GroupBy(Scan(2), (0,), ((0, "count"),)), (0,))
+    plan_metrics.reset()
+    out = execute_plan(plan, t)
+    assert plan_metrics.snapshot()["plan_fallbacks"] == 1
+    assert_tables_bit_identical(out, run_eager(plan, t))
+
+
+def test_malformed_plans_raise():
+    with pytest.raises(PlanError):
+        Scan(0)
+    with pytest.raises(PlanError):
+        GroupBy(Scan(2), (), ((0, "sum"),))
+    with pytest.raises(PlanError):
+        GroupBy(Scan(2), (0,), ((1, "median"),))
+    with pytest.raises(PlanError):
+        Sort(Scan(2), (0,), ascending=(True, False))
+    t = _table()
+    with pytest.raises(PlanError):
+        # limit directly on a filter: rows are not prefix-compacted
+        execute_plan(Limit(Filter(Scan(5), col(1) == lit(1)), 3), t,
+                     cache=ProgramCache())
+
+
+def test_fingerprint_is_structural():
+    p1 = PLANS["filter_groupby_sort"]()
+    p2 = PLANS["filter_groupby_sort"]()
+    assert fingerprint(p1) == fingerprint(p2)
+    assert fingerprint(p1) != fingerprint(PLANS["groupby_sort"]())
+    # literal values participate in identity
+    a = Filter(Scan(5), col(4) < lit(1800))
+    b = Filter(Scan(5), col(4) < lit(1801))
+    assert fingerprint(a) != fingerprint(b)
+
+
+# ---------------------------------------------------------------------------
+# fault storms at the fused-program boundary
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    yield
+    uninstall()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002), \
+            config.override("watchdog.poll_period_s", 0.02):
+        yield
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "plan_faults.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def _rule(injection_type, count, **extra):
+    rule = {"percent": 100, "injectionType": injection_type,
+            "interceptionCount": count}
+    rule.update(extra)
+    return {"xlaRuntimeFaults": {"plan_execute": rule}}
+
+
+def _host(table: Table):
+    return [np.asarray(c.data).tolist() for c in table.columns]
+
+
+def test_transient_storm_retries_to_bit_identical(tmp_path):
+    li = tpch.generate_q1_lineitem(20_000, 21)
+    baseline = _host(tpch.run_q1(li, engine="plan"))
+    install(write_cfg(tmp_path, _rule(2, 2, substituteReturnCode=700)),
+            seed=0)
+    out = _host(tpch.run_q1(li, engine="plan"))
+    assert out == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_faults"] == 2
+    assert m["transient_retries"] == 2
+
+
+def test_stall_storm_cancelled_and_recovered_bit_identical(tmp_path):
+    li = tpch.generate_q1_lineitem(20_000, 22)
+    baseline = _host(tpch.run_q1(li, engine="plan"))
+    install(write_cfg(tmp_path, _rule(4, 1, delayMs=-1)), seed=0)
+    with config.override("task.budget_s", 0.35), \
+            config.override("task.retry_budget", 8), \
+            config.override("task.degrade_after", 0), \
+            TaskExecutor() as ex:
+        fut = ex.submit(1, lambda: _host(tpch.run_q1(li, engine="plan")))
+        assert fut.result(timeout=60) == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_delays"] == 1
+    assert m["stall_detected"] >= 1
+    assert m["stall_cancelled"] >= 1
+
+
+def test_corruption_at_fused_boundary_propagates_then_recovers():
+    """CORRUPTION is never retried in place: the guard counts the
+    detection and propagates for discard-and-reconstruct. A raise-once
+    shim around the cached executable stands in for an integrity-check
+    failure (the injector's check() cannot synthesize CorruptionError)."""
+    li = tpch.generate_q1_lineitem(20_000, 23)
+    plan = tpch._q1_plan(2400)
+    cache = ProgramCache()
+    baseline = _host(execute_plan(plan, li, cache=cache))
+
+    prog = cache.get_or_compile(plan, li)
+    real = prog.compiled
+    state = {"armed": True}
+
+    def corrupt_once(*a, **kw):
+        if state["armed"]:
+            state["armed"] = False
+            raise CorruptionError("plan_execute: fused output checksum "
+                                  "mismatch (injected)")
+        return real(*a, **kw)
+
+    prog.compiled = corrupt_once
+    try:
+        with pytest.raises(CorruptionError):
+            execute_plan(plan, li, cache=cache)
+        m = RmmSpark.get_fault_domain_metrics()
+        assert m["corruption_detected"] == 1
+        # shim drained: the re-run recomputes and is bit-identical
+        assert _host(execute_plan(plan, li, cache=cache)) == baseline
+    finally:
+        prog.compiled = real
