@@ -345,6 +345,11 @@ def axis_table():
         ("tpch_q3_1m", lambda: _B().bench_tpch_q3(1 << 20), 1 << 20),
         ("row_conversion_fixed_4m", lambda: _B().bench_row_conversion(1 << 22, False), 1 << 22),
         ("row_conversion_strings_4m", lambda: _B().bench_row_conversion(1 << 22, True), 1 << 22),
+        # the dictionary-execution axes (ROADMAP item 4): each row carries
+        # the materialized engine's time + pushdown skip counters, so one
+        # capture proves the encoded-vs-materialized ratio on-chip
+        ("dict_filter_strings_4m", lambda: _B().bench_dict_filter_strings(1 << 22), 1 << 22),
+        ("dict_groupby_strings_4m", lambda: _B().bench_dict_groupby_strings(1 << 22), 1 << 22),
         ("sort_1m", lambda: _B().bench_sort(1 << 20), 1 << 20),
         ("bloom_filter_1m", lambda: _B().bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: _B().bench_cast_string_to_float(500_000), 500_000),

@@ -252,6 +252,147 @@ def bench_sort(rows: int):
     return sec, rows * 8
 
 
+def bench_dict_groupby_strings(rows: int):
+    """Encoded vs materialized engines side by side: groupby-sum/count over
+    a ~1k-cardinality string key, once on the DICT32 code column (sort by
+    precomputed code ranks, segment compare on int32 codes) and once on the
+    materialized STRING column (padded-byte lexicographic sort, byte-matrix
+    segment compare). The headline ``seconds`` is the encoded engine; the
+    materialized engine's time and the encoded/materialized ratio ride in
+    the row via pop_extra() so one JSON line carries both sides."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Table
+    from spark_rapids_jni_tpu.columnar.dictionary import (
+        encode_strings, materialize)
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+    from spark_rapids_jni_tpu.utils.datagen import (
+        ColumnProfile, Dist, generate_column)
+
+    enc_tables, mat_tables, nbytes = [], [], 0
+    for s in range(_NVARIANTS):
+        key = generate_column(rows, ColumnProfile(
+            dt.STRING, string_len=Dist("normal", 0, 64),
+            cardinality=1000, null_frequency=None), seed=s)
+        val = generate_column(rows, ColumnProfile(
+            dt.INT64, dist=Dist("uniform", -1000, 1000), cardinality=0,
+            avg_run_length=1, null_frequency=None), seed=100 + s)
+        enc = encode_strings(key)
+        # materialize the encoded column back so both engines see the exact
+        # same bytes (bit-identity between the two paths is test-enforced)
+        mat = materialize(enc)
+        nbytes = int(mat.data.size) + rows * 8
+        enc_tables.append(Table((enc, val)))
+        mat_tables.append(Table((mat, val)))
+
+    aggs = [(1, "sum"), (1, "count")]
+    sec = _time(lambda i: groupby_aggregate(
+        enc_tables[i % _NVARIANTS], [0], aggs), warmup=_NVARIANTS)
+    mat_sec = _time(lambda i: groupby_aggregate(
+        mat_tables[i % _NVARIANTS], [0], aggs), warmup=_NVARIANTS)
+    LAST_EXTRA.clear()
+    LAST_EXTRA.update({
+        "engine": "dict32",
+        "materialized_seconds": round(mat_sec, 6),
+        "speedup_vs_materialized": round(mat_sec / sec, 2),
+    })
+    return sec, nbytes
+
+
+def bench_dict_filter_strings(rows: int):
+    """Selective scan→filter on a dictionary string key, encoded engine vs
+    full-decode engine over the same snappy parquet file (8 row groups, the
+    needle value present in only the last one — a <=12.5%-qualifying scan).
+
+    Encoded engine (headline ``seconds``): predicate pushdown probes each
+    row group's dictionary page before decode (7/8 groups skipped, counters
+    in the row), the survivor decodes to DICT32 with no gather, and the
+    residual filter runs fused on int32 codes. Materialized engine: full
+    decode of every group to STRING (dictionary gather included), then a
+    dense padded-byte equality mask. Extra row fields: pages_skipped /
+    bytes_skipped / row_groups_skipped deltas, the fused-plan split from
+    _with_plan_extra, materialized_seconds, speedup_vs_materialized."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.strings import padded_bytes
+    from spark_rapids_jni_tpu.columnar.table_ops import filter_table
+    from spark_rapids_jni_tpu.parquet import ParquetReader
+    from spark_rapids_jni_tpu.parquet.reader import reader_metrics
+    from spark_rapids_jni_tpu.plan import (
+        Filter, Scan, col as pcol, execute_plan)
+    from spark_rapids_jni_tpu.utils import config
+
+    needle = "needle_0042"
+    rng = np.random.default_rng(0)
+    pool = np.array([f"key_{i:04d}" for i in range(1000)])
+    # object dtype: a fixed-width <U8 array would silently truncate the
+    # longer needle on assignment and the probe would (correctly) prune it
+    vals = pool[rng.integers(0, len(pool), rows)].astype(object)
+    # the needle lives only in the last row group: every other group's
+    # dictionary page provably lacks it, so pushdown prunes all but one
+    # (7/8 at the sweep sizes; tiny smoke rows land fewer groups)
+    group = max(rows // 8, 1024)
+    last = ((rows - 1) // group) * group
+    hits = rng.choice(np.arange(last, rows), size=max(rows // 400, 1),
+                      replace=False)
+    vals[hits] = needle
+    payload = rng.integers(-1000, 1000, rows)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "dict_filter.parquet")
+        pq.write_table(
+            pa.table({"key": pa.array(vals), "val": pa.array(payload)}),
+            path, compression="snappy", row_group_size=group)
+        nbytes = os.path.getsize(path)
+
+        plan = Filter(Scan(ncols=2), pcol(0) == needle)
+
+        def run_encoded():
+            import jax
+            with config.override("parquet.device_decode", "on"), \
+                    config.override("parquet.encoded_strings", True):
+                with ParquetReader(path, predicate=plan.predicate) as r:
+                    t = r.read_all()
+                out = execute_plan(plan, t)
+            jax.block_until_ready([c.data for c in out.columns])
+            return out
+
+        def run_materialized():
+            import jax
+            with config.override("parquet.device_decode", "on"):
+                with ParquetReader(path) as r:
+                    t = r.read_all()
+            mat, lens = padded_bytes(t.columns[0])
+            lit = np.zeros(int(mat.shape[1]), np.uint8)
+            lit[:len(needle)] = np.frombuffer(needle.encode(), np.uint8)
+            mask = (lens == len(needle)) & jnp.all(
+                mat == jnp.asarray(lit), axis=1)
+            out = filter_table(t, mask)
+            jax.block_until_ready([c.data for c in out.columns])
+            return out
+
+        # one warm read doubles as the pushdown-counter sample: the skip
+        # counts are per-read properties of the file, not of the timing
+        before = reader_metrics.snapshot()
+        run_encoded()
+        after = reader_metrics.snapshot()
+        skip = {k: after[k] - before[k]
+                for k in ("pages_skipped", "bytes_skipped",
+                          "row_groups_skipped")}
+        sec = _with_plan_extra(lambda: _time(run_encoded, warmup=0, iters=3))
+        mat_sec = _time(run_materialized, warmup=1, iters=3)
+    LAST_EXTRA.update(skip)
+    LAST_EXTRA.update({
+        "materialized_seconds": round(mat_sec, 6),
+        "speedup_vs_materialized": round(mat_sec / sec, 2),
+    })
+    return sec, nbytes
+
+
 def _query_mesh(n_devices: int):
     """Mesh for distributed query benches (None = local single-device)."""
     if n_devices <= 0:
@@ -492,7 +633,8 @@ def main():
                              "join", "sort", "tpch_q1", "tpch_q3",
                              "tpch_q5", "tpch_q6",
                              "get_json_object", "from_json",
-                             "parquet_decode", "shuffle_skewed"])
+                             "parquet_decode", "shuffle_skewed",
+                             "dict_filter_strings", "dict_groupby_strings"])
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
@@ -526,6 +668,14 @@ def main():
     if args.bench in ("all", "sort"):
         runs.append(("sort", "int64", args.rows,
                      lambda: bench_sort(args.rows)))
+    if args.bench in ("all", "dict_groupby_strings"):
+        runs.append(("dict_groupby_strings", "encoded vs materialized key",
+                     args.rows,
+                     lambda: bench_dict_groupby_strings(args.rows)))
+    if args.bench in ("all", "dict_filter_strings"):
+        runs.append(("dict_filter_strings", "pushdown+codes vs full decode",
+                     args.rows,
+                     lambda: bench_dict_filter_strings(args.rows)))
     if args.bench in ("all", "tpch_q1"):
         cfg = ("filter+8agg-groupby+sort" if not args.mesh
                else f"distributed mesh={args.mesh}")

@@ -19,6 +19,7 @@ Rule IDs:
   SRJT010  native library load / handle acquisition outside the
            sanctioned loader modules
   SRJT011  host sync or dispatch guard inside a plan-registered op core
+  SRJT012  dictionary materialize() inside a plan core or an ops/ module
 """
 
 from __future__ import annotations
@@ -823,9 +824,61 @@ def rule_srjt011(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT012 — dictionary materialize() inside a plan core or an ops/ module
+# ---------------------------------------------------------------------------
+
+# Dictionary-encoded (DICT32) columns run filter/groupby/join/sort on int32
+# codes; columnar/dictionary.materialize() gathers string bytes and is an
+# OUTPUT-BOUNDARY operation (row conversion, exchange re-encode, results).
+# A materialize inside an op's code path or a @plan_core body silently
+# re-inflates the encoded column — the exact gather the encoding exists to
+# skip — and inside a fused plan it would also bloat the traced program.
+# columnar/dictionary.py owns the definition; plan/expr.py's materialize is
+# the unrelated _Val -> Column projection helper.
+
+_SRJT012_NAMES = ("materialize", "materialize_table")
+_SRJT012_EXEMPT = ("columnar/dictionary.py", "plan/expr.py")
+
+
+def rule_srjt012(tree, rel, lines, ctx) -> List[Finding]:
+    if any(rel.endswith(e) for e in _SRJT012_EXEMPT):
+        return []
+    in_ops = "/ops/" in "/" + rel
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn is None or dn.split(".")[-1] not in _SRJT012_NAMES:
+            continue
+        core = None
+        for a in anc:
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _plan_core_decorated(a):
+                core = a
+        if core is not None:
+            findings.append(Finding(
+                "SRJT012", rel, node.lineno,
+                f"`{dn}(...)` inside plan core `{core.name}` — dictionary "
+                f"materialization is an output-boundary operation; a fused "
+                f"program must carry DICT32 codes end-to-end (the string "
+                f"gather it would inline is the cost the encoding removes; "
+                f"contract: columnar/dictionary.py)"))
+        elif in_ops:
+            findings.append(Finding(
+                "SRJT012", rel, node.lineno,
+                f"`{dn}(...)` in an ops/ module — ops must execute on "
+                f"DICT32 codes (compare/gather/rank lanes) and leave "
+                f"materialization to output boundaries "
+                f"(columnar/dictionary.py); materializing here re-inflates "
+                f"every encoded batch that flows through the op"))
+    return findings
+
+
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
-              rule_srjt011)
+              rule_srjt011, rule_srjt012)
 PROJECT_RULES = (project_rule_srjt008_spans,)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -266,6 +266,10 @@ class Column:
                                                                 errors="replace"))
             return out
 
+        if tid is TypeId.DICT32:
+            from .dictionary import materialize
+            return materialize(self).to_pylist()
+
         if tid is TypeId.DECIMAL128:
             limbs = np.asarray(self.data)
             out = []

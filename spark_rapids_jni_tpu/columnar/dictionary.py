@@ -1,0 +1,275 @@
+"""Dictionary-encoded string columns (DICT32).
+
+Following "GPU Acceleration of SQL Analytics on Compressed Data" (PAPERS.md),
+parquet dictionary-encoded string columns stay encoded end-to-end: a DICT32
+column is a plain :class:`Column` whose ``data`` is the int32 code array
+(validity rides the codes) and whose ``children`` carry the shared, immutable
+dictionary:
+
+    children[0]  "values" — a STRING Column of the unique dictionary entries
+    children[1]  "ranks"  — INT32, ranks.data[i] = byte-lexicographic rank of
+                 entry i, so ``take(ranks, codes)`` is an order-preserving
+                 sort lane without touching string bytes
+
+Because the dictionary lives in ``children``, the whole encoded column is one
+pytree: jit tracing, spill serialization, integrity fingerprints and
+``device_nbytes`` all recurse into it with no special cases. The values/ranks
+Columns are shared by reference across every batch produced from the same
+parquet dictionary page — ``materialize()`` is the only place string bytes are
+gathered, and it is an output boundary (row conversion, exchange to a peer
+with a different dictionary, user-visible results). `srjt-lint` rule SRJT012
+keeps it out of op code paths and ``@plan_core`` bodies.
+
+Dictionary entries are assumed UNIQUE (parquet guarantees this; the encoders
+here construct unique entries) — code equality is string equality, which is
+what lets filters/groupby/joins run on int32 codes.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column
+from .dtype import TypeId
+from .strings import gather_spans
+
+
+def is_dict(col: Column) -> bool:
+    return col.dtype.id is TypeId.DICT32
+
+
+def dict_values(col: Column) -> Column:
+    """The shared STRING dictionary of a DICT32 column."""
+    return col.children[0]
+
+
+def dict_ranks(col: Column) -> Column:
+    """The per-entry byte-lexicographic rank lane of a DICT32 column."""
+    return col.children[1]
+
+
+# ---------------------------------------------------------------------------
+# dictionary construction
+# ---------------------------------------------------------------------------
+
+def _entries(values: Column) -> Tuple[bytes, ...]:
+    """Host tuple of dictionary entry byte strings, memoized on the
+    (immutable, shared) values column so every batch referencing the same
+    dictionary pays the readback once."""
+    cached = getattr(values, "_dict_entries", None)
+    if cached is not None:
+        return cached
+    offs = values.host_offsets()
+    data = values.host_data()
+    blob = data.tobytes() if data is not None and data.size else b""
+    out = tuple(blob[int(offs[i]):int(offs[i + 1])]
+                for i in range(values.size))
+    object.__setattr__(values, "_dict_entries", out)
+    return out
+
+
+def _ranks_for(values: Column) -> Column:
+    """INT32 rank column for a values dictionary: ranks[i] = position of
+    entry i in byte-lexicographic order (ties impossible — entries unique)."""
+    nd = values.size
+    order = sorted(range(nd), key=_entries(values).__getitem__)
+    ranks = np.empty(nd, dtype=np.int32)
+    ranks[order] = np.arange(nd, dtype=np.int32)
+    return Column(dt.INT32, nd, data=jnp.asarray(ranks))._seed_host_cache(ranks)
+
+
+def values_from_entries(entries: Sequence[bytes]) -> Column:
+    """Build a (host-seeded) STRING values column from entry byte strings."""
+    offsets = np.zeros(len(entries) + 1, dtype=np.int32)
+    for i, e in enumerate(entries):
+        offsets[i + 1] = offsets[i] + len(e)
+    blob = b"".join(entries)
+    data = (np.frombuffer(blob, dtype=np.uint8).copy() if blob
+            else np.zeros((0,), dtype=np.uint8))
+    values = Column(dt.STRING, len(entries), data=jnp.asarray(data),
+                    offsets=jnp.asarray(offsets))
+    values._seed_host_cache(data, offsets)
+    object.__setattr__(values, "_dict_entries", tuple(entries))
+    return values
+
+
+def dict_column(codes: jnp.ndarray, values: Column,
+                validity: Optional[jnp.ndarray] = None,
+                ranks: Optional[Column] = None) -> Column:
+    """Assemble a DICT32 column. ``ranks`` is computed (and memoized on the
+    shared values column) when not supplied, so it is built once per
+    dictionary, not once per batch."""
+    if ranks is None:
+        ranks = getattr(values, "_dict_ranks", None)
+        if ranks is None:
+            ranks = _ranks_for(values)
+            object.__setattr__(values, "_dict_ranks", ranks)
+    codes = jnp.asarray(codes, dtype=jnp.int32)
+    return Column(dt.DICT32, int(codes.shape[0]), data=codes,
+                  validity=validity, children=(values, ranks))
+
+
+def encode_strings(col: Column) -> Column:
+    """Re-encode a STRING column as DICT32 (host-side unique; bench/test
+    entry point — production encoded columns come straight from the parquet
+    dictionary pages without ever materializing)."""
+    assert col.dtype.id is TypeId.STRING
+    n = col.size
+    offs = np.asarray(col.host_offsets(), dtype=np.int64)
+    data = col.host_data()
+    lengths = (offs[1:] - offs[:-1]).astype(np.int64)
+    if n == 0 or int(offs[-1]) == 0:
+        # all-empty (or all-null) input: one-entry dictionary suffices
+        values = values_from_entries([b""] if n else [])
+        codes = np.zeros(n, dtype=np.int32)
+        return dict_column(jnp.asarray(codes), values, col.validity)
+    L = max(1, int(lengths.max()))
+    mat = np.zeros((n, L), dtype=np.uint8)
+    row_of = np.repeat(np.arange(n), lengths)
+    col_in = np.arange(int(offs[-1])) - np.repeat(offs[:-1], lengths)
+    mat[row_of, col_in] = np.asarray(data)
+    # unique over (padded bytes, length) so "a" and "a\x00" stay distinct
+    combo = np.concatenate(
+        [mat, lengths.astype("<i4").view(np.uint8).reshape(n, 4)], axis=1)
+    v = np.ascontiguousarray(combo).view(
+        np.dtype((np.void, combo.shape[1])))[:, 0]
+    _, first, inverse = np.unique(v, return_index=True, return_inverse=True)
+    entries = [mat[i, :lengths[i]].tobytes() for i in first]
+    values = values_from_entries(entries)
+    codes = inverse.astype(np.int32)
+    return dict_column(jnp.asarray(codes), values, col.validity)
+
+
+# ---------------------------------------------------------------------------
+# output boundary
+# ---------------------------------------------------------------------------
+
+def materialize(col: Column) -> Column:
+    """Gather string bytes for a DICT32 column -> STRING column. The ONLY
+    place encoded columns touch string data; callers are output boundaries
+    (row conversion, exchange re-encode, user-visible results, benches)."""
+    assert is_dict(col)
+    values = dict_values(col)
+    n, nd = col.size, values.size
+    if n == 0 or nd == 0:
+        return Column(dt.STRING, n, data=jnp.zeros((0,), jnp.uint8),
+                      validity=col.validity,
+                      offsets=jnp.zeros(n + 1, jnp.int32))
+    offs = jnp.asarray(values.offsets, dtype=jnp.int32)
+    codes = jnp.clip(col.data, 0, nd - 1)
+    starts = jnp.take(offs[:-1], codes)
+    lens = jnp.take(offs[1:], codes) - starts
+    return gather_spans(values.data, starts, lens, col.validity,
+                        pad_to_bucket=True)
+
+
+def materialize_table(table):
+    """Materialize every DICT32 column of a Table (output boundary)."""
+    from .column import Table
+    return Table(tuple(materialize(c) if is_dict(c) else c for c in table))
+
+
+# ---------------------------------------------------------------------------
+# identity / lookup
+# ---------------------------------------------------------------------------
+
+def dictionary_fingerprint(col: Column) -> int:
+    """crc32 over the dictionary's flat bytes + offsets. Memoized on the
+    shared values column; keys the plan program cache (a recompiled program
+    bakes nothing dictionary-specific in, but constant-folding across
+    dictionaries must not alias) and the co-dictionary join fast path."""
+    values = dict_values(col) if is_dict(col) else col
+    cached = getattr(values, "_dict_fp", None)
+    if cached is None:
+        h = zlib.crc32(np.asarray(values.host_offsets(),
+                                  dtype=np.int64).tobytes())
+        data = values.host_data()
+        if data is not None and data.size:
+            h = zlib.crc32(data.tobytes(), h)
+        cached = (h ^ values.size) & 0xFFFFFFFF
+        object.__setattr__(values, "_dict_fp", cached)
+    return cached
+
+
+def same_dictionary(a: Column, b: Column) -> bool:
+    va, vb = dict_values(a), dict_values(b)
+    return va is vb or dictionary_fingerprint(a) == dictionary_fingerprint(b)
+
+
+def lookup_code(col: Column, value) -> int:
+    """Code of a string literal in the dictionary of a DICT32 column, or -1
+    when absent (codes are non-negative, so -1 matches no row — the encoded
+    equivalent of an always-false equality)."""
+    needle = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    values = dict_values(col)
+    index = getattr(values, "_dict_index", None)
+    if index is None:
+        index = {e: i for i, e in enumerate(_entries(values))}
+        object.__setattr__(values, "_dict_index", index)
+    return index.get(needle, -1)
+
+
+# ---------------------------------------------------------------------------
+# cross-dictionary alignment (joins, concat)
+# ---------------------------------------------------------------------------
+
+def align_codes(left: Column, right: Column) -> Tuple[Column, Column]:
+    """Plain INT32 code columns for a DICT32 join-key pair, comparable by
+    value. Co-dictionary pairs pass codes through untouched; otherwise the
+    right side's codes are re-mapped into the left dictionary host-side
+    (once per dictionary PAIR, not per row batch) with absent entries -> -1,
+    which equals no left code."""
+    lcol = Column(dt.INT32, left.size, data=left.data, validity=left.validity)
+    if same_dictionary(left, right):
+        rdata = right.data
+    else:
+        lv, rv = dict_values(left), dict_values(right)
+        index = getattr(lv, "_dict_index", None)
+        if index is None:
+            index = {e: i for i, e in enumerate(_entries(lv))}
+            object.__setattr__(lv, "_dict_index", index)
+        remap = np.array([index.get(e, -1) for e in _entries(rv)],
+                         dtype=np.int32)
+        nd = rv.size
+        if nd:
+            rdata = jnp.take(jnp.asarray(remap),
+                             jnp.clip(right.data, 0, nd - 1))
+        else:
+            rdata = jnp.full((right.size,), -1, dtype=jnp.int32)
+    rcol = Column(dt.INT32, right.size, data=rdata, validity=right.validity)
+    return lcol, rcol
+
+
+def merge_dictionaries(cols: Sequence[Column]) -> List[Column]:
+    """Re-encode DICT32 columns onto ONE shared dictionary (union of entries,
+    first-seen order) so they can be concatenated code-wise. Co-dictionary
+    inputs short-circuit to the originals."""
+    first = dict_values(cols[0])
+    if all(dict_values(c) is first or same_dictionary(c, cols[0])
+           for c in cols[1:]):
+        return list(cols)
+    entries: List[bytes] = []
+    index = {}
+    for c in cols:
+        for e in _entries(dict_values(c)):
+            if e not in index:
+                index[e] = len(entries)
+                entries.append(e)
+    values = values_from_entries(entries)
+    object.__setattr__(values, "_dict_index", dict(index))
+    out = []
+    for c in cols:
+        ents = _entries(dict_values(c))
+        nd = len(ents)
+        remap = np.array([index[e] for e in ents], dtype=np.int32)
+        if nd:
+            codes = jnp.take(jnp.asarray(remap), jnp.clip(c.data, 0, nd - 1))
+        else:
+            codes = jnp.zeros((c.size,), dtype=jnp.int32)
+        out.append(dict_column(codes, values, c.validity))
+    return out

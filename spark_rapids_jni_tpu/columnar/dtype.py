@@ -35,6 +35,7 @@ class TypeId(enum.Enum):
     TIMESTAMP_MILLISECONDS = "timestamp_ms"  # int64
     TIMESTAMP_MICROSECONDS = "timestamp_us"  # int64
     STRING = "string"
+    DICT32 = "dict32"  # int32 codes into a shared string dictionary
     DECIMAL32 = "decimal32"
     DECIMAL64 = "decimal64"
     DECIMAL128 = "decimal128"
@@ -60,6 +61,7 @@ _FIXED_WIDTH_NP = {
     TypeId.TIMESTAMP_MICROSECONDS: np.int64,
     TypeId.DECIMAL32: np.int32,
     TypeId.DECIMAL64: np.int64,
+    TypeId.DICT32: np.int32,
     # DECIMAL128 handled specially: (n, 4) uint32 little-endian limbs.
 }
 
@@ -71,7 +73,7 @@ _SIZE_BYTES = {
     TypeId.INT64: 8, TypeId.UINT64: 8, TypeId.FLOAT64: 8,
     TypeId.TIMESTAMP_SECONDS: 8, TypeId.TIMESTAMP_MILLISECONDS: 8,
     TypeId.TIMESTAMP_MICROSECONDS: 8, TypeId.DECIMAL64: 8,
-    TypeId.DECIMAL128: 16,
+    TypeId.DECIMAL128: 16, TypeId.DICT32: 4,
 }
 
 
@@ -148,6 +150,7 @@ UINT64 = DType(TypeId.UINT64)
 FLOAT32 = DType(TypeId.FLOAT32)
 FLOAT64 = DType(TypeId.FLOAT64)
 STRING = DType(TypeId.STRING)
+DICT32 = DType(TypeId.DICT32)
 TIMESTAMP_DAYS = DType(TypeId.TIMESTAMP_DAYS)
 TIMESTAMP_SECONDS = DType(TypeId.TIMESTAMP_SECONDS)
 TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)

@@ -97,6 +97,15 @@ def concat_columns(cols: Sequence[Column]) -> Column:
             concat_columns([c.children[i] for c in cols])
             for i in range(len(cols[0].children)))
         return Column(d, n, validity=validity, children=children)
+    if tid is dt.TypeId.DICT32:
+        # co-dictionary batches concatenate code-wise and keep SHARING the
+        # dictionary; mixed dictionaries re-encode onto their union first
+        # (host remap of the small per-dictionary entry sets, not the rows)
+        from .dictionary import dict_column, merge_dictionaries
+        cols = merge_dictionaries(cols)
+        codes = jnp.concatenate([c.data for c in cols])
+        return dict_column(codes, cols[0].children[0], validity,
+                           ranks=cols[0].children[1])
     data = jnp.concatenate([c.data for c in cols], axis=0)
     return Column(d, n, data=data, validity=validity)
 

@@ -49,6 +49,10 @@ def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
     elif col.dtype.id is dt.TypeId.DECIMAL128:
         same_val = jnp.all(jnp.take(col.data, idx, axis=0)
                            == jnp.take(col.data, pidx, axis=0), axis=1)
+    elif col.dtype.id is dt.TypeId.DICT32:
+        # dictionary entries are unique, so code equality IS string
+        # equality — no byte-matrix compare
+        same_val = jnp.take(col.data, idx) == jnp.take(col.data, pidx)
     else:
         vals = spark_key_values(col)
         same_val = jnp.take(vals, idx) == jnp.take(vals, pidx)
@@ -171,7 +175,8 @@ def _decimal128_segment_mean(vcol: Column, order, valid, seg_ids,
 
 
 def _segment_agg_fixed(vcol: Column, order, valid, seg_ids,
-                       num_segments: int, cnt, op: str) -> Column:
+                       num_segments: int, cnt, op: str,
+                       sorted_ids: bool = True) -> Column:
     """One non-decimal aggregation over sorted segments — the pure jnp
     body shared by the eager op and the fused plan core. ``valid`` is the
     per-sorted-row contribution mask (null mask, optionally ANDed with a
@@ -182,12 +187,13 @@ def _segment_agg_fixed(vcol: Column, order, valid, seg_ids,
     if op == "count":
         return Column(dt.INT64, num_segments, data=cnt)
     vals, is_float = _agg_values(vcol)
-    vals = jnp.take(vals, order)
+    if order is not None:  # None: rows already in segment-id order space
+        vals = jnp.take(vals, order)
     any_valid = cnt > 0
     if op in ("sum", "mean"):
         z = jnp.where(valid, vals, jnp.zeros_like(vals))
         s = jax.ops.segment_sum(z, seg_ids, num_segments=num_segments,
-                                indices_are_sorted=True)
+                                indices_are_sorted=sorted_ids)
         if op == "mean":
             m = s / jnp.maximum(cnt, 1).astype(s.dtype)
             return Column(dt.FLOAT64, num_segments,
@@ -198,13 +204,13 @@ def _segment_agg_fixed(vcol: Column, order, valid, seg_ids,
                else jnp.iinfo(jnp.int64).max)
         z = jnp.where(valid, vals, big)
         res = jax.ops.segment_min(z, seg_ids, num_segments=num_segments,
-                                  indices_are_sorted=True)
+                                  indices_are_sorted=sorted_ids)
     elif op == "max":
         small = (jnp.asarray(-np.inf, vals.dtype) if is_float
                  else jnp.iinfo(jnp.int64).min)
         z = jnp.where(valid, vals, small)
         res = jax.ops.segment_max(z, seg_ids, num_segments=num_segments,
-                                  indices_are_sorted=True)
+                                  indices_are_sorted=sorted_ids)
     else:
         raise ValueError(f"unknown aggregation {op}")
     if out_dtype.id is dt.TypeId.FLOAT64:
@@ -238,6 +244,12 @@ def _agg_out_dtype(vdtype: dt.DType, op: str) -> dt.DType:
     sum(int)→long, sum(decimal)→decimal same scale, mean→double)."""
     if op == "count":
         return dt.INT64
+    if vdtype.id is dt.TypeId.DICT32:
+        # codes are labels, not numbers: every numeric agg over an encoded
+        # string value column is meaningless (keys are fine — they never
+        # pass through here)
+        raise TypeError("groupby aggregation over dictionary-encoded "
+                        "string value columns supports count only")
     if vdtype.id is dt.TypeId.DECIMAL128:
         if op == "mean":
             # Spark avg(decimal(p, s)) -> decimal scale min(s+4, 38)
@@ -285,9 +297,97 @@ def groupby_aggregate(
             _groupby_aggregate(table, key_indices, aggs, row_mask), took)
 
 
+def _dict_code_groupby(table: Table, key_indices, aggs, row_mask):
+    """Sort-free groupby for a single dictionary-encoded key. Ranks map
+    codes straight to group-sorted slots (null group first — matching the
+    sorted path's ascending/nulls-first default — then entries in rank
+    order), so segmentation is a scatter-add over |dictionary|+1 slots
+    instead of an n-row lexsort. Bit-identical to the sorted path: the
+    stable lexsort makes a group's representative its first row in table
+    order, which is exactly segment_min of the row index. Returns None
+    when inapplicable (multi-key, decimal aggs, or order-sensitive float
+    accumulation that must match the fused core's sorted-order sums)."""
+    if len(key_indices) != 1:
+        return None
+    key = table.columns[key_indices[0]]
+    if key.dtype.id is not dt.TypeId.DICT32 or key.size == 0:
+        return None
+    for ci, op in aggs:
+        did = table.columns[ci].dtype.id
+        if did is dt.TypeId.DECIMAL128:
+            return None  # limb carries stay on the sorted path
+        if did in (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64) \
+                and op in ("sum", "mean"):
+            return None  # fp addition order must match the sorted path
+    n = key.size
+    ranks = key.children[1].data
+    card = int(ranks.size)
+    valid = key.valid_mask()
+    if card:
+        slot = jnp.where(valid,
+                         jnp.take(ranks, jnp.clip(key.data, 0, card - 1))
+                         + 1, 0).astype(jnp.int32)
+    else:
+        slot = jnp.zeros((n,), jnp.int32)  # all-null: one group at slot 0
+    if row_mask is not None:
+        live = jnp.asarray(row_mask, dtype=bool)
+        if live.shape != (n,):
+            raise ValueError(
+                f"boolean row_mask shape {live.shape} != table rows "
+                f"({n},)")  # mirror filter_table's contract
+    else:
+        live = jnp.ones((n,), bool)
+    rows_in_slot = jax.ops.segment_sum(live.astype(jnp.int32), slot,
+                                       num_segments=card + 1)
+    present = rows_in_slot > 0
+    pos = jnp.cumsum(present.astype(jnp.int32)) - 1  # slot -> group id
+    true_segments = int(jnp.sum(present))  # the op's one host sync
+    num_segments = bucket_size(max(true_segments, 1))
+    # dead rows park in segment 0 with all contributions masked off
+    seg_ids = jnp.where(live, jnp.take(pos, slot), 0).astype(jnp.int32)
+    # the key column falls straight out of the dictionary — group g's key
+    # is the entry whose rank is its slot position (no n-row gather): for
+    # a valid group every row carries that same code, and for the null
+    # group (slot 0) the code is masked by validity just like the sorted
+    # path's representative row
+    from ..columnar.dictionary import dict_column
+    slot_of_group = jnp.nonzero(present, size=num_segments,
+                                fill_value=0)[0].astype(jnp.int32)
+    if card:
+        inv_rank = jnp.argsort(ranks).astype(jnp.int32)
+        code_of_group = jnp.take(inv_rank,
+                                 jnp.maximum(slot_of_group - 1, 0))
+    else:
+        code_of_group = jnp.zeros((num_segments,), jnp.int32)
+    validity = None if key.validity is None else slot_of_group > 0
+    out_cols = [dict_column(code_of_group, key.children[0],
+                            validity=validity, ranks=key.children[1])]
+    cnt_cache = {}  # (mask, count) per value column — shared across aggs
+    for ci, op in aggs:
+        vcol = table.columns[ci]
+        _agg_out_dtype(vcol.dtype, op)  # validates op/type pair
+        if ci not in cnt_cache:
+            v = vcol.valid_mask() & live
+            # accumulate in i32 (n < 2^31) — scatter-add is the hot loop
+            cnt_cache[ci] = (v, jax.ops.segment_sum(
+                v.astype(jnp.int32), seg_ids,
+                num_segments=num_segments).astype(jnp.int64))
+        v, cnt = cnt_cache[ci]
+        if op == "count":
+            out_cols.append(Column(dt.INT64, num_segments, data=cnt))
+        else:
+            out_cols.append(_segment_agg_fixed(
+                vcol, None, v, seg_ids, num_segments, cnt, op,
+                sorted_ids=False))
+    return Table(tuple(_shrink(c, true_segments) for c in out_cols))
+
+
 def _groupby_aggregate(
         table: Table, key_indices: Sequence[int],
         aggs: Sequence[Tuple[int, str]], row_mask=None) -> Table:
+    fast = _dict_code_groupby(table, key_indices, aggs, row_mask)
+    if fast is not None:
+        return fast
     keys = [table.columns[i] for i in key_indices]
     dead_col = None
     if row_mask is not None:

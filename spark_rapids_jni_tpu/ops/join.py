@@ -114,6 +114,7 @@ def _candidates(left_keys, right_keys, nulls_equal,
       the contract always allowed.
     - cpu: exact path with host compaction (syncs are free there).
     """
+    left_keys, right_keys = _align_dict_key_pairs(left_keys, right_keys)
     if left_mask is not None:
         left_mask = jnp.asarray(left_mask, dtype=bool)
     if right_mask is not None:
@@ -191,6 +192,24 @@ def _candidates(left_keys, right_keys, nulls_equal,
         # Columns for sort/groupby) are the caller's accounting, same as
         # the reference's RMM brackets ending when do_allocate returns
         return release_barrier(out, took)
+
+
+def _align_dict_key_pairs(left_keys, right_keys):
+    """Dictionary-encoded key pairs join as plain INT32 code columns:
+    co-dictionary pairs compare codes directly (identity remap); pairs with
+    different dictionaries re-map the right side into the left dictionary
+    once per dictionary pair (absent entries -> -1, matching no left code).
+    String bytes are never touched — the encoded join is an int32 join."""
+    if not any(lc.dtype.id is dt.TypeId.DICT32 for lc in left_keys):
+        return left_keys, right_keys
+    from ..columnar.dictionary import align_codes
+    lout, rout = [], []
+    for lc, rc in zip(left_keys, right_keys):
+        if lc.dtype.id is dt.TypeId.DICT32 and rc.dtype.id is dt.TypeId.DICT32:
+            lc, rc = align_codes(lc, rc)
+        lout.append(lc)
+        rout.append(rc)
+    return lout, rout
 
 
 def _verify_width(col: Column) -> int:

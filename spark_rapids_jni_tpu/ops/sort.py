@@ -71,6 +71,16 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
         limbs = data
         top = limbs[:, 3] ^ np.uint32(1 << 31)
         return [top, limbs[:, 2], limbs[:, 1], limbs[:, 0]]
+    if tid is dt.TypeId.DICT32:
+        # encoded strings sort by the once-per-dictionary rank permutation
+        # (children[1]): one int32 gather replaces L/4 padded byte lanes.
+        # Must precede the signedinteger default — raw codes carry NO order.
+        ranks = col.children[1].data
+        nd = int(ranks.shape[0])
+        if nd == 0:  # empty dictionary => all rows null; lane is masked
+            return [jnp.zeros(data.shape, dtype=jnp.uint32)]
+        lane = jnp.take(ranks, jnp.clip(data, 0, nd - 1))
+        return [lane.astype(jnp.uint32)]
     if col.dtype.np_dtype is not None and np.issubdtype(col.dtype.np_dtype,
                                                         np.signedinteger):
         wide = data.astype(jnp.int64)
@@ -188,6 +198,11 @@ def gather(col: Column, idx: jnp.ndarray) -> Column:
     if tid is dt.TypeId.STRUCT:
         children = tuple(gather(c, idx) for c in col.children)
         return Column(col.dtype, m, validity=validity, children=children)
+    if tid is dt.TypeId.DICT32:
+        # gather the codes; the dictionary (values, ranks) is row-invariant
+        # and stays SHARED by reference
+        return Column(col.dtype, m, data=jnp.take(col.data, idx),
+                      validity=validity, children=col.children)
     return Column(col.dtype, m, data=jnp.take(col.data, idx, axis=0),
                   validity=validity)
 

@@ -313,6 +313,37 @@ def pages_supported(leaf, pages: List[_Page]) -> bool:
     return True
 
 
+def parse_byte_array_dictionary(blob: np.ndarray, page: _Page):
+    """Host parse of a BYTE_ARRAY dictionary page's length-prefixed
+    layout -> (flat uint8 bytes, int64 offsets). Shared by the decode
+    path and the reader's predicate-pushdown probe (which needs only
+    entry membership, never the data pages)."""
+    nd = page.num_values
+    offs = np.zeros(nd + 1, np.int64)
+    pos = page.val_off
+    parts = []
+    for i in range(nd):
+        ln = int(np.frombuffer(blob[pos:pos + 4].tobytes(),
+                               np.uint32)[0])
+        pos += 4
+        parts.append(blob[pos:pos + ln])
+        pos += ln
+        offs[i + 1] = offs[i] + ln
+    flat = (np.concatenate(parts) if parts
+            else np.zeros(0, np.uint8))
+    return flat, offs
+
+
+def dictionary_entry_set(blob: np.ndarray, page: _Page) -> frozenset:
+    """Membership set of a BYTE_ARRAY dictionary page's entries (the
+    pushdown probe's statistic: an equality literal absent from it can
+    match no row of a fully dict-encoded chunk)."""
+    flat, offs = parse_byte_array_dictionary(blob, page)
+    blob_b = flat.tobytes()
+    return frozenset(blob_b[int(offs[i]):int(offs[i + 1])]
+                     for i in range(page.num_values))
+
+
 def _decode_dictionary(leaf, blob: np.ndarray, blob_dev, page: _Page):
     """Dictionary values: fixed-width dicts assemble on device from the
     already-shipped blob; a BYTE_ARRAY dict (small by construction)
@@ -320,26 +351,19 @@ def _decode_dictionary(leaf, blob: np.ndarray, blob_dev, page: _Page):
     offsets."""
     nd = page.num_values
     if leaf.physical == _PT_BYTE_ARRAY:
-        offs = np.zeros(nd + 1, np.int64)
-        pos = page.val_off
-        parts = []
-        for i in range(nd):
-            ln = int(np.frombuffer(blob[pos:pos + 4].tobytes(),
-                                   np.uint32)[0])
-            pos += 4
-            parts.append(blob[pos:pos + ln])
-            pos += ln
-            offs[i + 1] = offs[i] + ln
-        flat = (np.concatenate(parts) if parts
-                else np.zeros(0, np.uint8))
-        return ("bytes", jnp.asarray(flat),
-                jnp.asarray(offs.astype(np.int32)))
+        flat, offs = parse_byte_array_dictionary(blob, page)
+        offs32 = offs.astype(np.int32)
+        # host copies ride along: the DICT32 path seeds the values
+        # column's host mirrors from them, so fingerprinting the
+        # dictionary never costs a device readback
+        return ("bytes", jnp.asarray(flat), jnp.asarray(offs32),
+                flat, offs32)
     es = _ELEM_SIZE[leaf.physical]
     if leaf.physical == _PT_BOOLEAN:
         vals = _plain_bool(blob_dev, page.val_off, nd)
     else:
         vals = _plain_fixed(blob_dev, page.val_off, nd, es)
-    return ("fixed", vals, None)
+    return ("fixed", vals, None, None, None)
 
 
 def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
@@ -426,14 +450,17 @@ def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
 
     if any_dict_data:
         idx_rows = jnp.concatenate(idx_parts)  # row-aligned per page
-        kind, payload, offs = dictionary
-        if kind == "fixed":
-            if payload.shape[0] == 0:  # all-null column: empty dictionary
-                data = jnp.zeros(idx_rows.shape, payload.dtype)
-            else:
-                data = jnp.take(payload, jnp.clip(idx_rows, 0,
-                                                  payload.shape[0] - 1))
+        kind, payload, offs, host_flat, host_offs = dictionary
+        nd = (int(payload.shape[0]) if kind == "fixed"
+              else int(offs.shape[0]) - 1)
+        if nd == 0:
+            entries = _finish_empty_dict(eleaf, rows, idx_rows, validity)
+        elif kind == "fixed":
+            data = jnp.take(payload, jnp.clip(idx_rows, 0, nd - 1))
             entries = _finish_fixed(eleaf, rows, data, validity)
+        elif _encoded_strings(is_list):
+            entries = _finish_dict32(rows, idx_rows, payload, offs,
+                                     host_flat, host_offs, validity)
         else:
             entries = _finish_string_dict(eleaf, rows, idx_rows, payload,
                                           offs, validity)
@@ -507,18 +534,55 @@ def _finish_fixed(leaf, rows: int, lanes: jnp.ndarray,
     return Column(d, rows, data=data, validity=validity)
 
 
+def _finish_empty_dict(leaf, rows: int, idx_rows, validity) -> Column:
+    """All-null chunk: the dictionary page holds zero entries, so every
+    index in ``idx_rows`` is padding under a null mask. One shared
+    early-out for the fixed and BYTE_ARRAY assembly paths (an empty
+    gather source admits no take)."""
+    if leaf.dtype.id is TypeId.STRING:
+        return Column(dt.STRING, rows, data=jnp.zeros((0,), jnp.uint8),
+                      validity=validity,
+                      offsets=jnp.zeros(rows + 1, jnp.int32))
+    return _finish_fixed(leaf, rows, jnp.zeros(idx_rows.shape, jnp.uint64),
+                         validity)
+
+
+def _encoded_strings(is_list: bool) -> bool:
+    """Surface dictionary-encoded BYTE_ARRAY chunks as DICT32? LIST
+    element children stay materialized — list assembly gathers element
+    slots and the encoded form has no offsets to fold."""
+    if is_list:
+        return False
+    from ..utils import config
+    return bool(config.get("parquet.encoded_strings"))
+
+
+def _finish_dict32(rows: int, idx, flat, offs, host_flat, host_offs,
+                   validity) -> Column:
+    """DICT32 column straight from the decode: the expanded row indices
+    ARE the codes — the gather that _finish_string_dict would run is
+    skipped entirely and deferred to materialize() at an output
+    boundary. The shared values column wraps the already-shipped device
+    dictionary buffers and seeds its host mirrors from the numpy arrays
+    the host-side dictionary parse produced, so fingerprinting (program
+    cache key, co-dictionary checks) costs no device readback."""
+    from ..columnar.dictionary import dict_column
+    nd = int(host_offs.shape[0]) - 1
+    values = Column(dt.STRING, nd, data=flat, offsets=offs)
+    values._seed_host_cache(host_flat, host_offs)
+    codes = jnp.clip(idx, 0, nd - 1).astype(jnp.int32)
+    return dict_column(codes, values, validity)
+
+
 def _finish_string_dict(leaf, rows: int, idx, flat, offs,
                         validity) -> Column:
     """STRING column from dictionary gather: per-row (start, length)
     spans from the dict offsets, then the shared gather_spans path (one
-    output-sizing sync)."""
+    output-sizing sync). Empty dictionaries are handled upstream by
+    ``_finish_empty_dict``."""
     from ..columnar.strings import gather_spans
     lens_d = offs[1:] - offs[:-1]
     nd = lens_d.shape[0]
-    if nd == 0:  # all-null column: empty dictionary
-        return Column(dt.STRING, rows, data=jnp.zeros((0,), jnp.uint8),
-                      validity=validity,
-                      offsets=jnp.zeros(rows + 1, jnp.int32))
     safe_idx = jnp.clip(idx, 0, max(0, nd - 1))
     return gather_spans(flat, jnp.take(offs[:-1], safe_idx),
                         jnp.take(lens_d, safe_idx), validity)

@@ -43,6 +43,35 @@ from ..memory.reservation import device_reservation, release_barrier
 _lock = threading.Lock()
 _lib = None
 
+
+class ReaderMetrics:
+    """Predicate-pushdown counters for the chunked reader, surfaced in
+    bench rows and asserted by the page-skip tests. ``inc`` (not
+    ``bump``): SRJT008 reserves ``.bump`` for the fault domain's fixed
+    counter set."""
+
+    _COUNTERS = ("pages_skipped", "bytes_skipped", "row_groups_skipped",
+                 "pushdown_probes")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c = {k: 0 for k in self._COUNTERS}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[name] += by
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._c)
+
+
+reader_metrics = ReaderMetrics()
+
 # parquet physical types
 _PT_BOOLEAN, _PT_INT32, _PT_INT64, _PT_INT96 = 0, 1, 2, 3
 _PT_FLOAT, _PT_DOUBLE, _PT_BYTE_ARRAY, _PT_FLBA = 4, 5, 6, 7
@@ -180,8 +209,18 @@ class ParquetReader:
     device as a `Table`. Host memory stays bounded by the largest chunk.
     """
 
-    def __init__(self, path: str, columns: Optional[Sequence[str]] = None):
+    def __init__(self, path: str, columns: Optional[Sequence[str]] = None,
+                 predicate=None):
         self._path = path
+        # plan expression over the SELECTED columns (plan/expr.py). Only
+        # used for row-group pruning: equality conjuncts against string
+        # columns are tested for dictionary-page membership before any
+        # decode (see _qualifying_groups); the caller still applies the
+        # full predicate downstream — pruning only removes row groups
+        # that provably contain no qualifying row, so results are
+        # bit-identical with pushdown on or off.
+        self._predicate = predicate
+        self._probe_cache = {}
         self._lib = _load()
         with open(path, "rb") as f:
             footer = _read_footer_bytes(f)
@@ -453,6 +492,142 @@ class ParquetReader:
         host = values.view(dtype.np_dtype)
         return Column(dtype, rows, data=jnp.asarray(host), validity=vmask)
 
+    # ---- predicate pushdown (dictionary-page membership) ------------------
+
+    @staticmethod
+    def _pushdown_conjuncts(predicate):
+        """Equality conjuncts usable for row-group pruning: (column
+        index, literal byte-set) pairs where the predicate is an
+        AND-tree and the pair is ``col(i) == "lit"`` — or an OR of such
+        equalities on ONE column (the IN shape). A row group whose
+        dictionary lacks EVERY literal of any one conjunct can contain
+        no qualifying row."""
+        from ..plan import expr as ex
+
+        def eq_set(x):
+            if isinstance(x, ex.BinOp):
+                if x.op == "or":
+                    a, b = eq_set(x.left), eq_set(x.right)
+                    if a is not None and b is not None and a[0] == b[0]:
+                        return (a[0], a[1] | b[1])
+                    return None
+                if x.op == "eq":
+                    l, r = x.left, x.right
+                    if isinstance(l, ex.Lit):
+                        l, r = r, l
+                    if (isinstance(l, ex.Col) and isinstance(r, ex.Lit)
+                            and isinstance(r.value, str)):
+                        return (l.index, frozenset((r.value.encode(),)))
+            return None
+
+        out = []
+
+        def walk(x):
+            from ..plan import expr as ex
+            if isinstance(x, ex.BinOp) and x.op == "and":
+                walk(x.left)
+                walk(x.right)
+                return
+            got = eq_set(x)
+            if got is not None:
+                out.append(got)
+
+        walk(predicate)
+        return out
+
+    def _probe_dictionary(self, f, g: int, leaf: LeafSchema):
+        """Pushdown statistic for one (row group, string leaf): the
+        dictionary page's entry set, whether every data page is
+        dictionary-encoded (a fallback chunk can hold literals outside
+        the dictionary), and the data-page count. None when the chunk
+        has no parsable dictionary page. Cached per (group, leaf)."""
+        key = (g, leaf.index)
+        if key in self._probe_cache:
+            return self._probe_cache[key]
+        from . import device_decode as dd
+        off, length, _, _ = self._chunk_range(g, leaf.index)
+        f.seek(off)
+        buf = np.frombuffer(f.read(length), dtype=np.uint8)
+        res = None
+        try:
+            blob, pages = dd.extract_pages(self._lib, self._h, g,
+                                           leaf.index, buf)
+        except RuntimeError:
+            pages = None  # corrupt/unsupported: never prune on it
+        if pages is not None:
+            reader_metrics.inc("pushdown_probes")
+            entries = None
+            all_dict = True
+            n_data = 0
+            for p in pages:
+                if p.ptype == 2:
+                    if p.encoding in (dd._ENC_PLAIN, dd._ENC_PLAIN_DICT):
+                        entries = dd.dictionary_entry_set(blob, p)
+                else:
+                    n_data += 1
+                    if p.encoding not in (dd._ENC_PLAIN_DICT,
+                                          dd._ENC_RLE_DICT):
+                        all_dict = False
+            if entries is not None:
+                res = (entries, all_dict, n_data)
+        self._probe_cache[key] = res
+        return res
+
+    def _group_prunable(self, f, g: int) -> Optional[int]:
+        """Data-page count of the proving chunk when row group ``g``
+        provably holds no qualifying row, else None."""
+        for idx, lits in self._conjuncts:
+            plan = self._selected_plans[idx]
+            if plan.kind != "simple":
+                continue
+            leaf = plan.leaves[0]
+            if leaf.max_rep != 0 or leaf.physical != _PT_BYTE_ARRAY:
+                continue
+            probe = self._probe_dictionary(f, g, leaf)
+            if probe is None:
+                continue
+            entries, all_dict, n_data = probe
+            if not all_dict:
+                # dictionary-fallback chunk: PLAIN pages may hold values
+                # outside the dictionary — membership proves nothing
+                continue
+            if not (lits & entries):
+                return n_data
+        return None
+
+    def _qualifying_groups(self) -> List[int]:
+        """Row groups left after predicate pushdown (all of them when no
+        predicate / pushdown disabled). Skipped groups are counted:
+        ``pages_skipped`` = data pages of the chunk that proved the skip
+        (the only chunk whose page inventory the probe parsed),
+        ``bytes_skipped`` = summed compressed bytes of every selected
+        chunk in the group — none of which is decoded or shipped."""
+        groups = list(range(self.num_row_groups))
+        from ..utils import config
+        if self._predicate is None \
+                or not config.get("parquet.predicate_pushdown"):
+            return groups
+        if not hasattr(self, "_conjuncts"):
+            self._conjuncts = self._pushdown_conjuncts(self._predicate)
+        if not self._conjuncts:
+            return groups
+        keep, skipped = [], []
+        with open(self._path, "rb") as f:
+            for g in groups:
+                n_data = self._group_prunable(f, g)
+                (keep if n_data is None else skipped).append(
+                    g if n_data is None else (g, n_data))
+        if not keep and skipped \
+                and any(p.kind != "simple" for p in self._selected_plans):
+            # nested output columns have no synthesizable 0-row shape;
+            # keep one group (its rows are filtered downstream anyway)
+            keep.append(skipped.pop()[0])
+        for g, n_data in skipped:
+            reader_metrics.inc("row_groups_skipped")
+            reader_metrics.inc("pages_skipped", n_data)
+            reader_metrics.inc("bytes_skipped", self._rg_bytes(g))
+        return keep
+
     def iter_chunks(self, byte_budget: Optional[int] = None) -> Iterator[Table]:
         """Yield one device Table per chunk of row groups.
 
@@ -460,24 +635,25 @@ class ParquetReader:
         compressed column-chunk bytes stay within ``byte_budget`` (default:
         the ``parquet.chunk_byte_budget`` config flag; always at least one
         row group, mirroring the reference chunked reader's
-        at-least-one-row-group guarantee).
+        at-least-one-row-group guarantee). Row groups pruned by predicate
+        pushdown never enter a chunk.
         """
         if byte_budget is None:
             from ..utils import config
             byte_budget = int(config.get("parquet.chunk_byte_budget"))
-        n_rg = self.num_row_groups
-        rg = 0
-        while rg < n_rg:
-            group = [rg]
-            used = self._rg_bytes(rg)
-            rg += 1
-            while rg < n_rg:
-                nxt = self._rg_bytes(rg)
+        pending = self._qualifying_groups()
+        i, n = 0, len(pending)
+        while i < n:
+            group = [pending[i]]
+            used = self._rg_bytes(pending[i])
+            i += 1
+            while i < n:
+                nxt = self._rg_bytes(pending[i])
                 if used + nxt > byte_budget:
                     break
-                group.append(rg)
+                group.append(pending[i])
                 used += nxt
-                rg += 1
+                i += 1
             yield self._read_groups(group)
 
     @staticmethod
@@ -491,7 +667,34 @@ class ParquetReader:
             n += sum(x.nbytes for x in p[4] if isinstance(x, np.ndarray))
         return n
 
+    def _empty_plan_column(self, plan: ColumnPlan) -> Column:
+        """0-row Column for a simple plan (every row group was pruned)."""
+        from . import device_decode as dd
+        leaf = plan.leaves[0]
+        values = np.zeros(0, np.uint8)
+        offsets = (np.zeros(1, np.int32)
+                   if leaf.physical == _PT_BYTE_ARRAY else None)
+        lists = ((0, np.zeros(1, np.int32), None)
+                 if leaf.max_rep == 1 else None)
+        col = self._to_column(leaf, 0, values, offsets, None, lists)
+        if (leaf.physical == _PT_BYTE_ARRAY and leaf.max_rep == 0
+                and self._device_tier_enabled()
+                and dd._encoded_strings(False)):
+            # keep the encoded-shape contract: downstream plans that
+            # resolved string literals against DICT32 columns must still
+            # see DICT32 (with an empty dictionary) when every group is
+            # pruned, not a bare STRING column
+            from ..columnar.dictionary import dict_column
+            col = dict_column(jnp.zeros((0,), jnp.int32), col)
+        return col
+
     def _read_groups(self, groups: Sequence[int]) -> Table:
+        if not groups:
+            # pushdown pruned everything (only reachable when all
+            # selected plans are simple — _qualifying_groups keeps one
+            # group otherwise)
+            return Table(tuple(self._empty_plan_column(p)
+                               for p in self._selected_plans))
         # Decode column plans in parallel: the native decoder runs outside
         # the GIL (ctypes releases it), so page decode scales with cores the
         # way the reference's decode scales with SMs. A sliding window of at
@@ -639,7 +842,10 @@ class ParquetReader:
         est = 0
         for b, pages, nv, _lr in parts:
             est += int(nv) * 17 + int(b.nbytes)
-            if leaf.physical == _PT_BYTE_ARRAY:
+            if leaf.physical == _PT_BYTE_ARRAY \
+                    and not dd._encoded_strings(leaf.max_rep == 1):
+                # encoded-strings mode skips the gather: rows hold int32
+                # codes only, the flat dictionary bytes stay shared
                 for p in pages:
                     if p.ptype == 2 and p.num_values:
                         avg = max(1, (p.val_len - 4 * p.num_values)
@@ -727,8 +933,9 @@ class ParquetReader:
 
     def read_all(self) -> Table:
         """Decode the whole file into one Table (host memory scales with the
-        file; use iter_chunks for bounded-memory streaming)."""
-        return self._read_groups(list(range(self.num_row_groups)))
+        file; use iter_chunks for bounded-memory streaming). Row groups
+        pruned by predicate pushdown are never decoded."""
+        return self._read_groups(self._qualifying_groups())
 
     def close(self):
         if self._h:
@@ -742,7 +949,11 @@ class ParquetReader:
         self.close()
 
 
-def read_parquet(path: str, columns: Optional[Sequence[str]] = None) -> Table:
-    """One-shot convenience: decode an entire file to a device Table."""
-    with ParquetReader(path, columns=columns) as r:
+def read_parquet(path: str, columns: Optional[Sequence[str]] = None,
+                 predicate=None) -> Table:
+    """One-shot convenience: decode an entire file to a device Table.
+    ``predicate`` (a plan expression over the selected columns) enables
+    dictionary-membership row-group pruning; the caller still applies
+    the predicate to the returned rows."""
+    with ParquetReader(path, columns=columns, predicate=predicate) as r:
         return r.read_all()

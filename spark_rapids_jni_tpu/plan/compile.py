@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..ops.groupby import groupby_core
 from ..ops.sort import gather, sort_lanes
@@ -98,14 +99,27 @@ class CompiledPlan:
 def _shape_key(table: Table) -> Tuple:
     """Input signature component of the cache key: per-column dtype,
     static size, and validity presence — everything that changes the
-    traced program. Data values are deliberately absent."""
-    return tuple((c.dtype.id.value, getattr(c.dtype, "scale", 0) or 0,
-                  c.size, c.validity is not None) for c in table.columns)
+    traced program. Data values are deliberately absent, with one
+    exception: DICT32 columns append their dictionary fingerprint. The
+    dictionary enters the program as a constant-like traced operand
+    (never donated), and its fingerprint keys the cache so programs
+    never alias across dictionaries (it also subsumes the dictionary's
+    byte/entry shapes, which the AOT executable is locked to)."""
+    key = []
+    for c in table.columns:
+        ent: Tuple = (c.dtype.id.value, getattr(c.dtype, "scale", 0) or 0,
+                      c.size, c.validity is not None)
+        if c.dtype.id is dt.TypeId.DICT32:
+            from ..columnar.dictionary import dictionary_fingerprint
+            ent = ent + (dictionary_fingerprint(c),)
+        key.append(ent)
+    return tuple(key)
 
 
 def _slice_col(c: Column, k: int) -> Column:
     v = c.validity[:k] if c.validity is not None else None
-    return Column(c.dtype, k, data=c.data[:k], validity=v)
+    return Column(c.dtype, k, data=c.data[:k], validity=v,
+                  children=c.children)
 
 
 def _make_fn(plan: PlanNode, max_groups: int, out_info: Dict[str, Any]):
@@ -135,8 +149,7 @@ def _make_fn(plan: PlanNode, max_groups: int, out_info: Dict[str, Any]):
                 live = jnp.sum(mask, dtype=jnp.int32)
                 prefix = False
             elif isinstance(node, Project):
-                cols = [ex.materialize(ex.eval_expr(e, cols), n)
-                        for e in node.exprs]
+                cols = [ex.project_column(e, cols, n) for e in node.exprs]
             elif isinstance(node, GroupBy):
                 G = bucket_size(min(max_groups, n))
                 keys = [cols[i] for i in node.keys]

@@ -21,19 +21,22 @@ group-budget overflow detected on device (``plan_overflows``).
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Optional
+from typing import List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.table_ops import gather_table, mask_indices_core
 from ..faultinj.guard import guarded_dispatch
 from ..memory.reservation import device_reservation, release_barrier
+from . import expr as ex
 from .compile import CompiledPlan, ProgramCache, plan_metrics
 from .interpreter import run_eager
-from .nodes import PlanNode
+from .nodes import Filter, GroupBy, PlanNode, Project, linearize
 
 _default_cache = ProgramCache()
 
@@ -60,8 +63,95 @@ def _trim_prefix(cols, live: int) -> Table:
     out = []
     for c in cols:
         v = c.validity[:live] if c.validity is not None else None
-        out.append(Column(c.dtype, live, data=c.data[:live], validity=v))
+        out.append(Column(c.dtype, live, data=c.data[:live], validity=v,
+                          children=c.children))
     return Table(tuple(out))
+
+
+# ---------------------------------------------------------------------------
+# dictionary literal resolution
+# ---------------------------------------------------------------------------
+
+def _has_str_lit(e: ex.Expr) -> bool:
+    if isinstance(e, ex.Lit):
+        return isinstance(e.value, str)
+    if isinstance(e, ex.BinOp):
+        return _has_str_lit(e.left) or _has_str_lit(e.right)
+    if isinstance(e, (ex.Not, ex.Cast64)):
+        return _has_str_lit(e.operand)
+    return False
+
+
+def _resolve_pair(left: ex.Expr, right: ex.Expr, desc):
+    from ..columnar.dictionary import lookup_code
+
+    def code_lit(lit_e, col_e):
+        if not (isinstance(col_e, ex.Col)
+                and col_e.index < len(desc)
+                and desc[col_e.index] is not None):
+            raise TypeError(
+                "a string literal in a plan expression can only be "
+                "compared (eq/ne) against a dictionary-encoded column")
+        return ex.Lit(int(lookup_code(desc[col_e.index], lit_e.value)))
+
+    if isinstance(left, ex.Lit) and isinstance(left.value, str):
+        left = code_lit(left, right)
+    if isinstance(right, ex.Lit) and isinstance(right.value, str):
+        right = code_lit(right, left)
+    return left, right
+
+
+def _resolve_expr(e: ex.Expr, desc) -> ex.Expr:
+    if isinstance(e, ex.Lit) and isinstance(e.value, str):
+        raise TypeError(
+            "string literal outside an eq/ne comparison with a "
+            "dictionary-encoded column")
+    if isinstance(e, ex.BinOp):
+        left, right = e.left, e.right
+        if e.op in ("eq", "ne"):
+            left, right = _resolve_pair(left, right, desc)
+        return ex.BinOp(e.op, _resolve_expr(left, desc),
+                        _resolve_expr(right, desc))
+    if isinstance(e, ex.Not):
+        return ex.Not(_resolve_expr(e.operand, desc))
+    if isinstance(e, ex.Cast64):
+        return ex.Cast64(_resolve_expr(e.operand, desc))
+    return e
+
+
+def resolve_dict_literals(plan: PlanNode, table: Table) -> PlanNode:
+    """Rewrite string literals compared against DICT32 columns into their
+    int32 dictionary codes (absent entry -> -1, which no code equals — the
+    encoded always-false). A pure, deterministic pre-trace pass: the
+    rewritten plan's fingerprint keys the program cache, so queries whose
+    literals resolve to different codes compile/cached separately and the
+    fused program contains only integer compares. Plans without string
+    literals return UNCHANGED (same object, same fingerprint)."""
+    nodes = linearize(plan)
+    needs = any(
+        (isinstance(n, Filter) and _has_str_lit(n.predicate))
+        or (isinstance(n, Project) and any(_has_str_lit(e) for e in n.exprs))
+        for n in nodes[1:])
+    if not needs:
+        return plan
+    desc: List[Optional[Column]] = [
+        c if c.dtype.id is dt.TypeId.DICT32 else None for c in table.columns]
+    new_plan: PlanNode = nodes[0]
+    for node in nodes[1:]:
+        if isinstance(node, Filter):
+            node = Filter(new_plan, _resolve_expr(node.predicate, desc))
+        elif isinstance(node, Project):
+            exprs = tuple(_resolve_expr(e, desc) for e in node.exprs)
+            desc = [desc[e.index] if isinstance(e, ex.Col) else None
+                    for e in exprs]
+            node = Project(new_plan, exprs)
+        else:
+            if isinstance(node, GroupBy):
+                desc = ([desc[i] for i in node.keys]
+                        + [None] * len(node.aggs))
+            node = dataclasses.replace(node, child=new_plan)
+        new_plan = node
+    return new_plan
 
 
 def execute_plan(plan: PlanNode, table: Table,
@@ -73,6 +163,13 @@ def execute_plan(plan: PlanNode, table: Table,
     with the table AND is willing to lose in-flight retry (a fault
     mid-program after donation cannot re-run; the guard surfaces it)."""
     cache = cache if cache is not None else _default_cache
+    plan = resolve_dict_literals(plan, table)
+    if donate_input and any(c.dtype.id is dt.TypeId.DICT32
+                            for c in table.columns):
+        # the dictionary (values/ranks children) is SHARED across every
+        # batch from the same parquet dictionary page — donating it would
+        # let XLA scribble over buffers other queries still reference
+        donate_input = False
     reason = unsupported_reason(plan, table)
     if reason is not None:
         plan_metrics.inc("plan_fallbacks")

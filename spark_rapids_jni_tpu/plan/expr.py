@@ -57,7 +57,7 @@ _BOOL = {"and", "or"}
 def _wrap(v) -> "Expr":
     if isinstance(v, Expr):
         return v
-    if isinstance(v, (bool, int)):
+    if isinstance(v, (bool, int, str)):
         return Lit(v)
     raise TypeError(f"cannot use {type(v).__name__} in a plan expression")
 
@@ -124,7 +124,12 @@ class Col(Expr):
 
 @dataclasses.dataclass(frozen=True, eq=False, repr=True)
 class Lit(Expr):
-    """Integer or boolean literal (broadcast at evaluation)."""
+    """Integer, boolean, or string literal (broadcast at evaluation).
+    String literals only appear in eq/ne comparisons against
+    dictionary-encoded (DICT32) columns and MUST be rewritten to their
+    int32 dictionary code before evaluation — execute_plan runs
+    ``resolve_dict_literals`` over the plan so both the fused and eager
+    paths see the already-resolved integer form."""
 
     value: int
 
@@ -187,10 +192,18 @@ def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
         if c.dtype.is_nested or c.dtype.id is dt.TypeId.STRING:
             raise TypeError(f"plan expressions cannot reference "
                             f"{c.dtype.id.value} column {e.index}")
+        # DICT32 flows through as its int32 code array: equality against a
+        # resolved literal code IS string equality (entries unique), and
+        # the string bytes never enter the program
         return _Val(c.data, c.validity, c.dtype)
     if isinstance(e, Lit):
         if isinstance(e.value, bool):
             return _Val(jnp.asarray(e.value, dtype=bool), None, dt.BOOL8)
+        if isinstance(e.value, str):
+            raise TypeError(
+                "unresolved string literal in a plan expression — string "
+                "literals must be rewritten to dictionary codes "
+                "(plan/executor.resolve_dict_literals) before evaluation")
         return _Val(jnp.asarray(e.value, dtype=jnp.int64), None, dt.INT64)
     if isinstance(e, Cast64):
         v = eval_expr(e.operand, cols)
@@ -208,6 +221,9 @@ def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
             data = _ARITH[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
             return _Val(data, validity, dt.INT64)
         if e.op in _CMP:
+            if (lv.dtype.id is dt.TypeId.DICT32
+                    or rv.dtype.id is dt.TypeId.DICT32):
+                return _Val(_dict_compare(e.op, lv, rv), validity, dt.BOOL8)
             data = _CMP[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
             return _Val(data, validity, dt.BOOL8)
         if e.op in _BOOL:
@@ -219,6 +235,41 @@ def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
                         validity, dt.BOOL8)
         raise TypeError(f"unknown expression op {e.op!r}")
     raise TypeError(f"not a plan expression: {e!r}")
+
+
+def _dict_compare(op: str, lv: _Val, rv: _Val) -> jnp.ndarray:
+    """eq/ne between a DICT32 code array and a resolved literal code.
+    Codes carry NO order (ranks do), so lt/le/gt/ge raise; comparing two
+    dictionary columns raises too — their codes index different
+    dictionaries (join on the keys instead)."""
+    if op not in ("eq", "ne"):
+        raise TypeError(
+            f"plan expression {op} is unsupported on dictionary-encoded "
+            f"columns — codes carry equality only; sort via a Sort node "
+            f"(rank lanes), or materialize first")
+    if (lv.dtype.id is dt.TypeId.DICT32
+            and rv.dtype.id is dt.TypeId.DICT32):
+        raise TypeError(
+            "comparing two dictionary-encoded columns is unsupported in "
+            "plan expressions (their codes index different dictionaries); "
+            "use a join on the key columns")
+    dv, ov = (lv, rv) if lv.dtype.id is dt.TypeId.DICT32 else (rv, lv)
+    if ov.dtype.id not in _INTLIKE:
+        raise TypeError(
+            f"dictionary-encoded comparison needs a resolved integer code "
+            f"operand, got {ov.dtype.id.value}")
+    return _CMP[op](dv.data.astype(jnp.int64), ov.data.astype(jnp.int64))
+
+
+def project_column(e: Expr, cols: Sequence[Column], size: int) -> Column:
+    """Project one expression to an output Column. Bare ``col(i)`` refs to
+    DICT32 columns pass the encoded column through BY REFERENCE (codes +
+    shared dictionary children intact) — eval_expr's _Val carries only the
+    code array, so rebuilding from it would drop the dictionary. Shared by
+    the fused compiler and the eager interpreter."""
+    if isinstance(e, Col) and cols[e.index].dtype.id is dt.TypeId.DICT32:
+        return cols[e.index]
+    return materialize(eval_expr(e, cols), size)
 
 
 def materialize(v: _Val, size: int) -> Column:

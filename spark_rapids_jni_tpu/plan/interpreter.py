@@ -40,7 +40,7 @@ def run_eager(plan: PlanNode, table: Table) -> Table:
         elif isinstance(node, Project):
             n = table.num_rows
             table = Table(tuple(
-                ex.materialize(ex.eval_expr(e, table.columns), n)
+                ex.project_column(e, table.columns, n)
                 for e in node.exprs))
         elif isinstance(node, GroupBy):
             table = groupby_aggregate(table, list(node.keys),

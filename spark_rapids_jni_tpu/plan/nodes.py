@@ -170,9 +170,12 @@ def _expr_repr(e: ex.Expr) -> str:
     if isinstance(e, ex.Col):
         return f"c{e.index}"
     if isinstance(e, ex.Lit):
-        # bool is an int subclass; keep the two distinct in the canon
-        return f"lb{int(e.value)}" if isinstance(e.value, bool) \
-            else f"l{e.value}"
+        # bool is an int subclass; keep the three kinds distinct in the canon
+        if isinstance(e.value, bool):
+            return f"lb{int(e.value)}"
+        if isinstance(e.value, str):
+            return f"ls{e.value!r}"
+        return f"l{e.value}"
     if isinstance(e, ex.Cast64):
         return f"i64({_expr_repr(e.operand)})"
     if isinstance(e, ex.Not):

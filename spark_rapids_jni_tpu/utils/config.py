@@ -172,6 +172,18 @@ _register("parquet.device_decode", "SRJT_PARQUET_DEVICE_DECODE", "auto",
           str, "Parquet decode stage 1 on-device (RLE/dict/PLAIN as XLA; "
           "only encoded page bytes cross the link): auto (accelerators) "
           "| on | off")
+_register("parquet.encoded_strings", "SRJT_PARQUET_ENCODED_STRINGS", False,
+          _parse_bool,
+          "surface dictionary-encoded BYTE_ARRAY columns from the device "
+          "decode tier as DICT32 (int32 codes + shared dictionary) instead "
+          "of gather-materializing STRING; downstream filter/groupby/join/"
+          "sort run on codes and materialize() only at output boundaries")
+_register("parquet.predicate_pushdown", "SRJT_PARQUET_PUSHDOWN", True,
+          _parse_bool,
+          "evaluate reader-level equality predicates against row-group "
+          "dictionary pages before decode and skip row groups that cannot "
+          "contain a match (pages_skipped/bytes_skipped reader metrics); "
+          "off = decode everything and filter downstream")
 _register("get_json.tier", "SRJT_GET_JSON_TIER", "auto", str,
           "get_json_object execution: auto (device scan+navigate on "
           "accelerators for KEY/INDEX paths, host PDA normalizes the "

@@ -681,6 +681,53 @@ def test_srjt011_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT012 — dictionary materialize() inside a plan core or an ops/ module
+# ---------------------------------------------------------------------------
+
+SRC_012_CORE = """
+    from spark_rapids_jni_tpu.columnar.dictionary import materialize
+    from spark_rapids_jni_tpu.plan.registry import plan_core
+
+    @plan_core("bad_op")
+    def bad_core(col):
+        return materialize(col)
+"""
+
+SRC_012_OPS = """
+    from ..columnar import dictionary as dc
+
+    def compare_keys(col):
+        return dc.materialize(col).data
+"""
+
+
+def test_srjt012_plan_core_triggers():
+    fs = run(SRC_012_CORE)
+    assert rules_of(fs) == {"SRJT012"}
+    assert "output-boundary" in fs[0].message
+
+
+def test_srjt012_ops_module_triggers():
+    fs = run(SRC_012_OPS, path="pkg/ops/join.py")
+    assert rules_of(fs) == {"SRJT012"}
+    assert "DICT32 codes" in fs[0].message
+
+
+def test_srjt012_boundaries_are_clean():
+    # same call outside ops/ and outside a plan core: an output boundary
+    assert run(SRC_012_OPS, path="pkg/memory/transport.py") == []
+    # the defining module and plan/expr.py's unrelated materialize helper
+    assert run(SRC_012_OPS, path="pkg/columnar/dictionary.py") == []
+    assert run(SRC_012_OPS, path="pkg/plan/expr.py") == []
+
+
+def test_srjt012_noqa():
+    assert run(SRC_012_CORE.replace(
+        "return materialize(col)",
+        "return materialize(col)  # srjt: noqa[SRJT012]")) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -700,7 +747,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 11
+    assert len(FILE_RULES) == 12
 
 
 def test_syntax_error_is_reported_not_raised():

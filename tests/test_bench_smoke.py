@@ -108,6 +108,8 @@ def test_every_sweep_axis_function_runs_small():
         (lambda: B.bench_tpch_q3(2048), "q3"),
         (lambda: B.bench_tpch_q5(2048), "q5"),
         (lambda: B.bench_tpch_q6(2048), "q6"),
+        (lambda: B.bench_dict_filter_strings(2048), "dict_filter"),
+        (lambda: B.bench_dict_groupby_strings(2048), "dict_groupby"),
     ]
     for fn, name in small:
         sec, nbytes = fn()
