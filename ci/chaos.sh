@@ -48,7 +48,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_crash.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 4 — exception-fault storms over the whole chaos-marked suite
+# stage 4 — lock-witness mode (srjt-race): re-run a concurrent storm with
+# every package lock wrapped in the order-recording proxy
+# (analysis/witness.py), then cross-check the real acquisition orders
+# against the static lock graph. Pass criteria baked into the test: zero
+# dynamic lock-order inversions in the shipped runtime, and zero dynamic
+# inversions the static SRJTR01 pass did not predict (static/dynamic
+# disagreement fails the lane).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_race.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 5 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -10,12 +10,20 @@ that enforce the invariants the docs state and the code relies on:
   * an AST pass (stdlib ``ast``, no dependencies) over the whole package
     with the SRJT00x rule catalog (docs/STATIC_ANALYSIS.md);
   * a jaxpr auditor that traces registered device ops at tiny shapes and
-    scans the emitted jaxpr for forbidden primitives (SRJTX0x).
+    scans the emitted jaxpr for forbidden primitives (SRJTX0x);
+  * srjt-race (``callgraph``/``locks``): an interprocedural lock-graph +
+    shared-state engine with rules SRJTR01–03 (lock-order inversion,
+    lock held across a blocking operation, unguarded multi-thread
+    writes), plus the debug-only runtime lock-witness mode
+    (``witness``) that labels static inversions WITNESSED/PLAUSIBLE
+    from real chaos-storm acquisition orders.
 
 Entry points::
 
     python -m spark_rapids_jni_tpu.analysis --format json
+    python -m spark_rapids_jni_tpu.analysis --race   # SRJTR01-03 only
     make lint            # block-on-new-findings mode (ci/lint.sh)
+    make race            # race tests + focused race pass
 
 Findings already recorded in ``ci/lint_baseline.json`` warn; anything new
 fails. Per-line suppression: ``# srjt: noqa[SRJT001]`` (or bare
@@ -32,3 +40,10 @@ from .core import (  # noqa: F401
     write_baseline,
 )
 from .rules import ALL_RULES, FILE_RULES, PROJECT_RULES  # noqa: F401
+from .callgraph import CallGraph, build_graph, get_graph  # noqa: F401
+from .locks import (  # noqa: F401
+    RACE_RULES,
+    inversions,
+    lock_order_edges,
+    project_rule_races,
+)

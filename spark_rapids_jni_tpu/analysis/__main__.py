@@ -25,7 +25,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_jni_tpu.analysis",
         description="srjt-lint: TPU-invariant static analysis "
-                    "(AST rules SRJT001-008 + jaxpr audit SRJTX01-05)")
+                    "(AST rules SRJT001-012, race rules SRJTR01-03, "
+                    "jaxpr audit SRJTX01-05)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the package)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -40,17 +41,26 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default="",
                     help="comma-separated rule IDs to keep (e.g. "
                          "SRJT004,SRJTX01); default all")
+    ap.add_argument("--race", action="store_true",
+                    help="focused race pass: keep only the SRJTR01-03 "
+                         "lock/shared-state findings (implies --no-jaxpr)")
     try:
         args = ap.parse_args(argv)
         paths = args.paths or [os.path.join(_REPO_ROOT,
                                             "spark_rapids_jni_tpu")]
         ctx = ProjectContext.from_package()
         findings = analyze_paths(paths, ctx)
-        if not args.no_jaxpr:
+        if not (args.no_jaxpr or args.race):
             from .jaxpr_audit import run_jaxpr_audit
             findings = findings + run_jaxpr_audit()
+        keep = None
+        if args.race:
+            from .locks import RACE_RULES
+            keep = set(RACE_RULES)
         if args.rules:
-            keep = {r.strip().upper() for r in args.rules.split(",")}
+            named = {r.strip().upper() for r in args.rules.split(",")}
+            keep = named if keep is None else (keep & named)
+        if keep is not None:
             findings = [f for f in findings if f.rule in keep]
 
         if args.write_baseline:
@@ -60,6 +70,11 @@ def main(argv=None) -> int:
             return 0
 
         baseline = {} if args.no_baseline else load_baseline(args.baseline)
+        if keep is not None:
+            # a filtered run must also filter the baseline, or every entry
+            # for an excluded rule would print as a bogus "stale" note
+            baseline = {fp: e for fp, e in baseline.items()
+                        if e.get("rule") in keep}
         new, old, stale = match_baseline(findings, baseline)
 
         if args.format == "json":

@@ -1,0 +1,962 @@
+"""srjt-race call graph: project-wide function summaries for race analysis.
+
+Builds, from the already-parsed module corpus that ``analyze_paths``
+hands to project rules, a call graph whose nodes carry everything the
+lock rules (``locks.py``) and the interprocedural SRJT001/SRJT007
+upgrades need:
+
+* which locks a function acquires (``with lock:`` / ``lock.acquire()``),
+* which locks are *held* at each call site / blocking site / write site,
+* which blocking operations it performs (``join``, ``deadline_sleep``,
+  ``guarded_dispatch``, pipe ``recv``, ``device_get``, unbounded waits),
+* which shared attributes / module globals it writes,
+* thread entry points (``threading.Thread(target=...)``, pool
+  ``submit(...)`` targets).
+
+Lock identity is canonical and project-wide:
+
+* ``pkg/mod.py::name`` for a module-level lock,
+* ``pkg/mod.py::Class.attr`` for ``self._lock`` / ``cls._lock`` / a
+  class-body lock attribute.
+
+The module is deliberately stdlib-only and imports nothing from the
+rest of the analysis package, so ``rules.py`` and ``locks.py`` can both
+import it without cycles.  A few tiny helpers (``_dotted``,
+``_timeout_bounded``) are mirrored from ``rules.py`` for that reason.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockDecl", "CallSite", "BlockSite", "WriteSite", "AcquireSite",
+    "FuncInfo", "CallGraph", "build_graph", "get_graph",
+]
+
+# ---------------------------------------------------------------------------
+# helpers (mirrored from rules.py; kept here so callgraph stays standalone)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_TLS_FACTORIES = {"local"}
+
+# Operations that block *unconditionally* — dispatch fences, sleeps,
+# device syncs, pipe reads.  Keyed by dotted-call name or method name.
+_ALWAYS_BLOCKING_CALLS = {
+    "guarded_dispatch", "deadline_sleep", "watchdog.deadline_sleep",
+    "time.sleep", "jax.device_get", "device_get", "jax.block_until_ready",
+}
+_ALWAYS_BLOCKING_METHODS = {"recv", "_guarded", "guarded_dispatch",
+                            "deadline_sleep", "block_until_ready"}
+# Operations that block only when they carry no timeout bound.  ``poll``
+# is deliberately absent: Popen.poll() and Connection.poll() both return
+# immediately when called without a timeout.
+_MAYBE_BLOCKING_METHODS = {"join", "wait", "result", "get", "acquire"}
+_QUEUEISH_RECEIVERS = ("q", "_q", "queue", "_queue", "work_queue", "inbox")
+
+# Guard invokers whose function-valued argument runs synchronously at the
+# call site (so a lambda body executes under whatever locks are held).
+_THUNK_INVOKERS = {"_guarded", "guarded_dispatch"}
+
+# Method names too generic to resolve by uniqueness alone.
+_HEURISTIC_STOPLIST = {
+    "get", "close", "join", "wait", "put", "run", "submit", "result",
+    "state", "reset", "check", "call", "start", "stop", "poll", "send",
+    "recv", "acquire", "release", "clear", "update", "items", "keys",
+    "values", "append", "pop", "add", "read", "write", "copy", "name",
+}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _dotted(node) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _timeout_bounded(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return bool(call.args)
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        d = _dotted(dec)
+        if d in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            f = _dotted(dec.func)
+            if f in _JIT_NAMES:
+                return True
+            if f in _PARTIAL_NAMES and dec.args \
+                    and _dotted(dec.args[0]) in _JIT_NAMES:
+                return True
+    return False
+
+
+def _ann_class_name(ann) -> Optional[str]:
+    """Extract a class name from an annotation: ``Foo``, ``Optional[Foo]``,
+    ``"Foo"`` (string annotation) — best effort, last dotted component."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip()
+        return name.split("[")[-1].rstrip("]").split(".")[-1] or None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base and base.split(".")[-1] in ("Optional", "ClassVar"):
+            return _ann_class_name(ann.slice)
+        return None
+    d = _dotted(ann)
+    if d:
+        return d.split(".")[-1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# data model
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    lock_id: str        # canonical id: "rel::name" or "rel::Class.attr"
+    path: str           # rel path of the declaring module
+    line: int           # line of the creating assignment
+    kind: str           # "Lock" | "RLock" | "Condition"
+
+
+@dataclass(frozen=True)
+class AcquireSite:
+    lock: str                   # canonical lock id
+    line: int
+    held: Tuple[str, ...]       # locks already held at this acquisition
+    via_with: bool              # with-statement (scoped) vs bare .acquire()
+
+
+@dataclass(frozen=True)
+class CallSite:
+    callee: Optional[str]       # resolved function key, or None
+    raw: str                    # dotted source text of the call target
+    line: int
+    held: Tuple[str, ...]
+    heuristic: bool             # resolved only by unique-method-name match
+    arg_names: Tuple[Tuple[int, str], ...] = ()  # (position, Name-arg) pairs
+
+
+@dataclass(frozen=True)
+class BlockSite:
+    what: str                   # e.g. "q.get", "deadline_sleep", "recv"
+    line: int
+    held: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    target: str                 # "rel::Class.attr" or "rel::global_name"
+    line: int
+    held: Tuple[str, ...]
+
+
+@dataclass
+class FuncInfo:
+    key: str                    # "rel::qualname"
+    rel: str
+    name: str                   # bare function name
+    qualname: str
+    class_name: Optional[str]
+    line: int
+    node: object                # the ast.FunctionDef / AsyncFunctionDef
+    is_jit: bool = False
+    params: Tuple[str, ...] = ()
+    acquires: List[AcquireSite] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    blocks: List[BlockSite] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    host_syncs: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class CallGraph:
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    lock_decls: Dict[str, LockDecl] = field(default_factory=dict)
+    decl_at: Dict[Tuple[str, int], str] = field(default_factory=dict)
+    thread_roots: List[Tuple[str, str, int]] = field(default_factory=list)
+    # thread_roots: (func_key, kind "thread"|"submit", line)
+
+    def callees(self, key: str) -> List[str]:
+        f = self.funcs.get(key)
+        if f is None:
+            return []
+        return sorted({c.callee for c in f.calls if c.callee})
+
+
+# ---------------------------------------------------------------------------
+# pass 1: per-module indexing (imports, classes, locks, functions)
+
+
+class _ClassInfo:
+    def __init__(self, name: str, rel: str):
+        self.name = name
+        self.rel = rel
+        self.methods: Dict[str, ast.AST] = {}
+        self.attr_types: Dict[str, str] = {}    # attr -> class name
+        self.attr_locks: Dict[str, str] = {}    # attr -> lock id
+        self.attr_tls: Set[str] = set()         # attrs that are threading.local
+
+
+class _ModuleIndex:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        self.mod_name = rel[:-3].replace("/", ".") if rel.endswith(".py") \
+            else rel.replace("/", ".")
+        self.import_mods: Dict[str, str] = {}       # alias -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, sym)
+        self.functions: Dict[str, ast.AST] = {}     # module-level defs
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.module_locks: Dict[str, str] = {}      # name -> lock id
+        self.module_tls: Set[str] = set()           # threading.local globals
+        self.module_globals: Set[str] = set()       # names assigned at top level
+        self.var_types: Dict[str, str] = {}         # module-level var -> class
+
+
+def _lock_factory_kind(call: ast.Call, idx: _ModuleIndex) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' if ``call`` creates a lock, else None."""
+    d = _dotted(call.func)
+    if not d:
+        return None
+    last = d.split(".")[-1]
+    if last not in _LOCK_FACTORIES:
+        return None
+    if "." in d:
+        head = d.split(".")[0]
+        if head in ("threading", "multiprocessing") \
+                or idx.import_mods.get(head, "").startswith(("threading",
+                                                             "multiprocessing")):
+            return last
+        return None
+    # bare Lock()/RLock()/Condition(): accept when imported from threading
+    src = idx.from_imports.get(last)
+    if src and src[0].split(".")[-1] in ("threading", "multiprocessing"):
+        return last
+    return None
+
+
+def _is_tls_factory(call: ast.Call, idx: _ModuleIndex) -> bool:
+    d = _dotted(call.func)
+    if not d:
+        return False
+    last = d.split(".")[-1]
+    if last not in _TLS_FACTORIES:
+        return False
+    head = d.split(".")[0]
+    return "." not in d or head == "threading" \
+        or idx.import_mods.get(head, "") == "threading"
+
+
+def _index_module(rel: str, tree: ast.Module) -> _ModuleIndex:
+    idx = _ModuleIndex(rel, tree)
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                idx.import_mods[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for alias in node.names:
+                idx.from_imports[alias.asname or alias.name] = (mod, alias.name)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            ci = _ClassInfo(node.name, rel)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[item.name] = item
+                elif isinstance(item, ast.AnnAssign) \
+                        and isinstance(item.target, ast.Name):
+                    cname = _ann_class_name(item.annotation)
+                    if cname:
+                        ci.attr_types[item.target.id] = cname
+                    if item.value is not None and isinstance(item.value,
+                                                             ast.Call):
+                        kind = _lock_factory_kind(item.value, idx)
+                        if kind:
+                            ci.attr_locks[item.target.id] = \
+                                f"{rel}::{node.name}.{item.target.id}"
+                elif isinstance(item, ast.Assign):
+                    for tgt in item.targets:
+                        if not isinstance(tgt, ast.Name):
+                            continue
+                        if isinstance(item.value, ast.Call):
+                            kind = _lock_factory_kind(item.value, idx)
+                            if kind:
+                                ci.attr_locks[tgt.id] = \
+                                    f"{rel}::{node.name}.{tgt.id}"
+                            elif _is_tls_factory(item.value, idx):
+                                ci.attr_tls.add(tgt.id)
+            idx.classes[node.name] = ci
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                idx.module_globals.add(tgt.id)
+                val = node.value
+                if isinstance(val, ast.Call):
+                    kind = _lock_factory_kind(val, idx)
+                    if kind:
+                        idx.module_locks[tgt.id] = f"{rel}::{tgt.id}"
+                    elif _is_tls_factory(val, idx):
+                        idx.module_tls.add(tgt.id)
+                    else:
+                        d = _dotted(val.func)
+                        if d:
+                            idx.var_types[tgt.id] = d.split(".")[-1]
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function summary extraction
+
+
+class _Resolver:
+    """Cross-module name resolution over the indexed corpus."""
+
+    def __init__(self, indexes: Dict[str, _ModuleIndex]):
+        self.indexes = indexes
+        # class name -> list of (rel, _ClassInfo); usually unique
+        self.class_index: Dict[str, List[_ClassInfo]] = {}
+        # method name -> list of (rel, class, method node)
+        self.method_index: Dict[str, List[Tuple[str, str]]] = {}
+        for rel in sorted(indexes):
+            idx = indexes[rel]
+            for cname in sorted(idx.classes):
+                ci = idx.classes[cname]
+                self.class_index.setdefault(cname, []).append(ci)
+                for m in sorted(ci.methods):
+                    self.method_index.setdefault(m, []).append((rel, cname))
+
+    def module_by_dotted(self, dotted: str) -> Optional[_ModuleIndex]:
+        """Match an imported module path to a corpus module by path suffix,
+        so tmp-dir fixture trees resolve the same way the package does."""
+        tail = dotted.replace(".", "/")
+        candidates = [i for r, i in sorted(self.indexes.items())
+                      if r[:-3].endswith(tail)]
+        return candidates[0] if len(candidates) == 1 else None
+
+    def resolve_symbol(self, idx: _ModuleIndex, name: str):
+        """Resolve a bare name in module scope to ('func', key) /
+        ('class', _ClassInfo) / ('mod', _ModuleIndex) / None."""
+        if name in idx.functions:
+            return ("func", f"{idx.rel}::{name}")
+        if name in idx.classes:
+            return ("class", idx.classes[name])
+        if name in idx.from_imports:
+            mod_dotted, sym = idx.from_imports[name]
+            target = self.module_by_dotted(mod_dotted)
+            if target is not None:
+                if sym in target.functions:
+                    return ("func", f"{target.rel}::{sym}")
+                if sym in target.classes:
+                    return ("class", target.classes[sym])
+        if name in idx.import_mods:
+            target = self.module_by_dotted(idx.import_mods[name])
+            if target is not None:
+                return ("mod", target)
+        return None
+
+    def unique_method(self, name: str) -> Optional[Tuple[str, str]]:
+        """(rel, class) when ``name`` is a plausibly-unique project method."""
+        if len(name) <= 3 or name in _HEURISTIC_STOPLIST:
+            return None
+        owners = self.method_index.get(name, [])
+        return owners[0] if len(owners) == 1 else None
+
+
+class _FuncVisitor:
+    """Walks one function body, tracking the held-lock stack."""
+
+    def __init__(self, resolver: _Resolver, idx: _ModuleIndex,
+                 info: FuncInfo, class_info: Optional[_ClassInfo],
+                 graph: CallGraph):
+        self.r = resolver
+        self.idx = idx
+        self.info = info
+        self.ci = class_info
+        self.graph = graph
+        self.held: List[str] = []
+        # local var -> class name (from annotations / constructor calls)
+        self.local_types: Dict[str, str] = {}
+        self.fresh_locals: Set[str] = set()   # constructed in this function
+        self.global_decls: Set[str] = set()
+        fn = info.node
+        a = fn.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            if p.annotation is not None:
+                cname = _ann_class_name(p.annotation)
+                if cname and cname in self.r.class_index:
+                    self.local_types[p.arg] = cname
+
+    # -- lock / receiver resolution -------------------------------------
+
+    def _lock_of(self, node) -> Optional[str]:
+        """Canonical lock id for an expression, or None."""
+        if isinstance(node, ast.Name):
+            return self.idx.module_locks.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                if self.ci is not None:
+                    return self.ci.attr_locks.get(node.attr)
+                return None
+            if isinstance(base, ast.Name):
+                # module-alias lock: watchdog._lock
+                sym = self.r.resolve_symbol(self.idx, base.id)
+                if sym and sym[0] == "mod":
+                    return sym[1].module_locks.get(node.attr)
+                # typed receiver: obj._lock where obj: SomeClass
+                cname = self.local_types.get(base.id)
+                if cname:
+                    for ci in self.r.class_index.get(cname, []):
+                        if node.attr in ci.attr_locks:
+                            return ci.attr_locks[node.attr]
+        return None
+
+    def _receiver_class(self, node) -> Optional[_ClassInfo]:
+        """Class of a method-call receiver expression, or None."""
+        if isinstance(node, ast.Name):
+            if node.id in ("self", "cls"):
+                return self.ci
+            cname = self.local_types.get(node.id) \
+                or self.idx.var_types.get(node.id)
+            if cname:
+                owners = self.r.class_index.get(cname, [])
+                if len(owners) == 1:
+                    return owners[0]
+            sym = self.r.resolve_symbol(self.idx, node.id)
+            if sym and sym[0] == "class":
+                return sym[1]
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and self.ci is not None:
+                cname = self.ci.attr_types.get(node.attr)
+                if cname:
+                    owners = self.r.class_index.get(cname, [])
+                    if len(owners) == 1:
+                        return owners[0]
+        return None
+
+    def _is_tls_base(self, node) -> bool:
+        """True when ``node`` is a threading.local object (writes through it
+        are thread-confined by construction)."""
+        if isinstance(node, ast.Name):
+            return node.id in self.idx.module_tls
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls") and self.ci is not None:
+            return node.attr in self.ci.attr_tls
+        return False
+
+    # -- call resolution --------------------------------------------------
+
+    def _resolve_call(self, call: ast.Call) -> Tuple[Optional[str], str, bool]:
+        """(resolved function key | None, raw dotted text, heuristic?)."""
+        raw = _dotted(call.func) or "<expr>"
+        f = call.func
+        if isinstance(f, ast.Name):
+            sym = self.r.resolve_symbol(self.idx, f.id)
+            if sym and sym[0] == "func":
+                return sym[1], raw, False
+            if sym and sym[0] == "class":
+                ci = sym[1]
+                if "__init__" in ci.methods:
+                    return f"{ci.rel}::{ci.name}.__init__", raw, False
+            return None, raw, False
+        if isinstance(f, ast.Attribute):
+            meth = f.attr
+            base = f.value
+            # self.m() / cls.m()
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and self.ci is not None and meth in self.ci.methods:
+                return f"{self.ci.rel}::{self.ci.name}.{meth}", raw, False
+            # Module.m() / ClassName.m() / typed-receiver.m()
+            recv = self._receiver_class(base)
+            if recv is not None and meth in recv.methods:
+                return f"{recv.rel}::{recv.name}.{meth}", raw, False
+            if isinstance(base, ast.Name):
+                sym = self.r.resolve_symbol(self.idx, base.id)
+                if sym and sym[0] == "mod" and meth in sym[1].functions:
+                    return f"{sym[1].rel}::{meth}", raw, False
+            # uniqueness heuristic: method defined in exactly one class
+            owner = self.r.unique_method(meth)
+            if owner is not None:
+                return f"{owner[0]}::{owner[1]}.{meth}", raw, True
+        return None, raw, False
+
+    def _resolve_target_name(self, node) -> Optional[str]:
+        """Resolve a function-valued argument (thread target / thunk)."""
+        if isinstance(node, ast.Name):
+            sym = self.r.resolve_symbol(self.idx, node.id)
+            if sym and sym[0] == "func":
+                return sym[1]
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and self.ci is not None and node.attr in self.ci.methods:
+                return f"{self.ci.rel}::{self.ci.name}.{node.attr}"
+            recv = self._receiver_class(base)
+            if recv is not None and node.attr in recv.methods:
+                return f"{recv.rel}::{recv.name}.{node.attr}"
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in _PARTIAL_NAMES and node.args:
+                return self._resolve_target_name(node.args[0])
+        return None
+
+    # -- blocking classification ------------------------------------------
+
+    def _blocking_kind(self, call: ast.Call) -> Optional[str]:
+        d = _dotted(call.func)
+        if d:
+            last = d.split(".")[-1]
+            if d in _ALWAYS_BLOCKING_CALLS or last in ("deadline_sleep",
+                                                       "guarded_dispatch"):
+                return last
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            recv = call.func.value
+            if meth in _ALWAYS_BLOCKING_METHODS:
+                return meth
+            if meth in _MAYBE_BLOCKING_METHODS:
+                # Condition.wait / lock.acquire on a lock we *hold* releases
+                # and re-takes it — the sanctioned pattern, not a hazard.
+                lock = self._lock_of(recv)
+                if lock is not None and lock in self.held:
+                    return None
+                if meth == "acquire":
+                    return None  # acquisition order handled separately
+                if meth == "get":
+                    rd = _dotted(recv) or ""
+                    tail = rd.split(".")[-1]
+                    if not any(q in tail for q in _QUEUEISH_RECEIVERS):
+                        return None
+                if not _timeout_bounded(call):
+                    return f"{_dotted(recv) or '?'}.{meth}"
+        elif isinstance(call.func, ast.Name) and call.func.id == "wait" \
+                and not _timeout_bounded(call):
+            return "wait"
+        return None
+
+    # -- write extraction --------------------------------------------------
+
+    def _record_write(self, target, line: int):
+        held = tuple(self.held)
+        if isinstance(target, ast.Attribute):
+            base = target.value
+            if self._is_tls_base(base) or self._is_tls_base(target):
+                return
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                if self.ci is not None and self.info.name != "__init__":
+                    if target.attr in self.ci.attr_locks \
+                            or target.attr in self.ci.attr_tls:
+                        return
+                    self.info.writes.append(WriteSite(
+                        f"{self.ci.rel}::{self.ci.name}.{target.attr}",
+                        line, held))
+                return
+            if isinstance(base, ast.Name):
+                if base.id in self.fresh_locals:
+                    return  # freshly constructed here: not yet shared
+                cname = self.local_types.get(base.id)
+                if cname:
+                    owners = self.r.class_index.get(cname, [])
+                    if len(owners) == 1:
+                        self.info.writes.append(WriteSite(
+                            f"{owners[0].rel}::{cname}.{target.attr}",
+                            line, held))
+            return
+        if isinstance(target, ast.Name):
+            if target.id in self.global_decls \
+                    and target.id in self.idx.module_globals \
+                    and target.id not in self.idx.module_locks \
+                    and target.id not in self.idx.module_tls:
+                self.info.writes.append(WriteSite(
+                    f"{self.idx.rel}::{target.id}", line, held))
+            return
+        if isinstance(target, ast.Subscript):
+            inner = target.value
+            if self._is_tls_base(inner):
+                return
+            if isinstance(inner, ast.Name) \
+                    and inner.id in self.idx.module_globals \
+                    and inner.id not in self.fresh_locals \
+                    and inner.id not in self.local_types \
+                    and inner.id not in self.idx.module_tls:
+                self.info.writes.append(WriteSite(
+                    f"{self.idx.rel}::{inner.id}", line, held))
+            elif isinstance(inner, ast.Attribute):
+                self._record_write(inner, line)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_write(el, line)
+
+    # -- the walk ----------------------------------------------------------
+
+    def visit_body(self, body: List[ast.stmt]):
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt):
+        if isinstance(stmt, ast.Global):
+            self.global_decls.update(stmt.names)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own FuncInfo (collector pass)
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            pushed = []
+            for item in stmt.items:
+                ctx_expr = item.context_expr
+                self.visit_expr(ctx_expr)
+                lock = self._lock_of(ctx_expr)
+                if lock is None and isinstance(ctx_expr, ast.Call):
+                    lock = self._lock_of(ctx_expr.func)  # rare: lock() call
+                if lock is not None:
+                    self.info.acquires.append(AcquireSite(
+                        lock, stmt.lineno, tuple(self.held), True))
+                    self.held.append(lock)
+                    pushed.append(lock)
+            self.visit_body(stmt.body)
+            for _ in pushed:
+                self.held.pop()
+            return
+        if isinstance(stmt, ast.Assign):
+            self.visit_expr(stmt.value)
+            # track local construction / typing before recording writes
+            if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if isinstance(stmt.value, ast.Call):
+                    d = _dotted(stmt.value.func)
+                    if d:
+                        last = d.split(".")[-1]
+                        if last in self.r.class_index:
+                            self.local_types[name] = last
+                            self.fresh_locals.add(name)
+                        else:
+                            sym = self.r.resolve_symbol(self.idx,
+                                                        d.split(".")[0])
+                            if sym and sym[0] == "func":
+                                fn_node = None
+                                key = sym[1]
+                                # return-annotation typing: x = f() -> Cls
+                                rel, qn = key.split("::", 1)
+                                tgt_idx = self.r.indexes.get(rel)
+                                if tgt_idx is not None:
+                                    fn_node = tgt_idx.functions.get(qn)
+                                if fn_node is not None \
+                                        and fn_node.returns is not None:
+                                    cname = _ann_class_name(fn_node.returns)
+                                    if cname and cname in self.r.class_index:
+                                        self.local_types[name] = cname
+                elif isinstance(stmt.value, ast.Name):
+                    if stmt.value.id in self.local_types:
+                        self.local_types[name] = self.local_types[stmt.value.id]
+                if name in self.local_types and name not in self.fresh_locals \
+                        and isinstance(stmt.value, ast.Call) \
+                        and self.local_types[name] == \
+                        (_dotted(stmt.value.func) or "").split(".")[-1]:
+                    self.fresh_locals.add(name)
+            for tgt in stmt.targets:
+                self._record_write(tgt, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self.visit_expr(stmt.value)
+            self._record_write(stmt.target, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                cname = _ann_class_name(stmt.annotation)
+                if cname and cname in self.r.class_index:
+                    self.local_types[stmt.target.id] = cname
+            if stmt.value is not None:
+                self._record_write(stmt.target, stmt.lineno)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.visit_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.visit_expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.visit_expr(stmt.test)
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter)
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.visit_body(stmt.body)
+            for h in stmt.handlers:
+                self.visit_body(h.body)
+            self.visit_body(stmt.orelse)
+            self.visit_body(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.visit_expr(child)
+            return
+        if isinstance(stmt, ast.Delete):
+            return
+        # fallback: visit any expression children
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child)
+            elif isinstance(child, ast.stmt):
+                self.visit_stmt(child)
+
+    def visit_expr(self, expr):
+        if expr is None:
+            return
+        for node in self._walk_expr(expr):
+            if isinstance(node, ast.Call):
+                self._handle_call(node)
+
+    def _walk_expr(self, expr):
+        """Depth-first over an expression, skipping lambda bodies (those are
+        deferred; thunk invokers inline them explicitly)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Lambda):
+                    continue
+                stack.append(child)
+
+    def _handle_call(self, call: ast.Call):
+        d = _dotted(call.func)
+        held = tuple(self.held)
+        line = call.lineno
+
+        # lock.acquire(): an ordering event; blocking=False is a try-lock.
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire":
+            lock = self._lock_of(call.func.value)
+            if lock is not None:
+                nonblocking = any(
+                    kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False for kw in call.keywords)
+                if not nonblocking and call.args:
+                    a0 = call.args[0]
+                    nonblocking = isinstance(a0, ast.Constant) \
+                        and a0.value is False
+                if not nonblocking:
+                    self.info.acquires.append(
+                        AcquireSite(lock, line, held, False))
+                return
+
+        # host syncs (for the interprocedural SRJT001 upgrade); literal
+        # args (trace-time lookup tables) never sync — same carve-out as
+        # the intraprocedural rule
+        if d in ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                 "jax.device_get", "device_get"):
+            if not (call.args and isinstance(call.args[0], ast.Constant)):
+                self.info.host_syncs.append((d, line))
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("tolist", "item"):
+            self.info.host_syncs.append((call.func.attr, line))
+
+        # thread roots
+        last = d.split(".")[-1] if d else ""
+        if last == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    key = self._resolve_target_name(kw.value)
+                    if key:
+                        self.graph.thread_roots.append((key, "thread", line))
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "submit" and call.args:
+            key = self._resolve_target_name(call.args[0])
+            if key:
+                self.graph.thread_roots.append((key, "submit", line))
+
+        # blocking?
+        bk = self._blocking_kind(call)
+        if bk is not None:
+            self.info.blocks.append(BlockSite(bk, line, held))
+
+        # thunk invokers run their function argument synchronously, under
+        # whatever locks are currently held
+        meth = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else (d or "")
+        if meth.split(".")[-1] in _THUNK_INVOKERS:
+            for arg in call.args:
+                if isinstance(arg, ast.Lambda):
+                    self.visit_expr(arg.body)
+                else:
+                    key = self._resolve_target_name(arg)
+                    if key:
+                        self.info.calls.append(CallSite(
+                            key, _dotted(arg) or "<thunk>", line, held, False))
+
+        # the call edge itself
+        callee, raw, heur = self._resolve_call(call)
+        arg_names = tuple(
+            (i, a.id) for i, a in enumerate(call.args)
+            if isinstance(a, ast.Name))
+        self.info.calls.append(CallSite(callee, raw, line, held, heur,
+                                        arg_names))
+
+
+# ---------------------------------------------------------------------------
+# graph construction
+
+
+def _collect_functions(rel: str, tree: ast.Module):
+    """Yield (qualname, class_name, node) for every def, including methods
+    and nested functions (keyed ``outer.<locals>.inner``)."""
+    def walk(body, prefix, class_name):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{node.name}"
+                yield qn, class_name, node
+                yield from walk(node.body, f"{qn}.<locals>.", class_name)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, f"{prefix}{node.name}."
+                                if not prefix else f"{prefix}{node.name}.",
+                                node.name)
+    yield from walk(tree.body, "", None)
+
+
+def build_graph(modules) -> CallGraph:
+    """Build the project call graph from ``[(rel, tree, lines)]``."""
+    indexes: Dict[str, _ModuleIndex] = {}
+    for rel, tree, _lines in modules:
+        indexes[rel] = _index_module(rel, tree)
+    resolver = _Resolver(indexes)
+    graph = CallGraph()
+
+    # lock declarations: module-level + class-body (from the index) ...
+    for rel in sorted(indexes):
+        idx = indexes[rel]
+        for name in sorted(idx.module_locks):
+            lock_id = idx.module_locks[name]
+            line, kind = _find_decl_site(idx.tree, name, idx)
+            graph.lock_decls[lock_id] = LockDecl(lock_id, rel, line, kind)
+            graph.decl_at[(rel, line)] = lock_id
+
+    # ... plus self._lock = threading.Lock() inside methods (usually __init__)
+    for rel in sorted(indexes):
+        idx = indexes[rel]
+        for cname in sorted(idx.classes):
+            ci = idx.classes[cname]
+            for mnode in ci.methods.values():
+                for node in ast.walk(mnode):
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Call):
+                        kind = _lock_factory_kind(node.value, idx)
+                        is_tls = _is_tls_factory(node.value, idx)
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id in ("self", "cls"):
+                                if kind:
+                                    lock_id = f"{rel}::{cname}.{tgt.attr}"
+                                    ci.attr_locks[tgt.attr] = lock_id
+                                    if lock_id not in graph.lock_decls:
+                                        graph.lock_decls[lock_id] = LockDecl(
+                                            lock_id, rel, node.lineno, kind)
+                                        graph.decl_at[(rel, node.lineno)] = \
+                                            lock_id
+                                elif is_tls:
+                                    ci.attr_tls.add(tgt.attr)
+            # class-body lock decl sites
+            for attr, lock_id in sorted(ci.attr_locks.items()):
+                if lock_id in graph.lock_decls:
+                    continue
+                for item in idx.tree.body:
+                    if isinstance(item, ast.ClassDef) and item.name == cname:
+                        for sub in item.body:
+                            tgts = []
+                            if isinstance(sub, ast.Assign):
+                                tgts = sub.targets
+                            elif isinstance(sub, ast.AnnAssign):
+                                tgts = [sub.target]
+                            for tgt in tgts:
+                                if isinstance(tgt, ast.Name) \
+                                        and tgt.id == attr:
+                                    graph.lock_decls[lock_id] = LockDecl(
+                                        lock_id, rel, sub.lineno, "Lock")
+                                    graph.decl_at[(rel, sub.lineno)] = lock_id
+
+    # function summaries
+    for rel in sorted(indexes):
+        idx = indexes[rel]
+        for qualname, class_name, node in _collect_functions(rel, idx.tree):
+            key = f"{rel}::{qualname}"
+            a = node.args
+            params = tuple(p.arg for p in (list(a.posonlyargs) + list(a.args)
+                                           + list(a.kwonlyargs)))
+            info = FuncInfo(
+                key=key, rel=rel, name=node.name, qualname=qualname,
+                class_name=class_name, line=node.lineno, node=node,
+                is_jit=_is_jit_decorated(node), params=params)
+            ci = idx.classes.get(class_name) if class_name else None
+            visitor = _FuncVisitor(resolver, idx, info, ci, graph)
+            visitor.visit_body(node.body)
+            graph.funcs[key] = info
+
+    graph.thread_roots.sort()
+    return graph
+
+
+def _find_decl_site(tree: ast.Module, name: str, idx) -> Tuple[int, str]:
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name \
+                        and isinstance(node.value, ast.Call):
+                    kind = _lock_factory_kind(node.value, idx)
+                    if kind:
+                        return node.lineno, kind
+    return 1, "Lock"
+
+
+# ---------------------------------------------------------------------------
+# memoized entry point: one graph per analyze_paths corpus
+
+_GRAPH_CACHE: List[Tuple[object, CallGraph]] = []
+_GRAPH_CACHE_MAX = 4
+
+
+def get_graph(modules) -> CallGraph:
+    """Build (or reuse) the call graph for a corpus.  ``analyze_paths``
+    passes the same ``modules`` list object to every project rule, so
+    identity of that list is a safe memo key for the life of the run."""
+    for ref, graph in _GRAPH_CACHE:
+        if ref is modules:
+            return graph
+    graph = build_graph(modules)
+    _GRAPH_CACHE.append((modules, graph))
+    del _GRAPH_CACHE[:-_GRAPH_CACHE_MAX]
+    return graph

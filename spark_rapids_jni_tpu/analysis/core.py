@@ -270,7 +270,8 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         "findings": [
             {"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
              "line": f.line, "message": f.message, "snippet": f.snippet}
-            for f in sorted(findings, key=lambda x: (x.path, x.line, x.rule))
+            for f in sorted(findings, key=lambda x: (x.path, x.line, x.rule,
+                                                     x.message))
         ],
     }
     with open(path, "w") as f:

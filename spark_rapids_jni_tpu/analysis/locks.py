@@ -1,0 +1,319 @@
+"""srjt-race lock rules: lock-order inversions, locks held across blocking
+operations, and unguarded cross-thread shared-state writes.
+
+Consumes the per-function summaries from :mod:`callgraph` and emits three
+rules through the standard project-rule interface:
+
+* **SRJTR01** — lock-order inversion: lock A is acquired while B is held
+  on one path and B while A is held on another, including paths that
+  cross function and module boundaries.  Each unordered pair is reported
+  once, anchored at the later of its two witness sites.
+* **SRJTR02** — a lock held across a blocking operation (``join``,
+  ``deadline_sleep``, ``guarded_dispatch``, pipe ``recv``, ``device_get``,
+  unbounded ``wait``/``get``/``result``) — directly or through a call
+  chain.  This is the stall class the watchdog currently only catches at
+  runtime.
+* **SRJTR03** — an instance attribute or module global written from two
+  or more thread entry points with no common lock held at every write.
+  Thread roots come from ``threading.Thread(target=...)`` and pool
+  ``submit(...)`` sites; code unreachable from any spawned thread is
+  attributed to the implicit caller (main) thread.
+
+All traversals iterate in sorted order so finding output — and therefore
+baseline fingerprints — is deterministic.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Finding
+from .callgraph import BlockSite, CallGraph, FuncInfo, get_graph
+
+__all__ = [
+    "project_rule_races", "lock_order_edges", "inversions",
+    "RACE_RULES",
+]
+
+RACE_RULES = ("SRJTR01", "SRJTR02", "SRJTR03")
+
+# A witness for a directed lock-order edge: (path, line, description).
+_Edge = Tuple[str, str]
+_Witness = Tuple[str, int, str]
+
+
+def _short(lock_id: str) -> str:
+    """Human-readable lock name: transport.py::SpillStore._lock."""
+    rel, name = lock_id.split("::", 1)
+    return f"{rel.rsplit('/', 1)[-1]}::{name}"
+
+
+# ---------------------------------------------------------------------------
+# transitive summaries
+
+
+def _acq_trans(graph: CallGraph) -> Dict[str, Dict[str, _Witness]]:
+    """For each function, the locks it (transitively) acquires, with one
+    witness site each.  Cycle-safe memoized DFS."""
+    memo: Dict[str, Dict[str, _Witness]] = {}
+    visiting: Set[str] = set()
+
+    def go(key: str) -> Dict[str, _Witness]:
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return {}
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out: Dict[str, _Witness] = {}
+        if f is not None:
+            for a in f.acquires:
+                out.setdefault(a.lock, (f.rel, a.line, f.qualname))
+            for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                if not c.callee:
+                    continue
+                for lock, (_, _, via) in sorted(go(c.callee).items()):
+                    out.setdefault(
+                        lock, (f.rel, c.line, f"{f.qualname} → {via}"))
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    for key in sorted(graph.funcs):
+        go(key)
+    return memo
+
+
+def _block_trans(graph: CallGraph) -> Dict[str, Optional[Tuple[str, str]]]:
+    """For each function, one (blocking-op, via-chain) it can reach through
+    confidently-resolved calls, or None."""
+    memo: Dict[str, Optional[Tuple[str, str]]] = {}
+    visiting: Set[str] = set()
+
+    def go(key: str) -> Optional[Tuple[str, str]]:
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return None
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out: Optional[Tuple[str, str]] = None
+        if f is not None:
+            if f.blocks:
+                b = min(f.blocks, key=lambda b: b.line)
+                out = (b.what, f.qualname)
+            else:
+                for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                    if not c.callee or c.heuristic:
+                        continue
+                    sub = go(c.callee)
+                    if sub is not None:
+                        out = (sub[0], f"{f.qualname} → {sub[1]}")
+                        break
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    for key in sorted(graph.funcs):
+        go(key)
+    return memo
+
+
+# ---------------------------------------------------------------------------
+# SRJTR01: lock-order inversions
+
+
+def lock_order_edges(graph: CallGraph) -> Dict[_Edge, _Witness]:
+    """Directed held→acquired edges with one witness site per edge."""
+    acq = _acq_trans(graph)
+    edges: Dict[_Edge, _Witness] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        for a in f.acquires:
+            for h in a.held:
+                if h != a.lock:
+                    edges.setdefault(
+                        (h, a.lock), (f.rel, a.line, f.qualname))
+        for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+            if not c.callee or not c.held:
+                continue
+            for lock, (_, _, via) in sorted(acq.get(c.callee, {}).items()):
+                for h in c.held:
+                    if h != lock:
+                        edges.setdefault(
+                            (h, lock),
+                            (f.rel, c.line, f"{f.qualname} → {via}"))
+    return edges
+
+
+def inversions(edges: Dict[_Edge, _Witness]) \
+        -> List[Tuple[str, str, _Witness, _Witness]]:
+    """Unordered lock pairs acquired in both orders: (a, b, witness-of-a→b,
+    witness-of-b→a) with a < b."""
+    out = []
+    for (a, b) in sorted(edges):
+        if a < b and (b, a) in edges:
+            out.append((a, b, edges[(a, b)], edges[(b, a)]))
+    return out
+
+
+def _srjtr01(graph: CallGraph) -> List[Finding]:
+    findings = []
+    for a, b, wab, wba in inversions(lock_order_edges(graph)):
+        # anchor at the later of the two witness sites so one noqa/baseline
+        # entry covers the pair deterministically
+        anchor = max(wab, wba, key=lambda w: (w[0], w[1]))
+        other = wba if anchor == wab else wab
+        first, second = (a, b) if anchor == wab else (b, a)
+        findings.append(Finding(
+            "SRJTR01", anchor[0], anchor[1],
+            f"lock-order inversion: {_short(second)} acquired while "
+            f"{_short(first)} is held (via {anchor[2]}), but the opposite "
+            f"order exists at {other[0]}:{other[1]} (via {other[2]}) — "
+            f"deadlock window"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJTR02: lock held across a blocking operation
+
+
+def _srjtr02(graph: CallGraph) -> List[Finding]:
+    block = _block_trans(graph)
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        flagged_lines: Set[int] = set()
+        for b in sorted(f.blocks, key=lambda b: (b.line, b.what)):
+            if not b.held or b.line in flagged_lines:
+                continue
+            flagged_lines.add(b.line)
+            findings.append(Finding(
+                "SRJTR02", f.rel, b.line,
+                f"{_short(b.held[-1])} held across blocking `{b.what}` in "
+                f"{f.qualname} — stall here wedges every waiter on that "
+                f"lock (watchdog can only catch it at runtime)"))
+        for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+            if not c.held or not c.callee or c.heuristic \
+                    or c.line in flagged_lines:
+                continue
+            sub = block.get(c.callee)
+            if sub is None:
+                continue
+            flagged_lines.add(c.line)
+            findings.append(Finding(
+                "SRJTR02", f.rel, c.line,
+                f"{_short(c.held[-1])} held across `{c.raw}()` which "
+                f"blocks (`{sub[0]}` via {sub[1]}) — stall here wedges "
+                f"every waiter on that lock"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJTR03: shared writes from multiple thread roots without a common lock
+
+
+_MAIN_ROOT = "<caller>"
+
+
+def _reachable_from(graph: CallGraph, roots: List[str]) -> Dict[str, Set[str]]:
+    """function key -> set of thread-root labels that can reach it."""
+    out: Dict[str, Set[str]] = {}
+    for root in roots:
+        stack, seen = [root], set()
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            out.setdefault(key, set()).add(root)
+            for callee in graph.callees(key):
+                stack.append(callee)
+    return out
+
+
+def _held_in(graph: CallGraph, root_keys: Set[str]) -> Dict[str, FrozenSet[str]]:
+    """Locks guaranteed held on *every* entry to each function (meet =
+    intersection over call sites; thread roots and uncalled functions
+    enter with nothing held)."""
+    callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        for c in f.calls:
+            if c.callee:
+                callers.setdefault(c.callee, []).append(
+                    (key, frozenset(c.held)))
+    universe = frozenset(graph.lock_decls)
+    held: Dict[str, FrozenSet[str]] = {}
+    for key in graph.funcs:
+        if key in root_keys or key not in callers:
+            held[key] = frozenset()
+        else:
+            held[key] = universe
+    changed = True
+    while changed:
+        changed = False
+        for key in sorted(graph.funcs):
+            if key in root_keys or key not in callers:
+                continue
+            acc: Optional[FrozenSet[str]] = None
+            for caller, site_held in callers[key]:
+                entry = held.get(caller, universe) | site_held
+                acc = entry if acc is None else (acc & entry)
+            acc = acc if acc is not None else frozenset()
+            if acc != held[key]:
+                held[key] = acc
+                changed = True
+    return held
+
+
+def _srjtr03(graph: CallGraph) -> List[Finding]:
+    root_keys = sorted({k for k, _, _ in graph.thread_roots})
+    reach = _reachable_from(graph, root_keys)
+    held_in = _held_in(graph, set(root_keys))
+
+    # group write sites by target
+    by_target: Dict[str, List[Tuple[str, FuncInfo, int, FrozenSet[str]]]] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        for w in f.writes:
+            eff = frozenset(w.held) | held_in.get(key, frozenset())
+            by_target.setdefault(w.target, []).append((key, f, w.line, eff))
+
+    findings = []
+    for target in sorted(by_target):
+        sites = by_target[target]
+        roots: Set[str] = set()
+        for key, _, _, _ in sites:
+            r = reach.get(key)
+            roots.update(r if r else {_MAIN_ROOT})
+        if len(roots) < 2:
+            continue
+        common = None
+        for _, _, _, eff in sites:
+            common = eff if common is None else (common & eff)
+        if common:
+            continue
+        # anchor at the first site with nothing held, else the first site
+        ordered = sorted(sites, key=lambda s: (s[1].rel, s[2]))
+        anchor = next((s for s in ordered if not s[3]), ordered[0])
+        _, f, line, _ = anchor
+        root_names = ", ".join(
+            r.split("::")[-1] if r != _MAIN_ROOT else "caller"
+            for r in sorted(roots))
+        nsites = len(sites)
+        findings.append(Finding(
+            "SRJTR03", f.rel, line,
+            f"`{_short(target)}` written from {len(roots)} thread roots "
+            f"({root_names}) across {nsites} site(s) with no common lock "
+            f"— racy read-modify-write"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project-rule entry point
+
+
+def project_rule_races(modules, ctx) -> List[Finding]:
+    """SRJTR01–03 over the already-parsed corpus (standard project rule)."""
+    graph = get_graph(modules)
+    return _srjtr01(graph) + _srjtr02(graph) + _srjtr03(graph)

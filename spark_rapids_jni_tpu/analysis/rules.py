@@ -876,9 +876,162 @@ def rule_srjt012(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# Interprocedural upgrades (srjt-race call graph): SRJT001 / SRJT007 across
+# function boundaries
+# ---------------------------------------------------------------------------
+
+# The intraprocedural SRJT001/SRJT007 rules above only see a sync or a
+# donation when it is textually inside the jitted function.  The call
+# graph (analysis/callgraph.py) lets both follow *confidently-resolved*
+# call edges — the uniqueness-heuristic edges the race rules tolerate are
+# excluded here, since a wrong edge would produce a wrong "your helper
+# syncs" claim against a specific line.
+
+
+def project_rule_srjt001_interproc(modules, ctx) -> List[Finding]:
+    """Host sync reached *through a helper* from inside a jitted function."""
+    from . import callgraph as cg
+    graph = cg.get_graph(modules)
+    memo: Dict[str, Optional[Tuple[str, str]]] = {}
+    visiting: set = set()
+
+    def reaches_sync(key: str) -> Optional[Tuple[str, str]]:
+        """(sync-op, via-chain) reachable from ``key``, or None.  Does not
+        look inside jitted callees — their syncs are flagged in their own
+        bodies by the intraprocedural rule."""
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return None
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out: Optional[Tuple[str, str]] = None
+        if f is not None and not f.is_jit:
+            if f.host_syncs:
+                what, _line = min(f.host_syncs, key=lambda s: s[1])
+                out = (what, f.qualname)
+            else:
+                for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                    if not c.callee or c.heuristic:
+                        continue
+                    sub = reaches_sync(c.callee)
+                    if sub is not None:
+                        out = (sub[0], f"{f.qualname} → {sub[1]}")
+                        break
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        if not f.is_jit:
+            continue
+        flagged: set = set()
+        for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+            if not c.callee or c.heuristic or c.line in flagged:
+                continue
+            callee = graph.funcs.get(c.callee)
+            if callee is None or callee.is_jit:
+                continue
+            sub = reaches_sync(c.callee)
+            if sub is None:
+                continue
+            flagged.add(c.line)
+            findings.append(Finding(
+                "SRJT001", f.rel, c.line,
+                f"implicit host sync `{sub[0]}` reached from jit-compiled "
+                f"`{f.name}` through `{c.raw}()` (via {sub[1]}) — device "
+                f"round-trip on every call "
+                f"(docs/TPU_PERF.md: ~16 ms d2h floor on the tunnel)"))
+    return findings
+
+
+def project_rule_srjt007_interproc(modules, ctx) -> List[Finding]:
+    """Use-after-donation where the donation happens inside a callee: a
+    helper that forwards its parameter to a ``donate_argnums`` position
+    donates its caller's buffer too."""
+    from . import callgraph as cg
+    graph = cg.get_graph(modules)
+    donated_by_rel = {rel: _donated_jits(tree) for rel, tree, _ in modules}
+
+    # seed: f donates param i when f's body passes that param at a donated
+    # position of a module-level jitted callable
+    donating: Dict[str, set] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        dm = donated_by_rel.get(f.rel, {})
+        if not dm:
+            continue
+        params = list(f.params)
+        pos_set = set()
+        for st in ast.walk(f.node):
+            if isinstance(st, ast.Call) and isinstance(st.func, ast.Name) \
+                    and st.func.id in dm:
+                for pos in dm[st.func.id]:
+                    if pos < len(st.args) and isinstance(st.args[pos],
+                                                         ast.Name) \
+                            and st.args[pos].id in params:
+                        pos_set.add(params.index(st.args[pos].id))
+        if pos_set:
+            donating[key] = pos_set
+
+    # fixpoint: forwarding a param into a donating position is donating
+    changed = True
+    while changed:
+        changed = False
+        for key in sorted(graph.funcs):
+            f = graph.funcs[key]
+            params = list(f.params)
+            for c in f.calls:
+                if c.heuristic or not c.callee or c.callee not in donating:
+                    continue
+                for pos, name in c.arg_names:
+                    if pos in donating[c.callee] and name in params:
+                        p = params.index(name)
+                        if p not in donating.get(key, set()):
+                            donating.setdefault(key, set()).add(p)
+                            changed = True
+
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        # donation events through *function* callees (direct jit-callable
+        # calls are the intraprocedural rule's territory)
+        events: List[Tuple[str, int, str]] = []
+        for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+            if c.heuristic or not c.callee or c.callee not in donating:
+                continue
+            for pos, name in c.arg_names:
+                if pos in donating[c.callee]:
+                    events.append((name, c.line, c.raw))
+        for buf, at, via in events:
+            rebound = [n.lineno for n in ast.walk(f.node)
+                       if isinstance(n, ast.Name) and n.id == buf
+                       and isinstance(n.ctx, ast.Store) and n.lineno >= at]
+            bound_at = min(rebound) if rebound else None
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.Name) and n.id == buf \
+                        and isinstance(n.ctx, ast.Load) and n.lineno > at \
+                        and (bound_at is None or n.lineno < bound_at):
+                    findings.append(Finding(
+                        "SRJT007", f.rel, n.lineno,
+                        f"`{buf}` used after `{via}()` donated it at line "
+                        f"{at} (the callee forwards it to a donate_argnums "
+                        f"position) — donated buffers are deallocated by "
+                        f"XLA; reading one returns garbage or crashes"))
+                    break
+    return findings
+
+
+from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
+# imports only core+callgraph, neither imports rules at module load)
+
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012)
-PROJECT_RULES = (project_rule_srjt008_spans,)
+PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
+                 project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -1,0 +1,281 @@
+"""Lock-witness mode: runtime recording of real lock-acquisition orders.
+
+The static race engine (``callgraph``/``locks``) over-approximates: it
+reports every lock-order inversion the code *could* execute.  Witness
+mode closes the loop from the other side — ``install()`` patches the
+``threading.Lock``/``threading.RLock`` factories so every lock the
+package (or the test suite) creates is wrapped in a recording proxy.
+Each successful acquisition appends a directed edge *held-site →
+acquired-site* to a global edge log keyed by the locks' creation sites.
+
+``crosscheck()`` then joins the two views through the static engine's
+lock-declaration map (creation ``(path, line)`` → canonical lock id):
+
+* a static SRJTR01 inversion whose both orders appear in the dynamic log
+  is **WITNESSED** — a real interleaving, fix it;
+* one with at most one order observed stays **PLAUSIBLE** — still a
+  hazard, but no storm has driven it yet;
+* a dynamic inversion with no static counterpart means the static graph
+  missed an edge (``ci/chaos.sh`` fails on this disagreement).
+
+Debug-only: the proxy adds a dict update per acquire.  Enable with the
+``witness.enabled`` config flag / ``SRJT_WITNESS=1`` (``maybe_install``)
+or call ``install()`` explicitly in a test.  Locks created outside the
+repo (stdlib internals, jax) are returned unwrapped so library behavior
+is untouched.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "install", "uninstall", "installed", "maybe_install", "reset",
+    "snapshot", "dynamic_inversions", "crosscheck",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the real factories, captured at import time (before any patching)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+# registry state; guarded by a raw (never-wrapped) lock so the witness
+# machinery itself can never deadlock or self-record
+_REG_LOCK = _REAL_LOCK()
+_EDGES: Dict[Tuple[str, str], int] = {}   # (held-site, acquired-site) -> count
+_SITES: Set[str] = set()                  # every wrapped-lock creation site
+_INSTALLED = False
+
+_tls = threading.local()                  # per-thread stack of held sites
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _creation_site() -> Optional[str]:
+    """repo-relative ``path:line`` of the frame creating the lock, or None
+    for locks born outside the repo (left unwrapped)."""
+    f = sys._getframe(2)  # caller of the patched factory
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(__file__[:__file__.rfind("/")]) \
+                and "threading" not in fn.rsplit("/", 1)[-1]:
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    fn = os.path.abspath(f.f_code.co_filename)
+    if not fn.startswith(_REPO_ROOT + os.sep):
+        return None
+    return f"{fn[len(_REPO_ROOT) + 1:].replace(os.sep, '/')}:{f.f_lineno}"
+
+
+class _WitnessLock:
+    """Order-recording proxy over a real Lock/RLock."""
+
+    __slots__ = ("_lock", "_site", "_reentrant")
+
+    def __init__(self, lock, site: str, reentrant: bool):
+        self._lock = lock
+        self._site = site
+        self._reentrant = reentrant
+
+    def _record(self):
+        stack = _held_stack()
+        if self._reentrant and any(e[0] is self for e in stack):
+            stack.append((self, None))  # reentrant re-acquire: no edge
+            return
+        with _REG_LOCK:
+            for held in stack:
+                if held[1] is not None and held[1] != self._site:
+                    key = (held[1], self._site)
+                    _EDGES[key] = _EDGES.get(key, 0) + 1
+        stack.append((self, self._site))
+
+    def _unrecord(self):
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):  # non-LIFO release ok
+            if stack[i][0] is self:
+                del stack[i]
+                return
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._record()
+        return got
+
+    def release(self):
+        self._unrecord()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __repr__(self):
+        return f"<WitnessLock {self._site} over {self._lock!r}>"
+
+
+def _make_factory(real, reentrant: bool):
+    def factory():
+        site = _creation_site()
+        lock = real()
+        if site is None:
+            return lock
+        with _REG_LOCK:
+            _SITES.add(site)
+        return _WitnessLock(lock, site, reentrant)
+    return factory
+
+
+def install() -> None:
+    """Patch the threading lock factories (idempotent)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    threading.Lock = _make_factory(_REAL_LOCK, False)
+    threading.RLock = _make_factory(_REAL_RLOCK, True)
+    _INSTALLED = True
+
+
+def uninstall() -> None:
+    """Restore the real factories. Locks already wrapped keep recording
+    until they are garbage-collected; ``reset()`` clears the log."""
+    global _INSTALLED
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _INSTALLED = False
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def maybe_install() -> bool:
+    """Install when the ``witness.enabled`` config flag is on."""
+    from ..utils import config
+    if bool(config.get("witness.enabled")):
+        install()
+    return _INSTALLED
+
+
+def reset() -> None:
+    with _REG_LOCK:
+        _EDGES.clear()
+        _SITES.clear()
+
+
+def snapshot() -> Dict[Tuple[str, str], int]:
+    """The recorded (held-site → acquired-site) edge counts."""
+    with _REG_LOCK:
+        return dict(_EDGES)
+
+
+def dynamic_inversions() -> List[Tuple[str, str]]:
+    """Site pairs observed in BOTH orders at runtime — real, demonstrated
+    lock-order inversions (a < b, each pair once)."""
+    edges = snapshot()
+    return sorted({(a, b) for (a, b) in edges
+                   if a < b and (b, a) in edges})
+
+
+# ---------------------------------------------------------------------------
+# static/dynamic crosscheck
+
+
+def _site_to_lock_id(site: str, decl_at: Dict[Tuple[str, int], str]) \
+        -> Optional[str]:
+    path, _, line = site.rpartition(":")
+    try:
+        return decl_at.get((path, int(line)))
+    except ValueError:
+        return None
+
+
+def crosscheck(graph=None, edges: Optional[Dict[Tuple[str, str], int]] = None
+               ) -> Dict[str, list]:
+    """Join the dynamic edge log against the static lock graph.
+
+    Returns::
+
+        {"witnessed":  [(lock_a, lock_b), ...]   # static inversion, both
+                                                 # orders seen at runtime
+         "plausible":  [(lock_a, lock_b), ...]   # static inversion, not
+                                                 # (fully) driven yet
+         "dynamic_only": [(lock_a, lock_b), ...] # runtime inversion the
+                                                 # static graph missed
+         "unmapped_edges": [(site_a, site_b), ...]}  # dynamic edges whose
+                                                 # creation sites are not
+                                                 # static lock decls
+
+    ``graph`` defaults to a fresh static graph over the package; ``edges``
+    defaults to the live witness log.
+    """
+    from .callgraph import get_graph
+    from .locks import inversions, lock_order_edges
+
+    if graph is None:
+        import ast
+        pkg = os.path.join(_REPO_ROOT, "spark_rapids_jni_tpu")
+        modules = []
+        for root, dirs, files in os.walk(pkg):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                fp = os.path.join(root, name)
+                rel = fp[len(_REPO_ROOT) + 1:].replace(os.sep, "/")
+                try:
+                    with open(fp, encoding="utf-8") as fh:
+                        src = fh.read()
+                    modules.append((rel, ast.parse(src), src.splitlines()))
+                except (OSError, SyntaxError, UnicodeDecodeError):
+                    continue
+        graph = get_graph(modules)
+    if edges is None:
+        edges = snapshot()
+
+    # dynamic edges lifted to canonical lock ids (where mappable)
+    dyn_edges: Set[Tuple[str, str]] = set()
+    unmapped: List[Tuple[str, str]] = []
+    for (sa, sb) in sorted(edges):
+        a = _site_to_lock_id(sa, graph.decl_at)
+        b = _site_to_lock_id(sb, graph.decl_at)
+        if a is not None and b is not None:
+            dyn_edges.add((a, b))
+        else:
+            unmapped.append((sa, sb))
+
+    static_edges = lock_order_edges(graph)
+    witnessed, plausible = [], []
+    for a, b, _wab, _wba in inversions(static_edges):
+        if (a, b) in dyn_edges and (b, a) in dyn_edges:
+            witnessed.append((a, b))
+        else:
+            plausible.append((a, b))
+
+    static_pairs = {(a, b) for (a, b) in static_edges} \
+        | {(b, a) for (a, b) in static_edges}
+    dynamic_only = sorted({
+        (a, b) for (a, b) in dyn_edges
+        if a < b and (b, a) in dyn_edges
+        and not ((a, b) in static_pairs and (b, a) in static_pairs)})
+
+    return {"witnessed": witnessed, "plausible": plausible,
+            "dynamic_only": dynamic_only, "unmapped_edges": unmapped}

@@ -310,11 +310,15 @@ class RmmSpark:
 
     @classmethod
     def clear_event_handler(cls) -> None:
+        # pop the adaptor under the lock, close it outside: close() joins
+        # the rmm watchdog thread, and holding cls._lock across that join
+        # would wedge every thread-registration call until the watchdog
+        # exits (srjt-race SRJTR02)
         with cls._lock:
-            if cls._adaptor is not None:
-                cls._adaptor.close()
-                cls._adaptor = None
+            adaptor, cls._adaptor = cls._adaptor, None
             cls._tid_map.clear()
+        if adaptor is not None:
+            adaptor.close()
 
     @classmethod
     def _adp(cls) -> SparkResourceAdaptor:

@@ -162,12 +162,31 @@ class SpillableTable:
 
     def __init__(self, table: Table):
         self._lock = threading.Lock()
+        # state-transition fence: transfers (d2h/h2d/disk IO) run OUTSIDE
+        # the lock so a stalled or fault-injected transfer can never wedge
+        # readers of the state properties (srjt-race SRJTR02); _busy marks
+        # a transition in flight and _cond wakes its waiters
+        self._cond = threading.Condition(self._lock)
+        self._busy = False
         self._table: Optional[Table] = table
         self._state = self.DEVICE
         self._fingerprint = None
         self._disk_path: Optional[str] = None
         self._on_promote = None  # set by SpillStore.register (LRU touch)
         self._on_spill = None    # set by SpillStore.register (host limit)
+
+    def _await_settled_locked(self) -> None:
+        """Wait (bounded, cancellable) for an in-flight transition to
+        finish. Caller holds ``self._lock``."""
+        while self._busy:
+            watchdog.checkpoint()  # honor deadline/cancel while waiting
+            self._cond.wait(0.05)
+
+    def _settle(self) -> None:
+        """Clear the busy flag and wake waiters (transition epilogue)."""
+        with self._lock:
+            self._busy = False
+            self._cond.notify_all()
 
     @property
     def device_nbytes(self) -> int:
@@ -221,18 +240,29 @@ class SpillableTable:
         """Demote to host; returns HBM bytes released (0 if not device-
         resident). Fingerprints the host bytes for promote-time verify."""
         with self._lock:
+            self._await_settled_locked()
             if self._state != self.DEVICE:
                 return 0
             freed = self._table.device_nbytes()
+            table = self._table
+            self._busy = True
+        try:
             with trace_range("spill"):
-                self._table = _guarded("spill", lambda: to_host(self._table))
-                self._fingerprint = (table_fingerprint(self._table)
-                                     if _verify_enabled() else None)
+                host = _guarded("spill", lambda: to_host(table))
+                fp = table_fingerprint(host) if _verify_enabled() else None
                 # chaos surface "spill": a flip landing after the
                 # fingerprint models bit rot while the table sits in host
                 # RAM — caught by the verify in get()
-                self._table, _ = maybe_flip_table("spill", self._table)
+                host, _ = maybe_flip_table("spill", host)
+        except BaseException:
+            self._settle()
+            raise
+        with self._lock:
+            self._table = host
+            self._fingerprint = fp
             self._state = self.HOST
+            self._busy = False
+            self._cond.notify_all()
         if self._on_spill is not None:
             self._on_spill(self)  # outside the lock: store takes its own
         return freed
@@ -243,50 +273,73 @@ class SpillableTable:
         Device-resident tables spill to host first."""
         self.spill()
         with self._lock:
+            self._await_settled_locked()
             if self._state != self.HOST:
                 return 0
             freed = _host_table_nbytes(self._table)
             table = self._table
+            self._busy = True
+        try:
             with trace_range("spill_disk"):
                 _guarded("spill_disk", lambda: write_table_file(path, table))
+        except BaseException:
+            self._settle()
+            raise
+        with self._lock:
             self._disk_path = path
             self._table = None
             self._state = self.DISK
-            return freed
+            self._busy = False
+            self._cond.notify_all()
+        return freed
 
-    def _promote_locked(self) -> None:
-        """DISK/HOST → DEVICE under self._lock. Raises CorruptionError
+    def _promote(self) -> Table:
+        """DISK/HOST → DEVICE. Entered owning the busy flag (lock NOT
+        held); transfers run unlocked, each state step commits under the
+        lock, and the flag is always cleared. Raises CorruptionError
         (after the guard counted the detection) on any integrity failure;
         the caller quarantines."""
-        if self._state == self.DISK:
-            path = self._disk_path
-            with trace_range("unspill_disk"):
-                # the disk surface's chaos flip ("disk_promote") lands on
-                # the raw payload inside read_table_file, before the
-                # per-buffer crc verify
-                self._table = _guarded(
-                    "unspill_disk",
-                    lambda: read_table_file(path, inject_api="disk_promote"))
-            self._disk_path = None
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            self._state = self.HOST
-        if self._state == self.HOST:
-            fp = self._fingerprint
-            table = self._table
+        try:
+            with self._lock:
+                state = self._state
+                path = self._disk_path
+                fp = self._fingerprint
+                table = self._table
+            if state == self.DISK:
+                with trace_range("unspill_disk"):
+                    # the disk surface's chaos flip ("disk_promote") lands
+                    # on the raw payload inside read_table_file, before
+                    # the per-buffer crc verify
+                    table = _guarded(
+                        "unspill_disk",
+                        lambda: read_table_file(path,
+                                                inject_api="disk_promote"))
+                with self._lock:
+                    self._table = table
+                    self._disk_path = None
+                    self._state = self.HOST
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                state = self.HOST
+            if state == self.HOST:
+                def _verified_upload():
+                    t, _ = maybe_flip_table("unspill", table)
+                    if fp is not None:
+                        verify_table(t, fp, context="unspill")
+                    return to_device(t)
 
-            def _verified_upload():
-                t, _ = maybe_flip_table("unspill", table)
-                if fp is not None:
-                    verify_table(t, fp, context="unspill")
-                return to_device(t)
-
-            with trace_range("unspill"):
-                self._table = _guarded("unspill", _verified_upload)
-            self._fingerprint = None
-            self._state = self.DEVICE
+                with trace_range("unspill"):
+                    dev = _guarded("unspill", _verified_upload)
+                with self._lock:
+                    self._table = dev
+                    self._fingerprint = None
+                    self._state = self.DEVICE
+            with self._lock:
+                return self._table
+        finally:
+            self._settle()
 
     def get(self) -> Table:
         """The device-resident table, promoting (re-uploading) if spilled.
@@ -296,12 +349,18 @@ class SpillableTable:
         already quarantined by an earlier failure."""
         try:
             with self._lock:
+                self._await_settled_locked()
                 if self._state == self.QUARANTINED:
                     raise CorruptionError(
                         "spillable table is quarantined (a previous "
                         "integrity check failed); rebuild from source")
-                self._promote_locked()
-                table = self._table
+                if self._state == self.DEVICE:
+                    table = self._table
+                else:
+                    self._busy = True
+                    table = None
+            if table is None:
+                table = self._promote()
         except CorruptionError:
             self._quarantine()
             raise

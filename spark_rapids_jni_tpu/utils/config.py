@@ -156,6 +156,12 @@ _register("exchange.verify_checksum", "SRJT_EXCHANGE_VERIFY_CHECKSUM",
           "carry a per-shard checksum companion through the exchange "
           "all_to_all and verify on the receive side before tables are "
           "rebuilt; a mismatch raises CorruptionError")
+_register("witness.enabled", "SRJT_WITNESS", False, _parse_bool,
+          "lock-witness mode (analysis/witness.py): wrap every lock the "
+          "package creates in an order-recording proxy so chaos storms "
+          "log real acquisition orders; srjt-race cross-checks them "
+          "against the static lock graph (WITNESSED vs PLAUSIBLE). "
+          "Debug-only — measurable per-acquire overhead")
 _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "input variants cycled by benchmarks to defeat identical-args "
           "elision")
