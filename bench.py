@@ -350,6 +350,11 @@ def axis_table():
         # capture proves the encoded-vs-materialized ratio on-chip
         ("dict_filter_strings_4m", lambda: _B().bench_dict_filter_strings(1 << 22), 1 << 22),
         ("dict_groupby_strings_4m", lambda: _B().bench_dict_groupby_strings(1 << 22), 1 << 22),
+        # the serving-tier axis (ROADMAP item 3): sustained QPS + tail
+        # latency through admission/scheduling/micro-batching; the row
+        # carries qps, p50/p95/p99, queue depth, dispatches-per-query and
+        # rejected/deadline-missed counts via pop_extra()
+        ("serving_qps_mixed_1k", lambda: _B().bench_serving_qps_mixed(1000), 1000 * 2048),
         ("sort_1m", lambda: _B().bench_sort(1 << 20), 1 << 20),
         ("bloom_filter_1m", lambda: _B().bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: _B().bench_cast_string_to_float(500_000), 500_000),

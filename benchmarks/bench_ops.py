@@ -393,6 +393,137 @@ def bench_dict_filter_strings(rows: int):
     return sec, nbytes
 
 
+def bench_serving_qps_mixed(queries: int):
+    """Serving-tier sustained-QPS storm: ``queries`` queries, 3 tenants,
+    a skewed plan mix (~70% filter / 20% groupby / 10% sort+limit), and
+    Poisson arrivals, all through the ServingFrontend's
+    admission → schedule → microbatch → guarded-dispatch path.
+
+    Headline ``seconds`` is the wall clock of the timed phase (a warmup
+    phase pays the batched-program compiles first); the serving row
+    fields ride via pop_extra(): sustained ``qps``, ``p50_ms`` /
+    ``p95_ms`` / ``p99_ms`` submit-to-result latency,
+    ``peak_queue_depth``, ``dispatches_per_query`` (the micro-batching
+    win: < 1 means batching collapsed more dispatches than it added),
+    ``batches``, ``rejected`` and ``deadline_missed`` counts."""
+    import threading
+    import time as _time_mod
+
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.plan import expr as ex
+    from spark_rapids_jni_tpu.plan.nodes import (Filter, GroupBy, Limit,
+                                                 Scan, Sort)
+    from spark_rapids_jni_tpu.serving import (AdmissionRejected,
+                                              ServingFrontend,
+                                              serving_metrics)
+
+    rows = 2048
+    rng = np.random.default_rng(0)
+
+    def mk(seed):
+        r = np.random.default_rng(seed)
+        return Table((
+            Column(dt.INT64, rows, data=jnp.asarray(
+                r.integers(0, 9, rows, dtype=np.int64))),
+            Column(dt.INT64, rows, data=jnp.asarray(
+                r.integers(0, 1000, rows, dtype=np.int64))),
+        ))
+
+    tables = [mk(s) for s in range(8)]
+    plans = [
+        Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(5))),
+        GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count"))),
+        Limit(Sort(Scan(2), (0, 1)), 64),
+    ]
+    tenants = ["interactive", "analytics", "background"]
+    plan_mix = rng.choice(3, size=queries, p=[0.7, 0.2, 0.1])
+    tenant_mix = rng.choice(3, size=queries, p=[0.5, 0.35, 0.15])
+    gaps = rng.exponential(scale=0.007, size=queries)  # ~140 QPS offered
+
+    def storm(fe, count, record=None):
+        futs = []
+        for i in range(count):
+            _time_mod.sleep(gaps[i])
+            t0 = _time_mod.monotonic()
+            try:
+                fut = fe.submit(tenants[tenant_mix[i]],
+                                plans[plan_mix[i]],
+                                tables[i % len(tables)], budget_s=120.0)
+            except AdmissionRejected:
+                continue
+            if record is not None:
+                fut.add_done_callback(
+                    lambda _f, t0=t0: record.append(
+                        (_time_mod.monotonic() - t0) * 1000.0))
+            futs.append(fut)
+        for f in futs:
+            try:
+                f.result(timeout=600)
+            except Exception:
+                pass
+        return futs
+
+    fe = ServingFrontend()
+    for i, name in enumerate(tenants):
+        # generous in-flight caps: this axis measures batching + tail
+        # latency under load, not admission shedding (the rejected count
+        # in the row then isolates genuine queue_full/budget pressure)
+        fe.register_tenant(name, priority=2 * i, max_in_flight=1024)
+    try:
+        # warmup: pre-pay every batched-program compile the storm can
+        # reach — the batcher quantizes group sizes to powers of two, so
+        # plan x {1,2,4,8,...,max_batch} covers the whole compile space
+        from spark_rapids_jni_tpu.serving import MicroBatcher, batch_key_for
+        from spark_rapids_jni_tpu.utils import config as _cfg
+        mb = MicroBatcher()
+        max_batch = max(1, int(_cfg.get("serving.max_batch")))
+        for plan in plans:
+            kb = 1
+            while kb <= max_batch:
+                group = [tables[i % len(tables)] for i in range(kb)]
+                mb.execute_group(
+                    [batch_key_for(plan, t)[0] for t in group],
+                    group, [None] * kb)
+                kb *= 2
+        storm(fe, min(queries, 100))
+        serving_metrics.reset()
+        fe.scheduler.peak_depth = 0
+        latencies = []
+        t0 = _time_mod.monotonic()
+        storm(fe, queries, record=latencies)
+        sec = _time_mod.monotonic() - t0
+        peak_depth = fe.scheduler.peak_depth
+    finally:
+        fe.drain()
+
+    m = serving_metrics.snapshot()
+    lat = np.sort(np.asarray(latencies)) if latencies else np.zeros(1)
+
+    def pct(p):
+        return round(float(lat[min(len(lat) - 1,
+                                   int(len(lat) * p / 100))]), 3)
+
+    done = max(1, m["completed"] + m["failed"])
+    LAST_EXTRA.clear()
+    LAST_EXTRA.update({
+        "engine": "serving",
+        "qps": round(done / sec, 1),
+        "p50_ms": pct(50),
+        "p95_ms": pct(95),
+        "p99_ms": pct(99),
+        "peak_queue_depth": peak_depth,
+        "dispatches_per_query": round(m["dispatches"] / done, 3),
+        "batches": m["batches"],
+        "batched_queries": m["batched_queries"],
+        "rejected": m["rejected"],
+        "deadline_missed": m["deadline_missed"],
+    })
+    return sec, queries * rows * 16
+
+
 def _query_mesh(n_devices: int):
     """Mesh for distributed query benches (None = local single-device)."""
     if n_devices <= 0:
@@ -634,7 +765,8 @@ def main():
                              "tpch_q5", "tpch_q6",
                              "get_json_object", "from_json",
                              "parquet_decode", "shuffle_skewed",
-                             "dict_filter_strings", "dict_groupby_strings"])
+                             "dict_filter_strings", "dict_groupby_strings",
+                             "serving_qps_mixed"])
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
@@ -676,6 +808,10 @@ def main():
         runs.append(("dict_filter_strings", "pushdown+codes vs full decode",
                      args.rows,
                      lambda: bench_dict_filter_strings(args.rows)))
+    if args.bench in ("all", "serving_qps_mixed"):
+        q = min(args.rows, 1000)
+        runs.append(("serving_qps_mixed", "3 tenants, poisson, 70/20/10 mix",
+                     q, lambda: bench_serving_qps_mixed(q)))
     if args.bench in ("all", "tpch_q1"):
         cfg = ("filter+8agg-groupby+sort" if not args.mesh
                else f"distributed mesh={args.mesh}")

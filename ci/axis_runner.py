@@ -39,7 +39,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     axis = sys.argv[1]
-    repeats = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    # serving axes are full workload storms (1000 queries each), not
+    # single-op timings: default to fewer repeats so one axis stays
+    # inside the SIGKILL budget (an explicit argv[2] still wins)
+    default_repeats = 2 if axis.startswith("serving_") else 3
+    repeats = int(sys.argv[2]) if len(sys.argv) > 2 else default_repeats
 
     # No subprocess pre-probe here: the parent daemon probed the tunnel
     # seconds ago, and a redundant 240 s probe inside the axis budget

@@ -59,7 +59,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_race.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 5 — exception-fault storms over the whole chaos-marked suite
+# stage 5 — serving-tier mixed-workload storm: a 3-tenant load through
+# admission → schedule → microbatch → guarded dispatch with POISON traps
+# injected at the plan_execute surface. Pass criteria baked into the
+# tests (tests/test_serving.py chaos marks): zero cross-tenant failure
+# propagation (a batch-mate's trap never fails another tenant's query),
+# every surviving result bit-identical to its solo baseline, and a clean
+# frontend drain afterwards. The outer `timeout` is part of the
+# contract: if batched-fault replay or drain ever wedges, the lane fails
+# loudly instead of hanging CI. `make serve` runs the full serving lane.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serving.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 6 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

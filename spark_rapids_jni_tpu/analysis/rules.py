@@ -20,6 +20,8 @@ Rule IDs:
            sanctioned loader modules
   SRJT011  host sync or dispatch guard inside a plan-registered op core
   SRJT012  dictionary materialize() inside a plan core or an ops/ module
+  SRJT013  serving entry point without a Deadline, or raw dispatch from
+           serving/ (device work must route through guarded_dispatch)
 """
 
 from __future__ import annotations
@@ -877,6 +879,108 @@ def rule_srjt012(tree, rel, lines, ctx) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# SRJT013 — serving-tier discipline: deadlines at entry, guarded dispatch only
+# ---------------------------------------------------------------------------
+
+# The serving tier (spark_rapids_jni_tpu/serving/) multiplexes many
+# tenants over one device: an unbounded query would let one tenant wedge a
+# dispatch lane forever, and a raw dispatch would bypass the fault-domain
+# supervisor the whole isolation story (solo replay, breaker shedding)
+# hangs off. Two clauses:
+#
+#   (a) every public entry point (submit*/execute*/run*/serve*/dispatch*)
+#       must establish or adopt a Deadline — Deadline(...),
+#       Deadline.adopt(...), or ensure_deadline(...) — so queue time and
+#       device time are both bounded per query;
+#   (b) no raw dispatch (same detection as SRJT003) outside a
+#       guarded_dispatch thunk — serving code owns ZERO device surfaces,
+#       it borrows plan_execute through the guard.
+
+_SRJT013_ENTRY_PREFIXES = ("submit", "execute", "run", "serve", "dispatch")
+_SRJT013_DEADLINE_FNS = ("ensure_deadline", "adopt")
+
+
+def _establishes_deadline(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn is None:
+            continue
+        parts = dn.split(".")
+        if parts[-1] in _SRJT013_DEADLINE_FNS or "Deadline" in parts:
+            return True
+    return False
+
+
+def rule_srjt013(tree, rel, lines, ctx) -> List[Finding]:
+    if "/serving/" not in "/" + rel or rel.endswith("__init__.py"):
+        return []
+    guarded = _guarded_fn_names(tree)
+    findings = []
+    for node, anc in _walk_stack(tree):
+        # clause (a): entry points establish a Deadline
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and not node.name.startswith("_") \
+                and node.name.startswith(_SRJT013_ENTRY_PREFIXES) \
+                and node.name not in guarded \
+                and not _establishes_deadline(node):
+            findings.append(Finding(
+                "SRJT013", rel, node.lineno,
+                f"serving entry point `{node.name}` never establishes a "
+                f"Deadline — arm Deadline(budget)/Deadline.adopt(snap)/"
+                f"ensure_deadline(what) so queue time and device time are "
+                f"bounded per query (faultinj/watchdog.py; one wedged "
+                f"tenant must not hold a dispatch lane forever)"))
+            continue
+        # clause (b): raw dispatch (SRJT003 detection, serving scope)
+        if not isinstance(node, ast.Call):
+            continue
+        protected = False
+        jitted_locals = set()
+        for a in anc:
+            if (isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and a.name in guarded):
+                protected = True
+            if isinstance(a, ast.Call):
+                afn = _dotted(a.func)
+                if afn and afn.split(".")[-1] in _GUARD_FNS:
+                    protected = True
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for st in ast.walk(a):
+                    if (isinstance(st, ast.Assign)
+                            and isinstance(st.value, ast.Call)
+                            and _jit_call_info(st.value) is not None):
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                jitted_locals.add(t.id)
+        if protected:
+            continue
+        fn = _dotted(node.func)
+        hit = None
+        if fn in _DISPATCH_PRIMS:
+            hit = fn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"):
+            hit = ".block_until_ready()"
+        elif isinstance(node.func, ast.Call) \
+                and _jit_call_info(node.func) is not None:
+            hit = "jax.jit(...)(...)"
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in jitted_locals:
+            hit = f"{node.func.id}(...) [jitted program]"
+        if hit is not None:
+            findings.append(Finding(
+                "SRJT013", rel, node.lineno,
+                f"raw dispatch `{hit}` from serving/ — the serving tier "
+                f"owns no device surfaces; route through "
+                f"faultinj.guarded_dispatch(\"plan_execute\", ...) so the "
+                f"supervisor, breaker, and batch fault isolation all see "
+                f"it (faultinj/guard.py)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Interprocedural upgrades (srjt-race call graph): SRJT001 / SRJT007 across
 # function boundaries
 # ---------------------------------------------------------------------------
@@ -1031,7 +1135,7 @@ from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
-              rule_srjt011, rule_srjt012)
+              rule_srjt011, rule_srjt012, rule_srjt013)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -134,6 +134,16 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def retry_after_s(self) -> float:
+        """Seconds until an OPEN breaker starts admitting probes — the
+        retry-after hint the serving front door attaches to shed load
+        (0.0 when not OPEN, so callers can pass it through unguarded)."""
+        _enabled, _threshold, _window, cooldown = _limits()
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, cooldown - (time.monotonic() - self._opened_at))
+
 
 _breakers: Dict[str, CircuitBreaker] = {}
 _lock = threading.Lock()

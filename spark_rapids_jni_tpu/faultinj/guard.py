@@ -210,7 +210,8 @@ class FaultDomainMetrics:
                "stall_cancelled", "stall_retries", "diagnostics_bundles",
                "workers_lost", "injected_crashes", "crash_detected",
                "worker_respawns", "quarantined_inputs", "breaker_opened",
-               "breaker_closed", "breaker_short_circuits", "drains")
+               "breaker_closed", "breaker_short_circuits", "drains",
+               "batch_solo_replays")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -20,7 +20,7 @@ import contextlib
 import ctypes
 import threading
 import weakref
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from . import native
 from .exceptions import (
@@ -423,11 +423,57 @@ class RmmSpark:
 
     @classmethod
     def alloc(cls, nbytes: int) -> None:
-        cls._adp().alloc(cls.get_current_thread_id(), nbytes)
+        tid = cls.get_current_thread_id()
+        cls._adp().alloc(tid, nbytes)
+        cls._track(tid, nbytes)
 
     @classmethod
     def dealloc(cls, nbytes: int) -> None:
-        cls._adp().dealloc(cls.get_current_thread_id(), nbytes)
+        tid = cls.get_current_thread_id()
+        cls._adp().dealloc(tid, nbytes)
+        cls._track(tid, -nbytes)
+
+    # -- per-thread reservation ledger (serving tenancy) ---------------------
+
+    # Python-side mirror of the adaptor's per-thread accounting: the serving
+    # tier attributes each dispatch thread's live reservation bytes to the
+    # tenant whose query runs on it (serving/sessions.py binds thread ->
+    # tenant for the duration of a dispatch). A dedicated lock, never held
+    # across the listener call, keeps this off the adaptor lock graph.
+    _ledger_lock = threading.Lock()
+    _thread_reserved: Dict[int, int] = {}
+    _alloc_listener: Optional[Callable[[int, int], None]] = None
+
+    @classmethod
+    def _track(cls, tid: int, delta: int) -> None:
+        with cls._ledger_lock:
+            now = cls._thread_reserved.get(tid, 0) + delta
+            if now <= 0:
+                cls._thread_reserved.pop(tid, None)
+            else:
+                cls._thread_reserved[tid] = now
+            listener = cls._alloc_listener
+        if listener is not None:
+            listener(tid, delta)
+
+    @classmethod
+    def thread_reserved_bytes(cls, tid: Optional[int] = None) -> int:
+        """Live reservation bytes attributed to ``tid`` (default: the
+        calling thread) — 0 for threads with no open bracket."""
+        if tid is None:
+            tid = cls.get_current_thread_id()
+        with cls._ledger_lock:
+            return cls._thread_reserved.get(tid, 0)
+
+    @classmethod
+    def set_alloc_listener(
+            cls, cb: Optional[Callable[[int, int], None]]) -> None:
+        """Install (or clear, with None) the single allocation listener:
+        called as ``cb(tid, delta_bytes)`` after every tracked alloc or
+        dealloc, outside the ledger lock. Serving sessions use this to
+        charge observed per-thread reservations to the owning tenant."""
+        with cls._ledger_lock:
+            cls._alloc_listener = cb
 
     @classmethod
     def block_thread_until_ready(cls) -> None:

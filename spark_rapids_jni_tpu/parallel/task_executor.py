@@ -337,7 +337,13 @@ class TaskExecutor:
         snap = dl.snapshot() if dl is not None else None
         with self._lock:
             if self._closed:
-                raise RuntimeError("TaskExecutor is closed")
+                # typed front-door rejection (subclasses RuntimeError, so
+                # pre-serving callers that caught RuntimeError still work);
+                # lazy import: serving imports this module back
+                from ..serving.admission import AdmissionRejected
+                raise AdmissionRejected(
+                    "closed", 0.0, None,
+                    "TaskExecutor is closed (drain() has run)")
             w = self._workers.get(task_id)
             if w is None:
                 register = RmmSpark.is_installed()

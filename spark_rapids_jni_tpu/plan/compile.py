@@ -233,6 +233,40 @@ class ProgramCache:
             prog = self._programs.setdefault(key, prog)
         return prog
 
+    def get_or_compile_batched(self, plan: PlanNode, template: Table,
+                               stacked_cols: Tuple[Column, ...],
+                               k: int) -> CompiledPlan:
+        """Batched variant for the serving micro-batcher: ``jax.vmap`` of
+        the same traced plan function over a leading batch axis of ``k``
+        stacked same-shape inputs. One dispatch then executes ``k``
+        queries; per-example semantics are untouched (vmap maps every op
+        core over axis 0), so each slice of the output is bit-identical
+        to the solo program's. Never donates: the stacked operand is a
+        serving-owned copy and member tables stay live for solo replay."""
+        max_groups = int(config.get("plan.max_groups"))
+        key = (fingerprint(plan), _shape_key(template), "vmap", k,
+               max_groups)
+        with self._lock:
+            prog = self._programs.get(key)
+        if prog is not None:
+            plan_metrics.inc("plan_cache_hits")
+            return prog
+        plan_metrics.inc("plan_cache_misses")
+        t0 = time.perf_counter()
+        out_info: Dict[str, Any] = {}
+        fn = _make_fn(plan, max_groups, out_info)
+        jitted = jax.jit(jax.vmap(fn))
+        compiled = jitted.lower(stacked_cols).compile()
+        plan_metrics.add_time("compile_s", time.perf_counter() - t0)
+        plan_metrics.inc("plan_compiles")
+        prog = CompiledPlan(compiled=compiled, fingerprint=key[0],
+                            has_mask=out_info["has_mask"],
+                            prefix=out_info["prefix"],
+                            n_out=out_info["n_out"])
+        with self._lock:
+            prog = self._programs.setdefault(key, prog)
+        return prog
+
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()

@@ -1,0 +1,42 @@
+"""Concurrent serving tier: multi-tenant sessions, admission control,
+deadline-aware scheduling, and plan-fingerprint micro-batching over the
+fused-plan executor.
+
+See docs/ARCHITECTURE.md "Serving tier". Exports are lazy (PEP 562) so
+``parallel.task_executor`` can import ``AdmissionRejected`` from here
+without dragging the scheduler (which imports the executor back) into
+the cycle.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "AdmissionController": ".admission",
+    "AdmissionRejected": ".admission",
+    "PLAN_SURFACE": ".admission",
+    "MemberOutcome": ".microbatch",
+    "MicroBatcher": ".microbatch",
+    "batch_key_for": ".microbatch",
+    "QueryTicket": ".scheduler",
+    "SchedulerClosed": ".scheduler",
+    "ServingFrontend": ".scheduler",
+    "ServingScheduler": ".scheduler",
+    "ServingMetrics": ".sessions",
+    "SessionRegistry": ".sessions",
+    "Tenant": ".sessions",
+    "serving_metrics": ".sessions",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod, __name__), name)
+
+
+def __dir__():
+    return __all__

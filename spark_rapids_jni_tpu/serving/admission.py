@@ -1,0 +1,103 @@
+"""Admission control: the serving tier's front door.
+
+Overload is rejected HERE, with a typed error carrying a retry-after
+hint, instead of deep in the stack where a queue-full or an open breaker
+would otherwise surface as a timeout. The checks, in order:
+
+1. frontend draining/closed (``TaskExecutor.drain()`` has begun — the
+   same ``AdmissionRejected`` the executor itself now raises);
+2. open ``plan_execute`` circuit breaker (faultinj/breaker.py): a
+   persistently failing dispatch surface sheds load at submission time,
+   retry-after = the breaker's cooldown remainder;
+3. global queue depth (``serving.max_queue_depth``);
+4. per-tenant in-flight cap and per-tenant HBM budget, validated and
+   charged atomically by the session registry (sessions.py).
+
+``AdmissionRejected`` subclasses RuntimeError so pre-serving callers of
+``TaskExecutor.submit()`` that caught RuntimeError keep working. The
+pipeline this fronts is docs/ARCHITECTURE.md "Serving tier".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..faultinj import breaker
+from ..utils import config
+from .sessions import SessionRegistry, serving_metrics
+
+# the guarded surface whose breaker gates serving admission: every fused
+# plan (batched or solo) dispatches through guarded_dispatch("plan_execute")
+PLAN_SURFACE = "plan_execute"
+
+
+class AdmissionRejected(RuntimeError):
+    """Typed front-door rejection. ``reason`` is one of ``closed`` /
+    ``draining`` / ``breaker_open`` / ``queue_full`` / ``unknown_tenant``
+    / ``tenant_in_flight`` / ``hbm_budget``; ``retry_after_s`` is the
+    caller's backoff hint (0.0 = do not retry, the resource is gone)."""
+
+    def __init__(self, reason: str, retry_after_s: float = 0.0,
+                 tenant_id: Optional[str] = None, detail: str = ""):
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.tenant_id = tenant_id
+        msg = f"admission rejected ({reason})"
+        if tenant_id is not None:
+            msg += f" for tenant {tenant_id!r}"
+        if detail:
+            msg += f": {detail}"
+        if self.retry_after_s > 0:
+            msg += f" [retry after {self.retry_after_s:.3f}s]"
+        super().__init__(msg)
+
+
+class AdmissionController:
+    """Stateless policy over the registry + breaker + queue-depth inputs;
+    one instance per frontend."""
+
+    def __init__(self, registry: SessionRegistry):
+        self._registry = registry
+
+    def admit(self, tenant_id: str, estimate_bytes: int,
+              queue_depth: int, draining: bool = False) -> None:
+        """Admit or raise. On success the tenant's in-flight slot and HBM
+        estimate are already charged (release via registry.release)."""
+        window_s = float(config.get("serving.batch_window_ms")) / 1000.0
+        if draining:
+            serving_metrics.inc("rejected")
+            self._registry.count(tenant_id, "rejected")
+            raise AdmissionRejected("draining", 0.0, tenant_id,
+                                    "serving frontend is draining")
+        br = breaker.lookup(PLAN_SURFACE)
+        if br is not None and br.state() == breaker.OPEN:
+            serving_metrics.inc("rejected")
+            self._registry.count(tenant_id, "rejected")
+            raise AdmissionRejected(
+                "breaker_open", max(br.retry_after_s(), window_s),
+                tenant_id,
+                f"the {PLAN_SURFACE} breaker is open (shedding at the "
+                f"front door)")
+        max_depth = int(config.get("serving.max_queue_depth"))
+        if max_depth > 0 and queue_depth >= max_depth:
+            serving_metrics.inc("rejected")
+            self._registry.count(tenant_id, "rejected")
+            raise AdmissionRejected(
+                "queue_full", window_s, tenant_id,
+                f"queue depth {queue_depth} >= serving.max_queue_depth "
+                f"{max_depth}")
+        reason = self._registry.try_admit(tenant_id, estimate_bytes)
+        if reason is not None:
+            serving_metrics.inc("rejected")
+            if reason == "unknown_tenant":
+                self._registry.count(tenant_id, "rejected")  # no-op: absent
+                raise AdmissionRejected(
+                    "unknown_tenant", 0.0, tenant_id,
+                    "register_tenant() before submitting")
+            raise AdmissionRejected(
+                reason, window_s, tenant_id,
+                "per-tenant in-flight cap reached"
+                if reason == "tenant_in_flight"
+                else f"HBM budget would be exceeded by +{estimate_bytes} "
+                     f"bytes")
+        serving_metrics.inc("admitted")

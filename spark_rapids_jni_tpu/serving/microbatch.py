@@ -1,0 +1,287 @@
+"""Plan-fingerprint micro-batching: K queued queries, ONE fused dispatch.
+
+The equivalence class comes from PR 7's whole-plan compiler: queries
+whose (dict-literal-resolved) plans share a fingerprint and whose input
+tables share a column signature and power-of-two row bucket can run as
+one program. The batcher:
+
+1. **pads** each member table to the bucket and appends a BOOL8
+   live-row indicator column;
+2. **rewrites** the plan to ``Scan(ncols+1) -> Filter(col(ncols)) ->
+   <original nodes>`` — pad rows become masked rows, which the fused
+   lowering already treats exactly like filtered rows (GroupBy pushes
+   them into dead segments, Sort sinks them, trims drop them), so
+   padding is invisible by the same mechanism bit-identity already
+   rests on;
+3. **stacks** the padded tables on a new leading axis and runs
+   ``jax.jit(jax.vmap(plan_fn))`` through the existing
+   ``guarded_dispatch("plan_execute")`` boundary — one reservation, one
+   injection point, one host sync (the ``[K, 2]`` head) for K queries;
+4. **scatters** per-query slices back to futures with the plan
+   executor's own trim logic.
+
+Fault isolation: a POISON/CRASH/corruption escaping the guard fails the
+*dispatch*, not the batch-mates — every member is replayed SOLO through
+``execute_plan`` under its own deadline, so only the query whose input
+actually trips the fault fails, and the ``plan_execute`` breaker records
+the surface failure for admission to shed on. Per-member group-budget
+overflow replays solo the same way (the solo path then takes its eager
+fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.table_ops import gather_table, mask_indices_core
+from ..faultinj import breaker, watchdog
+from ..faultinj.guard import guarded_dispatch, metrics as fault_metrics
+from ..memory.reservation import device_reservation, release_barrier
+from ..plan.compile import ProgramCache, _shape_key
+from ..plan.executor import (default_cache, execute_plan,
+                             resolve_dict_literals, unsupported_reason)
+from ..plan.nodes import (Filter, GroupBy, PlanNode, Project, Scan,
+                          fingerprint, linearize)
+from ..plan import expr as ex
+from ..utils.shapes import bucket_size
+from .admission import PLAN_SURFACE
+from .sessions import serving_metrics
+
+
+def batch_key_for(plan: PlanNode, table: Table
+                  ) -> Tuple[PlanNode, Optional[Tuple]]:
+    """(resolved plan, batching key) — key is None when the query cannot
+    batch (unsupported input: the caller routes it solo, where
+    execute_plan takes its eager fallback)."""
+    plan = resolve_dict_literals(plan, table)
+    if unsupported_reason(plan, table) is not None:
+        return plan, None
+    bucket = bucket_size(table.num_rows)
+    sig = tuple(ent[:2] + (bucket,) + ent[3:]
+                for ent in _shape_key(table))
+    return plan, (fingerprint(plan), sig)
+
+
+def _pad_plan(plan: PlanNode) -> PlanNode:
+    """Prepend the live-row filter over the appended indicator column.
+    Original column indices stay valid (the indicator is appended last),
+    and the first Project drops it — by then the mask carries liveness."""
+    nodes = linearize(plan)
+    ncols = nodes[0].ncols
+    new_plan: PlanNode = Filter(Scan(ncols + 1), ex.Col(ncols))
+    for node in nodes[1:]:
+        new_plan = dataclasses.replace(node, child=new_plan)
+    return new_plan
+
+
+def _pad_table(table: Table, bucket: int) -> Table:
+    """Pad to ``bucket`` rows (zero data, null validity where the column
+    carries one) and append the BOOL8 indicator column. Pad rows are
+    masked out by the rewritten plan before any operator sees them, so
+    the zeros never influence a result."""
+    n = table.num_rows
+    pad = bucket - n
+    cols = []
+    for c in table.columns:
+        data = c.data
+        val = c.validity
+        if pad:
+            data = jnp.concatenate(
+                [data, jnp.zeros((pad,), dtype=data.dtype)])
+            if val is not None:
+                val = jnp.concatenate(
+                    [val, jnp.zeros((pad,), dtype=val.dtype)])
+        cols.append(Column(c.dtype, bucket, data=data, validity=val,
+                           children=c.children))
+    ind = jnp.ones((n,), jnp.uint8)
+    if pad:
+        ind = jnp.concatenate([ind, jnp.zeros((pad,), jnp.uint8)])
+    cols.append(Column(dt.BOOL8, bucket, data=ind))
+    return Table(tuple(cols))
+
+
+def _stack_columns(tables: Sequence[Table]) -> Tuple[Column, ...]:
+    """Stack same-shape column pytrees along a new leading batch axis."""
+    flats = [jax.tree_util.tree_flatten(tuple(t.columns)) for t in tables]
+    treedef = flats[0][1]
+    leaves = [jnp.stack([leaves_k[i] for leaves_k, _ in flats])
+              for i in range(len(flats[0][0]))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _slice_member(cols, mask, k: int):
+    cols_k = [jax.tree_util.tree_map(lambda a: a[k], c) for c in cols]
+    return cols_k, (None if mask is None else mask[k])
+
+
+def _trim(cols_k, mask_k, live: int, prefix: bool) -> Table:
+    """The plan executor's trim, per batch member."""
+    if mask_k is None:
+        return Table(tuple(cols_k))
+    if prefix:
+        out = []
+        for c in cols_k:
+            v = c.validity[:live] if c.validity is not None else None
+            out.append(Column(c.dtype, live, data=c.data[:live],
+                              validity=v, children=c.children))
+        return Table(tuple(out))
+    idx = mask_indices_core(mask_k, live)
+    return gather_table(Table(tuple(cols_k)), idx)
+
+
+class MemberOutcome:
+    """Per-query result of one batched dispatch: a Table or an error."""
+
+    __slots__ = ("table", "error", "replayed_solo")
+
+    def __init__(self, table: Optional[Table] = None,
+                 error: Optional[BaseException] = None,
+                 replayed_solo: bool = False):
+        self.table = table
+        self.error = error
+        self.replayed_solo = replayed_solo
+
+
+class MicroBatcher:
+    """Executes a group of batch-compatible queries (same batch key) as
+    one fused program; falls back member-by-member on faults/overflow."""
+
+    def __init__(self, cache: Optional[ProgramCache] = None):
+        self._cache = cache if cache is not None else default_cache()
+
+    # -- solo path -----------------------------------------------------------
+
+    def _solo(self, plan: PlanNode, table: Table,
+              snap=None) -> MemberOutcome:
+        """One member through the normal solo executor, under the
+        member's own adopted deadline (fault attribution: only this
+        member's future sees this dispatch's outcome)."""
+        ctx = (watchdog.Deadline.adopt(snap) if snap is not None
+               else watchdog.ensure_deadline("serving:solo"))
+        try:
+            with ctx:
+                out = execute_plan(plan, table, cache=self._cache)
+            return MemberOutcome(table=out)
+        except BaseException as e:  # noqa: BLE001 — routed to the future
+            return MemberOutcome(error=e)
+
+    # -- batched path --------------------------------------------------------
+
+    def execute_group(self, plans: Sequence[PlanNode],
+                      tables: Sequence[Table],
+                      snaps: Sequence[Any]) -> List[MemberOutcome]:
+        """Run the group (one dispatch when len > 1); always returns one
+        outcome per member, never raises for a member-attributable fault.
+        ``snaps`` are the members' submit-side Deadline snapshots (None
+        entries = unbounded)."""
+        k = len(tables)
+        serving_metrics.inc("dispatches")
+        if k == 1:
+            serving_metrics.inc("solo_dispatches")
+            return [self._solo(plans[0], tables[0], snaps[0])]
+
+        bucket = bucket_size(max(t.num_rows for t in tables))
+        padded = [_pad_table(t, bucket) for t in tables]
+        pplan = _pad_plan(plans[0])
+        # a pure passthrough chain (Filter/Sort/Limit only) carries every
+        # scanned column to the output — including the appended indicator;
+        # a Project or GroupBy re-derives the schema and drops it
+        passthrough = not any(isinstance(n, (Project, GroupBy))
+                              for n in linearize(plans[0])[1:])
+        # quantize the batch axis to the next power of two with all-dead
+        # dummy lanes (zero leaves: indicator 0 = every row masked), so
+        # the compile-key space per plan signature is {2,4,8,16,...}
+        # instead of one program per observed group size — the classic
+        # serving tradeoff of bounded compile count for bounded waste
+        kb = 1 << (k - 1).bit_length()
+        stacked = _stack_columns(padded)
+        if kb > k:
+            stacked = jax.tree_util.tree_map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((kb - k,) + a.shape[1:], a.dtype)]),
+                stacked)
+        nbytes = sum(t.device_nbytes() for t in tables)
+
+        # the batch runs under the LOOSEST member deadline so no member
+        # is cancelled by a batch-mate's tighter budget; each member's
+        # own expiry is accounted at scatter time by the caller
+        loosest = None
+        if all(s is not None for s in snaps):
+            loosest = max(snaps, key=lambda s: s[1])
+        ctx = (watchdog.Deadline.adopt(loosest) if loosest is not None
+               else watchdog.ensure_deadline("serving:batch"))
+        br = breaker.get_breaker(PLAN_SURFACE)
+        try:
+            with ctx:
+                prog = self._cache.get_or_compile_batched(
+                    pplan, padded[0], stacked, kb)
+
+                def run():
+                    # same 2x envelope as the solo executor, summed over
+                    # the members riding this dispatch
+                    with device_reservation(2 * nbytes) as took:
+                        out = prog.compiled(stacked)
+                        return release_barrier(out, took)
+
+                cols, mask, head = guarded_dispatch(PLAN_SURFACE, run)
+                head_h = np.asarray(head)   # THE host sync for the batch
+        except BaseException as e:  # noqa: BLE001 — isolate per member
+            # the whole dispatch failed (POISON storm, crash, stall...):
+            # surface health is the breaker's business, member outcomes
+            # are decided by SOLO replay — one tenant's poison pill must
+            # not fail its batch-mates
+            br.record_failure()
+            serving_metrics.inc("batch_fault_replays", k)
+            fault_metrics.bump("batch_solo_replays", k)
+            return self._replay_members(plans, tables, snaps, e)
+
+        br.record_success()
+        serving_metrics.inc("batches")
+        serving_metrics.inc("batched_queries", k)
+        outcomes: List[MemberOutcome] = []
+        for i in range(k):
+            live, overflow = int(head_h[i][0]), bool(head_h[i][1])
+            if overflow:
+                # this member's true group count exceeded the static
+                # budget: its padded slots are garbage — replay solo
+                # (the solo path detects the same overflow and takes
+                # its eager fallback)
+                serving_metrics.inc("overflow_replays")
+                out = self._solo(plans[i], tables[i], snaps[i])
+                out.replayed_solo = True
+                outcomes.append(out)
+                continue
+            cols_i, mask_i = _slice_member(cols, mask, i)
+            out = _trim(cols_i, mask_i, live, prog.prefix)
+            if passthrough:
+                out = Table(out.columns[:-1])   # shed the indicator column
+            outcomes.append(MemberOutcome(table=out))
+        return outcomes
+
+    def _replay_members(self, plans, tables, snaps,
+                        batch_error: BaseException) -> List[MemberOutcome]:
+        """Solo replay after a failed batched dispatch. A member whose
+        deadline already expired inherits the batch's stall error (its
+        budget is spent — replaying would only fail at the first
+        checkpoint); everyone else gets a clean solo run."""
+        outcomes = []
+        for plan, table, snap in zip(plans, tables, snaps):
+            if snap is not None and snap[1] <= _now():
+                outcomes.append(MemberOutcome(error=batch_error))
+                continue
+            out = self._solo(plan, table, snap)
+            out.replayed_solo = True
+            outcomes.append(out)
+        return outcomes
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()

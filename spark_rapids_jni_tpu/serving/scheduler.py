@@ -1,0 +1,361 @@
+"""Deadline-aware scheduling + the serving frontend's dispatch loops.
+
+Ordering: earliest-deadline-first within priority. A ticket's effective
+priority is its tenant priority minus one level per
+``serving.age_step_s`` waited (priority aging) — a background tenant's
+query cannot starve behind a steady stream of urgent arrivals, it climbs
+one class per quantum until it wins. Within an effective class, tickets
+order by their Deadline expiry (the thread-local ``Deadline`` snapshot
+captured at submit — queue time counts against the budget, exactly the
+TaskExecutor contract), deadline-less tickets last, FIFO as the tiebreak.
+
+Batching interaction: the dispatcher pops the most urgent ticket and
+takes every queued ticket sharing its batch key (microbatch.py) with it,
+up to ``serving.max_batch``. If the group is not full and the head has
+been queued for less than ``serving.batch_window_ms``, the dispatcher
+waits out the remainder of the window for mates to arrive — so the
+window bounds the extra latency batching can ever add to a query.
+
+Drain: ``ServingFrontend.drain()`` stops admission (further submits
+raise AdmissionRejected), flushes the queue WITHOUT window waits (queued
+work runs, it just stops waiting for company), joins the dispatch
+lanes, then delegates to ``TaskExecutor.drain()`` for the executor-level
+verdict — one graceful path from front door to device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..columnar.column import Table
+from ..faultinj import watchdog
+from ..parallel.task_executor import TaskExecutor
+from ..plan.compile import ProgramCache
+from ..plan.nodes import PlanNode
+from ..utils import config
+from .admission import AdmissionController, AdmissionRejected
+from .microbatch import MicroBatcher, batch_key_for
+from .sessions import SessionRegistry, serving_metrics
+
+_UNBOUNDED = float("inf")
+
+
+class SchedulerClosed(RuntimeError):
+    """push() after close(): the frontend translates this into an
+    AdmissionRejected at the front door."""
+
+
+@dataclasses.dataclass
+class QueryTicket:
+    """One admitted query waiting for dispatch."""
+
+    seq: int
+    tenant_id: str
+    plan: PlanNode                    # dict-literal-resolved
+    table: Table
+    batch_key: Tuple
+    priority: int
+    enqueued_at: float
+    deadline_snap: Optional[Tuple]    # watchdog.Deadline.snapshot()
+    estimate_bytes: int
+    future: Future
+
+    @property
+    def expires_at(self) -> float:
+        return (_UNBOUNDED if self.deadline_snap is None
+                else self.deadline_snap[1])
+
+
+class ServingScheduler:
+    """The priority queue (module doc). Bounded waits only: a closed or
+    repopulated queue is always noticed within one poll."""
+
+    _POLL_S = 0.05
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[QueryTicket] = []
+        self._closed = False
+        self.peak_depth = 0
+
+    def push(self, ticket: QueryTicket) -> None:
+        with self._cv:
+            if self._closed:
+                raise SchedulerClosed("serving scheduler is closed")
+            self._queue.append(ticket)
+            if len(self._queue) > self.peak_depth:
+                self.peak_depth = len(self._queue)
+            self._cv.notify_all()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self) -> None:
+        """Stop accepting; queued tickets still drain through pop_group
+        (window waits are skipped so the flush is prompt)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def _effective_key(self, t: QueryTicket, now: float,
+                       age_step: float) -> Tuple:
+        aged = t.priority
+        if age_step > 0:
+            aged -= int((now - t.enqueued_at) / age_step)
+        return (max(0, aged), t.expires_at, t.seq)
+
+    def pop_group(self, window_s: float,
+                  max_batch: int) -> Optional[List[QueryTicket]]:
+        """Block until a dispatch group is ready; None once closed AND
+        empty (the dispatcher's exit signal)."""
+        age_step = float(config.get("serving.age_step_s"))
+        with self._cv:
+            while True:
+                if not self._queue:
+                    if self._closed:
+                        return None
+                    self._cv.wait(timeout=self._POLL_S)
+                    continue
+                now = time.monotonic()
+                head = min(self._queue,
+                           key=lambda t: self._effective_key(
+                               t, now, age_step))
+                mates = sorted(
+                    (t for t in self._queue
+                     if t.batch_key == head.batch_key),
+                    key=lambda t: t.seq)[:max(1, max_batch)]
+                window_end = head.enqueued_at + max(0.0, window_s)
+                if (len(mates) < max_batch and not self._closed
+                        and now < window_end):
+                    # wait out the rest of the batching window for
+                    # mates — bounded, and re-evaluated on every arrival
+                    self._cv.wait(
+                        timeout=min(window_end - now, self._POLL_S))
+                    continue
+                for t in mates:
+                    self._queue.remove(t)
+                return mates
+
+    def drain_remaining(self) -> List[QueryTicket]:
+        """Take everything (used only for forced teardown paths)."""
+        with self._cv:
+            out, self._queue = self._queue, []
+            return out
+
+
+class ServingFrontend:
+    """admission -> schedule -> microbatch -> guarded dispatch, end to
+    end (docs/ARCHITECTURE.md "Serving tier"). One instance per process
+    is the expected shape; tests run many isolated ones."""
+
+    def __init__(self, registry: Optional[SessionRegistry] = None,
+                 executor: Optional[TaskExecutor] = None,
+                 cache: Optional[ProgramCache] = None):
+        self.registry = registry if registry is not None \
+            else SessionRegistry()
+        self.admission = AdmissionController(self.registry)
+        self.scheduler = ServingScheduler()
+        self._batcher = MicroBatcher(cache)
+        self._executor = executor if executor is not None else TaskExecutor()
+        self._own_executor = executor is None
+        self._seq = itertools.count()
+        self._state_lock = threading.Lock()
+        self._draining = False
+        self._drained: Optional[Dict[str, Any]] = None
+        self._lanes = max(1, int(config.get("serving.dispatch_lanes")))
+        self._dispatchers = [
+            threading.Thread(target=self._dispatch_loop, args=(lane,),
+                             name=f"serving-dispatch-{lane}", daemon=True)
+            for lane in range(self._lanes)]
+        self.registry.install_rmm_listener()
+        for th in self._dispatchers:
+            th.start()
+
+    # -- tenant management ---------------------------------------------------
+
+    def register_tenant(self, tenant_id: str, **limits):
+        return self.registry.register_tenant(tenant_id, **limits)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, tenant_id: str, plan: PlanNode, table: Table,
+               budget_s: Optional[float] = None) -> Future:
+        """Admit one query and return its Future.
+
+        Every submit establishes a Deadline (SRJT013): ``budget_s`` arms
+        an explicit one, otherwise the caller's active Deadline (or the
+        ``watchdog.default_budget_s`` implicit one) is adopted — its
+        snapshot rides the ticket so queue time counts against the
+        budget and EDF can order by real expiry."""
+        serving_metrics.inc("submitted")
+        estimate = 2 * table.device_nbytes()
+        ctx = (watchdog.Deadline(budget_s, f"serving:{tenant_id}")
+               if budget_s else
+               watchdog.ensure_deadline(f"serving:{tenant_id}"))
+        with ctx:
+            dl = watchdog.current_deadline()
+            snap = dl.snapshot() if dl is not None else None
+            with self._state_lock:
+                draining = self._draining
+            self.admission.admit(tenant_id, estimate,
+                                 self.scheduler.depth(), draining)
+            plan, bkey = batch_key_for(plan, table)
+            seq = next(self._seq)
+            if bkey is None:
+                bkey = ("solo", seq)   # unsupported input: never groups
+            tenant = self.registry.get(tenant_id)
+            ticket = QueryTicket(
+                seq=seq, tenant_id=tenant_id, plan=plan, table=table,
+                batch_key=bkey, priority=tenant.priority,
+                enqueued_at=time.monotonic(), deadline_snap=snap,
+                estimate_bytes=estimate, future=Future())
+            try:
+                self.scheduler.push(ticket)
+            except SchedulerClosed:
+                # drain won the race after admission charged the slot:
+                # roll the charge back without touching outcome counters
+                self.registry.release(tenant_id, estimate, completed=None)
+                serving_metrics.inc("rejected")
+                self.registry.count(tenant_id, "rejected")
+                raise AdmissionRejected(
+                    "draining", 0.0, tenant_id,
+                    "serving frontend drained during submit") from None
+            return ticket.future
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch_loop(self, lane: int) -> None:
+        while True:
+            window_s = float(config.get("serving.batch_window_ms")) / 1000.0
+            max_batch = max(1, int(config.get("serving.max_batch")))
+            group = self.scheduler.pop_group(window_s, max_batch)
+            if group is None:
+                return                      # closed and empty: lane done
+            ready: List[QueryTicket] = []
+            now = time.monotonic()
+            for t in group:
+                if t.expires_at <= now:
+                    # expired while queued: its budget is gone (queue
+                    # time counts) — fail fast, never dispatch
+                    serving_metrics.inc("expired_in_queue")
+                    self._finish(t, None, watchdog.DeadlineExceededError(
+                        f"serving:{t.tenant_id}",
+                        t.deadline_snap[0]), missed=True)
+                else:
+                    ready.append(t)
+            if not ready:
+                continue
+            fut = self._executor.submit(lane, self._run_group, ready)
+            while True:
+                try:
+                    fut.result(timeout=0.5)   # bounded: lost-worker path
+                    break                     # resolves the future itself
+                except FutureTimeout:
+                    continue
+                except BaseException as e:  # noqa: BLE001 — to futures
+                    for t in ready:
+                        if not t.future.done():
+                            self._finish(t, None, e)
+                    break
+
+    def _run_group(self, group: List[QueryTicket]) -> None:
+        """Lane-worker body: attribute the dispatch thread's RmmSpark
+        reservations to the member tenants, execute (batched when the
+        group has mates), scatter outcomes."""
+        total = sum(t.estimate_bytes for t in group) or 1
+        shares = [(t.tenant_id, t.estimate_bytes / total) for t in group]
+        with self.registry.attributed(shares):
+            outcomes = self._batcher.execute_group(
+                [t.plan for t in group],
+                [t.table for t in group],
+                [t.deadline_snap for t in group])
+        now = time.monotonic()
+        for t, out in zip(group, outcomes):
+            if out.error is not None:
+                self._finish(t, None, out.error,
+                             missed=t.expires_at <= now)
+            else:
+                if out.replayed_solo:
+                    self.registry.count(t.tenant_id, "faults_isolated")
+                self._finish(t, out.table, None,
+                             missed=t.expires_at <= now)
+
+    def _finish(self, t: QueryTicket, table: Optional[Table],
+                error: Optional[BaseException], missed: bool = False):
+        if missed:
+            serving_metrics.inc("deadline_missed")
+            self.registry.count(t.tenant_id, "deadline_missed")
+        self.registry.release(t.tenant_id, t.estimate_bytes,
+                              completed=error is None)
+        if error is None:
+            serving_metrics.inc("completed")
+            if not t.future.done():
+                t.future.set_result(table)
+        else:
+            serving_metrics.inc("failed")
+            if not t.future.done():
+                t.future.set_exception(error)
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful frontend drain: stop admission, flush the queue (no
+        window waits), join the lanes, drain the TaskExecutor, release
+        the RmmSpark listener. Idempotent; verdict mirrors the
+        executor's."""
+        if timeout is None:
+            timeout = float(config.get("drain.timeout_s"))
+        with self._state_lock:
+            if self._draining and self._drained is not None:
+                out = dict(self._drained)
+                out["already_closed"] = True
+                return out
+            self._draining = True
+        self.scheduler.close()
+        t0 = time.monotonic()
+        lane_stragglers = 0
+        for th in self._dispatchers:
+            th.join(watchdog.derive_timeout(timeout))
+            if th.is_alive():
+                lane_stragglers += 1
+        executor_verdict = (self._executor.drain(timeout=timeout)
+                            if self._own_executor else None)
+        self.registry.uninstall_rmm_listener()
+        # anything still queued had no lane left to run it (stragglers
+        # wedged): fail it with the same typed front-door error
+        orphaned = 0
+        for t in self.scheduler.drain_remaining():
+            orphaned += 1
+            self._finish(t, None, AdmissionRejected(
+                "draining", 0.0, t.tenant_id,
+                "serving frontend drained before dispatch"))
+        verdict = {
+            "clean": (lane_stragglers == 0 and orphaned == 0
+                      and (executor_verdict is None
+                           or executor_verdict["clean"])),
+            "already_closed": False,
+            "lane_stragglers": lane_stragglers,
+            "orphaned": orphaned,
+            "executor": executor_verdict,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+        with self._state_lock:
+            self._drained = verdict
+        return verdict
+
+    def close(self) -> None:
+        self.drain()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

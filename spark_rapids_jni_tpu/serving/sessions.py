@@ -1,0 +1,248 @@
+"""Tenant sessions: registry, per-tenant HBM budgets, per-tenant counters.
+
+A *tenant* is one isolation domain of the serving tier — one user, one
+Spark application, one priority class. The registry is the single place
+tenancy state lives:
+
+* **HBM budgets.** Every admitted query charges its reservation estimate
+  (the same 2x-input envelope the plan executor reserves through
+  ``device_reservation``) against its tenant before dispatch and releases
+  it on completion; admission (admission.py) rejects a query whose charge
+  would exceed ``hbm_budget_bytes``. On top of the estimate ledger, the
+  registry attributes RmmSpark's *observed* per-thread allocation
+  tracking (memory/rmm_spark.py ``set_alloc_listener``) to tenants: while
+  a dispatch lane executes a batch, the lane thread is bound to the
+  member tenants (weighted by their estimate share), so real reservation
+  traffic lands on ``hbm_observed_bytes`` / ``hbm_peak_bytes`` per
+  tenant — the enforcement estimate and the observed truth are both
+  visible in ``snapshot()``.
+
+* **Counters.** admitted / rejected / completed / failed /
+  deadline_missed / faults_isolated per tenant, mirroring the reference's
+  per-task accounting in RmmSpark.java but keyed by tenant.
+
+Thread-safety: one leaf lock guards all registry state; the RmmSpark
+listener callback runs outside RmmSpark's ledger lock by contract, so
+registry -> ledger ordering never occurs and the lock graph stays acyclic.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..memory.rmm_spark import RmmSpark
+from ..utils import config
+
+_COUNTERS = ("admitted", "rejected", "completed", "failed",
+             "deadline_missed", "faults_isolated")
+
+
+class ServingMetrics:
+    """Process-wide serving counters, ``inc``-named like PlanMetrics on
+    purpose: SRJT008 reserves ``.bump`` for the fault domain's fixed
+    vocabulary; serving counters are their own surface (bench rows,
+    tests)."""
+
+    _FIELDS = ("submitted", "admitted", "rejected", "completed", "failed",
+               "deadline_missed", "expired_in_queue", "dispatches",
+               "batches", "batched_queries", "solo_dispatches",
+               "batch_fault_replays", "overflow_replays")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c = {k: 0 for k in self._FIELDS}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[name] += by
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+
+serving_metrics = ServingMetrics()
+
+
+class Tenant:
+    """One tenant's registered limits and live accounting. Mutable fields
+    are guarded by the owning registry's lock — read them through
+    ``SessionRegistry.snapshot()`` / ``stats_of()``."""
+
+    def __init__(self, tenant_id: str, priority: int, max_in_flight: int,
+                 hbm_budget_bytes: int):
+        self.tenant_id = tenant_id
+        self.priority = priority
+        self.max_in_flight = max_in_flight
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.in_flight = 0
+        self.hbm_reserved_bytes = 0   # estimate ledger (enforced)
+        self.hbm_observed_bytes = 0   # RmmSpark per-thread attribution
+        self.hbm_peak_bytes = 0
+        self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+
+
+class SessionRegistry:
+    """Tenant registry + the estimate/observed HBM ledgers (module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Tenant] = {}
+        # RmmSpark tid -> [(tenant_id, weight)] while a dispatch runs
+        self._thread_shares: Dict[int, List[Tuple[str, float]]] = {}
+        self._listener_installed = False
+
+    # -- registration --------------------------------------------------------
+
+    def register_tenant(self, tenant_id: str,
+                        priority: Optional[int] = None,
+                        max_in_flight: Optional[int] = None,
+                        hbm_budget_bytes: Optional[int] = None) -> Tenant:
+        """Create (or re-declare) a tenant. Omitted limits fall back to
+        the ``serving.*`` config defaults; ``hbm_budget_bytes=0`` means
+        unlimited."""
+        if priority is None:
+            priority = int(config.get("serving.default_priority"))
+        if max_in_flight is None:
+            max_in_flight = int(config.get("serving.tenant_max_in_flight"))
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = int(
+                config.get("serving.default_hbm_budget_bytes"))
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None:
+                t = Tenant(tenant_id, priority, max_in_flight,
+                           hbm_budget_bytes)
+                self._tenants[tenant_id] = t
+            else:
+                t.priority = priority
+                t.max_in_flight = max_in_flight
+                t.hbm_budget_bytes = hbm_budget_bytes
+            return t
+
+    def get(self, tenant_id: str) -> Optional[Tenant]:
+        with self._lock:
+            return self._tenants.get(tenant_id)
+
+    def tenant_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    # -- counters / ledgers --------------------------------------------------
+
+    def count(self, tenant_id: str, field: str, by: int = 1) -> None:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is not None:
+                t.counters[field] += by
+
+    def try_admit(self, tenant_id: str, estimate_bytes: int) -> Optional[str]:
+        """Atomically validate the tenant's limits and, on success, take
+        an in-flight slot and charge ``estimate_bytes`` to the estimate
+        ledger. Returns None when admitted, else the rejection reason
+        (``unknown_tenant`` / ``tenant_in_flight`` / ``hbm_budget``) with
+        the tenant's rejected counter already bumped."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None:
+                return "unknown_tenant"
+            if t.max_in_flight > 0 and t.in_flight >= t.max_in_flight:
+                t.counters["rejected"] += 1
+                return "tenant_in_flight"
+            if (t.hbm_budget_bytes > 0
+                    and t.hbm_reserved_bytes + estimate_bytes
+                    > t.hbm_budget_bytes):
+                t.counters["rejected"] += 1
+                return "hbm_budget"
+            t.in_flight += 1
+            t.hbm_reserved_bytes += estimate_bytes
+            t.counters["admitted"] += 1
+            return None
+
+    def release(self, tenant_id: str, nbytes: int,
+                completed: Optional[bool] = True) -> None:
+        """Release a completed/failed query's estimate and retire its
+        in-flight slot. ``completed=None`` is the admission-rollback
+        mode (drain won the race after try_admit charged the slot):
+        undo the charge without recording an outcome."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None:
+                return
+            t.hbm_reserved_bytes = max(0, t.hbm_reserved_bytes - nbytes)
+            t.in_flight = max(0, t.in_flight - 1)
+            if completed is not None:
+                t.counters["completed" if completed else "failed"] += 1
+
+    def stats_of(self, tenant_id: str) -> Dict[str, Any]:
+        with self._lock:
+            t = self._tenants[tenant_id]
+            out: Dict[str, Any] = dict(t.counters)
+            out.update(in_flight=t.in_flight,
+                       hbm_reserved_bytes=t.hbm_reserved_bytes,
+                       hbm_observed_bytes=t.hbm_observed_bytes,
+                       hbm_peak_bytes=t.hbm_peak_bytes)
+            return out
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            ids = sorted(self._tenants)
+        return {tid: self.stats_of(tid) for tid in ids}
+
+    # -- RmmSpark per-thread attribution -------------------------------------
+
+    def install_rmm_listener(self) -> None:
+        """Attribute RmmSpark's per-thread reservation tracking to tenants
+        for as long as this registry serves (idempotent; frontends call it
+        at start and ``uninstall_rmm_listener`` at drain)."""
+        with self._lock:
+            if self._listener_installed:
+                return
+            self._listener_installed = True
+        RmmSpark.set_alloc_listener(self._on_alloc)
+
+    def uninstall_rmm_listener(self) -> None:
+        with self._lock:
+            if not self._listener_installed:
+                return
+            self._listener_installed = False
+        RmmSpark.set_alloc_listener(None)
+
+    def _on_alloc(self, tid: int, delta: int) -> None:
+        """RmmSpark listener (called outside the ledger lock): split the
+        thread's reservation delta across the tenants bound to it."""
+        with self._lock:
+            shares = self._thread_shares.get(tid)
+            if not shares:
+                return
+            for tenant_id, weight in shares:
+                t = self._tenants.get(tenant_id)
+                if t is None:
+                    continue
+                t.hbm_observed_bytes = max(
+                    0, t.hbm_observed_bytes + int(delta * weight))
+                if t.hbm_observed_bytes > t.hbm_peak_bytes:
+                    t.hbm_peak_bytes = t.hbm_observed_bytes
+
+    @contextmanager
+    def attributed(self, shares: Sequence[Tuple[str, float]]):
+        """Bind the calling thread's RmmSpark reservations to ``shares``
+        (tenant_id, weight) for the duration of a dispatch. No-op when no
+        adaptor is installed (the estimate ledger still enforces)."""
+        if not RmmSpark.is_installed():
+            yield
+            return
+        tid = RmmSpark.get_current_thread_id()
+        with self._lock:
+            self._thread_shares[tid] = list(shares)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._thread_shares.pop(tid, None)

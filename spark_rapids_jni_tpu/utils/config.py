@@ -240,6 +240,41 @@ _register("drain.timeout_s", "SRJT_DRAIN_TIMEOUT_S", 30.0, float,
           "deadline for TaskExecutor.drain(): stop admission, run "
           "in-flight tasks to completion, flush+fsync the SpillStore, "
           "stop sandbox workers, report a verdict")
+_register("serving.batch_window_ms", "SRJT_SERVING_BATCH_WINDOW_MS", 4.0,
+          float,
+          "micro-batching window: after a query reaches the head of the "
+          "serving queue the dispatcher waits at most this long for "
+          "fingerprint-compatible batch-mates — the bound on extra p99 "
+          "a query can pay for batching (serving/microbatch.py)")
+_register("serving.max_batch", "SRJT_SERVING_MAX_BATCH", 16, int,
+          "max queries fused into one batched plan program; a full batch "
+          "dispatches immediately without waiting out the window")
+_register("serving.max_queue_depth", "SRJT_SERVING_MAX_QUEUE_DEPTH", 1024,
+          int,
+          "global admission bound on queued-but-undispatched queries; "
+          "beyond it submits raise AdmissionRejected (retry-after set "
+          "from the batching window)")
+_register("serving.tenant_max_in_flight", "SRJT_SERVING_TENANT_MAX_IN_FLIGHT",
+          64, int,
+          "default per-tenant cap on admitted-but-incomplete queries "
+          "(overridable per tenant at register_tenant)")
+_register("serving.default_hbm_budget_bytes", "SRJT_SERVING_HBM_BUDGET",
+          0, int,
+          "default per-tenant HBM budget (0 = unlimited): admission "
+          "rejects a query whose 2x-input reservation estimate would "
+          "push the tenant's in-flight device bytes past its budget")
+_register("serving.age_step_s", "SRJT_SERVING_AGE_STEP_S", 0.25, float,
+          "priority aging quantum: a queued query's effective priority "
+          "improves one level per quantum waited, so background tenants "
+          "cannot starve (0 disables aging)")
+_register("serving.dispatch_lanes", "SRJT_SERVING_DISPATCH_LANES", 2, int,
+          "concurrent dispatch lanes (TaskExecutor task ids) the serving "
+          "frontend multiplexes batches onto; each lane is a dedicated "
+          "RmmSpark-registered worker thread")
+_register("serving.default_priority", "SRJT_SERVING_DEFAULT_PRIORITY", 2,
+          int,
+          "priority assigned to tenants that do not specify one "
+          "(0 = most urgent; larger is more deferrable)")
 
 
 def get(key: str) -> Any:

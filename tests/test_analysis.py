@@ -728,6 +728,69 @@ def test_srjt012_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT013 — serving entry points: Deadline + guarded dispatch only
+# ---------------------------------------------------------------------------
+
+SRC_013_NO_DEADLINE = """
+    def submit_query(plan, table):
+        return _push(plan, table)
+"""
+
+SRC_013_RAW = """
+    import jax
+
+    def _push(x):
+        return jax.device_put(x)
+"""
+
+SRC_013_CLEAN = """
+    import jax
+    from ..faultinj import watchdog
+    from ..faultinj.guard import guarded_dispatch
+
+    def execute_group(prog, cols):
+        with watchdog.Deadline(1.0, "serving:batch"):
+            def run():
+                return jax.device_put(cols)
+            return guarded_dispatch("plan_execute", run)
+
+    def submit_query(plan, table):
+        with watchdog.ensure_deadline("serving:q"):
+            return _push(plan, table)
+"""
+
+
+def test_srjt013_entry_without_deadline_triggers():
+    fs = run(SRC_013_NO_DEADLINE, path="pkg/serving/scheduler.py")
+    assert rules_of(fs) == {"SRJT013"}
+    assert "Deadline" in fs[0].message
+
+
+def test_srjt013_raw_dispatch_triggers():
+    fs = run(SRC_013_RAW, path="pkg/serving/microbatch.py")
+    assert rules_of(fs) == {"SRJT013"}
+    assert "guarded_dispatch" in fs[0].message
+
+
+def test_srjt013_guarded_and_deadlined_is_clean():
+    # guarded thunk exempts both its body (raw dispatch) and its own name
+    # (entry-point clause); both entry points establish deadlines
+    assert run(SRC_013_CLEAN, path="pkg/serving/microbatch.py") == []
+
+
+def test_srjt013_outside_serving_is_clean():
+    assert run(SRC_013_NO_DEADLINE, path="pkg/parallel/task_executor.py") == []
+    assert run(SRC_013_RAW, path="pkg/plan/executor.py") == []
+
+
+def test_srjt013_noqa():
+    assert run(SRC_013_RAW.replace(
+        "return jax.device_put(x)",
+        "return jax.device_put(x)  # srjt: noqa[SRJT013]"),
+        path="pkg/serving/microbatch.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -747,7 +810,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 12
+    assert len(FILE_RULES) == 13
 
 
 def test_syntax_error_is_reported_not_raised():

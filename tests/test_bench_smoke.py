@@ -110,6 +110,7 @@ def test_every_sweep_axis_function_runs_small():
         (lambda: B.bench_tpch_q6(2048), "q6"),
         (lambda: B.bench_dict_filter_strings(2048), "dict_filter"),
         (lambda: B.bench_dict_groupby_strings(2048), "dict_groupby"),
+        (lambda: B.bench_serving_qps_mixed(24), "serving_qps_mixed"),
     ]
     for fn, name in small:
         sec, nbytes = fn()

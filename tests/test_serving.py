@@ -1,0 +1,453 @@
+"""Serving-tier tests: bit-identity of batched execution, admission
+control at every limit, EDF + priority-aging scheduling, per-tenant HBM
+budgets, fault-storm tenant isolation, and clean drain mid-load.
+
+The deterministic fault recipes pin ``faultinj.max_poison_redispatch`` to
+0 so the FIRST injected trap surfaces as ``ProgramPoisonedError`` with no
+in-guard redispatch: an ``interceptionCount`` of N then fails exactly the
+batched dispatch plus the first N-1 solo replays — cross-tenant isolation
+becomes an exact assertion, not a statistical one.
+"""
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.dictionary import encode_strings
+from spark_rapids_jni_tpu.faultinj import breaker, install, uninstall, watchdog
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.plan import expr as ex
+from spark_rapids_jni_tpu.plan.executor import execute_plan
+from spark_rapids_jni_tpu.plan.nodes import (Filter, GroupBy, Limit, Project,
+                                             Scan, Sort)
+from spark_rapids_jni_tpu.serving import (AdmissionController,
+                                          AdmissionRejected, MicroBatcher,
+                                          QueryTicket, ServingFrontend,
+                                          ServingScheduler, SessionRegistry,
+                                          batch_key_for, serving_metrics)
+from spark_rapids_jni_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    serving_metrics.reset()
+    breaker.reset_all()
+    yield
+    uninstall()
+    breaker.reset_all()
+    watchdog.reset()
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def make_table(n, seed, nulls=False):
+    rng = np.random.default_rng(seed)
+    a = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 7, n, dtype=np.int64)))
+    bval = (jnp.asarray(rng.random(n) > 0.3) if nulls else None)
+    b = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 1000, n, dtype=np.int64)), validity=bval)
+    return Table((a, b))
+
+
+def make_dict_table(n, seed):
+    rng = np.random.default_rng(seed)
+    words = ["aa", "bb", "cc", "dd"]
+    sc = Column.from_pylist([words[i] for i in rng.integers(0, 4, n)],
+                            dt.STRING)
+    v = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 50, n, dtype=np.int64)))
+    return Table((encode_strings(sc), v))
+
+
+PLAN_FILTER = Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(4)))
+PLAN_GROUPBY = GroupBy(Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(5))),
+                       (0,), ((1, "sum"), (1, "count")))
+PLAN_SORTLIM = Limit(Sort(Project(Scan(2), (
+    ex.Col(0), ex.BinOp("add", ex.Col(1), ex.Lit(1)))), (0, 1)), 10)
+PLAN_DICT = GroupBy(Filter(Scan(2), ex.BinOp("ne", ex.Col(0), ex.Lit("bb"))),
+                    (0,), ((1, "sum"),))
+
+
+def assert_cols_bit_identical(ca: Column, cb: Column, what=""):
+    assert np.array_equal(np.asarray(ca.data), np.asarray(cb.data)), what
+    va = (None if ca.validity is None else np.asarray(ca.validity))
+    vb = (None if cb.validity is None else np.asarray(cb.validity))
+    if va is None or vb is None:
+        assert bool((va if va is not None else vb) is None
+                    or (va if va is not None else vb).all()), what
+    else:
+        assert np.array_equal(va, vb), what
+    assert len(ca.children) == len(cb.children), what
+    for i, (ka, kb) in enumerate(zip(ca.children, cb.children)):
+        assert_cols_bit_identical(ka, kb, f"{what} child {i}")
+
+
+def assert_tables_bit_identical(a: Table, b: Table):
+    assert a.num_rows == b.num_rows
+    assert a.num_columns == b.num_columns
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        assert_cols_bit_identical(ca, cb, f"col {i}")
+
+
+def run_group(plan, tables):
+    """Route a compatible group through the MicroBatcher directly
+    (deterministic batching, no window timing)."""
+    plans, keys = [], []
+    for t in tables:
+        p, k = batch_key_for(plan, t)
+        plans.append(p)
+        keys.append(k)
+    assert all(k == keys[0] and k is not None for k in keys), keys
+    return plans, MicroBatcher().execute_group(
+        plans, tables, [None] * len(tables))
+
+
+# -- bit-identity: batched vs solo -------------------------------------------
+
+
+@pytest.mark.parametrize("plan", [PLAN_FILTER, PLAN_GROUPBY, PLAN_SORTLIM],
+                         ids=["filter", "groupby", "sort_limit"])
+def test_batched_bit_identical(plan):
+    tables = [make_table(900, s) for s in range(4)]
+    plans, outs = run_group(plan, tables)
+    assert serving_metrics.snapshot()["batches"] == 1
+    for p, t, o in zip(plans, tables, outs):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, execute_plan(p, t))
+
+
+def test_batched_bit_identical_with_nulls():
+    tables = [make_table(700, 10 + s, nulls=True) for s in range(3)]
+    plans, outs = run_group(PLAN_GROUPBY, tables)
+    for p, t, o in zip(plans, tables, outs):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, execute_plan(p, t))
+
+
+def test_batched_bit_identical_dict32():
+    tables = [make_dict_table(500, 20 + s) for s in range(3)]
+    plans, outs = run_group(PLAN_DICT, tables)
+    for p, t, o in zip(plans, tables, outs):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, execute_plan(p, t))
+
+
+def test_batched_mixed_row_counts_share_bucket():
+    # 600 and 1000 rows both bucket to 1024: one fused dispatch
+    tables = [make_table(600, 30), make_table(1000, 31), make_table(1, 32)]
+    plans, outs = run_group(PLAN_FILTER, tables)
+    assert serving_metrics.snapshot()["batches"] == 1
+    for p, t, o in zip(plans, tables, outs):
+        assert_tables_bit_identical(o.table, execute_plan(p, t))
+
+
+def test_batch_key_discriminates():
+    p1, k1 = batch_key_for(PLAN_FILTER, make_table(800, 1))
+    _, k2 = batch_key_for(PLAN_FILTER, make_table(900, 2))
+    _, k3 = batch_key_for(PLAN_GROUPBY, make_table(800, 1))
+    _, k4 = batch_key_for(PLAN_FILTER, make_table(3000, 1))  # other bucket
+    assert k1 == k2
+    assert k1 != k3 and k1 != k4
+    # unsupported input (empty table) never batches
+    empty = Table((Column(dt.INT64, 0, data=jnp.zeros((0,), jnp.int64)),
+                   Column(dt.INT64, 0, data=jnp.zeros((0,), jnp.int64))))
+    _, k5 = batch_key_for(PLAN_FILTER, empty)
+    assert k5 is None
+
+
+# -- admission control --------------------------------------------------------
+
+
+def _registry(**limits):
+    reg = SessionRegistry()
+    reg.register_tenant("t0", **limits)
+    return reg
+
+
+def test_admission_queue_full():
+    ctrl = AdmissionController(_registry())
+    with config.override("serving.max_queue_depth", 4):
+        ctrl.admit("t0", 100, queue_depth=3)
+        with pytest.raises(AdmissionRejected) as ei:
+            ctrl.admit("t0", 100, queue_depth=4)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s > 0
+
+
+def test_admission_tenant_in_flight_cap():
+    reg = _registry(max_in_flight=1)
+    ctrl = AdmissionController(reg)
+    ctrl.admit("t0", 100, queue_depth=0)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit("t0", 100, queue_depth=0)
+    assert ei.value.reason == "tenant_in_flight"
+    reg.release("t0", 100)
+    ctrl.admit("t0", 100, queue_depth=0)  # slot freed: admitted again
+
+
+def test_admission_hbm_budget():
+    reg = _registry(hbm_budget_bytes=1000)
+    ctrl = AdmissionController(reg)
+    ctrl.admit("t0", 600, queue_depth=0)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit("t0", 600, queue_depth=0)
+    assert ei.value.reason == "hbm_budget"
+    assert reg.stats_of("t0")["hbm_reserved_bytes"] == 600
+    reg.release("t0", 600)
+    ctrl.admit("t0", 600, queue_depth=0)
+    assert reg.stats_of("t0")["rejected"] == 1
+
+
+def test_admission_unknown_tenant():
+    ctrl = AdmissionController(SessionRegistry())
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit("ghost", 1, queue_depth=0)
+    assert ei.value.reason == "unknown_tenant"
+    assert ei.value.retry_after_s == 0.0
+
+
+def test_admission_sheds_when_breaker_open():
+    """An open plan_execute breaker rejects at the FRONT DOOR with the
+    cooldown as the retry-after hint — and without consuming the
+    breaker's half-open probe slot."""
+    ctrl = AdmissionController(_registry())
+    br = breaker.get_breaker("plan_execute")
+    with config.override("breaker.threshold", 1):
+        br.record_failure()
+    assert br.state() == breaker.OPEN
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit("t0", 100, queue_depth=0)
+    assert ei.value.reason == "breaker_open"
+    assert ei.value.retry_after_s > 0
+    assert br.state() == breaker.OPEN  # state read only: no probe consumed
+
+
+# -- scheduling: EDF within priority, aging across ----------------------------
+
+
+def _ticket(seq, priority, enqueued_at, expires_at=None, key=None):
+    snap = None if expires_at is None else (30.0, expires_at, None, "t")
+    from concurrent.futures import Future
+    return QueryTicket(seq=seq, tenant_id="t0", plan=None, table=None,
+                       batch_key=key if key is not None else ("k", seq),
+                       priority=priority, enqueued_at=enqueued_at,
+                       deadline_snap=snap, estimate_bytes=1, future=Future())
+
+
+def test_edf_within_priority():
+    s = ServingScheduler()
+    now = time.monotonic()
+    s.push(_ticket(0, 2, now, expires_at=now + 60))
+    s.push(_ticket(1, 2, now, expires_at=now + 5))   # tightest deadline
+    s.push(_ticket(2, 2, now, expires_at=now + 30))
+    order = [s.pop_group(0.0, 1)[0].seq for _ in range(3)]
+    assert order == [1, 2, 0]
+
+
+def test_priority_beats_later_deadline():
+    s = ServingScheduler()
+    now = time.monotonic()
+    s.push(_ticket(0, 3, now, expires_at=now + 1))    # urgent but low class
+    s.push(_ticket(1, 0, now, expires_at=now + 60))   # high class wins
+    assert s.pop_group(0.0, 1)[0].seq == 1
+
+
+def test_priority_aging_prevents_starvation():
+    s = ServingScheduler()
+    now = time.monotonic()
+    with config.override("serving.age_step_s", 0.05):
+        # seq 0, class 1, fresh: would beat class 5 forever without aging
+        s.push(_ticket(0, 1, now))
+        # seq 1, class 5, waited 1s: aged 20 steps -> effective class 0
+        s.push(_ticket(1, 5, now - 1.0))
+        assert s.pop_group(0.0, 1)[0].seq == 1
+        assert s.pop_group(0.0, 1)[0].seq == 0
+
+
+def test_batch_window_bounds_wait_and_close_flushes():
+    s = ServingScheduler()
+    now = time.monotonic()
+    s.push(_ticket(0, 2, now, key=("shared",)))
+    t0 = time.monotonic()
+    got = s.pop_group(0.05, 4)       # alone: waits only the window out
+    assert [t.seq for t in got] == [0]
+    assert time.monotonic() - t0 < 1.0
+    # closed: flush immediately even with a huge window, then report None
+    s.push(_ticket(1, 2, time.monotonic(), key=("shared",)))
+    s.push(_ticket(2, 2, time.monotonic(), key=("shared",)))
+    s.close()
+    t0 = time.monotonic()
+    got = s.pop_group(30.0, 4)
+    assert sorted(t.seq for t in got) == [1, 2]
+    assert time.monotonic() - t0 < 1.0
+    assert s.pop_group(30.0, 4) is None
+    with pytest.raises(Exception):
+        s.push(_ticket(3, 2, time.monotonic()))
+
+
+def test_rmm_attribution_splits_by_share():
+    reg = SessionRegistry()
+    reg.register_tenant("a")
+    reg.register_tenant("b")
+    reg._thread_shares[42] = [("a", 0.75), ("b", 0.25)]
+    reg._on_alloc(42, 1000)
+    reg._on_alloc(42, -400)
+    assert reg.stats_of("a")["hbm_observed_bytes"] == 450
+    assert reg.stats_of("a")["hbm_peak_bytes"] == 750
+    assert reg.stats_of("b")["hbm_observed_bytes"] == 150
+    assert reg.stats_of("b")["hbm_peak_bytes"] == 250
+
+
+# -- frontend end-to-end ------------------------------------------------------
+
+
+def test_frontend_batches_and_is_bit_identical():
+    tables = [make_table(800, 40 + s) for s in range(6)]
+    baselines = [execute_plan(batch_key_for(PLAN_GROUPBY, t)[0], t)
+                 for t in tables]
+    with config.override("serving.batch_window_ms", 250.0), \
+            ServingFrontend() as fe:
+        fe.register_tenant("alpha", priority=1)
+        fe.register_tenant("beta", priority=3)
+        futs = [fe.submit("alpha" if i % 2 else "beta", PLAN_GROUPBY, t,
+                          budget_s=60.0)
+                for i, t in enumerate(tables)]
+        for f, want in zip(futs, baselines):
+            assert_tables_bit_identical(f.result(timeout=120), want)
+        v = fe.drain()
+    assert v["clean"]
+    m = serving_metrics.snapshot()
+    assert m["completed"] == 6 and m["failed"] == 0
+    assert m["batched_queries"] >= 2          # grouping actually happened
+    assert m["dispatches"] < 6                # fewer dispatches than queries
+
+
+def test_frontend_hbm_budget_rejects_at_submit():
+    with ServingFrontend() as fe:
+        fe.register_tenant("tiny", hbm_budget_bytes=64)
+        with pytest.raises(AdmissionRejected) as ei:
+            fe.submit("tiny", PLAN_FILTER, make_table(1000, 50))
+        assert ei.value.reason == "hbm_budget"
+        assert fe.registry.stats_of("tiny")["rejected"] == 1
+
+
+def test_frontend_submit_after_drain_rejected():
+    fe = ServingFrontend()
+    fe.register_tenant("t0")
+    assert fe.drain()["clean"]
+    with pytest.raises(AdmissionRejected) as ei:
+        fe.submit("t0", PLAN_FILTER, make_table(100, 51))
+    assert ei.value.reason == "draining"
+    # idempotent drain
+    assert fe.drain()["already_closed"]
+
+
+def test_clean_drain_mid_load():
+    tables = [make_table(600, 60 + s) for s in range(12)]
+    with config.override("serving.batch_window_ms", 100.0):
+        fe = ServingFrontend()
+        fe.register_tenant("a", priority=1)
+        fe.register_tenant("b", priority=2)
+        futs = []
+        rejected = 0
+        for i, t in enumerate(tables):
+            try:
+                futs.append(fe.submit("a" if i % 2 else "b", PLAN_FILTER, t,
+                                      budget_s=60.0))
+            except AdmissionRejected:
+                rejected += 1
+        v = fe.drain()      # mid-load: queue still has windowed groups
+    assert v["clean"], v
+    done = sum(1 for f in futs if f.done())
+    assert done == len(futs)    # every admitted query resolved, none lost
+    m = serving_metrics.snapshot()
+    assert m["completed"] + m["failed"] == len(futs)
+    assert m["failed"] == 0
+
+
+# -- fault isolation ----------------------------------------------------------
+
+
+def _trap_cfg(tmp_path, count):
+    p = tmp_path / "serving_faults.json"
+    p.write_text(json.dumps({"xlaRuntimeFaults": {
+        "plan_execute": {"percent": 100, "injectionType": 0,
+                         "interceptionCount": count}}}))
+    return str(p)
+
+
+def test_batch_fault_isolated_all_mates_survive(tmp_path):
+    """POISON on the batched dispatch: every member replays solo and
+    succeeds bit-identically — one tenant's fault fails nobody else."""
+    tables = [make_table(512, 70 + s) for s in range(3)]
+    plans = [batch_key_for(PLAN_GROUPBY, t)[0] for t in tables]
+    baselines = [execute_plan(p, t) for p, t in zip(plans, tables)]
+    install(_trap_cfg(tmp_path, 1), seed=0)
+    with config.override("faultinj.max_poison_redispatch", 0):
+        outs = MicroBatcher().execute_group(plans, tables, [None] * 3)
+    for o, want in zip(outs, baselines):
+        assert o.error is None
+        assert o.replayed_solo
+        assert_tables_bit_identical(o.table, want)
+    assert serving_metrics.snapshot()["batch_fault_replays"] == 3
+
+
+def test_batch_fault_fails_only_the_poisoned_member(tmp_path):
+    """Second interception lands on the first solo replay: exactly that
+    member fails, its batch-mates stay bit-identical."""
+    tables = [make_table(512, 80 + s) for s in range(3)]
+    plans = [batch_key_for(PLAN_GROUPBY, t)[0] for t in tables]
+    baselines = [execute_plan(p, t) for p, t in zip(plans, tables)]
+    install(_trap_cfg(tmp_path, 2), seed=0)
+    with config.override("faultinj.max_poison_redispatch", 0):
+        outs = MicroBatcher().execute_group(plans, tables, [None] * 3)
+    assert outs[0].error is not None        # the poisoned member
+    for o, want in zip(outs[1:], baselines[1:]):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, want)
+
+
+@pytest.mark.chaos
+def test_fault_storm_zero_cross_tenant_propagation(tmp_path):
+    """Storm across a mixed 3-tenant load: N injected traps can fail at
+    most N-1 queries (the first trap hits a batched dispatch, which fails
+    NO query — it triggers solo replays), and every surviving query is
+    bit-identical to its solo baseline."""
+    tables = [make_table(512, 90 + s) for s in range(12)]
+    plans_base = [batch_key_for(PLAN_GROUPBY, t)[0] for t in tables]
+    baselines = [execute_plan(p, t) for p, t in zip(plans_base, tables)]
+    traps = 4
+    install(_trap_cfg(tmp_path, traps), seed=0)
+    tenants = ["a", "b", "c"]
+    with config.override("faultinj.max_poison_redispatch", 0), \
+            config.override("breaker.threshold", 100), \
+            config.override("serving.batch_window_ms", 150.0), \
+            ServingFrontend() as fe:
+        for name in tenants:
+            fe.register_tenant(name)
+        futs = [fe.submit(tenants[i % 3], PLAN_GROUPBY, t, budget_s=120.0)
+                for i, t in enumerate(tables)]
+        failed, ok = 0, 0
+        for f, want in zip(futs, baselines):
+            try:
+                got = f.result(timeout=240)
+            except Exception:
+                failed += 1
+            else:
+                ok += 1
+                assert_tables_bit_identical(got, want)
+        assert fe.drain()["clean"]
+    assert failed <= traps, (failed, traps)   # no fault amplification
+    assert ok == len(tables) - failed
+    m = serving_metrics.snapshot()
+    assert m["batch_fault_replays"] > 0       # the storm actually stormed
+    isolated = sum(fe.registry.stats_of(t)["faults_isolated"]
+                   for t in tenants)
+    assert isolated > 0

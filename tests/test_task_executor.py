@@ -107,10 +107,17 @@ def test_error_propagates_and_executor_survives():
 
 
 def test_closed_executor_rejects_submits():
+    """Regression: a submit after drain()/close() must raise the TYPED
+    front-door error (serving.AdmissionRejected) — which still subclasses
+    RuntimeError, so pre-serving callers keep working."""
+    from spark_rapids_jni_tpu.serving import AdmissionRejected
     ex = TaskExecutor()
     ex.close()
-    with pytest.raises(RuntimeError, match="closed"):
+    with pytest.raises(AdmissionRejected, match="closed") as ei:
         ex.submit(1, lambda: 1)
+    assert ei.value.reason == "closed"
+    assert ei.value.retry_after_s == 0.0
+    assert isinstance(ei.value, RuntimeError)
 
 
 def test_lost_worker_releases_rmm_thread_association():
