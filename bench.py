@@ -363,6 +363,18 @@ def axis_table():
         ("from_json_200k", lambda: _B().bench_from_json(200_000), 200_000),
         ("tpch_q6_1m", lambda: _B().bench_tpch_q6(1 << 20), 1 << 20),
         ("tpch_q5_1m", lambda: _B().bench_tpch_q5(1 << 20), 1 << 20),
+        # GSPMD sharded-plan scaling (ROADMAP item 1): the same fused
+        # q1/q6 program across 1/2/4/8 mesh devices; rows carry
+        # devices/sharding columns via pop_extra() and feed the
+        # MULTICHIP_r06.json scaling section
+        ("tpch_q1_sharded_4m_d1", lambda: _B().bench_tpch_q1_sharded(1 << 22, 1), 1 << 22),
+        ("tpch_q1_sharded_4m_d2", lambda: _B().bench_tpch_q1_sharded(1 << 22, 2), 1 << 22),
+        ("tpch_q1_sharded_4m_d4", lambda: _B().bench_tpch_q1_sharded(1 << 22, 4), 1 << 22),
+        ("tpch_q1_sharded_4m_d8", lambda: _B().bench_tpch_q1_sharded(1 << 22, 8), 1 << 22),
+        ("tpch_q6_sharded_4m_d1", lambda: _B().bench_tpch_q6_sharded(1 << 22, 1), 1 << 22),
+        ("tpch_q6_sharded_4m_d2", lambda: _B().bench_tpch_q6_sharded(1 << 22, 2), 1 << 22),
+        ("tpch_q6_sharded_4m_d4", lambda: _B().bench_tpch_q6_sharded(1 << 22, 4), 1 << 22),
+        ("tpch_q6_sharded_4m_d8", lambda: _B().bench_tpch_q6_sharded(1 << 22, 8), 1 << 22),
         ("shuffle_skewed_1m", lambda: _B().bench_shuffle_skewed(1 << 20), 1 << 20),
         ("parquet_decode_1m", lambda: _B().bench_parquet_decode(1 << 20), 1 << 20),
     ]

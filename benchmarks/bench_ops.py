@@ -525,17 +525,18 @@ def bench_serving_qps_mixed(queries: int):
 
 
 def _query_mesh(n_devices: int):
-    """Mesh for distributed query benches (None = local single-device)."""
+    """Mesh for distributed query benches (None = local single-device) —
+    always the process-wide cached instance (cluster.get_mesh)."""
     if n_devices <= 0:
         return None
     import jax
-    from jax.sharding import Mesh
+    from spark_rapids_jni_tpu.parallel import cluster
     devs = jax.devices()
     if len(devs) < n_devices:  # not assert: must hold under python -O too
         raise SystemExit(
             f"--mesh {n_devices} needs {n_devices} devices, have {len(devs)} "
             f"(CPU: set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
-    return Mesh(np.array(devs[:n_devices]), axis_names=("shuffle",))
+    return cluster.get_mesh(n_devices)
 
 
 def bench_tpch_q3(rows: int, mesh_devices: int = 0):
@@ -589,6 +590,58 @@ def bench_tpch_q6(rows: int, mesh_devices: int = 0):
         lambda: _time(lambda i: run_q6(datasets[i % _NVARIANTS], mesh=mesh),
                       warmup=_NVARIANTS))
     # q6 touches qty i64 + price i64 + disc i32 + shipdate i32
+    return sec, rows * (2 * 8 + 2 * 4)
+
+
+def _bench_query_sharded(rows: int, devices: int, run_query):
+    """Shared body of the GSPMD query benches: the fused plan as ONE
+    sharded program across ``devices`` mesh devices (1 = the solo fused
+    program — the scaling baseline in the same row format). Rows carry
+    devices/sharding columns via pop_extra() for MULTICHIP sections."""
+    from benchmarks.tpch import generate_q1_lineitem
+
+    import jax
+    if len(jax.devices()) < devices:
+        raise RuntimeError(
+            f"sharded bench needs {devices} devices, have "
+            f"{len(jax.devices())} (CPU: set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    datasets = [generate_q1_lineitem(rows, seed=s)
+                for s in range(_NVARIANTS)]
+    engine = "sharded" if devices > 1 else "plan"
+
+    def run(i):
+        return run_query(datasets[i % _NVARIANTS], engine, devices)
+
+    sec = _with_plan_extra(lambda: _time(run, warmup=_NVARIANTS))
+    LAST_EXTRA.update({
+        "devices": devices,
+        "sharding": "rows" if devices > 1 else "none",
+    })
+    return sec
+
+
+def bench_tpch_q1_sharded(rows: int, devices: int):
+    """q1's fused plan sharded across the mesh (plan/sharding.py):
+    row-sharded filter/project, per-shard partial groupby + all_gather
+    exact merge, replicated sort — bit-identical to solo by contract."""
+    from benchmarks.tpch import run_q1
+
+    def q(t, engine, d):
+        out = run_q1(t, engine=engine, devices=d)
+        return [c.data for c in out.columns]
+
+    sec = _bench_query_sharded(rows, devices, q)
+    return sec, rows * (2 * 8 + 5 * 4)
+
+
+def bench_tpch_q6_sharded(rows: int, devices: int):
+    """q6's fused constant-key plan sharded across the mesh."""
+    from benchmarks.tpch import run_q6
+
+    sec = _bench_query_sharded(
+        rows, devices, lambda t, engine, d: run_q6(t, engine=engine,
+                                                   devices=d))
     return sec, rows * (2 * 8 + 2 * 4)
 
 
@@ -708,9 +761,9 @@ def bench_shuffle_skewed(rows: int):
     the axis as unavailable rather than timing a degenerate 1-partition
     no-op."""
     import jax
-    from jax.sharding import Mesh
     from spark_rapids_jni_tpu.columnar import dtype as dt
     from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.parallel import cluster
     from spark_rapids_jni_tpu.parallel.exchange import (
         hash_partition_exchange)
 
@@ -721,7 +774,7 @@ def bench_shuffle_skewed(rows: int):
         raise RuntimeError("shuffle bench needs >= 2 devices "
                            f"(have {len(devs)})")
     nd = len(devs)
-    mesh = Mesh(np.array(devs), axis_names=("shuffle",))
+    mesh = cluster.get_mesh()
     dests = []
     for s in range(_NVARIANTS):
         rng = np.random.default_rng(s)

@@ -357,7 +357,7 @@ def _q1_plan(cutoff: int):
 
 
 def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None,
-           engine: str = "auto") -> Table:
+           engine: str = "auto", devices: int = 0) -> Table:
     """TPC-H q1 shape: pricing summary report. Filter shipdate <= cutoff,
     group by (returnflag, linestatus): sum qty, sum base price, sum
     discounted price, sum charge, avg qty, avg price, avg discount, count.
@@ -371,10 +371,18 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None,
     (mask pushdown into the groupby) — the oracle the plan equivalence
     tests compare against.
 
+    ``engine="sharded"`` runs the same fused plan as ONE GSPMD program
+    across ``devices`` mesh devices (0 = all) — bit-identical to solo by
+    the plan/sharding.py merge contract.
+
     Reference-role note: the reference library supplies the kernels for
     this composition (groupby/sort via its vendored layer); the pipeline
     itself exercises BASELINE configs[1]-style aggregation at q1's shape.
     """
+    if engine == "sharded":
+        from spark_rapids_jni_tpu.plan import execute_plan_sharded
+        return execute_plan_sharded(_q1_plan(cutoff), lineitem,
+                                    devices=devices)
     if _use_plan(engine, lineitem.num_rows, mesh):
         return execute_plan(_q1_plan(cutoff), lineitem)
     keep = lineitem.columns[6].data <= cutoff
@@ -401,25 +409,39 @@ def run_q1(lineitem: Table, cutoff: int = 2400, mesh=None,
     return sort_table(g, [0, 1])
 
 
+def _q6_plan(date_lo: int, date_hi: int, disc_lo: int, disc_hi: int,
+             qty_max: int):
+    """q6 as a constant-key fused plan: filter -> project a literal key +
+    revenue -> single-group sum."""
+    return GroupBy(
+        Project(Filter(Scan(7),
+                       (col(6) >= lit(date_lo)) & (col(6) < lit(date_hi))
+                       & (col(2) >= lit(disc_lo))
+                       & (col(2) <= lit(disc_hi))
+                       & (col(0) < lit(qty_max))),
+                (i64(lit(0)), i64(col(1)) * i64(col(2)))),
+        (0,), ((1, "sum"),))
+
+
 def run_q6(lineitem: Table, date_lo: int = 365, date_hi: int = 730,
            disc_lo: int = 5, disc_hi: int = 7, qty_max: int = 24,
-           mesh=None, engine: str = "auto") -> int:
+           mesh=None, engine: str = "auto", devices: int = 0) -> int:
     """TPC-H q6 shape: forecast-revenue-change — one filtered sum.
     Returns revenue in cents·pct as an exact python int.
 
     Locally at or above the ``plan.min_rows`` floor this runs as a
     constant-key fused plan (filter -> project a literal key + revenue ->
     single-group sum): exact int64 arithmetic makes it equal to the eager
-    masked sum (``engine="eager"``; ``engine="plan"`` forces fusion)."""
+    masked sum (``engine="eager"``; ``engine="plan"`` forces fusion;
+    ``engine="sharded"`` runs the fused plan GSPMD across ``devices``)."""
+    if engine == "sharded":
+        from spark_rapids_jni_tpu.plan import execute_plan_sharded
+        g = execute_plan_sharded(
+            _q6_plan(date_lo, date_hi, disc_lo, disc_hi, qty_max),
+            lineitem, devices=devices)
+        return int(np.asarray(g.columns[1].data)[0]) if g.num_rows else 0
     if _use_plan(engine, lineitem.num_rows, mesh):
-        p = GroupBy(
-            Project(Filter(Scan(7),
-                           (col(6) >= lit(date_lo)) & (col(6) < lit(date_hi))
-                           & (col(2) >= lit(disc_lo))
-                           & (col(2) <= lit(disc_hi))
-                           & (col(0) < lit(qty_max))),
-                    (i64(lit(0)), i64(col(1)) * i64(col(2)))),
-            (0,), ((1, "sum"),))
+        p = _q6_plan(date_lo, date_hi, disc_lo, disc_hi, qty_max)
         g = execute_plan(p, lineitem)
         return int(np.asarray(g.columns[1].data)[0]) if g.num_rows else 0
     sd = lineitem.columns[6].data

@@ -72,7 +72,22 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_serving.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 6 — exception-fault storms over the whole chaos-marked suite
+# stage 6 — sharded-plan device-loss storm: POISON traps at the
+# plan_execute surface while GSPMD sharded queries run on the 8-device
+# mesh. Pass criteria baked into the test (tests/test_sharded_plan.py
+# chaos mark): every faulted query walks the 8->4->2->1 degradation
+# ladder as far as it needs and still returns bits identical to the solo
+# fused program, the degradation count matches the injected traps
+# exactly, and once the storm passes the full mesh serves again with
+# zero residual degradations. The outer `timeout` is part of the
+# contract: if ladder retry ever loops or the degraded replay wedges,
+# the kill fails the lane loudly. `make shard` runs the full sharded
+# lane.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sharded_plan.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 7 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

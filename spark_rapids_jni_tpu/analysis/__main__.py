@@ -25,7 +25,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_jni_tpu.analysis",
         description="srjt-lint: TPU-invariant static analysis "
-                    "(AST rules SRJT001-012, race rules SRJTR01-03, "
+                    "(AST rules SRJT001-014, race rules SRJTR01-03, "
                     "jaxpr audit SRJTX01-05)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the package)")

@@ -22,6 +22,8 @@ Rule IDs:
   SRJT012  dictionary materialize() inside a plan core or an ops/ module
   SRJT013  serving entry point without a Deadline, or raw dispatch from
            serving/ (device work must route through guarded_dispatch)
+  SRJT014  sharding annotation minted outside plan/sharding.py, or host
+           sync / dispatch guard inside a shard_map body
 """
 
 from __future__ import annotations
@@ -1129,13 +1131,101 @@ def project_rule_srjt007_interproc(modules, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT014 — sharded-plan discipline: annotations from plan/sharding.py only,
+# no host traffic inside shard_map bodies
+# ---------------------------------------------------------------------------
+
+# The GSPMD subsystem keeps every sharding decision in plan/sharding.py —
+# ``named_sharding`` is the single sanctioned ``NamedSharding`` constructor,
+# so the Column-pytree layout rules (row-axis leaves shard, DICT32
+# dictionaries replicate) stay in one reviewable place. And a shard_map
+# body executes PER DEVICE inside one fused program: a host sync there
+# would sync once per device (or fail at trace time), and a
+# guarded_dispatch would nest a retry scope under the executor's single
+# plan_execute boundary — the same contract SRJT011 enforces for solo plan
+# cores, extended to the sharded lowering. Two clauses:
+#
+#   (a) ``NamedSharding(...)`` constructed outside plan/sharding.py —
+#       mint annotations via sharding.named_sharding/row_spec/
+#       replicated_spec instead (pre-existing accepted sites are
+#       baselined in ci/lint_baseline.json);
+#   (b) host sync / .tolist() / device_get / guarded_dispatch inside a
+#       function passed to ``shard_map`` (by name in the same module; in
+#       plan/sharding.py the nested whole-plan ``body`` counts too).
+
+_SRJT014_HOME = "plan/sharding.py"
+
+
+def _shard_body_names(tree) -> set:
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func)
+            if dn is not None and dn.split(".")[-1] == "shard_map" \
+                    and node.args:
+                first = _dotted(node.args[0])
+                if first is not None:
+                    names.add(first.split(".")[-1])
+    return names
+
+
+def rule_srjt014(tree, rel, lines, ctx) -> List[Finding]:
+    in_home = rel.endswith(_SRJT014_HOME)
+    body_names = _shard_body_names(tree)
+    if in_home:
+        body_names.add("body")
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        # clause (a): sharding annotation minted outside plan/sharding.py
+        if not in_home and dn is not None \
+                and dn.split(".")[-1] == "NamedSharding":
+            findings.append(Finding(
+                "SRJT014", rel, node.lineno,
+                "`NamedSharding(...)` constructed outside plan/sharding.py "
+                "— mint annotations via plan.sharding.named_sharding (or "
+                "row_spec/replicated_spec) so the Column-pytree layout "
+                "rules stay in the one module that owns them"))
+            continue
+        # clause (b): host traffic / guard inside a shard_map body
+        body = None
+        for a in anc:
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and a.name in body_names:
+                body = a
+        if body is None:
+            continue
+        what = None
+        if dn is not None and dn.split(".")[-1] == "guarded_dispatch":
+            what = "guarded_dispatch(...)"
+        elif dn in _HOST_SYNC_CALLS:
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue  # literal args never touch a device buffer
+            what = dn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _HOST_SYNC_METHODS):
+            what = f".{node.func.attr}()"
+        if what is not None:
+            findings.append(Finding(
+                "SRJT014", rel, node.lineno,
+                f"`{what}` inside shard_map body `{body.name}` — shard "
+                f"bodies execute per device inside one fused sharded "
+                f"program: host traffic there syncs once PER DEVICE (or "
+                f"fails at trace time), and guard scopes must stay at the "
+                f"single plan_execute boundary (plan/sharded_executor.py)"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
-              rule_srjt011, rule_srjt012, rule_srjt013)
+              rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

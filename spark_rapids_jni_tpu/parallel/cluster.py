@@ -26,9 +26,13 @@ devices is exactly the mesh the tests and `dryrun_multichip` build.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
+
+_MESH_LOCK = threading.Lock()
+_MESH_CACHE: dict = {}
 
 
 def initialize(coordinator: str, num_processes: int, process_id: int,
@@ -48,23 +52,43 @@ def initialize(coordinator: str, num_processes: int, process_id: int,
         local_device_ids=local_device_ids)
 
 
-def global_mesh(axis_name: str = "shuffle", num_devices: int = 0):
-    """1-D mesh over the cluster's global device list.
+def get_mesh(num_devices: int = 0, axis_name: str = "shuffle"):
+    """THE process-wide mesh accessor: one cached 1-D ``Mesh`` per
+    (device count, axis name), shared by the plan compiler, the exchange
+    layer, the serving tier, benches and tests.
 
-    num_devices = 0 uses every device; otherwise the first N (useful for
-    carving a sub-mesh on shared hosts). Device order is jax's global
-    order: process-major, so per-host runs are contiguous.
+    Returning the *same object* matters beyond convenience: the exchange
+    program caches and the sharded ``ProgramCache`` entries key on the
+    mesh, so two call sites building equal-but-distinct meshes would
+    silently double-compile — and a site building a mesh with a different
+    device slice or axis name would drift apart from the plan mesh with
+    no error. All mesh construction funnels through here.
+
+    num_devices = 0 uses every device; otherwise the first N (a sub-mesh
+    for degraded replay or per-device-count benches). Device order is
+    jax's global order: process-major, so per-host runs are contiguous.
     """
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
-    if num_devices:
-        if len(devs) < num_devices:
-            raise ValueError(
-                f"need {num_devices} devices, cluster has {len(devs)}")
-        devs = devs[:num_devices]
-    return Mesh(np.array(devs), axis_names=(axis_name,))
+    n = num_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(
+            f"need {n} devices, cluster has {len(devs)}")
+    key = (n, axis_name)
+    with _MESH_LOCK:
+        mesh = _MESH_CACHE.get(key)
+        if mesh is None:
+            mesh = Mesh(np.array(devs[:n]), axis_names=(axis_name,))
+            _MESH_CACHE[key] = mesh
+        return mesh
+
+
+def global_mesh(axis_name: str = "shuffle", num_devices: int = 0):
+    """1-D mesh over the cluster's global device list (cached — delegates
+    to ``get_mesh``, the single mesh constructor)."""
+    return get_mesh(num_devices, axis_name)
 
 
 def process_info() -> dict:

@@ -516,9 +516,13 @@ def _exchange_program_ragged(mesh: Mesh, per_dev: int,
 
 
 def hash_partition_exchange(
-        table: Table, key_indices: Sequence[int], mesh: Mesh,
+        table: Table, key_indices: Sequence[int], mesh: Optional[Mesh] = None,
         dest: Optional[jnp.ndarray] = None) -> List[Table]:
     """Shuffle ``table`` across ``mesh`` so equal keys land on one device.
+
+    ``mesh=None`` uses the process-wide cached mesh (cluster.get_mesh) —
+    the same instance the plan compiler and serving tier share, so the
+    exchange can never drift onto a different device slice or axis name.
 
     Returns the per-device partitions as device-resident local Tables
     (schema preserved). ``dest`` overrides the murmur route (e.g. range
@@ -529,6 +533,9 @@ def hash_partition_exchange(
     index, Table) pairs for THIS process's local devices only — the other
     partitions live on other hosts by design.
     """
+    if mesh is None:
+        from . import cluster
+        mesh = cluster.get_mesh()
     nd = mesh.devices.size
     n = table.num_rows
     if dest is None:

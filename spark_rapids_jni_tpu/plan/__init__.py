@@ -27,6 +27,8 @@ _LAZY = {
     "plan_metrics": ".compile",
     "execute_plan": ".executor",
     "unsupported_reason": ".executor",
+    "execute_plan_sharded": ".sharded_executor",
+    "sharding_unsupported_reason": ".sharding",
     "run_eager": ".interpreter",
 }
 

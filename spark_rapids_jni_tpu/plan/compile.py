@@ -96,6 +96,24 @@ class CompiledPlan:
     n_out: int                 # static (padded) output row count
 
 
+@dataclasses.dataclass
+class CompiledShardedPlan:
+    """AOT-compiled GSPMD program (plan/sharding.py lowering) plus the
+    facts the sharded executor needs: input leaf specs to re-stage fresh
+    tables, and whether outputs are replicated (post-GroupBy) or still
+    row-sharded."""
+
+    compiled: Any              # jax.stages.Compiled over flat leaves
+    fingerprint: str
+    prefix: bool
+    n_out: int
+    replicated: bool           # outputs replicated vs row-sharded
+    out_cols: Any              # static rebuild metadata per output column
+    in_specs: Tuple            # PartitionSpec per input leaf
+    mesh: Any
+    n_rows: int                # global row count the program is locked to
+
+
 def _shape_key(table: Table) -> Tuple:
     """Input signature component of the cache key: per-column dtype,
     static size, and validity presence — everything that changes the
@@ -233,19 +251,63 @@ class ProgramCache:
             prog = self._programs.setdefault(key, prog)
         return prog
 
+    def get_or_compile_sharded(self, plan: PlanNode,
+                               table: Table, mesh) -> CompiledShardedPlan:
+        """GSPMD variant: ONE jitted shard_map program spanning ``mesh``
+        (plan/sharding.py lowering). The key extends the solo key with
+        the mesh shape and axis name — "sharded" is a string sentinel, so
+        solo entries (bool donate in that slot) and sharded entries can
+        never collide, and each device count compiles separately (the
+        degradation ladder walks distinct cache entries). Never donates:
+        inputs must survive for degraded replay."""
+        max_groups = int(config.get("plan.max_groups"))
+        nd = int(mesh.devices.size)
+        key = (fingerprint(plan), _shape_key(table), "sharded", nd,
+               mesh.axis_names[0], max_groups)
+        with self._lock:
+            prog = self._programs.get(key)
+        if prog is not None:
+            plan_metrics.inc("plan_cache_hits")
+            return prog
+        plan_metrics.inc("plan_cache_misses")
+        from . import sharding  # lazy: sharding imports this module
+        t0 = time.perf_counter()
+        jitted, staged, in_specs, out_info, n = sharding.lower_sharded(
+            plan, table, mesh, max_groups)
+        compiled = jitted.lower(*staged).compile()
+        plan_metrics.add_time("compile_s", time.perf_counter() - t0)
+        plan_metrics.inc("plan_compiles")
+        prog = CompiledShardedPlan(
+            compiled=compiled, fingerprint=key[0],
+            prefix=out_info["prefix"], n_out=out_info["n_out"],
+            replicated=out_info["replicated"],
+            out_cols=out_info["out_cols"], in_specs=tuple(in_specs),
+            mesh=mesh, n_rows=n)
+        with self._lock:
+            prog = self._programs.setdefault(key, prog)
+        return prog
+
     def get_or_compile_batched(self, plan: PlanNode, template: Table,
                                stacked_cols: Tuple[Column, ...],
-                               k: int) -> CompiledPlan:
+                               k: int, mesh=None) -> CompiledPlan:
         """Batched variant for the serving micro-batcher: ``jax.vmap`` of
         the same traced plan function over a leading batch axis of ``k``
         stacked same-shape inputs. One dispatch then executes ``k``
         queries; per-example semantics are untouched (vmap maps every op
         core over axis 0), so each slice of the output is bit-identical
         to the solo program's. Never donates: the stacked operand is a
-        serving-owned copy and member tables stay live for solo replay."""
+        serving-owned copy and member tables stay live for solo replay.
+
+        With ``mesh`` the caller has staged ``stacked_cols`` across it
+        (sharding.stage_batched) and the jitted program partitions under
+        GSPMD; the key grows (mesh shape, axis) so sharded-batch entries
+        never serve an unsharded dispatch or vice versa."""
         max_groups = int(config.get("plan.max_groups"))
         key = (fingerprint(plan), _shape_key(template), "vmap", k,
                max_groups)
+        if mesh is not None:
+            key = key + ("sharded", int(mesh.devices.size),
+                         mesh.axis_names[0])
         with self._lock:
             prog = self._programs.get(key)
         if prog is not None:

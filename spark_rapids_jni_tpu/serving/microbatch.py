@@ -50,6 +50,7 @@ from ..plan.executor import (default_cache, execute_plan,
 from ..plan.nodes import (Filter, GroupBy, PlanNode, Project, Scan,
                           fingerprint, linearize)
 from ..plan import expr as ex
+from ..utils import config
 from ..utils.shapes import bucket_size
 from .admission import PLAN_SURFACE
 from .sessions import serving_metrics
@@ -209,6 +210,20 @@ class MicroBatcher:
                 stacked)
         nbytes = sum(t.device_nbytes() for t in tables)
 
+        # config-gated sharded mode: stage the stacked pytree's ROW axis
+        # across the mesh and let the jit(vmap(plan)) program partition
+        # under GSPMD — one dispatch still executes the whole slice, now
+        # across serving.sharded_devices devices. vmap'd per-member
+        # semantics are untouched; the mesh extends the cache key so
+        # sharded-batch programs never serve an unsharded dispatch
+        mesh = None
+        nd = int(config.get("serving.sharded_devices"))
+        if nd > 1 and len(jax.devices()) >= nd:
+            from ..parallel import cluster
+            from ..plan import sharding
+            mesh = cluster.get_mesh(nd)
+            stacked = sharding.stage_batched(stacked, mesh, bucket)
+
         # the batch runs under the LOOSEST member deadline so no member
         # is cancelled by a batch-mate's tighter budget; each member's
         # own expiry is accounted at scatter time by the caller
@@ -221,7 +236,7 @@ class MicroBatcher:
         try:
             with ctx:
                 prog = self._cache.get_or_compile_batched(
-                    pplan, padded[0], stacked, kb)
+                    pplan, padded[0], stacked, kb, mesh=mesh)
 
                 def run():
                     # same 2x envelope as the solo executor, summed over

@@ -275,6 +275,11 @@ _register("serving.default_priority", "SRJT_SERVING_DEFAULT_PRIORITY", 2,
           int,
           "priority assigned to tenants that do not specify one "
           "(0 = most urgent; larger is more deferrable)")
+_register("serving.sharded_devices", "SRJT_SERVING_SHARDED_DEVICES", 0, int,
+          "GSPMD mesh width for batched dispatches (0/1 = off): the "
+          "micro-batcher stages each stacked slice's row axis across this "
+          "many devices of the process-wide mesh so one jit(vmap(plan)) "
+          "dispatch runs sharded; per-member results stay bit-identical")
 
 
 def get(key: str) -> Any:

@@ -19,7 +19,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-from jax.sharding import Mesh  # noqa: E402
+from spark_rapids_jni_tpu.parallel import cluster  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -50,7 +50,7 @@ def main() -> int:
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
     devs = jax.devices()
     assert len(devs) >= nd, f"need {nd} devices, have {len(devs)}"
-    mesh = Mesh(np.array(devs[:nd]), axis_names=("shuffle",))
+    mesh = cluster.get_mesh(nd)
     rng = np.random.default_rng(11)
 
     plans = {}

@@ -19,7 +19,6 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
-from jax.sharding import Mesh
 
 from spark_rapids_jni_tpu.columnar import dtype as dt
 from spark_rapids_jni_tpu.columnar.column import Column, Table
@@ -310,9 +309,9 @@ def test_parquet_page_flip_detected_and_reread(tmp_path):
 
 @pytest.fixture(scope="module")
 def mesh():
-    devs = jax.devices()
-    assert len(devs) >= 8, "conftest must provide 8 virtual devices"
-    return Mesh(np.array(devs[:8]), axis_names=("shuffle",))
+    from spark_rapids_jni_tpu.parallel import cluster
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return cluster.get_mesh(8)
 
 
 def _exchange_values(parts):

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh
+from spark_rapids_jni_tpu.parallel import cluster
 
 from spark_rapids_jni_tpu.columnar import dtype as dt
 from spark_rapids_jni_tpu.columnar.column import Column, Table
@@ -28,9 +28,8 @@ from spark_rapids_jni_tpu.parallel import (
 
 @pytest.fixture(scope="module")
 def mesh():
-    devs = jax.devices()
-    assert len(devs) >= 8, "conftest must provide 8 virtual devices"
-    return Mesh(np.array(devs[:8]), axis_names=("shuffle",))
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return cluster.get_mesh(8)
 
 
 def _table(n=1000, seed=3, with_strings=True, with_floats=True):
@@ -455,7 +454,7 @@ def test_distributed_q6_matches_local(mesh):
 def test_exchange_single_device_mesh():
     """nd=1 degenerate mesh: the exchange must be an identity shuffle
     (all_to_all over an axis of size 1), not a special case."""
-    m = Mesh(np.array(jax.devices()[:1]), axis_names=("shuffle",))
+    m = cluster.get_mesh(1)
     t = _table(123)
     parts = hash_partition_exchange(t, [0], m)
     assert len(parts) == 1 and parts[0].num_rows == 123

@@ -1,0 +1,306 @@
+"""GSPMD sharded plan execution: bit-identity, caching, degradation.
+
+The sharded executor (plan/sharded_executor.py) must be a pure
+performance layer: every query it accepts returns the exact bits the
+solo fused program returns — data, validity presence, validity bits,
+dtypes, dictionary children. These tests pin that contract on the
+8-device virtual CPU mesh (conftest.py), including the paths where it
+is easiest to lose: null-carrying aggregates, DICT32 keys, row counts
+that do not divide the mesh, and the 8->4->2->1 fault ladder.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.tpch import _q1_plan, generate_q1_lineitem
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.dictionary import encode_strings
+from spark_rapids_jni_tpu.faultinj import guard
+from spark_rapids_jni_tpu.faultinj.injector import install, uninstall
+from spark_rapids_jni_tpu.plan.compile import ProgramCache, plan_metrics
+from spark_rapids_jni_tpu.plan.executor import execute_plan
+from spark_rapids_jni_tpu.plan.expr import col, i64, lit
+from spark_rapids_jni_tpu.plan.nodes import Filter, GroupBy, Project, Scan, Sort
+from spark_rapids_jni_tpu.plan.sharded_executor import execute_plan_sharded
+from spark_rapids_jni_tpu.plan.sharding import sharding_unsupported_reason
+from spark_rapids_jni_tpu.utils import budget, config
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    guard.metrics.reset()
+    yield
+    uninstall()
+
+
+def assert_bit_identical(a, b):
+    assert a.num_rows == b.num_rows
+    assert len(a.columns) == len(b.columns)
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        assert ca.dtype.id == cb.dtype.id, i
+        assert np.array_equal(np.asarray(ca.data), np.asarray(cb.data)), i
+        va = None if ca.validity is None else np.asarray(ca.validity)
+        vb = None if cb.validity is None else np.asarray(cb.validity)
+        assert (va is None) == (vb is None), (i, "validity presence")
+        if va is not None:
+            assert np.array_equal(va, vb), (i, "validity bits")
+
+
+# -- bit-identity -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("devices", [8, 4, 2])
+def test_q1_bit_identical(devices):
+    li = generate_q1_lineitem(50_000, seed=5)
+    plan = _q1_plan(2400)
+    solo = execute_plan(plan, li)
+    assert_bit_identical(solo, execute_plan_sharded(plan, li,
+                                                    devices=devices))
+
+
+def test_q1_row_count_not_divisible_by_mesh():
+    """50_003 rows on 8 devices: the padding rows must stay dead through
+    filter masks, groupby partials and the gathered merge."""
+    li = generate_q1_lineitem(50_003, seed=11)
+    plan = _q1_plan(2400)
+    assert_bit_identical(execute_plan(plan, li),
+                         execute_plan_sharded(plan, li, devices=8))
+
+
+def test_filter_project_row_sharded_output():
+    """No GroupBy: outputs stay row-sharded on the mesh and are gathered
+    in row order only at rebuild time."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    p = Project(Filter(Scan(7), col(6) <= lit(1200)),
+                (col(0), i64(col(1)) * i64(col(2)), col(4)))
+    assert_bit_identical(execute_plan(p, li),
+                         execute_plan_sharded(p, li, devices=8))
+
+
+def test_constant_key_single_group():
+    """q6 shape: every live row lands in one group — the per-shard
+    partials all merge into a single slot."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    p = GroupBy(Project(Filter(Scan(7),
+            (col(6) >= lit(365)) & (col(6) < lit(730)) & (col(2) >= lit(5))
+            & (col(2) <= lit(7)) & (col(0) < lit(24))),
+            (i64(lit(0)), i64(col(1)) * i64(col(2)))), (0,), ((1, "sum"),))
+    assert_bit_identical(execute_plan(p, li),
+                         execute_plan_sharded(p, li, devices=8))
+
+
+def _null_table(n=24_000, seed=3):
+    rng = np.random.default_rng(seed)
+    key = Column.from_numpy(rng.integers(0, 5, n).astype(np.int32), dt.INT32)
+    val = Column(dt.INT64, n, data=jnp.asarray(rng.integers(-1000, 1000, n)),
+                 validity=jnp.asarray(rng.random(n) < 0.8))
+    sel = Column.from_numpy(rng.integers(0, 100, n).astype(np.int32),
+                            dt.INT32)
+    return Table((key, val, sel)), rng
+
+
+_AGG_PLAN = Sort(GroupBy(Filter(Scan(3), col(2) < lit(70)), (0,),
+                         ((1, "sum"), (1, "mean"), (1, "count"),
+                          (1, "min"), (1, "max"))), (0,))
+
+
+def test_null_aggregates_bit_identical():
+    t, _ = _null_table()
+    assert_bit_identical(execute_plan(_AGG_PLAN, t),
+                         execute_plan_sharded(_AGG_PLAN, t, devices=8))
+
+
+def test_all_null_group_bit_identical():
+    """A key whose every row is null: count must be 0, sum/min/max null —
+    exactly as the solo program reports them."""
+    t, _ = _null_table()
+    key = np.asarray(t.columns[0].data).copy()
+    key[:100] = 99
+    validity = np.asarray(t.columns[1].validity).copy()
+    validity[key == 99] = False
+    n = t.num_rows
+    t2 = Table((Column(dt.INT32, n, data=jnp.asarray(key)),
+                Column(dt.INT64, n, data=t.columns[1].data,
+                       validity=jnp.asarray(validity)),
+                t.columns[2]))
+    assert_bit_identical(execute_plan(_AGG_PLAN, t2),
+                         execute_plan_sharded(_AGG_PLAN, t2, devices=8))
+
+
+def _dict_table(n=24_000, seed=3):
+    rng = np.random.default_rng(seed)
+    strs = [["apple", "banana", "cherry", "date"][i]
+            for i in rng.integers(0, 4, n)]
+    sc = encode_strings(Column.from_pylist(strs, dt.STRING))
+    val = Column(dt.INT64, n, data=jnp.asarray(rng.integers(-1000, 1000, n)),
+                 validity=jnp.asarray(rng.random(n) < 0.8))
+    sel = Column.from_numpy(rng.integers(0, 100, n).astype(np.int32),
+                            dt.INT32)
+    return Table((sc, val, sel))
+
+
+def test_dict32_groupby_key():
+    """DICT32 key: codes shard along rows, the dictionary replicates, and
+    the output column keeps its string children."""
+    t = _dict_table()
+    p = Sort(GroupBy(Filter(Scan(3), col(2) < lit(70)), (0,),
+                     ((1, "sum"), (1, "count"))), (0,))
+    solo, sh = execute_plan(p, t), execute_plan_sharded(p, t, devices=8)
+    assert_bit_identical(solo, sh)
+    assert sh.columns[0].dtype.id == dt.TypeId.DICT32
+    assert sh.columns[0].children
+    assert sharding_unsupported_reason(p, t) is None
+
+
+def test_dict32_passthrough_string_literal_filter():
+    t = _dict_table()
+    p = Project(Filter(Scan(3), col(0) == lit("banana")),
+                (col(0), i64(col(1))))
+    solo, sh = execute_plan(p, t), execute_plan_sharded(p, t, devices=8)
+    assert_bit_identical(solo, sh)
+    assert sh.columns[0].dtype.id == dt.TypeId.DICT32
+    assert len(sh.columns[0].children) > 0
+
+
+def test_float_aggregate_gated_to_solo():
+    """Float partial sums don't commute bit-exactly across shard order, so
+    the gate must route float aggregates to the solo fused program."""
+    rng = np.random.default_rng(3)
+    n = 24_000
+    key = Column.from_numpy(rng.integers(0, 5, n).astype(np.int32), dt.INT32)
+    fl = Column(dt.FLOAT64, n,
+                data=jax.lax.bitcast_convert_type(rng.random(n), jnp.uint64))
+    sel = Column.from_numpy(rng.integers(0, 100, n).astype(np.int32),
+                            dt.INT32)
+    t = Table((key, fl, sel))
+    p = GroupBy(Filter(Scan(3), col(2) < lit(70)), (0,), ((1, "sum"),))
+    assert sharding_unsupported_reason(p, t) is not None
+    assert_bit_identical(execute_plan(p, t),
+                         execute_plan_sharded(p, t, devices=8))
+
+
+# -- program cache ------------------------------------------------------------
+
+
+def test_cache_key_separation_and_hits():
+    """Solo and sharded programs for the same (plan, shape) live in the
+    same ProgramCache under distinct keys; reruns hit, never recompile."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    plan = _q1_plan(2400)
+    cache = ProgramCache()
+    plan_metrics.reset()
+    execute_plan(plan, li, cache=cache)
+    execute_plan_sharded(plan, li, devices=8, cache=cache)
+    assert len(cache) == 2
+    snap = plan_metrics.snapshot()
+    assert snap["plan_compiles"] == 2 and snap["plan_cache_misses"] == 2
+    execute_plan(plan, li, cache=cache)
+    execute_plan_sharded(plan, li, devices=8, cache=cache)
+    snap = plan_metrics.snapshot()
+    assert snap["plan_compiles"] == 2 and snap["plan_cache_hits"] == 2
+
+
+@pytest.mark.parametrize("devices", [8, 4, 2])
+def test_zero_steady_state_retraces(devices):
+    li = generate_q1_lineitem(50_000, seed=5)
+    plan = _q1_plan(2400)
+    cache = ProgramCache()
+    execute_plan_sharded(plan, li, devices=devices, cache=cache)  # warm
+    with budget.measure() as b:
+        execute_plan_sharded(plan, li, devices=devices, cache=cache)
+    assert b.compiles == 0 and b.traces == 0
+
+
+# -- mesh-degradation ladder --------------------------------------------------
+
+
+def _trap_cfg(tmp_path, count):
+    p = tmp_path / "shard_faults.json"
+    p.write_text(json.dumps({"xlaRuntimeFaults": {
+        "plan_execute": {"percent": 100, "injectionType": 0,
+                         "interceptionCount": count}}}))
+    return str(p)
+
+
+def test_full_ladder_8_to_solo(tmp_path):
+    """Three consecutive device faults walk 8->4->2->1; the final rung
+    replays solo under guard.degraded and returns identical bits."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    plan = _q1_plan(2400)
+    solo = execute_plan(plan, li)
+    install(_trap_cfg(tmp_path, 3), seed=0)
+    with config.override("faultinj.max_poison_redispatch", 0):
+        out = execute_plan_sharded(plan, li, devices=8)
+    assert_bit_identical(solo, out)
+    assert guard.metrics.snapshot().get("degradations") == 3
+
+
+def test_partial_ladder_stays_sharded(tmp_path):
+    """One fault: degrade 8->4 and finish sharded, not solo."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    plan = _q1_plan(2400)
+    solo = execute_plan(plan, li)
+    install(_trap_cfg(tmp_path, 1), seed=0)
+    with config.override("faultinj.max_poison_redispatch", 0):
+        out = execute_plan_sharded(plan, li, devices=8)
+    assert_bit_identical(solo, out)
+    assert guard.metrics.snapshot().get("degradations") == 1
+
+
+@pytest.mark.chaos
+def test_device_loss_storm_degraded_replay(tmp_path):
+    """Chaos stage: a storm of device-loss faults across consecutive
+    sharded queries. Every query must return solo bits (degrading as far
+    as it needs), and once the storm passes the full mesh serves again
+    with no residual degradations."""
+    li = generate_q1_lineitem(50_000, seed=5)
+    plans = [_q1_plan(cutoff) for cutoff in (1200, 2400, 3600)]
+    baselines = [execute_plan(p, li) for p in plans]
+    # 5 traps: first query burns 3 (full ladder), second burns the
+    # remaining 2 (8->4->2), third runs clean at the full mesh
+    install(_trap_cfg(tmp_path, 5), seed=0)
+    with config.override("faultinj.max_poison_redispatch", 0):
+        for p, want in zip(plans, baselines):
+            assert_bit_identical(want, execute_plan_sharded(p, li,
+                                                            devices=8))
+    assert guard.metrics.snapshot().get("degradations") == 5
+    uninstall()
+    guard.metrics.reset()
+    out = execute_plan_sharded(plans[0], li, devices=8)
+    assert_bit_identical(baselines[0], out)
+    assert guard.metrics.snapshot().get("degradations", 0) == 0
+
+
+# -- serving sharded mode -----------------------------------------------------
+
+
+def test_serving_microbatch_sharded_bit_identical():
+    from spark_rapids_jni_tpu.serving.microbatch import (MicroBatcher,
+                                                         batch_key_for)
+
+    def make_table(n, seed):
+        rng = np.random.default_rng(seed)
+        return Table((
+            Column.from_numpy(rng.integers(0, 7, n).astype(np.int32),
+                              dt.INT32),
+            Column.from_numpy(rng.integers(-50, 50, n), dt.INT64),
+            Column.from_numpy(rng.integers(0, 100, n).astype(np.int32),
+                              dt.INT32),
+        ))
+
+    plan = Sort(GroupBy(Filter(Scan(3), col(2) < lit(60)), (0,),
+                        ((1, "sum"), (1, "mean"), (1, "count"))), (0,))
+    tables = [make_table(512, 10 + s) for s in range(4)]
+    plans = [batch_key_for(plan, t)[0] for t in tables]
+    base = [execute_plan(p, t) for p, t in zip(plans, tables)]
+    with config.override("serving.sharded_devices", 4):
+        outs = MicroBatcher().execute_group(plans, tables, [None] * 4)
+    for o, want in zip(outs, base):
+        assert o.error is None, o.error
+        assert_bit_identical(want, o.table)

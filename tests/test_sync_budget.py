@@ -211,11 +211,11 @@ def test_rowconv_strings_bounded_syncs(accel):
 # ---------------------------------------------------------------------------
 
 def _exchange_syncs(nd, rows):
-    from jax.sharding import Mesh
+    from spark_rapids_jni_tpu.parallel import cluster
     from spark_rapids_jni_tpu.parallel.exchange import (
         hash_partition_exchange,
     )
-    mesh = Mesh(np.array(jax.devices()[:nd]), axis_names=("shuffle",))
+    mesh = cluster.get_mesh(nd)
     t = Table((_ints(rows, hi=max(4, rows // 4), seed=11),
                _ints(rows, seed=12)))
     hash_partition_exchange(t, [0], mesh)  # warm

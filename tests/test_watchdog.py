@@ -24,7 +24,6 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
-from jax.sharding import Mesh
 
 from spark_rapids_jni_tpu import bridge
 from spark_rapids_jni_tpu.columnar import dtype as dt
@@ -366,9 +365,9 @@ def test_hang_disk_tier_cancelled_then_clean(tmp_path):
 
 @pytest.fixture(scope="module")
 def mesh():
-    devs = jax.devices()
-    assert len(devs) >= 8, "conftest must provide 8 virtual devices"
-    return Mesh(np.array(devs[:8]), axis_names=("shuffle",))
+    from spark_rapids_jni_tpu.parallel import cluster
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return cluster.get_mesh(8)
 
 
 def _exchange_values(parts):
