@@ -89,6 +89,10 @@ def _with_plan_extra(timed):
                 after["plan_cache_hits"] - before["plan_cache_hits"],
             "plan_cache_misses":
                 after["plan_cache_misses"] - before["plan_cache_misses"],
+            # always present so "0" is a visible claim, not an omission:
+            # a fused join query must never silently drop to eager joins
+            "eager_join_fallbacks":
+                after["plan_join_fallbacks"] - before["plan_join_fallbacks"],
         })
         fallbacks = after["plan_fallbacks"] - before["plan_fallbacks"]
         if fallbacks:
@@ -555,7 +559,7 @@ def bench_tpch_q3(rows: int, mesh_devices: int = 0):
         out = run_q3(*datasets[i % _NVARIANTS], mesh=mesh)
         return [c.data for c in out.columns]
 
-    sec = _time(run, warmup=_NVARIANTS)
+    sec = _with_plan_extra(lambda: _time(run, warmup=_NVARIANTS))
     cust, orders, _ = datasets[0]
     nbytes = rows * 24 + orders.num_rows * 24 + cust.num_rows * 12
     return sec, nbytes

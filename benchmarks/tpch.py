@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_jni_tpu.columnar import dtype as dt
-from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnStats, Table
 from spark_rapids_jni_tpu.columnar.table_ops import (
     filter_table,
     gather_table,
@@ -23,8 +23,9 @@ from spark_rapids_jni_tpu.columnar.table_ops import (
 from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
 from spark_rapids_jni_tpu.ops.join import inner_join
 from spark_rapids_jni_tpu.ops.sort import sort_table
-from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Project, Scan, Sort,
-                                       col, execute_plan, i64, lit)
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Join, Limit, Project,
+                                       Scan, Sort, col, execute_plan, i64,
+                                       lit)
 
 
 def _backend() -> str:
@@ -49,6 +50,16 @@ def _use_plan(engine: str, rows: int, mesh) -> bool:
 
 
 CUTOFF_DAYS = 1200  # "1995-03-15" as days into the generated date range
+
+
+def _scol(arr: np.ndarray, dtype) -> Column:
+    """Column with honest advisory ColumnStats attached. The cost-shaped
+    planner picks join/groupby strategies off these (direct-addressed
+    probes for dense ascending keys, direct-slot groupbys for small
+    spans); every pick is re-checked on device, so stats only ever cost
+    a fallback, never a wrong answer."""
+    return Column.from_numpy(arr, dtype).with_stats(
+        ColumnStats.from_numpy(arr))
 
 
 def _plan_ops(mesh):
@@ -150,25 +161,20 @@ def generate_q3_tables(rows: int, seed: int):
     nord = max(rows // 4, 16)
     rng = np.random.default_rng(seed)
     cust = Table((
-        Column.from_numpy(np.arange(ncust, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, 5, ncust).astype(np.int32),
-                          dt.INT32),
+        _scol(np.arange(ncust, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, 5, ncust).astype(np.int32), dt.INT32),
     ))
     orders = Table((
-        Column.from_numpy(np.arange(nord, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, ncust, nord), dt.INT64),
-        Column.from_numpy(rng.integers(0, 2400, nord).astype(np.int32),
-                          dt.INT32),
-        Column.from_numpy(rng.integers(0, 3, nord).astype(np.int32),
-                          dt.INT32),
+        _scol(np.arange(nord, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, ncust, nord), dt.INT64),
+        _scol(rng.integers(0, 2400, nord).astype(np.int32), dt.INT32),
+        _scol(rng.integers(0, 3, nord).astype(np.int32), dt.INT32),
     ))
     lineitem = Table((
-        Column.from_numpy(rng.integers(0, nord, rows), dt.INT64),
-        Column.from_numpy(rng.integers(0, 2400, rows).astype(np.int32),
-                          dt.INT32),
-        Column.from_numpy(rng.integers(90000, 10500000, rows), dt.INT64),
-        Column.from_numpy(rng.integers(0, 11, rows).astype(np.int32),
-                          dt.INT32),
+        _scol(rng.integers(0, nord, rows), dt.INT64),
+        _scol(rng.integers(0, 2400, rows).astype(np.int32), dt.INT32),
+        _scol(rng.integers(90000, 10500000, rows), dt.INT64),
+        _scol(rng.integers(0, 11, rows).astype(np.int32), dt.INT32),
     ))
     return cust, orders, lineitem
 
@@ -192,33 +198,56 @@ def generate_q5_tables(rows: int, seed: int):
     nsupp = max(rows // 600, 8)
     rng = np.random.default_rng(seed)
     cust = Table((
-        Column.from_numpy(np.arange(ncust, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, 25, ncust).astype(np.int32),
-                          dt.INT32),
+        _scol(np.arange(ncust, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, 25, ncust).astype(np.int32), dt.INT32),
     ))
     orders = Table((
-        Column.from_numpy(np.arange(nord, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, ncust, nord), dt.INT64),
-        Column.from_numpy(rng.integers(0, 2400, nord).astype(np.int32),
-                          dt.INT32),
+        _scol(np.arange(nord, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, ncust, nord), dt.INT64),
+        _scol(rng.integers(0, 2400, nord).astype(np.int32), dt.INT32),
     ))
     lineitem = Table((
-        Column.from_numpy(rng.integers(0, nord, rows), dt.INT64),
-        Column.from_numpy(rng.integers(0, nsupp, rows), dt.INT64),
-        Column.from_numpy(rng.integers(90000, 10500000, rows), dt.INT64),
-        Column.from_numpy(rng.integers(0, 11, rows).astype(np.int32),
-                          dt.INT32),
+        _scol(rng.integers(0, nord, rows), dt.INT64),
+        _scol(rng.integers(0, nsupp, rows), dt.INT64),
+        _scol(rng.integers(90000, 10500000, rows), dt.INT64),
+        _scol(rng.integers(0, 11, rows).astype(np.int32), dt.INT32),
     ))
     supplier = Table((
-        Column.from_numpy(np.arange(nsupp, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, 25, nsupp).astype(np.int32),
-                          dt.INT32),
+        _scol(np.arange(nsupp, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, 25, nsupp).astype(np.int32), dt.INT32),
     ))
     nation = Table((
-        Column.from_numpy(np.arange(25, dtype=np.int64), dt.INT64),
-        Column.from_numpy(rng.integers(0, 5, 25).astype(np.int32), dt.INT32),
+        _scol(np.arange(25, dtype=np.int64), dt.INT64),
+        _scol(rng.integers(0, 5, 25).astype(np.int32), dt.INT32),
     ))
     return cust, orders, lineitem, supplier, nation
+
+
+def _q5_plan(region_code: int, date_lo: int, date_hi: int):
+    """q5 as a five-input plan DAG — all four joins INSIDE the fused
+    program. Inputs: cust=0, orders=1, lineitem=2, supplier=3, nation=4.
+
+    Shape: lineitem probes (date-filtered orders ⋈ customer) on
+    l_orderkey and (supplier ⋈ region-filtered nation) on l_suppkey; the
+    co-nation predicate is an ordinary column Filter on the joined row;
+    revenue sums per supplier nation, sorted descending. All build keys
+    are dense ascending PKs, so the cost-shaped planner lowers every
+    join to a direct-addressed probe."""
+    ord_f = Filter(Scan(3, input_index=1),
+                   (col(2) >= lit(date_lo)) & (col(2) < lit(date_hi)))
+    oc = Join(ord_f, Scan(2, input_index=0), (1,), (0,), "inner")
+    nat_f = Filter(Scan(2, input_index=4), col(1) == lit(region_code))
+    sn = Join(Scan(2, input_index=3), nat_f, (1,), (0,), "inner")
+    lo = Join(Scan(4, input_index=2), oc, (0,), (0,), "inner")
+    ls = Join(lo, sn, (1,), (0,), "inner")
+    # ls columns: l_orderkey0 l_suppkey1 l_price2 l_disc3 | o_orderkey4
+    #   o_custkey5 o_orderdate6 | c_custkey7 c_nationkey8 | s_suppkey9
+    #   s_nationkey10 | n_nationkey11 n_regionkey12
+    conat = Filter(ls, col(8) == col(10))
+    rev = i64(col(2)) * (lit(100) - i64(col(3)))
+    proj = Project(conat, (col(10), rev))
+    return Sort(GroupBy(proj, (0,), ((1, "sum"),)), (1,),
+                ascending=(False,))
 
 
 def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
@@ -229,11 +258,15 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     c_nationkey = s_nationkey co-nation predicate, then revenue per nation
     sorted descending. Returns (n_nationkey, revenue).
 
-    The post-join tail (co-nation filter, revenue groupby, desc sort) runs
-    through the whole-plan compiler when local and at or above the
-    ``plan.min_rows`` floor (``engine="plan"`` forces it);
-    ``engine="eager"`` forces the op-by-op path (the equivalence
-    oracle)."""
+    Locally at or above the ``plan.min_rows`` floor the WHOLE query —
+    all four joins included — runs as ONE fused XLA program over the
+    five-table plan DAG (``engine="plan"`` forces it): one guarded
+    dispatch, one host sync. ``engine="eager"`` forces the op-by-op path
+    (the equivalence oracle); mesh runs keep the distributed eager
+    path."""
+    if _use_plan(engine, lineitem.num_rows, mesh):
+        return execute_plan(_q5_plan(region_code, date_lo, date_hi),
+                            [cust, orders, lineitem, supplier, nation])
     od = orders.columns[2].data
     join, group = _plan_ops(mesh)
 
@@ -266,16 +299,6 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     rev_all = (li_jj.columns[2].data.astype(jnp.int64)
                * (100 - li_jj.columns[3].data.astype(jnp.int64)))
     nrows = int(rev_all.shape[0])
-    if _use_plan(engine, nrows, mesh):
-        # post-join tail as ONE fused XLA program (filter -> groupby ->
-        # sort-desc), one guarded dispatch, one host sync
-        gt3 = Table((snat.columns[0],
-                     Column(dt.INT64, nrows, data=rev_all),
-                     Column(dt.BOOL8, nrows,
-                            data=same.astype(jnp.uint8))))
-        tail = Sort(GroupBy(Filter(Scan(3), col(2)), (0,), ((1, "sum"),)),
-                    (1,), ascending=(False,))
-        return execute_plan(tail, gt3)
     gt = Table((snat.columns[0],
                 Column(dt.INT64, nrows, data=rev_all)))
     # co-nation predicate rides the group's row_mask pushdown
@@ -283,17 +306,49 @@ def run_q5(cust: Table, orders: Table, lineitem: Table, supplier: Table,
     return sort_table(g, [1], ascending=[False])
 
 
+def _q3_plan(cutoff: int, segment_code: int, top_k: int):
+    """q3 as a three-input plan DAG — both joins INSIDE the fused
+    program. Inputs: cust=0, orders=1, lineitem=2.
+
+    Shape: date-filtered orders semi-join segment-filtered customers
+    (c_custkey is unique, so semi ≡ the eager inner join that drops the
+    customer columns), then shipdate-filtered lineitem inner-joins those
+    orders on the dense-ascending o_orderkey (direct-addressed probe).
+    The (l_orderkey, o_orderdate, o_shippriority) group key FD-reduces
+    onto l_orderkey alone — orderdate/shippriority are direct-join
+    payload probed by the sibling key — and Sort+Limit fuse to top-k."""
+    cust_f = Filter(Scan(2, input_index=0), col(1) == lit(segment_code))
+    ord_f = Filter(Scan(4, input_index=1), col(2) < lit(cutoff))
+    ord_seg = Join(ord_f, cust_f, (1,), (0,), "semi")
+    li_f = Filter(Scan(4, input_index=2), col(1) > lit(cutoff))
+    j = Join(li_f, ord_seg, (0,), (0,), "inner")
+    # j columns: l_orderkey0 l_shipdate1 l_price2 l_disc3 | o_orderkey4
+    #   o_custkey5 o_orderdate6 o_shippriority7
+    rev = i64(col(2)) * (lit(100) - i64(col(3)))
+    proj = Project(j, (col(0), col(6), col(7), rev))
+    gb = GroupBy(proj, (0, 1, 2), ((3, "sum"),))
+    return Limit(Sort(gb, (3, 1), ascending=(False, True)), top_k)
+
+
 def run_q3(cust: Table, orders: Table, lineitem: Table,
            cutoff: int = CUTOFF_DAYS, segment_code: int = 1,
-           top_k: int = 10, mesh=None) -> Table:
+           top_k: int = 10, mesh=None, engine: str = "auto") -> Table:
     """Execute the q3 pipeline; returns the top-k Table of
     (l_orderkey, o_orderdate, o_shippriority, revenue).
+
+    Locally at or above the ``plan.min_rows`` floor the whole query —
+    joins, FD-reduced groupby, fused top-k — runs as ONE jitted XLA
+    program over the three-table plan DAG (``engine="plan"`` forces it;
+    ``engine="eager"`` keeps the op-by-op oracle).
 
     With ``mesh`` (a jax.sharding.Mesh), the joins and the groupby run
     distributed: hash-partition exchanges over the mesh, local kernels per
     partition (parallel/distributed). Filters are embarrassingly parallel
     and the final sort sees only group-count rows, so both stay local.
     """
+    if _use_plan(engine, lineitem.num_rows, mesh):
+        return execute_plan(_q3_plan(cutoff, segment_code, top_k),
+                            [cust, orders, lineitem])
     join, group = _plan_ops(mesh)
     # one plan for both modes: filters ride the joins' mask pushdown
     # (gather maps index the ORIGINAL tables; the mesh wrappers realize the

@@ -87,7 +87,21 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_sharded_plan.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 7 — exception-fault storms over the whole chaos-marked suite
+# stage 7 — fused-join fault storms: TRANSIENT and permanent-STALL
+# injection at the plan_execute surface while a multi-join DAG (the q5
+# shape: 4 joins + groupby in ONE program) is in flight. Pass criteria
+# baked into the tests (tests/test_plan_join.py chaos marks): retries
+# re-dispatch the SAME fused program from immutable inputs (zero eager
+# join fallbacks), stalls are watchdog-cancelled and re-run, and every
+# recovered result is bit-identical to the clean run. The outer
+# `timeout` is part of the contract — if the fused re-dispatch ever
+# wedges mid-DAG, the kill fails the lane loudly instead of hanging CI.
+# `make join` runs the full join-plan lane.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_plan_join.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 8 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

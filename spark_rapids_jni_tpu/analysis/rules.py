@@ -24,6 +24,9 @@ Rule IDs:
            serving/ (device work must route through guarded_dispatch)
   SRJT014  sharding annotation minted outside plan/sharding.py, or host
            sync / dispatch guard inside a shard_map body
+  SRJT015  host sync or any dispatch inside a join plan core, or a
+           join-order decision (order_joins/estimate_rows/JoinDecision)
+           outside plan/planner.py
 """
 
 from __future__ import annotations
@@ -1219,13 +1222,104 @@ def rule_srjt014(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT015 — join-plan discipline: pure join cores, join ordering in the
+# planner only
+# ---------------------------------------------------------------------------
+
+# Join build/probe cores trace into the middle of a fused DAG program
+# between other pipelines' cores — a host sync there splits the program
+# at its most expensive point (the build/probe boundary), and any
+# dispatch (guarded or raw) nests under the executor's single
+# guarded_dispatch("plan_execute"). Stricter than SRJT011: raw dispatch
+# primitives (jax.device_put / block_until_ready) are flagged too, since
+# a join core is handed device-resident build state and must never
+# re-materialize it. And join ORDERING is a planner decision: the cost
+# model (estimate_rows) and the reorder pass (order_joins) live in
+# plan/planner.py and are reached elsewhere only through ``optimize`` /
+# ``plan_decisions`` — a direct call anywhere else forks the cost model
+# and silently diverges the ProgramCache's decision suffix. Two clauses:
+#
+#   (a) host sync / guarded_dispatch / raw dispatch primitive inside a
+#       ``@plan_core`` function whose registered name starts with
+#       ``join`` (the build/probe cores in ops/join.py);
+#   (b) ``order_joins(...)`` / ``estimate_rows(...)`` / a minted
+#       ``JoinDecision(...)`` outside plan/planner.py.
+
+_SRJT015_HOME = "plan/planner.py"
+_SRJT015_ORDER_FNS = ("order_joins", "estimate_rows", "JoinDecision")
+
+
+def _is_join_core(fn) -> bool:
+    if not _plan_core_decorated(fn):
+        return False
+    if fn.name.split("_", 1)[0] == "join":
+        return True
+    for dec in fn.decorator_list:   # registered name: @plan_core("join_x")
+        if isinstance(dec, ast.Call) and dec.args:
+            reg = _const_str(dec.args[0])
+            if reg is not None and reg.startswith("join"):
+                return True
+    return False
+
+
+def rule_srjt015(tree, rel, lines, ctx) -> List[Finding]:
+    in_home = rel.endswith(_SRJT015_HOME)
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        # clause (b): join-order decision minted outside the planner
+        if not in_home and dn is not None \
+                and dn.split(".")[-1] in _SRJT015_ORDER_FNS:
+            findings.append(Finding(
+                "SRJT015", rel, node.lineno,
+                f"`{dn}(...)` outside plan/planner.py — join ordering is "
+                f"a planner decision: call plan.optimize/plan_decisions "
+                f"instead, so the cost model stays in one module and the "
+                f"ProgramCache decision suffix cannot diverge"))
+            continue
+        # clause (a): impure call inside a join plan core
+        core = None
+        for a in anc:
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_join_core(a):
+                core = a
+        if core is None:
+            continue
+        what = None
+        if dn is not None and dn.split(".")[-1] == "guarded_dispatch":
+            what = "guarded_dispatch(...)"
+        elif dn in _DISPATCH_PRIMS:
+            what = dn
+        elif dn in _HOST_SYNC_CALLS:
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue  # literal args never touch a device buffer
+            what = dn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _HOST_SYNC_METHODS
+              | {"block_until_ready"}):
+            what = f".{node.func.attr}()"
+        if what is not None:
+            findings.append(Finding(
+                "SRJT015", rel, node.lineno,
+                f"`{what}` inside join plan core `{core.name}` — join "
+                f"build/probe cores trace into the middle of a fused DAG "
+                f"program: they must stay pure jnp, with the one "
+                f"sync/guard boundary at guarded_dispatch(\"plan_execute\")"
+                f" in plan/executor.py"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
-              rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014)
+              rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
+              rule_srjt015)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -30,6 +30,42 @@ from .dtype import DType, TypeId
 from . import dtype as dt
 
 
+@dataclass(frozen=True)
+class ColumnStats:
+    """Advisory value statistics for an integer column.
+
+    The planner (plan/planner.py) uses these to pick cheap join/groupby
+    strategies — direct-addressed joins when a build key is a dense
+    ascending sequence, direct-slot groupbys when a key's span is small.
+    Stats are ADVISORY ONLY: every strategy picked from them re-checks the
+    claimed property on device and folds a violation into the plan's
+    overflow flag, so lying stats cost a fallback, never a wrong answer.
+
+      lo / hi:          inclusive value bounds over ALL rows (the raw data
+                        buffer, including rows a validity mask nulls out —
+                        fused lowering evaluates dead lanes too).
+      unique:           values are pairwise distinct.
+      ascending_dense:  data == arange(n) + lo exactly.
+    """
+
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+    unique: bool = False
+    ascending_dense: bool = False
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray) -> "ColumnStats":
+        """Honest stats computed from a host integer array."""
+        if arr.size == 0 or not np.issubdtype(arr.dtype, np.integer):
+            return ColumnStats()
+        lo = int(arr.min())
+        hi = int(arr.max())
+        dense = bool(hi - lo == arr.size - 1) and bool(
+            np.array_equal(arr, np.arange(arr.size, dtype=arr.dtype) + lo))
+        unique = dense or bool(len(np.unique(arr)) == arr.size)
+        return ColumnStats(lo=lo, hi=hi, unique=unique, ascending_dense=dense)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Column:
@@ -157,6 +193,21 @@ class Column:
 
     def with_validity(self, validity: Optional[jnp.ndarray]) -> "Column":
         return replace(self, validity=validity)
+
+    # ---- advisory stats ---------------------------------------------------
+    # Carried as a non-pytree attribute (same pattern as the host mirror
+    # caches): stats never enter traced programs, they only shape host-side
+    # planning, so they must not perturb pytree structure or jit keys.
+    # dataclasses.replace() and tree_unflatten intentionally drop them —
+    # a derived column's stats are unknown unless re-attached.
+    def with_stats(self, stats: Optional[ColumnStats]) -> "Column":
+        """Attach advisory stats; returns self (chainable)."""
+        if stats is not None:
+            object.__setattr__(self, "_stats", stats)
+        return self
+
+    def stats(self) -> Optional[ColumnStats]:
+        return getattr(self, "_stats", None)
 
     # ---- host constructors ------------------------------------------------
     @staticmethod

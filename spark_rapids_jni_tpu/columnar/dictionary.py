@@ -218,24 +218,38 @@ def lookup_code(col: Column, value) -> int:
 # cross-dictionary alignment (joins, concat)
 # ---------------------------------------------------------------------------
 
+def code_remap_table(left: Column, right: Column) -> Optional[np.ndarray]:
+    """Host int32 remap array for a DICT32 join-key pair: remap[right_code]
+    = left code of the same entry, or -1 when the entry is absent from the
+    left dictionary (-1 equals no left code). Returns None for
+    co-dictionary pairs (codes already comparable). Memoization rides the
+    left dictionary's ``_dict_index`` — the array itself is tiny (one
+    int32 per right dictionary entry) and the fused plan path feeds it to
+    the compiled program as an auxiliary traced input, so a changed
+    dictionary changes data, not program structure."""
+    if same_dictionary(left, right):
+        return None
+    lv, rv = dict_values(left), dict_values(right)
+    index = getattr(lv, "_dict_index", None)
+    if index is None:
+        index = {e: i for i, e in enumerate(_entries(lv))}
+        object.__setattr__(lv, "_dict_index", index)
+    return np.array([index.get(e, -1) for e in _entries(rv)],
+                    dtype=np.int32)
+
+
 def align_codes(left: Column, right: Column) -> Tuple[Column, Column]:
     """Plain INT32 code columns for a DICT32 join-key pair, comparable by
     value. Co-dictionary pairs pass codes through untouched; otherwise the
     right side's codes are re-mapped into the left dictionary host-side
-    (once per dictionary PAIR, not per row batch) with absent entries -> -1,
-    which equals no left code."""
+    (once per dictionary PAIR, not per row batch — see code_remap_table)
+    with absent entries -> -1, which equals no left code."""
     lcol = Column(dt.INT32, left.size, data=left.data, validity=left.validity)
-    if same_dictionary(left, right):
+    remap = code_remap_table(left, right)
+    if remap is None:
         rdata = right.data
     else:
-        lv, rv = dict_values(left), dict_values(right)
-        index = getattr(lv, "_dict_index", None)
-        if index is None:
-            index = {e: i for i, e in enumerate(_entries(lv))}
-            object.__setattr__(lv, "_dict_index", index)
-        remap = np.array([index.get(e, -1) for e in _entries(rv)],
-                         dtype=np.int32)
-        nd = rv.size
+        nd = dict_values(right).size
         if nd:
             rdata = jnp.take(jnp.asarray(remap),
                              jnp.clip(right.data, 0, nd - 1))

@@ -533,6 +533,116 @@ def groupby_core(keys: List[Column], aggs: Sequence[Tuple[Column, str]],
     return out_cols, live_groups, overflow
 
 
+@plan_core("groupby_direct_small")
+def groupby_direct_small_core(key: jnp.ndarray, value: jnp.ndarray,
+                              row_mask, lo: int, span: int,
+                              num_slots: int, chunk: int):
+    """Direct-slot groupby for a single int key with a TINY span and one
+    integer sum aggregate — the fused-plan fast path for TPC-H q5-shaped
+    tails (few-group sums over millions of rows).
+
+    Rows pack ``(group_slot << 48) | value`` into one int64 word (slot 0 =
+    dead row), reshape to [n/chunk, chunk], and a ``lax.scan`` accumulates
+    per-slot masked sums — one sequential pass, no scatter, no sort:
+    ~5x faster than segment_sum at span <= 64 on XLA:CPU (measured
+    PLAN_JOIN_r07). Liveness falls out of the sum: the planner only picks
+    this core when stats prove every row's value is in (0, 2^48), so a
+    slot is live iff its sum is positive. ``bad`` re-checks the span and
+    value-range claims on device over every LIVE row (violators pack
+    into a sentinel slot inside the same pass) — a violation is an
+    overflow, never a wrong answer, and dead rows can't corrupt the sum
+    either way.
+
+    Returns ``(slot_keys i64[G], sums i64[G], live i32, bad bool)`` with
+    live slots compacted to a key-ascending prefix (matching the eager
+    op's group order), G = ``num_slots`` >= span + 1."""
+    n = key.shape[0]
+    keep = row_mask if row_mask is not None else jnp.ones((n,), dtype=bool)
+    ok = ((key >= lo) & (key < lo + span)
+          & (value > 0) & (value < (jnp.int64(1) << 48)))
+    # LIVE rows that violate the advisory claims pack into a sentinel
+    # slot (span + 1) with a nonzero payload, so the violation check
+    # rides the same single pass as the sum — no separate all-rows
+    # reduce kernels. Dead rows contribute nothing either way, so
+    # live-only checking keeps the result exact; a live violation makes
+    # ``bad`` fire and the executor falls back to eager.
+    gid = jnp.where(keep, jnp.where(ok, key - lo + 1, span + 1),
+                    0).astype(jnp.int64)
+    packed = (gid << 48) | jnp.where(keep, jnp.where(ok, value, 1), 0)
+    pad = (-n) % chunk
+    if pad:
+        packed = jnp.concatenate([packed,
+                                  jnp.zeros((pad,), dtype=jnp.int64)])
+    wv = packed.reshape(-1, chunk)
+    # the scan accumulator is span-sized, NOT num_slots-sized: span is
+    # static in the program key, and broadcasting the per-chunk compare
+    # over the bucket-padded num_slots (1024 floor) makes the pass ~40x
+    # wider than a q5-shaped span needs (0.8s -> 20ms at 1M rows).
+    nacc = span + 2  # + slot 0 = dead rows, slot span+1 = violations
+    sgids = jnp.arange(nacc, dtype=jnp.int64)
+
+    def step(acc, wc):
+        t = wc >> 48
+        r = wc & ((jnp.int64(1) << 48) - 1)
+        return acc + jnp.sum(
+            jnp.where(t[None, :] == sgids[:, None], r[None, :],
+                      jnp.int64(0)), axis=1), None
+
+    small, _ = jax.lax.scan(step, jnp.zeros((nacc,), jnp.int64), wv)
+    bad = small[span + 1] > 0
+    sums = jnp.zeros((num_slots,), jnp.int64).at[:span + 1].set(
+        small[:span + 1])
+    gids = jnp.arange(num_slots, dtype=jnp.int64)
+    livem = (sums > 0) & (gids > 0)
+    order = jnp.argsort(jnp.where(livem, gids,
+                                  jnp.int64(num_slots))).astype(jnp.int32)
+    slot_keys = jnp.take(gids, order) - 1 + lo
+    live = jnp.sum(livem).astype(jnp.int32)
+    return slot_keys, jnp.take(sums, order), live, bad
+
+
+@plan_core("groupby_direct_wide")
+def groupby_direct_wide_core(key: jnp.ndarray, aggs, row_mask,
+                             lo: int, span: int, num_slots: int,
+                             live_agg):
+    """Direct-slot groupby for a single int key with a WIDE span (up to
+    ~2^21 slots): one scatter-add per aggregate instead of the generic
+    core's n-row lexsort — the fused-plan path for q3-shaped groupbys
+    (many groups, integer sums). ``aggs``: (value i64[n] | None, op) with
+    op in sum/count (count ignores the value). ``live_agg``: index of a
+    sum aggregate whose per-row value stats prove > 0, making slot
+    liveness free (sum > 0); None adds a dedicated count scatter.
+
+    Slots stay in key order WITHOUT compaction — output slot s holds key
+    ``lo + s`` and ``live_mask[s]`` marks real groups (the executor's
+    mask-gather trim, or a downstream fused sort, orders them). ``bad``
+    re-checks the span claim on device (overflow semantics).
+
+    Returns ``(slot_keys i64[G], out_sums tuple, live_mask bool[G],
+    live i32, bad bool)``."""
+    n = key.shape[0]
+    bad = ~jnp.all((key >= lo) & (key < lo + span))
+    keep = row_mask if row_mask is not None else jnp.ones((n,), dtype=bool)
+    seg = jnp.clip(key - lo, 0, num_slots - 1).astype(jnp.int32)
+    outs = []
+    for val, op in aggs:
+        if op == "count":
+            contrib = keep.astype(jnp.int64)
+        else:
+            contrib = jnp.where(keep, val, jnp.int64(0))
+        outs.append(jax.ops.segment_sum(contrib, seg,
+                                        num_segments=num_slots))
+    if live_agg is None:
+        cnt = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
+                                  num_segments=num_slots)
+        live_mask = cnt > 0
+    else:
+        live_mask = outs[live_agg] > 0
+    slot_keys = jnp.arange(num_slots, dtype=jnp.int64) + lo
+    live = jnp.sum(live_mask).astype(jnp.int32)
+    return slot_keys, tuple(outs), live_mask, live, bad
+
+
 def _shrink(col: Column, n: int) -> Column:
     """Trim a bucket-padded result column to the true group count — the
     only per-distinct-count program this op compiles (one slice for

@@ -420,3 +420,87 @@ def left_anti_join(left_keys, right_keys,
     """Indices of left rows with no match."""
     l_idx, _ = _candidates(left_keys, right_keys, nulls_equal)
     return np.flatnonzero(~_matched_mask(l_idx, left_keys[0].size))
+
+
+# ---------------------------------------------------------------------------
+# fused-plan join cores
+# ---------------------------------------------------------------------------
+# Pure jnp build/probe pieces the DAG lowering (plan/compile.py) traces into
+# ONE program with everything up- and downstream. Same key-equality contract
+# as the eager wrappers above (null keys never match — the poison-hash rule;
+# DICT32 keys compare as codes after the executor's align_codes remap), but
+# restricted to UNIQUE single-column int builds: the probe side keeps its
+# static lane count (r_idx, found) instead of an expanded gather map. A
+# duplicate-key build is detected ON DEVICE and raises the plan's overflow
+# flag → the executor replays through the eager wrappers, which expand.
+# SRJT015 keeps these bodies free of host syncs and raw dispatches, and the
+# join-order choice lives in plan/planner.py, not here.
+
+@plan_core("join_build_sorted")
+def join_build_sorted_core(build_keys: jnp.ndarray, build_live):
+    """Sort-based build over int64 key values (n >= 1).
+
+    ``build_live``: optional bool[n] — rows that may match (validity AND
+    any carried filter mask AND, for cross-dictionary keys, remapped code
+    >= 0). Dead rows sort after live rows within each key run so the
+    probe's leftmost-hit lands on a live row whenever one exists.
+
+    Returns ``(order, sorted_keys, sorted_live, dup)`` with ``dup`` a
+    device bool: some key occurs on more than one LIVE build row (the
+    fused join would need row expansion → overflow)."""
+    rn = build_keys.shape[0]
+    if build_live is None:
+        build_live = jnp.ones((rn,), dtype=bool)
+    dead = (~build_live).astype(jnp.uint8)
+    order = jnp.lexsort((dead, build_keys)).astype(jnp.int32)
+    sk = jnp.take(build_keys, order)
+    sl = jnp.take(build_live, order)
+    if rn > 1:
+        dup = jnp.any((sk[1:] == sk[:-1]) & sl[1:] & sl[:-1])
+    else:
+        dup = jnp.zeros((), dtype=bool)
+    return order, sk, sl, dup
+
+
+@plan_core("join_probe_sorted")
+def join_probe_sorted_core(order: jnp.ndarray, sorted_keys: jnp.ndarray,
+                           sorted_live: jnp.ndarray,
+                           probe_keys: jnp.ndarray):
+    """Binary-search probe against a sorted unique build.
+
+    Returns ``(r_idx i32[n], found bool[n])``: the build row index each
+    probe lane matched (garbage where not found) and the match mask.
+    Callers AND in probe-side validity — a null probe key never matches."""
+    rn = sorted_keys.shape[0]
+    pos = jnp.searchsorted(sorted_keys, probe_keys)
+    posc = jnp.minimum(pos, rn - 1).astype(jnp.int32)
+    found = ((pos < rn)
+             & (jnp.take(sorted_keys, posc) == probe_keys)
+             & jnp.take(sorted_live, posc))
+    r_idx = jnp.take(order, posc)
+    return r_idx, found
+
+
+@plan_core("join_probe_direct")
+def join_probe_direct_core(build_keys: jnp.ndarray, build_live,
+                           lo: int, probe_keys: jnp.ndarray):
+    """Direct-addressed probe for a build key the planner believes is the
+    dense ascending sequence ``arange(n) + lo``: the build table IS the
+    hash table, the probe is one subtract + gather (no sort, no search).
+
+    The density claim is ADVISORY — ``bad`` re-checks it on device and the
+    executor treats it as overflow, so lying stats fall back to eager
+    instead of mis-joining. Dense ascending keys are automatically unique,
+    so no duplicate check is needed.
+
+    Returns ``(r_idx i32[n], found bool[n], bad device-bool)``."""
+    rn = build_keys.shape[0]
+    bad = ~jnp.all(build_keys
+                   == jnp.arange(rn, dtype=build_keys.dtype) + lo)
+    idx = probe_keys - lo
+    in_range = (idx >= 0) & (idx < rn)
+    r_idx = jnp.clip(idx, 0, rn - 1).astype(jnp.int32)
+    found = in_range
+    if build_live is not None:
+        found = found & jnp.take(build_live, r_idx)
+    return r_idx, found, bad

@@ -207,6 +207,39 @@ def gather(col: Column, idx: jnp.ndarray) -> Column:
                   validity=validity)
 
 
+@plan_core("select_topk")
+def select_topk_core(lanes: Sequence[jnp.ndarray], live: jnp.ndarray,
+                     k: int) -> jnp.ndarray:
+    """Row indices of the top ``k`` live rows under ``sort_lanes`` order —
+    the fused Sort+Limit(k) path: k selection rounds (min over the primary
+    lane, tie-broken down the minor lanes, first-row-index tie-break)
+    replace the full lexsort + compaction gather, turning an O(n log n)
+    sort of n rows into k O(n) reductions. Because the lanes come from the
+    SAME ``sort_lanes`` the eager path lexsorts, null placement and
+    descending flags behave identically, and the argmax first-index
+    tie-break reproduces the stable lexsort's lowest-row-index-first
+    order — fused output is bit-identical to eager sort+slice.
+
+    ``live``: bool[n] keep-mask (non-prefix masks fine). Rounds past the
+    live-row count return garbage indices the caller masks off via its
+    own live count. Pure jnp; k is static and small (plan.topk_max)."""
+    n = live.shape[0]
+    rowids = jnp.arange(n, dtype=jnp.int32)
+    alive = live
+    picks = []
+    for _ in range(k):
+        cand = alive
+        for lane in reversed(lanes):  # primary lane first
+            # typed scalar: uint64 max overflows the default-int path
+            pad = jnp.asarray(jnp.iinfo(lane.dtype).max, dtype=lane.dtype)
+            m = jnp.min(jnp.where(cand, lane, pad))
+            cand = cand & (lane == m)
+        w = jnp.argmax(cand).astype(jnp.int32)
+        picks.append(w)
+        alive = alive & (rowids != w)
+    return jnp.stack(picks)
+
+
 @func_range()
 def sort_table(table: Table, key_indices: Sequence[int],
                ascending: Optional[Sequence[bool]] = None,

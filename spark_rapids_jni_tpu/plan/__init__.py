@@ -22,7 +22,14 @@ _LAZY = {
     "GroupBy": ".nodes",
     "Sort": ".nodes",
     "Limit": ".nodes",
+    "Join": ".nodes",
     "fingerprint": ".nodes",
+    "is_dag": ".nodes",
+    "walk": ".nodes",
+    "optimize": ".planner",
+    "plan_decisions": ".planner",
+    "push_filters": ".planner",
+    "source_predicates": ".planner",
     "ProgramCache": ".compile",
     "plan_metrics": ".compile",
     "execute_plan": ".executor",

@@ -38,13 +38,16 @@ import jax.numpy as jnp
 
 from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
-from ..ops.groupby import groupby_core
-from ..ops.sort import gather, sort_lanes
+from ..ops.groupby import (groupby_core, groupby_direct_small_core,
+                           groupby_direct_wide_core)
+from ..ops.join import (join_build_sorted_core, join_probe_direct_core,
+                        join_probe_sorted_core)
+from ..ops.sort import gather, select_topk_core, sort_lanes
 from ..utils import config
 from ..utils.shapes import bucket_size
 from . import expr as ex
-from .nodes import (Filter, GroupBy, Limit, PlanError, PlanNode, Project,
-                    Scan, Sort, fingerprint, linearize)
+from .nodes import (Filter, GroupBy, Join, Limit, PlanError, PlanNode,
+                    Project, Scan, Sort, fingerprint, linearize)
 
 
 class PlanMetrics:
@@ -54,7 +57,8 @@ class PlanMetrics:
     domain's fixed counter set."""
 
     _COUNTERS = ("plan_compiles", "plan_cache_hits", "plan_cache_misses",
-                 "plan_executes", "plan_fallbacks", "plan_overflows")
+                 "plan_executes", "plan_fallbacks", "plan_join_fallbacks",
+                 "plan_overflows")
     _TIMES = ("compile_s", "execute_s")
 
     def __init__(self):
@@ -65,10 +69,18 @@ class PlanMetrics:
         with self._lock:
             self._c = {k: 0 for k in self._COUNTERS}
             self._t = {k: 0.0 for k in self._TIMES}
+            self._reasons: Dict[str, int] = {}
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
             self._c[name] += by
+
+    def inc_fallback_reason(self, reason: str) -> None:
+        """Per-reason fallback label (overflow vs unsupported-node vs
+        planner gate ...) so serving metrics can tell fallback causes
+        apart; the reason string is a short stable slug, not free text."""
+        with self._lock:
+            self._reasons[reason] = self._reasons.get(reason, 0) + 1
 
     def add_time(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -78,6 +90,7 @@ class PlanMetrics:
         with self._lock:
             out: Dict[str, Any] = dict(self._c)
             out.update({k: round(v, 6) for k, v in self._t.items()})
+            out["plan_fallback_reasons"] = dict(self._reasons)
             return out
 
 
@@ -212,6 +225,267 @@ def _make_fn(plan: PlanNode, max_groups: int, out_info: Dict[str, Any]):
     return fn
 
 
+@dataclasses.dataclass
+class _DagState:
+    """Per-subtree lowering state: traced columns, carried keep-mask
+    (None = all rows live), static lane count, and whether live rows are
+    a prefix of the lanes."""
+
+    cols: list
+    mask: Optional[jnp.ndarray]
+    n: int
+    prefix: bool
+
+
+def _key_values(col: Column) -> jnp.ndarray:
+    """int64 join-key lane for an integer or DICT32 (code) column."""
+    return col.data.astype(jnp.int64)
+
+
+def _gather_probe(rc: Column, r_idx: jnp.ndarray, found: jnp.ndarray,
+                  how: str) -> Column:
+    """Build-side payload gather at probe positions. ``r_idx`` is
+    clipped-in-range even for misses, so the gather itself is always
+    safe; miss lanes hold garbage that the row mask (inner) or the
+    validity bits (left) hide. DICT32 children (dictionary values/ranks)
+    stay shared by reference — only codes are row-indexed."""
+    if rc.offsets is not None:
+        # executor input gating keeps offset columns out of DAG plans
+        raise PlanError("offset-based column on a join build side")
+    data = (jnp.take(rc.data, r_idx, axis=0)
+            if rc.data is not None else None)
+    validity = (jnp.take(rc.validity, r_idx)
+                if rc.validity is not None else None)
+    if how == "left":
+        # LEFT OUTER: miss lanes keep the probe row and null the payload.
+        # Miss lanes survive into the output (validity-nulled, never
+        # mask-dropped), so their data is pinned to dtype-zero — the
+        # canonical value the eager interpreter also writes — keeping
+        # left-join results bit-identical under the nulls.
+        if data is not None:
+            f = found.reshape(found.shape + (1,) * (data.ndim - 1))
+            data = jnp.where(f, data, jnp.zeros((), data.dtype))
+        validity = found if validity is None else (validity & found)
+    return Column(rc.dtype, int(r_idx.shape[0]), data=data,
+                  validity=validity, children=rc.children)
+
+
+def _make_dag_fn(plan: PlanNode, decisions, max_groups: int,
+                 out_info: Dict[str, Any]):
+    """Build the traceable whole-DAG function: multiple input tables,
+    Join nodes lowered to build/probe cores, GroupBy/Sort+Limit lowered
+    to the planner-picked strategies. Same contract as ``_make_fn`` —
+    one function of the input pytree, zero host syncs inside, returns
+    ``(columns, mask, head)`` with every advisory-stats claim re-checked
+    on device and folded into the overflow flag.
+
+    ``decisions`` is a planner.PlanDecisions for THIS plan object (the
+    by_node map keys on node identity); ``aux`` carries one int32
+    code-remap array per cross-dictionary join, in ``dict_joins``
+    iteration order."""
+    aux_pos = {jid: i for i, jid in enumerate(decisions.dict_joins)}
+
+    def fn(tables: Tuple[Tuple[Column, ...], ...],
+           aux: Tuple[jnp.ndarray, ...]):
+        overflow = [jnp.asarray(False)]
+        # per-join build context for FD reprobe at GroupBy lowering
+        join_env: Dict[int, Dict[str, Any]] = {}
+
+        def lower_join(node: Join) -> _DagState:
+            ls = rec(node.left)
+            rs = rec(node.right)
+            dec = decisions.of(node)
+            lkey = ls.cols[node.left_on[0]]
+            rkey = rs.cols[node.right_on[0]]
+            pk = _key_values(lkey)
+            blive = rs.mask
+            if rkey.validity is not None:
+                blive = (rkey.validity if blive is None
+                         else blive & rkey.validity)
+            if dec.dict_remap:
+                remap = aux[aux_pos[id(node)]]
+                nd = int(remap.shape[0])
+                if nd:
+                    bk = jnp.take(remap, jnp.clip(rkey.data, 0, nd - 1)
+                                  ).astype(jnp.int64)
+                else:  # empty right dictionary: nothing can match
+                    bk = jnp.full(rkey.data.shape, -1, dtype=jnp.int64)
+                alive = bk >= 0
+                blive = alive if blive is None else blive & alive
+            else:
+                bk = _key_values(rkey)
+            if dec.strategy == "direct":
+                r_idx, found, bad = join_probe_direct_core(
+                    bk, blive, dec.lo, pk)
+                overflow[0] = overflow[0] | bad
+            else:
+                order, sk, sl, dup = join_build_sorted_core(bk, blive)
+                overflow[0] = overflow[0] | dup
+                r_idx, found = join_probe_sorted_core(order, sk, sl, pk)
+            if lkey.validity is not None:
+                found = found & lkey.validity
+            join_env[id(node)] = {"dec": dec, "bk": bk, "blive": blive,
+                                  "rcols": rs.cols}
+            if node.how == "semi":
+                m = found if ls.mask is None else ls.mask & found
+                return _DagState(list(ls.cols), m, ls.n, False)
+            if node.how == "anti":
+                # NOT EXISTS: null probe keys never match -> kept
+                m = ~found if ls.mask is None else ls.mask & ~found
+                return _DagState(list(ls.cols), m, ls.n, False)
+            out = list(ls.cols)
+            for rc in rs.cols:
+                out.append(_gather_probe(rc, r_idx, found, node.how))
+            if node.how == "inner":
+                m = found if ls.mask is None else ls.mask & found
+                return _DagState(out, m, ls.n, False)
+            return _DagState(out, ls.mask, ls.n, ls.prefix)  # left
+
+        def fd_reprobe(jid: int, slot_keys: jnp.ndarray):
+            """Re-probe a direct join's build at the groupby slot keys —
+            the FD-reduction gather that restores a dropped key column."""
+            env = join_env[jid]
+            bk, lo = env["bk"], env["dec"].lo
+            rn = bk.shape[0]
+            # inner + not-null payload: every LIVE slot key matched a live
+            # in-range build row, so no found-mask is needed here — dead
+            # slots gather garbage the live mask already hides
+            idx = slot_keys - lo
+            return jnp.clip(idx, 0, rn - 1).astype(jnp.int32)
+
+        def lower_groupby(node: GroupBy) -> _DagState:
+            st = rec(node.child)
+            dec = decisions.of(node)
+            strat = dec.strategy if dec is not None else "generic"
+            if strat == "generic":
+                G = bucket_size(min(max_groups, st.n))
+                keys = [st.cols[i] for i in node.keys]
+                aggs = [(st.cols[i], op) for i, op in node.aggs]
+                cols, live, ov = groupby_core(keys, aggs, st.mask, G)
+                overflow[0] = overflow[0] | ov
+                m = jnp.arange(G, dtype=jnp.int32) < live
+                return _DagState(list(cols), m, G, True)
+            if strat == "direct_small":
+                kcol = st.cols[node.keys[0]]
+                vi, _ = node.aggs[0]
+                value = st.cols[vi].data.astype(jnp.int64)
+                slot_keys, sums, live, bad = groupby_direct_small_core(
+                    kcol.data.astype(jnp.int64), value, st.mask,
+                    dec.lo, dec.span, dec.num_slots, dec.chunk)
+                overflow[0] = overflow[0] | bad
+                G = dec.num_slots
+                cols = [Column(kcol.dtype, G,
+                               data=slot_keys.astype(kcol.dtype.jnp_dtype)),
+                        Column(dt.INT64, G, data=sums)]
+                m = jnp.arange(G, dtype=jnp.int32) < live
+                return _DagState(cols, m, G, True)
+            # direct_wide: slots stay in key order, live mask NON-prefix
+            dropped = {e[0] for e in dec.fd_drop}
+            kept_pos = next(p for p in range(len(node.keys))
+                            if p not in dropped)
+            kcol = st.cols[node.keys[kept_pos]]
+            aggs_in = []
+            for i, op in node.aggs:
+                v = (None if op == "count"
+                     else st.cols[i].data.astype(jnp.int64))
+                aggs_in.append((v, op))
+            slot_keys, outs, live_mask, live, bad = \
+                groupby_direct_wide_core(
+                    kcol.data.astype(jnp.int64), tuple(aggs_in), st.mask,
+                    dec.lo, dec.span, dec.num_slots, dec.live_agg)
+            overflow[0] = overflow[0] | bad
+            G = dec.num_slots
+            nk = len(node.keys)
+            cols: list = [None] * (nk + len(node.aggs))
+            cols[kept_pos] = Column(
+                kcol.dtype, G, data=slot_keys.astype(kcol.dtype.jnp_dtype))
+            for pos, jid, rloc in dec.fd_drop:
+                rc = join_env[jid]["rcols"][rloc]
+                r_idx = fd_reprobe(jid, slot_keys)
+                cols[pos] = Column(rc.dtype, G,
+                                   data=jnp.take(rc.data, r_idx, axis=0))
+            for j in range(len(node.aggs)):
+                cols[nk + j] = Column(dt.INT64, G, data=outs[j])
+            return _DagState(cols, live_mask, G, False)
+
+        def rec(node) -> _DagState:
+            if isinstance(node, Scan):
+                cols = list(tables[node.input_index])
+                if len(cols) != node.ncols:
+                    raise PlanError(f"plan expects {node.ncols} columns "
+                                    f"for input {node.input_index}, got "
+                                    f"{len(cols)}")
+                return _DagState(cols, None, cols[0].size, True)
+            if isinstance(node, Filter):
+                st = rec(node.child)
+                keep = ex.predicate_mask(
+                    ex.eval_expr(node.predicate, st.cols))
+                m = keep if st.mask is None else st.mask & keep
+                return _DagState(st.cols, m, st.n, False)
+            if isinstance(node, Project):
+                st = rec(node.child)
+                cols = [ex.project_column(e, st.cols, st.n)
+                        for e in node.exprs]
+                return _DagState(cols, st.mask, st.n, st.prefix)
+            if isinstance(node, Join):
+                return lower_join(node)
+            if isinstance(node, GroupBy):
+                return lower_groupby(node)
+            if isinstance(node, Sort):
+                dec = decisions.of(node)
+                if dec is not None and dec.strategy == "skip":
+                    return rec(node.child)  # folded into Limit topk
+                st = rec(node.child)
+                keys = [st.cols[i] for i in node.keys]
+                lanes = sort_lanes(keys, node.ascending, node.nulls_first)
+                if st.mask is not None:
+                    lanes.append((~st.mask).astype(jnp.uint8))
+                order = jnp.lexsort(tuple(lanes)).astype(jnp.int32)
+                cols = [gather(c, order) for c in st.cols]
+                m = (jnp.take(st.mask, order)
+                     if st.mask is not None else None)
+                return _DagState(cols, m, st.n, True)
+            if isinstance(node, Limit):
+                dec = decisions.of(node)
+                if dec is not None and dec.strategy == "topk":
+                    sort_node = node.child
+                    st = rec(sort_node.child)
+                    keys = [st.cols[i] for i in sort_node.keys]
+                    lanes = sort_lanes(keys, sort_node.ascending,
+                                       sort_node.nulls_first)
+                    livem = (st.mask if st.mask is not None
+                             else jnp.ones((st.n,), dtype=bool))
+                    k = min(dec.k, st.n)
+                    idx = select_topk_core(lanes, livem, k)
+                    cols = [gather(c, idx) for c in st.cols]
+                    nlive = jnp.minimum(
+                        jnp.sum(livem, dtype=jnp.int32), jnp.int32(k))
+                    m = jnp.arange(k, dtype=jnp.int32) < nlive
+                    return _DagState(cols, m, k, True)
+                st = rec(node.child)
+                if st.mask is not None and not st.prefix:
+                    raise PlanError(
+                        "Limit needs prefix-compacted rows — place it "
+                        "after a Sort or GroupBy, not directly on a "
+                        "Filter or Join")
+                k = min(node.count, st.n)
+                cols = [_slice_col(c, k) for c in st.cols]
+                m = st.mask[:k] if st.mask is not None else None
+                return _DagState(cols, m, k, st.prefix)
+            raise PlanError(f"unknown plan node {type(node).__name__}")
+
+        st = rec(plan)
+        out_info["has_mask"] = st.mask is not None
+        out_info["prefix"] = st.prefix
+        out_info["n_out"] = st.n
+        live_out = (jnp.int32(st.n) if st.mask is None
+                    else jnp.sum(st.mask, dtype=jnp.int32))
+        head = jnp.stack([live_out, overflow[0].astype(jnp.int32)])
+        return tuple(st.cols), st.mask, head
+
+    return fn
+
+
 class ProgramCache:
     """Compile-once-per-(plan, shape) cache of AOT executables. The
     fingerprint is structural (nodes.py), the shape key is the input
@@ -248,6 +522,47 @@ class ProgramCache:
                             n_out=out_info["n_out"])
         with self._lock:
             # lost race: keep the first compile, drop ours
+            prog = self._programs.setdefault(key, prog)
+        return prog
+
+    def get_or_compile_dag(self, plan: PlanNode,
+                           tables: Tuple[Table, ...], decisions,
+                           aux: Tuple) -> CompiledPlan:
+        """DAG (multi-input, Join-bearing) variant. The key extends the
+        solo key with every input's shape signature, the planner's
+        ``cache_suffix`` (canonical strategy tuples — a stats-driven
+        strategy flip compiles a distinct program instead of aliasing),
+        and the aux remap-array lengths; the "dag" sentinel keeps the
+        namespace disjoint from solo/sharded/vmap entries. Dictionary
+        content is covered by the DICT32 fingerprints inside each
+        ``_shape_key`` — both sides' fingerprints pin the remap arrays'
+        CONTENT, their lengths pin the traced shapes. Never donates:
+        inputs must survive for the eager overflow replay."""
+        max_groups = int(config.get("plan.max_groups"))
+        key = (fingerprint(plan),
+               tuple(_shape_key(t) for t in tables), "dag",
+               max_groups, decisions.cache_suffix,
+               tuple(int(a.shape[0]) for a in aux))
+        with self._lock:
+            prog = self._programs.get(key)
+        if prog is not None:
+            plan_metrics.inc("plan_cache_hits")
+            return prog
+        plan_metrics.inc("plan_cache_misses")
+        t0 = time.perf_counter()
+        out_info: Dict[str, Any] = {}
+        fn = _make_dag_fn(plan, decisions, max_groups, out_info)
+        jitted = jax.jit(fn)
+        compiled = jitted.lower(
+            tuple(tuple(t.columns) for t in tables),
+            tuple(aux)).compile()
+        plan_metrics.add_time("compile_s", time.perf_counter() - t0)
+        plan_metrics.inc("plan_compiles")
+        prog = CompiledPlan(compiled=compiled, fingerprint=key[0],
+                            has_mask=out_info["has_mask"],
+                            prefix=out_info["prefix"],
+                            n_out=out_info["n_out"])
+        with self._lock:
             prog = self._programs.setdefault(key, prog)
         return prog
 

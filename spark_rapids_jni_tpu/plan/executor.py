@@ -14,16 +14,25 @@ vector (live row count, overflow flag). Trimming to the live rows
 happens after that sync — a static prefix slice when the fused state is
 prefix-compacted (post GroupBy/Sort), else a nonzero-gather.
 
-Fallbacks go through ``run_eager`` (plan/interpreter.py) and bump
-``plan_fallbacks``: unsupported input column types, empty input, and
-group-budget overflow detected on device (``plan_overflows``).
+Fallbacks go through ``run_eager`` (plan/interpreter.py), which bumps
+``plan_fallbacks`` plus a per-reason label: unsupported input column
+types, empty input, a planner gate (DAG plans the strategy selector
+can't fuse), and group-budget / join-shape overflow detected on device
+(``plan_overflows``).
+
+DAG plans (Join nodes, multiple input tables) take the same shape of
+path: the cost-shaped planner (plan/planner.py) rewrites and annotates
+the plan, ``ProgramCache.get_or_compile_dag`` lowers the whole DAG into
+ONE fused program, and the identical single guarded dispatch + single
+head sync protocol applies. Join-order and strategy decisions are the
+planner's alone (SRJT015); this module only routes them.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,9 +43,11 @@ from ..columnar.table_ops import gather_table, mask_indices_core
 from ..faultinj.guard import guarded_dispatch
 from ..memory.reservation import device_reservation, release_barrier
 from . import expr as ex
+from . import planner as _planner
 from .compile import CompiledPlan, ProgramCache, plan_metrics
 from .interpreter import run_eager
-from .nodes import Filter, GroupBy, PlanNode, Project, linearize
+from .nodes import (Filter, GroupBy, Join, PlanError, PlanNode, Project,
+                    Scan, is_dag, linearize, num_inputs, walk)
 
 _default_cache = ProgramCache()
 
@@ -45,10 +56,10 @@ def default_cache() -> ProgramCache:
     return _default_cache
 
 
-def unsupported_reason(plan: PlanNode, table: Table) -> Optional[str]:
-    """Why this (plan, table) can't run fused — None when it can.
-    Conservative by design: anything not provably supported falls back
-    to the eager path rather than risking wrong fused results."""
+def _table_unsupported_reason(table: Table) -> Optional[str]:
+    """Why one input table can't feed a fused program — None when it
+    can. Conservative by design: anything not provably supported falls
+    back to the eager path rather than risking wrong fused results."""
     if table.num_rows == 0:
         return "empty input"
     for i, c in enumerate(table.columns):
@@ -57,6 +68,11 @@ def unsupported_reason(plan: PlanNode, table: Table) -> Optional[str]:
         if c.dtype.is_decimal:
             return f"column {i} is decimal (eager-only aggregation path)"
     return None
+
+
+def unsupported_reason(plan: PlanNode, table: Table) -> Optional[str]:
+    """Why this (plan, table) can't run fused — None when it can."""
+    return _table_unsupported_reason(table)
 
 
 def _trim_prefix(cols, live: int) -> Table:
@@ -154,15 +170,135 @@ def resolve_dict_literals(plan: PlanNode, table: Table) -> PlanNode:
     return new_plan
 
 
-def execute_plan(plan: PlanNode, table: Table,
+def _resolve_dag_literals(plan: PlanNode, tables: Tuple[Table, ...]
+                          ) -> PlanNode:
+    """``resolve_dict_literals`` for DAG plans: the dictionary-column
+    descriptor is tracked per branch and concatenated across Join
+    outputs. Plans without string literals return UNCHANGED (same
+    object, same fingerprint, same decision identity map)."""
+    needs = False
+    for n in walk(plan):
+        if isinstance(n, Filter) and _has_str_lit(n.predicate):
+            needs = True
+        if isinstance(n, Project) and any(_has_str_lit(e)
+                                          for e in n.exprs):
+            needs = True
+    if not needs:
+        return plan
+
+    def rec(node):
+        if isinstance(node, Scan):
+            t = tables[node.input_index]
+            return node, [c if c.dtype.id is dt.TypeId.DICT32 else None
+                          for c in t.columns]
+        if isinstance(node, Join):
+            left, ldesc = rec(node.left)
+            right, rdesc = rec(node.right)
+            desc = (ldesc if node.how in ("semi", "anti")
+                    else ldesc + rdesc)
+            return Join(left, right, node.left_on, node.right_on,
+                        node.how), desc
+        child, desc = rec(node.child)
+        if isinstance(node, Filter):
+            return Filter(child, _resolve_expr(node.predicate,
+                                               desc)), desc
+        if isinstance(node, Project):
+            exprs = tuple(_resolve_expr(e, desc) for e in node.exprs)
+            desc = [desc[e.index] if isinstance(e, ex.Col) else None
+                    for e in exprs]
+            return Project(child, exprs), desc
+        if isinstance(node, GroupBy):
+            desc = ([desc[i] for i in node.keys]
+                    + [None] * len(node.aggs))
+            return GroupBy(child, node.keys, node.aggs), desc
+        return dataclasses.replace(node, child=child), desc
+
+    new_plan, _ = rec(plan)
+    return new_plan
+
+
+def _execute_dag(plan: PlanNode, tables: Tuple[Table, ...],
+                 cache: ProgramCache) -> Table:
+    """DAG (Join-bearing / multi-input) execution: planner passes, one
+    fused program, one guarded dispatch, one head sync. Fallbacks run
+    the eager interpreter on the PRE-optimization plan — the reference
+    semantics do not depend on the rewrite passes being loaded."""
+    k = num_inputs(plan)
+    if len(tables) < k:
+        raise PlanError(f"plan reads {k} inputs, got {len(tables)}")
+    tables = tuple(tables[:k])
+    plan = _resolve_dag_literals(plan, tables)
+    for t in tables:
+        if _table_unsupported_reason(t) is not None:
+            return run_eager(plan, tables,
+                             fallback_reason="unsupported-input")
+
+    opt = _planner.optimize(plan, tables)
+    decisions = _planner.plan_decisions(opt, tables)
+    if decisions.eager_reason is not None:
+        return run_eager(plan, tables,
+                         fallback_reason="planner-unsupported")
+
+    aux: List[jnp.ndarray] = []
+    for jid, (lsrc, rsrc) in decisions.dict_joins.items():
+        from ..columnar.dictionary import code_remap_table, dict_values
+        lcol = tables[lsrc[0]].columns[lsrc[1]]
+        rcol = tables[rsrc[0]].columns[rsrc[1]]
+        remap = code_remap_table(lcol, rcol)
+        if remap is None:  # co-dictionary after all: identity remap
+            remap = np.arange(dict_values(rcol).size, dtype=np.int32)
+        aux.append(jnp.asarray(remap))
+
+    prog: CompiledPlan = cache.get_or_compile_dag(opt, tables, decisions,
+                                                  tuple(aux))
+
+    nbytes = sum(t.device_nbytes() for t in tables)
+
+    def run():
+        with device_reservation(2 * nbytes) as took:
+            out = prog.compiled(tuple(tuple(t.columns) for t in tables),
+                                tuple(aux))
+            return release_barrier(out, took)
+
+    t0 = time.perf_counter()
+    cols, mask, head = guarded_dispatch("plan_execute", run)
+    head_h = np.asarray(head)           # THE host sync for the query
+    plan_metrics.add_time("execute_s", time.perf_counter() - t0)
+    plan_metrics.inc("plan_executes")
+    live, overflow = int(head_h[0]), bool(head_h[1])
+
+    if overflow:
+        # a device re-check failed (group budget, non-dense build key,
+        # duplicate-key build, packing range): fused output is garbage —
+        # recompute eagerly. Inputs were never donated on this path.
+        plan_metrics.inc("plan_overflows")
+        return run_eager(plan, tables, fallback_reason="overflow")
+
+    if mask is None:
+        return Table(tuple(cols))
+    if prog.prefix:
+        return _trim_prefix(cols, live)
+    idx = mask_indices_core(mask, live)
+    return gather_table(Table(tuple(cols)), idx)
+
+
+def execute_plan(plan: PlanNode,
+                 table: Union[Table, Sequence[Table]],
                  donate_input: bool = False,
                  cache: Optional[ProgramCache] = None) -> Table:
     """Run ``plan`` over ``table`` as one fused XLA program (eager
-    fallback when unsupported). ``donate_input=True`` lets XLA reuse the
-    input buffers for intermediates — only safe when the caller is done
-    with the table AND is willing to lose in-flight retry (a fault
-    mid-program after donation cannot re-run; the guard surfaces it)."""
+    fallback when unsupported). DAG plans (Join nodes) take a sequence
+    of tables indexed by ``Scan.input_index``; they never donate (the
+    eager overflow replay needs the inputs alive).
+
+    ``donate_input=True`` lets XLA reuse the input buffers for
+    intermediates — only safe when the caller is done with the table
+    AND is willing to lose in-flight retry (a fault mid-program after
+    donation cannot re-run; the guard surfaces it)."""
     cache = cache if cache is not None else _default_cache
+    if is_dag(plan) or not isinstance(table, Table):
+        tables = (table,) if isinstance(table, Table) else tuple(table)
+        return _execute_dag(plan, tables, cache)
     plan = resolve_dict_literals(plan, table)
     if donate_input and any(c.dtype.id is dt.TypeId.DICT32
                             for c in table.columns):
@@ -172,8 +308,7 @@ def execute_plan(plan: PlanNode, table: Table,
         donate_input = False
     reason = unsupported_reason(plan, table)
     if reason is not None:
-        plan_metrics.inc("plan_fallbacks")
-        return run_eager(plan, table)
+        return run_eager(plan, table, fallback_reason="unsupported-input")
 
     prog: CompiledPlan = cache.get_or_compile(plan, table,
                                               donate=donate_input)
@@ -196,14 +331,13 @@ def execute_plan(plan: PlanNode, table: Table,
         # true group count exceeded the static budget: fused output is
         # truncated garbage — recompute eagerly (data-dependent shapes)
         plan_metrics.inc("plan_overflows")
-        plan_metrics.inc("plan_fallbacks")
         if donate_input:
             raise RuntimeError(
                 "plan group-budget overflow after input donation: the "
                 "input was consumed by the fused program and the eager "
                 "fallback cannot run. Raise plan.max_groups or disable "
                 "donation for this query.")
-        return run_eager(plan, table)
+        return run_eager(plan, table, fallback_reason="overflow")
 
     if mask is None:
         return Table(tuple(cols))

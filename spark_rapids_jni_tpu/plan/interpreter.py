@@ -3,53 +3,163 @@
 Runs the same plan through the existing public ops, one dispatch per
 node, materializing every intermediate. This is (a) the fallback path
 when a plan can't be fused (unsupported column types, group-budget
-overflow), and (b) the oracle the equivalence tests compare the fused
-program against: both paths evaluate expressions through
-``plan/expr.eval_expr`` and aggregate through the shared segment cores
-in ops/groupby.py, so their results must match bit-for-bit.
+overflow, duplicate-key join builds), and (b) the oracle the
+equivalence tests compare the fused program against: both paths
+evaluate expressions through ``plan/expr.eval_expr``, aggregate through
+the shared segment cores in ops/groupby.py, and join through the
+ops/join.py wrappers, so their results must match bit-for-bit.
 
-One deliberate semantic note: eager Filter compacts rows immediately
-(``filter_table``) while the fused path carries a mask — identical
-results because every downstream op is stable (stable lexsorts preserve
-live-row relative order; segment sums accumulate in sorted-row order).
+Two deliberate semantic notes:
+
+* eager Filter compacts rows immediately (``filter_table``) while the
+  fused path carries a mask — identical results because every
+  downstream op is stable (stable lexsorts preserve live-row relative
+  order; segment sums accumulate in sorted-row order).
+* eager joins re-order the gather maps to (left-row, right-row)
+  lexicographic order. For the unique-build joins the fused path
+  accepts, that IS probe-row order — the order the fused carried-mask
+  lowering produces by construction — so the two paths agree
+  bit-for-bit. Duplicate-key builds (eager-only; the fused program
+  overflows) expand rows in the same deterministic order.
+
+Fallback accounting lives here so every entry point (executor gates,
+device overflow, planner gate) labels its reason in one place:
+``run_eager(..., fallback_reason=...)`` bumps ``plan_fallbacks``, the
+per-reason label map, and — for Join-bearing plans — the
+``plan_join_fallbacks`` counter the q3/q5 acceptance gate asserts is
+zero. Oracle calls (tests comparing fused vs eager) pass no reason and
+bump nothing.
 """
 
 from __future__ import annotations
 
-from ..columnar.column import Table
-from ..columnar.table_ops import filter_table, slice_table
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column, Table
+from ..columnar.dictionary import align_codes, is_dict
+from ..columnar.table_ops import filter_table, gather_table, slice_table
 from ..ops.groupby import groupby_aggregate
-from ..ops.sort import sort_table
+from ..ops.join import (inner_join, left_anti_join, left_join,
+                        left_semi_join)
+from ..ops.sort import gather, sort_table
 from . import expr as ex
-from .nodes import (Filter, GroupBy, Limit, PlanError, PlanNode, Project,
-                    Scan, Sort, linearize)
+from .compile import plan_metrics
+from .nodes import (Filter, GroupBy, Join, Limit, PlanError, PlanNode,
+                    Project, Scan, Sort, walk)
+
+TableOrTables = Union[Table, Sequence[Table]]
 
 
-def run_eager(plan: PlanNode, table: Table) -> Table:
-    nodes = linearize(plan)
-    scan = nodes[0]
-    assert isinstance(scan, Scan)
-    if table.num_columns != scan.ncols:
-        raise PlanError(f"plan expects {scan.ncols} columns, "
-                        f"got {table.num_columns}")
-    for node in nodes[1:]:
-        if isinstance(node, Filter):
-            keep = ex.predicate_mask(
-                ex.eval_expr(node.predicate, table.columns))
-            table = filter_table(table, keep)
-        elif isinstance(node, Project):
-            n = table.num_rows
-            table = Table(tuple(
-                ex.project_column(e, table.columns, n)
-                for e in node.exprs))
-        elif isinstance(node, GroupBy):
-            table = groupby_aggregate(table, list(node.keys),
-                                      list(node.aggs))
-        elif isinstance(node, Sort):
-            table = sort_table(table, list(node.keys),
-                               node.ascending, node.nulls_first)
-        elif isinstance(node, Limit):
-            table = slice_table(table, 0, min(node.count, table.num_rows))
-        else:
-            raise PlanError(f"unknown plan node {type(node).__name__}")
-    return table
+def _as_tables(table: TableOrTables) -> tuple:
+    if isinstance(table, Table):
+        return (table,)
+    return tuple(table)
+
+
+def _join_eager(node: Join, lt: Table, rt: Table) -> Table:
+    """One eager join via the ops/join.py wrappers (null keys never
+    match; DICT32 key pairs compare as codes after align_codes)."""
+    lkeys, rkeys = [], []
+    for li, ri in zip(node.left_on, node.right_on):
+        lc, rc = lt.columns[li], rt.columns[ri]
+        if is_dict(lc) and is_dict(rc):
+            lc, rc = align_codes(lc, rc)
+        lkeys.append(lc)
+        rkeys.append(rc)
+    if node.how == "semi":
+        return gather_table(lt, jnp.asarray(left_semi_join(lkeys, rkeys)))
+    if node.how == "anti":
+        return gather_table(lt, jnp.asarray(left_anti_join(lkeys, rkeys)))
+    if node.how == "inner":
+        l_idx, r_idx = inner_join(lkeys, rkeys)
+    else:
+        l_idx, r_idx = left_join(lkeys, rkeys)
+    l_idx, r_idx = np.asarray(l_idx), np.asarray(r_idx)
+    # (left-row, right-row) lexicographic order: probe-row order for
+    # unique builds (the fused contract), deterministic expansion order
+    # for duplicate builds (left_join appends misses at the END — the
+    # re-sort interleaves them back into probe-row position)
+    order = np.lexsort((r_idx, l_idx))
+    l_idx, r_idx = l_idx[order], r_idx[order]
+    out = list(gather_table(lt, jnp.asarray(l_idx)).columns)
+    if node.how == "inner":
+        out.extend(gather_table(rt, jnp.asarray(r_idx)).columns)
+        return Table(tuple(out))
+    # LEFT OUTER: misses carry right index -1 — gather clipped, null the
+    # payload lanes. Miss-lane DATA is pinned to dtype-zero (the same
+    # canonical value the fused lowering writes), so left-join results
+    # stay bit-identical under the nulls — and a 0-row build (nothing to
+    # gather from) degenerates to all-zero, all-null payload columns.
+    found = jnp.asarray(r_idx >= 0)
+    n = int(found.shape[0])
+    safe = jnp.asarray(np.maximum(r_idx, 0))
+    for c in rt.columns:
+        if c.offsets is not None or c.data is None:
+            # variable-width/struct payloads keep the plain gather path
+            # (no fused counterpart to stay bit-identical with)
+            g = gather(c, safe if rt.num_rows else jnp.asarray(r_idx))
+            v = found if g.validity is None else (g.validity & found)
+            out.append(Column(g.dtype, g.size, data=g.data, validity=v,
+                              offsets=g.offsets, children=g.children))
+            continue
+        if rt.num_rows == 0:
+            shape = (n,) + c.data.shape[1:]
+            out.append(Column(c.dtype, n,
+                              data=jnp.zeros(shape, c.data.dtype),
+                              validity=jnp.zeros((n,), bool),
+                              children=c.children))
+            continue
+        g = gather(c, safe)
+        f = found.reshape(found.shape + (1,) * (g.data.ndim - 1))
+        data = jnp.where(f, g.data, jnp.zeros((), g.data.dtype))
+        v = found if g.validity is None else (g.validity & found)
+        out.append(Column(g.dtype, g.size, data=data, validity=v,
+                          children=g.children))
+    return Table(tuple(out))
+
+
+def _run(node: PlanNode, tables: tuple) -> Table:
+    if isinstance(node, Scan):
+        t = tables[node.input_index]
+        if t.num_columns != node.ncols:
+            raise PlanError(f"plan expects {node.ncols} columns, "
+                            f"got {t.num_columns}")
+        return t
+    if isinstance(node, Join):
+        return _join_eager(node, _run(node.left, tables),
+                           _run(node.right, tables))
+    table = _run(node.child, tables)
+    if isinstance(node, Filter):
+        keep = ex.predicate_mask(
+            ex.eval_expr(node.predicate, table.columns))
+        return filter_table(table, keep)
+    if isinstance(node, Project):
+        n = table.num_rows
+        return Table(tuple(ex.project_column(e, table.columns, n)
+                           for e in node.exprs))
+    if isinstance(node, GroupBy):
+        return groupby_aggregate(table, list(node.keys), list(node.aggs))
+    if isinstance(node, Sort):
+        return sort_table(table, list(node.keys),
+                          node.ascending, node.nulls_first)
+    if isinstance(node, Limit):
+        return slice_table(table, 0, min(node.count, table.num_rows))
+    raise PlanError(f"unknown plan node {type(node).__name__}")
+
+
+def run_eager(plan: PlanNode, table: TableOrTables,
+              fallback_reason: Optional[str] = None) -> Table:
+    """Execute ``plan`` eagerly over one table (linear plans) or a
+    sequence of tables (DAG plans; ``Scan.input_index`` selects).
+
+    ``fallback_reason`` labels this run as a fused-path fallback and
+    bumps the plan metrics; oracle/reference callers omit it."""
+    if fallback_reason is not None:
+        plan_metrics.inc("plan_fallbacks")
+        plan_metrics.inc_fallback_reason(fallback_reason)
+        if any(isinstance(n, Join) for n in walk(plan)):
+            plan_metrics.inc("plan_join_fallbacks")
+    return _run(plan, _as_tables(table))

@@ -1,17 +1,25 @@
 """Logical plan IR for whole-plan compilation.
 
-A plan is a linear pipeline of frozen dataclass nodes rooted at ``Scan``:
+A plan is a DAG of frozen dataclass nodes rooted at ``Scan`` leaves:
 
     Scan -> [Filter | Project]* -> [GroupBy] -> [Sort] -> [Limit]
 
+with ``Join`` nodes composing pipelines: ``Join(left, right, ...)``
+probes the left pipeline's rows against a hash/sorted build of the
+right pipeline. Plans without Join (and with a single input) remain the
+linear grammar above and lower through the original single-pipeline
+path unchanged.
+
 Each node composes the existing op layer's pure cores (ops/groupby.py
-``groupby_core``, ops/sort.py ``sort_lanes``, plan/expr.py) — the plan
-layer adds no new math, it only decides what gets fused into one XLA
-program. The grammar above is the fusable subset: Filter never
-materializes a compaction inside the fused program (it carries a
-keep-mask that downstream nodes consume — GroupBy pushes masked rows
-into a dead segment, Sort orders them last), so every intermediate
-keeps the input's static shape and XLA can donate/fuse freely.
+``groupby_core``, ops/sort.py ``sort_lanes``, ops/join.py probe cores,
+plan/expr.py) — the plan layer adds no new math, it only decides what
+gets fused into one XLA program. The grammar above is the fusable
+subset: Filter never materializes a compaction inside the fused program
+(it carries a keep-mask that downstream nodes consume — GroupBy pushes
+masked rows into a dead segment, Sort orders them last), and Join
+preserves the probe side's lane count (build rows are gathered onto
+probe lanes, never expanded), so every intermediate keeps a static
+shape and XLA can donate/fuse freely.
 
 Identity: ``fingerprint(plan)`` is a sha1 over a canonical repr built
 from node/expression structure only (no data, no shapes). The compiled
@@ -43,16 +51,20 @@ class PlanNode:
 
 @dataclasses.dataclass(frozen=True)
 class Scan(PlanNode):
-    """Pipeline source: the input Table handed to execute_plan. ``ncols``
-    is declared up front so expression column refs validate at build
-    time."""
+    """Pipeline source: one of the input Tables handed to execute_plan.
+    ``ncols`` is declared up front so expression column refs validate at
+    build time; ``input_index`` selects which table of a multi-input DAG
+    this leaf reads (0 for single-input linear plans)."""
 
     ncols: int
     child: None = None
+    input_index: int = 0
 
     def __post_init__(self):
         if self.ncols < 1:
             raise PlanError("Scan needs at least one column")
+        if self.input_index < 0:
+            raise PlanError("Scan input_index must be non-negative")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,11 +161,110 @@ class Limit(PlanNode):
             raise PlanError("Limit count must be non-negative")
 
 
+@dataclasses.dataclass(frozen=True)
+class Join(PlanNode):
+    """Join the ``left`` pipeline (probe side — row order preserved)
+    against a build of the ``right`` pipeline on equal key columns.
+
+    ``how``:
+      inner  output = left cols + right cols; probe rows without a build
+             match are dropped (mask).
+      left   output = left cols + right cols; unmatched probe rows keep
+             their left values with null right payload.
+      semi   output = left cols only; keep probe rows WITH a match.
+      anti   output = left cols only; keep probe rows WITHOUT a match
+             (NOT EXISTS — a null probe key never matches, so anti keeps
+             it; same contract as ops/join's poison-hash nulls).
+
+    Fused lowering gathers build rows onto probe lanes, so the output
+    lane count equals the left side's: a build side with duplicate keys
+    (row-expanding join) trips the overflow flag and falls back to the
+    eager interpreter, which handles expansion on the host.
+    """
+
+    left: PlanNode
+    right: PlanNode
+    left_on: Tuple[int, ...]
+    right_on: Tuple[int, ...]
+    how: str = "inner"
+
+    _HOWS = ("inner", "left", "semi", "anti")
+
+    def __post_init__(self):
+        object.__setattr__(self, "left_on",
+                           tuple(int(i) for i in self.left_on))
+        object.__setattr__(self, "right_on",
+                           tuple(int(i) for i in self.right_on))
+        if self.how not in self._HOWS:
+            raise PlanError(f"unknown join how={self.how!r}")
+        if not self.left_on or len(self.left_on) != len(self.right_on):
+            raise PlanError("Join needs equal, non-empty key index tuples")
+        ln, rn = output_ncols(self.left), output_ncols(self.right)
+        for i in self.left_on:
+            if not (0 <= i < ln):
+                raise PlanError(f"Join left_on {i} out of range [0,{ln})")
+        for i in self.right_on:
+            if not (0 <= i < rn):
+                raise PlanError(f"Join right_on {i} out of range [0,{rn})")
+
+
+def walk(plan: PlanNode) -> Tuple[PlanNode, ...]:
+    """Deterministic post-order node sequence (left before right before
+    node) over the plan DAG."""
+    out = []
+
+    def _rec(node):
+        if isinstance(node, Join):
+            _rec(node.left)
+            _rec(node.right)
+        elif not isinstance(node, Scan):
+            _rec(node.child)
+        out.append(node)
+
+    _rec(plan)
+    return tuple(out)
+
+
+def is_dag(plan: PlanNode) -> bool:
+    """True when the plan needs the multi-pipeline (DAG) lowering: it
+    contains a Join or reads an input other than table 0."""
+    return any(isinstance(n, Join) or
+               (isinstance(n, Scan) and n.input_index != 0)
+               for n in walk(plan))
+
+
+def num_inputs(plan: PlanNode) -> int:
+    """Number of input tables the DAG reads (max Scan input_index + 1)."""
+    return 1 + max(n.input_index for n in walk(plan) if isinstance(n, Scan))
+
+
+def output_ncols(node: PlanNode) -> int:
+    """Column count of a node's output schema."""
+    if isinstance(node, Scan):
+        return node.ncols
+    if isinstance(node, Project):
+        return len(node.exprs)
+    if isinstance(node, GroupBy):
+        return len(node.keys) + len(node.aggs)
+    if isinstance(node, Join):
+        if node.how in ("semi", "anti"):
+            return output_ncols(node.left)
+        return output_ncols(node.left) + output_ncols(node.right)
+    if isinstance(node, (Filter, Sort, Limit)):
+        return output_ncols(node.child)
+    raise PlanError(f"unknown plan node {type(node).__name__}")
+
+
 def linearize(plan: PlanNode) -> Tuple[PlanNode, ...]:
-    """Scan-first node sequence; validates the chain is rooted at Scan."""
+    """Scan-first node sequence; validates the chain is rooted at Scan.
+    Linear-pipeline consumers only — a DAG plan (Join) does not
+    linearize."""
     nodes = []
     node: Optional[PlanNode] = plan
     while node is not None:
+        if isinstance(node, Join):
+            raise PlanError("plan contains a Join — DAG plans don't "
+                            "linearize; use walk()/the DAG lowering")
         nodes.append(node)
         if isinstance(node, Scan):
             break
@@ -187,7 +298,15 @@ def _expr_repr(e: ex.Expr) -> str:
 
 def _node_repr(n: PlanNode) -> str:
     if isinstance(n, Scan):
-        return f"scan[{n.ncols}]"
+        # input_index 0 keeps the historical spelling so every pre-DAG
+        # fingerprint (persistent ProgramCache entries) stays stable
+        if n.input_index == 0:
+            return f"scan[{n.ncols}]"
+        return f"scan[{n.ncols}]@{n.input_index}"
+    if isinstance(n, Join):
+        lon = ",".join(map(str, n.left_on))
+        ron = ",".join(map(str, n.right_on))
+        return f"join[{n.how}|{lon}|{ron}]"
     if isinstance(n, Filter):
         return f"filter[{_expr_repr(n.predicate)}]"
     if isinstance(n, Project):
@@ -209,8 +328,14 @@ def _node_repr(n: PlanNode) -> str:
 def canonical_repr(plan: PlanNode) -> str:
     """Deterministic structural repr — the fingerprint preimage. Data- and
     shape-free by construction: only node kinds, column indices, literal
-    values, and flags appear."""
-    return ">".join(_node_repr(n) for n in linearize(plan))
+    values, and flags appear. Linear plans produce the exact pre-DAG
+    ">"-joined spelling; a Join brackets its two sub-pipelines."""
+    if isinstance(plan, Scan):
+        return _node_repr(plan)
+    if isinstance(plan, Join):
+        return ("(" + canonical_repr(plan.left) + "|" +
+                canonical_repr(plan.right) + ")>" + _node_repr(plan))
+    return canonical_repr(plan.child) + ">" + _node_repr(plan)
 
 
 def fingerprint(plan: PlanNode) -> str:

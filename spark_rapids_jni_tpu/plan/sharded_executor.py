@@ -38,7 +38,7 @@ from .compile import CompiledShardedPlan, ProgramCache, plan_metrics
 from .executor import (_default_cache, _trim_prefix, execute_plan,
                        resolve_dict_literals, unsupported_reason)
 from .interpreter import run_eager
-from .nodes import PlanNode
+from .nodes import PlanNode, is_dag
 
 
 def _execute_on_mesh(plan: PlanNode, table: Table, mesh,
@@ -65,8 +65,7 @@ def _execute_on_mesh(plan: PlanNode, table: Table, mesh,
 
     if overflow:
         plan_metrics.inc("plan_overflows")
-        plan_metrics.inc("plan_fallbacks")
-        return run_eager(plan, table)
+        return run_eager(plan, table, fallback_reason="overflow")
 
     cols = sharding.rebuild_outputs(prog.replicated, prog.out_cols,
                                     out_leaves, table)
@@ -84,11 +83,14 @@ def execute_plan_sharded(plan: PlanNode, table: Table,
     bit-identical to ``execute_plan``. ``devices`` picks a sub-mesh
     (0 = all); faults degrade the mesh by halves and replay."""
     cache = cache if cache is not None else _default_cache
+    if is_dag(plan) or not isinstance(table, Table):
+        # DAG plans are gated solo by sharding_unsupported_reason; route
+        # straight to the (DAG-aware) solo executor without linearizing
+        return execute_plan(plan, table, cache=cache)
     plan = resolve_dict_literals(plan, table)
     reason = unsupported_reason(plan, table)
     if reason is not None:
-        plan_metrics.inc("plan_fallbacks")
-        return run_eager(plan, table)
+        return run_eager(plan, table, fallback_reason="unsupported-input")
     if mesh is None:
         mesh = cluster.get_mesh(devices)
     if (int(mesh.devices.size) == 1

@@ -60,7 +60,7 @@ from ..parallel import cluster
 from ..utils.shapes import bucket_size
 from . import expr as ex
 from .nodes import (Filter, GroupBy, Limit, PlanError, PlanNode, Project,
-                    Sort, linearize)
+                    Sort, is_dag, linearize)
 
 _FLOAT_IDS = (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64)
 
@@ -240,7 +240,14 @@ def sharding_unsupported_reason(plan: PlanNode,
     * Sort/Limit before the first GroupBy would need a global row sort
       over sharded state; after a GroupBy the state is replicated and
       the solo lowering runs verbatim.
+    * DAG plans (Join nodes) stay solo: a sharded join build would need
+      either a replicated build side or a key-partitioned exchange, and
+      neither preserves the solo program's probe-row order guarantees
+      yet. The solo DAG path still fuses the whole query.
     """
+    if is_dag(plan):
+        return ("plan is a DAG (Join) — cross-shard join builds are "
+                "not partitionable bit-identically; runs solo-fused")
     nodes = linearize(plan)
     is_float = [c.dtype.id in _FLOAT_IDS for c in table.columns]
     for node in nodes[1:]:

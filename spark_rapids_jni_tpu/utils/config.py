@@ -72,6 +72,27 @@ _register("plan.min_rows", "SRJT_PLAN_MIN_ROWS", 262144, int,
           "fuses into one jitted program; below it a fresh (plan, shape) "
           "compile costs more than the saved per-op dispatches/syncs, so "
           "auto takes the eager path. engine=\"plan\"/\"eager\" override")
+_register("plan.topk_max", "SRJT_PLAN_TOPK_MAX", 64, int,
+          "DAG planner: largest Limit count lowered as fused top-k "
+          "selection (k min-reduction rounds) instead of a full lexsort + "
+          "compaction gather (plan/planner.py). Each round is O(rows), so "
+          "large k loses to the sort it replaces")
+_register("plan.groupby_small_span", "SRJT_PLAN_GROUPBY_SMALL_SPAN", 64, int,
+          "DAG planner: max key span (hi-lo+1) for the chunked-scan "
+          "direct-slot groupby (ops/groupby.groupby_direct_small_core). "
+          "The scan body reduces over every slot per chunk, so cost grows "
+          "linearly with the span")
+_register("plan.groupby_wide_span", "SRJT_PLAN_GROUPBY_WIDE_SPAN", 1 << 21,
+          int,
+          "DAG planner: max key span for the scatter-add direct-slot "
+          "groupby (ops/groupby.groupby_direct_wide_core); above it the "
+          "slot arrays outgrow the lexsort the strategy avoids and the "
+          "generic sorted core wins")
+_register("plan.groupby_chunk", "SRJT_PLAN_GROUPBY_CHUNK", 1024, int,
+          "DAG planner: rows per lax.scan step in the direct-slot small "
+          "groupby; 1024 keeps the span-wide compare block inside L1 "
+          "while amortizing scan trip overhead on XLA:CPU (chunk sweep "
+          "256-131072 measured at 1M rows, span 25)")
 _register("rmm.watchdog_period_s", "SRJT_RMM_WATCHDOG_PERIOD_S", 0.1, float,
           "deadlock watchdog poll period "
           "(ref: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod, 100ms)")

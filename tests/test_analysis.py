@@ -791,6 +791,93 @@ def test_srjt013_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT015 — join-plan discipline
+# ---------------------------------------------------------------------------
+
+SRC_015_CORE = """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_jni_tpu.plan.registry import plan_core
+    from spark_rapids_jni_tpu.faultinj import guarded_dispatch
+
+    @plan_core("join_probe_bad")
+    def join_probe_bad_core(build_keys, probe_keys):
+        bk = jax.device_put(build_keys)            # raw dispatch
+        hits = np.asarray(probe_keys)              # host sync
+        return guarded_dispatch("join", lambda: hits)  # nested guard
+"""
+
+SRC_015_ORDER = """
+    from spark_rapids_jni_tpu.plan.planner import order_joins, estimate_rows
+
+    def pick_order(plan, tables):
+        if estimate_rows(plan, tables) > 10:
+            return order_joins(plan, tables)
+        return plan
+"""
+
+
+def test_srjt015_impure_join_core_triggers():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt015
+    fs = run(SRC_015_CORE, rules=[rule_srjt015])
+    assert rules_of(fs) == {"SRJT015"}
+    assert len(fs) == 3    # device_put, np.asarray, guarded_dispatch
+    assert all("join plan core" in f.message for f in fs)
+    # the full catalog flags it too (SRJT011 overlaps on the sync/guard)
+    assert "SRJT015" in rules_of(run(SRC_015_CORE))
+
+
+def test_srjt015_join_order_outside_planner_triggers():
+    fs = run(SRC_015_ORDER, path="pkg/plan/executor.py")
+    assert rules_of(fs) == {"SRJT015"}
+    assert len(fs) == 2    # estimate_rows + order_joins
+    assert all("plan/planner.py" in f.message for f in fs)
+
+
+def test_srjt015_planner_home_and_pure_core_clean():
+    # the planner module itself may mint join-order decisions
+    assert run(SRC_015_ORDER, path="pkg/plan/planner.py") == []
+    src = """
+        import jax.numpy as jnp
+        from spark_rapids_jni_tpu.plan.registry import plan_core
+
+        @plan_core("join_probe_good")
+        def join_probe_good_core(build_keys, probe_keys):
+            pos = jnp.searchsorted(build_keys, probe_keys)
+            return jnp.minimum(pos, build_keys.shape[0] - 1)
+    """
+    assert run(src) == []
+
+
+def test_srjt015_non_join_core_not_in_scope():
+    # dispatch prims in a NON-join core are not SRJT015's business
+    # (SRJT011 handles the sync/guard subset for every plan core)
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt015
+    src = """
+        import jax
+        from spark_rapids_jni_tpu.plan.registry import plan_core
+
+        @plan_core("scan_op")
+        def scan_core(col):
+            return jax.device_put(col)
+    """
+    assert run(src, rules=[rule_srjt015]) == []
+
+
+def test_srjt015_noqa():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt015
+    assert run(SRC_015_CORE.replace(
+        "bk = jax.device_put(build_keys)",
+        "bk = jax.device_put(build_keys)  # srjt: noqa[SRJT015]").replace(
+        "hits = np.asarray(probe_keys)",
+        "hits = np.asarray(probe_keys)  # srjt: noqa[SRJT015]").replace(
+        'return guarded_dispatch("join", lambda: hits)',
+        'return guarded_dispatch("join", lambda: hits)'
+        '  # srjt: noqa[SRJT015]'), rules=[rule_srjt015]) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -810,7 +897,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 13
+    assert len(FILE_RULES) == 15
 
 
 def test_syntax_error_is_reported_not_raised():

@@ -1,0 +1,471 @@
+"""Join-bearing plan DAGs: equivalence, planner passes, fault storms.
+
+Equivalence: every fused join result must be BIT-IDENTICAL to the eager
+interpreter (``plan.run_eager``) — data AND validity — for all four join
+hows, with and without null keys, for plain-int and DICT32 (co- and
+cross-dictionary) keys, and through the planner's join-reorder pass.
+The fused lowering gathers build rows onto probe lanes behind a carried
+mask, so these tests are the proof that lane bookkeeping, the direct
+(dense-key) probe shortcut, and the cross-dictionary code remap are
+invisible in results.
+
+Safety: every planner claim is ADVISORY. Duplicate live build keys and
+lying ascending_dense stats must trip the device overflow flag and land
+on the eager answer — a wrong plan costs a fallback, never a wrong row.
+Fallbacks are labeled per reason and Join-bearing plans bump
+``plan_join_fallbacks``, the counter the q3/q5 acceptance gate pins to
+zero.
+
+Fault storms: the single ``guarded_dispatch("plan_execute")`` boundary
+classifies TRANSIENT / STALL faults with a join plan in flight and
+recovers bit-identically — join cores are pure, so a re-dispatch re-runs
+the fused program from immutable inputs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from benchmarks import tpch
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnStats, Table
+from spark_rapids_jni_tpu.columnar.dictionary import (dict_column,
+                                                      dict_values,
+                                                      encode_strings)
+from spark_rapids_jni_tpu.faultinj import install, uninstall
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.ops.groupby import groupby_direct_small_core
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Join, PlanError,
+                                       Project, Scan, Sort, col,
+                                       execute_plan, lit, optimize,
+                                       plan_decisions, plan_metrics,
+                                       push_filters, run_eager,
+                                       sharding_unsupported_reason,
+                                       source_predicates, walk)
+from spark_rapids_jni_tpu.plan.compile import ProgramCache
+from spark_rapids_jni_tpu.plan.planner import order_joins
+from spark_rapids_jni_tpu.utils import config
+
+from tests.test_plan import assert_tables_bit_identical
+
+N = 3000
+NB = 400
+
+
+def _c(arr, d, valid=None, stats=False):
+    arr = np.asarray(arr)
+    v = None if valid is None else jnp.asarray(valid)
+    c = Column(d, len(arr), data=jnp.asarray(arr), validity=v)
+    return c.with_stats(ColumnStats.from_numpy(arr)) if stats else c
+
+
+def _probe_build(seed=5, null_keys=True, dense=False, dup=False):
+    """(probe, build) pair joined on column 0 = column 0. ``dense``
+    attaches honest ascending_dense stats to the build key (direct
+    strategy); otherwise the key is unique-but-scattered (sorted
+    strategy). ``dup`` plants one duplicate live build key."""
+    rng = np.random.default_rng(seed)
+    if dense:
+        bkeys = np.arange(NB) + 7
+    else:
+        bkeys = rng.permutation(NB).astype(np.int64) * 3 + 1
+    if dup:
+        bkeys = bkeys.copy()
+        bkeys[5] = bkeys[17]
+    build = Table((
+        _c(bkeys, dt.INT64, stats=dense),
+        _c(rng.integers(0, 100, NB), dt.INT64),
+        _c(rng.integers(0, 5, NB).astype(np.int32), dt.INT32,
+           valid=(rng.random(NB) >= 0.1) if null_keys else None),
+    ))
+    probe = Table((
+        _c(rng.integers(0, int(bkeys.max()) + 20, N), dt.INT64,
+           valid=(rng.random(N) >= 0.15) if null_keys else None),
+        _c(rng.integers(0, 50, N).astype(np.int32), dt.INT32),
+        _c(rng.integers(1, 1000, N), dt.INT64),
+    ))
+    return probe, build
+
+
+def _join_plan(how):
+    return Join(Scan(3, input_index=0), Scan(3, input_index=1),
+                (0,), (0,), how)
+
+
+def _fused(plan, tables):
+    """execute_plan on a fresh cache, asserting the fused path ran with
+    zero fallbacks; returns the result."""
+    plan_metrics.reset()
+    out = execute_plan(plan, tables, cache=ProgramCache())
+    snap = plan_metrics.snapshot()
+    assert snap["plan_executes"] == 1, snap
+    assert snap["plan_fallbacks"] == 0, snap
+    assert snap["plan_join_fallbacks"] == 0, snap
+    return out
+
+
+# ---------------------------------------------------------------------------
+# equivalence: all hows, null keys, direct + sorted strategies
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_fused_join_bit_identical_sorted_null_keys(how):
+    tabs = _probe_build(seed=11, null_keys=True, dense=False)
+    plan = _join_plan(how)
+    assert_tables_bit_identical(_fused(plan, tabs), run_eager(plan, tabs))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_fused_join_bit_identical_direct_dense_build(how):
+    tabs = _probe_build(seed=12, null_keys=False, dense=True)
+    plan = _join_plan(how)
+    opt = optimize(plan, tabs)
+    dec = plan_decisions(opt, tabs)
+    jn = next(n for n in walk(opt) if isinstance(n, Join))
+    assert dec.of(jn).strategy == "direct"
+    assert_tables_bit_identical(_fused(plan, tabs), run_eager(plan, tabs))
+
+
+def test_fused_join_empty_build_side():
+    tabs = _probe_build(seed=13, null_keys=True, dense=False)
+    for how in ("inner", "left", "semi", "anti"):
+        # the filter kills every build row: inner/semi go empty, left
+        # keeps all-null right payload, anti keeps everything
+        plan = Join(Scan(3, input_index=0),
+                    Filter(Scan(3, input_index=1), col(0) < lit(-1)),
+                    (0,), (0,), how)
+        assert_tables_bit_identical(_fused(plan, tabs),
+                                    run_eager(plan, tabs))
+
+
+def test_fused_join_downstream_groupby_sort():
+    # the q3/q5 shape in miniature: filter -> join -> project -> groupby
+    tabs = _probe_build(seed=14, null_keys=True, dense=True)
+    plan = Sort(
+        GroupBy(
+            Project(
+                Join(Filter(Scan(3, input_index=0), col(1) < lit(40)),
+                     Scan(3, input_index=1), (0,), (0,), "inner"),
+                (col(5), col(2))),
+            (0,), ((1, "sum"), (1, "count"))),
+        (0,))
+    assert_tables_bit_identical(_fused(plan, tabs), run_eager(plan, tabs))
+
+
+# ---------------------------------------------------------------------------
+# DICT32 keys: co-dictionary and cross-dictionary code remap
+# ---------------------------------------------------------------------------
+
+def _dict_tables(cross: bool, seed=21):
+    """Probe/build with DICT32 key columns. Co-dictionary: both sides
+    share ONE values column. Cross: the build side re-encodes a
+    different (overlapping) entry set, so joining needs the remap."""
+    rng = np.random.default_rng(seed)
+    nb = 40
+    build_strs = ["key%03d" % i for i in range(nb)]
+    bkey = encode_strings(Column.from_pylist(build_strs, dt.STRING))
+    if cross:
+        # probe dictionary: overlapping subset plus foreign entries
+        probe_strs = ["key%03d" % i for i in range(0, nb, 2)] + \
+                     ["alien%d" % i for i in range(8)]
+        pool = encode_strings(
+            Column.from_pylist(probe_strs, dt.STRING))
+        pcodes = rng.integers(0, dict_values(pool).size, N).astype(np.int32)
+        pkey = dict_column(jnp.asarray(pcodes), dict_values(pool))
+    else:
+        pcodes = rng.integers(0, nb, N).astype(np.int32)
+        pkey = dict_column(jnp.asarray(pcodes), dict_values(bkey))
+    probe = Table((pkey, _c(rng.integers(1, 1000, N), dt.INT64)))
+    build = Table((bkey, _c(rng.integers(0, 100, nb), dt.INT64)))
+    return probe, build
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_fused_join_dict32_co_dictionary(how):
+    tabs = _dict_tables(cross=False)
+    plan = Join(Scan(2, input_index=0), Scan(2, input_index=1),
+                (0,), (0,), how)
+    dec = plan_decisions(optimize(plan, tabs), tabs)
+    assert not dec.dict_joins          # shared dictionary: no remap aux
+    assert_tables_bit_identical(_fused(plan, tabs), run_eager(plan, tabs))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_fused_join_dict32_cross_dictionary_remap(how):
+    tabs = _dict_tables(cross=True)
+    plan = Join(Scan(2, input_index=0), Scan(2, input_index=1),
+                (0,), (0,), how)
+    dec = plan_decisions(optimize(plan, tabs), tabs)
+    assert len(dec.dict_joins) == 1    # remap aux input required
+    assert_tables_bit_identical(_fused(plan, tabs), run_eager(plan, tabs))
+
+
+# ---------------------------------------------------------------------------
+# advisory claims: overflow -> labeled eager fallback, never a wrong row
+# ---------------------------------------------------------------------------
+
+def test_duplicate_build_key_overflows_to_eager():
+    tabs = _probe_build(seed=31, null_keys=False, dense=False, dup=True)
+    plan = _join_plan("inner")
+    plan_metrics.reset()
+    out = execute_plan(plan, tabs, cache=ProgramCache())
+    snap = plan_metrics.snapshot()
+    assert snap["plan_overflows"] == 1
+    assert snap["plan_join_fallbacks"] == 1
+    assert snap["plan_fallback_reasons"] == {"overflow": 1}
+    assert_tables_bit_identical(out, run_eager(plan, tabs))
+
+
+def test_lying_dense_stats_fall_back_not_misjoin():
+    # stats CLAIM arange(NB), data is shuffled: the direct probe's device
+    # re-check must trip overflow and the answer must still be exact
+    tabs = _probe_build(seed=32, null_keys=False, dense=False)
+    bad_key = tabs[1].columns[0].with_stats(
+        ColumnStats(lo=0, hi=NB - 1, unique=True, ascending_dense=True))
+    tabs = (tabs[0], Table((bad_key,) + tabs[1].columns[1:]))
+    plan = _join_plan("inner")
+    opt = optimize(plan, tabs)
+    dec = plan_decisions(opt, tabs)
+    jn = next(n for n in walk(opt) if isinstance(n, Join))
+    assert dec.of(jn).strategy == "direct"      # planner believed the lie
+    plan_metrics.reset()
+    out = execute_plan(plan, tabs, cache=ProgramCache())
+    snap = plan_metrics.snapshot()
+    assert snap["plan_overflows"] == 1
+    assert snap["plan_fallback_reasons"] == {"overflow": 1}
+    assert_tables_bit_identical(out, run_eager(plan, tabs))
+
+
+def test_planner_unsupported_join_is_labeled_fallback():
+    tabs = _probe_build(seed=33, null_keys=False)
+    plan = Join(Scan(3, input_index=0), Scan(3, input_index=1),
+                (0, 1), (0, 2), "inner")        # multi-column key
+    plan_metrics.reset()
+    out = execute_plan(plan, tabs, cache=ProgramCache())
+    snap = plan_metrics.snapshot()
+    assert snap["plan_executes"] == 0
+    assert snap["plan_join_fallbacks"] == 1
+    assert snap["plan_fallback_reasons"] == {"planner-unsupported": 1}
+    assert_tables_bit_identical(out, run_eager(plan, tabs))
+
+
+def test_malformed_joins_raise():
+    with pytest.raises(PlanError):
+        Join(Scan(2), Scan(2), (0,), (0,), "full_outer")
+    with pytest.raises(PlanError):
+        Join(Scan(2), Scan(2), (), (), "inner")
+    with pytest.raises(PlanError):
+        Join(Scan(2), Scan(2), (0,), (0, 1), "inner")
+    with pytest.raises(PlanError):
+        Join(Scan(2), Scan(2), (5,), (0,), "inner")
+
+
+# ---------------------------------------------------------------------------
+# planner passes: pushdown, source predicates, join ordering
+# ---------------------------------------------------------------------------
+
+def test_push_filters_splits_conjuncts_across_join():
+    j = Join(Scan(2, input_index=0), Scan(2, input_index=1),
+             (0,), (0,), "inner")
+    pred = ((col(1) < lit(5)) & (col(3) < lit(7))) & (col(1) < col(3))
+    p = push_filters(Filter(j, pred))
+    # mixed conjunct stays above; pure-side conjuncts sink to their scan
+    assert isinstance(p, Filter) and isinstance(p.child, Join)
+    assert isinstance(p.child.left, Filter)
+    assert isinstance(p.child.right, Filter)
+    sp = source_predicates(p)
+    assert set(sp) == {0, 1}
+    assert len(sp[0]) == 1 and len(sp[1]) == 1
+
+
+def test_push_filters_keeps_right_predicate_above_left_join():
+    # sinking a right-side predicate below a LEFT join would drop rows
+    # that must survive with null payload
+    j = Join(Scan(2, input_index=0), Scan(2, input_index=1),
+             (0,), (0,), "left")
+    p = push_filters(Filter(j, col(3) < lit(7)))
+    assert isinstance(p, Filter) and isinstance(p.child, Join)
+    assert not isinstance(p.child.right, Filter)
+
+
+def test_order_joins_puts_smaller_build_first():
+    rng = np.random.default_rng(41)
+    x = Table((_c(np.arange(1000), dt.INT64),
+               _c(rng.integers(0, 50, 1000), dt.INT64)))
+    b1 = Table((_c(np.arange(500), dt.INT64, stats=True),
+                _c(rng.integers(0, 9, 500), dt.INT64)))
+    b2 = Table((_c(np.arange(50), dt.INT64, stats=True),
+                _c(rng.integers(0, 9, 50), dt.INT64)))
+    plan = Join(Join(Scan(2, input_index=0), Scan(2, input_index=1),
+                     (0,), (0,), "inner"),
+                Scan(2, input_index=2), (1,), (0,), "inner")
+    tabs = (x, b1, b2)
+    out = order_joins(plan, tabs)
+    # the cheaper build (b2, 50 rows) now probes first
+    assert out.left.right.input_index == 2
+    assert out.right.input_index == 1
+    # and the rewrite is invisible in results (column remap included)
+    full = Sort(GroupBy(Project(plan, (col(3), col(5), col(1))),
+                        (0, 1), ((2, "sum"),)), (0, 1))
+    assert_tables_bit_identical(_fused(full, tabs), run_eager(full, tabs))
+
+
+# ---------------------------------------------------------------------------
+# q3/q5 end-to-end: fused plan engine vs eager engine, zero fallbacks
+# ---------------------------------------------------------------------------
+
+def test_q3_plan_matches_eager_engine_zero_join_fallbacks():
+    tabs = tpch.generate_q3_tables(60_000, 17)
+    plan_metrics.reset()
+    fused = tpch.run_q3(*tabs, engine="plan")
+    snap = plan_metrics.snapshot()
+    assert snap["plan_executes"] == 1
+    assert snap["plan_join_fallbacks"] == 0
+    assert snap["plan_fallbacks"] == 0
+    assert_tables_bit_identical(fused, tpch.run_q3(*tabs, engine="eager"))
+
+
+def test_q5_plan_matches_eager_engine_zero_join_fallbacks():
+    tabs = tpch.generate_q5_tables(60_000, 18)
+    plan_metrics.reset()
+    fused = tpch.run_q5(*tabs, engine="plan")
+    snap = plan_metrics.snapshot()
+    assert snap["plan_executes"] == 1
+    assert snap["plan_join_fallbacks"] == 0
+    assert snap["plan_fallbacks"] == 0
+    assert_tables_bit_identical(fused, tpch.run_q5(*tabs, engine="eager"))
+
+
+# ---------------------------------------------------------------------------
+# sharding gate: DAG plans run solo-fused, with a named reason
+# ---------------------------------------------------------------------------
+
+def test_sharding_gate_names_dag_join_reason():
+    probe, build = _probe_build(seed=51)
+    reason = sharding_unsupported_reason(_join_plan("inner"), probe)
+    assert reason is not None
+    assert "Join" in reason and "solo" in reason
+    # a linear integer plan is NOT gated
+    linear = Sort(GroupBy(Scan(3), (1,), ((2, "sum"),)), (0,))
+    assert sharding_unsupported_reason(linear, probe) is None
+
+
+# ---------------------------------------------------------------------------
+# direct_small groupby: sentinel-slot claim checking (live rows only)
+# ---------------------------------------------------------------------------
+
+def test_groupby_direct_small_sentinel_checks_live_rows_only():
+    lo, span, num_slots, chunk = 10, 6, 16, 8
+    key = np.array([10, 11, 10, 15, 12, 11, 10, 99, 13, 14], np.int64)
+    val = np.array([5, 7, 11, 2, 3, 1, 9, 1000, 8, 4], np.int64)
+    mask = np.ones(10, bool)
+    mask[7] = False                     # the out-of-span row is DEAD
+    sk, sums, live, bad = groupby_direct_small_core(
+        jnp.asarray(key), jnp.asarray(val), jnp.asarray(mask),
+        lo, span, num_slots, chunk)
+    assert not bool(bad)                # dead violators don't fire
+    oracle = np.zeros(span, np.int64)
+    np.add.at(oracle, key[mask] - lo, val[mask])
+    nlive = int(live)
+    assert nlive == int((oracle > 0).sum())
+    got = dict(zip(np.asarray(sk)[:nlive].tolist(),
+                   np.asarray(sums)[:nlive].tolist()))
+    want = {int(k + lo): int(v) for k, v in enumerate(oracle) if v > 0}
+    assert got == want
+
+    # LIVE out-of-span row: bad fires
+    mask2 = np.ones(10, bool)
+    *_, bad2 = groupby_direct_small_core(
+        jnp.asarray(key), jnp.asarray(val), jnp.asarray(mask2),
+        lo, span, num_slots, chunk)
+    assert bool(bad2)
+
+    # LIVE non-positive value violates the packing claim: bad fires
+    val3 = val.copy()
+    val3[0] = 0
+    *_, bad3 = groupby_direct_small_core(
+        jnp.asarray(key), jnp.asarray(val3), jnp.asarray(mask),
+        lo, span, num_slots, chunk)
+    assert bool(bad3)
+
+    # LIVE value at the 2^48 packing limit: bad fires
+    val4 = val.copy()
+    val4[2] = 1 << 48
+    *_, bad4 = groupby_direct_small_core(
+        jnp.asarray(key), jnp.asarray(val4), jnp.asarray(mask),
+        lo, span, num_slots, chunk)
+    assert bool(bad4)
+
+
+# ---------------------------------------------------------------------------
+# fault storms at the fused boundary with a join plan in flight
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    yield
+    uninstall()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002), \
+            config.override("watchdog.poll_period_s", 0.02):
+        yield
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "join_faults.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def _rule(injection_type, count, **extra):
+    rule = {"percent": 100, "injectionType": injection_type,
+            "interceptionCount": count}
+    rule.update(extra)
+    return {"xlaRuntimeFaults": {"plan_execute": rule}}
+
+
+def _host(table: Table):
+    return [np.asarray(c.data).tolist() for c in table.columns]
+
+
+@pytest.mark.chaos
+def test_transient_storm_on_join_plan_retries_bit_identical(tmp_path):
+    tabs = tpch.generate_q5_tables(20_000, 61)
+    baseline = _host(tpch.run_q5(*tabs, engine="plan"))
+    install(write_cfg(tmp_path, _rule(2, 2, substituteReturnCode=700)),
+            seed=0)
+    plan_metrics.reset()
+    out = _host(tpch.run_q5(*tabs, engine="plan"))
+    assert out == baseline
+    # retries re-dispatch the SAME fused program: no eager fallback
+    assert plan_metrics.snapshot()["plan_join_fallbacks"] == 0
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_faults"] == 2
+    assert m["transient_retries"] == 2
+
+
+@pytest.mark.chaos
+def test_stall_storm_on_join_plan_cancelled_and_recovered(tmp_path):
+    tabs = tpch.generate_q5_tables(20_000, 62)
+    baseline = _host(tpch.run_q5(*tabs, engine="plan"))
+    install(write_cfg(tmp_path, _rule(4, 1, delayMs=-1)), seed=0)
+    with config.override("task.budget_s", 0.35), \
+            config.override("task.retry_budget", 8), \
+            config.override("task.degrade_after", 0), \
+            TaskExecutor() as ex:
+        fut = ex.submit(1, lambda: _host(tpch.run_q5(*tabs, engine="plan")))
+        assert fut.result(timeout=60) == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_delays"] == 1
+    assert m["stall_detected"] >= 1
+    assert m["stall_cancelled"] >= 1
