@@ -350,6 +350,13 @@ def axis_table():
         # capture proves the encoded-vs-materialized ratio on-chip
         ("dict_filter_strings_4m", lambda: _B().bench_dict_filter_strings(1 << 22), 1 << 22),
         ("dict_groupby_strings_4m", lambda: _B().bench_dict_groupby_strings(1 << 22), 1 << 22),
+        # the RLE/FOR encoded-execution axes (ROADMAP item 2): sorted /
+        # low-cardinality data; each row carries the materialized engine's
+        # time, the run/row compression ratio and bytes_skipped, so one
+        # capture proves compute-without-decode on-chip
+        ("rle_filter_4m", lambda: _B().bench_rle_filter(1 << 22), 1 << 22),
+        ("rle_groupby_4m", lambda: _B().bench_rle_groupby(1 << 22), 1 << 22),
+        ("for_filter_4m", lambda: _B().bench_for_filter(1 << 22), 1 << 22),
         # the serving-tier axis (ROADMAP item 3): sustained QPS + tail
         # latency through admission/scheduling/micro-batching; the row
         # carries qps, p50/p95/p99, queue depth, dispatches-per-query and

@@ -397,6 +397,232 @@ def bench_dict_filter_strings(rows: int):
     return sec, nbytes
 
 
+def _sorted_lowcard_int64(rows: int, avg_run: int = 1024) -> np.ndarray:
+    """Sorted int64 key with ~avg_run-row runs (the timestamp/partition-key
+    shape RLE targets): cardinality rows/avg_run, each value contiguous."""
+    card = max(rows // avg_run, 2)
+    reps = -(-rows // card)
+    return np.repeat(np.arange(card, dtype=np.int64), reps)[:rows]
+
+
+def bench_rle_groupby(rows: int):
+    """Groupby-sum/count over a sorted ~1k-run int64 key, encoded vs
+    materialized engines side by side: the RLE key rides the _rle_groupby
+    fast path (host run-unique + device segment aggregation — no row-width
+    sort), the materialized key pays the full sort-based groupby over the
+    same decoded rows. Extra row fields via pop_extra():
+    materialized_seconds, speedup_vs_materialized, the run/row
+    compression_ratio, encoded_bytes, and bytes_skipped — the key-ingest
+    bytes the encoded engine never touched."""
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar import encodings
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+
+    key = Column.from_numpy(_sorted_lowcard_int64(rows), dt.INT64)
+    rkey = encodings.rle_encode(key)
+    nruns = encodings.num_runs(rkey)
+    enc_tables, mat_tables = [], []
+    for s in range(_NVARIANTS):
+        rng = np.random.default_rng(s)
+        val = Column.from_numpy(rng.integers(-1000, 1000, rows), dt.INT64)
+        enc_tables.append(Table((rkey, val)))
+        mat_tables.append(Table((encodings.materialize(rkey), val)))
+
+    aggs = [(1, "sum"), (1, "count")]
+    sec = _time(lambda i: groupby_aggregate(
+        enc_tables[i % _NVARIANTS], [0], aggs), warmup=_NVARIANTS)
+    mat_sec = _time(lambda i: groupby_aggregate(
+        mat_tables[i % _NVARIANTS], [0], aggs), warmup=_NVARIANTS)
+    enc_bytes = nruns * (8 + 4)  # int64 run values + int32 run lengths
+    LAST_EXTRA.clear()
+    LAST_EXTRA.update({
+        "engine": "rle",
+        "materialized_seconds": round(mat_sec, 6),
+        "speedup_vs_materialized": round(mat_sec / sec, 2),
+        "compression_ratio": round(rows / nruns, 1),
+        "encoded_bytes": enc_bytes,
+        "bytes_skipped": rows * 8 - enc_bytes,
+    })
+    return sec, rows * 16
+
+
+def bench_rle_filter(rows: int):
+    """Selective scan→filter on a sorted ~1k-run int64 key over snappy
+    parquet (16 row groups, the needle value only in the last one).
+
+    Encoded engine (headline ``seconds``): column-chunk min/max statistics
+    prune 15/16 groups before any decode (stat_skips / bytes_skipped
+    counters in the row), the survivor's all-RLE dictionary-index pages
+    surface directly as an RLE column (no decode gather), and the fused
+    plan evaluates the predicate per-RUN. Materialized engine: full decode
+    of every group to plain int64 rows, then the same fused filter
+    row-wise. Extra row fields: the reader skip-counter deltas,
+    materialized_seconds, speedup_vs_materialized, compression_ratio,
+    encoded_bytes."""
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.columnar import encodings
+    from spark_rapids_jni_tpu.parquet import ParquetReader
+    from spark_rapids_jni_tpu.parquet.reader import reader_metrics
+    from spark_rapids_jni_tpu.plan import (
+        Filter, Scan, col as pcol, execute_plan)
+    from spark_rapids_jni_tpu.utils import config
+
+    keys = _sorted_lowcard_int64(rows)
+    needle = int(keys[-1])  # sorted => only the last group can hold it
+    payload = np.random.default_rng(0).integers(-1000, 1000, rows)
+    group = max(rows // 16, 1024)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "rle_filter.parquet")
+        pq.write_table(
+            pa.table({"key": pa.array(keys), "val": pa.array(payload)}),
+            path, compression="snappy", row_group_size=group)
+        nbytes = os.path.getsize(path)
+
+        plan = Filter(Scan(ncols=2), pcol(0) == needle)
+
+        def run_encoded():
+            import jax
+            with config.override("parquet.device_decode", "on"), \
+                    config.override("parquet.encoded_ints", True):
+                with ParquetReader(path, predicate=plan.predicate) as r:
+                    t = r.read_all()
+                out = execute_plan(plan, t)
+            jax.block_until_ready(
+                [c.data for c in out.columns if c.data is not None])
+            return t
+
+        def run_materialized():
+            import jax
+            with config.override("parquet.device_decode", "on"):
+                with ParquetReader(path) as r:
+                    t = r.read_all()
+                out = execute_plan(plan, t)
+            jax.block_until_ready(
+                [c.data for c in out.columns if c.data is not None])
+            return out
+
+        # one warm read doubles as the pushdown-counter + encoding sample:
+        # skip counts and the surviving column's encoding are per-read
+        # properties of the file, not of the timing
+        before = reader_metrics.snapshot()
+        warm = run_encoded()
+        after = reader_metrics.snapshot()
+        skip = {k: after[k] - before[k]
+                for k in ("pages_skipped", "bytes_skipped",
+                          "row_groups_skipped", "stat_skips",
+                          "membership_skips")}
+        kcol = warm.columns[0]
+        enc_bytes = (encodings.num_runs(kcol) * (8 + 4)
+                     if encodings.is_rle(kcol) else kcol.size * 8)
+        comp = (round(kcol.size / encodings.num_runs(kcol), 1)
+                if encodings.is_rle(kcol) else 1.0)
+        sec = _with_plan_extra(lambda: _time(run_encoded, warmup=0, iters=3))
+        mat_sec = _time(run_materialized, warmup=1, iters=3)
+    LAST_EXTRA.update(skip)
+    LAST_EXTRA.update({
+        "materialized_seconds": round(mat_sec, 6),
+        "speedup_vs_materialized": round(mat_sec / sec, 2),
+        "compression_ratio": comp,
+        "encoded_bytes": enc_bytes,
+    })
+    return sec, nbytes
+
+
+def bench_for_filter(rows: int):
+    """Selective scan→filter on a bounded-range int64 key over snappy
+    parquet: each of the 16 row groups cycles its own dense 1024-value
+    range (values strictly increase group to group), so chunk min/max
+    statistics prune 15/16 groups and the survivor's bit-packed
+    dictionary-index page over a dense ascending dictionary surfaces as a
+    frame-of-reference column — 10-bit packed codes, never the 8-byte
+    rows. The fused plan evaluates the predicate in CODE space against
+    the reference-shifted literal. Materialized engine: full decode of
+    every group, same fused filter row-wise. Extra row fields mirror
+    bench_rle_filter."""
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu.columnar import encodings
+    from spark_rapids_jni_tpu.parquet import ParquetReader
+    from spark_rapids_jni_tpu.parquet.reader import reader_metrics
+    from spark_rapids_jni_tpu.plan import (
+        Filter, Scan, col as pcol, execute_plan)
+    from spark_rapids_jni_tpu.utils import config
+
+    card = 1024
+    group = max(rows // 16, card)  # group % card == 0: cycles stay aligned
+    idx = np.arange(rows, dtype=np.int64)
+    keys = (idx // group) * card + (idx % card)
+    needle = int(keys[-1])
+    payload = np.random.default_rng(0).integers(-1000, 1000, rows)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "for_filter.parquet")
+        # one data page per chunk: the FOR fast path stitches a single
+        # page's bit-packed runs into one packed buffer
+        pq.write_table(
+            pa.table({"key": pa.array(keys), "val": pa.array(payload)}),
+            path, compression="snappy", row_group_size=group,
+            data_page_size=1 << 24)
+        nbytes = os.path.getsize(path)
+
+        plan = Filter(Scan(ncols=2), pcol(0) == needle)
+
+        def run_encoded():
+            import jax
+            with config.override("parquet.device_decode", "on"), \
+                    config.override("parquet.encoded_ints", True):
+                with ParquetReader(path, predicate=plan.predicate) as r:
+                    t = r.read_all()
+                out = execute_plan(plan, t)
+            jax.block_until_ready(
+                [c.data for c in out.columns if c.data is not None])
+            return t
+
+        def run_materialized():
+            import jax
+            with config.override("parquet.device_decode", "on"):
+                with ParquetReader(path) as r:
+                    t = r.read_all()
+                out = execute_plan(plan, t)
+            jax.block_until_ready(
+                [c.data for c in out.columns if c.data is not None])
+            return out
+
+        before = reader_metrics.snapshot()
+        warm = run_encoded()
+        after = reader_metrics.snapshot()
+        skip = {k: after[k] - before[k]
+                for k in ("pages_skipped", "bytes_skipped",
+                          "row_groups_skipped", "stat_skips",
+                          "membership_skips")}
+        kcol = warm.columns[0]
+        if encodings.is_for(kcol):
+            enc_bytes = encodings.packed_nbytes(
+                kcol.size, encodings.for_width(kcol))
+            comp = round(kcol.size * 8 / enc_bytes, 1)
+        else:
+            enc_bytes, comp = kcol.size * 8, 1.0
+        sec = _with_plan_extra(lambda: _time(run_encoded, warmup=0, iters=3))
+        mat_sec = _time(run_materialized, warmup=1, iters=3)
+    LAST_EXTRA.update(skip)
+    LAST_EXTRA.update({
+        "materialized_seconds": round(mat_sec, 6),
+        "speedup_vs_materialized": round(mat_sec / sec, 2),
+        "compression_ratio": comp,
+        "encoded_bytes": enc_bytes,
+    })
+    return sec, nbytes
+
+
 def bench_serving_qps_mixed(queries: int):
     """Serving-tier sustained-QPS storm: ``queries`` queries, 3 tenants,
     a skewed plan mix (~70% filter / 20% groupby / 10% sort+limit), and
@@ -823,6 +1049,7 @@ def main():
                              "get_json_object", "from_json",
                              "parquet_decode", "shuffle_skewed",
                              "dict_filter_strings", "dict_groupby_strings",
+                             "rle_filter", "rle_groupby", "for_filter",
                              "serving_qps_mixed"])
     args = ap.parse_args()
     _refresh_variants()
@@ -865,6 +1092,18 @@ def main():
         runs.append(("dict_filter_strings", "pushdown+codes vs full decode",
                      args.rows,
                      lambda: bench_dict_filter_strings(args.rows)))
+    if args.bench in ("all", "rle_filter"):
+        runs.append(("rle_filter", "stats pushdown + run-space predicate",
+                     args.rows,
+                     lambda: bench_rle_filter(args.rows)))
+    if args.bench in ("all", "rle_groupby"):
+        runs.append(("rle_groupby", "run-space groupby vs sort-based decode",
+                     args.rows,
+                     lambda: bench_rle_groupby(args.rows)))
+    if args.bench in ("all", "for_filter"):
+        runs.append(("for_filter", "packed code-space predicate",
+                     args.rows,
+                     lambda: bench_for_filter(args.rows)))
     if args.bench in ("all", "serving_qps_mixed"):
         q = min(args.rows, 1000)
         runs.append(("serving_qps_mixed", "3 tenants, poisson, 70/20/10 mix",

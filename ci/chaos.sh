@@ -101,7 +101,21 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_plan_join.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 8 — exception-fault storms over the whole chaos-marked suite
+# stage 8 — encoded-execution fault storm: POISON traps at the
+# plan_execute surface while scan→filter→groupby plans run over RLE and
+# FOR encoded inputs. Pass criteria baked into the test
+# (tests/test_encodings.py chaos mark): every faulted query retries from
+# the immutable run/packed buffers and returns bits identical to the
+# materialized clean run, and the shared encoded children survive the
+# storm untouched (donation is blocked for encoded columns — a retry
+# must never read a donated-away run buffer). The outer `timeout` is
+# part of the contract: a wedged encoded replay fails the lane loudly.
+# `make encode` runs the full encoded-execution lane.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_encodings.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 9 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -1312,6 +1312,56 @@ def rule_srjt015(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT016 — encoded-column (RLE/FOR) decode outside declared boundaries
+# ---------------------------------------------------------------------------
+
+# Generalizes SRJT012 from DICT32 to the run-length and frame-of-reference
+# encodings (columnar/encodings.py): filter predicates evaluate per-run /
+# in code space, aggregates fold runs as value x length, and concat
+# appends run buffers — the row expansion those shortcuts skip IS the
+# encoding's value. ``decoded_rows`` (the sanctioned interior decode) and
+# the encodings module's ``materialize``/``materialize_table`` re-inflate
+# a column to row width, so every call site is an output boundary that
+# must be DECLARED: flagged here, then individually accepted into
+# ci/lint_baseline.json with a reason (the workflow SRJT002's accepted
+# f64 sites use). A new decode site anywhere in the package fails lint
+# until it is either restructured to stay encoded or explicitly
+# sanctioned. columnar/encodings.py itself is exempt (it defines the
+# boundary operations).
+
+_SRJT016_EXEMPT = ("columnar/encodings.py",)
+_SRJT016_ENC_QUALS = ("enc", "encodings")
+
+
+def rule_srjt016(tree, rel, lines, ctx) -> List[Finding]:
+    if any(rel.endswith(e) for e in _SRJT016_EXEMPT):
+        return []
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        if dn is None:
+            continue
+        parts = dn.split(".")
+        hit = parts[-1] == "decoded_rows" or (
+            len(parts) >= 2
+            and parts[-1] in ("materialize", "materialize_table")
+            and parts[-2] in _SRJT016_ENC_QUALS)
+        if not hit:
+            continue
+        findings.append(Finding(
+            "SRJT016", rel, node.lineno,
+            f"`{dn}(...)` decodes an RLE/FOR column to row width — "
+            f"encoded execution must stay per-run / in code space "
+            f"(predicates, aggregates, concat all have encoded forms in "
+            f"columnar/encodings.py); if this site is a genuine output "
+            f"boundary, declare it in ci/lint_baseline.json with a "
+            f"reason"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 
@@ -1319,7 +1369,7 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
-              rule_srjt015)
+              rule_srjt015, rule_srjt016)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

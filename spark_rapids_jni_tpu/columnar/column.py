@@ -321,6 +321,10 @@ class Column:
             from .dictionary import materialize
             return materialize(self).to_pylist()
 
+        if tid in (TypeId.RLE, TypeId.FOR32, TypeId.FOR64):
+            from .encodings import materialize
+            return materialize(self).to_pylist()
+
         if tid is TypeId.DECIMAL128:
             limbs = np.asarray(self.data)
             out = []

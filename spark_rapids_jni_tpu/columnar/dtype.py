@@ -36,6 +36,9 @@ class TypeId(enum.Enum):
     TIMESTAMP_MICROSECONDS = "timestamp_us"  # int64
     STRING = "string"
     DICT32 = "dict32"  # int32 codes into a shared string dictionary
+    RLE = "rle"        # run-length: children = (run values, run lengths)
+    FOR32 = "for32"    # frame-of-reference bit-packed int32 (scale = width)
+    FOR64 = "for64"    # frame-of-reference bit-packed int64 (scale = width)
     DECIMAL32 = "decimal32"
     DECIMAL64 = "decimal64"
     DECIMAL128 = "decimal128"
@@ -62,6 +65,12 @@ _FIXED_WIDTH_NP = {
     TypeId.DECIMAL32: np.int32,
     TypeId.DECIMAL64: np.int64,
     TypeId.DICT32: np.int32,
+    # RLE stores no row-shaped data buffer (runs live in children); FOR
+    # stores packed uint8 bytes. np_dtype reports the LOGICAL element type
+    # so bit-identity checks and aggregates know what a decoded row is.
+    TypeId.RLE: np.int64,
+    TypeId.FOR32: np.int32,
+    TypeId.FOR64: np.int64,
     # DECIMAL128 handled specially: (n, 4) uint32 little-endian limbs.
 }
 
@@ -74,6 +83,7 @@ _SIZE_BYTES = {
     TypeId.TIMESTAMP_SECONDS: 8, TypeId.TIMESTAMP_MILLISECONDS: 8,
     TypeId.TIMESTAMP_MICROSECONDS: 8, TypeId.DECIMAL64: 8,
     TypeId.DECIMAL128: 16, TypeId.DICT32: 4,
+    TypeId.RLE: 8, TypeId.FOR32: 4, TypeId.FOR64: 8,
 }
 
 
@@ -157,6 +167,30 @@ TIMESTAMP_MILLISECONDS = DType(TypeId.TIMESTAMP_MILLISECONDS)
 TIMESTAMP_MICROSECONDS = DType(TypeId.TIMESTAMP_MICROSECONDS)
 LIST = DType(TypeId.LIST)
 STRUCT = DType(TypeId.STRUCT)
+
+
+RLE = DType(TypeId.RLE)
+
+
+def for32(width: int) -> DType:
+    """FOR32 dtype with a static bit width (1..32) riding the scale slot —
+    the same generic-int reuse decimals make of it, so the width lands in
+    jit shape keys and spill metadata with no new machinery."""
+    assert 1 <= width <= 32, width
+    return DType(TypeId.FOR32, width)
+
+
+def for64(width: int) -> DType:
+    """FOR64 dtype with a static bit width (1..32; codes are offsets from
+    the reference, so 32 bits of span covers 4B-distinct-value frames)."""
+    assert 1 <= width <= 32, width
+    return DType(TypeId.FOR64, width)
+
+
+def is_encoded(dtype: DType) -> bool:
+    """True for the run/packed encodings introduced by columnar/encodings.py
+    (DICT32 is its own older lattice point with dedicated handling)."""
+    return dtype.id in (TypeId.RLE, TypeId.FOR32, TypeId.FOR64)
 
 
 def decimal32(scale: int) -> DType:

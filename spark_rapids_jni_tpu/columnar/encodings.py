@@ -1,0 +1,525 @@
+"""Run-length and frame-of-reference encoded columns (RLE, FOR32/FOR64).
+
+Following "GPU Acceleration of SQL Analytics on Compressed Data" (PAPERS.md),
+integer columns stay encoded end-to-end and the engine computes on the
+encoded form — a predicate over an RLE column evaluates once per RUN, an
+aggregate sums ``value * run_length``, and a FOR comparison shifts the
+literal by the reference and compares bit-packed codes. Both encodings are
+plain :class:`Column` pytrees (same move as DICT32 in dictionary.py), so jit
+tracing, spill serialization, integrity fingerprints and ``device_nbytes``
+all recurse into the encoded buffers with no special cases:
+
+RLE — ``Column(dtype=dt.RLE, size=n, data=None, children=(values, lengths))``
+    children[0] "values"  — run values, a fixed-width integer Column of the
+                LOGICAL dtype (size r). Per-run validity: a null run is ONE
+                null entry here, covering length[i] rows.
+    children[1] "lengths" — INT32 run lengths (size r, >= 0; zero-length
+                runs are legal and cover no rows).
+    Column-level ``data``/``validity`` are always None — row-shaped state
+    would defeat the encoding. Host run ENDS (inclusive cumulative sums)
+    are memoized on the lengths child; inside traced programs ends are a
+    ``jnp.cumsum`` (XLA dedupes the repeats).
+
+FOR — ``Column(dtype=DType(FOR32|FOR64, scale=width), size=n,
+               data=uint8[ceil(n*width/8)], validity, children=(header,))``
+    ``data`` holds LSB-first bit-packed codes (parquet bit-pack order);
+    the static bit width (1..32) rides ``dtype.scale`` exactly like
+    decimal scale, so it lands in jit shape keys and spill metadata for
+    free. children[0] "header" is a one-row INT64 Column carrying the
+    reference — a TRACED operand, so a new reference value never
+    recompiles a fused program. Decoded row = reference + code; null rows
+    carry code 0 (canonical form, keeps encoded-vs-decoded bit-identity).
+
+``materialize()`` / ``materialize_table()`` are the output boundaries
+(row conversion, user-visible results) and ``decoded_rows()`` is the pure
+in-program decoder for the few SANCTIONED interior boundaries (gather's
+row re-order, sort's key expansion). srjt-lint rule SRJT016 keeps both out
+of op code paths and ``@plan_core`` bodies except for baselined sites.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dt
+from .column import Column
+from .dtype import TypeId
+
+# value dtypes an RLE column may carry (the fused expression layer's
+# int64-arithmetic family; floats/decimals/strings never ride runs here)
+_RLE_VALUE_IDS = (
+    TypeId.BOOL8, TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64,
+    TypeId.UINT8, TypeId.UINT16, TypeId.UINT32,
+    TypeId.TIMESTAMP_DAYS, TypeId.TIMESTAMP_SECONDS,
+    TypeId.TIMESTAMP_MILLISECONDS, TypeId.TIMESTAMP_MICROSECONDS,
+)
+
+
+def is_rle(col: Column) -> bool:
+    return col.dtype.id is TypeId.RLE
+
+
+def is_for(col: Column) -> bool:
+    return col.dtype.id in (TypeId.FOR32, TypeId.FOR64)
+
+
+def is_encoded(col: Column) -> bool:
+    """RLE or FOR (DICT32 predates this module and keeps its own paths)."""
+    return col.dtype.id in (TypeId.RLE, TypeId.FOR32, TypeId.FOR64)
+
+
+def logical_dtype(col: Column) -> dt.DType:
+    """The dtype a decoded row carries."""
+    if is_rle(col):
+        return rle_values(col).dtype
+    if col.dtype.id is TypeId.FOR32:
+        return dt.INT32
+    if col.dtype.id is TypeId.FOR64:
+        return dt.INT64
+    return col.dtype
+
+
+# ---------------------------------------------------------------------------
+# RLE construction / accessors
+# ---------------------------------------------------------------------------
+
+def rle_values(col: Column) -> Column:
+    """The per-run values child of an RLE column."""
+    return col.children[0]
+
+
+def rle_lengths(col: Column) -> Column:
+    """The per-run INT32 lengths child of an RLE column."""
+    return col.children[1]
+
+
+def num_runs(col: Column) -> int:
+    return col.children[0].size
+
+
+def rle_column(values: Column, lengths: Column,
+               size: Optional[int] = None) -> Column:
+    """Assemble an RLE column from run values + run lengths. ``size`` (the
+    decoded row count) defaults to the host sum of lengths — pass it when
+    the lengths buffer is traced."""
+    assert values.dtype.id in _RLE_VALUE_IDS, values.dtype
+    assert lengths.dtype.id is TypeId.INT32, lengths.dtype
+    assert values.size == lengths.size, (values.size, lengths.size)
+    if size is None:
+        h = lengths.host_data()
+        size = int(h.sum()) if h is not None and h.size else 0
+    return Column(dt.RLE, int(size), data=None, validity=None,
+                  children=(values, lengths))
+
+
+def rle_encode(col: Column) -> Column:
+    """Re-encode a plain fixed-width integer column as RLE (host-side run
+    detection; bench/test entry point — production encoded columns come
+    straight from parquet RLE pages without a decoded intermediate). A run
+    breaks on a value change OR a validity change; null runs store value 0."""
+    assert col.dtype.id in _RLE_VALUE_IDS, col.dtype
+    n = col.size
+    if n == 0:
+        values = Column.from_numpy(np.zeros((0,), dtype=col.dtype.np_dtype),
+                                   col.dtype)
+        lengths = Column.from_numpy(np.zeros((0,), dtype=np.int32), dt.INT32)
+        return rle_column(values, lengths, 0)
+    vals = np.asarray(col.host_data())
+    valid = (np.asarray(col.validity).astype(bool)
+             if col.validity is not None else np.ones(n, dtype=bool))
+    vals = np.where(valid, vals, 0).astype(col.dtype.np_dtype)
+    brk = np.empty(n, dtype=bool)
+    brk[0] = True
+    brk[1:] = (vals[1:] != vals[:-1]) | (valid[1:] != valid[:-1])
+    starts = np.flatnonzero(brk)
+    ends = np.append(starts[1:], n)
+    run_vals = vals[starts].copy()
+    run_valid = valid[starts]
+    lengths_np = (ends - starts).astype(np.int32)
+    vmask = None if run_valid.all() else jnp.asarray(run_valid)
+    values = Column(col.dtype, len(starts), data=jnp.asarray(run_vals),
+                    validity=vmask)._seed_host_cache(run_vals)
+    lcol = Column(dt.INT32, len(starts), data=jnp.asarray(lengths_np))
+    lcol._seed_host_cache(lengths_np)
+    return rle_column(values, lcol, n)
+
+
+def run_ends(col: Column) -> np.ndarray:
+    """Host int64 inclusive run ends (``ends[i] = sum(lengths[:i+1])``),
+    memoized on the shared, immutable lengths child so every batch sharing
+    the run structure pays the readback once — the dictionary.py
+    memoize-on-immutable pattern."""
+    lengths = rle_lengths(col)
+    cached = getattr(lengths, "_rle_ends", None)
+    if cached is None:
+        h = lengths.host_data()
+        cached = (np.cumsum(h, dtype=np.int64) if h is not None and h.size
+                  else np.zeros((0,), dtype=np.int64))
+        cached.flags.writeable = False
+        object.__setattr__(lengths, "_rle_ends", cached)
+    return cached
+
+
+def run_ends_device(col: Column) -> jnp.ndarray:
+    """Traced int64 inclusive run ends (cumsum of lengths) for in-program
+    row->run mapping; XLA CSE collapses repeated cumsums over one buffer."""
+    return jnp.cumsum(rle_lengths(col).data.astype(jnp.int64))
+
+
+def row_to_run(ends: jnp.ndarray, n: int) -> jnp.ndarray:
+    """int32 run id of every row given inclusive run ends: the first run
+    whose end exceeds the row index. Zero-length runs have ``ends`` equal
+    to their predecessor's and are never selected."""
+    rows = jnp.arange(n, dtype=jnp.int64)
+    return jnp.searchsorted(ends, rows, side="right").astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# FOR construction / accessors
+# ---------------------------------------------------------------------------
+
+def for_header(col: Column) -> Column:
+    """The one-row INT64 reference header of a FOR column."""
+    return col.children[0]
+
+
+def for_width(col: Column) -> int:
+    """Static bit width (1..32) of a FOR column's packed codes."""
+    return col.dtype.scale
+
+
+def for_reference(col: Column) -> jnp.ndarray:
+    """Traced int64 scalar reference (decoded value = reference + code)."""
+    return for_header(col).data[0]
+
+
+def packed_nbytes(n: int, width: int) -> int:
+    return (n * width + 7) // 8
+
+
+def pack_codes(codes: np.ndarray, width: int) -> np.ndarray:
+    """LSB-first bit-pack host uint64 codes (< 2**width) into uint8 bytes
+    — parquet bit-packed order, so parquet pages surface by reference."""
+    n = codes.shape[0]
+    buf = np.zeros(packed_nbytes(n, width) + 8, dtype=np.uint8)
+    bit0 = np.arange(n, dtype=np.int64) * width
+    byte0 = bit0 >> 3
+    sh = (bit0 & 7).astype(np.uint64)
+    c = codes.astype(np.uint64) << sh  # <= width + 7 <= 39 bits
+    for b in range(5):  # a shifted code spans at most 5 bytes
+        np.bitwise_or.at(buf, byte0 + b,
+                         ((c >> np.uint64(8 * b)) & np.uint64(0xFF))
+                         .astype(np.uint8))
+    return buf[:packed_nbytes(n, width)]
+
+
+def unpack_codes(packed: jnp.ndarray, n: int, width: int) -> jnp.ndarray:
+    """Pure-jnp int64 codes from LSB-first packed bytes — the clipped
+    5-byte gather window technique shared with parquet's run expander
+    (parquet/device_decode.py): bytes gathered past the buffer clip to the
+    last byte, and any duplicate bits land strictly above ``shift + width``
+    so the mask discards them."""
+    if n == 0:
+        return jnp.zeros((0,), dtype=jnp.int64)
+    blob = packed.astype(jnp.uint64)
+    nb = packed.shape[0]
+    bit0 = jnp.arange(n, dtype=jnp.int64) * width
+    byte0 = bit0 >> 3
+    sh = (bit0 & 7).astype(jnp.uint64)
+    word = jnp.zeros((n,), dtype=jnp.uint64)
+    for b in range(5):
+        word = word | (jnp.take(blob, jnp.clip(byte0 + b, 0, nb - 1))
+                       << jnp.uint64(8 * b))
+    mask = jnp.uint64((1 << width) - 1)
+    return ((word >> sh) & mask).astype(jnp.int64)
+
+
+def for_column(packed: jnp.ndarray, dtype: dt.DType, size: int,
+               reference, validity: Optional[jnp.ndarray] = None) -> Column:
+    """Assemble a FOR column from packed bytes + reference. ``reference``
+    may be a python int or a traced scalar."""
+    assert dtype.id in (TypeId.FOR32, TypeId.FOR64), dtype
+    assert 1 <= dtype.scale <= 32, dtype.scale
+    header = Column(dt.INT64, 1,
+                    data=jnp.asarray(reference, dtype=jnp.int64).reshape(1))
+    return Column(dtype, int(size), data=packed, validity=validity,
+                  children=(header,))
+
+
+def for_encode(col: Column, width: Optional[int] = None) -> Column:
+    """Re-encode a plain INT32/INT64 column as FOR32/FOR64 (host-side;
+    bench/test entry point). Reference = min over valid rows; width = bits
+    of the valid-value span (forced >= 1); null rows pack code 0."""
+    assert col.dtype.id in (TypeId.INT32, TypeId.INT64), col.dtype
+    n = col.size
+    out_id = TypeId.FOR32 if col.dtype.id is TypeId.INT32 else TypeId.FOR64
+    vals = (np.asarray(col.host_data()).astype(np.int64)
+            if n else np.zeros((0,), dtype=np.int64))
+    valid = (np.asarray(col.validity).astype(bool)
+             if col.validity is not None else np.ones(n, dtype=bool))
+    live = vals[valid]
+    ref = int(live.min()) if live.size else 0
+    span = int(live.max()) - ref if live.size else 0
+    need = max(1, int(span).bit_length())
+    if width is None:
+        width = need
+    assert need <= width <= 32, (need, width, span)
+    codes = np.where(valid, vals - ref, 0).astype(np.uint64)
+    packed_np = pack_codes(codes, width)
+    packed = jnp.asarray(packed_np)
+    vmask = None if col.validity is None else col.validity
+    out = for_column(packed, dt.DType(out_id, width), n, ref, vmask)
+    out._seed_host_cache(packed_np)
+    return out
+
+
+def for_codes(col: Column) -> jnp.ndarray:
+    """Traced int64 code array of a FOR column (reference NOT added)."""
+    return unpack_codes(col.data, col.size, for_width(col))
+
+
+# ---------------------------------------------------------------------------
+# decoding — sanctioned interior boundary vs output boundary
+# ---------------------------------------------------------------------------
+
+def decoded_rows(col: Column) -> Column:
+    """Pure-jnp decode of an encoded column to its logical fixed-width
+    form. This is the SANCTIONED interior boundary — the only legitimate
+    callers are declared decode points (ops/sort.gather's row re-order,
+    sort key-lane expansion, groupby value expansion) and each call site in
+    ops//plan code must carry an SRJT016 baseline entry."""
+    if is_rle(col):
+        values = rle_values(col)
+        n = col.size
+        if n == 0 or values.size == 0:
+            return Column(values.dtype, n,
+                          data=jnp.zeros((n,), values.dtype.jnp_dtype))
+        rid = row_to_run(run_ends_device(col), n)
+        data = jnp.take(values.data, rid)
+        validity = (jnp.take(values.validity, rid)
+                    if values.validity is not None else None)
+        return Column(values.dtype, n, data=data, validity=validity)
+    if is_for(col):
+        out_dtype = logical_dtype(col)
+        data = (for_reference(col) + for_codes(col)).astype(
+            out_dtype.jnp_dtype)
+        return Column(out_dtype, col.size, data=data, validity=col.validity)
+    return col
+
+
+def materialize(col: Column) -> Column:
+    """Decode an RLE/FOR column -> plain column. The ONLY place encoded
+    columns expand to row-shaped buffers outside sanctioned decode points;
+    callers are output boundaries (row conversion, user-visible results,
+    benches). Mirrors dictionary.materialize."""
+    assert is_encoded(col), col.dtype
+    return decoded_rows(col)
+
+
+def materialize_table(table):
+    """Materialize every RLE/FOR column of a Table (output boundary)."""
+    from .column import Table
+    return Table(tuple(materialize(c) if is_encoded(c) else c
+                       for c in table))
+
+
+# ---------------------------------------------------------------------------
+# identity: fingerprints and program-cache keys
+# ---------------------------------------------------------------------------
+
+def encoding_fingerprint(col: Column) -> int:
+    """crc32 over the encoded buffers (run values+lengths, or packed
+    bytes+reference+width). Memoized on the column; identity for tests,
+    exchange sanity checks and parquet round-trip assertions — NOT for
+    program-cache keys (run buffers are per-batch traced data; a content
+    hash there would defeat cache reuse across batches)."""
+    cached = getattr(col, "_enc_fp", None)
+    if cached is not None:
+        return cached
+    if is_rle(col):
+        values, lengths = rle_values(col), rle_lengths(col)
+        h = zlib.crc32(np.asarray(values.host_data(),
+                                  dtype=np.int64).tobytes())
+        h = zlib.crc32(np.asarray(lengths.host_data(),
+                                  dtype=np.int64).tobytes(), h)
+        if values.validity is not None:
+            h = zlib.crc32(np.asarray(values.validity).tobytes(), h)
+    else:
+        assert is_for(col), col.dtype
+        h = zlib.crc32(np.asarray(col.host_data()).tobytes())
+        h = zlib.crc32(np.asarray(for_header(col).host_data(),
+                                  dtype=np.int64).tobytes(), h)
+        h = zlib.crc32(bytes([for_width(col)]), h)
+    cached = (h ^ col.size) & 0xFFFFFFFF
+    object.__setattr__(col, "_enc_fp", cached)
+    return cached
+
+
+def encoding_cache_key(col: Column) -> Tuple:
+    """Per-column encoding component of the fused ProgramCache shape key
+    (plan/compile._shape_key calls this uniformly for every column).
+
+    Plain columns contribute nothing. DICT32 contributes the dictionary
+    fingerprint (constant-folding across dictionaries must not alias —
+    moved here from _shape_key's special case). RLE contributes its STATIC
+    run structure: run count, value dtype, and run-validity presence — but
+    NO content hash, since run buffers are traced per-batch operands and
+    hashing them would recompile every batch. FOR contributes only a tag:
+    width already rides dtype.scale and packed length is derivable from
+    (size, width), both in the base key."""
+    tid = col.dtype.id
+    if tid is TypeId.DICT32:
+        from .dictionary import dictionary_fingerprint
+        return ("dict", dictionary_fingerprint(col))
+    if tid is TypeId.RLE:
+        values = rle_values(col)
+        return ("rle", values.dtype.id.value, values.size,
+                values.validity is not None)
+    if tid in (TypeId.FOR32, TypeId.FOR64):
+        return ("for",)
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# run-space / code-space compute (the encoded win)
+# ---------------------------------------------------------------------------
+
+_AGG_OPS = ("sum", "count", "min", "max")
+
+
+def rle_predicate_runs(col: Column, op: str, literal: int) -> jnp.ndarray:
+    """bool[r] per-RUN keep mask for ``col <op> literal`` — the paper's
+    core move: one comparison per run, not per row. Null runs drop (SQL
+    WHERE)."""
+    values = rle_values(col)
+    cmp = {"lt": jnp.less, "le": jnp.less_equal, "gt": jnp.greater,
+           "ge": jnp.greater_equal, "eq": jnp.equal,
+           "ne": jnp.not_equal}[op]
+    keep = cmp(values.data.astype(jnp.int64), jnp.int64(literal))
+    if values.validity is not None:
+        keep = keep & values.validity
+    return keep
+
+
+def rle_aggregate(col: Column, op: str,
+                  run_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """int64 scalar aggregate over an RLE column WITHOUT decoding: sum is
+    ``sum(value * length)`` over valid (masked) runs — exact int64 modular
+    arithmetic, bit-identical to the row-wise sum; count sums lengths;
+    min/max reduce run values. ``run_mask``: optional bool[r] per-run
+    filter (e.g. from rle_predicate_runs). min/max return int64
+    max/min identity when no run survives — check count first."""
+    assert op in _AGG_OPS, op
+    values, lengths = rle_values(col), rle_lengths(col)
+    live = (values.validity if values.validity is not None
+            else jnp.ones((values.size,), dtype=bool))
+    if run_mask is not None:
+        live = live & run_mask
+    lens = lengths.data.astype(jnp.int64)
+    vals = values.data.astype(jnp.int64)
+    if op == "count":
+        return jnp.sum(jnp.where(live, lens, 0))
+    if op == "sum":
+        return jnp.sum(jnp.where(live, vals * lens, 0))
+    # min/max ignore zero-length runs: a zero-length run covers no rows
+    live = live & (lens > 0)
+    if op == "min":
+        return jnp.min(jnp.where(live, vals, jnp.iinfo(jnp.int64).max))
+    return jnp.max(jnp.where(live, vals, jnp.iinfo(jnp.int64).min))
+
+
+def for_predicate_mask(col: Column, op: str, literal: int) -> jnp.ndarray:
+    """bool[n] keep mask for ``col <op> literal`` on a FOR column via a
+    REFERENCE-SHIFTED literal: codes compare against ``literal - ref``
+    directly, so the reference addition never touches the n-sized lane.
+    Null rows drop."""
+    cmp = {"lt": jnp.less, "le": jnp.less_equal, "gt": jnp.greater,
+           "ge": jnp.greater_equal, "eq": jnp.equal,
+           "ne": jnp.not_equal}[op]
+    shifted = jnp.int64(literal) - for_reference(col)
+    keep = cmp(for_codes(col), shifted)
+    if col.validity is not None:
+        keep = keep & col.validity
+    return keep
+
+
+def for_aggregate(col: Column, op: str,
+                  row_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """int64 scalar aggregate over a FOR column in CODE space: sum is
+    ``sum(codes) + reference * live_count`` (exact modular int64 —
+    bit-identical to decoded summation); min/max add the reference to the
+    code extremum. ``row_mask``: optional bool[n] filter."""
+    assert op in _AGG_OPS, op
+    live = (col.validity if col.validity is not None
+            else jnp.ones((col.size,), dtype=bool))
+    if row_mask is not None:
+        live = live & row_mask
+    cnt = jnp.sum(live.astype(jnp.int64))
+    if op == "count":
+        return cnt
+    codes = for_codes(col)
+    ref = for_reference(col)
+    if op == "sum":
+        return jnp.sum(jnp.where(live, codes, 0)) + ref * cnt
+    if op == "min":
+        return ref + jnp.min(jnp.where(live, codes,
+                                       jnp.iinfo(jnp.int64).max))
+    return ref + jnp.max(jnp.where(live, codes, jnp.iinfo(jnp.int64).min))
+
+
+# ---------------------------------------------------------------------------
+# concat (encoded where structure allows, one declared boundary otherwise)
+# ---------------------------------------------------------------------------
+
+def _concat_plain(cols: Sequence[Column], out_dtype: dt.DType) -> Column:
+    """Concat fixed-width run-value/length children (no offsets, no
+    children of their own)."""
+    n = sum(c.size for c in cols)
+    data = jnp.concatenate([c.data for c in cols]) if n else \
+        jnp.zeros((0,), dtype=out_dtype.jnp_dtype)
+    if any(c.validity is not None for c in cols):
+        validity = jnp.concatenate([c.valid_mask() for c in cols])
+    else:
+        validity = None
+    return Column(out_dtype, n, data=data, validity=validity)
+
+
+def concat_rle(cols: Sequence[Column]) -> Column:
+    """Concatenate RLE columns RUN-WISE — sizes and run counts add, no
+    row-shaped buffer is ever built (adjacent equal values across the seam
+    stay as separate runs; decoded output is identical either way)."""
+    assert all(is_rle(c) for c in cols)
+    vd = rle_values(cols[0]).dtype
+    assert all(rle_values(c).dtype == vd for c in cols), \
+        "RLE concat requires matching value dtypes"
+    values = _concat_plain([rle_values(c) for c in cols], vd)
+    lengths = _concat_plain([rle_lengths(c) for c in cols], dt.INT32)
+    return rle_column(values, lengths, sum(c.size for c in cols))
+
+
+def concat_for(cols: Sequence[Column]) -> Optional[Column]:
+    """Concatenate FOR columns ENCODED when the packed buffers are
+    byte-compatible: same width, same reference (host check), and every
+    chunk but the last byte-aligned (``size*width % 8 == 0``) so packed
+    bytes concatenate directly. Returns None when structure forbids it —
+    the caller decodes at its declared boundary instead."""
+    assert all(is_for(c) for c in cols)
+    d0 = cols[0].dtype
+    if not all(c.dtype == d0 for c in cols):
+        return None
+    refs = [int(np.asarray(for_header(c).host_data())[0]) for c in cols]
+    if len(set(refs)) != 1:
+        return None
+    if any(c.size * d0.scale % 8 for c in cols[:-1]):
+        return None
+    n = sum(c.size for c in cols)
+    packed = jnp.concatenate([c.data for c in cols])
+    if any(c.validity is not None for c in cols):
+        validity = jnp.concatenate([c.valid_mask() for c in cols])
+    else:
+        validity = None
+    return for_column(packed, d0, n, refs[0], validity)

@@ -70,6 +70,23 @@ def concat_columns(cols: Sequence[Column]) -> Column:
     """Concatenate equal-dtype columns rowwise."""
     cols = _unify_devices([c for c in cols])
     assert cols, "concat of zero columns"
+    if any(c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64)
+           for c in cols):
+        # run/packed encodings concatenate ENCODED when structure allows:
+        # RLE always (runs append; r-sized work only), FOR when width,
+        # reference and byte alignment line up. Mixed or incompatible
+        # inputs decode at this one declared boundary and concat plain —
+        # decoded output is identical either way (bit-identity tests).
+        from . import encodings as enc
+        if (all(enc.is_rle(c) for c in cols)
+                and len({enc.rle_values(c).dtype for c in cols}) == 1):
+            return enc.concat_rle(cols)
+        if all(enc.is_for(c) for c in cols):
+            packed = enc.concat_for(cols)
+            if packed is not None:
+                return packed
+        return concat_columns([enc.materialize(c) if enc.is_encoded(c)
+                               else c for c in cols])
     d = cols[0].dtype
     for c in cols[1:]:
         if c.dtype.id is not d.id:

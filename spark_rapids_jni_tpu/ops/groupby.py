@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import dtype as dt
+from ..columnar import encodings as enc
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
@@ -37,6 +38,11 @@ from ..utils.tracing import func_range
 def _keys_equal_prev(col: Column, order: jnp.ndarray) -> jnp.ndarray:
     """bool[n]: sorted row equals previous sorted row on this key column.
     Fully device-resident (padded-byte-matrix compare for strings)."""
+    if col.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64):
+        # declared decode boundary (SRJT016-baselined): segment equality
+        # needs per-ROW validity, and this branch only runs when the
+        # single-RLE-key fast path below didn't apply (multi-key, FOR key)
+        col = enc.decoded_rows(col)
     idx, pidx = order[1:], order[:-1]
     valid = col.valid_mask()
     v_cur = jnp.take(valid, idx)
@@ -382,12 +388,123 @@ def _dict_code_groupby(table: Table, key_indices, aggs, row_mask):
     return Table(tuple(_shrink(c, true_segments) for c in out_cols))
 
 
+def _rle_groupby(table: Table, key_indices, aggs, row_mask):
+    """Sort-free groupby for a single RLE key: distinct groups fall out of
+    the RUN values (r-sized host work — runs are tiny next to rows, which
+    is the encoding's whole point), so segmentation is one
+    searchsorted-per-row plus a scatter-add instead of an n-row lexsort.
+    Groups order nulls-first then ascending, matching the sorted path's
+    defaults; integer scatter sums are exact, so output is bit-identical.
+    Returns None when inapplicable (multi-key, non-RLE key, decimal aggs,
+    or order-sensitive float accumulation)."""
+    if len(key_indices) != 1:
+        return None
+    key = table.columns[key_indices[0]]
+    if key.dtype.id is not dt.TypeId.RLE or key.size == 0:
+        return None
+    for ci, op in aggs:
+        did = table.columns[ci].dtype.id
+        if did is dt.TypeId.DECIMAL128:
+            return None  # limb carries stay on the sorted path
+        if did in (dt.TypeId.FLOAT32, dt.TypeId.FLOAT64) \
+                and op in ("sum", "mean"):
+            return None  # fp addition order must match the sorted path
+    n = key.size
+    values, lengths = enc.rle_values(key), enc.rle_lengths(key)
+    r = values.size
+    if r == 0:
+        return None
+    rvals = np.asarray(values.host_data(), dtype=np.int64)
+    rvalid = (np.asarray(values.validity).astype(bool)
+              if values.validity is not None else np.ones(r, dtype=bool))
+    rlens = np.asarray(lengths.host_data(), dtype=np.int64)
+    live_run = rlens > 0  # zero-length runs cover no rows, form no groups
+    # distinct (validity, value) pairs in nulls-first ascending order —
+    # np.unique on the record array sorts by field order, and nf=0 (null)
+    # sorts before every valid value
+    rec = np.empty(r, dtype=[("nf", np.int8), ("val", np.int64)])
+    rec["nf"] = rvalid.astype(np.int8)
+    rec["val"] = np.where(rvalid, rvals, 0)
+    uniq, inverse = np.unique(rec[live_run], return_inverse=True)
+    run_group = np.zeros(r, dtype=np.int32)
+    run_group[live_run] = inverse.astype(np.int32)
+    num_groups = int(uniq.size)
+    rid = enc.row_to_run(enc.run_ends_device(key), n)
+    slot = jnp.take(jnp.asarray(run_group), rid)
+    if row_mask is not None:
+        live = jnp.asarray(row_mask, dtype=bool)
+        if live.shape != (n,):
+            raise ValueError(
+                f"boolean row_mask shape {live.shape} != table rows "
+                f"({n},)")  # mirror filter_table's contract
+        rows_in_slot = jax.ops.segment_sum(live.astype(jnp.int32), slot,
+                                           num_segments=num_groups)
+        present = rows_in_slot > 0
+        pos = jnp.cumsum(present.astype(jnp.int32)) - 1
+        true_segments = int(jnp.sum(present))  # the op's one host sync
+        num_segments = bucket_size(max(true_segments, 1))
+        seg_ids = jnp.where(live, jnp.take(pos, slot), 0).astype(jnp.int32)
+        slot_of_group = jnp.nonzero(present, size=num_segments,
+                                    fill_value=0)[0].astype(jnp.int32)
+    else:
+        live = jnp.ones((n,), bool)
+        true_segments = num_groups  # no mask -> every group has rows;
+        #                             the key side pays NO host sync at all
+        num_segments = bucket_size(num_groups)
+        seg_ids = slot.astype(jnp.int32)
+        slot_of_group = jnp.minimum(
+            jnp.arange(num_segments, dtype=jnp.int32), num_groups - 1)
+    gvals = uniq["val"].astype(values.dtype.np_dtype)
+    key_data = jnp.take(jnp.asarray(gvals), slot_of_group)
+    key_valid = (jnp.take(jnp.asarray(uniq["nf"].astype(bool)),
+                          slot_of_group)
+                 if values.validity is not None else None)
+    out_cols = [Column(values.dtype, num_segments, data=key_data,
+                       validity=key_valid)]
+    cnt_cache = {}
+    for ci, op in aggs:
+        vcol = table.columns[ci]
+        if vcol.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32,
+                             dt.TypeId.FOR64):
+            vcol = enc.decoded_rows(vcol)  # declared boundary (SRJT016)
+        _agg_out_dtype(vcol.dtype, op)  # validates op/type pair
+        if ci not in cnt_cache:
+            v = vcol.valid_mask() & live
+            cnt_cache[ci] = (v, jax.ops.segment_sum(
+                v.astype(jnp.int32), seg_ids,
+                num_segments=num_segments).astype(jnp.int64))
+        v, cnt = cnt_cache[ci]
+        if op == "count":
+            out_cols.append(Column(dt.INT64, num_segments, data=cnt))
+        else:
+            out_cols.append(_segment_agg_fixed(
+                vcol, None, v, seg_ids, num_segments, cnt, op,
+                sorted_ids=False))
+    return Table(tuple(_shrink(c, true_segments) for c in out_cols))
+
+
 def _groupby_aggregate(
         table: Table, key_indices: Sequence[int],
         aggs: Sequence[Tuple[int, str]], row_mask=None) -> Table:
     fast = _dict_code_groupby(table, key_indices, aggs, row_mask)
     if fast is not None:
         return fast
+    fast = _rle_groupby(table, key_indices, aggs, row_mask)
+    if fast is not None:
+        return fast
+    if any(table.columns[ci].dtype.id in
+           (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64)
+           for ci, _ in aggs):
+        # sorted-path fallback: encoded VALUE columns decode at this one
+        # declared boundary (SRJT016-baselined) — per-row validity and
+        # segment math below are row-shaped. Encoded KEYS stay encoded:
+        # sort_lanes/_keys_equal_prev/gather carry their own decode points.
+        cols = list(table.columns)
+        for ci, _ in aggs:
+            if cols[ci].dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32,
+                                     dt.TypeId.FOR64):
+                cols[ci] = enc.decoded_rows(cols[ci])
+        table = Table(tuple(cols))
     keys = [table.columns[i] for i in key_indices]
     dead_col = None
     if row_mask is not None:
@@ -494,6 +611,9 @@ def groupby_core(keys: List[Column], aggs: Sequence[Tuple[Column, str]],
     to the eager op's output.
     """
     n = keys[0].size
+    aggs = [(enc.decoded_rows(v) if v.dtype.id in
+             (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64) else v, op)
+            for v, op in aggs]  # declared in-program decode (SRJT016)
     dead_col = None
     if row_mask is not None:
         dead_col = Column(dt.BOOL8, n, data=(~row_mask).astype(jnp.uint8))

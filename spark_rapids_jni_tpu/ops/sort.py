@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import dtype as dt
+from ..columnar import encodings as enc
 from ..columnar.column import Column, Table
 from ..columnar.strings import padded_bytes
 from ..memory.reservation import device_reservation, release_barrier
@@ -81,6 +82,13 @@ def _monotone_unsigned(col: Column) -> List[jnp.ndarray]:
             return [jnp.zeros(data.shape, dtype=jnp.uint32)]
         lane = jnp.take(ranks, jnp.clip(data, 0, nd - 1))
         return [lane.astype(jnp.uint32)]
+    if tid in (dt.TypeId.FOR32, dt.TypeId.FOR64):
+        # frame-of-reference codes ARE the sort key: value = ref + code
+        # with one shared reference, so code order is value order — the
+        # packed column sorts without ever adding the reference. Must
+        # precede the signedinteger default (np_dtype reports the LOGICAL
+        # type; data is packed uint8 bytes).
+        return [enc.for_codes(col).astype(jnp.uint64)]
     if col.dtype.np_dtype is not None and np.issubdtype(col.dtype.np_dtype,
                                                         np.signedinteger):
         wide = data.astype(jnp.int64)
@@ -115,6 +123,11 @@ def sort_lanes(keys: Sequence[Column],
     lanes: List[jnp.ndarray] = []
     # lexsort: LAST array is the primary key → append minor keys first
     for col, asc, nf in reversed(list(zip(keys, ascending, nulls_first))):
+        if col.dtype.id is dt.TypeId.RLE:
+            # declared run-expansion boundary (SRJT016-baselined): sort
+            # needs a per-ROW null lane, so RLE keys expand here — runs
+            # don't survive an arbitrary permutation anyway
+            col = enc.decoded_rows(col)
         value_lanes = _monotone_unsigned(col)
         if not asc:
             value_lanes = [~v if v.dtype != jnp.bool_ else ~v
@@ -203,6 +216,13 @@ def gather(col: Column, idx: jnp.ndarray) -> Column:
         # and stays SHARED by reference
         return Column(col.dtype, m, data=jnp.take(col.data, idx),
                       validity=validity, children=col.children)
+    if tid in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64):
+        # THE declared materialize boundary for run/packed encodings
+        # (SRJT016-baselined): an arbitrary row permutation destroys run
+        # structure and bit alignment, so encoded columns decode exactly
+        # here — eager filter/sort compaction and fused output trims all
+        # funnel through this one gather
+        return gather(enc.decoded_rows(col), idx)
     return Column(col.dtype, m, data=jnp.take(col.data, idx, axis=0),
                   validity=validity)
 

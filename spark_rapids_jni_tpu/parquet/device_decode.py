@@ -366,6 +366,169 @@ def _decode_dictionary(leaf, blob: np.ndarray, blob_dev, page: _Page):
     return ("fixed", vals, None, None, None)
 
 
+def _encoded_ints_enabled() -> bool:
+    from ..utils import config
+    return bool(config.get("parquet.encoded_ints"))
+
+
+_INT_PHYS = {_PT_INT32: np.int32, _PT_INT64: np.int64}
+
+
+def _all_valid_pages(leaf, blob: np.ndarray, pages: List[_Page]) -> bool:
+    """True when every data page's def-level stream provably encodes
+    all-valid rows — the precondition for surfacing the dict-index runs
+    as row-aligned runs (the index stream stores non-null entries only,
+    so any null would misalign runs against rows). Host-cheap: run
+    headers, not rows."""
+    if leaf.max_def == 0:
+        return True
+    bw = max(1, leaf.max_def.bit_length())
+    for p in pages:
+        if p.ptype == 2:
+            continue
+        if p.def_len <= 0:
+            return False
+        try:
+            kinds, _, values, _ = _walk_runs(blob, p.def_off, p.def_len,
+                                             p.num_values, bw)
+        except ValueError:
+            return False
+        if not (np.all(kinds == 0) and np.all(values == leaf.max_def)):
+            return False
+    return True
+
+
+def _host_int_dictionary(leaf, blob: np.ndarray, page: _Page):
+    """PLAIN fixed-width dictionary page -> host int64 entry array (nd
+    entries x 4/8 bytes — dictionary-sized, never row-sized)."""
+    npdt = _INT_PHYS[leaf.physical]
+    es = np.dtype(npdt).itemsize
+    nd = page.num_values
+    raw = blob[page.val_off:page.val_off + nd * es]
+    if raw.size != nd * es:
+        return None
+    return np.frombuffer(raw.tobytes(), npdt).astype(np.int64)
+
+
+def _try_encoded_ints(leaf, blob: np.ndarray, pages: List[_Page],
+                      rows: int):
+    """Surface a dictionary-encoded INT32/INT64 chunk as an encoded
+    Column with NO row expansion — or None to take the normal decode.
+
+    * every dict-index stream all RLE runs -> ``RLE`` column: run values
+      gather through the (small) host dictionary, run lengths come
+      straight from the run headers. Work done is O(runs), not O(rows).
+    * one page, all bit-packed runs, dictionary a dense ascending range
+      [lo, lo+nd) -> ``FOR`` column: the page's packed bytes ARE the
+      column data (parquet bit-pack order == the FOR LSB-first layout),
+      reference = lo, width = the stream's index bit width.
+
+    Gated conservatively: flat all-valid chunks whose page inventory is
+    purely dictionary-encoded; anything else (nulls, PLAIN fallback
+    pages, mixed run kinds) returns None and decodes normally."""
+    from ..columnar import encodings as enc
+
+    if leaf.max_rep != 0 or leaf.physical not in _INT_PHYS:
+        return None
+    if leaf.dtype.id not in (TypeId.INT32, TypeId.INT64):
+        return None
+    dict_page = next((p for p in pages if p.ptype == 2), None)
+    data_pages = [p for p in pages if p.ptype != 2]
+    if dict_page is None or not data_pages:
+        return None
+    if dict_page.encoding not in (_ENC_PLAIN, _ENC_PLAIN_DICT):
+        return None
+    if any(p.encoding not in (_ENC_PLAIN_DICT, _ENC_RLE_DICT)
+           for p in data_pages):
+        return None
+    if sum(p.num_values for p in data_pages) != rows or rows == 0:
+        return None
+    if not _all_valid_pages(leaf, blob, pages):
+        return None
+    dict_host = _host_int_dictionary(leaf, blob, dict_page)
+    if dict_host is None or dict_host.size == 0:
+        return None
+    nd = dict_host.size
+
+    walked = []
+    for p in data_pages:
+        ibw = int(blob[p.val_off]) if p.val_len >= 1 else -1
+        if ibw == 0:  # degenerate stream: every row is dict entry 0
+            walked.append((np.zeros(1, np.int32),
+                           np.asarray([p.num_values], np.int64),
+                           np.zeros(1, np.int32),
+                           np.zeros(1, np.int64), ibw, p))
+            continue
+        if ibw < 1 or ibw > 32 or p.val_len <= 1:
+            return None
+        try:
+            k, c, v, bs = _walk_runs(blob, p.val_off + 1, p.val_len - 1,
+                                     p.num_values, ibw)
+        except ValueError:
+            return None
+        walked.append((k, c, v, bs, ibw, p))
+
+    npdt = _INT_PHYS[leaf.physical]
+
+    if all(np.all(w[0] == 0) for w in walked):
+        vals_parts, lens_parts = [], []
+        for k, c, v, bs, ibw, p in walked:
+            c = c.astype(np.int64).copy()
+            tot = int(c.sum())
+            if tot < p.num_values:
+                return None
+            over = tot - p.num_values  # writer padding in the final run
+            i = len(c) - 1
+            while over > 0 and i >= 0:
+                take = min(over, int(c[i]))
+                c[i] -= take
+                over -= take
+                i -= 1
+            if np.any(v < 0) or np.any(v >= nd):
+                return None
+            vals_parts.append(dict_host[v])
+            lens_parts.append(c)
+        rvals = np.concatenate(vals_parts).astype(npdt)
+        rlens64 = np.concatenate(lens_parts)
+        if rlens64.size and int(rlens64.max()) > np.iinfo(np.int32).max:
+            return None
+        rlens = rlens64.astype(np.int32)
+        values = Column(leaf.dtype, rvals.size, data=jnp.asarray(rvals))
+        values._seed_host_cache(rvals)
+        lengths = Column(dt.INT32, rlens.size, data=jnp.asarray(rlens))
+        lengths._seed_host_cache(rlens)
+        return enc.rle_column(values, lengths, size=rows)
+
+    if len(walked) == 1:
+        k, c, v, bs, ibw, p = walked[0]
+        if (np.all(k == 1)
+                and np.array_equal(dict_host,
+                                   np.arange(dict_host[0],
+                                             dict_host[0] + nd))
+                and (1 << ibw) >= nd):
+            # bit-packed runs are NOT contiguous in the blob (a varint
+            # header byte precedes each), but every run covers a multiple
+            # of 8 values (groups*8) at groups*ibw bytes — so stitching
+            # the per-run byte regions is a pure host byte concat that
+            # lands every code at bit i*ibw of the FOR buffer
+            parts = []
+            for j in range(len(k)):
+                start = int(bs[j]) >> 3  # run payloads are byte-aligned
+                nbytes = (int(c[j]) // 8) * ibw
+                parts.append(blob[start:start + nbytes])
+            packed = np.concatenate(parts) if parts else \
+                np.zeros(0, np.uint8)
+            need = enc.packed_nbytes(rows, ibw)
+            if packed.size < need:  # final-group padding clipped at blob end
+                packed = np.pad(packed, (0, need - packed.size))
+            packed = np.ascontiguousarray(packed[:need])
+            fdt = (dt.for32(ibw) if leaf.physical == _PT_INT32
+                   else dt.for64(ibw))
+            return enc.for_column(jnp.asarray(packed), fdt, rows,
+                                  int(dict_host[0]))
+    return None
+
+
 def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
                        rows: int, list_rows: int = 0) -> Column:
     """Full device decode of one column chunk (flat, or one-level LIST
@@ -373,6 +536,10 @@ def decode_leaf_device(leaf, blob: np.ndarray, pages: List[_Page],
     the footer). ``blob`` ships to the device once; everything after is
     XLA (plus the sizing syncs for BYTE_ARRAY dictionary outputs and
     LIST element counts)."""
+    if list_rows == 0 and _encoded_ints_enabled():
+        out = _try_encoded_ints(leaf, blob, pages, rows)
+        if out is not None:
+            return out
     blob_dev = jnp.asarray(blob)  # the ONE host->device data transfer
     dictionary = None
     val_parts: List[jnp.ndarray] = []

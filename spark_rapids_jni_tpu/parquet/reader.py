@@ -51,7 +51,7 @@ class ReaderMetrics:
     counter set."""
 
     _COUNTERS = ("pages_skipped", "bytes_skipped", "row_groups_skipped",
-                 "pushdown_probes")
+                 "pushdown_probes", "membership_skips", "stat_skips")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -535,6 +535,68 @@ class ParquetReader:
         walk(predicate)
         return out
 
+    @staticmethod
+    def _range_conjuncts(predicate):
+        """Integer-comparison conjuncts usable for min/max pruning:
+        (column index, op, literal) triples where the predicate is an
+        AND-tree and the triple is ``col(i) <op> lit`` with op one of
+        lt/le/gt/ge/eq (literal on either side; flipped to col-first).
+        Null rows never satisfy a comparison, so a chunk whose NON-NULL
+        value range provably excludes the literal holds no qualifying
+        row regardless of its null count."""
+        from ..plan import expr as ex
+        _FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                 "eq": "eq"}
+        out = []
+
+        def leafc(x):
+            if not (isinstance(x, ex.BinOp) and x.op in _FLIP):
+                return None
+            l, r, op = x.left, x.right, x.op
+            if isinstance(l, ex.Lit):
+                l, r, op = r, l, _FLIP[op]
+            if (isinstance(l, ex.Col) and isinstance(r, ex.Lit)
+                    and isinstance(r.value, int)
+                    and not isinstance(r.value, bool)):
+                return (l.index, op, int(r.value))
+            return None
+
+        def walk(x):
+            if isinstance(x, ex.BinOp) and x.op == "and":
+                walk(x.left)
+                walk(x.right)
+                return
+            got = leafc(x)
+            if got is not None:
+                out.append(got)
+
+        walk(predicate)
+        return out
+
+    @staticmethod
+    def _range_excludes(lo: int, hi: int, op: str, lit: int) -> bool:
+        """True when no value in [lo, hi] can satisfy ``value <op> lit``."""
+        if op == "eq":
+            return lit < lo or lit > hi
+        if op == "lt":
+            return lo >= lit
+        if op == "le":
+            return lo > lit
+        if op == "gt":
+            return hi <= lit
+        if op == "ge":
+            return hi < lit
+        return False
+
+    def _int_ranges(self):
+        """{(row group, leaf index): (min, max)} from the footer's
+        column-chunk statistics — parsed once, defensively (corrupt or
+        absent stats simply yield no entry; see parquet/stats.py)."""
+        if not hasattr(self, "_int_ranges_cache"):
+            from . import stats
+            self._int_ranges_cache = stats.chunk_int_ranges(self._footer)
+        return self._int_ranges_cache
+
     def _probe_dictionary(self, f, g: int, leaf: LeafSchema):
         """Pushdown statistic for one (row group, string leaf): the
         dictionary page's entry set, whether every data page is
@@ -573,9 +635,27 @@ class ParquetReader:
         self._probe_cache[key] = res
         return res
 
-    def _group_prunable(self, f, g: int) -> Optional[int]:
-        """Data-page count of the proving chunk when row group ``g``
-        provably holds no qualifying row, else None."""
+    def _group_prunable(self, f, g: int) -> Optional[Tuple[str, int]]:
+        """(skip kind, data-page count of the proving chunk) when row
+        group ``g`` provably holds no qualifying row, else None. Kind is
+        ``"stat"`` (footer min/max excluded a range conjunct — zero page
+        reads) or ``"membership"`` (dictionary-page probe missed every
+        equality literal)."""
+        ranges = self._int_ranges() if self._range_conj else {}
+        for idx, op, lit in self._range_conj:
+            plan = self._selected_plans[idx]
+            if plan.kind != "simple":
+                continue
+            leaf = plan.leaves[0]
+            if (leaf.max_rep != 0
+                    or leaf.physical not in (_PT_INT32, _PT_INT64)
+                    or leaf.dtype.is_decimal):
+                continue
+            rng = ranges.get((g, leaf.index))
+            if rng is None:
+                continue  # absent/corrupt stats: never prune
+            if self._range_excludes(rng[0], rng[1], op, lit):
+                return ("stat", 0)
         for idx, lits in self._conjuncts:
             plan = self._selected_plans[idx]
             if plan.kind != "simple":
@@ -592,7 +672,7 @@ class ParquetReader:
                 # outside the dictionary — membership proves nothing
                 continue
             if not (lits & entries):
-                return n_data
+                return ("membership", n_data)
         return None
 
     def _qualifying_groups(self) -> List[int]:
@@ -609,21 +689,23 @@ class ParquetReader:
             return groups
         if not hasattr(self, "_conjuncts"):
             self._conjuncts = self._pushdown_conjuncts(self._predicate)
-        if not self._conjuncts:
+            self._range_conj = self._range_conjuncts(self._predicate)
+        if not self._conjuncts and not self._range_conj:
             return groups
         keep, skipped = [], []
         with open(self._path, "rb") as f:
             for g in groups:
-                n_data = self._group_prunable(f, g)
-                (keep if n_data is None else skipped).append(
-                    g if n_data is None else (g, n_data))
+                why = self._group_prunable(f, g)
+                (keep if why is None else skipped).append(
+                    g if why is None else (g,) + why)
         if not keep and skipped \
                 and any(p.kind != "simple" for p in self._selected_plans):
             # nested output columns have no synthesizable 0-row shape;
             # keep one group (its rows are filtered downstream anyway)
             keep.append(skipped.pop()[0])
-        for g, n_data in skipped:
+        for g, kind, n_data in skipped:
             reader_metrics.inc("row_groups_skipped")
+            reader_metrics.inc(f"{kind}_skips")
             reader_metrics.inc("pages_skipped", n_data)
             reader_metrics.inc("bytes_skipped", self._rg_bytes(g))
         return keep

@@ -130,24 +130,29 @@ class CompiledShardedPlan:
 def _shape_key(table: Table) -> Tuple:
     """Input signature component of the cache key: per-column dtype,
     static size, and validity presence — everything that changes the
-    traced program. Data values are deliberately absent, with one
-    exception: DICT32 columns append their dictionary fingerprint. The
-    dictionary enters the program as a constant-like traced operand
-    (never donated), and its fingerprint keys the cache so programs
-    never alias across dictionaries (it also subsumes the dictionary's
-    byte/entry shapes, which the AOT executable is locked to)."""
+    traced program. Data values are deliberately absent; encoded columns
+    append their ``encoding_cache_key`` component (columnar/encodings.py):
+    DICT32 contributes its dictionary fingerprint (the dictionary enters
+    the program as a constant-like traced operand, never donated, and the
+    fingerprint keeps programs from aliasing across dictionaries), RLE its
+    static run structure (run count / value dtype / run-validity — run
+    CONTENT is per-batch traced data and stays out of the key), FOR a bare
+    encoding tag (width rides dtype.scale, already in the base entry)."""
+    from ..columnar.encodings import encoding_cache_key
     key = []
     for c in table.columns:
         ent: Tuple = (c.dtype.id.value, getattr(c.dtype, "scale", 0) or 0,
                       c.size, c.validity is not None)
-        if c.dtype.id is dt.TypeId.DICT32:
-            from ..columnar.dictionary import dictionary_fingerprint
-            ent = ent + (dictionary_fingerprint(c),)
-        key.append(ent)
+        key.append(ent + encoding_cache_key(c))
     return tuple(key)
 
 
 def _slice_col(c: Column, k: int) -> Column:
+    if c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64):
+        # static prefix slices don't land on run/byte boundaries; Limit is
+        # an output trim, so decode at this declared boundary (SRJT016)
+        from ..columnar.encodings import decoded_rows
+        return _slice_col(decoded_rows(c), k)
     v = c.validity[:k] if c.validity is not None else None
     return Column(c.dtype, k, data=c.data[:k], validity=v,
                   children=c.children)

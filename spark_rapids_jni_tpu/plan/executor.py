@@ -78,6 +78,13 @@ def unsupported_reason(plan: PlanNode, table: Table) -> Optional[str]:
 def _trim_prefix(cols, live: int) -> Table:
     out = []
     for c in cols:
+        if c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64):
+            # encoded passthrough output under a prefix trim: row-slicing
+            # run/packed buffers isn't a plain data[:live] — decode at this
+            # declared boundary (rare: prefix states come from GroupBy/Sort,
+            # which already decode in-program)
+            from ..columnar.encodings import decoded_rows
+            c = decoded_rows(c)
         v = c.validity[:live] if c.validity is not None else None
         out.append(Column(c.dtype, live, data=c.data[:live], validity=v,
                           children=c.children))
@@ -232,6 +239,14 @@ def _execute_dag(plan: PlanNode, tables: Tuple[Table, ...],
         if _table_unsupported_reason(t) is not None:
             return run_eager(plan, tables,
                              fallback_reason="unsupported-input")
+        if any(c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32,
+                              dt.TypeId.FOR64) for c in t.columns):
+            # join lowering reads key lanes straight from column data
+            # (_key_values) — run/packed layouts need a decode the DAG
+            # fuser doesn't model yet; the eager interpreter decodes at
+            # its join boundary instead
+            return run_eager(plan, tables,
+                             fallback_reason="unsupported-input")
 
     opt = _planner.optimize(plan, tables)
     decisions = _planner.plan_decisions(opt, tables)
@@ -300,11 +315,14 @@ def execute_plan(plan: PlanNode,
         tables = (table,) if isinstance(table, Table) else tuple(table)
         return _execute_dag(plan, tables, cache)
     plan = resolve_dict_literals(plan, table)
-    if donate_input and any(c.dtype.id is dt.TypeId.DICT32
-                            for c in table.columns):
-        # the dictionary (values/ranks children) is SHARED across every
-        # batch from the same parquet dictionary page — donating it would
-        # let XLA scribble over buffers other queries still reference
+    if donate_input and any(
+            c.dtype.id in (dt.TypeId.DICT32, dt.TypeId.RLE,
+                           dt.TypeId.FOR32, dt.TypeId.FOR64)
+            for c in table.columns):
+        # encoded children (dictionary values/ranks, RLE run buffers, FOR
+        # reference headers) are SHARED by reference across batches —
+        # donating them would let XLA scribble over buffers other queries
+        # still reference
         donate_input = False
     reason = unsupported_reason(plan, table)
     if reason is not None:

@@ -28,16 +28,35 @@ from typing import NamedTuple, Optional, Sequence
 import jax.numpy as jnp
 
 from ..columnar import dtype as dt
+from ..columnar import encodings as enc
 from ..columnar.column import Column
 
 
 class _Val(NamedTuple):
     """Evaluated expression: device data (array or scalar), optional
-    validity, and the logical dtype carried for Project output columns."""
+    validity, and the logical dtype carried for Project output columns.
+
+    Encoded-execution extensions (both default None for plain values):
+
+    ``runs`` — ``(ends, n, key)`` marks a RUN-SPACE value: ``data`` and
+    ``validity`` are r-sized per-run lanes from an RLE column, ``ends`` is
+    the traced int64 inclusive run-end array, ``n`` the static decoded row
+    count, and ``key`` the identity of the run structure (``id()`` of the
+    shared lengths child) — two run-space operands combine per-run only
+    when their keys match, so compound predicates over ONE RLE column
+    evaluate once per run end-to-end and expand exactly once at the mask
+    boundary.
+
+    ``offset`` — a traced int64 scalar marking FOR code space: the true
+    value is ``data + offset``. Comparisons against literals shift the
+    LITERAL by the offset instead of denormalizing the n-sized lane
+    (reference-shifted literals, the FOR predicate win)."""
 
     data: jnp.ndarray
     validity: Optional[jnp.ndarray]
     dtype: dt.DType
+    runs: Optional[tuple] = None
+    offset: Optional[jnp.ndarray] = None
 
 
 # dtypes whose .data participates in int64 expression arithmetic
@@ -173,6 +192,63 @@ def _merge_valid(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
     return a & b
 
 
+def _expand(v: _Val) -> _Val:
+    """Expand a run-space value to row space (the single declared
+    run-expansion point inside expression evaluation): row j takes run
+    ``searchsorted(ends, j, 'right')`` — zero-length runs are never
+    selected."""
+    if v.runs is None:
+        return v
+    ends, n, _ = v.runs
+    rid = jnp.searchsorted(ends, jnp.arange(n, dtype=jnp.int64),
+                           side="right").astype(jnp.int32)
+    data = (jnp.take(v.data, rid) if v.data.ndim
+            else jnp.broadcast_to(v.data, (n,)))
+    validity = (jnp.take(v.validity, rid)
+                if v.validity is not None else None)
+    return _Val(data, validity, v.dtype, None, v.offset)
+
+
+def _deoffset(v: _Val) -> _Val:
+    """Fold a FOR reference offset back into the data lane (losing code
+    space); the result keeps the logical dtype's storage type."""
+    if v.offset is None:
+        return v
+    data = (v.data.astype(jnp.int64) + v.offset).astype(v.dtype.jnp_dtype)
+    return _Val(data, v.validity, v.dtype, v.runs, None)
+
+
+def _align_runs(lv: _Val, rv: _Val):
+    """Reconcile run structure between two operands: matching run keys (or
+    a scalar against run space) stay per-run; anything else expands to row
+    space so shapes agree."""
+    if lv.runs is not None and rv.runs is not None:
+        if lv.runs[2] == rv.runs[2]:
+            return lv, rv
+        return _expand(lv), _expand(rv)
+    if lv.runs is not None:
+        return (lv, rv) if rv.data.ndim == 0 else (_expand(lv), rv)
+    if rv.runs is not None:
+        return (lv, rv) if lv.data.ndim == 0 else (lv, _expand(rv))
+    return lv, rv
+
+
+def _cmp_offsets(lv: _Val, rv: _Val):
+    """Comparison operand normalization for FOR code space: one offset
+    side against a scalar shifts the SCALAR (codes compare against
+    ``literal - reference``, no n-sized reference add); every other shape
+    denormalizes."""
+    if (lv.offset is not None and rv.offset is None
+            and rv.data.ndim == 0 and rv.dtype.id in _INTLIKE):
+        return (lv._replace(offset=None),
+                rv._replace(data=rv.data.astype(jnp.int64) - lv.offset))
+    if (rv.offset is not None and lv.offset is None
+            and lv.data.ndim == 0 and lv.dtype.id in _INTLIKE):
+        return (lv._replace(data=lv.data.astype(jnp.int64) - rv.offset),
+                rv._replace(offset=None))
+    return _deoffset(lv), _deoffset(rv)
+
+
 def _intlike(v: _Val, what: str) -> jnp.ndarray:
     if v.dtype.id not in _INTLIKE:
         raise TypeError(
@@ -195,6 +271,20 @@ def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
         # DICT32 flows through as its int32 code array: equality against a
         # resolved literal code IS string equality (entries unique), and
         # the string bytes never enter the program
+        if c.dtype.id is dt.TypeId.RLE:
+            # RLE enters RUN SPACE: r-sized value/validity lanes tagged
+            # with the run structure — downstream operators evaluate once
+            # per run until a shape forces expansion
+            values = enc.rle_values(c)
+            return _Val(values.data, values.validity, values.dtype,
+                        runs=(enc.run_ends_device(c), c.size,
+                              id(c.children[1])))
+        if c.dtype.id in (dt.TypeId.FOR32, dt.TypeId.FOR64):
+            # FOR enters CODE SPACE: unpacked codes plus a traced offset;
+            # comparisons shift literals by the reference instead of
+            # adding it to every row
+            return _Val(enc.for_codes(c), c.validity, enc.logical_dtype(c),
+                        offset=enc.for_reference(c))
         return _Val(c.data, c.validity, c.dtype)
     if isinstance(e, Lit):
         if isinstance(e.value, bool):
@@ -206,33 +296,41 @@ def eval_expr(e: Expr, cols: Sequence[Column]) -> _Val:
                 "(plan/executor.resolve_dict_literals) before evaluation")
         return _Val(jnp.asarray(e.value, dtype=jnp.int64), None, dt.INT64)
     if isinstance(e, Cast64):
-        v = eval_expr(e.operand, cols)
-        return _Val(_intlike(v, "i64()"), v.validity, dt.INT64)
+        v = _deoffset(eval_expr(e.operand, cols))
+        return _Val(_intlike(v, "i64()"), v.validity, dt.INT64,
+                    runs=v.runs)
     if isinstance(e, Not):
         v = eval_expr(e.operand, cols)
         if v.dtype.id is not dt.TypeId.BOOL8:
             raise TypeError("~ requires a boolean operand")
-        return _Val(~v.data.astype(bool), v.validity, dt.BOOL8)
+        return _Val(~v.data.astype(bool), v.validity, dt.BOOL8,
+                    runs=v.runs)
     if isinstance(e, BinOp):
         lv = eval_expr(e.left, cols)
         rv = eval_expr(e.right, cols)
+        if e.op in _CMP:
+            lv, rv = _cmp_offsets(lv, rv)
+        else:
+            lv, rv = _deoffset(lv), _deoffset(rv)
+        lv, rv = _align_runs(lv, rv)
+        runs = lv.runs if lv.runs is not None else rv.runs
         validity = _merge_valid(lv.validity, rv.validity)
         if e.op in _ARITH:
             data = _ARITH[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
-            return _Val(data, validity, dt.INT64)
+            return _Val(data, validity, dt.INT64, runs=runs)
         if e.op in _CMP:
             if (lv.dtype.id is dt.TypeId.DICT32
                     or rv.dtype.id is dt.TypeId.DICT32):
                 return _Val(_dict_compare(e.op, lv, rv), validity, dt.BOOL8)
             data = _CMP[e.op](_intlike(lv, e.op), _intlike(rv, e.op))
-            return _Val(data, validity, dt.BOOL8)
+            return _Val(data, validity, dt.BOOL8, runs=runs)
         if e.op in _BOOL:
             if (lv.dtype.id is not dt.TypeId.BOOL8
                     or rv.dtype.id is not dt.TypeId.BOOL8):
                 raise TypeError(f"{e.op} requires boolean operands")
             l, r = lv.data.astype(bool), rv.data.astype(bool)
             return _Val(l & r if e.op == "and" else l | r,
-                        validity, dt.BOOL8)
+                        validity, dt.BOOL8, runs=runs)
         raise TypeError(f"unknown expression op {e.op!r}")
     raise TypeError(f"not a plan expression: {e!r}")
 
@@ -267,7 +365,9 @@ def project_column(e: Expr, cols: Sequence[Column], size: int) -> Column:
     shared dictionary children intact) — eval_expr's _Val carries only the
     code array, so rebuilding from it would drop the dictionary. Shared by
     the fused compiler and the eager interpreter."""
-    if isinstance(e, Col) and cols[e.index].dtype.id is dt.TypeId.DICT32:
+    if isinstance(e, Col) and cols[e.index].dtype.id in (
+            dt.TypeId.DICT32, dt.TypeId.RLE, dt.TypeId.FOR32,
+            dt.TypeId.FOR64):
         return cols[e.index]
     return materialize(eval_expr(e, cols), size)
 
@@ -275,7 +375,11 @@ def project_column(e: Expr, cols: Sequence[Column], size: int) -> Column:
 def materialize(v: _Val, size: int) -> Column:
     """Build an output Column from an evaluated Project expression —
     scalars (literals) broadcast to the row count; BOOL8 results store
-    uint8 per the columnar convention."""
+    uint8 per the columnar convention. Run-space / code-space values
+    expand here — Project output columns are row-shaped by contract
+    (bare encoded ``col(i)`` refs never reach this: project_column passes
+    them through by reference)."""
+    v = _deoffset(_expand(v))
     data = v.data
     if data.ndim == 0:
         data = jnp.broadcast_to(data, (size,))
@@ -289,9 +393,12 @@ def materialize(v: _Val, size: int) -> Column:
 
 def predicate_mask(v: _Val) -> jnp.ndarray:
     """bool[n] keep-mask from a Filter predicate evaluation: null
-    predicate rows are dropped (SQL WHERE)."""
+    predicate rows are dropped (SQL WHERE). A run-space predicate (RLE
+    operands all the way down) expands HERE, once — the per-run compute
+    already happened on r-sized lanes."""
     if v.dtype.id is not dt.TypeId.BOOL8:
         raise TypeError("filter predicate must be boolean")
+    v = _expand(v)
     keep = v.data.astype(bool)
     if v.validity is not None:
         keep = keep & v.validity

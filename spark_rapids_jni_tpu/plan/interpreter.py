@@ -62,11 +62,18 @@ def _as_tables(table: TableOrTables) -> tuple:
 def _join_eager(node: Join, lt: Table, rt: Table) -> Table:
     """One eager join via the ops/join.py wrappers (null keys never
     match; DICT32 key pairs compare as codes after align_codes)."""
+    from ..columnar import encodings as enc
     lkeys, rkeys = [], []
     for li, ri in zip(node.left_on, node.right_on):
         lc, rc = lt.columns[li], rt.columns[ri]
         if is_dict(lc) and is_dict(rc):
             lc, rc = align_codes(lc, rc)
+        # run/packed key columns decode HERE — the declared eager join
+        # boundary (the join kernels hash raw key lanes)
+        if enc.is_encoded(lc):
+            lc = enc.decoded_rows(lc)
+        if enc.is_encoded(rc):
+            rc = enc.decoded_rows(rc)
         lkeys.append(lc)
         rkeys.append(rc)
     if node.how == "semi":

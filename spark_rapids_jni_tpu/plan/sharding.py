@@ -248,6 +248,13 @@ def sharding_unsupported_reason(plan: PlanNode,
     if is_dag(plan):
         return ("plan is a DAG (Join) — cross-shard join builds are "
                 "not partitionable bit-identically; runs solo-fused")
+    for i, c in enumerate(table.columns):
+        if c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32, dt.TypeId.FOR64):
+            # run boundaries and packed bit lanes don't split on row-block
+            # boundaries — sharding them means a repack/expand per shard
+            # that the sharded lowering doesn't model; runs solo-fused
+            return (f"column {i} is {c.dtype.id.value}-encoded — run/"
+                    f"packed buffers don't shard on row blocks")
     nodes = linearize(plan)
     is_float = [c.dtype.id in _FLOAT_IDS for c in table.columns]
     for node in nodes[1:]:

@@ -205,6 +205,16 @@ _register("parquet.encoded_strings", "SRJT_PARQUET_ENCODED_STRINGS", False,
           "decode tier as DICT32 (int32 codes + shared dictionary) instead "
           "of gather-materializing STRING; downstream filter/groupby/join/"
           "sort run on codes and materialize() only at output boundaries")
+_register("parquet.encoded_ints", "SRJT_PARQUET_ENCODED_INTS", False,
+          _parse_bool,
+          "surface dictionary-encoded INT32/INT64 chunks from the device "
+          "decode tier encoded: all-RLE index streams become RLE columns "
+          "(run values gathered through the small dictionary, zero row "
+          "expansion) and bit-packed streams over a dense ascending "
+          "dictionary become FOR32/FOR64 columns (the page's packed bytes "
+          "ARE the column; reference = dictionary floor). Downstream "
+          "filter/aggregate run per-run / in code space and decode only "
+          "at declared output boundaries")
 _register("parquet.predicate_pushdown", "SRJT_PARQUET_PUSHDOWN", True,
           _parse_bool,
           "evaluate reader-level equality predicates against row-group "

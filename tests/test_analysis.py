@@ -878,6 +878,86 @@ def test_srjt015_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT016 — encoded-column (RLE/FOR) decode outside declared boundaries
+# ---------------------------------------------------------------------------
+
+SRC_016_DECODE = """
+    from ..columnar import encodings as enc
+
+    def filter_encoded(col, mask):
+        rows = enc.decoded_rows(col)
+        return rows.data[mask]
+"""
+
+SRC_016_MATERIALIZE = """
+    from ..columnar import encodings as enc
+
+    def ship(table):
+        return enc.materialize_table(table)
+"""
+
+
+def test_srjt016_decoded_rows_triggers_anywhere():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt016
+    # unlike SRJT012, the scope is the whole package, not just ops/
+    for path in ("pkg/ops/filter.py", "pkg/plan/executor.py",
+                 "pkg/memory/transport.py"):
+        fs = run(SRC_016_DECODE, path=path, rules=[rule_srjt016])
+        assert rules_of(fs) == {"SRJT016"}, path
+        assert "lint_baseline" in fs[0].message
+
+
+def test_srjt016_qualified_materialize_triggers():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt016
+    fs = run(SRC_016_MATERIALIZE, path="pkg/parallel/exchange.py",
+             rules=[rule_srjt016])
+    assert rules_of(fs) == {"SRJT016"}
+
+
+def test_srjt016_defining_module_exempt():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt016
+    assert run(SRC_016_DECODE, path="pkg/columnar/encodings.py",
+               rules=[rule_srjt016]) == []
+
+
+def test_srjt016_unqualified_dict_materialize_not_in_scope():
+    # bare materialize() is SRJT012's (DICT32) business; 016 matches the
+    # encodings-qualified form plus decoded_rows under any qualifier
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt016
+    src = """
+        from ..columnar.dictionary import materialize
+
+        def ship(col):
+            return materialize(col)
+    """
+    assert run(src, path="pkg/memory/transport.py",
+               rules=[rule_srjt016]) == []
+
+
+def test_srjt016_noqa():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt016
+    assert run(SRC_016_DECODE.replace(
+        "rows = enc.decoded_rows(col)",
+        "rows = enc.decoded_rows(col)  # srjt: noqa[SRJT016]"),
+        path="pkg/ops/filter.py", rules=[rule_srjt016]) == []
+
+
+def test_srjt016_sanctioned_sites_are_baselined():
+    # the real package's declared boundaries must all be in the baseline:
+    # a fresh decode site fails lint, the sanctioned ones stay accepted
+    import json
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "ci", "lint_baseline.json")) as f:
+        entries = [e for e in json.load(f)["findings"]
+                   if e["rule"] == "SRJT016"]
+    assert entries, "SRJT016 declared boundaries missing from baseline"
+    assert all(e["reason"].startswith("accepted:") for e in entries)
+    paths = {e["path"] for e in entries}
+    assert "spark_rapids_jni_tpu/ops/sort.py" in paths  # THE gather boundary
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -897,7 +977,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 15
+    assert len(FILE_RULES) == 16
 
 
 def test_syntax_error_is_reported_not_raised():

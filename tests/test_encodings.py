@@ -1,0 +1,667 @@
+"""Encoded execution (RLE + frame-of-reference): encoded vs materialized
+bit-identity across ops and fused plans, parquet page surfacing, chunk
+min/max statistics pruning, spill/integrity coverage of run and packed
+buffers, and program-cache key separation.
+
+The contract under test (docs/ARCHITECTURE.md "Encoded execution"): an
+RLE column is run values + run lengths, a FOR column is bit-packed codes
++ a reference — predicates evaluate per-run / in reference-shifted code
+space, aggregates fold ``value x length`` / ``sum(codes) + ref x count``
+(exact int64 modular arithmetic), and every encoded path returns bits
+identical to the same op over the materialized rows. Decodes happen only
+at the declared boundaries (SRJT016, ci/lint_baseline.json).
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar import encodings as enc
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.table_ops import (
+    concat_columns,
+    filter_table,
+)
+from spark_rapids_jni_tpu.faultinj import install, uninstall
+from spark_rapids_jni_tpu.memory.integrity import (
+    CorruptionError,
+    read_table_file,
+    table_fingerprint,
+    verify_table,
+    write_table_file,
+)
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.memory.transport import SpillableTable, to_host
+from spark_rapids_jni_tpu.ops.groupby import groupby_aggregate
+from spark_rapids_jni_tpu.ops.sort import sort_table
+from spark_rapids_jni_tpu.parquet import ParquetReader
+from spark_rapids_jni_tpu.parquet import stats as pq_stats
+from spark_rapids_jni_tpu.parquet.reader import reader_metrics
+from spark_rapids_jni_tpu.plan import (
+    Filter,
+    GroupBy,
+    Scan,
+    col as pcol,
+    execute_plan,
+)
+from spark_rapids_jni_tpu.plan.compile import _shape_key
+from spark_rapids_jni_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    yield
+    uninstall()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+def _pl(table):
+    return [c.to_pylist() for c in table.columns]
+
+
+def _sorted_col(rows=4096, card=64, dtype=dt.INT64, nulls=False, seed=0):
+    """Sorted low-cardinality column: card runs of rows/card each."""
+    vals = np.repeat(np.arange(card, dtype=np.int64) * 3 - card,
+                     -(-rows // card))[:rows]
+    col = Column.from_numpy(vals.astype(dtype.np_dtype), dtype)
+    if nulls:
+        valid = np.ones(rows, dtype=bool)
+        valid[:: max(rows // card, 1) * 2] = False  # whole runs go null
+        col = Column(dtype, rows, data=col.data,
+                     validity=jnp.asarray(valid))
+    return col
+
+
+def _bounded_col(rows=4096, span=900, base=10_000, nulls=False, seed=1):
+    """Bounded-range unsorted column (the FOR shape)."""
+    rng = np.random.default_rng(seed)
+    vals = base + rng.integers(0, span, rows)
+    col = Column.from_numpy(vals.astype(np.int64), dt.INT64)
+    if nulls:
+        valid = rng.random(rows) > 0.1
+        col = Column(dt.INT64, rows, data=col.data,
+                     validity=jnp.asarray(valid))
+    return col
+
+
+def _payload(rows=4096, seed=7):
+    return Column.from_numpy(
+        np.random.default_rng(seed).integers(-1000, 1000, rows), dt.INT64)
+
+
+def _encoded_pair(rows=4096, kind="rle", nulls=False):
+    """(encoded table, materialized table) with identical decoded bytes."""
+    key = (_sorted_col(rows, nulls=nulls) if kind == "rle"
+           else _bounded_col(rows, nulls=nulls))
+    ecol = enc.rle_encode(key) if kind == "rle" else enc.for_encode(key)
+    val = _payload(rows)
+    return (Table((ecol, val)), Table((enc.materialize(ecol), val)))
+
+
+# ---------------------------------------------------------------------------
+# construction and encode/decode identity
+# ---------------------------------------------------------------------------
+
+def test_rle_roundtrip_sorted():
+    col = _sorted_col(4096, card=64)
+    r = enc.rle_encode(col)
+    assert enc.is_rle(r) and r.size == 4096
+    assert enc.num_runs(r) == 64
+    assert r.to_pylist() == col.to_pylist()
+
+
+def test_rle_roundtrip_nulls_break_runs():
+    col = _sorted_col(512, card=8, nulls=True)
+    r = enc.rle_encode(col)
+    assert enc.rle_values(r).validity is not None
+    assert r.to_pylist() == col.to_pylist()
+
+
+def test_rle_single_run_and_all_null():
+    one = Column.from_numpy(np.full(100, 42, np.int64), dt.INT64)
+    r = enc.rle_encode(one)
+    assert enc.num_runs(r) == 1
+    assert r.to_pylist() == [42] * 100
+
+    alln = Column(dt.INT64, 10, data=jnp.zeros(10, jnp.int64),
+                  validity=jnp.zeros(10, bool))
+    r = enc.rle_encode(alln)
+    assert enc.num_runs(r) == 1
+    assert r.to_pylist() == [None] * 10
+
+
+def test_rle_empty_column_and_empty_runs():
+    r = enc.rle_encode(Column.from_numpy(np.zeros(0, np.int64), dt.INT64))
+    assert r.size == 0 and enc.num_runs(r) == 0
+    assert r.to_pylist() == []
+
+    # zero-length runs are legal layout (parquet emits them): no rows
+    vals = Column.from_numpy(np.array([5, 7, 9], np.int64), dt.INT64)
+    lens = Column.from_numpy(np.array([2, 0, 3], np.int32), dt.INT32)
+    r = enc.rle_column(vals, lens)
+    assert r.size == 5
+    assert r.to_pylist() == [5, 5, 9, 9, 9]
+
+
+@pytest.mark.parametrize("width", [1, 5, 11, 13, 32])
+def test_for_roundtrip_nondivisible_widths(width):
+    # n=37: n*width % 8 != 0 for every odd width — the packed tail is
+    # partial and unpack must never read phantom rows
+    rng = np.random.default_rng(width)
+    vals = 10_000 + rng.integers(0, min(2 ** width, 2 ** 31), 37)
+    col = Column.from_numpy(vals.astype(np.int64), dt.INT64)
+    f = enc.for_encode(col, width=width)
+    assert enc.is_for(f) and enc.for_width(f) == width
+    assert len(np.asarray(f.data)) == enc.packed_nbytes(37, width)
+    assert f.to_pylist() == col.to_pylist()
+
+
+def test_for_roundtrip_nulls_and_negative_reference():
+    col = Column.from_numpy(
+        np.random.default_rng(3).integers(-500, -100, 256), dt.INT64)
+    valid = np.random.default_rng(4).random(256) > 0.2
+    col = Column(dt.INT64, 256, data=col.data, validity=jnp.asarray(valid))
+    f = enc.for_encode(col)
+    assert int(np.asarray(enc.for_header(f).host_data())[0]) < 0
+    assert f.to_pylist() == col.to_pylist()
+
+
+def test_for_int32_encodes_as_for32():
+    col = Column.from_numpy(
+        np.arange(100, dtype=np.int32) + 7, dt.INT32)
+    f = enc.for_encode(col)
+    assert f.dtype.id is dt.TypeId.FOR32
+    assert enc.logical_dtype(f).id is dt.TypeId.INT32
+    assert f.to_pylist() == col.to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# predicates and filters: encoded == materialized, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["lt", "le", "gt", "ge", "eq", "ne"])
+def test_rle_predicate_runs_matches_rowwise(op):
+    col = _sorted_col(1024, card=16, nulls=True)
+    r = enc.rle_encode(col)
+    run_keep = np.asarray(enc.rle_predicate_runs(r, op, 5))
+    # expand per-run verdicts to rows and compare against the plain mask
+    got = np.repeat(run_keep, np.diff(np.r_[0, enc.run_ends(r)]))
+    vals = np.asarray(col.host_data())
+    cmp = {"lt": np.less, "le": np.less_equal, "gt": np.greater,
+           "ge": np.greater_equal, "eq": np.equal, "ne": np.not_equal}[op]
+    want = cmp(vals, 5) & np.asarray(col.validity)
+    assert got.tolist() == want.tolist()
+
+
+@pytest.mark.parametrize("op", ["lt", "le", "gt", "ge", "eq", "ne"])
+def test_for_predicate_mask_matches_rowwise(op):
+    col = _bounded_col(1024, nulls=True)
+    f = enc.for_encode(col)
+    got = np.asarray(enc.for_predicate_mask(f, op, 10_450))
+    vals = np.asarray(col.host_data())
+    cmp = {"lt": np.less, "le": np.less_equal, "gt": np.greater,
+           "ge": np.greater_equal, "eq": np.equal, "ne": np.not_equal}[op]
+    want = cmp(vals, 10_450) & np.asarray(col.validity)
+    assert got.tolist() == want.tolist()
+
+
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_fused_filter_bit_identical(kind):
+    enc_t, mat_t = _encoded_pair(kind=kind)
+    lit = 20 if kind == "rle" else 10_400
+    plan = Filter(Scan(ncols=2), pcol(0) >= lit)
+    assert _pl(execute_plan(plan, enc_t)) == _pl(execute_plan(plan, mat_t))
+
+
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_fused_filter_groupby_bit_identical(kind):
+    enc_t, mat_t = _encoded_pair(kind=kind, nulls=True)
+    lit = 0 if kind == "rle" else 10_300
+    plan = GroupBy(Filter(Scan(ncols=2), pcol(0) >= lit),
+                   keys=(0,), aggs=((1, "sum"), (1, "count"), (1, "min"),
+                                    (1, "max")))
+    assert _pl(execute_plan(plan, enc_t)) == _pl(execute_plan(plan, mat_t))
+
+
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_filter_table_gather_decodes(kind):
+    enc_t, mat_t = _encoded_pair(kind=kind, nulls=True)
+    mask = jnp.asarray(np.random.default_rng(5).random(4096) > 0.5)
+    assert _pl(filter_table(enc_t, mask)) == _pl(filter_table(mat_t, mask))
+
+
+# ---------------------------------------------------------------------------
+# aggregates: run-space / code-space arithmetic is exact
+# ---------------------------------------------------------------------------
+
+def test_rle_aggregate_bit_identical():
+    col = _sorted_col(4096, card=64, nulls=True)
+    r = enc.rle_encode(col)
+    vals = np.asarray(col.host_data())
+    valid = np.asarray(col.validity)
+    live = vals[valid]
+    assert int(enc.rle_aggregate(r, "sum")) == int(live.sum())
+    assert int(enc.rle_aggregate(r, "count")) == int(valid.sum())
+    assert int(enc.rle_aggregate(r, "min")) == int(live.min())
+    assert int(enc.rle_aggregate(r, "max")) == int(live.max())
+    # filtered aggregate: predicate runs AND aggregation stay run-space
+    keep = enc.rle_predicate_runs(r, "ge", 10)
+    want = live[live >= 10]
+    assert int(enc.rle_aggregate(r, "sum", run_mask=keep)) == int(want.sum())
+    assert int(enc.rle_aggregate(r, "count", run_mask=keep)) == len(want)
+
+
+def test_for_aggregate_bit_identical():
+    col = _bounded_col(4096, nulls=True)
+    f = enc.for_encode(col)
+    vals = np.asarray(col.host_data())
+    valid = np.asarray(col.validity)
+    live = vals[valid]
+    assert int(enc.for_aggregate(f, "sum")) == int(live.sum())
+    assert int(enc.for_aggregate(f, "count")) == int(valid.sum())
+    assert int(enc.for_aggregate(f, "min")) == int(live.min())
+    assert int(enc.for_aggregate(f, "max")) == int(live.max())
+    keep = enc.for_predicate_mask(f, "lt", 10_500)
+    want = live[live < 10_500]
+    assert int(enc.for_aggregate(f, "sum", row_mask=keep)) == int(want.sum())
+
+
+def test_int64_overflow_wraps_identically():
+    # modular int64: run-space sum must wrap exactly like the row-wise sum
+    big = np.full(64, (1 << 62) + 12345, np.int64)
+    col = Column.from_numpy(big, dt.INT64)
+    r = enc.rle_encode(col)
+    want = int(np.add.reduce(big))  # wraps negative
+    assert int(enc.rle_aggregate(r, "sum")) == want
+    f = enc.for_encode(col)
+    assert int(enc.for_aggregate(f, "sum")) == want
+
+
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_groupby_aggregate_encoded_key_bit_identical(kind):
+    enc_t, mat_t = _encoded_pair(kind=kind, nulls=True)
+    aggs = [(1, "sum"), (1, "count"), (1, "min"), (1, "max")]
+    assert (_pl(groupby_aggregate(enc_t, [0], aggs))
+            == _pl(groupby_aggregate(mat_t, [0], aggs)))
+
+
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_sort_encoded_bit_identical(kind):
+    enc_t, mat_t = _encoded_pair(kind=kind, nulls=True)
+    assert _pl(sort_table(enc_t, [0])) == _pl(sort_table(mat_t, [0]))
+
+
+# ---------------------------------------------------------------------------
+# concat: encoded where structure allows, declared boundary otherwise
+# ---------------------------------------------------------------------------
+
+def test_concat_rle_stays_encoded():
+    a = enc.rle_encode(_sorted_col(512, card=8))
+    b = enc.rle_encode(_sorted_col(256, card=4, nulls=True))
+    out = concat_columns([a, b])
+    assert enc.is_rle(out)
+    assert enc.num_runs(out) == enc.num_runs(a) + enc.num_runs(b)
+    assert out.to_pylist() == a.to_pylist() + b.to_pylist()
+
+
+def test_concat_for_stays_encoded_when_aligned():
+    base = np.arange(64, dtype=np.int64) % 32 + 1000
+    a = enc.for_encode(Column.from_numpy(base, dt.INT64), width=5)
+    b = enc.for_encode(Column.from_numpy(base[::-1].copy(), dt.INT64),
+                       width=5)
+    # same width + same reference + a's 64*5 bits byte-aligned: encoded
+    out = concat_columns([a, b])
+    assert enc.is_for(out)
+    assert out.to_pylist() == a.to_pylist() + b.to_pylist()
+
+
+def test_concat_for_ref_mismatch_materializes():
+    a = enc.for_encode(Column.from_numpy(
+        np.arange(64, dtype=np.int64) + 100, dt.INT64))
+    b = enc.for_encode(Column.from_numpy(
+        np.arange(64, dtype=np.int64) + 900, dt.INT64))
+    out = concat_columns([a, b])
+    assert not enc.is_encoded(out)  # declared boundary: decode + plain
+    assert out.to_pylist() == a.to_pylist() + b.to_pylist()
+
+
+def test_concat_mixed_encoded_plain_materializes():
+    plain = _sorted_col(128, card=4)
+    r = enc.rle_encode(plain)
+    out = concat_columns([r, plain])
+    assert not enc.is_encoded(out)
+    assert out.to_pylist() == plain.to_pylist() * 2
+
+
+# ---------------------------------------------------------------------------
+# parquet: native pages surface as RLE/FOR, no decode gather
+# ---------------------------------------------------------------------------
+
+def _write_pq(tmp_path, arrays, name="t.parquet", **kw):
+    path = str(tmp_path / name)
+    pq.write_table(pa.table(arrays), path, **kw)
+    return path
+
+
+def _read_pq(path, encoded=True, predicate=None):
+    with config.override("parquet.device_decode", "on"), \
+            config.override("parquet.encoded_ints", encoded):
+        with ParquetReader(path, predicate=predicate) as r:
+            return r.read_all()
+
+
+def test_parquet_rle_pages_surface_as_rle(tmp_path):
+    keys = np.repeat(np.arange(64, dtype=np.int64) * 5, 64)
+    path = _write_pq(tmp_path, {"k": keys})
+    t = _read_pq(path)
+    assert enc.is_rle(t.columns[0])
+    assert enc.num_runs(t.columns[0]) == 64
+    assert t.columns[0].to_pylist() == keys.tolist()
+    # bit-identical to the plain decode tier
+    plain = _read_pq(path, encoded=False)
+    assert not enc.is_encoded(plain.columns[0])
+    assert t.columns[0].to_pylist() == plain.columns[0].to_pylist()
+
+
+def test_parquet_bitpacked_dense_dict_surfaces_as_for(tmp_path):
+    keys = 1000 + np.arange(4096, dtype=np.int64) % 32  # cycling: no runs
+    path = _write_pq(tmp_path, {"k": keys})
+    t = _read_pq(path)
+    kcol = t.columns[0]
+    assert enc.is_for(kcol)
+    assert enc.for_width(kcol) == 5
+    assert int(np.asarray(enc.for_header(kcol).host_data())[0]) == 1000
+    assert kcol.to_pylist() == keys.tolist()
+
+
+def test_parquet_encoded_fallbacks_stay_bit_identical(tmp_path):
+    rng = np.random.default_rng(0)
+    cases = {
+        # random order over a non-dense pool: mixed run kinds -> fallback
+        "random": rng.choice(np.array([3, 17, 90, 400], np.int64), 4096),
+        # nulls: the encoded fast path requires all-valid pages
+        "nulls": np.where(rng.random(4096) > 0.1,
+                          np.repeat(np.arange(64, dtype=np.int64), 64),
+                          np.int64(-1)),
+    }
+    null_mask = cases["nulls"] == -1
+    arr = pa.array(cases["nulls"], mask=null_mask)
+    for name, data in (("random", pa.array(cases["random"])),
+                       ("nulls", arr)):
+        path = _write_pq(tmp_path, {"k": data}, name=f"{name}.parquet")
+        t = _read_pq(path)
+        plain = _read_pq(path, encoded=False)
+        assert t.columns[0].to_pylist() == plain.columns[0].to_pylist(), name
+
+
+def test_parquet_encoded_flag_off_by_default(tmp_path):
+    keys = np.repeat(np.arange(16, dtype=np.int64), 64)
+    path = _write_pq(tmp_path, {"k": keys})
+    with config.override("parquet.device_decode", "on"):
+        with ParquetReader(path) as r:
+            t = r.read_all()
+    assert not enc.is_encoded(t.columns[0])
+
+
+# ---------------------------------------------------------------------------
+# parquet: chunk min/max statistics pruning
+# ---------------------------------------------------------------------------
+
+def _stats_file(tmp_path, rows=8192, groups=8, **kw):
+    keys = np.arange(rows, dtype=np.int64)  # sorted: disjoint group ranges
+    vals = np.random.default_rng(1).integers(-100, 100, rows)
+    path = _write_pq(tmp_path, {"k": keys, "v": vals}, name="stats.parquet",
+                     row_group_size=rows // groups, **kw)
+    return path, rows, rows // groups
+
+
+def _skips():
+    s = reader_metrics.snapshot()
+    return {k: s[k] for k in ("row_groups_skipped", "stat_skips",
+                              "membership_skips")}
+
+
+def test_stats_pruning_counts_stat_skips(tmp_path):
+    path, rows, group = _stats_file(tmp_path)
+    expr = pcol(0) >= (rows - group)  # only the last group qualifies
+    before = _skips()
+    pruned = _read_pq(path, encoded=False, predicate=expr)
+    delta = {k: v - before[k] for k, v in _skips().items()}
+    assert delta["row_groups_skipped"] == 7
+    assert delta["stat_skips"] == 7
+    assert delta["membership_skips"] == 0
+    # residual filter over the pruned read == filter over the full read
+    plan = Filter(Scan(ncols=2), expr)
+    full = _read_pq(path, encoded=False)
+    assert _pl(execute_plan(plan, pruned)) == _pl(execute_plan(plan, full))
+
+
+def test_stats_pruning_eq_out_of_range_prunes_all(tmp_path):
+    path, rows, _ = _stats_file(tmp_path)
+    before = _skips()
+    pruned = _read_pq(path, encoded=False, predicate=pcol(0) == rows + 99)
+    delta = {k: v - before[k] for k, v in _skips().items()}
+    assert delta["stat_skips"] == 8
+    assert all(c.size == 0 for c in pruned.columns)
+
+
+def test_membership_and_stat_skips_counted_separately(tmp_path):
+    # string dictionary file: only the membership probe can prune it
+    rng = np.random.default_rng(0)
+    pool = np.array([f"key_{i:03d}" for i in range(50)])
+    vals = pool[rng.integers(0, 50, 4096)].astype(object)
+    vals[4000] = "needle"
+    path = _write_pq(tmp_path, {"k": vals}, name="str.parquet",
+                     row_group_size=512)
+    before = _skips()
+    _read_pq(path, encoded=False, predicate=pcol(0) == "needle")
+    delta = {k: v - before[k] for k, v in _skips().items()}
+    assert delta["membership_skips"] == 7
+    assert delta["stat_skips"] == 0
+
+
+def test_absent_stats_never_prune(tmp_path):
+    path, rows, group = _stats_file(tmp_path, write_statistics=False)
+    before = _skips()
+    t = _read_pq(path, encoded=False, predicate=pcol(0) >= (rows - group))
+    delta = {k: v - before[k] for k, v in _skips().items()}
+    assert delta["stat_skips"] == 0
+    assert t.columns[0].size == rows  # nothing pruned: stats are absent
+
+
+def test_corrupt_footer_yields_no_ranges():
+    assert pq_stats.chunk_int_ranges(b"") == {}
+    assert pq_stats.chunk_int_ranges(b"\xff" * 64) == {}
+    assert pq_stats.chunk_int_ranges(bytes(range(48))) == {}
+    # width-mismatched stats values never decode (foreign/corrupt stats)
+    assert pq_stats._decode_int(b"\x01\x02", pq_stats._PT_INT32) is None
+    assert pq_stats._decode_int(b"\x01" * 4, pq_stats._PT_INT64) is None
+
+
+def test_chunk_int_ranges_parses_real_footer(tmp_path):
+    path, rows, group = _stats_file(tmp_path)
+    with ParquetReader(path) as r:
+        ranges = pq_stats.chunk_int_ranges(r._footer)
+    # 8 groups x 2 int64 leaves, disjoint sorted key ranges
+    assert len(ranges) == 16
+    for g in range(8):
+        lo, hi = ranges[(g, 0)]
+        assert (lo, hi) == (g * group, (g + 1) * group - 1)
+
+
+# ---------------------------------------------------------------------------
+# integrity: spill round-trip, tamper detection, fingerprints
+# ---------------------------------------------------------------------------
+
+def _encoded_table(rows=1024):
+    r = enc.rle_encode(_sorted_col(rows, card=16, nulls=True))
+    f = enc.for_encode(_bounded_col(rows, nulls=True))
+    return Table((r, f, _payload(rows)))
+
+
+def test_spill_roundtrip_encoded():
+    t = _encoded_table()
+    want = _pl(t)
+    st = SpillableTable(t)
+    st.spill()
+    back = st.get()
+    assert back.columns[0].dtype.id is dt.TypeId.RLE  # layout preserved
+    assert back.columns[1].dtype.id is dt.TypeId.FOR64
+    assert back.columns[1].dtype.scale == t.columns[1].dtype.scale
+    assert _pl(back) == want
+
+
+def test_spill_file_roundtrip_and_tamper_encoded(tmp_path):
+    t = to_host(_encoded_table())
+    path = str(tmp_path / "enc.spill")
+    write_table_file(path, t)
+    assert _pl(read_table_file(path)) == _pl(t)
+    raw = bytearray(open(path, "rb").read())
+    raw[-9] ^= 0x01  # single bit in an encoded payload buffer
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CorruptionError):
+        read_table_file(path)
+
+
+def test_fingerprint_covers_run_and_packed_buffers():
+    host = to_host(_encoded_table())
+    fp = table_fingerprint(host)
+    verify_table(host, fp)  # clean: no raise
+
+    # tamper one run LENGTH (a child buffer two levels down)
+    c = host.columns[0]
+    vals, lens = c.children
+    bad = np.array(lens.data, copy=True)
+    bad[0] += 1
+    tampered = Table((Column(c.dtype, c.size, data=None,
+                             children=(vals, Column(lens.dtype, lens.size,
+                                                    data=bad))),)
+                     + host.columns[1:])
+    with pytest.raises(CorruptionError):
+        verify_table(tampered, fp)
+
+    # tamper one PACKED byte of the FOR column
+    c = host.columns[1]
+    bad = np.array(c.data, copy=True)
+    bad[len(bad) // 2] ^= 0x04
+    tampered = Table((host.columns[0],
+                      Column(c.dtype, c.size, data=bad,
+                             validity=c.validity, children=c.children),
+                      host.columns[2]))
+    with pytest.raises(CorruptionError):
+        verify_table(tampered, fp)
+
+
+# ---------------------------------------------------------------------------
+# program-cache keys: RLE vs FOR vs decoded never collide
+# ---------------------------------------------------------------------------
+
+def test_shape_key_separates_encodings():
+    rows = 256
+    plain = _sorted_col(rows, card=8)
+    r = enc.rle_encode(plain)
+    f = enc.for_encode(plain)
+    val = _payload(rows)
+    keys = {name: _shape_key(Table((c, val)))
+            for name, c in (("plain", plain), ("rle", r), ("for", f))}
+    assert len(set(keys.values())) == 3
+
+    # same encoding, different static run structure -> different programs
+    r2 = enc.rle_encode(_sorted_col(rows, card=16))
+    assert _shape_key(Table((r, val))) != _shape_key(Table((r2, val)))
+
+    # same FOR values at a different width -> different programs
+    f2 = enc.for_encode(plain, width=enc.for_width(f) + 3)
+    assert _shape_key(Table((f, val))) != _shape_key(Table((f2, val)))
+
+
+def test_encoding_cache_key_shapes():
+    plain = _sorted_col(256, card=8)
+    assert enc.encoding_cache_key(plain) == ()
+    assert enc.encoding_cache_key(enc.rle_encode(plain))[0] == "rle"
+    assert enc.encoding_cache_key(enc.for_encode(plain))[0] == "for"
+
+
+def test_encoding_fingerprint_tracks_buffers():
+    a = enc.rle_encode(_sorted_col(512, card=8))
+    b = enc.rle_encode(_sorted_col(512, card=16))
+    assert enc.encoding_fingerprint(a) != enc.encoding_fingerprint(b)
+    fa = enc.for_encode(_bounded_col(512, seed=1))
+    fb = enc.for_encode(_bounded_col(512, seed=2))
+    assert enc.encoding_fingerprint(fa) != enc.encoding_fingerprint(fb)
+
+
+def test_fused_plan_results_cached_per_encoding():
+    # the same logical query over plain/RLE/FOR inputs compiles three
+    # distinct programs yet returns identical bits from each
+    rows = 1024
+    plain = _sorted_col(rows, card=16)
+    val = _payload(rows)
+    plan = GroupBy(Filter(Scan(ncols=2), pcol(0) >= 0),
+                   keys=(0,), aggs=((1, "sum"), (1, "count")))
+    want = _pl(execute_plan(plan, Table((plain, val))))
+    assert _pl(execute_plan(
+        plan, Table((enc.rle_encode(plain), val)))) == want
+    assert _pl(execute_plan(
+        plan, Table((enc.for_encode(plain), val)))) == want
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault storms through the encoded plan path
+# ---------------------------------------------------------------------------
+
+def _fault_cfg(tmp_path, injection_type, count, **extra):
+    rule = {"percent": 100, "injectionType": injection_type,
+            "interceptionCount": count}
+    rule.update(extra)
+    p = tmp_path / "enc_faults.json"
+    p.write_text(json.dumps({"xlaRuntimeFaults": {"plan_execute": rule}}))
+    return str(p)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["rle", "for"])
+def test_transient_storm_encoded_plan_bit_identical(tmp_path, kind):
+    enc_t, mat_t = _encoded_pair(rows=8192, kind=kind)
+    plan = GroupBy(Filter(Scan(ncols=2), pcol(0) >= 0),
+                   keys=(0,), aggs=((1, "sum"), (1, "count")))
+    baseline = _pl(execute_plan(plan, mat_t))
+    install(_fault_cfg(tmp_path, 2, 2, substituteReturnCode=700), seed=0)
+    assert _pl(execute_plan(plan, enc_t)) == baseline
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["injected_faults"] == 2
+    assert m["transient_retries"] == 2
+    # shared encoded children survived the storm (donation is blocked for
+    # encoded columns): a clean re-run still reads the same run/packed
+    # buffers and still matches
+    uninstall()
+    assert _pl(execute_plan(plan, enc_t)) == baseline
+
+
+@pytest.mark.chaos
+def test_bitflip_storm_encoded_spill_quarantines(tmp_path):
+    FLIPS = 3
+    cfg = tmp_path / "flip.json"
+    cfg.write_text(json.dumps({"xlaRuntimeFaults": {
+        "spill": {"percent": 100, "injectionType": 3,
+                  "interceptionCount": FLIPS}}}))
+    install(str(cfg), seed=1)
+    want = _pl(_encoded_table())
+    for _attempt in range(FLIPS + 1):
+        st = SpillableTable(_encoded_table())  # rebuild from source
+        st.spill()
+        try:
+            got = _pl(st.get())
+            break
+        except CorruptionError:
+            continue
+    assert got == want  # zero corrupted encoded bytes escape
+    m = RmmSpark.get_fault_domain_metrics()
+    assert m["corruption_detected"] == FLIPS
+    assert m["quarantined_buffers"] == FLIPS
