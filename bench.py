@@ -362,6 +362,16 @@ def axis_table():
         # carries qps, p50/p95/p99, queue depth, dispatches-per-query and
         # rejected/deadline-missed counts via pop_extra()
         ("serving_qps_mixed_1k", lambda: _B().bench_serving_qps_mixed(1000), 1000 * 2048),
+        # the soak axes (ROADMAP item 4 fairness/shedding): 1x baseline +
+        # 5x hot tenant (+ 30% fault storm under load for serving_soak);
+        # rows carry the fairness verdict and per-tenant columns (tenant,
+        # offered_qps, p99_ms, rejected_by_reason) via pop_extra(). Both
+        # run EXACTLY ONCE (no warm-up repeat — the soak warms its own
+        # program cache and a storm's wall clock IS the measurement);
+        # _sweep and ci/axis_runner.py special-case them on the
+        # serving_soak/serving_overload prefixes
+        ("serving_soak", lambda: _B().bench_serving_soak(20.0, 5.0, True), 5000 * 2048),
+        ("serving_overload_5x", lambda: _B().bench_serving_overload(20.0, 5.0), 5000 * 2048),
         ("sort_1m", lambda: _B().bench_sort(1 << 20), 1 << 20),
         ("bloom_filter_1m", lambda: _B().bench_bloom_filter(1 << 20), 1 << 20),
         ("cast_string_to_float_500k", lambda: _B().bench_cast_string_to_float(500_000), 500_000),
@@ -416,6 +426,10 @@ def _sweep(deadline):
         # Round r == 0 is an UNTIMED warm-up: compile + first-touch land
         # there, so every timed repeat (and the *_best fields) measures
         # steady state.
+        # soak axes run EXACTLY ONCE, timed: the storm warms its own
+        # program cache and its wall clock IS the measurement — an
+        # untimed warm-up would double a minutes-long axis for nothing
+        soak = name.startswith(("serving_soak", "serving_overload"))
         secs, nbytes, err = [], 0, None
         try:
             with Deadline(min(AXIS_DEADLINE_S, left), f"axis:{name}"):
@@ -423,13 +437,13 @@ def _sweep(deadline):
                     # test hook: a wedged device call — cancellable, so
                     # the axis deadline (not an external kill) unwedges it
                     deadline_sleep(10 ** 6)
-                for r in range(REPEATS + 1):
+                for r in range(1 if soak else REPEATS + 1):
                     if secs and time.monotonic() >= deadline:
                         break
-                    lbl = f"repeat {r}" if r else "warm-up"
+                    lbl = f"repeat {r}" if r or soak else "warm-up"
                     try:
                         sec, nbytes = fn()
-                        if r:
+                        if r or soak:
                             secs.append(sec)
                         _heartbeat()
                     except (DeadlineExceededError, StallCancelledError):

@@ -754,6 +754,32 @@ def bench_serving_qps_mixed(queries: int):
     return sec, queries * rows * 16
 
 
+def bench_serving_soak(stage_s: float = 20.0, multiplier: float = 5.0,
+                       chaos: bool = True):
+    """Serving-tier soak (benchmarks/bench_serving.py): 1x baseline ->
+    ``multiplier``x hot-tenant overload [-> 30% fault storm under load].
+    Headline ``seconds`` is the whole soak's wall clock; the fairness
+    verdict and the per-tenant columns (tenant, offered_qps, p99_ms,
+    rejected_by_reason) ride via pop_extra(). The standalone
+    ``python -m benchmarks.bench_serving`` entry runs the long-form
+    (60s stages) version and writes the SOAK_rNN.json artifact."""
+    from benchmarks import bench_serving
+
+    res = bench_serving.run_soak(stage_s=stage_s, multiplier=multiplier,
+                                 chaos=chaos, seed=0)
+    LAST_EXTRA.clear()
+    LAST_EXTRA.update(bench_serving.row_extra(res))
+    done = sum(r["completed"] for stage in
+               ("baseline_1x", "overload") for r in res[stage]["tenants"])
+    return res["elapsed_s"], done * bench_serving.ROWS * 16
+
+
+def bench_serving_overload(stage_s: float = 20.0, multiplier: float = 5.0):
+    """The overload slice of the soak (no chaos stage): 1x baseline +
+    ``multiplier``x hot tenant, emitting the shedding/fairness columns."""
+    return bench_serving_soak(stage_s, multiplier, chaos=False)
+
+
 def _query_mesh(n_devices: int):
     """Mesh for distributed query benches (None = local single-device) —
     always the process-wide cached instance (cluster.get_mesh)."""
@@ -1050,7 +1076,8 @@ def main():
                              "parquet_decode", "shuffle_skewed",
                              "dict_filter_strings", "dict_groupby_strings",
                              "rle_filter", "rle_groupby", "for_filter",
-                             "serving_qps_mixed"])
+                             "serving_qps_mixed", "serving_soak",
+                             "serving_overload_5x"])
     args = ap.parse_args()
     _refresh_variants()
     _ensure_backend()
@@ -1108,6 +1135,17 @@ def main():
         q = min(args.rows, 1000)
         runs.append(("serving_qps_mixed", "3 tenants, poisson, 70/20/10 mix",
                      q, lambda: bench_serving_qps_mixed(q)))
+    # the soak axes are deliberately NOT in "all": minutes-long storms
+    # belong to `make soak` / the sweep's explicit axis list, not to a
+    # default bench_ops invocation
+    if args.bench == "serving_soak":
+        runs.append(("serving_soak",
+                     "1x baseline + 5x hot tenant + 30% fault storm",
+                     5000, lambda: bench_serving_soak(20.0, 5.0, True)))
+    if args.bench == "serving_overload_5x":
+        runs.append(("serving_overload_5x",
+                     "1x baseline + 5x hot tenant, shedding/fairness",
+                     5000, lambda: bench_serving_overload(20.0, 5.0)))
     if args.bench in ("all", "tpch_q1"):
         cfg = ("filter+8agg-groupby+sort" if not args.mesh
                else f"distributed mesh={args.mesh}")

@@ -1,0 +1,506 @@
+"""Sustained-load soak harness for the serving tier (ROADMAP item 4).
+
+Runs minutes-long Poisson arrival storms against a live ServingFrontend
+in three stages and emits a per-tenant fairness verdict:
+
+1. **1x baseline** — three well-behaved tenants (interactive/analytics/
+   background) plus a "hot" tenant at its 1x rate; total offered load
+   sits under sustained capacity, establishing the per-tenant p50/p99
+   reference.
+2. **Nx overload** (default 5x) — the hot tenant alone multiplies its
+   offered rate; the well-behaved tenants do not change. The verdict
+   checks the overload invariants the shedding + DWRR design promises:
+   pooled well-behaved p99 within 3x of baseline (per-tenant ratios
+   are recorded for attribution but the binding check pools the three
+   identical well-behaved loads — a single tenant's few-hundred-sample
+   p99 swings +-50% run to run on a small host), the hot tenant
+   absorbing >= 90% of all rejections, zero deadline misses for
+   admitted well-behaved work.
+3. **chaos under load** (optional) — the same Nx storm with a 30%
+   POISON fault storm installed on ``plan_execute``; the verdict checks
+   zero cross-tenant fault propagation: failed queries never exceed
+   injected faults (a batch-level trap fails NO query — it triggers
+   solo replays; only a query whose own replay is trapped may fail).
+
+Each stage uses a fresh frontend but shares the process-wide program
+cache, so batched-program compiles are pre-paid once by ``_warm`` and
+never pollute stage latencies. Standalone entry point writes the
+``SOAK_rNN.json`` artifact::
+
+    JAX_PLATFORMS=cpu python -m benchmarks.bench_serving \
+        --stage-seconds 60 --multiplier 5 --out SOAK_r01.json
+
+``benchmarks/bench_ops.py`` wraps :func:`run_soak` as the
+``serving_soak`` / ``serving_overload_5x`` bench axes (per-tenant
+columns ride the one-line BENCH row via ``pop_extra()``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# Tenant population: (name, priority, offered QPS at 1x). The hot tenant
+# is the only one whose rate scales with the stage multiplier — overload
+# is a *tenant* behavior, not a global one, which is exactly what the
+# per-tenant queue budgets + CoDel shedding are supposed to contain.
+WELL_BEHAVED = (
+    ("interactive", 0, 12.0),
+    ("analytics", 2, 12.0),
+    ("background", 4, 12.0),
+)
+HOT = ("hot", 2, 120.0)
+
+ROWS = 512           # per-query table rows (serving-sized micro queries:
+                     # small enough that per-dispatch overhead is the
+                     # cost to amortize — the micro-batcher's actual job)
+N_TABLES = 8
+PLAN_MIX = (0.7, 0.2, 0.1)   # filter / groupby / sort+limit
+FUTURE_TIMEOUT_S = 180.0     # post-stage backlog drain bound per future
+
+
+def _fixtures():
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.plan import expr as ex
+    from spark_rapids_jni_tpu.plan.nodes import (Filter, GroupBy, Limit,
+                                                 Scan, Sort)
+
+    def mk(seed):
+        r = np.random.default_rng(seed)
+        return Table((
+            Column(dt.INT64, ROWS, data=jnp.asarray(
+                r.integers(0, 9, ROWS, dtype=np.int64))),
+            Column(dt.INT64, ROWS, data=jnp.asarray(
+                r.integers(0, 1000, ROWS, dtype=np.int64))),
+        ))
+
+    tables = [mk(s) for s in range(N_TABLES)]
+    plans = [
+        Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(5))),
+        GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count"))),
+        Limit(Sort(Scan(2), (0, 1)), 64),
+    ]
+    return plans, tables
+
+
+def _warm(plans, tables):
+    """Pre-pay every compile a storm can reach. Two kernel spaces matter:
+    the batched programs (quantized to power-of-two group sizes, so
+    plan x {1,2,4,...,max_batch} covers them) and the result-scatter
+    kernels, whose shapes depend on each member's LIVE row count — one
+    per (plan, table) pair with this fixture's fixed tables. Rotating
+    the member window per group walks every table through every group
+    size, so neither space compiles mid-storm."""
+    from spark_rapids_jni_tpu.serving import MicroBatcher, batch_key_for
+    from spark_rapids_jni_tpu.utils import config
+
+    mb = MicroBatcher()
+    max_batch = max(1, int(config.get("serving.max_batch")))
+    for plan in plans:
+        kb = 1
+        while kb <= max_batch:
+            for start in range(0, len(tables), kb):
+                group = [tables[(start + i) % len(tables)]
+                         for i in range(kb)]
+                mb.execute_group(
+                    [batch_key_for(plan, t)[0] for t in group],
+                    group, [None] * kb)
+            kb *= 2
+
+
+def _pct(lat_ms: List[float], p: float) -> float:
+    if not lat_ms:
+        return 0.0
+    return round(float(np.percentile(np.asarray(lat_ms), p)), 3)
+
+
+def _tenant_storm(fe, name, rate_qps, stop_at, plans, tables, seed, budget_s,
+                  out, lock):
+    """One tenant's open-loop Poisson arrival process: submit at
+    ``rate_qps`` until ``stop_at`` regardless of completions (offered
+    load, not closed-loop load), then classify every future."""
+    from spark_rapids_jni_tpu.faultinj.watchdog import DeadlineExceededError
+    from spark_rapids_jni_tpu.serving import AdmissionRejected
+
+    rng = np.random.default_rng(seed)
+    lat_ms: List[float] = []
+    futs = []
+    rejected: Dict[str, int] = {}
+    offered = 0
+    while True:
+        now = time.monotonic()
+        if now >= stop_at:
+            break
+        time.sleep(min(rng.exponential(1.0 / rate_qps), stop_at - now))
+        if time.monotonic() >= stop_at:
+            break
+        offered += 1
+        plan = plans[int(rng.choice(len(plans), p=PLAN_MIX))]
+        t0 = time.monotonic()
+        try:
+            fut = fe.submit(name, plan, tables[offered % len(tables)],
+                            budget_s=budget_s)
+        except AdmissionRejected as e:
+            rejected[e.reason] = rejected.get(e.reason, 0) + 1
+            continue
+        fut.add_done_callback(
+            lambda _f, t0=t0: lat_ms.append(
+                (time.monotonic() - t0) * 1000.0))
+        futs.append(fut)
+
+    completed = deadline_missed = shed = failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=FUTURE_TIMEOUT_S)
+            completed += 1
+        except DeadlineExceededError:
+            deadline_missed += 1
+        except AdmissionRejected:
+            shed += 1       # drained away mid-storm ("draining")
+        except Exception:
+            failed += 1     # fault-domain error on the query's own replay
+    with lock:
+        out[name] = {
+            "offered": offered,
+            "admitted": len(futs),
+            "completed": completed,
+            "deadline_missed": deadline_missed,
+            "shed_in_drain": shed,
+            "failed": failed,
+            "rejected_at_submit": rejected,
+            "lat_ms": lat_ms,
+        }
+
+
+def _trap_cfg_file(percent: int, count: int) -> str:
+    fd, path = tempfile.mkstemp(prefix="soak_traps_", suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump({"xlaRuntimeFaults": {
+            "plan_execute": {"percent": percent, "injectionType": 0,
+                             "interceptionCount": count}}}, f)
+    return path
+
+
+def _run_stage(plans, tables, duration_s: float, multiplier: float,
+               seed: int, budget_s: float = 30.0,
+               chaos: bool = False) -> Dict[str, Any]:
+    """One storm stage on a fresh frontend. Returns the per-tenant rows
+    plus the stage-wide serving counters (and, under chaos, the
+    fault-domain deltas + the propagation count)."""
+    from spark_rapids_jni_tpu.faultinj import guard, install, uninstall
+    from spark_rapids_jni_tpu.serving import ServingFrontend, serving_metrics
+    from spark_rapids_jni_tpu.utils import config
+
+    tenants = list(WELL_BEHAVED) + [
+        (HOT[0], HOT[1], HOT[2] * multiplier)]
+    fe = ServingFrontend()
+    for name, prio, _rate in tenants:
+        # generous in-flight caps: shedding must come from the queue
+        # budgets / CoDel path this harness exists to exercise, not from
+        # the per-tenant in-flight ceiling
+        fe.register_tenant(name, priority=prio, max_in_flight=4096)
+
+    trap_path: Optional[str] = None
+    fault_before = guard.metrics.snapshot()
+    out: Dict[str, Dict[str, Any]] = {}
+    lock = threading.Lock()
+    # pin the collector for the measured window, the way a production
+    # serving process would: on a small host a gen2 GC pause freezes the
+    # submit threads AND both dispatch lanes at once, and two ~60 ms
+    # pauses per stage is all it takes to own the p99. Allocation churn
+    # per query is bounded (tickets, futures), so disabling collection
+    # for one stage is safe; everything reachable now is frozen out of
+    # the young generations and a full collect runs between stages.
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        serving_metrics.reset()
+        fe.scheduler.peak_depth = 0
+        if chaos:
+            # 30% POISON storm on the batched dispatch path, bounded by
+            # an interception budget so the stage ends deterministically;
+            # max_poison_redispatch=0 surfaces every poisoned program to
+            # the isolation machinery (solo replays), breaker.threshold
+            # raised so the storm proves *isolation*, not breaker trips
+            trap_path = _trap_cfg_file(30, 64)
+            install(trap_path, seed=seed)
+        t0 = time.monotonic()
+        stop_at = t0 + duration_s
+        threads = [
+            threading.Thread(
+                target=_tenant_storm,
+                args=(fe, name, rate, stop_at, plans, tables,
+                      seed * 7919 + i, budget_s, out, lock),
+                name=f"storm-{name}", daemon=True)
+            for i, (name, _prio, rate) in enumerate(tenants)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        elapsed = time.monotonic() - t0
+        peak_depth = fe.scheduler.peak_depth
+        registry_stats = {name: fe.registry.stats_of(name)
+                          for name, _p, _r in tenants}
+    finally:
+        gc.enable()
+        gc.unfreeze()
+        gc.collect()
+        if chaos:
+            uninstall()
+            if trap_path:
+                os.unlink(trap_path)
+        fe.drain()
+
+    rows = []
+    for name, prio, rate in tenants:
+        t = out[name]
+        reg = registry_stats[name]
+        rej = dict(reg["rejected_by_reason"])
+        rows.append({
+            "tenant": name,
+            "priority": prio,
+            "offered_qps": round(t["offered"] / elapsed, 1),
+            "qps": round(t["completed"] / elapsed, 1),
+            "offered": t["offered"],
+            "admitted": t["admitted"],
+            "completed": t["completed"],
+            "deadline_missed": t["deadline_missed"],
+            "failed": t["failed"],
+            "shed_in_drain": t["shed_in_drain"],
+            "rejected": sum(rej.values()),
+            "rejected_by_reason": rej,
+            "p50_ms": _pct(t["lat_ms"], 50),
+            "p95_ms": _pct(t["lat_ms"], 95),
+            "p99_ms": _pct(t["lat_ms"], 99),
+            "faults_isolated": reg.get("faults_isolated", 0),
+            "compile_misses": reg.get("compile_misses", 0),
+        })
+
+    m = serving_metrics.snapshot()
+    total_rejected = sum(r["rejected"] for r in rows)
+    hot_rejected = next(r["rejected"] for r in rows if r["tenant"] == HOT[0])
+    # pooled well-behaved latency distribution: the three well-behaved
+    # tenants run identical loads, so pooling triples the sample count
+    # behind the stage's headline p99 — a per-tenant p99 over a few
+    # hundred samples swings ±50% run to run on a small host, which is
+    # noise, not fairness signal (per-tenant rows stay for attribution)
+    wb_names = {name for name, _p, _r in WELL_BEHAVED}
+    pooled = [ms for name, _p, _r in tenants if name in wb_names
+              for ms in out[name]["lat_ms"]]
+    stage: Dict[str, Any] = {
+        "multiplier": multiplier,
+        "duration_s": round(elapsed, 1),
+        "budget_s": budget_s,
+        "offered_qps": round(sum(r["offered"] for r in rows) / elapsed, 1),
+        "sustained_qps": round(
+            sum(r["completed"] for r in rows) / elapsed, 1),
+        "peak_queue_depth": peak_depth,
+        "well_behaved_p50_ms": _pct(pooled, 50),
+        "well_behaved_p99_ms": _pct(pooled, 99),
+        "total_rejected": total_rejected,
+        "hot_rejection_share": round(
+            hot_rejected / total_rejected, 3) if total_rejected else None,
+        "dispatches": m["dispatches"],
+        "batches": m["batches"],
+        "shed_expired": m["shed_expired"],
+        "deadline_missed": m["deadline_missed"],
+        "tenants": rows,
+    }
+    if chaos:
+        fault_after = guard.metrics.snapshot()
+        delta = {k: fault_after[k] - fault_before[k]
+                 for k in ("injected_faults", "poisoned_programs",
+                           "batch_solo_replays", "redispatches")}
+        failed_total = sum(r["failed"] for r in rows)
+        # a batch-level trap fails NO query (it triggers solo replays);
+        # only a query whose OWN solo replay is trapped may fail, and each
+        # trap consumes one interception — so any failure count beyond
+        # the injection count is, by construction, cross-tenant propagation
+        delta["failed_queries"] = failed_total
+        delta["cross_tenant_propagation"] = max(
+            0, failed_total - delta["injected_faults"])
+        delta["faults_isolated"] = sum(r["faults_isolated"] for r in rows)
+        stage["fault_storm"] = delta
+    return stage
+
+
+def run_soak(stage_s: float = 60.0, multiplier: float = 5.0,
+             chaos: bool = True, chaos_s: Optional[float] = None,
+             seed: int = 0, tenant_queue_budget: int = 16) -> Dict[str, Any]:
+    """The full soak: 1x baseline -> Nx overload [-> chaos under Nx].
+    Returns the artifact dict (stages + fairness verdict)."""
+    from spark_rapids_jni_tpu.utils import config
+
+    plans, tables = _fixtures()
+    _warm(plans, tables)
+
+    overrides = [
+        # one max_batch worth of backlog per tenant: deep per-tenant queues
+        # only add delay once a tenant is over its fair share — the budget,
+        # not CoDel, is the primary shedder under *sustained* overload
+        # (CoDel dithers around its target; a shallow queue back-pressures
+        # at admission time and keeps DWRR round times short for everyone)
+        config.override("serving.tenant_queue_budget", tenant_queue_budget),
+    ]
+    chaos_overrides = [
+        ("faultinj.max_poison_redispatch", 0),
+        ("breaker.threshold", 10_000),
+    ]
+    result: Dict[str, Any] = {
+        "harness": "benchmarks/bench_serving.py",
+        "stage_seconds": stage_s,
+        "multiplier": multiplier,
+        "tenant_queue_budget": tenant_queue_budget,
+        "seed": seed,
+    }
+    t_start = time.monotonic()
+    try:
+        for ov in overrides:
+            ov.__enter__()
+        result["baseline_1x"] = _run_stage(
+            plans, tables, stage_s, 1.0, seed)
+        result["overload"] = _run_stage(
+            plans, tables, stage_s, multiplier, seed + 1)
+        if chaos:
+            for k, v in chaos_overrides:
+                overrides.append(config.override(k, v))
+                overrides[-1].__enter__()
+            result["chaos_under_load"] = _run_stage(
+                plans, tables, chaos_s or min(stage_s, 30.0), multiplier,
+                seed + 2, chaos=True)
+    finally:
+        for ov in reversed(overrides):
+            ov.__exit__(None, None, None)
+    result["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    result["fairness"] = _verdict(result)
+    return result
+
+
+def _verdict(result: Dict[str, Any]) -> Dict[str, Any]:
+    """The acceptance checks, computed not asserted — the artifact
+    records what held; callers (make soak, the bench axes) decide."""
+    base = {r["tenant"]: r for r in result["baseline_1x"]["tenants"]}
+    over = {r["tenant"]: r for r in result["overload"]["tenants"]}
+    wb = [name for name, _p, _r in WELL_BEHAVED]
+    # guard against a sub-ms baseline making the 3x ratio meaningless:
+    # comparisons floor the baseline p99 at one batching window
+    from spark_rapids_jni_tpu.utils import config
+    floor_ms = float(config.get("serving.batch_window_ms"))
+    ratios = {
+        n: round(over[n]["p99_ms"] / max(base[n]["p99_ms"], floor_ms), 2)
+        for n in wb}
+    # the binding 3x check runs on the POOLED well-behaved distribution
+    # (the three tenants are identical loads; see _run_stage) — the
+    # per-tenant ratios stay in the artifact for attribution but a
+    # single tenant's few-hundred-sample p99 is too noisy to gate on
+    pooled_ratio = round(
+        result["overload"]["well_behaved_p99_ms"]
+        / max(result["baseline_1x"]["well_behaved_p99_ms"], floor_ms), 2)
+    share = result["overload"]["hot_rejection_share"]
+    verdict = {
+        "well_behaved_p99_ratio": ratios,
+        "pooled_well_behaved_p99_ratio": pooled_ratio,
+        "well_behaved_p99_within_3x": pooled_ratio <= 3.0,
+        "hot_rejection_share": share,
+        "hot_absorbs_90pct_of_rejections": (
+            share is not None and share >= 0.9),
+        "well_behaved_deadline_misses": sum(
+            over[n]["deadline_missed"] for n in wb),
+        "zero_well_behaved_deadline_misses": all(
+            over[n]["deadline_missed"] == 0 for n in wb),
+    }
+    if "chaos_under_load" in result:
+        storm = result["chaos_under_load"]["fault_storm"]
+        chaos_over = {r["tenant"]: r
+                      for r in result["chaos_under_load"]["tenants"]}
+        verdict["chaos_injected_faults"] = storm["injected_faults"]
+        verdict["chaos_zero_cross_tenant_propagation"] = (
+            storm["injected_faults"] > 0
+            and storm["cross_tenant_propagation"] == 0)
+        verdict["chaos_well_behaved_deadline_misses"] = sum(
+            chaos_over[n]["deadline_missed"] for n in wb)
+    verdict["ok"] = all(v for k, v in verdict.items()
+                        if k.startswith(("well_behaved_p99_within",
+                                         "hot_absorbs", "zero_",
+                                         "chaos_zero")))
+    return verdict
+
+
+def row_extra(result: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten a soak result into pop_extra()-style columns for the
+    one-line BENCH row: headline fairness fields + per-tenant columns
+    (tenant, offered_qps, p99_ms, rejected_by_reason) for the overload
+    stage."""
+    over = result["overload"]
+    v = result["fairness"]
+    extra: Dict[str, Any] = {
+        "engine": "serving",
+        "multiplier": result["multiplier"],
+        "sustained_qps": over["sustained_qps"],
+        "offered_qps": over["offered_qps"],
+        "peak_queue_depth": over["peak_queue_depth"],
+        "total_rejected": over["total_rejected"],
+        "hot_rejection_share": over["hot_rejection_share"],
+        "pooled_wb_p99_ratio": v["pooled_well_behaved_p99_ratio"],
+        "fairness_ok": v["ok"],
+        "tenants": [
+            {"tenant": r["tenant"],
+             "offered_qps": r["offered_qps"],
+             "qps": r["qps"],
+             "p50_ms": r["p50_ms"],
+             "p99_ms": r["p99_ms"],
+             "deadline_missed": r["deadline_missed"],
+             "rejected_by_reason": r["rejected_by_reason"]}
+            for r in over["tenants"]],
+    }
+    if "chaos_under_load" in result:
+        storm = result["chaos_under_load"]["fault_storm"]
+        extra["chaos_injected_faults"] = storm["injected_faults"]
+        extra["chaos_cross_tenant_propagation"] = (
+            storm["cross_tenant_propagation"])
+    return extra
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving-tier sustained-load soak harness")
+    ap.add_argument("--stage-seconds", type=float, default=60.0,
+                    help="duration of the 1x and Nx stages (default 60)")
+    ap.add_argument("--multiplier", type=float, default=5.0,
+                    help="hot-tenant overload multiplier (default 5)")
+    ap.add_argument("--chaos-seconds", type=float, default=None,
+                    help="chaos-stage duration (default min(stage, 30))")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the fault-storm-under-load stage")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="write the SOAK artifact JSON here")
+    args = ap.parse_args(argv)
+
+    res = run_soak(stage_s=args.stage_seconds, multiplier=args.multiplier,
+                   chaos=not args.no_chaos, chaos_s=args.chaos_seconds,
+                   seed=args.seed)
+    blob = json.dumps(res, indent=2, sort_keys=False)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        print(f"soak artifact -> {args.out}", file=sys.stderr)
+    print(blob)
+    return 0 if res["fairness"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

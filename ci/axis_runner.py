@@ -41,8 +41,13 @@ def main():
     axis = sys.argv[1]
     # serving axes are full workload storms (1000 queries each), not
     # single-op timings: default to fewer repeats so one axis stays
-    # inside the SIGKILL budget (an explicit argv[2] still wins)
-    default_repeats = 2 if axis.startswith("serving_") else 3
+    # inside the SIGKILL budget (an explicit argv[2] still wins). Soak
+    # axes go further: they run EXACTLY ONCE with no untimed warm-up —
+    # the storm warms its own program cache, its wall clock IS the
+    # measurement, and a warm-up repeat would double a minutes-long axis
+    soak = axis.startswith(("serving_soak", "serving_overload"))
+    default_repeats = 1 if soak else (
+        2 if axis.startswith("serving_") else 3)
     repeats = int(sys.argv[2]) if len(sys.argv) > 2 else default_repeats
 
     # No subprocess pre-probe here: the parent daemon probed the tunnel
@@ -71,11 +76,13 @@ def main():
         with Deadline(budget, f"axis:{axis}"):
             # one untimed warm-up so every TIMED repeat measures steady
             # state — compile + first-touch costs land here, not in the
-            # median (the *_best/min fields then compare like with like)
-            t = time.monotonic()
-            fn()
-            print(f"axis_runner: {axis} warm-up "
-                  f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
+            # median (the *_best/min fields then compare like with like);
+            # skipped for soak axes (they warm themselves, see above)
+            if not soak:
+                t = time.monotonic()
+                fn()
+                print(f"axis_runner: {axis} warm-up "
+                      f"(wall {time.monotonic() - t:.1f}s)", file=sys.stderr)
 
             for _ in range(repeats):
                 t = time.monotonic()

@@ -115,7 +115,21 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_encodings.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-# stage 9 — exception-fault storms over the whole chaos-marked suite
+# stage 9 — fault storm UNDER SUSTAINED LOAD: the serving soak harness's
+# chaos stage — a 30% POISON storm on plan_execute while a 5x-overloaded
+# 4-tenant Poisson storm is in flight (benchmarks/bench_serving.py).
+# Pass criteria are the harness's own exit code: zero cross-tenant fault
+# propagation (failed queries never exceed injected faults), well-behaved
+# p99 within 3x of the 1x baseline, the hot tenant absorbing >= 90% of
+# rejections, zero deadline misses for admitted well-behaved work. The
+# outer `timeout` is part of the contract — if shedding or drain ever
+# wedges under the combined storm, the kill fails the lane loudly.
+# `make soak` runs the long-form (60s stages) version and writes the
+# SOAK_rNN.json artifact; this stage is the short CI-budget cut.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m benchmarks.bench_serving \
+    --stage-seconds 12 --chaos-seconds 12 --multiplier 5 > /dev/null
+
+# stage 10 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -27,6 +27,10 @@ Rule IDs:
   SRJT015  host sync or any dispatch inside a join plan core, or a
            join-order decision (order_joins/estimate_rows/JoinDecision)
            outside plan/planner.py
+  SRJT016  encoded-column (RLE/FOR) decode outside the declared output
+           boundaries sanctioned in ci/lint_baseline.json
+  SRJT017  AdmissionRejected raised without a retry-after hint (missing
+           or constant-zero retry_after_s) and no sanctioned noqa
 """
 
 from __future__ import annotations
@@ -1362,6 +1366,66 @@ def rule_srjt016(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT017 — AdmissionRejected without a retry-after hint
+# ---------------------------------------------------------------------------
+
+# The serving tier's overload contract is that every rejection is PRICED:
+# ``AdmissionRejected.retry_after_s`` tells the shed client when capacity
+# is expected back (admission.py derives it from the measured drain rate;
+# the breaker path from its jittered cooldown). A raise site that omits
+# the hint, or hardcodes 0.0, silently re-creates the retry stampede the
+# pricing exists to prevent — clients treat 0.0 as "do not retry", which
+# is only correct when the resource is truly gone (drain/teardown,
+# unknown tenant). Those deliberate zero-hint sites must carry a
+# ``# srjt: noqa[SRJT017]`` with the reason on the raise line, so every
+# unpriced rejection in the tree is a reviewed decision, not an accident.
+
+
+def _srjt017_retry_arg(call: ast.Call):
+    """The retry_after_s argument node of an AdmissionRejected(...) call:
+    2nd positional or the keyword; None when absent."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "retry_after_s":
+            return kw.value
+    return None
+
+
+def rule_srjt017(tree, rel, lines, ctx) -> List[Finding]:
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        call = node.exc
+        if not isinstance(call, ast.Call):
+            continue
+        dn = _dotted(call.func)
+        if dn is None or dn.split(".")[-1] != "AdmissionRejected":
+            continue
+        arg = _srjt017_retry_arg(call)
+        if arg is None:
+            msg = ("`raise AdmissionRejected(...)` without a "
+                   "`retry_after_s` hint — every shed client must be told "
+                   "when to come back (price it from the drain rate / "
+                   "breaker cooldown), or carry `# srjt: noqa[SRJT017]` "
+                   "with the reason if 0.0 is the honest answer")
+        elif (isinstance(arg, ast.Constant)
+              and isinstance(arg.value, (int, float))
+              and not isinstance(arg.value, bool)
+              and float(arg.value) == 0.0):
+            msg = ("`raise AdmissionRejected(...)` with a constant-zero "
+                   "`retry_after_s` — 0.0 means \"never retry\"; if the "
+                   "resource is genuinely gone, say why with "
+                   "`# srjt: noqa[SRJT017]`, otherwise price the hint "
+                   "from the measured drain rate")
+        else:
+            continue
+        findings.append(Finding("SRJT017", rel, node.lineno, msg))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 
@@ -1369,7 +1433,7 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
-              rule_srjt015, rule_srjt016)
+              rule_srjt015, rule_srjt016, rule_srjt017)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

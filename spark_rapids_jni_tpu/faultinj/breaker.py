@@ -25,6 +25,7 @@ that policy at dispatch-surface granularity.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -55,6 +56,8 @@ class CircuitBreaker:
         self._failures: List[float] = []  # monotonic timestamps
         self._opened_at = 0.0
         self._probing = False
+        self._last_hint = 0.0   # previous retry hint (decorrelated jitter)
+        self._rng = random.Random(id(self) ^ 0x5273_4A54)
         self.opened_count = 0
         self.closed_count = 0
 
@@ -137,12 +140,29 @@ class CircuitBreaker:
     def retry_after_s(self) -> float:
         """Seconds until an OPEN breaker starts admitting probes — the
         retry-after hint the serving front door attaches to shed load
-        (0.0 when not OPEN, so callers can pass it through unguarded)."""
+        (0.0 when not OPEN, so callers can pass it through unguarded).
+
+        With ``breaker.retry_jitter`` on (default), hints carry
+        decorrelated jitter: each is drawn uniformly from [remaining
+        cooldown, 3x the previous hint], clamped to one extra cooldown.
+        Synchronized clients that were all shed at the same instant then
+        retry staggered instead of stampeding the single half-open probe
+        slot — and every concurrent rejection gets a distinct hint."""
+        from ..utils import config
         _enabled, _threshold, _window, cooldown = _limits()
         with self._lock:
             if self._state != OPEN:
+                self._last_hint = 0.0
                 return 0.0
-            return max(0.0, cooldown - (time.monotonic() - self._opened_at))
+            base = max(0.0, cooldown
+                       - (time.monotonic() - self._opened_at))
+            if not bool(config.get("breaker.retry_jitter")):
+                return base
+            hi = min(base + cooldown, max(base, 3.0 * self._last_hint))
+            hint = self._rng.uniform(base, hi) if hi > base else \
+                base + self._rng.uniform(0.0, max(cooldown, 1e-3))
+            self._last_hint = hint
+            return hint
 
 
 _breakers: Dict[str, CircuitBreaker] = {}

@@ -341,7 +341,7 @@ class TaskExecutor:
                 # pre-serving callers that caught RuntimeError still work);
                 # lazy import: serving imports this module back
                 from ..serving.admission import AdmissionRejected
-                raise AdmissionRejected(
+                raise AdmissionRejected(  # srjt: noqa[SRJT017] the executor is permanently closed; retrying this process cannot succeed
                     "closed", 0.0, None,
                     "TaskExecutor is closed (drain() has run)")
             w = self._workers.get(task_id)

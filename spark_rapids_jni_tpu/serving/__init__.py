@@ -25,6 +25,7 @@ _LAZY = {
     "SessionRegistry": ".sessions",
     "Tenant": ".sessions",
     "serving_metrics": ".sessions",
+    "WarmupProfile": ".warmup",
 }
 
 __all__ = sorted(_LAZY)

@@ -8,10 +8,27 @@ would otherwise surface as a timeout. The checks, in order:
    same ``AdmissionRejected`` the executor itself now raises);
 2. open ``plan_execute`` circuit breaker (faultinj/breaker.py): a
    persistently failing dispatch surface sheds load at submission time,
-   retry-after = the breaker's cooldown remainder;
+   retry-after = the breaker's (jittered) cooldown remainder;
 3. global queue depth (``serving.max_queue_depth``);
-4. per-tenant in-flight cap and per-tenant HBM budget, validated and
+4. per-tenant queue-depth budget (``serving.tenant_queue_budget``): one
+   tenant's backlog is bounded long before it can fill the global queue;
+5. CoDel-style queue-delay shedding: when dispatch-observed queue delay
+   has exceeded ``serving.codel_target_ms`` continuously for
+   ``serving.codel_interval_ms``, the scheduler is past its latency
+   target no matter what the depth counters say — arriving work of the
+   MOST over-budget tenant (largest depth/budget ratio) is shed until
+   delay recovers, so the hot tenant pays for the standing queue it
+   built while light tenants keep being admitted;
+6. per-tenant in-flight cap and per-tenant HBM budget, validated and
    charged atomically by the session registry (sessions.py).
+
+Retry-after hints are PRICED, not constant: the controller measures the
+frontend's drain rate (dispatched queries per second over a sliding
+window, fed by the dispatch loops via ``note_dispatch``) and quotes
+``excess work / drain rate`` clamped to [batch window, cap] — a client
+shed at 5x overload is told to come back when the backlog it saw will
+actually have drained, so retries arrive when capacity exists instead
+of stampeding immediately.
 
 ``AdmissionRejected`` subclasses RuntimeError so pre-serving callers of
 ``TaskExecutor.submit()`` that caught RuntimeError keep working. The
@@ -20,7 +37,10 @@ pipeline this fronts is docs/ARCHITECTURE.md "Serving tier".
 
 from __future__ import annotations
 
-from typing import Optional
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
 
 from ..faultinj import breaker
 from ..utils import config
@@ -30,11 +50,16 @@ from .sessions import SessionRegistry, serving_metrics
 # plan (batched or solo) dispatches through guarded_dispatch("plan_execute")
 PLAN_SURFACE = "plan_execute"
 
+# drain-rate sliding window: long enough to smooth batch bursts, short
+# enough to track a breaker flip or a lane stall within seconds
+_RATE_WINDOW_S = 5.0
+
 
 class AdmissionRejected(RuntimeError):
     """Typed front-door rejection. ``reason`` is one of ``closed`` /
-    ``draining`` / ``breaker_open`` / ``queue_full`` / ``unknown_tenant``
-    / ``tenant_in_flight`` / ``hbm_budget``; ``retry_after_s`` is the
+    ``draining`` / ``breaker_open`` / ``queue_full`` /
+    ``tenant_queue_budget`` / ``queue_delay`` / ``unknown_tenant`` /
+    ``tenant_in_flight`` / ``hbm_budget``; ``retry_after_s`` is the
     caller's backoff hint (0.0 = do not retry, the resource is gone)."""
 
     def __init__(self, reason: str, retry_after_s: float = 0.0,
@@ -53,49 +78,134 @@ class AdmissionRejected(RuntimeError):
 
 
 class AdmissionController:
-    """Stateless policy over the registry + breaker + queue-depth inputs;
-    one instance per frontend."""
+    """Policy over the registry + breaker + queue-depth + queue-delay
+    inputs; one instance per frontend. The only mutable state is the
+    drain-rate ring and the CoDel above-target timestamp, both fed by
+    ``note_dispatch`` from the dispatch lanes."""
 
     def __init__(self, registry: SessionRegistry):
         self._registry = registry
+        self._lock = threading.Lock()
+        self._dispatches: deque = deque()   # (monotonic, n) samples
+        self._above_since: Optional[float] = None
+        self._overloaded = False
+
+    # -- dispatch-side feedback ---------------------------------------------
+
+    def note_dispatch(self, n: int, queue_delay_s: float) -> None:
+        """Dispatch lanes report every group they pop: ``n`` queries and
+        the head's observed queue delay. Feeds the drain-rate estimate
+        and the CoDel above-target clock."""
+        now = time.monotonic()
+        target_s = float(config.get("serving.codel_target_ms")) / 1000.0
+        interval_s = float(config.get("serving.codel_interval_ms")) / 1000.0
+        with self._lock:
+            self._dispatches.append((now, n))
+            cutoff = now - _RATE_WINDOW_S
+            while self._dispatches and self._dispatches[0][0] < cutoff:
+                self._dispatches.popleft()
+            if target_s > 0 and queue_delay_s > target_s:
+                if self._above_since is None:
+                    self._above_since = now
+                elif now - self._above_since >= interval_s:
+                    self._overloaded = True
+            else:
+                self._above_since = None
+                self._overloaded = False
+
+    def drain_rate(self) -> float:
+        """Measured queries dispatched per second over the sliding
+        window (0.0 until the first dispatch lands)."""
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - _RATE_WINDOW_S
+            while self._dispatches and self._dispatches[0][0] < cutoff:
+                self._dispatches.popleft()
+            total = sum(n for _, n in self._dispatches)
+        return total / _RATE_WINDOW_S
+
+    def is_overloaded(self) -> bool:
+        with self._lock:
+            return self._overloaded
+
+    def _priced_hint(self, excess: float) -> float:
+        """Retry-after = time for ``excess`` queued queries to drain at
+        the measured rate, clamped to [batch window, retry_after cap].
+        No rate measured yet -> quote the floor (nothing to amortise)."""
+        floor = float(config.get("serving.batch_window_ms")) / 1000.0
+        cap = float(config.get("serving.retry_after_cap_s"))
+        rate = self.drain_rate()
+        if rate <= 0.0:
+            return max(floor, 0.001)
+        return min(max(excess / rate, floor, 0.001), cap)
+
+    # -- the front door ------------------------------------------------------
+
+    def _reject(self, tenant_id: str, reason: str) -> None:
+        serving_metrics.inc_rejected(reason)
+        self._registry.count_rejection(tenant_id, reason)
 
     def admit(self, tenant_id: str, estimate_bytes: int,
-              queue_depth: int, draining: bool = False) -> None:
+              queue_depth: int, draining: bool = False,
+              tenant_depths: Optional[Dict[str, int]] = None) -> None:
         """Admit or raise. On success the tenant's in-flight slot and HBM
-        estimate are already charged (release via registry.release)."""
-        window_s = float(config.get("serving.batch_window_ms")) / 1000.0
+        estimate are already charged (release via registry.release).
+        ``tenant_depths`` (scheduler.depths()) arms the per-tenant budget
+        and CoDel checks; omitted (direct callers, tests) they skip."""
         if draining:
-            serving_metrics.inc("rejected")
-            self._registry.count(tenant_id, "rejected")
-            raise AdmissionRejected("draining", 0.0, tenant_id,
-                                    "serving frontend is draining")
+            self._reject(tenant_id, "draining")
+            raise AdmissionRejected(  # srjt: noqa[SRJT017] the frontend is going away; there is nothing to retry against
+                "draining", 0.0, tenant_id,
+                "serving frontend is draining")
         br = breaker.lookup(PLAN_SURFACE)
         if br is not None and br.state() == breaker.OPEN:
-            serving_metrics.inc("rejected")
-            self._registry.count(tenant_id, "rejected")
+            self._reject(tenant_id, "breaker_open")
             raise AdmissionRejected(
-                "breaker_open", max(br.retry_after_s(), window_s),
+                "breaker_open",
+                max(br.retry_after_s(), self._priced_hint(queue_depth)),
                 tenant_id,
                 f"the {PLAN_SURFACE} breaker is open (shedding at the "
                 f"front door)")
         max_depth = int(config.get("serving.max_queue_depth"))
         if max_depth > 0 and queue_depth >= max_depth:
-            serving_metrics.inc("rejected")
-            self._registry.count(tenant_id, "rejected")
+            self._reject(tenant_id, "queue_full")
             raise AdmissionRejected(
-                "queue_full", window_s, tenant_id,
+                "queue_full",
+                self._priced_hint(queue_depth - max_depth + 1),
+                tenant_id,
                 f"queue depth {queue_depth} >= serving.max_queue_depth "
                 f"{max_depth}")
+        if tenant_depths is not None:
+            budget = int(config.get("serving.tenant_queue_budget"))
+            own_depth = tenant_depths.get(tenant_id, 0)
+            if budget > 0 and own_depth >= budget:
+                self._reject(tenant_id, "tenant_queue_budget")
+                raise AdmissionRejected(
+                    "tenant_queue_budget",
+                    self._priced_hint(own_depth - budget + 1),
+                    tenant_id,
+                    f"tenant queue depth {own_depth} >= "
+                    f"serving.tenant_queue_budget {budget}")
+            if budget > 0 and tenant_depths and self.is_overloaded():
+                worst = max(tenant_depths,
+                            key=lambda t: tenant_depths[t] / budget)
+                if tenant_id == worst and own_depth > 0:
+                    self._reject(tenant_id, "queue_delay")
+                    raise AdmissionRejected(
+                        "queue_delay", self._priced_hint(own_depth),
+                        tenant_id,
+                        "queue delay over serving.codel_target_ms; "
+                        "shedding the most over-budget tenant's arrivals")
         reason = self._registry.try_admit(tenant_id, estimate_bytes)
         if reason is not None:
-            serving_metrics.inc("rejected")
+            # try_admit already recorded the per-tenant reason split
+            serving_metrics.inc_rejected(reason)
             if reason == "unknown_tenant":
-                self._registry.count(tenant_id, "rejected")  # no-op: absent
-                raise AdmissionRejected(
+                raise AdmissionRejected(  # srjt: noqa[SRJT017] registration is a programming error, not load — retrying cannot help
                     "unknown_tenant", 0.0, tenant_id,
                     "register_tenant() before submitting")
             raise AdmissionRejected(
-                reason, window_s, tenant_id,
+                reason, self._priced_hint(max(queue_depth, 1)), tenant_id,
                 "per-tenant in-flight cap reached"
                 if reason == "tenant_in_flight"
                 else f"HBM budget would be exceeded by +{estimate_bytes} "

@@ -200,14 +200,21 @@ class MicroBatcher:
         # dummy lanes (zero leaves: indicator 0 = every row masked), so
         # the compile-key space per plan signature is {2,4,8,16,...}
         # instead of one program per observed group size — the classic
-        # serving tradeoff of bounded compile count for bounded waste
+        # serving tradeoff of bounded compile count for bounded waste.
+        # The dummy lanes are appended to the MEMBER LIST before
+        # stacking (not concatenated after): jnp.stack specializes its
+        # fused kernel on the argument count, so stacking k members and
+        # padding with a concatenate afterwards compiles a fresh stack
+        # kernel for every distinct observed k — each first-seen group
+        # size then stalls both dispatch lanes ~100-300 ms mid-storm,
+        # which is exactly the p99 spike the kb quantization exists to
+        # prevent. Stacking the padded list keeps the stack-kernel space
+        # identical to the program space: {2,4,8,16,...} only.
         kb = 1 << (k - 1).bit_length()
-        stacked = _stack_columns(padded)
         if kb > k:
-            stacked = jax.tree_util.tree_map(
-                lambda a: jnp.concatenate(
-                    [a, jnp.zeros((kb - k,) + a.shape[1:], a.dtype)]),
-                stacked)
+            zero = jax.tree_util.tree_map(jnp.zeros_like, padded[0])
+            padded = list(padded) + [zero] * (kb - k)
+        stacked = _stack_columns(padded)
         nbytes = sum(t.device_nbytes() for t in tables)
 
         # config-gated sharded mode: stage the stacked pytree's ROW axis

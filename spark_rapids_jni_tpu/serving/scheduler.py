@@ -1,26 +1,47 @@
-"""Deadline-aware scheduling + the serving frontend's dispatch loops.
+"""Deadline-aware fair scheduling + the serving frontend's dispatch loops.
 
-Ordering: earliest-deadline-first within priority. A ticket's effective
-priority is its tenant priority minus one level per
-``serving.age_step_s`` waited (priority aging) — a background tenant's
-query cannot starve behind a steady stream of urgent arrivals, it climbs
-one class per quantum until it wins. Within an effective class, tickets
-order by their Deadline expiry (the thread-local ``Deadline`` snapshot
-captured at submit — queue time counts against the budget, exactly the
-TaskExecutor contract), deadline-less tickets last, FIFO as the tiebreak.
+Cross-tenant ordering is deficit-weighted round-robin (DWRR): each
+tenant with queued work holds a deficit counter credited in round-robin
+passes by its weight — ``1 / (1 + effective priority)``, so an urgent
+class-0 tenant earns a full dispatch credit per pass while a class-3
+background tenant earns a quarter — and a tenant dispatches when its
+deficit reaches one query's cost. A hot tenant's backlog therefore
+degrades only its OWN p99: the other tenants keep earning credits at
+their weighted rate no matter how deep the hot queue grows. Each
+dispatched query costs one credit, batch-mates riding another tenant's
+dispatch are charged against their own tenant, and an emptied queue
+resets its deficit (no banking credit while idle).
 
-Batching interaction: the dispatcher pops the most urgent ticket and
-takes every queued ticket sharing its batch key (microbatch.py) with it,
-up to ``serving.max_batch``. If the group is not full and the head has
-been queued for less than ``serving.batch_window_ms``, the dispatcher
-waits out the remainder of the window for mates to arrive — so the
-window bounds the extra latency batching can ever add to a query.
+Within a tenant, ordering is the original aged-priority EDF: effective
+priority improves one class per ``serving.age_step_s`` waited, and
+within a class tickets order by Deadline expiry (the snapshot captured
+at submit — queue time counts against the budget), FIFO tiebreak.
+Aging also lifts the tenant's DWRR weight (it is computed from the best
+aged class in the queue), so starvation is impossible across tenants
+AND within one.
+
+Batching interaction: the dispatcher pops the selected tenant's most
+urgent ticket and takes every queued ticket sharing its batch key
+(microbatch.py) with it — across tenants, since batching is how mixed
+loads share programs — up to ``serving.max_batch``. If the group is not
+full and the head has been queued for less than
+``serving.batch_window_ms``, the dispatcher waits out the remainder of
+the window for mates to arrive — so the window bounds the extra latency
+batching can ever add to a query.
+
+Expiry: tickets whose Deadline expired while queued are swept on every
+push (``shed_expired``) as well as at pop time (``expired_in_queue``) —
+dead work cannot sit holding queue-depth budget against live arrivals
+just because no lane has reached it yet.
 
 Drain: ``ServingFrontend.drain()`` stops admission (further submits
-raise AdmissionRejected), flushes the queue WITHOUT window waits (queued
-work runs, it just stops waiting for company), joins the dispatch
-lanes, then delegates to ``TaskExecutor.drain()`` for the executor-level
-verdict — one graceful path from front door to device.
+raise AdmissionRejected), SHEDS everything still queued with the same
+typed ``AdmissionRejected("draining")`` (under overload, running the
+backlog out could take unboundedly long — in-flight dispatches finish,
+queued ones are rejected and can be retried elsewhere), joins the
+dispatch lanes, then delegates to ``TaskExecutor.drain()`` for the
+executor-level verdict — one graceful, Deadline-bounded path from front
+door to device.
 """
 
 from __future__ import annotations
@@ -36,12 +57,13 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..columnar.column import Table
 from ..faultinj import watchdog
 from ..parallel.task_executor import TaskExecutor
-from ..plan.compile import ProgramCache
+from ..plan.compile import ProgramCache, plan_metrics
 from ..plan.nodes import PlanNode
 from ..utils import config
 from .admission import AdmissionController, AdmissionRejected
 from .microbatch import MicroBatcher, batch_key_for
 from .sessions import SessionRegistry, serving_metrics
+from .warmup import WarmupProfile
 
 _UNBOUNDED = float("inf")
 
@@ -73,34 +95,103 @@ class QueryTicket:
 
 
 class ServingScheduler:
-    """The priority queue (module doc). Bounded waits only: a closed or
-    repopulated queue is always noticed within one poll."""
+    """Per-tenant EDF queues under a DWRR cross-tenant selector (module
+    doc). Bounded waits only: a closed or repopulated queue is always
+    noticed within one poll."""
 
     _POLL_S = 0.05
+    # deficit floor: batching lets a tenant's mates ride early, charging
+    # its deficit negative; the floor bounds how much debt it can owe so
+    # one lucky mega-batch cannot lock a tenant out for long
+    _DEFICIT_FLOOR = -16.0
 
     def __init__(self):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: List[QueryTicket] = []
+        self._queues: Dict[str, List[QueryTicket]] = {}
+        self._deficit: Dict[str, float] = {}
+        self._rr: List[str] = []      # tenant round-robin order
+        self._rr_pos = 0
+        self._depth = 0
+        self._min_expiry = _UNBOUNDED  # earliest expiry of any queued ticket
         self._closed = False
+        self._expired_sink = None      # frontend fails swept tickets typed
         self.peak_depth = 0
+
+    def set_expired_sink(self, sink) -> None:
+        """``sink(ticket)`` is called (outside the scheduler lock) for
+        every ticket the push-time sweep sheds."""
+        self._expired_sink = sink
 
     def push(self, ticket: QueryTicket) -> None:
         with self._cv:
             if self._closed:
                 raise SchedulerClosed("serving scheduler is closed")
-            self._queue.append(ticket)
-            if len(self._queue) > self.peak_depth:
-                self.peak_depth = len(self._queue)
+            q = self._queues.get(ticket.tenant_id)
+            if q is None:
+                q = self._queues[ticket.tenant_id] = []
+                self._deficit.setdefault(ticket.tenant_id, 0.0)
+                self._rr.append(ticket.tenant_id)
+            q.append(ticket)
+            self._depth += 1
+            if ticket.expires_at < self._min_expiry:
+                self._min_expiry = ticket.expires_at
+            if self._depth > self.peak_depth:
+                self.peak_depth = self._depth
+            expired = self._sweep_expired_locked(time.monotonic())
             self._cv.notify_all()
+        self._report_expired(expired)
+
+    def _sweep_expired_locked(self, now: float) -> List[QueryTicket]:
+        """Shed every queued ticket whose deadline already passed — a
+        stalled lane must not let dead work hold queue depth against the
+        global and per-tenant admission bounds. O(1) when nothing can be
+        expired (the min-expiry watermark gates the scan)."""
+        if now < self._min_expiry:
+            return []
+        expired: List[QueryTicket] = []
+        new_min = _UNBOUNDED
+        for tid, q in self._queues.items():
+            live = []
+            for t in q:
+                if t.expires_at <= now:
+                    expired.append(t)
+                else:
+                    live.append(t)
+                    if t.expires_at < new_min:
+                        new_min = t.expires_at
+            if len(live) != len(q):
+                self._queues[tid] = live
+        self._depth -= len(expired)
+        self._min_expiry = new_min
+        return expired
+
+    def _report_expired(self, expired: List[QueryTicket]) -> None:
+        if not expired:
+            return
+        serving_metrics.inc("shed_expired", len(expired))
+        sink = self._expired_sink
+        if sink is not None:
+            for t in expired:
+                sink(t)
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return self._depth
+
+    def depth_of(self, tenant_id: str) -> int:
+        with self._lock:
+            return len(self._queues.get(tenant_id, ()))
+
+    def depths(self) -> Dict[str, int]:
+        """Per-tenant queued depth (admission's shedding input)."""
+        with self._lock:
+            return {tid: len(q) for tid, q in self._queues.items() if q}
 
     def close(self) -> None:
-        """Stop accepting; queued tickets still drain through pop_group
-        (window waits are skipped so the flush is prompt)."""
+        """Stop accepting; anything still queued is taken by pop_group
+        (window waits are skipped so the flush is prompt) or shed by
+        drain_remaining()."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
@@ -112,6 +203,57 @@ class ServingScheduler:
             aged -= int((now - t.enqueued_at) / age_step)
         return (max(0, aged), t.expires_at, t.seq)
 
+    def _weight_locked(self, tid: str, now: float, age_step: float) -> float:
+        """DWRR weight from the tenant's best aged class: 1/(1+class).
+        Aging walks a waiting tenant's weight toward 1.0, so weights are
+        starvation-proof by the same mechanism classes are."""
+        best = min(self._effective_key(t, now, age_step)[0]
+                   for t in self._queues[tid])
+        return 1.0 / (1.0 + best)
+
+    def _dwrr_pick_locked(self, now: float, age_step: float,
+                          commit: bool) -> Optional[str]:
+        """The tenant whose deficit crosses one dispatch credit first,
+        crediting weights in round-robin passes. ``commit=False``
+        simulates without mutating (window waits must not farm credits);
+        the commit call with the same ``now`` returns the same tenant."""
+        active = [tid for tid in self._rr if self._queues.get(tid)]
+        if not active:
+            return None
+        if len(active) == 1:
+            return active[0]
+        w = {tid: self._weight_locked(tid, now, age_step) for tid in active}
+        deficit = self._deficit if commit else dict(self._deficit)
+        pos = self._rr_pos
+        winner = None
+        # bounded credit loop: the heaviest weight >= 1/(1+maxclass), so
+        # a winner emerges within ~(1+maxclass) passes; 64 covers any
+        # sane priority range, the max() fallback covers the rest
+        for _ in range(64 * len(active)):
+            tid = active[pos % len(active)]
+            pos += 1
+            deficit[tid] = deficit.get(tid, 0.0) + w[tid]
+            if deficit[tid] >= 1.0:
+                winner = tid
+                break
+        if winner is None:
+            winner = max(active, key=lambda t: deficit.get(t, 0.0))
+        if commit:
+            self._rr_pos = pos
+        return winner
+
+    def _charge_locked(self, t: QueryTicket) -> None:
+        """Remove a dispatched ticket and charge its tenant one credit."""
+        self._queues[t.tenant_id].remove(t)
+        self._depth -= 1
+        self._deficit[t.tenant_id] = max(
+            self._DEFICIT_FLOOR, self._deficit.get(t.tenant_id, 0.0) - 1.0)
+        if not self._queues[t.tenant_id]:
+            # idle tenants bank nothing: classic DWRR anti-banking
+            self._deficit[t.tenant_id] = 0.0
+            del self._queues[t.tenant_id]
+            self._rr.remove(t.tenant_id)
+
     def pop_group(self, window_s: float,
                   max_batch: int) -> Optional[List[QueryTicket]]:
         """Block until a dispatch group is ready; None once closed AND
@@ -119,36 +261,64 @@ class ServingScheduler:
         age_step = float(config.get("serving.age_step_s"))
         with self._cv:
             while True:
-                if not self._queue:
+                if self._depth == 0:
                     if self._closed:
                         return None
                     self._cv.wait(timeout=self._POLL_S)
                     continue
                 now = time.monotonic()
-                head = min(self._queue,
+                tid = self._dwrr_pick_locked(now, age_step, commit=False)
+                head = min(self._queues[tid],
                            key=lambda t: self._effective_key(
                                t, now, age_step))
-                mates = sorted(
-                    (t for t in self._queue
-                     if t.batch_key == head.batch_key),
-                    key=lambda t: t.seq)[:max(1, max_batch)]
+                # contention-aware quantum: a batch occupies its lane for
+                # the whole service time, so while several tenants have
+                # queued work the group size IS every other tenant's
+                # head-of-line wait — cap it; a lone tenant still gets
+                # full-size batches (pure throughput, nobody is waiting)
+                cap = max_batch
+                if len(self._queues) > 1:
+                    fair_cap = int(config.get("serving.fair_batch_cap"))
+                    if fair_cap > 0:
+                        cap = min(cap, fair_cap)
+                cap = max(1, cap)
+                # the DWRR winner's head ALWAYS rides the group it earned;
+                # remaining seats go to same-key tickets in arrival order
+                # (cross-tenant — batching stays a throughput win). Filling
+                # all seats by global seq instead would hand the whole
+                # group to an overloaded tenant's earlier arrivals and
+                # silently un-win the DWRR pick: the victim tenant's head
+                # then waits a full extra service round per pop, which is
+                # exactly the well-behaved p99 inflation the soak measures.
+                others = sorted(
+                    (t for q in self._queues.values() for t in q
+                     if t.batch_key == head.batch_key and t is not head),
+                    key=lambda t: t.seq)
+                mates = sorted([head] + others[:cap - 1],
+                               key=lambda t: t.seq)
                 window_end = head.enqueued_at + max(0.0, window_s)
-                if (len(mates) < max_batch and not self._closed
+                if (len(mates) < cap and not self._closed
                         and now < window_end):
                     # wait out the rest of the batching window for
                     # mates — bounded, and re-evaluated on every arrival
                     self._cv.wait(
                         timeout=min(window_end - now, self._POLL_S))
                     continue
+                self._dwrr_pick_locked(now, age_step, commit=True)
                 for t in mates:
-                    self._queue.remove(t)
+                    self._charge_locked(t)
                 return mates
 
     def drain_remaining(self) -> List[QueryTicket]:
-        """Take everything (used only for forced teardown paths)."""
+        """Take everything (drain shedding and forced teardown paths)."""
         with self._cv:
-            out, self._queue = self._queue, []
-            return out
+            out = [t for q in self._queues.values() for t in q]
+            self._queues.clear()
+            self._rr.clear()
+            self._deficit.clear()
+            self._depth = 0
+            self._min_expiry = _UNBOUNDED
+            return sorted(out, key=lambda t: t.seq)
 
 
 class ServingFrontend:
@@ -175,6 +345,13 @@ class ServingFrontend:
             threading.Thread(target=self._dispatch_loop, args=(lane,),
                              name=f"serving-dispatch-{lane}", daemon=True)
             for lane in range(self._lanes)]
+        self.scheduler.set_expired_sink(self._expired_in_sweep)
+        # warmup: pre-pay profiled first-compiles before traffic arrives,
+        # on the constructing thread (no tenant is billed for these)
+        self.warmup = WarmupProfile()
+        profile_path = str(config.get("serving.warmup_profile") or "")
+        if profile_path:
+            WarmupProfile.load(profile_path).warm(self._batcher)
         self.registry.install_rmm_listener()
         for th in self._dispatchers:
             th.start()
@@ -206,7 +383,8 @@ class ServingFrontend:
             with self._state_lock:
                 draining = self._draining
             self.admission.admit(tenant_id, estimate,
-                                 self.scheduler.depth(), draining)
+                                 self.scheduler.depth(), draining,
+                                 tenant_depths=self.scheduler.depths())
             plan, bkey = batch_key_for(plan, table)
             seq = next(self._seq)
             if bkey is None:
@@ -223,9 +401,9 @@ class ServingFrontend:
                 # drain won the race after admission charged the slot:
                 # roll the charge back without touching outcome counters
                 self.registry.release(tenant_id, estimate, completed=None)
-                serving_metrics.inc("rejected")
-                self.registry.count(tenant_id, "rejected")
-                raise AdmissionRejected(
+                serving_metrics.inc_rejected("draining")
+                self.registry.count_rejection(tenant_id, "draining")
+                raise AdmissionRejected(  # srjt: noqa[SRJT017] the frontend is going away; no capacity will return
                     "draining", 0.0, tenant_id,
                     "serving frontend drained during submit") from None
             return ticket.future
@@ -241,6 +419,10 @@ class ServingFrontend:
                 return                      # closed and empty: lane done
             ready: List[QueryTicket] = []
             now = time.monotonic()
+            # feed admission's drain-rate / CoDel trackers with the
+            # dispatch-observed queue delay of the group head
+            self.admission.note_dispatch(
+                len(group), now - min(t.enqueued_at for t in group))
             for t in group:
                 if t.expires_at <= now:
                     # expired while queued: its budget is gone (queue
@@ -272,11 +454,23 @@ class ServingFrontend:
         group has mates), scatter outcomes."""
         total = sum(t.estimate_bytes for t in group) or 1
         shares = [(t.tenant_id, t.estimate_bytes / total) for t in group]
+        before = plan_metrics.snapshot()
         with self.registry.attributed(shares):
             outcomes = self._batcher.execute_group(
                 [t.plan for t in group],
                 [t.table for t in group],
                 [t.deadline_snap for t in group])
+        after = plan_metrics.snapshot()
+        # admission-priced compile misses: a first-compile this dispatch
+        # triggered is billed to the tenant whose query headed the group
+        # (the one that brought the never-seen plan/shape), not smeared
+        misses = after["plan_cache_misses"] - before["plan_cache_misses"]
+        if misses > 0:
+            compile_s = after["compile_s"] - before["compile_s"]
+            self.registry.charge_compile(group[0].tenant_id, misses,
+                                         compile_s)
+            serving_metrics.inc("compile_misses", misses)
+        self.warmup.note(group[0].plan, group[0].table, len(group))
         now = time.monotonic()
         for t, out in zip(group, outcomes):
             if out.error is not None:
@@ -287,6 +481,26 @@ class ServingFrontend:
                     self.registry.count(t.tenant_id, "faults_isolated")
                 self._finish(t, out.table, None,
                              missed=t.expires_at <= now)
+
+    def _expired_in_sweep(self, t: QueryTicket) -> None:
+        """Push-time sweep callback: a ticket whose deadline lapsed while
+        queued fails with the same typed error the pop-time check uses —
+        the sweep only changes WHEN dead work is noticed, not what its
+        caller sees."""
+        self._finish(t, None, watchdog.DeadlineExceededError(
+            f"serving:{t.tenant_id}", t.deadline_snap[0]), missed=True)
+
+    def _shed_ticket(self, t: QueryTicket, detail: str) -> None:
+        """Fail a queued-but-never-dispatched ticket with the typed
+        front-door rejection, rolling back its admission charge without
+        recording a completed/failed outcome (it never ran)."""
+        self.registry.release(t.tenant_id, t.estimate_bytes,
+                              completed=None)
+        serving_metrics.inc_rejected("draining")
+        self.registry.count_rejection(t.tenant_id, "draining")
+        if not t.future.done():
+            t.future.set_exception(AdmissionRejected(  # srjt: noqa[SRJT017] drain is terminal for this frontend; clients must fail over, not retry here
+                "draining", 0.0, t.tenant_id, detail))
 
     def _finish(self, t: QueryTicket, table: Optional[Table],
                 error: Optional[BaseException], missed: bool = False):
@@ -307,10 +521,14 @@ class ServingFrontend:
     # -- drain ---------------------------------------------------------------
 
     def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
-        """Graceful frontend drain: stop admission, flush the queue (no
-        window waits), join the lanes, drain the TaskExecutor, release
-        the RmmSpark listener. Idempotent; verdict mirrors the
-        executor's."""
+        """Graceful frontend drain: stop admission, SHED everything still
+        queued (module doc — under overload, running the backlog out
+        could outlast any drain budget; in-flight dispatches finish,
+        queued work gets the typed ``AdmissionRejected("draining")`` and
+        can be retried elsewhere), join the lanes, drain the
+        TaskExecutor, release the RmmSpark listener. Idempotent; verdict
+        mirrors the executor's — ``shed`` counts rejected queue entries
+        and does not affect ``clean``."""
         if timeout is None:
             timeout = float(config.get("drain.timeout_s"))
         with self._state_lock:
@@ -321,6 +539,15 @@ class ServingFrontend:
             self._draining = True
         self.scheduler.close()
         t0 = time.monotonic()
+        # shed the queue FIRST: lanes mid-pop race us harmlessly (a
+        # ticket is either taken by drain_remaining or dispatched, never
+        # both), and with the backlog gone the lanes exit within one
+        # group's execution time instead of running the whole queue out
+        shed = 0
+        for t in self.scheduler.drain_remaining():
+            shed += 1
+            self._shed_ticket(t, "serving frontend drained before "
+                                 "dispatch")
         lane_stragglers = 0
         for th in self._dispatchers:
             th.join(watchdog.derive_timeout(timeout))
@@ -329,21 +556,19 @@ class ServingFrontend:
         executor_verdict = (self._executor.drain(timeout=timeout)
                             if self._own_executor else None)
         self.registry.uninstall_rmm_listener()
-        # anything still queued had no lane left to run it (stragglers
-        # wedged): fail it with the same typed front-door error
-        orphaned = 0
+        # anything pushed between close-race windows had no lane left to
+        # run it: same typed front-door rejection
         for t in self.scheduler.drain_remaining():
-            orphaned += 1
-            self._finish(t, None, AdmissionRejected(
-                "draining", 0.0, t.tenant_id,
-                "serving frontend drained before dispatch"))
+            shed += 1
+            self._shed_ticket(t, "serving frontend drained before "
+                                 "dispatch")
         verdict = {
-            "clean": (lane_stragglers == 0 and orphaned == 0
+            "clean": (lane_stragglers == 0
                       and (executor_verdict is None
                            or executor_verdict["clean"])),
             "already_closed": False,
             "lane_stragglers": lane_stragglers,
-            "orphaned": orphaned,
+            "shed": shed,
             "executor": executor_verdict,
             "elapsed_s": round(time.monotonic() - t0, 3),
         }

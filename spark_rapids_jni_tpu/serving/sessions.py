@@ -46,9 +46,10 @@ class ServingMetrics:
     tests)."""
 
     _FIELDS = ("submitted", "admitted", "rejected", "completed", "failed",
-               "deadline_missed", "expired_in_queue", "dispatches",
-               "batches", "batched_queries", "solo_dispatches",
-               "batch_fault_replays", "overflow_replays")
+               "deadline_missed", "expired_in_queue", "shed_expired",
+               "dispatches", "batches", "batched_queries",
+               "solo_dispatches", "batch_fault_replays", "overflow_replays",
+               "compile_misses", "warmup_compiles")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -57,14 +58,29 @@ class ServingMetrics:
     def reset(self) -> None:
         with self._lock:
             self._c = {k: 0 for k in self._FIELDS}
+            self._reasons: Dict[str, int] = {}
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
             self._c[name] += by
 
+    def inc_rejected(self, reason: str, by: int = 1) -> None:
+        """Bump the global rejected counter AND its per-reason split —
+        every rejection carries a reason, so ``rejected`` always equals
+        the sum of ``rejected_by_reason`` values."""
+        with self._lock:
+            self._c["rejected"] += by
+            self._reasons[reason] = self._reasons.get(reason, 0) + by
+
+    def rejected_by_reason(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._reasons)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._c)
+            out = dict(self._c)
+            out["rejected_by_reason"] = dict(self._reasons)
+            return out
 
 
 serving_metrics = ServingMetrics()
@@ -86,6 +102,9 @@ class Tenant:
         self.hbm_observed_bytes = 0   # RmmSpark per-thread attribution
         self.hbm_peak_bytes = 0
         self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self.rejected_by_reason: Dict[str, int] = {}
+        self.compile_misses = 0       # first-compiles this tenant paid for
+        self.compile_s_charged = 0.0  # compile wall-seconds billed to it
 
 
 class SessionRegistry:
@@ -142,6 +161,30 @@ class SessionRegistry:
             if t is not None:
                 t.counters[field] += by
 
+    def count_rejection(self, tenant_id: str, reason: str,
+                        by: int = 1) -> None:
+        """Bump the tenant's rejected counter plus its per-reason split
+        (breaker/HBM/queue/deadline rejections stay attributable per
+        tenant — the soak bench's fairness verdict reads this)."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is None:
+                return
+            t.counters["rejected"] += by
+            t.rejected_by_reason[reason] = \
+                t.rejected_by_reason.get(reason, 0) + by
+
+    def charge_compile(self, tenant_id: str, misses: int,
+                       seconds: float) -> None:
+        """Bill a first-compile to the tenant whose query missed the
+        ProgramCache (admission-priced compile: the cold-start cost is
+        attributed, not smeared across whoever dispatches next)."""
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            if t is not None:
+                t.compile_misses += misses
+                t.compile_s_charged += seconds
+
     def try_admit(self, tenant_id: str, estimate_bytes: int) -> Optional[str]:
         """Atomically validate the tenant's limits and, on success, take
         an in-flight slot and charge ``estimate_bytes`` to the estimate
@@ -154,11 +197,15 @@ class SessionRegistry:
                 return "unknown_tenant"
             if t.max_in_flight > 0 and t.in_flight >= t.max_in_flight:
                 t.counters["rejected"] += 1
+                t.rejected_by_reason["tenant_in_flight"] = \
+                    t.rejected_by_reason.get("tenant_in_flight", 0) + 1
                 return "tenant_in_flight"
             if (t.hbm_budget_bytes > 0
                     and t.hbm_reserved_bytes + estimate_bytes
                     > t.hbm_budget_bytes):
                 t.counters["rejected"] += 1
+                t.rejected_by_reason["hbm_budget"] = \
+                    t.rejected_by_reason.get("hbm_budget", 0) + 1
                 return "hbm_budget"
             t.in_flight += 1
             t.hbm_reserved_bytes += estimate_bytes
@@ -187,7 +234,10 @@ class SessionRegistry:
             out.update(in_flight=t.in_flight,
                        hbm_reserved_bytes=t.hbm_reserved_bytes,
                        hbm_observed_bytes=t.hbm_observed_bytes,
-                       hbm_peak_bytes=t.hbm_peak_bytes)
+                       hbm_peak_bytes=t.hbm_peak_bytes,
+                       rejected_by_reason=dict(t.rejected_by_reason),
+                       compile_misses=t.compile_misses,
+                       compile_s_charged=round(t.compile_s_charged, 6))
             return out
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:

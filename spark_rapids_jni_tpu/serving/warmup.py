@@ -1,0 +1,280 @@
+"""Warmup pre-compilation from a persisted plan-frequency profile.
+
+First compiles are the serving tier's worst cold-start tail: a fused
+plan x shape x batch-bucket combination that has never been seen pays
+hundreds of milliseconds of XLA compilation inside its first query's
+budget. The profile closes the loop: a running frontend ``note()``-s
+every dispatched (plan, input signature, batch bucket) with its query
+count, ``save()`` persists the observed frequency table as JSON, and the
+next process ``load()``-s it and ``warm()``-s — replaying each recorded
+combination through the SAME MicroBatcher path live traffic takes, with
+synthesized all-zero tables of the recorded shape, so the ProgramCache
+key (plan fingerprint, padded shape signature, batch bucket) is
+IDENTICAL to the one real queries will hit. After warmup, the first real
+query of a profiled plan is a cache hit.
+
+What is profiled: linear plans (the batchable subset — exactly what
+``batch_key_for`` accepts) over plain fixed-width childless columns.
+Encoded (DICT32/RLE/FOR) and nested inputs are skipped — their cache
+keys depend on per-batch data (dictionary fingerprints, run structure)
+that zeros cannot reproduce, so a replay would warm the WRONG key.
+
+Compile cost attribution: warmup compiles count in
+``ServingMetrics.warmup_compiles``; live first-compiles that escape the
+profile are charged to the missing tenant by the frontend
+(``SessionRegistry.charge_compile``) — cold-start is always someone's
+bill, never ambient noise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar.column import Column, Table
+from ..columnar.encodings import encoding_cache_key
+from ..plan import expr as ex
+from ..plan.compile import plan_metrics
+from ..plan.nodes import (Filter, GroupBy, Limit, PlanError, PlanNode,
+                          Project, Scan, Sort, fingerprint, linearize)
+from ..utils.shapes import bucket_size
+from .sessions import serving_metrics
+
+PROFILE_VERSION = 1
+
+# profile size cap: the head of the frequency distribution is where the
+# warmup value is; a long tail of one-off shapes would just slow startup
+MAX_ENTRIES = 64
+
+
+# -- plan codec (linear plans only — the batchable subset) -------------------
+
+def _encode_expr(e: ex.Expr) -> Dict[str, Any]:
+    if isinstance(e, ex.Col):
+        return {"k": "col", "i": e.index}
+    if isinstance(e, ex.Lit):
+        if isinstance(e.value, bool):
+            return {"k": "lit", "t": "b", "v": int(e.value)}
+        if isinstance(e.value, str):
+            return {"k": "lit", "t": "s", "v": e.value}
+        return {"k": "lit", "t": "i", "v": int(e.value)}
+    if isinstance(e, ex.Cast64):
+        return {"k": "i64", "o": _encode_expr(e.operand)}
+    if isinstance(e, ex.Not):
+        return {"k": "not", "o": _encode_expr(e.operand)}
+    if isinstance(e, ex.BinOp):
+        return {"k": "bin", "op": e.op, "l": _encode_expr(e.left),
+                "r": _encode_expr(e.right)}
+    raise PlanError(f"unprofileable expression {e!r}")
+
+
+def _decode_expr(d: Dict[str, Any]) -> ex.Expr:
+    k = d["k"]
+    if k == "col":
+        return ex.Col(int(d["i"]))
+    if k == "lit":
+        if d["t"] == "b":
+            return ex.Lit(bool(d["v"]))
+        if d["t"] == "s":
+            return ex.Lit(str(d["v"]))
+        return ex.Lit(int(d["v"]))
+    if k == "i64":
+        return ex.Cast64(_decode_expr(d["o"]))
+    if k == "not":
+        return ex.Not(_decode_expr(d["o"]))
+    if k == "bin":
+        return ex.BinOp(d["op"], _decode_expr(d["l"]), _decode_expr(d["r"]))
+    raise PlanError(f"bad profile expression kind {k!r}")
+
+
+def _encode_plan(plan: PlanNode) -> List[Dict[str, Any]]:
+    """Scan-first node list; raises PlanError on DAG plans (they don't
+    batch, so they never reach the profile)."""
+    out: List[Dict[str, Any]] = []
+    for n in linearize(plan):
+        if isinstance(n, Scan):
+            out.append({"k": "scan", "ncols": n.ncols})
+        elif isinstance(n, Filter):
+            out.append({"k": "filter", "p": _encode_expr(n.predicate)})
+        elif isinstance(n, Project):
+            out.append({"k": "project",
+                        "es": [_encode_expr(e) for e in n.exprs]})
+        elif isinstance(n, GroupBy):
+            out.append({"k": "groupby", "keys": list(n.keys),
+                        "aggs": [[i, op] for i, op in n.aggs]})
+        elif isinstance(n, Sort):
+            out.append({"k": "sort", "keys": list(n.keys),
+                        "asc": (None if n.ascending is None
+                                else [int(a) for a in n.ascending]),
+                        "nf": (None if n.nulls_first is None
+                               else [int(f) for f in n.nulls_first])})
+        elif isinstance(n, Limit):
+            out.append({"k": "limit", "count": n.count})
+        else:
+            raise PlanError(f"unprofileable node {type(n).__name__}")
+    return out
+
+
+def _decode_plan(nodes: List[Dict[str, Any]]) -> PlanNode:
+    plan: Optional[PlanNode] = None
+    for d in nodes:
+        k = d["k"]
+        if k == "scan":
+            plan = Scan(int(d["ncols"]))
+        elif k == "filter":
+            plan = Filter(plan, _decode_expr(d["p"]))
+        elif k == "project":
+            plan = Project(plan, tuple(_decode_expr(e) for e in d["es"]))
+        elif k == "groupby":
+            plan = GroupBy(plan, tuple(d["keys"]),
+                           tuple((int(i), str(op)) for i, op in d["aggs"]))
+        elif k == "sort":
+            plan = Sort(plan, tuple(d["keys"]),
+                        None if d["asc"] is None
+                        else tuple(bool(a) for a in d["asc"]),
+                        None if d["nf"] is None
+                        else tuple(bool(f) for f in d["nf"]))
+        elif k == "limit":
+            plan = Limit(plan, int(d["count"]))
+        else:
+            raise PlanError(f"bad profile node kind {k!r}")
+    if plan is None:
+        raise PlanError("empty profile plan")
+    return plan
+
+
+# -- shape codec -------------------------------------------------------------
+
+def _col_specs(table: Table) -> Optional[List[List[Any]]]:
+    """Per-column [type id, scale, bucketed size, has validity] — or None
+    when the table is not profileable (encoded, nested, or data-less
+    columns: zeros cannot reproduce their cache key)."""
+    specs: List[List[Any]] = []
+    for c in table.columns:
+        if c.children or c.offsets is not None or c.data is None:
+            return None
+        if (not c.dtype.is_fixed_width
+                or c.dtype.id is dt.TypeId.DECIMAL128):
+            return None   # limb/offset-backed: zeros can't mimic the shape
+        if encoding_cache_key(c):
+            return None
+        specs.append([c.dtype.id.value,
+                      getattr(c.dtype, "scale", 0) or 0,
+                      bucket_size(table.num_rows),
+                      int(c.validity is not None)])
+    return specs if specs else None
+
+
+def _synth_table(specs: List[List[Any]]) -> Table:
+    """All-zero table matching the recorded shape signature exactly —
+    same dtype/scale/size/validity per column, so ``_shape_key`` (and
+    therefore the ProgramCache key) is identical to live traffic's."""
+    cols = []
+    for tid, scale, size, has_val in specs:
+        dtype = dt.DType(dt.TypeId(tid), scale)
+        data = jnp.zeros((size,), dtype=np.dtype(dtype.np_dtype))
+        val = jnp.ones((size,), dtype=jnp.bool_) if has_val else None
+        cols.append(Column(dtype, size, data=data, validity=val))
+    return Table(tuple(cols))
+
+
+# -- the profile -------------------------------------------------------------
+
+class WarmupProfile:
+    """Observed (plan, shape, batch bucket) frequency table with JSON
+    persistence and MicroBatcher replay."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict[str, Any]] = {}
+
+    def note(self, plan: PlanNode, table: Table, k: int) -> None:
+        """Record one dispatched group: the (already-resolved) plan, one
+        member's input table, and the group size. Unprofileable inputs
+        are silently skipped — the profile is best-effort."""
+        specs = _col_specs(table)
+        if specs is None:
+            return
+        try:
+            nodes = _encode_plan(plan)
+        except PlanError:
+            return
+        kb = 1 << (max(1, k) - 1).bit_length()
+        key = f"{fingerprint(plan)}|{specs}|{kb}"
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self._entries[key] = {"plan": nodes, "cols": specs,
+                                      "kb": kb, "count": k}
+            else:
+                ent["count"] += k
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Profile entries, hottest first."""
+        with self._lock:
+            ents = [dict(e) for e in self._entries.values()]
+        return sorted(ents, key=lambda e: -e["count"])[:MAX_ENTRIES]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def save(self, path: str) -> None:
+        payload = {"version": PROFILE_VERSION, "entries": self.entries()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "WarmupProfile":
+        """Load a persisted profile; a missing/corrupt/mismatched file
+        yields an EMPTY profile (warmup is an optimization, never a
+        startup failure)."""
+        prof = cls()
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return prof
+        if payload.get("version") != PROFILE_VERSION:
+            return prof
+        for ent in payload.get("entries", []):
+            try:
+                key = (f"{fingerprint(_decode_plan(ent['plan']))}"
+                       f"|{ent['cols']}|{int(ent['kb'])}")
+            except (PlanError, KeyError, TypeError, ValueError):
+                continue
+            prof._entries[key] = {"plan": ent["plan"], "cols": ent["cols"],
+                                  "kb": int(ent["kb"]),
+                                  "count": int(ent.get("count", 1))}
+        return prof
+
+    def warm(self, batcher) -> int:
+        """Replay every profiled combination through ``batcher``
+        (MicroBatcher), hottest first, compiling into its ProgramCache.
+        Returns the number of programs actually compiled (cache misses
+        paid now instead of by the first tenant); also counted in
+        ``ServingMetrics.warmup_compiles``."""
+        before = plan_metrics.snapshot()["plan_cache_misses"]
+        for ent in self.entries():
+            try:
+                plan = _decode_plan(ent["plan"])
+                tables = [_synth_table(ent["cols"])
+                          for _ in range(ent["kb"])]
+            except (PlanError, KeyError, TypeError, ValueError):
+                continue
+            plans = [plan] * len(tables)
+            outcomes = batcher.execute_group(plans, tables,
+                                             [None] * len(tables))
+            del outcomes   # warmup discards results; faults are isolated
+        compiled = plan_metrics.snapshot()["plan_cache_misses"] - before
+        if compiled > 0:
+            serving_metrics.inc("warmup_compiles", compiled)
+        return compiled

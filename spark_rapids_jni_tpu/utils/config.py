@@ -267,6 +267,13 @@ _register("breaker.cooldown_s", "SRJT_BREAKER_COOLDOWN_S", 5.0, float,
           "time an open breaker waits before going half-open and "
           "admitting one probe (probe success closes it, failure re-opens "
           "with a fresh cooldown)")
+_register("breaker.retry_jitter", "SRJT_BREAKER_RETRY_JITTER", True,
+          _parse_bool,
+          "decorrelated jitter on an open breaker's retry_after_s hints: "
+          "each hint is drawn from [remaining cooldown, 3x the previous "
+          "hint] so shed clients retry staggered instead of stampeding "
+          "the half-open probe in lockstep; off = deterministic "
+          "cooldown remainder")
 _register("drain.timeout_s", "SRJT_DRAIN_TIMEOUT_S", 30.0, float,
           "deadline for TaskExecutor.drain(): stop admission, run "
           "in-flight tasks to completion, flush+fsync the SpillStore, "
@@ -280,6 +287,13 @@ _register("serving.batch_window_ms", "SRJT_SERVING_BATCH_WINDOW_MS", 4.0,
 _register("serving.max_batch", "SRJT_SERVING_MAX_BATCH", 16, int,
           "max queries fused into one batched plan program; a full batch "
           "dispatches immediately without waiting out the window")
+_register("serving.fair_batch_cap", "SRJT_SERVING_FAIR_BATCH_CAP", 4, int,
+          "group-size cap while MORE THAN ONE tenant has queued work: a "
+          "batch occupies a dispatch lane for its whole service time, so "
+          "under contention the batch quantum is also every other "
+          "tenant's head-of-line wait — full-size batches are a "
+          "single-tenant throughput win, small quanta are a multi-tenant "
+          "latency floor (0 disables the cap; bounded below by 1)")
 _register("serving.max_queue_depth", "SRJT_SERVING_MAX_QUEUE_DEPTH", 1024,
           int,
           "global admission bound on queued-but-undispatched queries; "
@@ -306,6 +320,35 @@ _register("serving.default_priority", "SRJT_SERVING_DEFAULT_PRIORITY", 2,
           int,
           "priority assigned to tenants that do not specify one "
           "(0 = most urgent; larger is more deferrable)")
+_register("serving.tenant_queue_budget", "SRJT_SERVING_TENANT_QUEUE_BUDGET",
+          128, int,
+          "per-tenant budget on queued-but-undispatched queries: beyond "
+          "it a tenant's submits are shed with AdmissionRejected"
+          "('tenant_queue_budget') while other tenants keep admitting — "
+          "one hot tenant cannot occupy the whole global queue "
+          "(0 disables the per-tenant bound)")
+_register("serving.codel_target_ms", "SRJT_SERVING_CODEL_TARGET_MS", 50.0,
+          float,
+          "CoDel-style queue-delay target for adaptive shedding: while "
+          "dispatch-observed queue delay stays above this target for a "
+          "full serving.codel_interval_ms, admission sheds the newest "
+          "work of the most-over-budget tenant (0 disables)")
+_register("serving.codel_interval_ms", "SRJT_SERVING_CODEL_INTERVAL_MS",
+          500.0, float,
+          "how long measured queue delay must continuously exceed "
+          "serving.codel_target_ms before adaptive shedding engages "
+          "(one good dispatch resets the clock)")
+_register("serving.retry_after_cap_s", "SRJT_SERVING_RETRY_AFTER_CAP_S",
+          30.0, float,
+          "upper clamp on drain-rate-priced retry_after_s hints so a "
+          "momentarily stalled drain rate cannot tell clients to go "
+          "away for hours")
+_register("serving.warmup_profile", "SRJT_SERVING_WARMUP_PROFILE", "", str,
+          "path to a persisted plan-frequency profile (serving/warmup.py):"
+          " when set and present, a new ServingFrontend pre-compiles the "
+          "profile's hottest (plan, shape, batch-size) programs before "
+          "its dispatch lanes open, so first-query tenants are not "
+          "charged cold-compile latency; '' disables")
 _register("serving.sharded_devices", "SRJT_SERVING_SHARDED_DEVICES", 0, int,
           "GSPMD mesh width for batched dispatches (0/1 = off): the "
           "micro-batcher stages each stacked slice's row axis across this "

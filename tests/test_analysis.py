@@ -958,6 +958,85 @@ def test_srjt016_sanctioned_sites_are_baselined():
 
 
 # ---------------------------------------------------------------------------
+# SRJT017 — AdmissionRejected without a retry-after hint
+# ---------------------------------------------------------------------------
+
+SRC_017_ZERO = """
+    def admit(tenant):
+        raise AdmissionRejected("queue_full", 0.0, tenant,
+                                "queue is full")
+"""
+
+SRC_017_MISSING = """
+    def admit(tenant):
+        raise AdmissionRejected("queue_full")
+"""
+
+
+def test_srjt017_constant_zero_hint_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt017
+    fs = run(SRC_017_ZERO, path="pkg/serving/admission.py",
+             rules=[rule_srjt017])
+    assert rules_of(fs) == {"SRJT017"}
+    assert "retry_after_s" in fs[0].message
+
+
+def test_srjt017_missing_hint_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt017
+    fs = run(SRC_017_MISSING, path="pkg/serving/admission.py",
+             rules=[rule_srjt017])
+    assert rules_of(fs) == {"SRJT017"}
+    # keyword-zero is the same offence as positional-zero
+    src = """
+        def admit(tenant):
+            raise AdmissionRejected("queue_full", retry_after_s=0,
+                                    tenant_id=tenant)
+    """
+    fs = run(src, path="pkg/serving/admission.py", rules=[rule_srjt017])
+    assert rules_of(fs) == {"SRJT017"}
+
+
+def test_srjt017_priced_hint_passes():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt017
+    for arg in ("hint", "self._priced_hint(depth)", "max(base, 0.1)",
+                "0.5"):
+        src = SRC_017_ZERO.replace("0.0", arg)
+        assert run(src, path="pkg/serving/admission.py",
+                   rules=[rule_srjt017]) == [], arg
+
+
+def test_srjt017_noqa_with_reason_sanctions_zero():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt017
+    src = SRC_017_ZERO.replace(
+        'raise AdmissionRejected("queue_full", 0.0, tenant,',
+        'raise AdmissionRejected(  # srjt: noqa[SRJT017] resource gone\n'
+        '            "queue_full", 0.0, tenant,')
+    assert run(src, path="pkg/serving/admission.py",
+               rules=[rule_srjt017]) == []
+
+
+def test_srjt017_package_zero_hint_sites_all_sanctioned():
+    # every real zero-hint raise carries its noqa: the whole package is
+    # clean under the rule with no baseline entries needed
+    import os
+    from spark_rapids_jni_tpu.analysis.core import analyze_source
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt017
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "spark_rapids_jni_tpu")
+    flagged = []
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path) as f:
+                src = f.read()
+            flagged += analyze_source(src, path, CTX,
+                                      rules=[rule_srjt017])
+    assert flagged == [], [(f.path, f.line) for f in flagged]
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -977,7 +1056,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 16
+    assert len(FILE_RULES) == 17
 
 
 def test_syntax_error_is_reported_not_raised():

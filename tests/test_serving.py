@@ -368,8 +368,341 @@ def test_clean_drain_mid_load():
     done = sum(1 for f in futs if f.done())
     assert done == len(futs)    # every admitted query resolved, none lost
     m = serving_metrics.snapshot()
-    assert m["completed"] + m["failed"] == len(futs)
+    # drain SHEDS the backlog instead of running it out: every admitted
+    # query either completed (it was in flight) or was rejected with the
+    # typed draining error — nothing failed, nothing vanished
     assert m["failed"] == 0
+    assert m["completed"] + v["shed"] == len(futs)
+    assert m["rejected_by_reason"].get("draining", 0) == v["shed"]
+    shed_errs = [f.exception() for f in futs if f.exception() is not None]
+    assert len(shed_errs) == v["shed"]
+    for e in shed_errs:
+        assert isinstance(e, AdmissionRejected) and e.reason == "draining"
+
+
+# -- DWRR fair queuing across tenants -----------------------------------------
+
+
+def _tenant_ticket(seq, tenant, priority, enqueued_at, expires_at=None):
+    snap = None if expires_at is None else (30.0, expires_at, None, "t")
+    from concurrent.futures import Future
+    return QueryTicket(seq=seq, tenant_id=tenant, plan=None, table=None,
+                       batch_key=("k", seq), priority=priority,
+                       enqueued_at=enqueued_at, deadline_snap=snap,
+                       estimate_bytes=1, future=Future())
+
+
+def test_dwrr_hot_tenant_cannot_starve_cold_tenant():
+    """20-deep hot backlog, 5 cold arrivals behind it: with equal
+    weights the cold tenant dispatches every other pop — its queries all
+    clear within the first 10 dispatches instead of waiting out the hot
+    queue."""
+    s = ServingScheduler()
+    now = time.monotonic()
+    for i in range(20):
+        s.push(_tenant_ticket(i, "hot", 0, now))
+    cold = []
+    for i in range(5):
+        t = _tenant_ticket(100 + i, "cold", 0, now)
+        cold.append(t.seq)
+        s.push(t)
+    first10 = [s.pop_group(0.0, 1)[0].seq for _ in range(10)]
+    assert set(cold) <= set(first10), first10
+
+
+def test_dwrr_weights_follow_priority():
+    """A class-0 tenant earns credits 4x as fast as a class-3 tenant, so
+    it dominates early dispatches — but the low class still dispatches
+    (deficit accrual is starvation-proof even before aging kicks in)."""
+    s = ServingScheduler()
+    now = time.monotonic()
+    with config.override("serving.age_step_s", 3600.0):  # isolate weights
+        for i in range(8):
+            s.push(_tenant_ticket(i, "gold", 0, now))
+            s.push(_tenant_ticket(100 + i, "bronze", 3, now))
+        first8 = [s.pop_group(0.0, 1)[0].tenant_id for _ in range(8)]
+    gold = first8.count("gold")
+    assert gold >= 5, first8            # ~4:1 credit rate
+    assert "bronze" in first8, first8   # never fully locked out
+
+
+def test_dwrr_within_tenant_order_is_still_aged_edf():
+    """Cross-tenant DWRR does not disturb within-tenant ordering: one
+    tenant's tickets still pop tightest-deadline-first."""
+    s = ServingScheduler()
+    now = time.monotonic()
+    s.push(_tenant_ticket(0, "a", 2, now, expires_at=now + 60))
+    s.push(_tenant_ticket(1, "a", 2, now, expires_at=now + 5))
+    s.push(_tenant_ticket(2, "a", 2, now, expires_at=now + 30))
+    order = [s.pop_group(0.0, 1)[0].seq for _ in range(3)]
+    assert order == [1, 2, 0]
+
+
+def _shared_key_ticket(seq, tenant, priority, enqueued_at):
+    from concurrent.futures import Future
+    return QueryTicket(seq=seq, tenant_id=tenant, plan=None, table=None,
+                       batch_key=("shared",), priority=priority,
+                       enqueued_at=enqueued_at, deadline_snap=None,
+                       estimate_bytes=1, future=Future())
+
+
+def test_dwrr_winner_head_always_rides_its_group():
+    """The DWRR winner's head ticket is IN the dispatched group even
+    when an overloaded tenant holds a deep backlog of earlier-seq
+    same-key tickets. Filling every seat by global arrival order would
+    hand the whole group to the hot tenant and silently un-win the
+    pick — the victim's head would wait a full extra service round per
+    pop (the well-behaved p99 inflation the soak harness measures)."""
+    s = ServingScheduler()
+    now = time.monotonic()
+    with config.override("serving.age_step_s", 3600.0):  # isolate weights
+        for i in range(20):
+            s.push(_shared_key_ticket(i, "hot", 2, now))
+        s.push(_shared_key_ticket(100, "victim", 0, now))
+        group = s.pop_group(0.0, 4)
+    seqs = [t.seq for t in group]
+    assert 100 in seqs, seqs            # the winner's head rides
+    assert len(group) == 4, seqs        # remaining seats: earliest mates
+    assert seqs == sorted(seqs)         # dispatch order stays by arrival
+
+
+def test_fair_batch_cap_bounds_group_under_contention():
+    """While several tenants have queued work the group size is every
+    other tenant's head-of-line wait, so it is capped at
+    serving.fair_batch_cap; a lone tenant still batches to max_batch
+    (nobody is waiting — pure throughput), and cap 0 disables."""
+    now = time.monotonic()
+    s = ServingScheduler()
+    for i in range(10):
+        s.push(_shared_key_ticket(i, "a", 2, now))
+        s.push(_shared_key_ticket(100 + i, "b", 2, now))
+    assert len(s.pop_group(0.0, 16)) == 4       # contended: capped
+    with config.override("serving.fair_batch_cap", 0):
+        assert len(s.pop_group(0.0, 16)) == 16  # cap disabled: full
+    solo = ServingScheduler()
+    for i in range(10):
+        solo.push(_shared_key_ticket(i, "only", 2, now))
+    assert len(solo.pop_group(0.0, 16)) == 10   # lone tenant: uncapped
+
+
+def test_push_sweeps_expired_entries():
+    """A ticket whose deadline lapsed while queued is shed by the NEXT
+    push (counted as shed_expired, reported to the sink) — dead work
+    cannot hold queue depth against the admission limits."""
+    s = ServingScheduler()
+    swept = []
+    s.set_expired_sink(swept.append)
+    now = time.monotonic()
+    s.push(_tenant_ticket(0, "a", 2, now, expires_at=now + 0.02))
+    assert s.depth() == 1
+    time.sleep(0.05)
+    s.push(_tenant_ticket(1, "a", 2, time.monotonic(),
+                          expires_at=time.monotonic() + 60))
+    assert s.depth() == 1               # the expired one is gone
+    assert [t.seq for t in swept] == [0]
+    assert serving_metrics.snapshot()["shed_expired"] == 1
+    assert s.pop_group(0.0, 1)[0].seq == 1
+
+
+# -- adaptive shedding ---------------------------------------------------------
+
+
+def test_admission_tenant_queue_budget():
+    ctrl = AdmissionController(_registry())
+    with config.override("serving.tenant_queue_budget", 2):
+        ctrl.admit("t0", 1, queue_depth=0, tenant_depths={"t0": 1})
+        with pytest.raises(AdmissionRejected) as ei:
+            ctrl.admit("t0", 1, queue_depth=0, tenant_depths={"t0": 2})
+    assert ei.value.reason == "tenant_queue_budget"
+    assert ei.value.retry_after_s > 0
+    # without tenant_depths (direct callers) the check does not arm
+    with config.override("serving.tenant_queue_budget", 2):
+        ctrl.admit("t0", 1, queue_depth=50)
+
+
+def test_codel_sheds_most_over_budget_tenant_only():
+    reg = _registry()
+    reg.register_tenant("light")
+    ctrl = AdmissionController(reg)
+    depths = {"t0": 6, "light": 1}
+    with config.override("serving.codel_target_ms", 10.0), \
+            config.override("serving.codel_interval_ms", 30.0):
+        # queue delay persistently above target -> overloaded
+        ctrl.note_dispatch(1, 0.5)
+        time.sleep(0.05)
+        ctrl.note_dispatch(1, 0.5)
+        assert ctrl.is_overloaded()
+        with pytest.raises(AdmissionRejected) as ei:
+            ctrl.admit("t0", 1, queue_depth=0, tenant_depths=depths)
+        assert ei.value.reason == "queue_delay"
+        assert ei.value.retry_after_s > 0
+        # the light tenant is NOT shed while the hot one is over budget
+        ctrl.admit("light", 1, queue_depth=0, tenant_depths=depths)
+        # delay back under target -> overload clears immediately
+        ctrl.note_dispatch(1, 0.0)
+        assert not ctrl.is_overloaded()
+        ctrl.admit("t0", 1, queue_depth=0, tenant_depths=depths)
+
+
+def test_retry_after_priced_from_drain_rate():
+    """The queue_full hint scales with the backlog the client saw over
+    the measured drain rate — deeper queue, longer hint."""
+    ctrl = AdmissionController(_registry())
+    ctrl.note_dispatch(50, 0.0)         # ~10 queries/s measured
+    with config.override("serving.max_queue_depth", 4):
+        with pytest.raises(AdmissionRejected) as shallow:
+            ctrl.admit("t0", 1, queue_depth=4)
+        with pytest.raises(AdmissionRejected) as deep:
+            ctrl.admit("t0", 1, queue_depth=104)
+    assert shallow.value.reason == deep.value.reason == "queue_full"
+    assert deep.value.retry_after_s > shallow.value.retry_after_s
+    assert deep.value.retry_after_s <= float(
+        config.get("serving.retry_after_cap_s"))
+    # per-tenant + per-reason attribution of both rejections
+    by_reason = ctrl._registry.stats_of("t0")["rejected_by_reason"]
+    assert by_reason.get("queue_full") == 2
+    assert serving_metrics.snapshot()["rejected_by_reason"][
+        "queue_full"] == 2
+
+
+def test_breaker_retry_hints_decorrelated():
+    """Two concurrent rejections against one OPEN breaker get DISTINCT
+    nonzero retry hints (decorrelated jitter): shed clients retry
+    staggered instead of stampeding the half-open probe slot."""
+    import threading as th
+    br = breaker.get_breaker("jitter_surface")
+    with config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 5.0):
+        br.record_failure()
+        assert br.state() == breaker.OPEN
+        hints, barrier = [], th.Barrier(2)
+
+        def grab():
+            barrier.wait()
+            hints.append(br.retry_after_s())
+
+        threads = [th.Thread(target=grab) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(hints) == 2
+    assert all(h > 0 for h in hints), hints
+    assert hints[0] != hints[1], hints
+    assert all(h <= 2 * 5.0 + 0.001 for h in hints), hints
+    # jitter off: the hint is the bare deterministic cooldown remainder
+    with config.override("breaker.retry_jitter", False):
+        a, b = br.retry_after_s(), br.retry_after_s()
+    assert abs(a - b) < 0.05
+
+
+# -- drain under overload ------------------------------------------------------
+
+
+def test_drain_under_overload_is_bounded_typed_and_leak_free():
+    """drain() invoked while ~5x-capacity load is queued: completes
+    within its budget (it SHEDS the backlog rather than running it out),
+    every queued query fails with the typed AdmissionRejected
+    ("draining"), and the executor reports no leaked tasks."""
+    plans = [PLAN_FILTER, PLAN_GROUPBY, PLAN_SORTLIM]
+    tables = [make_table(400 + 97 * i, 200 + i) for i in range(36)]
+    with config.override("serving.batch_window_ms", 40.0), \
+            config.override("serving.tenant_queue_budget", 0):
+        fe = ServingFrontend()
+        for name in ("a", "b", "c"):
+            fe.register_tenant(name)
+        futs = []
+        for i, t in enumerate(tables):
+            try:
+                futs.append(fe.submit(("a", "b", "c")[i % 3],
+                                      plans[i % 3], t, budget_s=60.0))
+            except AdmissionRejected:
+                pass
+        t0 = time.monotonic()
+        v = fe.drain(timeout=30.0)
+        elapsed = time.monotonic() - t0
+    assert elapsed < 30.0, elapsed      # bounded, not backlog-sized
+    assert v["clean"], v
+    assert v["executor"] is not None and v["executor"]["clean"]
+    assert all(f.done() for f in futs)  # nothing lost, nothing leaked
+    shed = 0
+    for f in futs:
+        e = f.exception()
+        if e is not None:
+            assert isinstance(e, AdmissionRejected), e
+            assert e.reason == "draining"
+            assert e.retry_after_s == 0.0
+            shed += 1
+    assert shed == v["shed"]
+    assert shed > 0                     # the overload was actually shed
+    m = serving_metrics.snapshot()
+    assert m["rejected_by_reason"].get("draining", 0) == shed
+    # in-flight work at drain time still completed normally
+    assert m["completed"] == len(futs) - shed
+    assert m["failed"] == 0
+
+
+# -- warmup pre-compilation ----------------------------------------------------
+
+
+def test_warmup_profile_roundtrip_and_prewarm(tmp_path):
+    """note -> save -> load -> warm: a fresh ProgramCache pre-compiled
+    from the profile serves the SAME live traffic without a single
+    compile miss."""
+    from spark_rapids_jni_tpu.plan.compile import ProgramCache, plan_metrics
+    from spark_rapids_jni_tpu.serving import WarmupProfile
+    tables = [make_table(900, 300 + s) for s in range(4)]
+    plan, _ = batch_key_for(PLAN_GROUPBY, tables[0])
+    prof = WarmupProfile()
+    prof.note(plan, tables[0], k=len(tables))
+    prof.note(plan, tables[0], k=len(tables))   # frequency accumulates
+    path = str(tmp_path / "warmup.json")
+    prof.save(path)
+    loaded = WarmupProfile.load(path)
+    assert len(loaded) == 1
+    assert loaded.entries()[0]["count"] == 2 * len(tables)
+
+    cold = MicroBatcher(ProgramCache())
+    compiled = loaded.warm(cold)
+    assert compiled > 0
+    assert serving_metrics.snapshot()["warmup_compiles"] == compiled
+    before = plan_metrics.snapshot()["plan_cache_misses"]
+    outs = cold.execute_group([plan] * len(tables), tables,
+                              [None] * len(tables))
+    assert all(o.error is None for o in outs)
+    assert plan_metrics.snapshot()["plan_cache_misses"] == before
+
+
+def test_warmup_load_missing_or_corrupt_is_empty(tmp_path):
+    from spark_rapids_jni_tpu.serving import WarmupProfile
+    assert len(WarmupProfile.load(str(tmp_path / "absent.json"))) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert len(WarmupProfile.load(str(bad))) == 0
+
+
+def test_compile_miss_charged_to_missing_tenant():
+    """The tenant whose query forces a first-compile pays for it in its
+    own stats; pre-compiled plans charge nobody."""
+    # a plan shape no other test compiles: unique literal + column mix
+    plan = Filter(Scan(2), ex.BinOp("lt",
+                                    ex.BinOp("add", ex.Col(0), ex.Col(1)),
+                                    ex.Lit(977)))
+    table = make_table(777, 400)
+    with config.override("serving.batch_window_ms", 1.0), \
+            ServingFrontend() as fe:
+        fe.register_tenant("payer")
+        fe.register_tenant("rider")
+        fe.submit("payer", plan, table, budget_s=60.0).result(timeout=120)
+        payer = fe.registry.stats_of("payer")
+        assert payer["compile_misses"] >= 1
+        assert payer["compile_s_charged"] > 0
+        # same plan/shape again from another tenant: cache hit, no charge
+        fe.submit("rider", plan, make_table(777, 401),
+                  budget_s=60.0).result(timeout=120)
+        rider = fe.registry.stats_of("rider")
+        assert rider["compile_misses"] == 0
+    assert serving_metrics.snapshot()["compile_misses"] >= 1
 
 
 # -- fault isolation ----------------------------------------------------------
