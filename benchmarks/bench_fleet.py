@@ -1,0 +1,445 @@
+"""Fleet soak harness: sustained load + replica-kill storm over the
+multi-process serving fleet (serving/fleet.py).
+
+Extends the single-host soak (benchmarks/bench_serving.py) to the
+routed fleet — N replica processes on one machine behind the
+router/supervisor — and emits the ``FLEET_rNN.json`` artifact with a
+combined throughput + fairness + robustness verdict:
+
+1. **1x baseline** — the soak's tenant population at fleet-scale rates
+   (one machine, N replicas): per-tenant p50/p99 reference.
+2. **Nx overload** — the hot tenant multiplies its offered rate. The
+   binding checks: sustained fleet QPS >= ``--qps-target`` (default 4x
+   the committed single-host SOAK_r01.json sustained rate) with the
+   pooled well-behaved p99 within 3x of baseline — same fairness
+   criterion, now enforced THROUGH the router's global admission.
+3. **replica-kill storm** — the Nx overload continues while >= 2 of the
+   N replicas are SIGKILLed mid-stage (fleet.kill_replica, the
+   sanctioned chaos hook). The verdict demands zero lost queries (every
+   admitted future resolves: completed or typed-rejected — requeue, not
+   loss), zero untyped failures for any tenant (a replica death must
+   not propagate across tenants riding other replicas or survive
+   requeue as an error), and the fleet back at full width afterwards
+   (respawn + re-warm + probe).
+
+Run::
+
+    JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
+        --replicas 4 --stage-seconds 60 --multiplier 5 --out auto
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from benchmarks.bench_serving import (_fixtures, _pct, _warm,
+                                      next_artifact_path)
+
+
+def _log(msg: str) -> None:
+    """Stage progress on stderr (stdout carries the artifact JSON; the
+    Makefile redirects stdout to /dev/null, so this is what CI sees)."""
+    print(f"[bench_fleet +{time.monotonic() - _T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+# Fleet-scale tenant population: same shape as the single-host soak
+# (three identical well-behaved tenants + one hot tenant), rates scaled
+# to the fleet bar — baseline offered load sits just under the ~4x
+# single-host capacity the fleet must sustain.
+WELL_BEHAVED = (
+    ("interactive", 0, 60.0),
+    ("analytics", 2, 60.0),
+    ("background", 4, 60.0),
+)
+HOT = ("hot", 2, 700.0)
+
+PLAN_MIX = (0.7, 0.2, 0.1)
+FUTURE_TIMEOUT_S = 180.0
+# the committed single-host reference: SOAK_r01.json sustained_qps
+SINGLE_HOST_QPS = 237.8
+
+
+def _tenant_storm(fleet, name, rate_qps, stop_at, plans, tables, seed,
+                  budget_s, out, lock):
+    """Open-loop Poisson arrivals against the fleet router; classifies
+    every future, including the two robustness buckets the single-host
+    storm has no use for: ``crash_failed`` (typed replica-crash error
+    after the requeue budget) and ``lost`` (a future that neither
+    completed nor resolved typed — the kill stage's binding zero)."""
+    from spark_rapids_jni_tpu.faultinj.sandbox import WorkerCrashError
+    from spark_rapids_jni_tpu.faultinj.watchdog import DeadlineExceededError
+    from spark_rapids_jni_tpu.serving import AdmissionRejected
+
+    rng = np.random.default_rng(seed)
+    lat_ms: List[float] = []
+    futs = []
+    rejected: Dict[str, int] = {}
+    offered = 0
+    # Schedule-driven open loop: arrivals ride a cumulative Poisson
+    # clock and the generator sleeps only when AHEAD of it, bursting to
+    # catch up when behind. Sleeping per arrival instead would add the
+    # timer slack (~0.1-1ms) to every gap, capping one thread's offered
+    # rate near 1/slack regardless of the requested rate — the harness
+    # would quietly under-offer and the "sustained" number would
+    # measure the generator, not the fleet.
+    next_t = time.monotonic() + float(rng.exponential(1.0 / rate_qps))
+    while True:
+        now = time.monotonic()
+        if now >= stop_at:
+            break
+        if next_t > now:
+            time.sleep(min(next_t - now, stop_at - now))
+            if time.monotonic() >= stop_at:
+                break
+        next_t += float(rng.exponential(1.0 / rate_qps))
+        offered += 1
+        plan = plans[int(rng.choice(len(plans), p=PLAN_MIX))]
+        t0 = time.monotonic()
+        try:
+            fut = fleet.submit(name, plan, tables[offered % len(tables)],
+                               budget_s=budget_s)
+        except AdmissionRejected as e:
+            rejected[e.reason] = rejected.get(e.reason, 0) + 1
+            continue
+        fut.add_done_callback(
+            lambda _f, t0=t0: lat_ms.append(
+                (time.monotonic() - t0) * 1000.0))
+        futs.append(fut)
+
+    completed = deadline_missed = shed = crash_failed = failed = lost = 0
+    for f in futs:
+        try:
+            f.result(timeout=FUTURE_TIMEOUT_S)
+            completed += 1
+        except DeadlineExceededError:
+            deadline_missed += 1
+        except AdmissionRejected:
+            shed += 1
+        except WorkerCrashError:
+            crash_failed += 1
+        except TimeoutError:
+            lost += 1       # neither completed nor typed-rejected
+        except Exception:
+            failed += 1
+    with lock:
+        out[name] = {
+            "offered": offered,
+            "admitted": len(futs),
+            "completed": completed,
+            "deadline_missed": deadline_missed,
+            "shed_in_drain": shed,
+            "crash_failed": crash_failed,
+            "failed": failed,
+            "lost": lost,
+            "rejected_at_submit": rejected,
+            "lat_ms": lat_ms,
+        }
+
+
+def _kill_controller(fleet, kills: int, stop_at: float,
+                     record: Dict[str, Any]) -> None:
+    """Kill ``kills`` distinct live replicas, spaced across the first
+    two thirds of the stage, so the storm rides both the degraded fleet
+    and (usually) the re-warmed respawn."""
+    killed = []
+    window = max(1.0, (stop_at - time.monotonic()) * 0.66)
+    spacing = window / max(1, kills)
+    for _ in range(kills):
+        time.sleep(spacing)
+        if time.monotonic() >= stop_at:
+            break
+        live = [h.idx for h in fleet.live_handles()]
+        target = next((i for i in live if i not in killed),
+                      live[0] if live else None)
+        if target is None:
+            break
+        if fleet.kill_replica(target):
+            killed.append(target)
+            record.setdefault("killed", []).append(
+                {"replica": target,
+                 "t_s": round(time.monotonic() - record["t0"], 1),
+                 "width_before": len(live)})
+    record["kills_done"] = len(killed)
+
+
+def _run_stage(fleet, plans, tables, duration_s: float, multiplier: float,
+               seed: int, budget_s: float = 30.0,
+               kills: int = 0) -> Dict[str, Any]:
+    """One storm stage against a LIVE fleet (stages share the fleet —
+    unlike the single-host soak the router and its replica caches are
+    long-lived; counters are delta'd per stage)."""
+    tenants = list(WELL_BEHAVED) + [
+        (HOT[0], HOT[1], HOT[2] * multiplier)]
+    counters_before = dict(fleet.stats()["counters"])
+    out: Dict[str, Dict[str, Any]] = {}
+    lock = threading.Lock()
+    kill_record: Dict[str, Any] = {"t0": time.monotonic(), "kills_done": 0}
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        t0 = time.monotonic()
+        stop_at = t0 + duration_s
+        kill_record["t0"] = t0
+        threads = [
+            threading.Thread(
+                target=_tenant_storm,
+                args=(fleet, name, rate, stop_at, plans, tables,
+                      seed * 7919 + i, budget_s, out, lock),
+                name=f"fleet-storm-{name}", daemon=True)
+            for i, (name, _prio, rate) in enumerate(tenants)]
+        if kills > 0:
+            threads.append(threading.Thread(
+                target=_kill_controller,
+                args=(fleet, kills, stop_at, kill_record),
+                name="fleet-kill-controller", daemon=True))
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        elapsed = time.monotonic() - t0
+    finally:
+        gc.enable()
+        gc.unfreeze()
+        gc.collect()
+
+    rows = []
+    for name, prio, rate in tenants:
+        t = out[name]
+        rows.append({
+            "tenant": name,
+            "priority": prio,
+            "offered_qps": round(t["offered"] / elapsed, 1),
+            "qps": round(t["completed"] / elapsed, 1),
+            "offered": t["offered"],
+            "admitted": t["admitted"],
+            "completed": t["completed"],
+            "deadline_missed": t["deadline_missed"],
+            "crash_failed": t["crash_failed"],
+            "failed": t["failed"],
+            "lost": t["lost"],
+            "shed_in_drain": t["shed_in_drain"],
+            "rejected_at_submit": t["rejected_at_submit"],
+            "p50_ms": _pct(t["lat_ms"], 50),
+            "p95_ms": _pct(t["lat_ms"], 95),
+            "p99_ms": _pct(t["lat_ms"], 99),
+        })
+    counters_after = dict(fleet.stats()["counters"])
+    wb_names = {name for name, _p, _r in WELL_BEHAVED}
+    pooled = [ms for name in out if name in wb_names
+              for ms in out[name]["lat_ms"]]
+    stage: Dict[str, Any] = {
+        "multiplier": multiplier,
+        "duration_s": round(elapsed, 1),
+        "budget_s": budget_s,
+        "offered_qps": round(sum(r["offered"] for r in rows) / elapsed, 1),
+        "sustained_qps": round(
+            sum(r["completed"] for r in rows) / elapsed, 1),
+        "well_behaved_p50_ms": _pct(pooled, 50),
+        "well_behaved_p99_ms": _pct(pooled, 99),
+        "lost": sum(r["lost"] for r in rows),
+        "crash_failed": sum(r["crash_failed"] for r in rows),
+        "failed": sum(r["failed"] for r in rows),
+        "fleet_counters_delta": {
+            k: counters_after.get(k, 0) - counters_before.get(k, 0)
+            for k in counters_after},
+        "width_after": fleet.width(),
+        "tenants": rows,
+    }
+    if kills > 0:
+        stage["kill_storm"] = kill_record
+    return stage
+
+
+def _await_full_width(fleet, timeout_s: float) -> Dict[str, Any]:
+    """Post-kill recovery: wait for respawn + re-warm + probe to restore
+    every replica (the breaker's cooldown and the warm replay both spend
+    real time — recovery is measured, not assumed)."""
+    t0 = time.monotonic()
+    full = fleet.stats()["full_width"]
+    while time.monotonic() - t0 < timeout_s:
+        if fleet.width() == full:
+            return {"recovered": True,
+                    "recovery_s": round(time.monotonic() - t0, 1),
+                    "width": fleet.width()}
+        time.sleep(0.5)
+    return {"recovered": False,
+            "recovery_s": round(time.monotonic() - t0, 1),
+            "width": fleet.width()}
+
+
+def run_fleet_soak(replicas: int = 4, stage_s: float = 60.0,
+                   multiplier: float = 5.0, kills: int = 2,
+                   seed: int = 0,
+                   qps_target: float = 4.0 * SINGLE_HOST_QPS,
+                   recovery_timeout_s: float = 300.0) -> Dict[str, Any]:
+    """The full fleet soak: build + warm the fleet, 1x baseline ->
+    Nx overload -> replica-kill storm under Nx -> recovery wait ->
+    drain. Returns the FLEET artifact dict."""
+    from spark_rapids_jni_tpu.serving.fleet import ServingFleet
+    from spark_rapids_jni_tpu.utils import config
+
+    import os
+    plans, tables = _fixtures()
+    result: Dict[str, Any] = {
+        "harness": "benchmarks/bench_fleet.py",
+        # the qps target assumes >= `replicas` cores; on a smaller host
+        # the fleet processes time-share and sustained QPS is bounded by
+        # total per-query CPU, not by replica count
+        "host_cpus": os.cpu_count(),
+        "replicas": replicas,
+        "stage_seconds": stage_s,
+        "multiplier": multiplier,
+        "kills": kills,
+        "seed": seed,
+        "qps_target": round(qps_target, 1),
+        "single_host_qps_reference": SINGLE_HOST_QPS,
+    }
+    t_start = time.monotonic()
+    overrides = [
+        config.override("fleet.replicas", replicas),
+    ]
+    fleet = None
+    try:
+        for ov in overrides:
+            ov.__enter__()
+        # pre-pay the compile space ONCE in this process: the persistent
+        # XLA cache (compile.cache_dir) turns every replica's broadcast
+        # warm into disk loads — N replicas compiling the same programs
+        # concurrently on one host would serialize N full compile passes
+        t_warm = time.monotonic()
+        _log("pre-warming compile cache in-process...")
+        _warm(plans, tables)
+        result["prewarm_s"] = round(time.monotonic() - t_warm, 1)
+        _log(f"pre-warm done in {result['prewarm_s']}s; "
+             f"spawning {replicas} replicas...")
+        fleet = ServingFleet(replicas=replicas)
+        for name, prio, _rate in list(WELL_BEHAVED) + [HOT]:
+            # generous caps: under overload the binding shedder is the
+            # router's global per-tenant in-flight ledger
+            fleet.register_tenant(name, priority=prio, max_in_flight=2048)
+        t_warm = time.monotonic()
+        _log("broadcasting fleet warm...")
+        fleet.warm(plans, tables)
+        result["warm_s"] = round(time.monotonic() - t_warm, 1)
+        _log(f"fleet warm done in {result['warm_s']}s; baseline stage...")
+        result["baseline_1x"] = _run_stage(
+            fleet, plans, tables, stage_s, 1.0, seed)
+        _log(f"baseline: offered {result['baseline_1x']['offered_qps']} "
+             f"sustained {result['baseline_1x']['sustained_qps']} qps; "
+             f"overload stage...")
+        result["overload"] = _run_stage(
+            fleet, plans, tables, stage_s, multiplier, seed + 1)
+        _log(f"overload: offered {result['overload']['offered_qps']} "
+             f"sustained {result['overload']['sustained_qps']} qps; "
+             f"kill stage...")
+        result["replica_kill"] = _run_stage(
+            fleet, plans, tables, stage_s, multiplier, seed + 2,
+            kills=kills)
+        _log(f"kill stage: sustained "
+             f"{result['replica_kill']['sustained_qps']} qps, lost "
+             f"{result['replica_kill']['lost']}, width "
+             f"{result['replica_kill']['width_after']}; recovery wait...")
+        result["recovery"] = _await_full_width(fleet, recovery_timeout_s)
+        _log(f"recovery: {result['recovery']}")
+        result["fleet_stats"] = {
+            k: v for k, v in fleet.stats().items()
+            if k in ("width", "full_width", "counters")}
+    finally:
+        if fleet is not None:
+            result["drain"] = {
+                k: v for k, v in fleet.drain().items()
+                if k in ("clean", "shed", "replica_stragglers",
+                         "elapsed_s")}
+        for ov in reversed(overrides):
+            ov.__exit__(None, None, None)
+    result["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    result["verdict"] = _verdict(result)
+    return result
+
+
+def _verdict(result: Dict[str, Any]) -> Dict[str, Any]:
+    """Computed, not asserted — the artifact records what held."""
+    from spark_rapids_jni_tpu.utils import config
+
+    base = result["baseline_1x"]
+    over = result["overload"]
+    kill = result["replica_kill"]
+    floor_ms = float(config.get("serving.batch_window_ms"))
+    pooled_ratio = round(
+        over["well_behaved_p99_ms"]
+        / max(base["well_behaved_p99_ms"], floor_ms), 2)
+    delta = kill["fleet_counters_delta"]
+    verdict = {
+        "sustained_qps": over["sustained_qps"],
+        "qps_target": result["qps_target"],
+        "sustained_qps_over_target": (
+            over["sustained_qps"] >= result["qps_target"]),
+        "pooled_well_behaved_p99_ratio": pooled_ratio,
+        "well_behaved_p99_within_3x": pooled_ratio <= 3.0,
+        "kill_replicas_killed": kill.get("kill_storm", {}).get(
+            "kills_done", 0),
+        "kill_replica_deaths_observed": delta.get("replica_deaths", 0),
+        "kill_requeued": delta.get("requeued", 0),
+        "kill_zero_lost": kill["lost"] == 0,
+        "kill_zero_untyped_failures": (kill["crash_failed"] == 0
+                                       and kill["failed"] == 0),
+        "recovered_to_full_width": result["recovery"]["recovered"],
+        "recovery_s": result["recovery"]["recovery_s"],
+    }
+    verdict["ok"] = all((
+        verdict["sustained_qps_over_target"],
+        verdict["well_behaved_p99_within_3x"],
+        verdict["kill_replicas_killed"] >= 2,
+        verdict["kill_zero_lost"],
+        verdict["kill_zero_untyped_failures"],
+        verdict["recovered_to_full_width"],
+    ))
+    return verdict
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving-fleet soak + replica-kill harness")
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--stage-seconds", type=float, default=60.0)
+    ap.add_argument("--multiplier", type=float, default=5.0)
+    ap.add_argument("--kills", type=int, default=2,
+                    help="replicas to SIGKILL during the kill stage")
+    ap.add_argument("--qps-target", type=float,
+                    default=4.0 * SINGLE_HOST_QPS)
+    ap.add_argument("--recovery-timeout", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="write the FLEET artifact JSON here "
+                         "('auto' = next free FLEET_rNN.json)")
+    args = ap.parse_args(argv)
+
+    res = run_fleet_soak(
+        replicas=args.replicas, stage_s=args.stage_seconds,
+        multiplier=args.multiplier, kills=args.kills, seed=args.seed,
+        qps_target=args.qps_target,
+        recovery_timeout_s=args.recovery_timeout)
+    blob = json.dumps(res, indent=2, sort_keys=False)
+    out = (next_artifact_path("FLEET") if args.out == "auto" else args.out)
+    if out:
+        with open(out, "w") as f:
+            f.write(blob + "\n")
+        print(f"fleet artifact -> {out}", file=sys.stderr)
+    print(blob)
+    return 0 if res["verdict"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -474,6 +474,18 @@ def row_extra(result: Dict[str, Any]) -> Dict[str, Any]:
     return extra
 
 
+def next_artifact_path(prefix: str, directory: str = ".") -> str:
+    """First free ``<prefix>_rNN.json`` (the BENCH_rNN/MULTICHIP_rNN
+    convention): committed reference rounds are never overwritten —
+    ``--out auto`` appends a fresh round instead."""
+    n = 1
+    while True:
+        path = os.path.join(directory, f"{prefix}_r{n:02d}.json")
+        if not os.path.exists(path):
+            return path
+        n += 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="serving-tier sustained-load soak harness")
@@ -487,17 +499,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the fault-storm-under-load stage")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="",
-                    help="write the SOAK artifact JSON here")
+                    help="write the SOAK artifact JSON here "
+                         "('auto' = next free SOAK_rNN.json)")
     args = ap.parse_args(argv)
 
     res = run_soak(stage_s=args.stage_seconds, multiplier=args.multiplier,
                    chaos=not args.no_chaos, chaos_s=args.chaos_seconds,
                    seed=args.seed)
     blob = json.dumps(res, indent=2, sort_keys=False)
-    if args.out:
-        with open(args.out, "w") as f:
+    out = (next_artifact_path("SOAK") if args.out == "auto" else args.out)
+    if out:
+        with open(out, "w") as f:
             f.write(blob + "\n")
-        print(f"soak artifact -> {args.out}", file=sys.stderr)
+        print(f"soak artifact -> {out}", file=sys.stderr)
     print(blob)
     return 0 if res["fairness"]["ok"] else 1
 

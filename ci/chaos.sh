@@ -131,5 +131,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m benchmarks.bench_serving \
 
 # stage 10 — exception-fault storms over the whole chaos-marked suite
 # (transient/poison/exhausted domains, exactly-once pipeline results)
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+
+# stage 11 — replica-kill storm on the serving FLEET: N replica processes
+# behind the router/supervisor (serving/fleet.py) with SIGKILLs landing
+# mid-overload (benchmarks/bench_fleet.py --kills). Pass criteria are the
+# harness's own exit code: zero lost queries (every query either completes
+# or is rejected TYPED — a kill orphans tickets onto survivors via the
+# requeue budget, it never drops them), zero untyped failures (no
+# WorkerCrashError ever reaches a caller), zero cross-tenant propagation,
+# and the fleet respawned back to full width before the run ends. The
+# outer `timeout` is part of the contract — if death detection, requeue,
+# or breaker-gated respawn ever wedges, the kill fails the lane loudly.
+# `make fleet` runs the long-form (60s stages) version and writes the
+# FLEET_rNN.json artifact; this stage is the short CI-budget cut.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
+    --stage-seconds 12 --kills 2 --qps-target 0 > /dev/null

@@ -1426,6 +1426,76 @@ def rule_srjt017(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT018: fleet IPC carries the Deadline; process kills stay in fleet.py
+# ---------------------------------------------------------------------------
+# The serving fleet (serving/fleet.py) is the only place the engine is
+# allowed to end a process on purpose, and every query it forwards must
+# carry the caller's Deadline snapshot so replica-side queue time burns
+# the same budget (docs/STATIC_ANALYSIS.md). Two clauses:
+#   (a) in serving/, a dict-literal IPC payload with ``"op": "submit"``
+#       must also carry a ``"snap"`` key — a fleet submit without the
+#       Deadline snapshot silently unbounds the replica's work;
+#   (b) ``os.kill(...)`` / ``<proc>.kill()`` / ``<proc>.terminate()``
+#       anywhere outside serving/fleet.py is raw process control that
+#       bypasses the supervisor's death bookkeeping (the sandbox's
+#       pre-existing kill sites are baselined with reasons).
+
+_SRJT018_KILL_ATTRS = ("kill", "terminate")
+
+
+def _srjt018_dict_keys(node: ast.Dict):
+    keys = {}
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys[k.value] = v
+    return keys
+
+
+def rule_srjt018(tree, rel, lines, ctx) -> List[Finding]:
+    findings = []
+    in_fleet = rel.endswith("serving/fleet.py") or rel == "fleet.py"
+    in_serving = "/serving/" in "/" + rel
+    for node, anc in _walk_stack(tree):
+        # clause (a): fleet IPC submit payloads carry the snapshot
+        if in_serving and isinstance(node, ast.Dict):
+            keys = _srjt018_dict_keys(node)
+            op = keys.get("op")
+            if (op is not None and isinstance(op, ast.Constant)
+                    and op.value == "submit" and "snap" not in keys):
+                findings.append(Finding(
+                    "SRJT018", rel, node.lineno,
+                    "fleet IPC submit payload without a \"snap\" key — "
+                    "every routed query must carry the caller's "
+                    "Deadline.snapshot_wire() so replica queue time "
+                    "burns the same budget (faultinj/watchdog.py); an "
+                    "unbounded replica dispatch is invisible to the "
+                    "router's stall machinery"))
+            continue
+        # clause (b): raw process kills outside the fleet supervisor
+        if not isinstance(node, ast.Call) or in_fleet:
+            continue
+        dn = _dotted(node.func)
+        hit = None
+        if dn == "os.kill":
+            hit = "os.kill(...)"
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _SRJT018_KILL_ATTRS):
+            recv = _dotted(node.func.value)
+            if recv is not None and "proc" in recv.split(".")[-1].lower():
+                hit = f"{recv}.{node.func.attr}()"
+        if hit is not None:
+            findings.append(Finding(
+                "SRJT018", rel, node.lineno,
+                f"raw process control `{hit}` outside serving/fleet.py — "
+                f"killing a worker without the fleet supervisor (or the "
+                f"sandbox's baselined kill sites) bypasses death "
+                f"classification, requeue, and breaker bookkeeping; route "
+                f"chaos through ServingFleet.kill_replica and lifecycle "
+                f"through drain()"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 
@@ -1433,7 +1503,7 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
-              rule_srjt015, rule_srjt016, rule_srjt017)
+              rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -86,8 +86,10 @@ __all__ = [
     "injected_delay",
     "last_bundles",
     "remaining",
+    "replica_id",
     "reset",
     "set_lost_handler",
+    "set_replica_id",
 ]
 
 
@@ -176,6 +178,24 @@ class Deadline:
         assert self.expires_at is not None, "snapshot() before __enter__"
         return (self.budget_s, self.expires_at, self.token, self.what)
 
+    @classmethod
+    def adopt_wire(cls, snap: Tuple[float, float, str]) -> "Deadline":
+        """Rebuild from ``snapshot_wire()`` received from another process.
+        The absolute expiry survives the hop (``time.monotonic`` is
+        CLOCK_MONOTONIC, system-wide on Linux) so router queue time counts
+        against the replica's budget; the cancel token cannot cross a
+        process boundary, so the adopted deadline gets a fresh one."""
+        budget, expires_at, what = snap
+        dl = cls(budget, what)
+        dl.expires_at = expires_at
+        return dl
+
+    def snapshot_wire(self) -> Tuple[float, float, str]:
+        """Picklable snapshot for cross-process propagation (fleet IPC):
+        ``(budget_s, expires_at, what)`` — everything but the token."""
+        assert self.expires_at is not None, "snapshot_wire() before __enter__"
+        return (self.budget_s, self.expires_at, self.what)
+
     def __enter__(self) -> "Deadline":
         if self.expires_at is None:  # adopt() arrives pre-armed
             self.expires_at = time.monotonic() + self.budget_s
@@ -209,6 +229,22 @@ class Deadline:
 
 def current_deadline() -> Optional[Deadline]:
     return getattr(_tls, "deadline", None)
+
+
+# -- process identity (fleet mode) -------------------------------------------
+
+_replica_id: Optional[str] = None
+
+
+def set_replica_id(rid: Optional[str]) -> None:
+    """Tag this process as fleet replica ``rid`` (None to clear) — stall
+    diagnostics bundles become attributable to a replica."""
+    global _replica_id
+    _replica_id = None if rid is None else str(rid)
+
+
+def replica_id() -> Optional[str]:
+    return _replica_id
 
 
 def remaining() -> Optional[float]:
@@ -340,9 +376,11 @@ def last_bundles() -> List[Dict[str, Any]]:
 def reset() -> None:
     """Test hook: drop in-flight records and captured bundles (the watchdog
     thread itself is left running; it idles on an empty registry)."""
+    global _replica_id
     with _lock:
         _inflight.clear()
         _bundles.clear()
+    _replica_id = None
 
 
 def _ensure_thread() -> None:
@@ -436,6 +474,8 @@ def _capture_bundle(rec: _Inflight) -> None:
         "thread": rec.thread_name,
         "budget_s": rec.deadline.budget_s,
         "inflight_s": round(time.monotonic() - rec.t_start, 4),
+        "pid": os.getpid(),
+        "replica_id": _replica_id,
     }
     try:
         frames = sys._current_frames()

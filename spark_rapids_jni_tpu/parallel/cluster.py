@@ -101,3 +101,40 @@ def process_info() -> dict:
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def rendezvous_pick(key: str, members: Sequence, weights=None):
+    """Weighted rendezvous (highest-random-weight) placement: pick one of
+    ``members`` for ``key`` such that (a) the same key always lands on
+    the same member while the member set and weights hold, (b) removing a
+    member only re-places the keys it owned (minimal disruption — the
+    property the serving fleet's affinity router needs: a replica death
+    must not reshuffle every tenant's compiled-program cache), and (c)
+    keys distribute proportionally to ``weights``.
+
+    Uses the exponential-race form: member i's score for ``key`` is
+    ``-ln(u_i) / w_i`` with ``u_i`` a blake2b-derived uniform in (0, 1),
+    and the MINIMUM score wins — the minimum of Exp(w_i) variables picks
+    i with probability w_i / Σw. Deterministic (hash-seeded), no shared
+    state, O(members) per pick.
+    """
+    import hashlib
+    import math
+
+    if not members:
+        return None
+    if weights is None:
+        weights = [1.0] * len(members)
+    best = None
+    best_score = float("inf")
+    for m, w in zip(members, weights):
+        digest = hashlib.blake2b(f"{key}\x00{m}".encode(),
+                                 digest_size=8).digest()
+        # map the 64-bit hash into the OPEN interval (0, 1): never 0
+        # (log blows up) and never 1 (score would tie at exactly 0)
+        u = (int.from_bytes(digest, "big") + 1) / (2.0 ** 64 + 2)
+        score = -math.log(u) / max(float(w), 1e-9)
+        if score < best_score:
+            best_score = score
+            best = m
+    return best

@@ -338,7 +338,24 @@ def canonical_repr(plan: PlanNode) -> str:
     return canonical_repr(plan.child) + ">" + _node_repr(plan)
 
 
+# Identity memo: serving resubmits the same long-lived (frozen,
+# immutable) plan objects thousands of times per second, and the router
+# fingerprints every submit for affinity routing. Values hold a strong
+# ref to the plan so an id() cannot be recycled while its entry lives;
+# the crude clear-on-full keeps the worst case bounded without an LRU
+# chain on the hot path.
+_FP_CACHE: dict = {}
+_FP_CACHE_MAX = 512
+
+
 def fingerprint(plan: PlanNode) -> str:
     """sha1 hex of the canonical plan structure; the compile-cache key
     component that is stable across processes and datasets."""
-    return hashlib.sha1(canonical_repr(plan).encode()).hexdigest()
+    hit = _FP_CACHE.get(id(plan))
+    if hit is not None and hit[0] is plan:
+        return hit[1]
+    fp = hashlib.sha1(canonical_repr(plan).encode()).hexdigest()
+    if len(_FP_CACHE) >= _FP_CACHE_MAX:
+        _FP_CACHE.clear()
+    _FP_CACHE[id(plan)] = (plan, fp)
+    return fp

@@ -14,10 +14,14 @@ _LAZY = {
     "AdmissionController": ".admission",
     "AdmissionRejected": ".admission",
     "PLAN_SURFACE": ".admission",
+    "FleetTicket": ".fleet",
     "MemberOutcome": ".microbatch",
     "MicroBatcher": ".microbatch",
     "batch_key_for": ".microbatch",
     "QueryTicket": ".scheduler",
+    "ReplicaHandle": ".fleet",
+    "ReplicaServer": ".replica",
+    "ServingFleet": ".fleet",
     "SchedulerClosed": ".scheduler",
     "ServingFrontend": ".scheduler",
     "ServingScheduler": ".scheduler",

@@ -1,0 +1,814 @@
+"""Serving fleet: a supervised router over N replica processes.
+
+One ServingFrontend per process was the serving tier's shape through
+round 15 — both the capacity ceiling and a single point of failure.
+This module scales it OUT on one machine the way the reference stack
+splits cluster control from per-executor acceleration: a router/
+supervisor (this file) in the caller's process, N replica workers
+(serving/replica.py, each a full admission -> scheduler -> microbatch
+stack) behind sandbox-style pipe pairs.
+
+Routing is **cache-affine**: queries hash by (tenant, plan fingerprint)
+under weighted rendezvous (parallel/cluster.rendezvous_pick), so every
+recurring (plan, shape) compiles on exactly one replica and stays hot
+there; a replica death re-places only the keys it owned. Routing
+weights come from the telemetry each reply piggybacks (queue depth,
+drain rate): a slow-but-alive replica sheds load to its peers before it
+stalls, in coarse buckets so measurement noise cannot churn affinity.
+
+Admission is **two-level**: the router charges per-tenant budgets
+globally (its own SessionRegistry) BEFORE any bytes cross a pipe, with
+``retry_after_s`` priced from the fleet's minimum live drain rate (the
+conservative quote: the slowest replica is where a retry may land);
+each replica then applies its own local admission unchanged.
+
+Robustness is the headline — the supervisor closes the same loop for
+replica loss that guard.py closes for device loss:
+
+  * death is detected by severed pipe + exitcode (the faultinj/
+    sandbox.py verdict), classified into the CRASH fault domain
+    (WorkerCrashError, guard.metrics "crash_detected");
+  * the dead replica's in-flight tickets REQUEUE onto survivors against
+    ``fleet.requeue_budget`` — a query is only failed when its budget
+    is spent, and then with the typed crash error;
+  * the dead replica leaves the rendezvous member set (its keys re-place
+    minimally) and respawns under exponential backoff behind a
+    per-replica circuit breaker (faultinj/breaker.py) — a replica that
+    keeps dying stops being respawned until its breaker's cooldown;
+  * width degrades N -> N/2 -> 1 -> in-process fallback exactly like
+    the sharded-plan mesh ladder (plan/sharded_executor.py): when every
+    replica is down the router runs queries on a lazily-built local
+    ServingFrontend rather than failing them.
+
+``drain()`` stops router admission first, then sends each replica the
+drain sentinel (its frontend sheds queued work typed, finishes
+in-flight groups, answers everything, exits 0), then joins processes.
+
+Config: ``fleet.replicas``, ``fleet.requeue_budget``,
+``fleet.respawn_backoff_s``, ``fleet.submit_timeout_s``,
+``fleet.max_in_flight``, ``fleet.telemetry_period_s``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ..faultinj import breaker, watchdog
+from ..faultinj.guard import metrics as fault_metrics
+from ..faultinj.sandbox import WorkerCrashError
+from ..parallel.cluster import rendezvous_pick
+from ..utils import config
+from .admission import AdmissionRejected
+from .microbatch import batch_key_for
+from .replica import (table_to_wire, wire_to_error, wire_to_table)
+from .sessions import SessionRegistry
+
+__all__ = ["FleetTicket", "ReplicaHandle", "ServingFleet"]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+# routing-weight quantization: depth buckets this coarse keep affinity
+# stable under sample noise while still shedding from a backed-up replica
+_DEPTH_BUCKET = 16
+
+
+class _Ctrl:
+    """In-flight control op (register/warm/stats probe)."""
+
+    kind = "ctrl"
+    __slots__ = ("future",)
+
+    def __init__(self):
+        self.future: Future = Future()
+
+
+class FleetTicket:
+    """One globally-admitted query riding the fleet. The wire-encoded
+    table is kept (not the device table) so a requeue after replica
+    death re-sends without re-encoding."""
+
+    kind = "query"
+    __slots__ = ("tenant_id", "plan", "fp", "wire_table", "snap",
+                 "estimate", "key", "future", "attempts", "enqueued_at")
+
+    def __init__(self, tenant_id, plan, fp, wire_table, snap, estimate,
+                 key):
+        self.tenant_id = tenant_id
+        self.plan = plan
+        self.fp = fp        # plan fingerprint; None for solo (unbatchable)
+        self.wire_table = wire_table
+        self.snap = snap
+        self.estimate = estimate
+        self.key = key
+        self.future: Future = Future()
+        self.attempts = 0
+        self.enqueued_at = time.monotonic()
+
+
+class ReplicaHandle:
+    """One supervised replica process: spawn, correlate replies, detect
+    death (sandbox.py verdict), carry routing telemetry + breaker."""
+
+    def __init__(self, fleet: "ServingFleet", idx: int):
+        self.fleet = fleet
+        self.idx = idx
+        self.name = f"fleet_replica_{idx}"
+        self.breaker = breaker.get_breaker(self.name)
+        self.lock = threading.Lock()   # guards proc/tx/pending/live
+        # serializes writers on the pipe ONLY — never held with
+        # self.lock, and never needed by the reader thread, so a send
+        # blocked on a full pipe cannot deadlock the reply path that
+        # would drain it (router reader <-> replica reply triangle)
+        self.send_lock = threading.Lock()
+        self.proc: Optional[subprocess.Popen] = None
+        self.tx = None
+        self.rx = None
+        self.pending: Dict[int, Any] = {}
+        # plan fingerprints this replica PROCESS has been sent the plan
+        # body for (plan interning: recurring plans cross the pipe once,
+        # later submits carry only the fingerprint). Swapped for a fresh
+        # set in spawn(); mutated only under send_lock so the pipe's
+        # FIFO order guarantees the body-carrying frame lands first.
+        self.sent_fps: set = set()
+        self.telemetry: Dict[str, Any] = {"drain_rate": 0.0, "depth": 0}
+        self.live = False
+        self.closing = False
+        self.deaths = 0                # consecutive: backoff exponent
+        self.next_attempt_at = 0.0
+        self._epoch = 0                # invalidates stale reader threads
+
+    # -- lifecycle -------------------------------------------------------
+
+    def spawn(self) -> None:
+        """Start the worker (sandbox.py pattern: pipe pair + pass_fds,
+        JAX_PLATFORMS=cpu, repo on PYTHONPATH) and its reader thread."""
+        from multiprocessing.connection import Connection
+        req_r, req_w = os.pipe()
+        rsp_r, rsp_w = os.pipe()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "spark_rapids_jni_tpu.serving.replica",
+                 str(req_r), str(rsp_w), str(self.idx)],
+                pass_fds=(req_r, rsp_w), env=env, cwd=_REPO_ROOT)
+        finally:
+            os.close(req_r)
+            os.close(rsp_w)
+        with self.lock:
+            self.proc = proc
+            self.tx = Connection(req_w, readable=False)
+            self.rx = Connection(rsp_r, writable=False)
+            self.sent_fps = set()   # new process knows no plans yet
+            self._epoch += 1
+            epoch = self._epoch
+        threading.Thread(target=self._read_loop,
+                         args=(self.rx, epoch),
+                         name=f"{self.name}-reader", daemon=True).start()
+
+    def post(self, msg: Dict[str, Any], entry=None,
+             plan_fp: Optional[str] = None, plan=None) -> bool:
+        """Register ``entry`` under a fresh reply id and send. False when
+        the pipe is already severed (caller re-routes; the reader thread
+        owns the death verdict).
+
+        The send happens OUTSIDE ``self.lock``: a full pipe blocks the
+        sender until the replica drains it, and the replica can only
+        drain if its replies are being read — which needs the reader
+        thread, which needs ``self.lock`` to pop pending entries.
+        Holding the handle lock across the send closes that triangle
+        into a fleet-wide seizure.
+
+        ``plan_fp``/``plan`` intern the plan body: the first frame for a
+        fingerprint carries the plan, later frames only the fingerprint
+        (the replica keeps ``{fp: plan}``). The check-and-mark happens
+        under ``send_lock`` so no fingerprint-only frame can overtake
+        the body-carrying frame on the FIFO pipe."""
+        with self.lock:
+            tx = self.tx
+            sent_fps = self.sent_fps
+            if tx is None:
+                return False
+            rid = self.fleet._next_rid()
+            msg = dict(msg)
+            msg["id"] = rid
+            if entry is not None:
+                self.pending[rid] = entry
+        try:
+            with self.send_lock:
+                if plan_fp is not None and plan_fp not in sent_fps:
+                    msg["plan"] = plan
+                    sent_fps.add(plan_fp)
+                tx.send(msg)
+        # TypeError/AttributeError: teardown() can null the Connection's
+        # handle between its closed-check and the write (the severed-pipe
+        # race is a death signal here, same as OSError)
+        except (OSError, ValueError, TypeError, AttributeError):
+            if entry is None:
+                return False
+            with self.lock:
+                owned = self.pending.pop(rid, None) is not None
+            # not owned => the death sweep already requeued the entry;
+            # reporting False would double-dispatch it
+            return not owned
+        return True
+
+    def _read_loop(self, rx, epoch: int) -> None:
+        while True:
+            try:
+                entries, telemetry = rx.recv()
+            except (EOFError, OSError):
+                break
+            except Exception:
+                break
+            if telemetry:
+                self.telemetry = telemetry
+            for rid, ok, payload in entries:
+                with self.lock:
+                    entry = self.pending.pop(rid, None)
+                if entry is not None:
+                    self.fleet._resolve(self, entry, ok, payload)
+        with self.lock:
+            stale = epoch != self._epoch
+            closing = self.closing
+        if not stale and not closing:
+            self.fleet._on_replica_death(self)
+
+    def death_verdict(self) -> WorkerCrashError:
+        """sandbox.py's verdict: wait briefly so the error carries the
+        real signal/exitcode instead of 'pipe severed'."""
+        rc = None
+        proc = self.proc
+        if proc is not None:
+            try:
+                rc = proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                rc = proc.poll()
+        signum = -rc if rc is not None and rc < 0 else None
+        detail = (f"killed by signal {signum}" if signum is not None
+                  else f"exit code {rc}" if rc is not None
+                  else "pipe severed")
+        return WorkerCrashError(self.name, detail,
+                                signum=signum, exitcode=rc)
+
+    def teardown(self) -> None:
+        with self.lock:
+            for conn in (self.tx, self.rx):
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+            self.tx = self.rx = None
+            self.proc = None
+            self.live = False
+
+
+class ServingFleet:
+    """The router/supervisor (module doc). One instance per process."""
+
+    def __init__(self, replicas: Optional[int] = None,
+                 registry: Optional[SessionRegistry] = None,
+                 spawn: bool = True):
+        n = replicas if replicas is not None \
+            else int(config.get("fleet.replicas"))
+        self.registry = registry if registry is not None \
+            else SessionRegistry()
+        self._handles = [ReplicaHandle(self, i) for i in range(n)]
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._seq = 0
+        self._in_flight = 0
+        self._draining = False
+        self._drained: Optional[Dict[str, Any]] = None
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        self._warm_payload: Optional[Dict[str, Any]] = None
+        self._fallback = None
+        self._full_width = n
+        self.counters: Dict[str, int] = {
+            "completed": 0, "failed": 0, "rejected": 0, "requeued": 0,
+            "requeue_budget_spent": 0, "replica_deaths": 0, "respawns": 0,
+            "fallback_queries": 0, "timed_out": 0,
+        }
+        self._stop = threading.Event()
+        if spawn:
+            for h in self._handles:
+                h.spawn()
+                with h.lock:
+                    h.live = True
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="fleet-supervisor", daemon=True)
+        self._supervisor.start()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _next_rid(self) -> int:
+        with self._lock:
+            self._rid += 1
+            return self._rid
+
+    def _count(self, field: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[field] = self.counters.get(field, 0) + by
+
+    def width(self) -> int:
+        return sum(1 for h in self._handles if h.live)
+
+    def live_handles(self) -> List[ReplicaHandle]:
+        return [h for h in self._handles if h.live]
+
+    # -- tenants ---------------------------------------------------------
+
+    def register_tenant(self, tenant_id: str, **limits):
+        """Declare a tenant fleet-wide: on the router's global registry
+        AND every live replica (respawns re-play the declaration)."""
+        tenant = self.registry.register_tenant(tenant_id, **limits)
+        with self._lock:
+            self._tenants[tenant_id] = dict(limits)
+        for h in self.live_handles():
+            h.post({"op": "register", "tenant": tenant_id,
+                    "limits": limits})
+        return tenant
+
+    # -- warm ------------------------------------------------------------
+
+    def warm(self, plans, tables, timeout_s: float = 300.0) -> int:
+        """Broadcast the compile-warm loop to every live replica and wait;
+        the payload is kept so a respawned replica re-warms before it
+        rejoins the live set (recovery must not compile mid-storm)."""
+        payload = {"op": "warm", "plans": list(plans),
+                   "tables": [table_to_wire(t) for t in tables]}
+        with self._lock:
+            self._warm_payload = payload
+        ctrls = []
+        for h in self.live_handles():
+            c = _Ctrl()
+            if h.post(payload, c):
+                ctrls.append(c)
+        for c in ctrls:
+            c.future.result(timeout=timeout_s)
+        return len(ctrls)
+
+    def replica_stats(self, idx: int, timeout_s: float = 30.0):
+        """Synchronous stats snapshot from one replica (None when dead)."""
+        h = self._handles[idx]
+        if not h.live:
+            return None
+        c = _Ctrl()
+        if not h.post({"op": "stats"}, c):
+            return None
+        return c.future.result(timeout=timeout_s)
+
+    # -- routing ---------------------------------------------------------
+
+    def _weight(self, h: ReplicaHandle, best_rate: float) -> float:
+        """Telemetry -> routing weight, quantized so noise cannot churn
+        affinity: weight halves per _DEPTH_BUCKET of queued depth, and
+        once more when the replica drains at under a quarter of the
+        fleet's best measured rate while work is queued on it."""
+        t = h.telemetry
+        depth = int(t.get("depth", 0))
+        w = 1.0 / (1.0 + depth // _DEPTH_BUCKET)
+        rate = float(t.get("drain_rate", 0.0))
+        if best_rate > 0 and depth > 0 and rate < 0.25 * best_rate:
+            w *= 0.5
+        return w
+
+    def _route(self, key: str) -> Optional[ReplicaHandle]:
+        live = self.live_handles()
+        if not live:
+            return None
+        best_rate = max((float(h.telemetry.get("drain_rate", 0.0))
+                         for h in live), default=0.0)
+        weights = [self._weight(h, best_rate) for h in live]
+        idx = rendezvous_pick(key, [h.idx for h in live], weights)
+        for h in live:
+            if h.idx == idx:
+                return h
+        return None
+
+    # -- fleet admission -------------------------------------------------
+
+    def min_drain_rate(self) -> float:
+        """The slowest live replica's measured drain rate (0.0 until
+        telemetry lands) — the conservative base for retry pricing."""
+        rates = [float(h.telemetry.get("drain_rate", 0.0))
+                 for h in self.live_handles()]
+        rates = [r for r in rates if r > 0.0]
+        return min(rates) if rates else 0.0
+
+    def _priced_hint(self, excess: float) -> float:
+        """admission.py's quote shape, priced fleet-wide: time for
+        ``excess`` queries to drain at the MINIMUM live replica rate,
+        clamped to [batch window, retry_after cap]."""
+        floor = float(config.get("serving.batch_window_ms")) / 1000.0
+        cap = float(config.get("serving.retry_after_cap_s"))
+        rate = self.min_drain_rate()
+        if rate <= 0.0:
+            return max(floor, 0.001)
+        return min(max(excess / rate, floor, 0.001), cap)
+
+    def _reject(self, tenant_id: str, reason: str) -> None:
+        self._count("rejected")
+        self.registry.count_rejection(tenant_id, reason)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, tenant_id: str, plan, table,
+               budget_s: Optional[float] = None) -> Future:
+        """Admit globally, route by (tenant, plan fingerprint), forward.
+
+        Establishes a Deadline exactly like ServingFrontend.submit
+        (SRJT013) and ships its wire snapshot with the ticket, so router
+        queue time and replica queue time burn the same budget."""
+        ctx = (watchdog.Deadline(budget_s, f"fleet:{tenant_id}")
+               if budget_s else
+               watchdog.ensure_deadline(f"fleet:{tenant_id}"))
+        with ctx:
+            dl = watchdog.current_deadline()
+            snap = dl.snapshot_wire() if dl is not None else None
+            with self._lock:
+                draining = self._draining
+                in_flight = self._in_flight
+            if draining:
+                self._reject(tenant_id, "draining")
+                raise AdmissionRejected(  # srjt: noqa[SRJT017] the fleet is going away; no capacity will return
+                    "draining", 0.0, tenant_id,
+                    "serving fleet is draining")
+            max_if = int(config.get("fleet.max_in_flight"))
+            if max_if > 0 and in_flight >= max_if:
+                self._reject(tenant_id, "queue_full")
+                raise AdmissionRejected(
+                    "queue_full",
+                    self._priced_hint(in_flight - max_if + 1), tenant_id,
+                    f"fleet in-flight {in_flight} >= fleet.max_in_flight "
+                    f"{max_if}")
+            estimate = 2 * table.device_nbytes()
+            reason = self.registry.try_admit(tenant_id, estimate)
+            if reason is not None:
+                self._count("rejected")
+                if reason == "unknown_tenant":
+                    raise AdmissionRejected(  # srjt: noqa[SRJT017] registration is a programming error, not load
+                        "unknown_tenant", 0.0, tenant_id,
+                        "register_tenant() on the fleet before submitting")
+                raise AdmissionRejected(
+                    reason, self._priced_hint(max(in_flight, 1)),
+                    tenant_id,
+                    "fleet per-tenant budget exhausted "
+                    f"({reason}, charged in the router)")
+            plan, bkey = batch_key_for(plan, table)
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            fp = bkey[0] if bkey is not None else None
+            route_fp = fp if fp is not None else f"solo-{seq}"
+            ticket = FleetTicket(tenant_id, plan, fp,
+                                 table_to_wire(table), snap, estimate,
+                                 f"{tenant_id}|{route_fp}")
+            with self._lock:
+                self._in_flight += 1
+            self._dispatch(ticket)
+            return ticket.future
+
+    def _dispatch(self, t: FleetTicket) -> None:
+        """Route + forward; a severed pipe mid-send just tries the next
+        survivor (the reader thread owns the death bookkeeping). With no
+        live replica left, the in-process fallback runs the query."""
+        for _ in range(len(self._handles) + 1):
+            h = self._route(t.key)
+            if h is None:
+                break
+            msg = {"op": "submit", "tenant": t.tenant_id,
+                   "table": t.wire_table, "snap": t.snap}
+            if t.fp is None:
+                msg["plan"] = t.plan    # solo queries are never interned
+            else:
+                msg["fp"] = t.fp
+            if h.post(msg, t, plan_fp=t.fp, plan=t.plan):
+                return
+            time.sleep(0.001)   # let the reader mark the death
+        self._fallback_submit(t)
+
+    # -- reply / death handling ------------------------------------------
+
+    def _finish(self, t: FleetTicket, table=None,
+                error: Optional[BaseException] = None,
+                completed=None) -> None:
+        self.registry.release(t.tenant_id, t.estimate, completed=completed)
+        with self._lock:
+            self._in_flight -= 1
+        if error is None:
+            self._count("completed")
+            if not t.future.done():
+                t.future.set_result(table)
+        else:
+            self._count("failed")
+            if not t.future.done():
+                t.future.set_exception(error)
+
+    def _resolve(self, h: ReplicaHandle, entry, ok: bool, payload) -> None:
+        """Reader-thread callback: one correlated reply."""
+        if entry.kind == "ctrl":
+            if ok:
+                entry.future.set_result(payload)
+            else:
+                entry.future.set_exception(wire_to_error(payload))
+            return
+        h.breaker.record_success()
+        if ok:
+            self._finish(entry, table=wire_to_table(payload),
+                         completed=True)
+        else:
+            err = wire_to_error(payload)
+            # replica-local admission rejections roll the global charge
+            # back without an outcome (the query never ran); real
+            # failures count against the tenant
+            completed = None if payload.get("kind") == "admission" \
+                else False
+            self._finish(entry, error=err, completed=completed)
+
+    def _on_replica_death(self, h: ReplicaHandle) -> None:
+        """Reader-thread death path: verdict, CRASH classification,
+        requeue of orphaned tickets, breaker + backoff arming."""
+        err = h.death_verdict()
+        with h.lock:
+            was_live = h.live
+            h.live = False
+            orphans = list(h.pending.values())
+            h.pending.clear()
+        h.teardown()
+        if not was_live:
+            return
+        fault_metrics.bump("crash_detected")
+        fault_metrics.bump("workers_lost")
+        if self.width() <= self._full_width // 2:
+            fault_metrics.bump("degradations")
+        h.breaker.record_failure()
+        backoff = float(config.get("fleet.respawn_backoff_s"))
+        with h.lock:
+            h.deaths += 1
+            h.next_attempt_at = time.monotonic() + min(
+                backoff * (2.0 ** (h.deaths - 1)), backoff * 16.0)
+        self._count("replica_deaths")
+        for entry in orphans:
+            if entry.kind == "ctrl":
+                if not entry.future.done():
+                    entry.future.set_exception(err)
+                continue
+            self._requeue(entry, err)
+
+    def _requeue(self, t: FleetTicket, err: WorkerCrashError) -> None:
+        t.attempts += 1
+        budget = int(config.get("fleet.requeue_budget"))
+        if t.attempts > budget:
+            self._count("requeue_budget_spent")
+            self._finish(t, error=err, completed=False)
+            return
+        self._count("requeued")
+        # re-route: the dead replica is out of the member set, so the
+        # rendezvous pick lands on a survivor (or the fallback)
+        self._dispatch(t)
+
+    # -- degradation end state -------------------------------------------
+
+    def _ensure_fallback(self):
+        """Width 0: lazily build an in-process ServingFrontend (the last
+        ladder rung, like the sharded executor's solo replay) and declare
+        every known tenant on it."""
+        from .scheduler import ServingFrontend
+        with self._lock:
+            fe = self._fallback
+            tenants = dict(self._tenants)
+        if fe is None:
+            fe = ServingFrontend()
+            for tid, limits in tenants.items():
+                fe.register_tenant(tid, **limits)
+            with self._lock:
+                if self._fallback is None:
+                    self._fallback = fe
+                fe = self._fallback
+        return fe
+
+    def _fallback_submit(self, t: FleetTicket) -> None:
+        self._count("fallback_queries")
+        fe = self._ensure_fallback()
+        try:
+            if t.snap is not None:
+                with watchdog.Deadline.adopt_wire(t.snap):
+                    inner = fe.submit(t.tenant_id, t.plan,
+                                      wire_to_table(t.wire_table))
+            else:
+                inner = fe.submit(t.tenant_id, t.plan,
+                                  wire_to_table(t.wire_table))
+        except BaseException as e:  # noqa: BLE001 — resolves the caller's future
+            completed = None if isinstance(e, AdmissionRejected) else False
+            self._finish(t, error=e, completed=completed)
+            return
+
+        def _chain(fut):
+            try:
+                table = fut.result()
+            except BaseException as e:  # noqa: BLE001 — resolves the caller's future
+                completed = (None if isinstance(e, AdmissionRejected)
+                             else False)
+                self._finish(t, error=e, completed=completed)
+            else:
+                self._finish(t, table=table, completed=True)
+
+        inner.add_done_callback(_chain)
+
+    # -- supervisor ------------------------------------------------------
+
+    def _supervise(self) -> None:
+        """Respawn dead replicas (backoff + breaker gate), sweep aged
+        tickets, poll telemetry from idle replicas."""
+        period = max(0.02, float(config.get("fleet.telemetry_period_s")))
+        last_probe = 0.0
+        while not self._stop.is_set():
+            self._stop.wait(0.05)
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            for h in self._handles:
+                if h.live or h.closing:
+                    continue
+                if now < h.next_attempt_at or not h.breaker.allow():
+                    continue
+                try:
+                    self._respawn(h)
+                except Exception:
+                    h.breaker.record_failure()
+                    backoff = float(config.get("fleet.respawn_backoff_s"))
+                    with h.lock:
+                        h.deaths += 1
+                        h.next_attempt_at = time.monotonic() + min(
+                            backoff * (2.0 ** (h.deaths - 1)),
+                            backoff * 16.0)
+            # age sweep: a ticket the replica never answered inside the
+            # fleet window fails typed instead of pending forever
+            timeout_s = float(config.get("fleet.submit_timeout_s"))
+            if timeout_s > 0:
+                for h in self._handles:
+                    with h.lock:
+                        aged = [(rid, e) for rid, e in h.pending.items()
+                                if e.kind == "query"
+                                and now - e.enqueued_at > timeout_s]
+                        for rid, _ in aged:
+                            h.pending.pop(rid, None)
+                    for _, t in aged:
+                        self._count("timed_out")
+                        self._finish(t, error=watchdog.DeadlineExceededError(
+                            f"fleet:{t.tenant_id}", timeout_s),
+                            completed=False)
+            if now - last_probe >= period:
+                last_probe = now
+                for h in self.live_handles():
+                    # fire-and-forget: any reply refreshes telemetry
+                    h.post({"op": "stats"})
+
+    def _respawn(self, h: ReplicaHandle) -> None:
+        """Bring a dead replica back: spawn, re-declare tenants, re-warm,
+        probe — only a replica that answers rejoins the live set."""
+        h.spawn()
+        with self._lock:
+            tenants = dict(self._tenants)
+            warm_payload = self._warm_payload
+        for tid, limits in tenants.items():
+            h.post({"op": "register", "tenant": tid, "limits": limits})
+        if warm_payload is not None:
+            c = _Ctrl()
+            if not h.post(warm_payload, c):
+                raise WorkerCrashError(h.name, "died during re-warm")
+            c.future.result(timeout=300.0)
+        probe = _Ctrl()
+        if not h.post({"op": "stats"}, probe):
+            raise WorkerCrashError(h.name, "died during respawn probe")
+        probe.future.result(timeout=60.0)
+        with h.lock:
+            h.live = True
+            h.deaths = 0
+        h.breaker.record_success()
+        fault_metrics.bump("worker_respawns")
+        self._count("respawns")
+
+    # -- chaos hook ------------------------------------------------------
+
+    def kill_replica(self, idx: int) -> bool:
+        """Chaos/testing hook — the ONE sanctioned process-kill site in
+        the serving tier (SRJT018): SIGKILL the replica and let the
+        supervisor's death path observe it exactly as a real crash."""
+        h = self._handles[idx]
+        proc = h.proc
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.kill()
+        return True
+
+    # -- drain -----------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Stop router admission FIRST, then drain replicas (each sheds
+        its queue typed, finishes in-flight, answers everything, exits),
+        then join processes. Idempotent."""
+        if timeout is None:
+            timeout = float(config.get("drain.timeout_s"))
+        with self._lock:
+            if self._draining and self._drained is not None:
+                out = dict(self._drained)
+                out["already_closed"] = True
+                return out
+            self._draining = True
+        t0 = time.monotonic()
+        self._stop.set()
+        self._supervisor.join(timeout=5.0)
+        for h in self._handles:
+            with h.lock:
+                h.closing = True
+            if h.live:
+                try:
+                    with h.send_lock:
+                        h.tx.send(None)
+                except (OSError, ValueError, TypeError, AttributeError):
+                    pass
+        stragglers = 0
+        deadline = time.monotonic() + timeout
+        for h in self._handles:
+            proc = h.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                stragglers += 1
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        # replies raced the join: give resolved-but-unread futures a beat,
+        # then shed anything still unanswered with the typed rejection
+        shed = 0
+        for h in self._handles:
+            with h.lock:
+                orphans = list(h.pending.values())
+                h.pending.clear()
+            h.teardown()
+            for entry in orphans:
+                if entry.kind == "ctrl":
+                    if not entry.future.done():
+                        entry.future.set_exception(RuntimeError(
+                            "fleet drained"))
+                    continue
+                if entry.future.done():
+                    continue
+                shed += 1
+                self._finish(entry, error=AdmissionRejected(  # srjt: noqa[SRJT017] drain is terminal for this fleet; clients must fail over, not retry here
+                    "draining", 0.0, entry.tenant_id,
+                    "fleet drained before the replica answered"),
+                    completed=None)
+        fb_verdict = None
+        if self._fallback is not None:
+            fb_verdict = self._fallback.drain(timeout=timeout)
+        verdict = {
+            "clean": stragglers == 0 and (fb_verdict is None
+                                          or fb_verdict["clean"]),
+            "already_closed": False,
+            "replica_stragglers": stragglers,
+            "shed": shed,
+            "fallback": fb_verdict,
+            "counters": dict(self.counters),
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+        with self._lock:
+            self._drained = verdict
+        return verdict
+
+    def close(self) -> None:
+        self.drain()
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "width": self.width(),
+            "full_width": self._full_width,
+            "in_flight": self._in_flight,
+            "counters": dict(self.counters),
+            "replicas": [
+                {"idx": h.idx, "live": h.live, "deaths": h.deaths,
+                 "breaker": h.breaker.state(),
+                 "pid": h.proc.pid if h.proc is not None else None,
+                 "telemetry": dict(h.telemetry)}
+                for h in self._handles],
+            "tenants": self.registry.snapshot(),
+        }

@@ -137,6 +137,85 @@ def _trim(cols_k, mask_k, live: int, prefix: bool) -> Table:
     return gather_table(Table(tuple(cols_k)), idx)
 
 
+def _pad_stack_host(tables: Sequence[Table], bucket: int,
+                    kb: int) -> Tuple[Tuple[Column, ...], Table]:
+    """Pad + stack on HOST numpy: the eager-path twin of ``_pad_table``
+    + ``_stack_columns``, for simple fixed-width members.
+
+    The traced version pays ~5 eager device dispatches per member
+    (concatenates, zeros, ones) before the batch even dispatches; here
+    each stacked leaf is assembled in one preallocated numpy buffer and
+    crosses to the device in ONE ``jnp.asarray`` per leaf. Values are
+    identical by construction (same zeros, same layout), so the compiled
+    batched program cannot tell the paths apart. Also returns the
+    member-0 template Table for the program-cache shape key (shape/dtype
+    metadata only — never traced)."""
+    cols: List[Column] = []
+    template: List[Column] = []
+    for j, ref in enumerate(tables[0].columns):
+        data = np.zeros((kb, bucket), dtype=np.asarray(ref.data).dtype)
+        val = None
+        if ref.validity is not None:
+            val = np.zeros((kb, bucket),
+                           dtype=np.asarray(ref.validity).dtype)
+        for i, t in enumerate(tables):
+            c = t.columns[j]
+            data[i, :c.size] = np.asarray(c.data)
+            if val is not None:
+                val[i, :c.size] = np.asarray(c.validity)
+        cols.append(Column(ref.dtype, bucket, data=jnp.asarray(data),
+                           validity=None if val is None
+                           else jnp.asarray(val)))
+        template.append(Column(ref.dtype, bucket, data=data[0],
+                               validity=None if val is None else val[0]))
+    ind = np.zeros((kb, bucket), dtype=np.uint8)
+    for i, t in enumerate(tables):
+        ind[i, :t.num_rows] = 1
+    cols.append(Column(dt.BOOL8, bucket, data=jnp.asarray(ind)))
+    template.append(Column(dt.BOOL8, bucket, data=ind[0]))
+    return tuple(cols), Table(tuple(template))
+
+
+def _host_trim_ok(cols) -> bool:
+    """The host-trim fast path covers simple fixed-width columns only
+    (no offsets, no dictionary/list children) — everything the serving
+    micro-query shapes produce. Anything richer takes the traced trim,
+    whose gather handles children/offsets correctly."""
+    return all(c.offsets is None and not c.children
+               and c.dtype.is_fixed_width for c in cols)
+
+
+def _trim_host(cols_h, mask_h, k: int, live: int, prefix: bool) -> Table:
+    """Member trim on HOST numpy after the batch's one device_get.
+
+    The traced per-member trim (`_slice_member` + `_trim`) runs ~30 eager
+    dispatches per member — nonzero, gathers, tree slicing — each paying
+    the XLA dispatch floor, which put a 2-3 ms/query floor under the
+    whole serving tier. The batched result is already host-synced (the
+    head read), so pulling the stacked payload once and slicing members
+    in numpy replaces K * 30 device dispatches with one transfer + pure
+    numpy. Bit-identity is preserved exactly: a numpy slice/take moves
+    the same bits `mask_indices_core` + `gather_table` would, and
+    `jnp.asarray` round-trips them unchanged."""
+    out = []
+    idx = None
+    if not prefix:
+        # same semantics as mask_indices_core(mask, live): the indices of
+        # the live rows, int32, exactly `live` of them
+        idx = np.flatnonzero(mask_h[k])[:live].astype(np.int32)
+    for c in cols_h:
+        data = c.data[k]
+        val = c.validity[k] if c.validity is not None else None
+        if prefix:
+            data, val = data[:live], (None if val is None else val[:live])
+        else:
+            data, val = data[idx], (None if val is None else val[idx])
+        out.append(Column(c.dtype, live, data=jnp.asarray(data),
+                          validity=None if val is None
+                          else jnp.asarray(val)))
+    return Table(tuple(out))
+
+
 class MemberOutcome:
     """Per-query result of one batched dispatch: a Table or an error."""
 
@@ -189,7 +268,6 @@ class MicroBatcher:
             return [self._solo(plans[0], tables[0], snaps[0])]
 
         bucket = bucket_size(max(t.num_rows for t in tables))
-        padded = [_pad_table(t, bucket) for t in tables]
         pplan = _pad_plan(plans[0])
         # a pure passthrough chain (Filter/Sort/Limit only) carries every
         # scanned column to the output — including the appended indicator;
@@ -211,10 +289,19 @@ class MicroBatcher:
         # prevent. Stacking the padded list keeps the stack-kernel space
         # identical to the program space: {2,4,8,16,...} only.
         kb = 1 << (k - 1).bit_length()
-        if kb > k:
-            zero = jax.tree_util.tree_map(jnp.zeros_like, padded[0])
-            padded = list(padded) + [zero] * (kb - k)
-        stacked = _stack_columns(padded)
+        host_pack = (bool(config.get("serving.host_trim"))
+                     and all(c.offsets is None and not c.children
+                             and c.dtype.is_fixed_width
+                             for t in tables for c in t.columns))
+        if host_pack:
+            stacked, template = _pad_stack_host(tables, bucket, kb)
+        else:
+            padded = [_pad_table(t, bucket) for t in tables]
+            if kb > k:
+                zero = jax.tree_util.tree_map(jnp.zeros_like, padded[0])
+                padded = list(padded) + [zero] * (kb - k)
+            stacked = _stack_columns(padded)
+            template = padded[0]
         nbytes = sum(t.device_nbytes() for t in tables)
 
         # config-gated sharded mode: stage the stacked pytree's ROW axis
@@ -243,7 +330,7 @@ class MicroBatcher:
         try:
             with ctx:
                 prog = self._cache.get_or_compile_batched(
-                    pplan, padded[0], stacked, kb, mesh=mesh)
+                    pplan, template, stacked, kb, mesh=mesh)
 
                 def run():
                     # same 2x envelope as the solo executor, summed over
@@ -267,6 +354,15 @@ class MicroBatcher:
         br.record_success()
         serving_metrics.inc("batches")
         serving_metrics.inc("batched_queries", k)
+        # host-trim fast path: one device_get of the stacked result, then
+        # pure-numpy member slicing (docstring of _trim_host). Sharded
+        # dispatches keep the traced trim — their leaves live on a mesh.
+        host_trim = (mask is not None and mesh is None
+                     and bool(config.get("serving.host_trim"))
+                     and _host_trim_ok(cols))
+        if host_trim:
+            cols_h = [jax.tree_util.tree_map(np.asarray, c) for c in cols]
+            mask_h = np.asarray(mask)
         outcomes: List[MemberOutcome] = []
         for i in range(k):
             live, overflow = int(head_h[i][0]), bool(head_h[i][1])
@@ -280,8 +376,11 @@ class MicroBatcher:
                 out.replayed_solo = True
                 outcomes.append(out)
                 continue
-            cols_i, mask_i = _slice_member(cols, mask, i)
-            out = _trim(cols_i, mask_i, live, prog.prefix)
+            if host_trim:
+                out = _trim_host(cols_h, mask_h, i, live, prog.prefix)
+            else:
+                cols_i, mask_i = _slice_member(cols, mask, i)
+                out = _trim(cols_i, mask_i, live, prog.prefix)
             if passthrough:
                 out = Table(out.columns[:-1])   # shed the indicator column
             outcomes.append(MemberOutcome(table=out))

@@ -354,6 +354,41 @@ _register("serving.sharded_devices", "SRJT_SERVING_SHARDED_DEVICES", 0, int,
           "micro-batcher stages each stacked slice's row axis across this "
           "many devices of the process-wide mesh so one jit(vmap(plan)) "
           "dispatch runs sharded; per-member results stay bit-identical")
+_register("serving.host_trim", "SRJT_SERVING_HOST_TRIM", True, _parse_bool,
+          "batched result scatter on host numpy: after the batch's one "
+          "head sync, pull the stacked payload once and slice members "
+          "with numpy instead of ~30 eager device dispatches per member "
+          "(bit-identical; simple fixed-width columns only — richer "
+          "schemas keep the traced trim)")
+_register("fleet.replicas", "SRJT_FLEET_REPLICAS", 4, int,
+          "serving fleet width: how many replica worker processes "
+          "(serving/replica.py) the router/supervisor (serving/fleet.py) "
+          "spawns and routes across")
+_register("fleet.requeue_budget", "SRJT_FLEET_REQUEUE_BUDGET", 3, int,
+          "how many times one in-flight query may be requeued onto a "
+          "surviving replica after its replica died before it fails with "
+          "the replica's WorkerCrashError (the fleet analog of "
+          "task.retry_budget)")
+_register("fleet.respawn_backoff_s", "SRJT_FLEET_RESPAWN_BACKOFF_S", 0.2,
+          float,
+          "base of the supervisor's exponential respawn backoff after a "
+          "replica death (doubles per consecutive death, capped at 16x; "
+          "the per-replica circuit breaker gates respawn attempts on top)")
+_register("fleet.submit_timeout_s", "SRJT_FLEET_SUBMIT_TIMEOUT_S", 60.0,
+          float,
+          "upper bound on one routed query's end-to-end wait inside the "
+          "fleet before the router fails its future (a backstop under "
+          "the caller's own Deadline, which always binds tighter when "
+          "set)")
+_register("fleet.max_in_flight", "SRJT_FLEET_MAX_IN_FLIGHT", 4096, int,
+          "global cap on queries the router may have outstanding across "
+          "all replicas (0 = unbounded); beyond it admission rejects "
+          "with a retry hint priced from the minimum replica drain rate")
+_register("fleet.telemetry_period_s", "SRJT_FLEET_TELEMETRY_PERIOD_S", 0.5,
+          float,
+          "how often the router polls each replica's drain-rate/depth "
+          "telemetry to refresh routing weights (responses also "
+          "piggyback telemetry, so this is the idle-replica floor)")
 
 
 def get(key: str) -> Any:

@@ -19,6 +19,10 @@ _REPO_ROOT = os.path.dirname(_PKG_ROOT)
 
 _lock = threading.Lock()
 _cache = {}
+# so_name -> (dep mtime signature, RuntimeError). A failed compile is
+# deterministic for unchanged sources, so re-raise instead of re-running
+# g++ on every import attempt (dozens of tests import the same loader).
+_failed = {}
 
 
 def load_native(src_name: str, so_name: str,
@@ -42,13 +46,20 @@ def load_native(src_name: str, so_name: str,
                  or any(os.path.getmtime(d) > os.path.getmtime(so)
                         for d in deps))
         if stale:
+            sig = tuple(os.path.getmtime(d) for d in deps)
+            prior = _failed.get(so_name)
+            if prior is not None and prior[0] == sig:
+                raise prior[1]
             os.makedirs(os.path.dirname(so), exist_ok=True)
             cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-Wall",
                    "-o", so, src, *link]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
-                raise RuntimeError(
+                err = RuntimeError(
                     f"failed to build {so} from {src}:\n{proc.stderr}")
+                _failed[so_name] = (sig, err)
+                raise err
+            _failed.pop(so_name, None)
         lib = ctypes.CDLL(so)
         _cache[so_name] = lib
         return lib

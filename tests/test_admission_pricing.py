@@ -1,0 +1,111 @@
+"""Admission retry-pricing monotonicity (ISSUE 16 satellite).
+
+``AdmissionRejected.retry_after_s`` is the client back-pressure
+contract: the quote must grow (or hold) as the queue deepens at a fixed
+drain rate, and come back down once the measured drain rate recovers.
+These tests pin ``AdmissionController._priced_hint`` / ``drain_rate``
+directly — the fleet router's ``_priced_hint`` reuses the same shape
+priced from the minimum replica rate, so this is the contract both
+levels quote from.
+"""
+
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.serving import AdmissionController, SessionRegistry
+from spark_rapids_jni_tpu.serving.sessions import serving_metrics
+from spark_rapids_jni_tpu.utils import config
+
+pytestmark = pytest.mark.usefixtures("_clean")
+
+
+@pytest.fixture
+def _clean():
+    serving_metrics.reset()
+    yield
+    serving_metrics.reset()
+
+
+def _controller_with_rate(n_dispatched: int) -> AdmissionController:
+    """A controller whose 5s sliding window has seen ``n_dispatched``
+    queries (rate = n/5 qps), fed through the real note_dispatch path."""
+    ac = AdmissionController(SessionRegistry())
+    if n_dispatched:
+        ac.note_dispatch(n_dispatched, queue_delay_s=0.0)
+    return ac
+
+
+def test_priced_hint_floor_without_rate():
+    """No dispatch observed yet -> quote the batch-window floor, never
+    zero (0.0 means 'do not retry', which is wrong for load shedding)."""
+    ac = _controller_with_rate(0)
+    floor = float(config.get("serving.batch_window_ms")) / 1000.0
+    hint = ac._priced_hint(100.0)
+    assert hint == pytest.approx(max(floor, 0.001))
+    assert hint > 0.0
+
+
+def test_priced_hint_monotonic_in_queue_depth():
+    """At a fixed drain rate, rising excess depth must never price a
+    SHORTER retry: the hint is non-decreasing in depth."""
+    ac = _controller_with_rate(100)   # 20 qps measured
+    rate = ac.drain_rate()
+    assert rate > 0.0
+    hints = [ac._priced_hint(float(excess))
+             for excess in (1, 2, 5, 10, 50, 200, 1000, 10_000)]
+    assert hints == sorted(hints)
+    # and strictly increasing once past the floor and under the cap
+    cap = float(config.get("serving.retry_after_cap_s"))
+    uncapped = [h for h in hints if h < cap]
+    past_floor = [h for h in uncapped
+                  if h > max(float(config.get("serving.batch_window_ms"))
+                             / 1000.0, 0.001)]
+    assert past_floor == sorted(set(past_floor))
+
+
+def test_priced_hint_capped():
+    """Depth beyond the cap quotes the cap — a client is never told to
+    go away for longer than serving.retry_after_cap_s."""
+    ac = _controller_with_rate(5)     # 1 qps: slow drain, big quotes
+    cap = float(config.get("serving.retry_after_cap_s"))
+    assert ac._priced_hint(10_000_000.0) == pytest.approx(cap)
+
+
+def test_priced_hint_falls_after_drain_rate_recovery():
+    """The same excess prices a SHORTER retry once the measured drain
+    rate rises — recovery must feed back into the quote."""
+    slow = _controller_with_rate(10)    # 2 qps
+    fast = _controller_with_rate(500)   # 100 qps
+    excess = 50.0
+    assert slow._priced_hint(excess) > fast._priced_hint(excess)
+    # and in-place: the SAME controller re-quotes lower after more
+    # dispatches land in its window
+    ac = _controller_with_rate(10)
+    before = ac._priced_hint(excess)
+    ac.note_dispatch(490, queue_delay_s=0.0)
+    after = ac._priced_hint(excess)
+    assert after < before
+
+
+def test_drain_rate_window_expiry():
+    """Samples age out of the 5s sliding window: a controller whose
+    only dispatches are older than the window reads 0.0 again."""
+    ac = AdmissionController(SessionRegistry())
+    ac.note_dispatch(50, queue_delay_s=0.0)
+    assert ac.drain_rate() > 0.0
+    # age the sample artificially instead of sleeping 5 wall seconds
+    with ac._lock:
+        ac._dispatches[0] = (ac._dispatches[0][0] - 6.0,
+                             ac._dispatches[0][1])
+    assert ac.drain_rate() == 0.0
+
+
+def test_hint_ordering_survives_round_trip():
+    """The ordering holds end to end through the priced rejections the
+    frontend raises: deeper queues quote >= retries at a fixed rate."""
+    ac = _controller_with_rate(100)
+    shallow = ac._priced_hint(2.0)
+    deep = ac._priced_hint(500.0)
+    assert deep >= shallow
+    assert shallow >= 0.001

@@ -1037,6 +1037,115 @@ def test_srjt017_package_zero_hint_sites_all_sanctioned():
 
 
 # ---------------------------------------------------------------------------
+# SRJT018 — fleet IPC deadline propagation + raw process control
+# ---------------------------------------------------------------------------
+
+SRC_018_SUBMIT_NO_SNAP = """
+    def forward(self, t):
+        self.tx.send({"op": "submit", "tenant": t.tenant_id,
+                      "plan": t.plan, "table": t.wire_table})
+"""
+
+SRC_018_SUBMIT_WITH_SNAP = """
+    def forward(self, t):
+        self.tx.send({"op": "submit", "tenant": t.tenant_id,
+                      "plan": t.plan, "table": t.wire_table,
+                      "snap": t.snap})
+"""
+
+SRC_018_RAW_KILL = """
+    def reap(self):
+        os.kill(self.pid, 9)
+"""
+
+SRC_018_PROC_KILL = """
+    def reap(self):
+        self.proc.kill()
+        worker_proc.terminate()
+"""
+
+
+def test_srjt018_submit_payload_without_snap_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    fs = run(SRC_018_SUBMIT_NO_SNAP, path="pkg/serving/fleet.py",
+             rules=[rule_srjt018])
+    assert rules_of(fs) == {"SRJT018"}
+    assert "snap" in fs[0].message
+
+
+def test_srjt018_submit_payload_with_snap_passes():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    assert run(SRC_018_SUBMIT_WITH_SNAP, path="pkg/serving/fleet.py",
+               rules=[rule_srjt018]) == []
+    # other ops need no snap: stats/register/warm are not queries
+    src = SRC_018_SUBMIT_NO_SNAP.replace('"submit"', '"stats"')
+    assert run(src, path="pkg/serving/fleet.py",
+               rules=[rule_srjt018]) == []
+
+
+def test_srjt018_submit_rule_scoped_to_serving():
+    # the payload clause polices the serving tier's IPC only — an
+    # op-shaped dict elsewhere in the package is not fleet traffic
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    assert run(SRC_018_SUBMIT_NO_SNAP, path="pkg/parallel/exchange.py",
+               rules=[rule_srjt018]) == []
+
+
+def test_srjt018_raw_process_control_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    fs = run(SRC_018_RAW_KILL, path="pkg/serving/scheduler.py",
+             rules=[rule_srjt018])
+    assert rules_of(fs) == {"SRJT018"}
+    assert "os.kill" in fs[0].message
+    fs = run(SRC_018_PROC_KILL, path="pkg/faultinj/chaosd.py",
+             rules=[rule_srjt018])
+    assert len(fs) == 2 and rules_of(fs) == {"SRJT018"}
+
+
+def test_srjt018_fleet_py_is_the_sanctioned_kill_site():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    assert run(SRC_018_PROC_KILL, path="pkg/serving/fleet.py",
+               rules=[rule_srjt018]) == []
+
+
+def test_srjt018_non_process_receivers_pass():
+    # .kill/.terminate on receivers that are not process-shaped (no
+    # "proc" in the tail name) are someone else's API, not ours
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    src = """
+        def stop(self):
+            self.timer.kill()
+            session.terminate()
+    """
+    assert run(src, path="pkg/serving/scheduler.py",
+               rules=[rule_srjt018]) == []
+
+
+def test_srjt018_noqa():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt018
+    src = SRC_018_RAW_KILL.replace(
+        "os.kill(self.pid, 9)",
+        "os.kill(self.pid, 9)  # srjt: noqa[SRJT018]")
+    assert run(src, path="pkg/serving/scheduler.py",
+               rules=[rule_srjt018]) == []
+
+
+def test_srjt018_sanctioned_sites_are_baselined():
+    # the sandbox's own kill sites (the injected fault + the stall
+    # escalation) are declared boundaries, with reasons
+    import json
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "ci", "lint_baseline.json")) as f:
+        entries = [e for e in json.load(f)["findings"]
+                   if e["rule"] == "SRJT018"]
+    assert entries, "SRJT018 sanctioned kill sites missing from baseline"
+    assert all(e["reason"].startswith("accepted:") for e in entries)
+    paths = {e["path"] for e in entries}
+    assert "spark_rapids_jni_tpu/faultinj/sandbox.py" in paths
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -1056,7 +1165,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 17
+    assert len(FILE_RULES) == 18
 
 
 def test_syntax_error_is_reported_not_raised():

@@ -1,0 +1,270 @@
+"""Serving-fleet tests: the router/supervisor in front of N replica
+processes (ISSUE 16 tentpole).
+
+Covers the seven acceptance points: routed-vs-single bit-identity,
+(tenant, plan)-affinity concentration, fleet-level admission with priced
+``retry_after_s``, replica-kill requeue inside the retry budget,
+breaker-gated respawn, degradation to the in-process fallback when every
+replica is dead, and drain() stopping router admission before joining
+the replicas.
+
+Replica processes are real subprocesses (sandbox.py spawn pattern), so
+spawns are expensive on this 1-core host: the healthy-path tests share
+one module-scoped 2-replica fleet; only the lifecycle tests (breaker,
+all-dead fallback, drain) build their own single-replica fleets.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.faultinj import breaker, watchdog
+from spark_rapids_jni_tpu.faultinj.guard import metrics as fault_metrics
+from spark_rapids_jni_tpu.plan import expr as ex
+from spark_rapids_jni_tpu.plan.executor import execute_plan
+from spark_rapids_jni_tpu.plan.nodes import Filter, GroupBy, Scan
+from spark_rapids_jni_tpu.serving import (AdmissionRejected, ServingFleet,
+                                          batch_key_for, serving_metrics)
+from spark_rapids_jni_tpu.utils import config
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    serving_metrics.reset()
+    yield
+    watchdog.reset()
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def make_table(n, seed):
+    rng = np.random.default_rng(seed)
+    a = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 7, n, dtype=np.int64)))
+    b = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 1000, n, dtype=np.int64)))
+    return Table((a, b))
+
+
+PLAN_FILTER = Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(4)))
+PLAN_GROUPBY = GroupBy(Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(5))),
+                       (0,), ((1, "sum"), (1, "count")))
+# distinct fingerprint reserved for the kill test: its first execution
+# compiles inside the replica, which keeps the queries in flight long
+# enough for the SIGKILL to orphan them deterministically
+PLAN_KILL = GroupBy(Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(6))),
+                    (0,), ((1, "sum"),))
+
+
+def assert_cols_bit_identical(ca: Column, cb: Column, what=""):
+    assert np.array_equal(np.asarray(ca.data), np.asarray(cb.data)), what
+    va = (None if ca.validity is None else np.asarray(ca.validity))
+    vb = (None if cb.validity is None else np.asarray(cb.validity))
+    if va is None or vb is None:
+        assert (va is None or bool(va.all())) and \
+            (vb is None or bool(vb.all())), what
+    else:
+        assert np.array_equal(va, vb), what
+    for i, (ka, kb) in enumerate(zip(ca.children, cb.children)):
+        assert_cols_bit_identical(ka, kb, f"{what} child {i}")
+
+
+def assert_tables_bit_identical(a: Table, b: Table):
+    assert a.num_columns == b.num_columns
+    assert a.num_rows == b.num_rows
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        assert_cols_bit_identical(ca, cb, f"col {i}")
+
+
+def _await(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timed out after {timeout_s}s waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def fleet2():
+    """One shared 2-replica fleet for the healthy-path tests (spawning a
+    replica process is seconds of wall time on this host)."""
+    fl = ServingFleet(replicas=2)
+    fl.register_tenant("alpha", priority=1, max_in_flight=64)
+    fl.register_tenant("tiny", priority=1, max_in_flight=64,
+                       hbm_budget_bytes=1)
+    yield fl
+    fl.drain()
+
+
+def _completed_of(fleet, idx, tenant):
+    stats = fleet.replica_stats(idx)
+    if stats is None:
+        return 0
+    return int(stats["tenants"].get(tenant, {}).get("completed", 0))
+
+
+# -- 1. routed-vs-single bit-identity ---------------------------------------
+
+
+def test_routed_bit_identical(fleet2):
+    """A query through router -> pipe -> replica -> pipe comes back
+    bit-identical to the same plan executed in this process."""
+    for plan, seed in ((PLAN_FILTER, 3), (PLAN_GROUPBY, 4)):
+        t = make_table(64, seed)
+        got = fleet2.submit("alpha", plan, t).result(timeout=180)
+        assert_tables_bit_identical(got, execute_plan(plan, t))
+
+
+# -- 2. affinity / compile concentration ------------------------------------
+
+
+def test_affinity_concentrates_on_one_replica(fleet2):
+    """Same (tenant, plan fingerprint) rendezvous-hashes to ONE replica:
+    every completion lands there and the other replica never compiles
+    or runs the stream."""
+    before = [_completed_of(fleet2, i, "alpha") for i in (0, 1)]
+    futs = [fleet2.submit("alpha", PLAN_FILTER, make_table(64, 10 + i))
+            for i in range(8)]
+    for f in futs:
+        f.result(timeout=180)
+    after = [_completed_of(fleet2, i, "alpha") for i in (0, 1)]
+    deltas = [after[i] - before[i] for i in (0, 1)]
+    assert sorted(deltas) == [0, 8], deltas
+
+
+# -- 3. fleet-level admission with priced retry_after_s ----------------------
+
+
+def test_fleet_admission_rejects_with_priced_retry(fleet2):
+    """The router charges tenant budgets globally BEFORE any replica
+    sees the query, and the rejection quotes a positive retry_after_s
+    (priced from the minimum replica drain rate, floored at the batch
+    window)."""
+    rejected_before = fleet2.counters["rejected"]
+    with pytest.raises(AdmissionRejected) as exc:
+        fleet2.submit("tiny", PLAN_FILTER, make_table(64, 0))
+    assert exc.value.reason == "hbm_budget"
+    assert exc.value.retry_after_s > 0.0
+    assert fleet2.counters["rejected"] == rejected_before + 1
+    # the charge was rolled back/never taken: the tenant admits nothing
+    snap = fleet2.registry.snapshot()["tiny"]
+    assert snap["in_flight"] == 0
+    assert snap["rejected_by_reason"].get("hbm_budget", 0) >= 1
+
+
+def test_unknown_tenant_rejected_at_router(fleet2):
+    with pytest.raises(AdmissionRejected) as exc:
+        fleet2.submit("nobody", PLAN_FILTER, make_table(8, 0))
+    assert exc.value.reason == "unknown_tenant"
+
+
+# -- 4. replica-kill requeue within the retry budget -------------------------
+
+
+def test_replica_kill_requeues_in_flight(fleet2):
+    """SIGKILL the replica holding a fresh (uncompiled) stream while its
+    queries are in flight: the supervisor classifies the death, requeues
+    every orphan onto the survivor inside fleet.requeue_budget, and no
+    caller sees an error. The fleet respawns back to full width."""
+    plan, bkey = batch_key_for(PLAN_KILL, make_table(64, 20))
+    key = f"alpha|{bkey[0]}" if bkey is not None else "alpha|solo-x"
+    victim = fleet2._route(key).idx
+    crashes_before = fault_metrics.snapshot().get("crash_detected", 0)
+    requeued_before = fleet2.counters["requeued"]
+    futs = [fleet2.submit("alpha", PLAN_KILL, make_table(64, 20 + i))
+            for i in range(4)]
+    assert fleet2.kill_replica(victim)
+    for i, f in enumerate(futs):
+        got = f.result(timeout=180)
+        assert_tables_bit_identical(
+            got, execute_plan(PLAN_KILL, make_table(64, 20 + i)))
+    assert fleet2.counters["requeued"] > requeued_before
+    assert fault_metrics.snapshot()["crash_detected"] > crashes_before
+    _await(lambda: fleet2.width() == 2, 90.0, "respawn to full width")
+    assert fleet2.counters["respawns"] >= 1
+
+
+# -- 5. breaker-gated respawn ------------------------------------------------
+
+
+def test_breaker_gates_respawn():
+    """A replica death trips its circuit breaker; the supervisor must
+    NOT respawn while the breaker is open, and does respawn through the
+    half-open probe once the cooldown passes."""
+    with config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 3.0), \
+            config.override("fleet.respawn_backoff_s", 0.05):
+        breaker.reset_all()
+        fl = ServingFleet(replicas=1)
+        try:
+            _await(lambda: fl.width() == 1, 30.0, "initial spawn")
+            assert fl.kill_replica(0)
+            _await(lambda: fl.width() == 0, 30.0, "death detection")
+            # breaker OPEN: backoff (50ms) expires immediately but the
+            # supervisor may not bring the replica back yet
+            time.sleep(1.0)
+            assert fl.width() == 0
+            assert fl._handles[0].breaker.state() == "open"
+            _await(lambda: fl.width() == 1, 60.0,
+                   "half-open probe respawn after cooldown")
+            assert fl.counters["respawns"] == 1
+        finally:
+            fl.drain()
+            breaker.reset_all()
+
+
+# -- 6. degradation end state: in-process fallback ---------------------------
+
+
+def test_all_replicas_dead_falls_back_in_process():
+    """Width 0 with the breaker pinned open: the router degrades to an
+    in-process ServingFrontend and still answers bit-identically."""
+    with config.override("breaker.threshold", 1), \
+            config.override("breaker.cooldown_s", 600.0):
+        breaker.reset_all()
+        fl = ServingFleet(replicas=1)
+        try:
+            fl.register_tenant("alpha", priority=1, max_in_flight=64)
+            _await(lambda: fl.width() == 1, 30.0, "initial spawn")
+            assert fl.kill_replica(0)
+            _await(lambda: fl.width() == 0, 30.0, "death detection")
+            t = make_table(64, 7)
+            got = fl.submit("alpha", PLAN_FILTER, t).result(timeout=180)
+            assert_tables_bit_identical(got, execute_plan(PLAN_FILTER, t))
+            assert fl.counters["fallback_queries"] >= 1
+            assert fl.width() == 0  # breaker held: no respawn happened
+        finally:
+            fl.drain()
+            breaker.reset_all()
+
+
+# -- 7. drain stops router admission before joining replicas -----------------
+
+
+def test_drain_stops_admission_and_joins():
+    fl = ServingFleet(replicas=1)
+    fl.register_tenant("alpha", priority=1, max_in_flight=64)
+    t = make_table(64, 9)
+    got = fl.submit("alpha", PLAN_FILTER, t).result(timeout=180)
+    assert_tables_bit_identical(got, execute_plan(PLAN_FILTER, t))
+    verdict = fl.drain()
+    assert verdict["clean"] is True
+    assert verdict["replica_stragglers"] == 0
+    assert verdict["shed"] == 0
+    assert verdict["counters"]["completed"] >= 1
+    # admission is OFF: a post-drain submit rejects typed, never reaches
+    # a (joined) replica, and never hangs
+    with pytest.raises(AdmissionRejected) as exc:
+        fl.submit("alpha", PLAN_FILTER, t)
+    assert exc.value.reason == "draining"
+    # idempotent: a second drain reports already_closed
+    again = fl.drain()
+    assert again["already_closed"] is True

@@ -473,6 +473,32 @@ def test_diagnostics_bundle_contents(tmp_path):
         assert json.load(f)["api"] == "hash.murmur3"
 
 
+def test_bundle_carries_process_identity(tmp_path):
+    """Fleet-mode attribution: every stall bundle names its process
+    (pid) and, when ``set_replica_id`` tagged it, the fleet replica —
+    a bundle collected off a replica's stderr must be attributable."""
+    import os as _os
+    install(write_cfg(tmp_path, hang_cfg(("hash.murmur3",))), seed=0)
+    watchdog.set_replica_id("3")
+    try:
+        col = Column.from_pylist([1, 2, 3], dt.INT64)
+        with pytest.raises((DeadlineExceededError, StallCancelledError)):
+            with Deadline(0.3, "replica-bundle-test"):
+                bridge.call("hash.murmur3", json.dumps({"seed": 42}),
+                            [bridge.col_to_wire(col)])
+        b = watchdog.last_bundles()[-1]
+        assert b["pid"] == _os.getpid()
+        assert b["replica_id"] == "3"
+        assert watchdog.replica_id() == "3"
+    finally:
+        watchdog.set_replica_id(None)
+    assert watchdog.replica_id() is None
+    # reset() clears the tag too (test hygiene for autouse fixtures)
+    watchdog.set_replica_id("9")
+    watchdog.reset()
+    assert watchdog.replica_id() is None
+
+
 # ---------------------------------------------------------------------------
 # bench sweep: a wedged axis costs its deadline, not the sweep
 # ---------------------------------------------------------------------------
