@@ -11,6 +11,10 @@
 #   make race      - srjt-race lane: the race-rule test suite
 #                    (tests/test_race.py, seeded fixtures + witness mode)
 #                    plus the focused SRJTR01-03 pass over the package
+#   make flow      - srjt-flow lane: the exception-flow/typestate test
+#                    suite (tests/test_flow.py, seeded fixtures +
+#                    protocol-witness mode) plus the focused SRJTF01-05
+#                    pass over the package
 #   make chaos     - fault-storm robustness lane (ci/chaos.sh; the same
 #                    tests also run inside tier-1, they are not slow-marked)
 #   make corrupt   - bit-flip storm lane only (injectionType 3 at the
@@ -64,7 +68,7 @@ CXXFLAGS ?= -std=c++17 -O2 -fPIC -shared -Wall
 VERSION := $(shell $(PY) -c "import re;print(re.search(r'version = \"([^\"]+)\"', open('pyproject.toml').read()).group(1))")
 GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: native test lint race chaos corrupt hang crash sanitize soak soak-mem fleet wheel bench plan join dict encode serve shard clean
+.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem fleet wheel bench plan join dict encode serve shard clean
 
 native:
 	mkdir -p $(NATIVE_DIR)
@@ -94,6 +98,13 @@ race:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_race.py -q \
 	    -p no:cacheprovider -p no:xdist -p no:randomly
 	SRJT_LINT_NO_JAXPR=1 bash ci/lint.sh --race
+
+# flow lane: seeded-fixture + protocol-witness tests, then the focused
+# SRJTF01-05 pass (exit-1-on-new-finding; AST only — no backend needed)
+flow:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flow.py -q \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	SRJT_LINT_NO_JAXPR=1 bash ci/lint.sh --flow
 
 chaos:
 	bash ci/chaos.sh

@@ -148,3 +148,20 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
 # FLEET_rNN.json artifact; this stage is the short CI-budget cut.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
     --stage-seconds 12 --kills 2 --qps-target 0 > /dev/null
+
+# stage 12 — kill/fault storm under the PROTOCOL WITNESS (srjt-flow):
+# re-run the flow-lane chaos storm with every sanctioned pair endpoint
+# (admission charge/release, begin/end_dispatch, RmmSpark alloc/dealloc,
+# sandbox + replica lifecycle, Deadline enter/exit) wrapped in counting
+# wrappers (analysis/protocol_witness.py), while tasks fail, admissions
+# race across threads, and deadlines expire mid-flight. Pass criteria
+# baked into the tests (tests/test_flow.py chaos marks): the books
+# balance at drain — ZERO unbalanced pairs in the executor's drain
+# verdict — and crosscheck() reports zero static/dynamic disagreement
+# (a dynamically leaked pair with no SRJTF02/05 counterpart means the
+# typestate scan lost a path). The outer `timeout` is part of the
+# contract: a drain wedged behind a leaked pair fails the lane loudly.
+# `make flow` runs the full flow lane (fixtures + the focused pass).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_flow.py -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,17 +1,21 @@
 #!/usr/bin/env bash
 # srjt-lint lane: block-on-new-findings static analysis.
 #
-# Runs the AST rule catalog (SRJT001-012), the srjt-race lock/shared-state
+# Runs the AST rule catalog (SRJT001-018), the srjt-race lock/shared-state
 # engine (SRJTR01-03 — interprocedural lock-order inversions, locks held
-# across blocking operations, unguarded multi-thread writes; these run as
-# project rules, so the default pass already includes them) and the jaxpr
-# auditor (SRJTX01-05) over the package. Findings recorded in
-# ci/lint_baseline.json warn; anything new exits non-zero.
-# SRJT_LINT_NO_JAXPR=1 skips the jaxpr engine (pure-AST mode; no jax
-# import — used by environments without a working backend). Pass --race
-# for the focused SRJTR-only pass (`make race`). See
-# docs/STATIC_ANALYSIS.md for the rule catalog, suppression syntax and
-# baseline workflow.
+# across blocking operations, unguarded multi-thread writes), the
+# srjt-flow exception-flow/typestate engine (SRJTF01-05 — untyped
+# boundary escapes, pair acquires without guaranteed release, double
+# releases, swallowed fault-domain exceptions, unrolled-back admission
+# charges; race and flow run as project rules, so the default pass
+# already includes them) and the jaxpr auditor (SRJTX01-05) over the
+# package. Findings recorded in ci/lint_baseline.json warn; anything new
+# exits non-zero. SRJT_LINT_NO_JAXPR=1 skips the jaxpr engine (pure-AST
+# mode; no jax import — used by environments without a working backend).
+# Pass --race for the focused SRJTR-only pass (`make race`), --flow for
+# the focused SRJTF-only pass (`make flow`), --changed to narrow any
+# pass to git-modified files. See docs/STATIC_ANALYSIS.md for the rule
+# catalog, suppression syntax and baseline workflow.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

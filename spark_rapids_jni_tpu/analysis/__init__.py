@@ -16,14 +16,22 @@ that enforce the invariants the docs state and the code relies on:
     lock held across a blocking operation, unguarded multi-thread
     writes), plus the debug-only runtime lock-witness mode
     (``witness``) that labels static inversions WITNESSED/PLAUSIBLE
-    from real chaos-storm acquisition orders.
+    from real chaos-storm acquisition orders;
+  * srjt-flow (``flow``/``protocol``): interprocedural exception-flow
+    summaries + a paired-resource typestate over the sanctioned pair
+    catalog (admission charge/rollback, begin/end_dispatch, device
+    reservation, sandbox/replica spawn/teardown, Deadline, breaker)
+    with rules SRJTF01–05, plus the debug-only runtime protocol
+    witness (``protocol_witness``) asserting pair balance at drain.
 
 Entry points::
 
     python -m spark_rapids_jni_tpu.analysis --format json
     python -m spark_rapids_jni_tpu.analysis --race   # SRJTR01-03 only
+    python -m spark_rapids_jni_tpu.analysis --flow   # SRJTF01-05 only
     make lint            # block-on-new-findings mode (ci/lint.sh)
     make race            # race tests + focused race pass
+    make flow            # flow tests + focused flow pass
 
 Findings already recorded in ``ci/lint_baseline.json`` warn; anything new
 fails. Per-line suppression: ``# srjt: noqa[SRJT001]`` (or bare
@@ -47,3 +55,10 @@ from .locks import (  # noqa: F401
     lock_order_edges,
     project_rule_races,
 )
+from .flow import (  # noqa: F401
+    ExceptionSummary,
+    build_summaries,
+    corpus_exception_classes,
+    escape_summaries,
+)
+from .protocol import FLOW_RULES, PAIR_CATALOG, project_rule_flow  # noqa: F401

@@ -21,11 +21,33 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "ci", "lint_baseline.json")
 
 
+def _changed_paths():
+    """Repo .py files touched per ``git status --porcelain`` (staged,
+    unstaged, and untracked) that still exist on disk."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "status", "--porcelain"], cwd=_REPO_ROOT,
+        capture_output=True, text=True, check=True).stdout
+    paths = []
+    for line in out.splitlines():
+        name = line[3:].strip()
+        if " -> " in name:              # rename: take the new side
+            name = name.split(" -> ", 1)[1]
+        name = name.strip('"')
+        if not name.endswith(".py"):
+            continue
+        fp = os.path.join(_REPO_ROOT, name)
+        if os.path.isfile(fp):
+            paths.append(fp)
+    return sorted(paths)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_jni_tpu.analysis",
         description="srjt-lint: TPU-invariant static analysis "
-                    "(AST rules SRJT001-014, race rules SRJTR01-03, "
+                    "(AST rules SRJT001-018, race rules SRJTR01-03, "
+                    "flow/protocol rules SRJTF01-05, "
                     "jaxpr audit SRJTX01-05)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the package)")
@@ -44,19 +66,36 @@ def main(argv=None) -> int:
     ap.add_argument("--race", action="store_true",
                     help="focused race pass: keep only the SRJTR01-03 "
                          "lock/shared-state findings (implies --no-jaxpr)")
+    ap.add_argument("--flow", action="store_true",
+                    help="focused flow pass: keep only the SRJTF01-05 "
+                         "exception-flow/protocol findings (implies "
+                         "--no-jaxpr)")
+    ap.add_argument("--changed", action="store_true",
+                    help="analyze only .py files in the git diff "
+                         "(staged + unstaged + untracked) — pre-commit "
+                         "mode; project rules see just those files")
     try:
         args = ap.parse_args(argv)
         paths = args.paths or [os.path.join(_REPO_ROOT,
                                             "spark_rapids_jni_tpu")]
+        if args.changed:
+            paths = _changed_paths()
+            if not paths:
+                print("srjt-lint: --changed: no modified .py files")
+                return 0
         ctx = ProjectContext.from_package()
         findings = analyze_paths(paths, ctx)
-        if not (args.no_jaxpr or args.race):
+        if not (args.no_jaxpr or args.race or args.flow):
             from .jaxpr_audit import run_jaxpr_audit
             findings = findings + run_jaxpr_audit()
         keep = None
         if args.race:
             from .locks import RACE_RULES
             keep = set(RACE_RULES)
+        if args.flow:
+            from .protocol import FLOW_RULES
+            keep = set(FLOW_RULES) if keep is None \
+                else keep | set(FLOW_RULES)
         if args.rules:
             named = {r.strip().upper() for r in args.rules.split(",")}
             keep = named if keep is None else (keep & named)

@@ -27,6 +27,7 @@ import it without cycles.  A few tiny helpers (``_dotted``,
 from __future__ import annotations
 
 import ast
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -948,15 +949,85 @@ def _find_decl_site(tree: ast.Module, name: str, idx) -> Tuple[int, str]:
 _GRAPH_CACHE: List[Tuple[object, CallGraph]] = []
 _GRAPH_CACHE_MAX = 4
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_DISK_CACHE_DIR = os.path.join(_REPO_ROOT, ".srjt_cache")
+_DISK_CACHE_MAX = 4
+
+
+def _corpus_signature(modules) -> Optional[tuple]:
+    """Stable on-disk memo key: ``(rel, mtime_ns, size)`` per module —
+    the nativeload.py failed-build trick.  Only the real package corpus is
+    disk-cacheable (fixture corpora under tmp dirs stay memory-only), so
+    every rel must live under the package and resolve to a real file."""
+    sig = []
+    for rel, _tree, _lines in modules:
+        if not rel.startswith("spark_rapids_jni_tpu/"):
+            return None
+        fp = os.path.join(_REPO_ROOT, rel)
+        try:
+            st = os.stat(fp)
+        except OSError:
+            return None
+        sig.append((rel, st.st_mtime_ns, st.st_size))
+    return tuple(sorted(sig)) if sig else None
+
+
+def _disk_cache_path(sig: tuple) -> str:
+    import hashlib
+    digest = hashlib.sha1(repr(sig).encode()).hexdigest()[:16]
+    return os.path.join(_DISK_CACHE_DIR, f"callgraph-{digest}.pkl")
+
+
+def _disk_load(sig: tuple) -> Optional[CallGraph]:
+    import pickle
+    try:
+        with open(_disk_cache_path(sig), "rb") as fh:
+            graph = pickle.load(fh)
+        return graph if isinstance(graph, CallGraph) else None
+    except Exception:   # missing, stale format, truncated write: rebuild
+        return None
+
+
+def _disk_store(sig: tuple, graph: CallGraph) -> None:
+    import pickle
+    try:
+        os.makedirs(_DISK_CACHE_DIR, exist_ok=True)
+        tmp = _disk_cache_path(sig) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(graph, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, _disk_cache_path(sig))   # atomic vs readers
+        # prune to the newest few so stale signatures don't accumulate
+        entries = sorted(
+            (os.path.getmtime(os.path.join(_DISK_CACHE_DIR, n)), n)
+            for n in os.listdir(_DISK_CACHE_DIR)
+            if n.startswith("callgraph-") and n.endswith(".pkl"))
+        for _mt, name in entries[:-_DISK_CACHE_MAX]:
+            os.unlink(os.path.join(_DISK_CACHE_DIR, name))
+    except Exception:   # cache is best-effort; never fail the analysis
+        pass
+
 
 def get_graph(modules) -> CallGraph:
     """Build (or reuse) the call graph for a corpus.  ``analyze_paths``
     passes the same ``modules`` list object to every project rule, so
-    identity of that list is a safe memo key for the life of the run."""
+    identity of that list is a safe memo key for the life of the run.
+    For the real package corpus the graph is additionally persisted under
+    ``.srjt_cache/`` keyed by a file-mtime signature, so ``make lint`` +
+    ``make race`` + ``make flow`` stop rebuilding the same graph across
+    CLI invocations (kill switch: ``SRJT_GRAPH_CACHE=0`` via the
+    ``analysis.graph_cache`` flag)."""
     for ref, graph in _GRAPH_CACHE:
         if ref is modules:
             return graph
-    graph = build_graph(modules)
+    from ..utils import config
+    use_disk = bool(config.get("analysis.graph_cache"))
+    sig = _corpus_signature(modules) if use_disk else None
+    graph = _disk_load(sig) if sig is not None else None
+    if graph is None:
+        graph = build_graph(modules)
+        if sig is not None:
+            _disk_store(sig, graph)
     _GRAPH_CACHE.append((modules, graph))
     del _GRAPH_CACHE[:-_GRAPH_CACHE_MAX]
     return graph

@@ -1,0 +1,532 @@
+"""srjt-flow: interprocedural exception-flow summaries + SRJTF01/SRJTF04.
+
+The lock-graph engine (``locks``) sees what the code *holds*; this module
+sees what the code *throws*.  For every function in the corpus it builds an
+:class:`ExceptionSummary` — the exception types it raises directly, the
+handler shapes it catches with, and the types that can ESCAPE it (directly
+or through confidently-resolved callees) — and classifies each escaping
+type by **typedness**: an exception class *defined in this corpus*
+(WorkerCrashError, DeadlineExceededError, AdmissionRejected, ...) maps to a
+``faultinj/guard.py`` fault domain and is routable; a generic builtin
+(RuntimeError, bare Exception) is not — ``guard.classify`` can only guess
+at it from message markers.
+
+Two rules consume the summaries here (the paired-resource rules SRJTF02/
+03/05 live in :mod:`protocol`):
+
+* **SRJTF01** — a *generic* exception (RuntimeError / Exception /
+  BaseException / AssertionError) can escape a public serving/fleet/
+  guarded boundary function.  The serving tier's callers key retry,
+  breaker, and requeue decisions off the typed error taxonomy; an
+  unclassifiable escape turns every one of those decisions into a guess.
+  Conventional argument-validation types (ValueError/TypeError/KeyError)
+  are deliberately exempt — they mean "caller bug", not "fault".
+* **SRJTF04** — a broad handler (bare ``except:``, ``except Exception``,
+  ``except BaseException``) whose protected block can raise a
+  *corpus-typed fault-domain exception*, and whose body neither re-raises
+  nor accounts for it (no metric bump, no rejection count, no
+  ``set_exception``, no breaker record — directly or through a resolved
+  callee).  Swallowing a typed fault erases exactly the signal the fault
+  taxonomy exists to carry.
+
+All traversals iterate in sorted order so output (and therefore baseline
+fingerprints) is deterministic.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding
+from .callgraph import CallGraph, get_graph
+
+__all__ = [
+    "ExceptionSummary", "build_summaries", "corpus_exception_classes",
+    "escape_summaries", "project_rule_flow_exceptions",
+]
+
+# builtin generics a boundary must never leak (SRJTF01's flag set) ...
+GENERIC_BOUNDARY = ("RuntimeError", "Exception", "BaseException",
+                    "AssertionError")
+# ... vs builtins that conventionally mean "caller bug" (exempt)
+_BUILTIN_EXCS = {
+    "BaseException", "Exception", "RuntimeError", "ValueError", "TypeError",
+    "KeyError", "IndexError", "AttributeError", "OSError", "IOError",
+    "NotImplementedError", "AssertionError", "StopIteration",
+    "ArithmeticError", "ZeroDivisionError", "OverflowError", "LookupError",
+    "EOFError", "InterruptedError", "TimeoutError", "MemoryError",
+    "UnicodeDecodeError", "FileNotFoundError", "KeyboardInterrupt",
+    "SystemExit", "GeneratorExit",
+}
+# minimal builtin ancestry (enough for handler-subsumption checks)
+_BUILTIN_BASES = {
+    "RuntimeError": {"Exception", "BaseException"},
+    "NotImplementedError": {"RuntimeError", "Exception", "BaseException"},
+    "ValueError": {"Exception", "BaseException"},
+    "TypeError": {"Exception", "BaseException"},
+    "KeyError": {"LookupError", "Exception", "BaseException"},
+    "IndexError": {"LookupError", "Exception", "BaseException"},
+    "LookupError": {"Exception", "BaseException"},
+    "AttributeError": {"Exception", "BaseException"},
+    "OSError": {"Exception", "BaseException"},
+    "IOError": {"OSError", "Exception", "BaseException"},
+    "FileNotFoundError": {"OSError", "Exception", "BaseException"},
+    "InterruptedError": {"OSError", "Exception", "BaseException"},
+    "TimeoutError": {"OSError", "Exception", "BaseException"},
+    "EOFError": {"Exception", "BaseException"},
+    "AssertionError": {"Exception", "BaseException"},
+    "StopIteration": {"Exception", "BaseException"},
+    "ArithmeticError": {"Exception", "BaseException"},
+    "ZeroDivisionError": {"ArithmeticError", "Exception", "BaseException"},
+    "OverflowError": {"ArithmeticError", "Exception", "BaseException"},
+    "MemoryError": {"Exception", "BaseException"},
+    "UnicodeDecodeError": {"ValueError", "Exception", "BaseException"},
+    "Exception": {"BaseException"},
+    "KeyboardInterrupt": {"BaseException"},
+    "SystemExit": {"BaseException"},
+    "GeneratorExit": {"BaseException"},
+}
+
+_BROAD = ("Exception", "BaseException")
+
+# handler-body calls that count as "accounted for" (SRJTF04)
+_ACCOUNT_CALLS = {
+    "bump", "inc", "inc_rejected", "count", "count_rejection",
+    "record_failure", "record_success", "set_exception",
+}
+
+
+def _dotted(node) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _exc_name(node) -> Optional[str]:
+    """Last dotted segment of a raised/caught exception expression."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Call):
+        node = node.func
+    dn = _dotted(node)
+    return dn.split(".")[-1] if dn else None
+
+
+# ---------------------------------------------------------------------------
+# corpus exception taxonomy
+
+
+def corpus_exception_classes(modules) -> Dict[str, Set[str]]:
+    """Exception classes *defined in the corpus*: ``{name: ancestor names}``
+    (ancestors include corpus bases transitively plus builtin bases).  A
+    class counts as an exception when its base chain reaches a builtin
+    exception name."""
+    bases: Dict[str, Set[str]] = {}
+    for _rel, tree, _lines in modules:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bn = {b.split(".")[-1] for b in
+                  (_dotted(base) for base in node.bases) if b}
+            bases.setdefault(node.name, set()).update(bn)
+
+    def ancestors(name: str, seen: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for b in bases.get(name, ()):
+            if b in seen:
+                continue
+            seen.add(b)
+            out.add(b)
+            out |= _BUILTIN_BASES.get(b, set())
+            out |= ancestors(b, seen)
+        return out
+
+    out: Dict[str, Set[str]] = {}
+    for name in sorted(bases):
+        anc = ancestors(name, {name})
+        if anc & _BUILTIN_EXCS:
+            out[name] = anc
+    return out
+
+
+def _ancestors_of(name: str, corpus_exc: Dict[str, Set[str]]) -> Set[str]:
+    if name in corpus_exc:
+        return corpus_exc[name]
+    return _BUILTIN_BASES.get(name, set())
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Optional[Set[str]]:
+    """Type names one handler catches; None = broad (bare/Exception)."""
+    t = handler.type
+    if t is None:
+        return None
+    names = set()
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for el in elts:
+        n = _exc_name(el)
+        if n is None:
+            continue
+        if n in _BROAD:
+            return None
+        names.add(n)
+    return names or set()
+
+
+def _caught_by(raise_name: str, try_stack: List[ast.Try],
+               corpus_exc: Dict[str, Set[str]]) -> bool:
+    """Does any enclosing handler catch ``raise_name`` (exactly, broadly,
+    or via a named ancestor)?"""
+    anc = _ancestors_of(raise_name, corpus_exc)
+    for t in try_stack:
+        for h in t.handlers:
+            names = _handler_names(h)
+            if names is None:
+                return True
+            if raise_name in names or (anc & names):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# per-function summaries
+
+
+@dataclass
+class ExceptionSummary:
+    """What one function throws, catches, and leaks."""
+    key: str
+    raises: Dict[str, int] = field(default_factory=dict)   # type -> line
+    broad_catches: List[int] = field(default_factory=list)  # handler lines
+    # type -> (witness line, via-chain); "*" = a bare re-raise of unknown
+    escapes: Dict[str, Tuple[int, str]] = field(default_factory=dict)
+
+
+def build_summaries(graph: CallGraph, modules,
+                    corpus_exc: Optional[Dict[str, Set[str]]] = None
+                    ) -> Dict[str, ExceptionSummary]:
+    """Direct (intraprocedural) summaries for every function in the graph."""
+    if corpus_exc is None:
+        corpus_exc = corpus_exception_classes(modules)
+    out: Dict[str, ExceptionSummary] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        s = ExceptionSummary(key)
+
+        def walk(stmts, try_stack, in_handler_broad):
+            for stmt in stmts:
+                if isinstance(stmt, ast.Raise):
+                    name = _exc_name(stmt.exc)
+                    if name is None:
+                        # bare re-raise: type-preserving, never a leak of a
+                        # NEW generic; record as unknown passthrough
+                        if in_handler_broad:
+                            s.escapes.setdefault(
+                                "*", (stmt.lineno, f.qualname))
+                        continue
+                    s.raises.setdefault(name, stmt.lineno)
+                    if not _caught_by(name, try_stack, corpus_exc):
+                        s.escapes.setdefault(
+                            name, (stmt.lineno, f.qualname))
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body, try_stack + [stmt], in_handler_broad)
+                    for h in stmt.handlers:
+                        if _handler_names(h) is None:
+                            s.broad_catches.append(h.lineno)
+                        walk(h.body, try_stack,
+                             _handler_names(h) is None or in_handler_broad)
+                    walk(stmt.orelse, try_stack, in_handler_broad)
+                    walk(stmt.finalbody, try_stack, in_handler_broad)
+                elif isinstance(stmt, (ast.If, ast.For, ast.While)):
+                    walk(stmt.body, try_stack, in_handler_broad)
+                    walk(stmt.orelse, try_stack, in_handler_broad)
+                elif isinstance(stmt, ast.With):
+                    walk(stmt.body, try_stack, in_handler_broad)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef)):
+                    continue   # nested defs are separate graph entries
+
+        walk(f.node.body, [], False)
+        out[key] = s
+    return out
+
+
+def escape_summaries(graph: CallGraph, modules,
+                     corpus_exc: Optional[Dict[str, Set[str]]] = None
+                     ) -> Dict[str, Dict[str, Tuple[int, str]]]:
+    """Transitive escapes: for each function, the exception types that can
+    leave it — its own uncaught raises plus escapes of confidently-resolved
+    callees that no enclosing handler at the call site catches.  Cycle-safe
+    memoized DFS (the locks.py shape)."""
+    if corpus_exc is None:
+        corpus_exc = corpus_exception_classes(modules)
+    direct = build_summaries(graph, modules, corpus_exc)
+    call_tries = _call_try_stacks(graph)
+    memo: Dict[str, Dict[str, Tuple[int, str]]] = {}
+    visiting: Set[str] = set()
+
+    def go(key: str) -> Dict[str, Tuple[int, str]]:
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return {}
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out: Dict[str, Tuple[int, str]] = {}
+        if f is not None:
+            out.update(direct[key].escapes)
+            for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                if not c.callee or c.heuristic:
+                    continue
+                try_stack = call_tries.get(key, {}).get((c.line, c.raw), [])
+                for name, (_ln, via) in sorted(go(c.callee).items()):
+                    if name == "*":
+                        continue
+                    if _caught_by(name, try_stack, corpus_exc):
+                        continue
+                    out.setdefault(
+                        name, (c.line, f"{f.qualname} → {via}"))
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    for key in sorted(graph.funcs):
+        go(key)
+    return memo
+
+
+def _call_try_stacks(graph: CallGraph) \
+        -> Dict[str, Dict[Tuple[int, str], List[ast.Try]]]:
+    """(line, dotted raw) -> enclosing-Try stack, for every call in every
+    function — the context the CallSite records don't carry."""
+    out: Dict[str, Dict[Tuple[int, str], List[ast.Try]]] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        table: Dict[Tuple[int, str], List[ast.Try]] = {}
+
+        def walk(node, try_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    dn = _dotted(child.func)
+                    if dn:
+                        table.setdefault((child.lineno, dn),
+                                         list(try_stack))
+                if isinstance(child, ast.Try):
+                    for sub in child.body:
+                        walk(sub, try_stack + [child])
+                        if isinstance(sub, ast.Call):
+                            pass
+                    for h in child.handlers:
+                        for sub in h.body:
+                            walk(sub, try_stack)
+                    for sub in child.orelse + child.finalbody:
+                        walk(sub, try_stack)
+                else:
+                    walk(child, try_stack)
+
+        walk(f.node, [])
+        out[key] = table
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SRJTF01: generic exception escaping a guarded/serving boundary
+
+
+_BOUNDARY_FILES = ("guard.py", "task_executor.py")
+
+
+def _is_boundary(f) -> bool:
+    """Public functions of the serving tier and the guarded-dispatch /
+    task-executor surfaces — the places callers key typed-error decisions
+    (retry, breaker, requeue, shed) off the exception class."""
+    if f.name.startswith("_"):
+        return False
+    if "<locals>" in f.qualname:
+        return False
+    rel = "/" + f.rel
+    return ("/serving/" in rel
+            or rel.rsplit("/", 1)[-1] in _BOUNDARY_FILES)
+
+
+def _srjtf01(graph: CallGraph, modules,
+             corpus_exc: Dict[str, Set[str]],
+             escapes=None) -> List[Finding]:
+    if escapes is None:
+        escapes = escape_summaries(graph, modules, corpus_exc)
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        if not _is_boundary(f):
+            continue
+        esc = escapes.get(key, {})
+        for name in GENERIC_BOUNDARY:
+            if name not in esc:
+                continue
+            line, via = esc[name]
+            findings.append(Finding(
+                "SRJTF01", f.rel, line,
+                f"generic `{name}` can escape the serving/guarded boundary "
+                f"`{f.qualname}` (via {via}) — guard.classify cannot route "
+                f"it to a fault domain, so retry/breaker/requeue decisions "
+                f"degrade to guesses; raise a typed engine error "
+                f"(or map it at the boundary)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJTF04: broad catch swallowing a typed fault
+
+
+def _accounts_direct(stmts) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                dn = _dotted(node.func)
+                if dn and dn.split(".")[-1] in _ACCOUNT_CALLS:
+                    return True
+    return False
+
+
+def _accounts_trans(graph: CallGraph) -> Dict[str, bool]:
+    """Functions that (transitively) raise or account — memoized DFS."""
+    memo: Dict[str, bool] = {}
+    visiting: Set[str] = set()
+
+    def go(key: str) -> bool:
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return False
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out = False
+        if f is not None:
+            if _accounts_direct(f.node.body):
+                out = True
+            else:
+                for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                    if c.callee and not c.heuristic and go(c.callee):
+                        out = True
+                        break
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    for key in sorted(graph.funcs):
+        go(key)
+    return memo
+
+
+def _body_typed_raises(stmts, graph: CallGraph, func_key: str,
+                       escapes: Dict[str, Dict[str, Tuple[int, str]]],
+                       corpus_exc: Dict[str, Set[str]],
+                       call_table) -> Set[str]:
+    """Corpus-typed exception names that can surface from a try body —
+    direct raises plus transitive escapes of resolved calls, minus types
+    caught by tries nested inside the body itself."""
+    out: Set[str] = set()
+
+    def walk(nodes, inner):
+        for stmt in nodes:
+            if isinstance(stmt, ast.Raise):
+                name = _exc_name(stmt.exc)
+                if name in corpus_exc and not _caught_by(name, inner,
+                                                         corpus_exc):
+                    out.add(name)
+            for node in ast.walk(stmt) if not isinstance(
+                    stmt, (ast.Try, ast.FunctionDef,
+                           ast.AsyncFunctionDef)) else ():
+                if isinstance(node, ast.Call):
+                    dn = _dotted(node.func)
+                    key = (node.lineno, dn) if dn else None
+                    callee = call_table.get(key)
+                    if callee:
+                        for name in escapes.get(callee, {}):
+                            if name in corpus_exc and not _caught_by(
+                                    name, inner, corpus_exc):
+                                out.add(name)
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, inner + [stmt])
+                for h in stmt.handlers:
+                    walk(h.body, inner)
+                walk(stmt.orelse + stmt.finalbody, inner)
+
+    walk(stmts, [])
+    return out
+
+
+def _srjtf04(graph: CallGraph, modules,
+             corpus_exc: Dict[str, Set[str]],
+             escapes=None) -> List[Finding]:
+    if escapes is None:
+        escapes = escape_summaries(graph, modules, corpus_exc)
+    accounts = _accounts_trans(graph)
+    # (line, raw) -> callee, per function (resolution for try-body calls)
+    call_map: Dict[str, Dict[Tuple[int, str], str]] = {}
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        call_map[key] = {(c.line, c.raw): c.callee
+                         for c in f.calls if c.callee and not c.heuristic}
+
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        for node in ast.walk(f.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if _handler_names(h) is not None:
+                    continue
+                typed = _body_typed_raises(
+                    node.body, graph, key, escapes, corpus_exc,
+                    call_map[key])
+                if not typed:
+                    continue
+                if _accounts_direct(h.body):
+                    continue
+                # `except ... as e` where the body *reads* e: the fault is
+                # captured (routed to a future/outcome), not swallowed
+                if h.name and any(
+                        isinstance(n, ast.Name) and n.id == h.name
+                        and isinstance(n.ctx, ast.Load)
+                        for st in h.body for n in ast.walk(st)):
+                    continue
+                called = [call_map[key].get((c.lineno, _dotted(c.func)))
+                          for st in h.body for c in ast.walk(st)
+                          if isinstance(c, ast.Call) and _dotted(c.func)]
+                if any(cal and accounts.get(cal) for cal in called):
+                    continue
+                names = ", ".join(sorted(typed)[:3])
+                findings.append(Finding(
+                    "SRJTF04", f.rel, h.lineno,
+                    f"broad catch in `{f.qualname}` can swallow typed "
+                    f"fault(s) {names} without re-raise, metric count, or "
+                    f"future resolution — the fault taxonomy's signal "
+                    f"(breaker/requeue/quarantine decisions) dies here; "
+                    f"re-raise, narrow the handler, or account for it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project-rule entry (combined with the protocol rules in rules.py)
+
+
+def project_rule_flow_exceptions(modules, ctx) -> List[Finding]:
+    """SRJTF01 + SRJTF04 over the already-parsed corpus."""
+    graph = get_graph(modules)
+    corpus_exc = corpus_exception_classes(modules)
+    escapes = escape_summaries(graph, modules, corpus_exc)
+    return _srjtf01(graph, modules, corpus_exc, escapes) \
+        + _srjtf04(graph, modules, corpus_exc, escapes)

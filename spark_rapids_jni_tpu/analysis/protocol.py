@@ -1,0 +1,584 @@
+"""srjt-flow: paired-resource typestate rules SRJTF02/03/05 + rule entry.
+
+The engine's cross-layer correctness now lives in *protocols*: operations
+that come in sanctioned pairs where the second half must run on every
+path — including the exception paths the happy-path tests never walk.
+:data:`PAIR_CATALOG` declares the pairs; the scanners here run a small
+forward typestate ("charged" → "settled") over each function body using
+the shared project call graph to resolve whether a cleanup call
+*transitively* reaches the real release (``self._finish`` counts because
+it reaches ``registry.release``; a bare log call does not).
+
+Rules (SRJTF01/04, the exception-flow half, live in :mod:`flow`):
+
+* **SRJTF02** — acquire without a guaranteed release on some path:
+  a ``begin_dispatch`` handle or ``RmmSpark.alloc`` charge followed by a
+  risky statement (a call that can raise) with no enclosing ``try`` whose
+  handler/finally releases; a ``Deadline``/``adopt`` result discarded or
+  never entered; a breaker ``allow()`` in a function that never records
+  an outcome.
+* **SRJTF03** — double-release / release-without-acquire: the same
+  release executed twice on one path (textual twin in a linear block, or
+  in both a try body and its ``finally``), or both breaker outcomes
+  recorded back-to-back.
+* **SRJTF05** — a *global admission charge* (``try_admit`` flag-style or
+  ``admit`` raise-style) followed by risky work with no rollback on the
+  exception path.  The charge is cluster-wide state; leaking it pins
+  ``in_flight``/``hbm_reserved`` for a query that will never finish and
+  starves every later admit decision.
+
+Liability ends at a release, at a call that transitively reaches one
+(ownership handoff), or at ``return`` (the charge is *meant* to outlive
+the function — e.g. released by ``_finish`` when the future resolves).
+Exception-path handlers are deliberately not scanned as live code: a
+release there protects, it does not re-arm.
+
+All iteration is sorted; findings are deterministic for baselining.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding
+from .callgraph import CallGraph, get_graph
+from .flow import _dotted, project_rule_flow_exceptions
+
+__all__ = ["PAIR_CATALOG", "FLOW_RULES", "project_rule_flow"]
+
+# The sanctioned pair catalog — the single place that names which
+# operations must balance.  The runtime witness (protocol_witness) counts
+# the same pairs live; STATIC_ANALYSIS.md documents them.
+PAIR_CATALOG = {
+    "admission": ("SessionRegistry.try_admit / AdmissionController.admit",
+                  "SessionRegistry.release (rollback via completed=None)"),
+    "dispatch": ("watchdog.begin_dispatch", "watchdog.end_dispatch"),
+    "reservation": ("RmmSpark.alloc (device_reservation enter)",
+                    "RmmSpark.dealloc (device_reservation exit)"),
+    "sandbox": ("SandboxWorker._spawn", "SandboxWorker._teardown"),
+    "replica": ("ReplicaHandle.spawn", "ReplicaHandle.teardown"),
+    "deadline": ("Deadline.__enter__ / adopt", "Deadline.__exit__ / restore"),
+    "breaker": ("CircuitBreaker.allow",
+                "CircuitBreaker.record_success / record_failure"),
+    "spill": ("SpillableTable fingerprint-at-spill",
+              "SpillableTable verify-at-get"),
+}
+
+FLOW_RULES = ("SRJTF01", "SRJTF02", "SRJTF03", "SRJTF04", "SRJTF05")
+
+# calls that cannot plausibly raise on the liable path (pure lookups,
+# constructors of builtin containers, clock reads)
+_SAFE_CALLS = {
+    "len", "isinstance", "issubclass", "next", "iter", "str", "int",
+    "float", "bool", "repr", "min", "max", "abs", "id", "getattr",
+    "hasattr", "sorted", "list", "dict", "tuple", "set", "frozenset",
+    "format", "join", "split", "strip", "startswith", "endswith",
+    "append", "extend", "add", "discard", "items", "keys", "values",
+    "monotonic", "time", "perf_counter", "count", "range", "enumerate",
+    "zip", "sum", "round", "get", "copy", "deque", "Event", "field",
+    # metrics bumps and sleeps: observational, never raise in-protocol
+    "sleep", "inc", "inc_rejected", "bump", "observe",
+    "info", "debug", "warning",
+}
+
+
+def _last(dn: Optional[str]) -> Optional[str]:
+    return dn.split(".")[-1] if dn else None
+
+
+def _calls_in(stmt) -> List[Tuple[int, str, ast.Call]]:
+    """(line, dotted, node) for every call in a statement, skipping nested
+    function/class definitions."""
+    out = []
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not stmt:
+            continue
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func)
+            if dn:
+                out.append((node.lineno, dn, node))
+    return out
+
+
+def _is_risky(stmt) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for _ln, dn, _node in _calls_in(stmt):
+        if _last(dn) not in _SAFE_CALLS:
+            return True
+    return False
+
+
+def _names_in(expr) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# transitive reachability of a release (ownership-handoff resolution)
+
+
+def _reaches(graph: CallGraph, direct) -> Dict[str, bool]:
+    """Memoized cycle-safe closure of ``direct(FuncInfo) -> bool`` over
+    confidently-resolved call edges (the locks.py DFS shape)."""
+    memo: Dict[str, bool] = {}
+    visiting: Set[str] = set()
+
+    def go(key: str) -> bool:
+        if key in memo:
+            return memo[key]
+        if key in visiting:
+            return False
+        visiting.add(key)
+        f = graph.funcs.get(key)
+        out = False
+        if f is not None:
+            if direct(f):
+                out = True
+            else:
+                for c in sorted(f.calls, key=lambda c: (c.line, c.raw)):
+                    if c.callee and not c.heuristic and go(c.callee):
+                        out = True
+                        break
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    for key in sorted(graph.funcs):
+        go(key)
+    return memo
+
+
+def _has_admission_release(f) -> bool:
+    for node in ast.walk(f.node):
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func)
+            if dn and _last(dn) == "release" \
+                    and (node.args or node.keywords):
+                return True
+    return False
+
+
+def _has_breaker_record(f) -> bool:
+    for node in ast.walk(f.node):
+        if isinstance(node, ast.Call):
+            if _last(_dotted(node.func)) in ("record_success",
+                                             "record_failure"):
+                return True
+    return False
+
+
+def _resolve_map(f) -> Dict[Tuple[int, str], str]:
+    return {(c.line, c.raw): c.callee
+            for c in f.calls if c.callee and not c.heuristic}
+
+
+# ---------------------------------------------------------------------------
+# the forward typestate scanner
+
+
+class _PairSpec:
+    """One acquire/release pair for the scanner: matchers + message."""
+
+    def __init__(self, rule, pair, charge_of, is_release_call,
+                 reaches_release, message):
+        self.rule = rule
+        self.pair = pair
+        self.charge_of = charge_of          # stmt -> Optional[(flag, line)]
+        self.is_release_call = is_release_call   # (dotted, call) -> bool
+        self.reaches_release = reaches_release   # key -> bool (or {})
+        self.message = message              # (qualname, charge_line) -> str
+
+
+class _ScanState:
+    __slots__ = ("charged", "flag", "charge_line", "done", "finding_line")
+
+    def __init__(self):
+        self.charged = False
+        self.flag = None
+        self.charge_line = 0
+        self.done = False
+        self.finding_line = None
+
+
+def _releases_stmt(stmt, spec: _PairSpec, rmap) -> bool:
+    for _ln, dn, node in _calls_in(stmt):
+        if spec.is_release_call(dn, node):
+            return True
+        callee = rmap.get((_ln, dn))
+        if callee and spec.reaches_release.get(callee):
+            return True
+    return False
+
+
+def _try_protects(t: ast.Try, spec: _PairSpec, rmap) -> bool:
+    for stmts in [h.body for h in t.handlers] + [t.finalbody]:
+        for stmt in stmts:
+            if _releases_stmt(stmt, spec, rmap):
+                return True
+    return False
+
+
+def _protected(try_stack, spec, rmap) -> bool:
+    return any(_try_protects(t, spec, rmap) for t in try_stack)
+
+
+def _scan_pair(f, spec: _PairSpec, rmap) -> List[Tuple[int, int]]:
+    """Run the typestate over one function; returns
+    ``[(charge_line, leak_line)]`` (at most one flag per charge)."""
+    flags: List[Tuple[int, int]] = []
+    st = _ScanState()
+
+    def liable(stmt, try_stack) -> None:
+        if st.done:
+            return
+        # rejection-guard on a flag-style charge: that branch was never
+        # charged, skip it wholesale
+        if st.flag and isinstance(stmt, ast.If) \
+                and st.flag in _names_in(stmt.test):
+            return
+        if _releases_stmt(stmt, spec, rmap):
+            st.done = True
+            return
+        if isinstance(stmt, ast.Return):
+            st.done = True        # charge meant to outlive the function
+            return
+        if isinstance(stmt, ast.Try):
+            for sub in stmt.body:
+                liable(sub, try_stack + [stmt])
+            # handlers are the exception path: a release there protects
+            # (checked via _try_protects), it is not live code to scan
+            for sub in stmt.orelse:
+                liable(sub, try_stack)
+            for sub in stmt.finalbody:
+                liable(sub, try_stack)
+            return
+        if isinstance(stmt, (ast.If, ast.For, ast.While, ast.With)):
+            test = getattr(stmt, "test", None)
+            if test is not None and _expr_risky(test) \
+                    and not _protected(try_stack, spec, rmap):
+                st.finding_line = stmt.lineno
+                st.done = True
+                return
+            for sub in stmt.body + getattr(stmt, "orelse", []):
+                liable(sub, try_stack)
+            return
+        if _is_risky(stmt) and not _protected(try_stack, spec, rmap):
+            st.finding_line = stmt.lineno
+            st.done = True
+            return
+
+    def _expr_risky(expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                if _last(_dotted(node.func)) not in _SAFE_CALLS:
+                    return True
+        return False
+
+    def scan(stmts, try_stack):
+        for stmt in stmts:
+            if st.done:
+                if st.finding_line is not None:
+                    flags.append((st.charge_line, st.finding_line))
+                    st.finding_line = None
+                # keep looking for further, independent charges
+                st.charged = False
+                st.done = False
+                st.flag = None
+            if st.charged:
+                liable(stmt, try_stack)
+                continue
+            ch = spec.charge_of(stmt)
+            if ch is not None:
+                st.charged = True
+                st.flag, st.charge_line = ch
+                continue
+            # descend looking for charges inside branches
+            if isinstance(stmt, ast.Try):
+                scan(stmt.body, try_stack + [stmt])
+                if not st.charged:
+                    for h in stmt.handlers:
+                        scan(h.body, try_stack)
+                scan(stmt.orelse, try_stack)
+                scan(stmt.finalbody, try_stack)
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With)):
+                scan(stmt.body, try_stack)
+                if not st.charged:
+                    scan(getattr(stmt, "orelse", []), try_stack)
+
+    scan(f.node.body, [])
+    if st.finding_line is not None:
+        flags.append((st.charge_line, st.finding_line))
+    return flags
+
+
+# ---------------------------------------------------------------------------
+# SRJTF05 — admission charge without rollback
+
+
+def _charge_admission(stmt):
+    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        if _last(_dotted(stmt.value.func)) == "try_admit":
+            tgt = stmt.targets[0]
+            name = tgt.id if isinstance(tgt, ast.Name) else None
+            return (name, stmt.lineno)
+    val = stmt.value if isinstance(stmt, (ast.Expr, ast.Assign)) else None
+    if isinstance(val, ast.Call):
+        dn = _dotted(val.func)
+        # raise-style charge: must be a *controller* method so a local
+        # helper merely named admit() doesn't count
+        if dn and _last(dn) == "admit" and "admission" in dn.lower():
+            return (None, stmt.lineno)
+    return None
+
+
+def _srjtf05(graph: CallGraph) -> List[Finding]:
+    reaches_rel = _reaches(graph, _has_admission_release)
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        rmap = _resolve_map(f)
+        spec = _PairSpec(
+            "SRJTF05", "admission", _charge_admission,
+            lambda dn, node: _last(dn) == "release"
+            and bool(node.args or node.keywords),
+            reaches_rel, None)
+        for charge_line, leak_line in _scan_pair(f, spec, rmap):
+            findings.append(Finding(
+                "SRJTF05", f.rel, leak_line,
+                f"global admission charge at line {charge_line} in "
+                f"`{f.qualname}` is not rolled back if this statement "
+                f"raises — the tenant's in_flight/hbm_reserved stay pinned "
+                f"for a query that will never finish; wrap in "
+                f"try/except with registry.release(..., completed=None)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJTF02 — acquire without guaranteed release
+
+
+def _charge_dispatch(stmt):
+    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        if _last(_dotted(stmt.value.func)) == "begin_dispatch":
+            return (None, stmt.lineno)
+    return None
+
+
+def _charge_rmm_alloc(stmt):
+    val = stmt.value if isinstance(stmt, (ast.Expr, ast.Assign)) else None
+    if isinstance(val, ast.Call):
+        dn = _dotted(val.func)
+        if dn and dn.split(".")[-2:] == ["RmmSpark", "alloc"]:
+            return (None, stmt.lineno)
+    return None
+
+
+def _srjtf02_scans(graph: CallGraph) -> List[Finding]:
+    findings = []
+    specs = [
+        ("dispatch", _charge_dispatch,
+         lambda dn, node: _last(dn) == "end_dispatch",
+         "watchdog dispatch record opened at line {0} in `{1}` has no "
+         "guaranteed end_dispatch if this statement raises — the watchdog "
+         "will report a phantom stuck dispatch forever; use "
+         "try/finally end_dispatch(handle)"),
+        ("reservation", _charge_rmm_alloc,
+         lambda dn, node: _last(dn) == "dealloc",
+         "device reservation charged at line {0} in `{1}` leaks if this "
+         "statement raises before the try/finally dealloc — the HBM "
+         "accountant stays pinned; move the risky work inside the "
+         "try body"),
+    ]
+    for pair, charge_of, is_rel, msg in specs:
+        for key in sorted(graph.funcs):
+            f = graph.funcs[key]
+            rmap = _resolve_map(f)
+            spec = _PairSpec("SRJTF02", pair, charge_of, is_rel, {}, None)
+            for charge_line, leak_line in _scan_pair(f, spec, rmap):
+                findings.append(Finding(
+                    "SRJTF02", f.rel, leak_line,
+                    msg.format(charge_line, f.qualname)))
+    return findings
+
+
+_DEADLINE_CTORS = ("Deadline", "adopt", "adopt_wire", "ensure_deadline")
+
+
+def _srjtf02_deadline(graph: CallGraph) -> List[Finding]:
+    """A Deadline (constructed or adopted) that is never entered: a bare
+    Expr discard, or an assigned name never used again."""
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        # names used anywhere (loads) in the function, for unused-check
+        loads: Dict[str, int] = {}
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads[node.id] = loads.get(node.id, 0) + 1
+        for stmt in ast.walk(f.node):
+            val = None
+            if isinstance(stmt, (ast.Expr, ast.Assign)):
+                val = stmt.value
+            if not (isinstance(val, ast.Call)
+                    and _last(_dotted(val.func)) in _DEADLINE_CTORS):
+                continue
+            if isinstance(stmt, ast.Expr):
+                findings.append(Finding(
+                    "SRJTF02", f.rel, stmt.lineno,
+                    f"deadline from `{_dotted(val.func)}` in "
+                    f"`{f.qualname}` is discarded without being entered — "
+                    f"the budget is never installed and never restored; "
+                    f"use `with ...:` or keep and enter the handle"))
+            elif isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and loads.get(stmt.targets[0].id, 0) == 0:
+                findings.append(Finding(
+                    "SRJTF02", f.rel, stmt.lineno,
+                    f"deadline assigned to `{stmt.targets[0].id}` in "
+                    f"`{f.qualname}` is never entered, returned, or "
+                    f"passed on — the budget never takes effect; enter it "
+                    f"with `with` or drop the call"))
+    return findings
+
+
+def _srjtf02_breaker(graph: CallGraph) -> List[Finding]:
+    """``allow()`` consumed (it eats the HALF_OPEN probe) by a function
+    that never records an outcome, directly or transitively."""
+    reaches_rec = _reaches(graph, _has_breaker_record)
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+        if f.class_name == "CircuitBreaker":
+            continue        # the breaker's own internals
+        allow_line = None
+        calls = [(c.lineno, _dotted(c.func), c)
+                 for c in ast.walk(f.node)
+                 if isinstance(c, ast.Call) and _dotted(c.func)]
+        for _ln, dn, node in sorted(calls, key=lambda t: (t[0], t[1])):
+            if _last(dn) == "allow" and not node.args:
+                allow_line = _ln
+                break
+        if allow_line is None:
+            continue
+        if reaches_rec.get(key):
+            continue
+        findings.append(Finding(
+            "SRJTF02", f.rel, allow_line,
+            f"breaker allow() in `{f.qualname}` consumes the HALF_OPEN "
+            f"probe but no record_success/record_failure is reachable "
+            f"from here — a probe that is never scored re-opens the "
+            f"breaker spuriously; record the outcome or route the call "
+            f"through a path that does"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# SRJTF03 — double-release / release-without-acquire
+
+
+_RELEASE_NAMES = ("end_dispatch", "dealloc", "release")
+
+
+def _release_sig(stmt) -> Optional[str]:
+    """Canonical text of a statement that is exactly one release call."""
+    if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value, ast.Call):
+        return None
+    dn = _dotted(stmt.value.func)
+    if _last(dn) not in _RELEASE_NAMES:
+        return None
+    if _last(dn) == "release" and not (stmt.value.args
+                                       or stmt.value.keywords):
+        return None       # Lock.release() is the lock engine's business
+    return ast.dump(stmt.value)
+
+
+def _srjtf03(graph: CallGraph) -> List[Finding]:
+    findings = []
+    for key in sorted(graph.funcs):
+        f = graph.funcs[key]
+
+        def blocks(node):
+            for child in ast.walk(node):
+                for attr in ("body", "orelse", "finalbody"):
+                    stmts = getattr(child, attr, None)
+                    if isinstance(stmts, list) and stmts \
+                            and isinstance(stmts[0], ast.stmt):
+                        yield stmts
+                if isinstance(child, ast.Try):
+                    for h in child.handlers:
+                        yield h.body
+
+        for block in blocks(f.node):
+            seen: Dict[str, int] = {}
+            for stmt in block:
+                sig = _release_sig(stmt)
+                if sig is None:
+                    continue
+                if sig in seen:
+                    findings.append(Finding(
+                        "SRJTF03", f.rel, stmt.lineno,
+                        f"release at line {seen[sig]} in `{f.qualname}` "
+                        f"is executed again here with identical arguments "
+                        f"— the pair underflows (double rollback / double "
+                        f"dealloc); release exactly once per acquire"))
+                else:
+                    seen[sig] = stmt.lineno
+
+        # release in try body AND same release in its finally: the
+        # success path runs both
+        for node in ast.walk(f.node):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            body_sigs = {s: st.lineno for st in node.body
+                         for s in ([_release_sig(st)] if _release_sig(st)
+                                   else [])}
+            for stmt in node.finalbody:
+                sig = _release_sig(stmt)
+                if sig and sig in body_sigs:
+                    findings.append(Finding(
+                        "SRJTF03", f.rel, stmt.lineno,
+                        f"release in `{f.qualname}` runs in both the try "
+                        f"body (line {body_sigs[sig]}) and its finally — "
+                        f"on the success path it executes twice; release "
+                        f"in the finally only"))
+
+        # both breaker outcomes scored back-to-back in one linear block
+        for block in blocks(f.node):
+            prev = None
+            for stmt in block:
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)):
+                    prev = None
+                    continue
+                dn = _dotted(stmt.value.func)
+                nm = _last(dn)
+                if nm in ("record_success", "record_failure"):
+                    recv = dn.rsplit(".", 1)[0] if "." in dn else ""
+                    if prev and prev[0] == recv and prev[1] != nm:
+                        findings.append(Finding(
+                            "SRJTF03", f.rel, stmt.lineno,
+                            f"breaker on `{recv or 'self'}` records both "
+                            f"success and failure on the same path in "
+                            f"`{f.qualname}` — one allow() must score "
+                            f"exactly one outcome"))
+                    prev = (recv, nm)
+                else:
+                    prev = None
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# combined project-rule entry (registered in rules.PROJECT_RULES)
+
+
+def project_rule_flow(modules, ctx) -> List[Finding]:
+    """SRJTF01–05: exception-flow + paired-resource typestate."""
+    graph = get_graph(modules)
+    findings = project_rule_flow_exceptions(modules, ctx)
+    findings += _srjtf02_scans(graph)
+    findings += _srjtf02_deadline(graph)
+    findings += _srjtf02_breaker(graph)
+    findings += _srjtf03(graph)
+    findings += _srjtf05(graph)
+    return findings

@@ -1,0 +1,344 @@
+"""Protocol-witness mode: runtime counting of the sanctioned pair catalog.
+
+The static typestate engine (:mod:`protocol`) over-approximates: it flags
+every path on which an acquire *could* miss its release.  Witness mode
+closes the loop from the other side — ``install()`` patches the real
+endpoints of each pair in :data:`protocol.PAIR_CATALOG` (admission
+charge/release, ``begin_dispatch``/``end_dispatch``, ``RmmSpark``
+alloc/dealloc, sandbox and replica spawn/teardown, ``Deadline``
+enter/exit) with counting wrappers, so a chaos storm can assert the books
+balance at the quiesce points: ``TaskExecutor.drain()`` and fleet
+``drain()`` call :func:`check_drain`, which raises (strict mode) when any
+pair is unbalanced after a drain.
+
+``crosscheck(findings)`` then joins the two views: a static SRJTF02/05
+finding whose pair is dynamically unbalanced is **WITNESSED** — a storm
+actually leaked it; one whose pair balanced stays **PLAUSIBLE**; a
+dynamically unbalanced pair with *no* static finding means the typestate
+scan missed a path (``ci/chaos.sh`` stage 12 fails on this disagreement).
+
+Debug-only: each wrapped call adds one counter update under a raw lock.
+Enable with the ``witness.protocol`` config flag / ``SRJT_WITNESS=1``
+(``maybe_install``) or call ``install()`` in a test.  The ``deadline``
+pair is counted but excluded from the drain assertion — the *caller's*
+deadline may lawfully still be open across a drain; ``spill`` is
+fingerprint bookkeeping, not zero-sum, and is informational only.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "PAIRS", "ASSERTED_PAIRS", "install", "uninstall", "installed",
+    "maybe_install", "reset", "snapshot", "unbalanced", "note_enter",
+    "note_exit", "check_drain", "crosscheck",
+]
+
+# counted pairs (superset of the asserted set)
+PAIRS = ("admission", "dispatch", "reservation", "sandbox", "replica",
+         "deadline")
+# pairs that must balance at a drain quiesce point
+ASSERTED_PAIRS = ("admission", "dispatch", "reservation", "sandbox",
+                  "replica")
+
+_REAL_LOCK = threading.Lock          # captured before any lock-witness patch
+_REG_LOCK = _REAL_LOCK()
+_ENTERS: Dict[str, int] = {}
+_EXITS: Dict[str, int] = {}
+_INSTALLED = False
+_PATCHES: List[tuple] = []           # (obj, attr, original)
+
+
+def note_enter(pair: str) -> None:
+    with _REG_LOCK:
+        _ENTERS[pair] = _ENTERS.get(pair, 0) + 1
+
+
+def note_exit(pair: str) -> None:
+    with _REG_LOCK:
+        _EXITS[pair] = _EXITS.get(pair, 0) + 1
+
+
+def reset() -> None:
+    with _REG_LOCK:
+        _ENTERS.clear()
+        _EXITS.clear()
+
+
+def snapshot() -> Dict[str, Dict[str, int]]:
+    """``{pair: {"enter": n, "exit": n}}`` for every pair touched."""
+    with _REG_LOCK:
+        pairs = sorted(set(_ENTERS) | set(_EXITS))
+        return {p: {"enter": _ENTERS.get(p, 0), "exit": _EXITS.get(p, 0)}
+                for p in pairs}
+
+
+def unbalanced(asserted_only: bool = True) -> Dict[str, int]:
+    """``{pair: enter-exit}`` for pairs whose books don't balance."""
+    snap = snapshot()
+    out = {}
+    for pair, c in snap.items():
+        if asserted_only and pair not in ASSERTED_PAIRS:
+            continue
+        delta = c["enter"] - c["exit"]
+        if delta != 0:
+            out[pair] = delta
+    return out
+
+
+# ---------------------------------------------------------------------------
+# endpoint patching
+
+
+def _patch(obj, attr: str, wrapper) -> None:
+    original = getattr(obj, attr)
+    _PATCHES.append((obj, attr, original))
+    setattr(obj, attr, wrapper(original))
+
+
+def _install_admission() -> None:
+    from ..serving.sessions import SessionRegistry
+
+    def wrap_try_admit(orig):
+        def try_admit(self, tenant_id, estimate_bytes):
+            reason = orig(self, tenant_id, estimate_bytes)
+            if reason is None:       # None = admitted = charged
+                note_enter("admission")
+            return reason
+        return try_admit
+
+    def wrap_release(orig):
+        def release(self, tenant_id, nbytes, completed=True):
+            note_exit("admission")
+            return orig(self, tenant_id, nbytes, completed)
+        return release
+
+    _patch(SessionRegistry, "try_admit", wrap_try_admit)
+    _patch(SessionRegistry, "release", wrap_release)
+
+
+def _install_dispatch() -> None:
+    from ..faultinj import watchdog
+
+    def wrap_begin(orig):
+        def begin_dispatch(api):
+            handle = orig(api)
+            if handle is not None:   # None = watchdog off / no deadline
+                note_enter("dispatch")
+            return handle
+        return begin_dispatch
+
+    def wrap_end(orig):
+        def end_dispatch(handle):
+            if handle is not None:
+                note_exit("dispatch")
+            return orig(handle)
+        return end_dispatch
+
+    _patch(watchdog, "begin_dispatch", wrap_begin)
+    _patch(watchdog, "end_dispatch", wrap_end)
+
+
+def _install_reservation() -> None:
+    from ..memory.rmm_spark import RmmSpark
+
+    def wrap_alloc(orig):
+        def alloc(nbytes):
+            orig(nbytes)             # orig is the bound classmethod
+            note_enter("reservation")
+        return alloc
+
+    def wrap_dealloc(orig):
+        def dealloc(nbytes):
+            note_exit("reservation")
+            return orig(nbytes)
+        return dealloc
+
+    _patch(RmmSpark, "alloc", wrap_alloc)
+    _patch(RmmSpark, "dealloc", wrap_dealloc)
+
+
+def _install_sandbox() -> None:
+    from ..faultinj.sandbox import SandboxWorker
+
+    def wrap_spawn(orig):
+        def _spawn(self):
+            orig(self)
+            note_enter("sandbox")
+        return _spawn
+
+    def wrap_teardown(orig):
+        def _teardown(self):
+            if self._proc is not None:   # idempotent second teardown
+                note_exit("sandbox")
+            return orig(self)
+        return _teardown
+
+    _patch(SandboxWorker, "_spawn", wrap_spawn)
+    _patch(SandboxWorker, "_teardown", wrap_teardown)
+
+
+def _install_replica() -> None:
+    from ..serving.fleet import ReplicaHandle
+
+    def wrap_spawn(orig):
+        def spawn(self):
+            orig(self)
+            note_enter("replica")
+        return spawn
+
+    def wrap_teardown(orig):
+        def teardown(self):
+            if self.proc is not None or self.tx is not None:
+                note_exit("replica")
+            return orig(self)
+        return teardown
+
+    _patch(ReplicaHandle, "spawn", wrap_spawn)
+    _patch(ReplicaHandle, "teardown", wrap_teardown)
+
+
+def _install_deadline() -> None:
+    from ..faultinj.watchdog import Deadline
+
+    def wrap_enter(orig):
+        def __enter__(self):
+            out = orig(self)
+            note_enter("deadline")
+            return out
+        return __enter__
+
+    def wrap_exit(orig):
+        def __exit__(self, *a):
+            note_exit("deadline")
+            return orig(self, *a)
+        return __exit__
+
+    _patch(Deadline, "__enter__", wrap_enter)
+    _patch(Deadline, "__exit__", wrap_exit)
+
+
+def install() -> None:
+    """Patch every pair endpoint (idempotent)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _install_admission()
+    _install_dispatch()
+    _install_reservation()
+    _install_sandbox()
+    _install_replica()
+    _install_deadline()
+    _INSTALLED = True
+
+
+def uninstall() -> None:
+    """Restore the original endpoints (idempotent); keeps the counters —
+    ``reset()`` clears them."""
+    global _INSTALLED
+    while _PATCHES:
+        obj, attr, original = _PATCHES.pop()
+        setattr(obj, attr, original)
+    _INSTALLED = False
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def maybe_install() -> bool:
+    """Install when the ``witness.protocol`` config flag is on."""
+    from ..utils import config
+    if bool(config.get("witness.protocol")):
+        install()
+    return _INSTALLED
+
+
+# ---------------------------------------------------------------------------
+# quiesce-point assertion + static/dynamic crosscheck
+
+
+def check_drain(site: str, strict: Optional[bool] = None) -> Dict[str, object]:
+    """Assert pair balance at a quiesce point (a completed drain).
+
+    Returns a verdict dict ``{"site", "counts", "unbalanced"}``; in strict
+    mode (the ``witness.strict`` flag / ``SRJT_WITNESS_STRICT``, default
+    on) raises ``AssertionError`` when any asserted pair is unbalanced.
+    """
+    if strict is None:
+        from ..utils import config
+        strict = bool(config.get("witness.strict"))
+    bad = unbalanced()
+    verdict = {"site": site, "counts": snapshot(), "unbalanced": bad}
+    if strict and bad:
+        raise AssertionError(
+            f"protocol witness: unbalanced pairs at {site}: {bad} "
+            f"(enter-exit deltas; every acquire must release by drain)")
+    return verdict
+
+
+def _finding_pair(finding) -> Optional[str]:
+    """Classify a static SRJTF02/05 finding onto a witness pair by its
+    message keywords."""
+    msg = finding.message.lower()
+    if finding.rule == "SRJTF05" or "admission" in msg:
+        return "admission"
+    if "dispatch" in msg:
+        return "dispatch"
+    if "reservation" in msg or "dealloc" in msg:
+        return "reservation"
+    if "sandbox" in msg:
+        return "sandbox"
+    if "replica" in msg:
+        return "replica"
+    if "deadline" in msg:
+        return "deadline"
+    if "breaker" in msg:
+        return "breaker"
+    return None
+
+
+def crosscheck(findings=None) -> Dict[str, list]:
+    """Join live pair balance against static SRJTF02/05 findings.
+
+    Returns::
+
+        {"witnessed":    [(pair, fingerprint), ...]  # static finding whose
+                                                     # pair leaked live
+         "plausible":    [(pair, fingerprint), ...]  # static finding, books
+                                                     # balanced this run
+         "dynamic_only": [pair, ...]}                # leaked pair with no
+                                                     # static counterpart
+
+    ``findings`` defaults to a fresh repo-wide flow pass (pre-baseline:
+    crosscheck classifies *all* static hazards, accepted or not).
+    """
+    if findings is None:
+        from .core import analyze_paths, ProjectContext
+        from .protocol import FLOW_RULES
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "spark_rapids_jni_tpu")
+        ctx = ProjectContext.from_package(pkg)
+        findings = [f for f in analyze_paths([pkg], ctx)
+                    if f.rule in FLOW_RULES]
+    bad = unbalanced(asserted_only=False)
+    witnessed, plausible = [], []
+    static_pairs = set()
+    for f in findings:
+        if f.rule not in ("SRJTF02", "SRJTF05"):
+            continue
+        pair = _finding_pair(f)
+        if pair is None:
+            continue
+        static_pairs.add(pair)
+        if pair in bad:
+            witnessed.append((pair, f.fingerprint))
+        else:
+            plausible.append((pair, f.fingerprint))
+    dynamic_only = sorted(p for p in bad
+                          if p in ASSERTED_PAIRS and p not in static_pairs)
+    return {"witnessed": sorted(witnessed), "plausible": sorted(plausible),
+            "dynamic_only": dynamic_only}

@@ -1498,6 +1498,8 @@ def rule_srjt018(tree, rel, lines, ctx) -> List[Finding]:
 
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
+from .protocol import project_rule_flow  # noqa: E402  (same shape:
+# protocol/flow import only core+callgraph)
 
 FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
@@ -1505,5 +1507,6 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
               rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
-                 project_rule_srjt007_interproc, project_rule_races)
+                 project_rule_srjt007_interproc, project_rule_races,
+                 project_rule_flow)
 ALL_RULES = FILE_RULES + PROJECT_RULES

@@ -36,8 +36,13 @@ def _build() -> None:
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
-        raise RuntimeError(
-            f"failed to build {_SO} from {_SRC}:\n{proc.stderr}")
+        from ..utils.nativeload import NativeBuildError
+        brief = next((ln for ln in proc.stderr.splitlines()
+                      if "error" in ln.lower()),
+                     "g++ failed")
+        raise NativeBuildError(
+            f"failed to build {_SO} from {_SRC}:\n{proc.stderr}",
+            os.path.basename(_SO), brief.strip())
 
 
 def _stale() -> bool:

@@ -59,13 +59,15 @@ def device_reservation(nbytes: int):
             _tls.depth = depth
         return
     RmmSpark.alloc(nbytes)
-    # optional real-HBM audit (rmm.validate_hbm): sample the PJRT
-    # allocator's counters around the bracket — see memory/hbm.py
+    # everything between alloc and the try used to run unprotected — a
+    # throw from the HBM audit hooks leaked the reservation (SRJTF02)
     mark = None
-    if hbm.enabled():
-        mark = hbm.bracket_begin()
     _tls.depth = depth + 1
     try:
+        # optional real-HBM audit (rmm.validate_hbm): sample the PJRT
+        # allocator's counters around the bracket — see memory/hbm.py
+        if hbm.enabled():
+            mark = hbm.bracket_begin()
         yield True
     finally:
         _tls.depth = depth

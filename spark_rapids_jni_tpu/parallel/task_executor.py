@@ -542,6 +542,11 @@ class TaskExecutor:
             "sandbox_workers_stopped": sandbox_stopped,
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
+        from ..analysis import protocol_witness
+        if protocol_witness.installed():
+            # quiesce point: every sanctioned pair must balance here
+            verdict["protocol_witness"] = protocol_witness.check_drain(
+                "task_executor.drain")
         self.last_drain = verdict
         return verdict
 

