@@ -68,7 +68,7 @@ CXXFLAGS ?= -std=c++17 -O2 -fPIC -shared -Wall
 VERSION := $(shell $(PY) -c "import re;print(re.search(r'version = \"([^\"]+)\"', open('pyproject.toml').read()).group(1))")
 GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem fleet wheel bench plan join dict encode serve shard clean
+.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem fleet restart wheel bench plan join dict encode serve shard clean
 
 native:
 	mkdir -p $(NATIVE_DIR)
@@ -146,8 +146,17 @@ soak:
 # the contract; the exit code is the combined fairness + robustness
 # verdict. Writes the next free FLEET_rNN.json.
 fleet:
-	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PY) -m benchmarks.bench_fleet \
+	timeout -k 10 1500 env JAX_PLATFORMS=cpu $(PY) -m benchmarks.bench_fleet \
 	    --stage-seconds 60 --multiplier 5 \
+	    --out auto > /dev/null
+
+# rolling-restart lane: recycle every replica one at a time under a
+# well-behaved storm — zero rejections, every replica back warm. The
+# outer timeout is part of the contract (a wedged drain or respawn
+# fails loudly). Writes the next free RESTART_rNN.json.
+restart:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PY) -m benchmarks.bench_fleet \
+	    --restart-only --stage-seconds 20 \
 	    --out auto > /dev/null
 
 soak-mem:

@@ -410,7 +410,13 @@ def _verdict(result: Dict[str, Any]) -> Dict[str, Any]:
         result["overload"]["well_behaved_p99_ms"]
         / max(result["baseline_1x"]["well_behaved_p99_ms"], floor_ms), 2)
     share = result["overload"]["hot_rejection_share"]
+    import os
     verdict = {
+        # capacity context for artifact consumers (the fleet bench's 4x
+        # target is derived from this harness's sustained rate, so the
+        # core count the rate was measured on travels with the verdict)
+        "host_cpus": os.cpu_count(),
+        "replicas": 1,   # single-process frontend: no fleet tier
         "well_behaved_p99_ratio": ratios,
         "pooled_well_behaved_p99_ratio": pooled_ratio,
         "well_behaved_p99_within_3x": pooled_ratio <= 3.0,

@@ -165,3 +165,19 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_flow.py -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly
+
+# stage 13 — router SIGKILL under a journal-backed hedge storm: a child
+# bench process (benchmarks/bench_fleet.py --router-child) runs the 5x
+# overload storm with the durable admission journal and hedged dispatch
+# enabled; the parent SIGKILLs the *router* mid-storm — the failure mode
+# rounds 16/17 could not survive — then recovers the journal in a fresh
+# fleet and replays the unacked suffix through normal admission. Pass
+# criteria are the harness's exit code: the kill landed on live work
+# (recovered > 0), every journaled admission is accounted (replayed to
+# completion, expired typed, or shed typed with a priced retry hint),
+# and ZERO entries stay live — a router death loses nothing that was
+# acked. The outer `timeout` is part of the contract: a recovery that
+# wedges mid-replay fails the lane loudly. `make restart` runs the
+# sibling rolling-restart lane (zero-downtime recycle of every replica).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
+    --router-kill --stage-seconds 20 --replicas 2 > /dev/null

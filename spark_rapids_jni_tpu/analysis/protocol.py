@@ -62,6 +62,8 @@ PAIR_CATALOG = {
                 "CircuitBreaker.record_success / record_failure"),
     "spill": ("SpillableTable fingerprint-at-spill",
               "SpillableTable verify-at-get"),
+    "journal": ("AdmissionJournal.append_admit (durable admit before ack)",
+                "AdmissionJournal.append_done (settle supersedes admit)"),
 }
 
 FLOW_RULES = ("SRJTF01", "SRJTF02", "SRJTF03", "SRJTF04", "SRJTF05")

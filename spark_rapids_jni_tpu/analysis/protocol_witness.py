@@ -22,7 +22,12 @@ Enable with the ``witness.protocol`` config flag / ``SRJT_WITNESS=1``
 (``maybe_install``) or call ``install()`` in a test.  The ``deadline``
 pair is counted but excluded from the drain assertion — the *caller's*
 deadline may lawfully still be open across a drain; ``spill`` is
-fingerprint bookkeeping, not zero-sum, and is informational only.
+fingerprint bookkeeping, not zero-sum, and is informational only.  The
+``journal`` pair (AdmissionJournal append_admit/append_done) is likewise
+counted but not asserted: its contract is at-least-once *across a
+crash*, so a recovery replay lawfully re-enters admits whose DONEs were
+written by a previous process — the books balance per settled query, not
+per process lifetime.
 """
 from __future__ import annotations
 
@@ -38,7 +43,7 @@ __all__ = [
 
 # counted pairs (superset of the asserted set)
 PAIRS = ("admission", "dispatch", "reservation", "sandbox", "replica",
-         "deadline")
+         "deadline", "journal")
 # pairs that must balance at a drain quiesce point
 ASSERTED_PAIRS = ("admission", "dispatch", "reservation", "sandbox",
                   "replica")
@@ -220,6 +225,31 @@ def _install_deadline() -> None:
     _patch(Deadline, "__exit__", wrap_exit)
 
 
+def _install_journal() -> None:
+    from ..serving.journal import AdmissionJournal
+
+    def wrap_admit(orig):
+        def append_admit(self, seq, *a, **kw):
+            orig(self, seq, *a, **kw)
+            with self._lock:             # closed journals no-op the write
+                wrote = seq in self._live
+            if wrote:
+                note_enter("journal")
+        return append_admit
+
+    def wrap_done(orig):
+        def append_done(self, seq):
+            with self._lock:
+                was = seq in self._live and self._f is not None
+            orig(self, seq)
+            if was:
+                note_exit("journal")
+        return append_done
+
+    _patch(AdmissionJournal, "append_admit", wrap_admit)
+    _patch(AdmissionJournal, "append_done", wrap_done)
+
+
 def install() -> None:
     """Patch every pair endpoint (idempotent)."""
     global _INSTALLED
@@ -231,6 +261,7 @@ def install() -> None:
     _install_sandbox()
     _install_replica()
     _install_deadline()
+    _install_journal()
     _INSTALLED = True
 
 
@@ -297,6 +328,8 @@ def _finding_pair(finding) -> Optional[str]:
         return "deadline"
     if "breaker" in msg:
         return "breaker"
+    if "journal" in msg:
+        return "journal"
     return None
 
 

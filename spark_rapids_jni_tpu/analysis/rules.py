@@ -31,6 +31,10 @@ Rule IDs:
            boundaries sanctioned in ci/lint_baseline.json
   SRJT017  AdmissionRejected raised without a retry-after hint (missing
            or constant-zero retry_after_s) and no sanctioned noqa
+  SRJT018  fleet IPC submit payload without the Deadline snapshot, or raw
+           process control outside serving/fleet.py
+  SRJT019  serving/* client ack (a future returned after an admission
+           charge) not dominated by a durable journal append
 """
 
 from __future__ import annotations
@@ -1496,6 +1500,67 @@ def rule_srjt018(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT019 — client ack in serving/* not dominated by a journal append
+# ---------------------------------------------------------------------------
+# The zero-loss contract (serving/journal.py): a globally-admitted query
+# must reach the durable admission journal BEFORE its future is handed to
+# the client — otherwise a router crash between ack and journal loses work
+# the client believes is owned. The rule's approximation of dominance: in
+# serving/ modules, a function that both charges admission (an ``admit`` /
+# ``try_admit`` call) and acks a client (returns an expression mentioning
+# ``.future``) must contain an ``append_admit`` call. Tiers that genuinely
+# have no journal (the single-process frontend — durability begins at the
+# fleet router) carry ``# srjt: noqa[SRJT019]`` with the reason on the
+# return line, so every unjournaled ack in the tree is a reviewed
+# decision.
+
+_SRJT019_ADMIT_ATTRS = ("admit", "try_admit")
+
+
+def _srjt019_mentions_future(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "future":
+            return True
+    return False
+
+
+def rule_srjt019(tree, rel, lines, ctx) -> List[Finding]:
+    if "/serving/" not in "/" + rel:
+        return []
+    findings = []
+    for node, anc in _walk_stack(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        charges = False
+        journals = False
+        ack_returns = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                dn = _dotted(sub.func)
+                leaf = dn.split(".")[-1] if dn is not None else None
+                if leaf in _SRJT019_ADMIT_ATTRS:
+                    charges = True
+                elif leaf == "append_admit":
+                    journals = True
+            elif (isinstance(sub, ast.Return) and sub.value is not None
+                    and _srjt019_mentions_future(sub.value)):
+                ack_returns.append(sub)
+        if not charges or journals:
+            continue
+        for ret in ack_returns:
+            findings.append(Finding(
+                "SRJT019", rel, ret.lineno,
+                f"`{node.name}` charges admission and returns a future "
+                f"without journaling the admit — the client ack must be "
+                f"dominated by AdmissionJournal.append_admit (serving/"
+                f"journal.py) so a router crash replays the query instead "
+                f"of losing it; journal before returning, or carry "
+                f"`# srjt: noqa[SRJT019]` with the reason if this tier "
+                f"deliberately has no durable journal"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 from .protocol import project_rule_flow  # noqa: E402  (same shape:
@@ -1505,7 +1570,8 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt005, rule_srjt006, rule_srjt007,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
-              rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018)
+              rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018,
+              rule_srjt019)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races,
                  project_rule_flow)

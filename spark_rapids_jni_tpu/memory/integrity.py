@@ -240,6 +240,76 @@ def clean_spill_dir(disk_dir: str, prefix: str = "srjt-spill-") -> int:
 
 
 # ---------------------------------------------------------------------------
+# checksummed append-only journal records (the admission journal's framing)
+# ---------------------------------------------------------------------------
+#
+# layout:  magic "SRJTJNL1" | record*
+# record:  u8 kind | u64 seq | u32 len | u32 crc | payload(len)
+#          crc = crc32(payload) seeded with the header fields, so a record
+#          whose header was torn cannot validate against a shorter payload.
+# Appends go through a single file handle (write + flush per record, fsync
+# optional); rewrites (compaction, torn-tail truncation) reuse the spill
+# tier's tmp + fsync + os.replace discipline so a crash mid-rewrite leaves
+# the previous journal intact. Recovery is ALWAYS exact-prefix: scanning
+# stops at the first record whose header or crc does not check out, and
+# everything before it is trusted (mirrors read_table_file's posture:
+# never guess past a checksum failure).
+
+_JOURNAL_MAGIC = b"SRJTJNL1"
+_JREC_HEAD = struct.Struct("<BQII")     # kind, seq, payload_len, crc
+
+
+def _journal_crc(kind: int, seq: int, payload: bytes) -> int:
+    seed = zlib.crc32(struct.pack("<BQI", kind, seq, len(payload)))
+    return zlib.crc32(payload, seed) & 0xFFFFFFFF
+
+
+def journal_record(kind: int, seq: int, payload: bytes) -> bytes:
+    """Frame one journal record (header + checksummed payload)."""
+    return _JREC_HEAD.pack(kind, seq, len(payload),
+                           _journal_crc(kind, seq, payload)) + payload
+
+
+def scan_journal(raw: bytes) -> Tuple[List[Tuple[int, int, bytes]], int]:
+    """Walk a journal image; return ``(records, valid_len)`` where
+    ``records`` is ``[(kind, seq, payload), ...]`` for the longest clean
+    prefix and ``valid_len`` is the byte offset of the first torn or
+    garbled record (== ``len(raw)`` when the file is clean). A file
+    without the magic recovers zero records with ``valid_len == 0``."""
+    records: List[Tuple[int, int, bytes]] = []
+    if raw[:len(_JOURNAL_MAGIC)] != _JOURNAL_MAGIC:
+        return records, 0
+    pos = len(_JOURNAL_MAGIC)
+    while pos + _JREC_HEAD.size <= len(raw):
+        kind, seq, plen, crc = _JREC_HEAD.unpack_from(raw, pos)
+        end = pos + _JREC_HEAD.size + plen
+        if end > len(raw):
+            break                        # torn tail: payload cut short
+        payload = raw[pos + _JREC_HEAD.size:end]
+        if _journal_crc(kind, seq, payload) != crc:
+            break                        # garbled record: stop, keep prefix
+        records.append((kind, seq, payload))
+        pos = end
+    return records, pos
+
+
+def write_journal_file(path: str,
+                       records: List[Tuple[int, int, bytes]]) -> int:
+    """Atomically (re)write a whole journal — compaction and torn-tail
+    truncation both land here. tmp + fsync + os.replace, same as
+    :func:`write_table_file`. Returns bytes written."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_JOURNAL_MAGIC)
+        for kind, seq, payload in records:
+            f.write(journal_record(kind, seq, payload))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return os.path.getsize(path)
+
+
+# ---------------------------------------------------------------------------
 # payload bit-flip injection (faultinj injectionType 3)
 # ---------------------------------------------------------------------------
 

@@ -12,7 +12,9 @@ from __future__ import annotations
 
 _LAZY = {
     "AdmissionController": ".admission",
+    "AdmissionJournal": ".journal",
     "AdmissionRejected": ".admission",
+    "JournalEntry": ".journal",
     "PLAN_SURFACE": ".admission",
     "FleetTicket": ".fleet",
     "MemberOutcome": ".microbatch",

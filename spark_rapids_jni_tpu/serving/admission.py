@@ -59,8 +59,11 @@ class AdmissionRejected(RuntimeError):
     """Typed front-door rejection. ``reason`` is one of ``closed`` /
     ``draining`` / ``breaker_open`` / ``queue_full`` /
     ``tenant_queue_budget`` / ``queue_delay`` / ``unknown_tenant`` /
-    ``tenant_in_flight`` / ``hbm_budget``; ``retry_after_s`` is the
-    caller's backoff hint (0.0 = do not retry, the resource is gone)."""
+    ``tenant_in_flight`` / ``hbm_budget`` / ``requeue_exhausted`` (the
+    fleet spent its replica-loss requeue budget on this query — every
+    survivor refused or died; retry after the hint, the fleet is
+    healing); ``retry_after_s`` is the caller's backoff hint (0.0 = do
+    not retry, the resource is gone)."""
 
     def __init__(self, reason: str, retry_after_s: float = 0.0,
                  tenant_id: Optional[str] = None, detail: str = ""):

@@ -40,31 +40,66 @@ replica loss that guard.py closes for device loss:
     replica is down the router runs queries on a lazily-built local
     ServingFrontend rather than failing them.
 
+Round 18 closes the three remaining loss windows:
+
+  * **Durable admission journal** (serving/journal.py, enabled by
+    ``fleet.journal_path``): every globally-admitted ticket is appended
+    (tenant, plan fingerprint + interned body digest, deadline snapshot,
+    seq) to a checksummed append-only log BEFORE the client ack;
+    ``_finish`` appends the completion record; ``replay_journal()`` on a
+    fresh router re-admits every unacked entry whose deadline still has
+    budget — a SIGKILLed router recovers its queue instead of losing it
+    (at-least-once: a crash between the new admit and the superseding
+    DONE can replay twice, never zero times).
+  * **Hedged dispatch**: when a routed query's reply lags past its plan
+    fingerprint's p95 latency (``max(p95, fleet.hedge_floor_ms)``), the
+    supervisor re-dispatches it to the next rendezvous choice; the first
+    reply wins, the loser is cancelled over the pipe (``op: cancel``)
+    and deduped by the ticket's ``settled`` flag keyed on the journal
+    seq. Hedges spend per-tenant token-bucket budget
+    (``fleet.hedge_budget`` capacity, ``fleet.hedge_refill_per_s``
+    refill) so tail-chasing cannot amplify an overload storm; counters:
+    ``hedges_issued`` / ``hedges_won`` / ``hedges_wasted``.
+  * **Rolling restart** (``rolling_restart()``): recycle replicas one at
+    a time — mark draining in the router weights (routing skips it, new
+    work lands on peers), let in-flight finish under their Deadlines,
+    graceful-exit, respawn + re-warm from the LIVE plan-fingerprint
+    frequency (the plans actually in flight, journal-backed), rejoin —
+    so upgrades ship with zero rejected well-behaved queries.
+
 ``drain()`` stops router admission first, then sends each replica the
 drain sentinel (its frontend sheds queued work typed, finishes
 in-flight groups, answers everything, exits 0), then joins processes.
 
 Config: ``fleet.replicas``, ``fleet.requeue_budget``,
 ``fleet.respawn_backoff_s``, ``fleet.submit_timeout_s``,
-``fleet.max_in_flight``, ``fleet.telemetry_period_s``.
+``fleet.max_in_flight``, ``fleet.telemetry_period_s``,
+``fleet.journal_path``, ``fleet.journal_fsync``,
+``fleet.journal_compact_every``, ``fleet.hedge_enabled``,
+``fleet.hedge_floor_ms``, ``fleet.hedge_budget``,
+``fleet.hedge_refill_per_s``, ``fleet.restart_drain_timeout_s``.
 """
 
 from __future__ import annotations
 
+import collections
 import os
+import signal
 import subprocess
 import sys
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..faultinj import breaker, watchdog
 from ..faultinj.guard import metrics as fault_metrics
+from ..faultinj.injector import get_injector as _get_injector
 from ..faultinj.sandbox import WorkerCrashError
 from ..parallel.cluster import rendezvous_pick
 from ..utils import config
 from .admission import AdmissionRejected
+from .journal import AdmissionJournal
 from .microbatch import batch_key_for
 from .replica import (table_to_wire, wire_to_error, wire_to_table)
 from .sessions import SessionRegistry
@@ -92,14 +127,23 @@ class _Ctrl:
 class FleetTicket:
     """One globally-admitted query riding the fleet. The wire-encoded
     table is kept (not the device table) so a requeue after replica
-    death re-sends without re-encoding."""
+    death re-sends without re-encoding.
+
+    ``seq`` is the router-global admission sequence — the journal's
+    record key AND hedging's dedup identity. ``routes`` tracks every
+    outstanding (handle, reply id) dispatch of this ticket (two while a
+    hedge races); ``settled`` is the exactly-once latch every resolution
+    path must win under the fleet lock before touching the registry or
+    the future."""
 
     kind = "query"
     __slots__ = ("tenant_id", "plan", "fp", "wire_table", "snap",
-                 "estimate", "key", "future", "attempts", "enqueued_at")
+                 "estimate", "key", "future", "attempts", "enqueued_at",
+                 "seq", "settled", "hedges", "routes", "primary_idx",
+                 "dispatched_at")
 
     def __init__(self, tenant_id, plan, fp, wire_table, snap, estimate,
-                 key):
+                 key, seq=0):
         self.tenant_id = tenant_id
         self.plan = plan
         self.fp = fp        # plan fingerprint; None for solo (unbatchable)
@@ -107,9 +151,15 @@ class FleetTicket:
         self.snap = snap
         self.estimate = estimate
         self.key = key
+        self.seq = seq
         self.future: Future = Future()
         self.attempts = 0
         self.enqueued_at = time.monotonic()
+        self.settled = False
+        self.hedges = 0
+        self.routes: List[Tuple["ReplicaHandle", int]] = []
+        self.primary_idx = -1
+        self.dispatched_at = self.enqueued_at
 
 
 class ReplicaHandle:
@@ -140,6 +190,9 @@ class ReplicaHandle:
         self.telemetry: Dict[str, Any] = {"drain_rate": 0.0, "depth": 0}
         self.live = False
         self.closing = False
+        # rolling restart: a draining replica stays live (its in-flight
+        # replies still matter) but leaves the routing member set
+        self.draining = False
         self.deaths = 0                # consecutive: backoff exponent
         self.next_attempt_at = 0.0
         self._epoch = 0                # invalidates stale reader threads
@@ -177,10 +230,12 @@ class ReplicaHandle:
                          name=f"{self.name}-reader", daemon=True).start()
 
     def post(self, msg: Dict[str, Any], entry=None,
-             plan_fp: Optional[str] = None, plan=None) -> bool:
-        """Register ``entry`` under a fresh reply id and send. False when
-        the pipe is already severed (caller re-routes; the reader thread
-        owns the death verdict).
+             plan_fp: Optional[str] = None, plan=None) -> Optional[int]:
+        """Register ``entry`` under a fresh reply id and send; returns
+        the reply id (truthy — ids start at 1). None when the pipe is
+        already severed (caller re-routes; the reader thread owns the
+        death verdict). Query entries also record the (handle, id) route
+        so hedged duplicates can be cancelled at settle.
 
         The send happens OUTSIDE ``self.lock``: a full pipe blocks the
         sender until the replica drains it, and the replica can only
@@ -198,12 +253,15 @@ class ReplicaHandle:
             tx = self.tx
             sent_fps = self.sent_fps
             if tx is None:
-                return False
+                return None
             rid = self.fleet._next_rid()
             msg = dict(msg)
             msg["id"] = rid
             if entry is not None:
                 self.pending[rid] = entry
+                if entry.kind == "query":
+                    with self.fleet._lock:
+                        entry.routes.append((self, rid))
         try:
             with self.send_lock:
                 if plan_fp is not None and plan_fp not in sent_fps:
@@ -215,13 +273,13 @@ class ReplicaHandle:
         # race is a death signal here, same as OSError)
         except (OSError, ValueError, TypeError, AttributeError):
             if entry is None:
-                return False
+                return None
             with self.lock:
                 owned = self.pending.pop(rid, None) is not None
             # not owned => the death sweep already requeued the entry;
-            # reporting False would double-dispatch it
-            return not owned
-        return True
+            # reporting failure would double-dispatch it
+            return None if owned else rid
+        return rid
 
     def _read_loop(self, rx, epoch: int) -> None:
         while True:
@@ -274,6 +332,15 @@ class ReplicaHandle:
             self.live = False
 
 
+# replica-side rejection reasons that are TRANSIENT while a rolling
+# restart is in progress: the respawn's re-warm compile starves the
+# survivors, so their CoDel / queue gates fire on load the fleet will
+# absorb within a beat once the recycled replica rejoins — defer and
+# retry instead of bouncing well-behaved callers
+_RESTART_TRANSIENT = ("queue_delay", "queue_full", "tenant_queue_budget")
+_RESTART_RETRY_S = 0.5
+
+
 class ServingFleet:
     """The router/supervisor (module doc). One instance per process."""
 
@@ -299,7 +366,29 @@ class ServingFleet:
             "completed": 0, "failed": 0, "rejected": 0, "requeued": 0,
             "requeue_budget_spent": 0, "replica_deaths": 0, "respawns": 0,
             "fallback_queries": 0, "timed_out": 0,
+            "hedges_issued": 0, "hedges_won": 0, "hedges_wasted": 0,
+            "journal_replayed": 0, "journal_expired": 0,
+            "replicas_recycled": 0, "restart_deferred": 0,
         }
+        # durable admission journal (round 18): appended before every ack
+        jpath = str(config.get("fleet.journal_path") or "")
+        self._journal: Optional[AdmissionJournal] = (
+            AdmissionJournal(jpath) if jpath else None)
+        # per-fingerprint completion-latency rings: the hedging signal
+        self._fp_lat: Dict[str, collections.deque] = {}
+        # live per-fingerprint frequency + last-seen bodies: what a
+        # respawned replica re-warms against (journal-backed — replay
+        # repopulates it through submit)
+        self._fp_hot: Dict[str, list] = {}   # fp -> [live_count, plan, wire]
+        # per-tenant hedge token buckets: (tokens, last_refill_monotonic)
+        self._hedge_tokens: Dict[str, Tuple[float, float]] = {}
+        # restart-aware deferral: replica-local transient sheds during a
+        # rolling restart park here (retry_at, ticket) and re-dispatch
+        # from the supervisor once due — still bounded by the fleet
+        # submit window, never by the requeue budget (that pays for
+        # replica LOSS, not for a survivor being briefly busy)
+        self._restarting = False
+        self._deferred: List[Tuple[float, FleetTicket]] = []
         self._stop = threading.Event()
         if spawn:
             for h in self._handles:
@@ -384,8 +473,14 @@ class ServingFleet:
             w *= 0.5
         return w
 
-    def _route(self, key: str) -> Optional[ReplicaHandle]:
-        live = self.live_handles()
+    def _route(self, key: str,
+               exclude: Optional[Set[int]] = None) -> Optional[ReplicaHandle]:
+        """Weighted rendezvous over the routable member set: live, not
+        draining (rolling restart), not excluded (``exclude`` carries the
+        hedge's primary so the hedge lands on the NEXT rendezvous
+        choice)."""
+        live = [h for h in self._handles if h.live and not h.draining
+                and (exclude is None or h.idx not in exclude)]
         if not live:
             return None
         best_rate = max((float(h.telemetry.get("drain_rate", 0.0))
@@ -396,6 +491,46 @@ class ServingFleet:
             if h.idx == idx:
                 return h
         return None
+
+    # -- hedging signal --------------------------------------------------
+
+    _LAT_RING = 128          # completion samples kept per fingerprint
+    _LAT_MIN_SAMPLES = 8     # below this, only the floor gates hedging
+
+    def _note_latency(self, t: FleetTicket, lat_s: float) -> None:
+        key = t.fp if t.fp is not None else "__solo__"
+        with self._lock:
+            ring = self._fp_lat.get(key)
+            if ring is None:
+                ring = self._fp_lat[key] = collections.deque(
+                    maxlen=self._LAT_RING)
+            ring.append(lat_s)
+
+    def _fp_p95(self, fp: Optional[str]) -> Optional[float]:
+        key = fp if fp is not None else "__solo__"
+        with self._lock:
+            ring = self._fp_lat.get(key)
+            if ring is None or len(ring) < self._LAT_MIN_SAMPLES:
+                return None
+            samples = sorted(ring)
+        return samples[min(len(samples) - 1, int(0.95 * len(samples)))]
+
+    def _take_hedge_token(self, tenant_id: str, now: float) -> bool:
+        """Per-tenant token bucket: capacity ``fleet.hedge_budget``,
+        refill ``fleet.hedge_refill_per_s`` — bounds hedges_issued per
+        tenant over any window to capacity + rate x window."""
+        cap = float(int(config.get("fleet.hedge_budget")))
+        if cap <= 0:
+            return False
+        rate = float(config.get("fleet.hedge_refill_per_s"))
+        with self._lock:
+            tokens, at = self._hedge_tokens.get(tenant_id, (cap, now))
+            tokens = min(cap, tokens + max(0.0, now - at) * rate)
+            if tokens < 1.0:
+                self._hedge_tokens[tenant_id] = (tokens, now)
+                return False
+            self._hedge_tokens[tenant_id] = (tokens - 1.0, now)
+            return True
 
     # -- fleet admission -------------------------------------------------
 
@@ -475,12 +610,35 @@ class ServingFleet:
                 route_fp = fp if fp is not None else f"solo-{seq}"
                 ticket = FleetTicket(tenant_id, plan, fp,
                                      table_to_wire(table), snap, estimate,
-                                     f"{tenant_id}|{route_fp}")
+                                     f"{tenant_id}|{route_fp}", seq=seq)
+                # the ack (returning the future) is dominated by the
+                # journal append: an admitted ticket is durable before
+                # the caller can observe it (SRJT019)
+                if self._journal is not None:
+                    self._journal.append_admit(seq, tenant_id, plan, fp,
+                                               ticket.wire_table, snap,
+                                               estimate)
+                if fp is not None:
+                    with self._lock:
+                        hot = self._fp_hot.get(fp)
+                        if hot is None:
+                            if len(self._fp_hot) >= 128:
+                                for k in [k for k, v in
+                                          self._fp_hot.items()
+                                          if v[0] <= 0][:64]:
+                                    del self._fp_hot[k]
+                            self._fp_hot[fp] = [1, plan,
+                                                ticket.wire_table]
+                        else:
+                            hot[0] += 1
+                            hot[1] = plan
+                            hot[2] = ticket.wire_table
             except BaseException:
                 # the admission charge is global router state: a throw
-                # from plan fingerprinting / wire encoding would pin the
-                # tenant's in_flight/hbm budget forever (SRJTF05) — roll
-                # back with no outcome, the query never ran
+                # from plan fingerprinting / wire encoding / the journal
+                # append would pin the tenant's in_flight/hbm budget
+                # forever (SRJTF05) — roll back with no outcome, the
+                # query never ran
                 self.registry.release(tenant_id, estimate, completed=None)
                 raise
             with self._lock:
@@ -503,25 +661,51 @@ class ServingFleet:
             h = self._route(t.key)
             if h is None:
                 break
-            msg = {"op": "submit", "tenant": t.tenant_id,
-                   "table": t.wire_table, "snap": t.snap}
-            if t.fp is None:
-                msg["plan"] = t.plan    # solo queries are never interned
-            else:
-                msg["fp"] = t.fp
-            if h.post(msg, t, plan_fp=t.fp, plan=t.plan):
+            if h.post(self._submit_msg(t), t, plan_fp=t.fp, plan=t.plan):
+                # dispatch now runs from submitters, the reader's requeue
+                # AND the supervisor's deferred retry; the hedge sweep
+                # reads dispatched_at — publish both under the fleet lock
+                with self._lock:
+                    t.primary_idx = h.idx
+                    t.dispatched_at = time.monotonic()
                 return
             time.sleep(0.001)   # let the reader mark the death
         self._fallback_submit(t)
+
+    def _submit_msg(self, t: FleetTicket) -> Dict[str, Any]:
+        msg = {"op": "submit", "tenant": t.tenant_id,
+               "table": t.wire_table, "snap": t.snap}
+        if t.fp is None:
+            msg["plan"] = t.plan        # solo queries are never interned
+        else:
+            msg["fp"] = t.fp
+        return msg
 
     # -- reply / death handling ------------------------------------------
 
     def _finish(self, t: FleetTicket, table=None,
                 error: Optional[BaseException] = None,
-                completed=None) -> None:
-        self.registry.release(t.tenant_id, t.estimate, completed=completed)
+                completed=None, resolver=None) -> bool:
+        """Settle a ticket EXACTLY ONCE (the ``settled`` latch): release
+        the global charge, resolve the future, cancel any still-racing
+        hedge duplicate on its replica (cancel-on-first-win), journal
+        the completion, and score the hedge (won when the re-dispatch
+        answered first, wasted when the primary did). ``resolver`` is
+        the handle whose reply settles the ticket — its own pending
+        entry was already popped by the reader (hedge routes are always
+        on distinct handles, so the handle identifies the route).
+        Returns False when another path already settled it."""
         with self._lock:
+            if t.settled:
+                return False
+            t.settled = True
             self._in_flight -= 1
+            routes, t.routes = t.routes, []
+            hedged = t.hedges > 0
+            hot = self._fp_hot.get(t.fp) if t.fp is not None else None
+            if hot is not None and hot[0] > 0:
+                hot[0] -= 1
+        self.registry.release(t.tenant_id, t.estimate, completed=completed)
         if error is None:
             self._count("completed")
             if not t.future.done():
@@ -530,6 +714,42 @@ class ServingFleet:
             self._count("failed")
             if not t.future.done():
                 t.future.set_exception(error)
+        # losers: pop their pending entries and tell their replicas to
+        # drop the duplicate (unknown targets no-op replica-side, so a
+        # raced reply or death sweep makes the cancel harmless)
+        for rh, rid in routes:
+            if resolver is not None and rh is resolver:
+                continue
+            with rh.lock:
+                rh.pending.pop(rid, None)
+            rh.post({"op": "cancel", "target": rid})
+        if hedged:
+            if resolver is not None and resolver.idx != t.primary_idx:
+                self._count("hedges_won")
+            else:
+                self._count("hedges_wasted")
+        if self._journal is not None:
+            try:
+                self._journal.append_done(t.seq)
+            except OSError:
+                pass    # a failed DONE only risks one replay, never loss
+        return True
+
+    def _other_route_racing(self, t: FleetTicket,
+                            not_on: Optional[ReplicaHandle]) -> bool:
+        """True when a DIFFERENT dispatch of this ticket is still pending
+        on a live replica — the arbiter for loser-error suppression and
+        death-requeue skips: while a copy races, the ticket's outcome is
+        that copy's to decide."""
+        with self._lock:
+            routes = list(t.routes)
+        for rh, rid in routes:
+            if rh is not_on or not rh.live:
+                continue
+            with rh.lock:
+                if rid in rh.pending:
+                    return True
+        return False
 
     def _resolve(self, h: ReplicaHandle, entry, ok: bool, payload) -> None:
         """Reader-thread callback: one correlated reply."""
@@ -541,16 +761,44 @@ class ServingFleet:
             return
         h.breaker.record_success()
         if ok:
+            self._note_latency(entry,
+                               time.monotonic() - entry.dispatched_at)
             self._finish(entry, table=wire_to_table(payload),
-                         completed=True)
+                         completed=True, resolver=h)
         else:
+            # a hedged copy's failure must not settle the ticket while
+            # its twin still races — the error could be replica-local
+            # (queue_full on the hedge target) while the primary is busy
+            # computing the answer
+            if entry.hedges > 0 and self._other_route_racing(entry, h):
+                with self._lock:
+                    entry.routes = [(rh, rid) for rh, rid in entry.routes
+                                    if rh is not h]
+                return
+            # mid-restart, a survivor's transient shed (its CoDel /
+            # queue gate fired while the respawn re-warm starves it) is
+            # fleet weather, not the caller's fault: park the ticket and
+            # let the supervisor re-dispatch once the beat passes. The
+            # global charge stays held (no journal DONE) and the fleet
+            # submit window still bounds the ticket's total life.
+            if (self._restarting and not entry.settled
+                    and payload.get("kind") == "admission"
+                    and payload.get("reason") in _RESTART_TRANSIENT):
+                with self._lock:
+                    entry.routes = [(rh, rid) for rh, rid in entry.routes
+                                    if rh is not h]
+                    self._deferred.append(
+                        (time.monotonic() + _RESTART_RETRY_S, entry))
+                self._count("restart_deferred")
+                return
             err = wire_to_error(payload)
             # replica-local admission rejections roll the global charge
             # back without an outcome (the query never ran); real
             # failures count against the tenant
             completed = None if payload.get("kind") == "admission" \
                 else False
-            self._finish(entry, error=err, completed=completed)
+            self._finish(entry, error=err, completed=completed,
+                         resolver=h)
 
     def _on_replica_death(self, h: ReplicaHandle) -> None:
         """Reader-thread death path: verdict, CRASH classification,
@@ -580,14 +828,34 @@ class ServingFleet:
                 if not entry.future.done():
                     entry.future.set_exception(err)
                 continue
-            self._requeue(entry, err)
+            self._requeue(entry, err, dead=h)
 
-    def _requeue(self, t: FleetTicket, err: WorkerCrashError) -> None:
+    def _requeue(self, t: FleetTicket, err: WorkerCrashError,
+                 dead: Optional[ReplicaHandle] = None) -> None:
+        if t.settled:
+            return
+        # a hedged twin still racing on a live replica owns the outcome:
+        # drop the dead route and let that copy decide
+        if self._other_route_racing(t, dead):
+            with self._lock:
+                t.routes = [(rh, rid) for rh, rid in t.routes
+                            if rh is not dead]
+            return
         t.attempts += 1
         budget = int(config.get("fleet.requeue_budget"))
         if t.attempts > budget:
+            # budget spent with every survivor refusing (or dead): shed
+            # TYPED with a priced retry hint — the caller sees the same
+            # contract every other overload path speaks, not a bare
+            # WorkerCrashError it cannot distinguish from data loss
             self._count("requeue_budget_spent")
-            self._finish(t, error=err, completed=False)
+            with self._lock:
+                in_flight = self._in_flight
+            self._finish(t, error=AdmissionRejected(
+                "requeue_exhausted",
+                self._priced_hint(max(in_flight, 1)), t.tenant_id,
+                f"requeue budget {budget} spent after replica loss "
+                f"({err})"), completed=None)
             return
         self._count("requeued")
         # re-route: the dead replica is out of the member set, so the
@@ -681,15 +949,106 @@ class ServingFleet:
                         for rid, _ in aged:
                             h.pending.pop(rid, None)
                     for _, t in aged:
+                        if self._finish(
+                                t, error=watchdog.DeadlineExceededError(
+                                    f"fleet:{t.tenant_id}", timeout_s),
+                                completed=False):
+                            self._count("timed_out")
+            # restart deferrals: transient replica-side sheds parked by
+            # _resolve re-dispatch here once due. Deferred tickets sit
+            # in NO handle's pending map, so the age sweep above cannot
+            # see them — apply the same window before re-dispatching.
+            with self._lock:
+                due = [(w, t) for w, t in self._deferred if w <= now]
+                if due:
+                    self._deferred = [(w, t) for w, t in self._deferred
+                                      if w > now]
+            for _, t in due:
+                if t.settled:
+                    continue
+                if timeout_s > 0 and now - t.enqueued_at > timeout_s:
+                    if self._finish(
+                            t, error=watchdog.DeadlineExceededError(
+                                f"fleet:{t.tenant_id}", timeout_s),
+                            completed=False):
                         self._count("timed_out")
-                        self._finish(t, error=watchdog.DeadlineExceededError(
-                            f"fleet:{t.tenant_id}", timeout_s),
-                            completed=False)
+                    continue
+                self._dispatch(t)
+            if bool(config.get("fleet.hedge_enabled")):
+                self._hedge_sweep(now)
+            # router-death injection (chaos): an injectionType-5 rule on
+            # the "fleet_router" surface SIGKILLs the ROUTER process
+            # itself — the journal's recovery path is what makes this
+            # survivable, and ci/chaos.sh stage 13 proves it
+            inj = _get_injector()
+            if inj is not None:
+                spec = inj.crash_spec("fleet_router")
+                if spec is not None:
+                    self.kill_router()
             if now - last_probe >= period:
                 last_probe = now
                 for h in self.live_handles():
                     # fire-and-forget: any reply refreshes telemetry
                     h.post({"op": "stats"})
+
+    def _hedge_sweep(self, now: float) -> None:
+        """One supervisor pass of hedged dispatch: any pending query
+        whose reply has lagged past max(its fingerprint's p95, the
+        configured floor) is re-dispatched to the next rendezvous choice
+        (primary excluded), spending one of its tenant's hedge tokens.
+        One hedge per ticket — a second lag means the fleet is saturated
+        and more copies only feed the storm."""
+        with self._lock:
+            draining = self._draining
+        if draining:
+            return
+        routable = [h for h in self._handles
+                    if h.live and not h.draining]
+        if len(routable) < 2:
+            return
+        floor = float(config.get("fleet.hedge_floor_ms")) / 1000.0
+        for h in routable:
+            with h.lock:
+                cands = [e for e in h.pending.values()
+                         if e.kind == "query"]
+            for t in cands:
+                if t.settled or t.hedges > 0:
+                    continue
+                p95 = self._fp_p95(t.fp)
+                if now - t.dispatched_at < max(floor, p95 or 0.0):
+                    continue
+                if not self._take_hedge_token(t.tenant_id, now):
+                    continue
+                h2 = self._route(t.key, exclude={h.idx})
+                if h2 is None or h2 is h:
+                    continue
+                with self._lock:
+                    if t.settled:
+                        continue
+                    t.hedges += 1
+                if h2.post(self._submit_msg(t), t, plan_fp=t.fp,
+                           plan=t.plan):
+                    self._count("hedges_issued")
+                else:
+                    with self._lock:
+                        t.hedges -= 1
+
+    def _respawn_warm_payload(self) -> Optional[Dict[str, Any]]:
+        """Re-warm payload for a respawning replica: the LIVE
+        plan-fingerprint frequency (plans in flight right now — journal-
+        backed, since replay repopulates it) beats the static startup
+        profile; mid-storm the respawn's first seconds then hit the
+        program cache for the traffic that is actually arriving. Falls
+        back to the static warm payload when nothing is in flight."""
+        with self._lock:
+            hot = sorted(((fp, v) for fp, v in self._fp_hot.items()
+                          if v[0] > 0),
+                         key=lambda kv: -kv[1][0])[:8]
+            static = self._warm_payload
+        if not hot:
+            return static
+        return {"op": "warm", "plans": [v[1] for _, v in hot],
+                "tables": [v[2] for _, v in hot]}
 
     def _respawn(self, h: ReplicaHandle) -> None:
         """Bring a dead replica back: spawn, re-declare tenants, re-warm,
@@ -697,7 +1056,7 @@ class ServingFleet:
         h.spawn()
         with self._lock:
             tenants = dict(self._tenants)
-            warm_payload = self._warm_payload
+        warm_payload = self._respawn_warm_payload()
         for tid, limits in tenants.items():
             h.post({"op": "register", "tenant": tid, "limits": limits})
         if warm_payload is not None:
@@ -716,7 +1075,149 @@ class ServingFleet:
         fault_metrics.bump("worker_respawns")
         self._count("respawns")
 
-    # -- chaos hook ------------------------------------------------------
+    # -- rolling restart -------------------------------------------------
+
+    def rolling_restart(self,
+                        drain_timeout_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Recycle every live replica one at a time with zero rejected
+        well-behaved queries: mark it draining (routing immediately
+        skips it; new work lands on peers), wait for its in-flight
+        queries to finish under their own Deadlines, graceful-exit via
+        the drain sentinel, respawn + re-warm from the live fingerprint
+        frequency, rejoin. Queries still unanswered when the per-replica
+        drain window (``fleet.restart_drain_timeout_s``) lapses requeue
+        onto survivors through the normal death path — typed, never
+        silently dropped."""
+        if drain_timeout_s is None:
+            drain_timeout_s = float(
+                config.get("fleet.restart_drain_timeout_s"))
+        report: Dict[str, Any] = {"recycled": [], "requeued_inflight": 0,
+                                  "clean": True, "errors": []}
+        self._restarting = True
+        try:
+            self._rolling_restart_body(report, drain_timeout_s)
+        finally:
+            self._restarting = False
+        report["width"] = self.width()
+        return report
+
+    def _rolling_restart_body(self, report: Dict[str, Any],
+                              drain_timeout_s: float) -> None:
+        for h in self._handles:
+            if not h.live:
+                continue
+            with h.lock:
+                h.draining = True
+            try:
+                deadline = time.monotonic() + max(0.0, drain_timeout_s)
+                while time.monotonic() < deadline:
+                    with h.lock:
+                        busy = any(e.kind == "query"
+                                   for e in h.pending.values())
+                    if not busy:
+                        break
+                    time.sleep(0.02)
+                # closing gates the reader's death path AND the
+                # supervisor's respawner while we recycle by hand
+                with h.lock:
+                    h.closing = True
+                    h.live = False
+                    leftovers = list(h.pending.values())
+                    h.pending.clear()
+                    tx = h.tx
+                try:
+                    with h.send_lock:
+                        tx.send(None)
+                except (OSError, ValueError, TypeError, AttributeError):
+                    pass
+                proc = h.proc
+                if proc is not None:
+                    try:
+                        proc.wait(timeout=max(5.0, drain_timeout_s))
+                    except subprocess.TimeoutExpired:
+                        proc.kill()     # sanctioned site (SRJT018)
+                        report["clean"] = False
+                h.teardown()
+                err = WorkerCrashError(
+                    h.name, "recycled by rolling restart before "
+                    "answering")
+                for entry in leftovers:
+                    if entry.kind == "ctrl":
+                        if not entry.future.done():
+                            entry.future.set_exception(err)
+                        continue
+                    report["requeued_inflight"] += 1
+                    self._requeue(entry, err, dead=h)
+                with h.lock:
+                    h.closing = False
+                self._respawn(h)
+                self._count("replicas_recycled")
+                report["recycled"].append(h.idx)
+            except Exception as e:  # noqa: BLE001 — supervisor retries
+                report["clean"] = False
+                report["errors"].append(f"replica {h.idx}: {e!r}")
+                with h.lock:
+                    h.closing = False
+                    h.next_attempt_at = time.monotonic() + float(
+                        config.get("fleet.respawn_backoff_s"))
+            finally:
+                with h.lock:
+                    h.draining = False
+
+    # -- journal replay --------------------------------------------------
+
+    def replay_journal(self) -> Dict[str, int]:
+        """Replay unacked journal entries through NORMAL admission (call
+        after ``register_tenant`` — the journal survives the process,
+        tenant declarations do not). Entries whose deadline budget is
+        already spent are shed typed (journaled DONE, counted
+        ``journal_expired``); a replayed entry is re-admitted under a
+        new seq (journaled by ``submit`` itself) and its old record is
+        superseded with a DONE — at-least-once across the crash, with
+        the seq keeping each incarnation exactly-once inside one router.
+        Unknown tenants stay live in the journal for a later replay."""
+        out = {"replayed": 0, "expired": 0, "shed": 0,
+               "unknown_tenant": 0}
+        j = self._journal
+        if j is None:
+            return out
+        for e in j.unacked():
+            if e.snap is not None and e.snap[1] <= time.monotonic():
+                j.append_done(e.seq)
+                out["expired"] += 1
+                self._count("journal_expired")
+                continue
+            table = wire_to_table(e.wire_table)
+            try:
+                if e.snap is not None:
+                    with watchdog.Deadline.adopt_wire(e.snap):
+                        self.submit(e.tenant_id, e.plan, table)
+                else:
+                    self.submit(e.tenant_id, e.plan, table)
+            except AdmissionRejected as rej:
+                if rej.reason == "unknown_tenant":
+                    out["unknown_tenant"] += 1
+                    continue        # not DONE: a later replay can run it
+                j.append_done(e.seq)    # shed typed — accounted, not lost
+                out["shed"] += 1
+                continue
+            j.append_done(e.seq)        # superseded by the new admit
+            out["replayed"] += 1
+            self._count("journal_replayed")
+        return out
+
+    def journal_stats(self) -> Optional[Dict[str, Any]]:
+        return None if self._journal is None else self._journal.stats()
+
+    # -- chaos hooks -----------------------------------------------------
+
+    def kill_router(self) -> None:
+        """Chaos/testing hook — SIGKILL the ROUTER (this process), the
+        sanctioned router-death site (SRJT018): bench_fleet's stage 13
+        harness runs the fleet in a child process, fires this mid-storm,
+        and proves the journal recovers every admitted query."""
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def kill_replica(self, idx: int) -> bool:
         """Chaos/testing hook — the ONE sanctioned process-kill site in
@@ -784,16 +1285,30 @@ class ServingFleet:
                         entry.future.set_exception(RuntimeError(
                             "fleet drained"))
                     continue
-                if entry.future.done():
+                if entry.settled or entry.future.done():
                     continue
-                shed += 1
-                self._finish(entry, error=AdmissionRejected(  # srjt: noqa[SRJT017] drain is terminal for this fleet; clients must fail over, not retry here
+                if self._finish(entry, error=AdmissionRejected(  # srjt: noqa[SRJT017] drain is terminal for this fleet; clients must fail over, not retry here
+                        "draining", 0.0, entry.tenant_id,
+                        "fleet drained before the replica answered"),
+                        completed=None):
+                    shed += 1
+        # restart-deferred tickets live in no handle's pending map —
+        # shed them typed too or their futures leak as lost
+        with self._lock:
+            deferred, self._deferred = self._deferred, []
+        for _, entry in deferred:
+            if entry.settled or entry.future.done():
+                continue
+            if self._finish(entry, error=AdmissionRejected(  # srjt: noqa[SRJT017] drain is terminal for this fleet; clients must fail over, not retry here
                     "draining", 0.0, entry.tenant_id,
-                    "fleet drained before the replica answered"),
-                    completed=None)
+                    "fleet drained before the deferred retry ran"),
+                    completed=None):
+                shed += 1
         fb_verdict = None
         if self._fallback is not None:
             fb_verdict = self._fallback.drain(timeout=timeout)
+        if self._journal is not None:
+            self._journal.close()
         verdict = {
             "clean": stragglers == 0 and (fb_verdict is None
                                           or fb_verdict["clean"]),
@@ -824,8 +1339,10 @@ class ServingFleet:
             "full_width": self._full_width,
             "in_flight": self._in_flight,
             "counters": dict(self.counters),
+            "journal": self.journal_stats(),
             "replicas": [
                 {"idx": h.idx, "live": h.live, "deaths": h.deaths,
+                 "draining": h.draining,
                  "breaker": h.breaker.state(),
                  "pid": h.proc.pid if h.proc is not None else None,
                  "telemetry": dict(h.telemetry)}

@@ -1,0 +1,284 @@
+"""Durable admission journal: the router's write-ahead log of admitted work.
+
+Round 16's fleet survives replica SIGKILLs, but every globally-admitted
+ticket lives only in router memory — a router crash (deploy, OOM-kill,
+operator error) silently loses all in-flight and queued work. This module
+closes that hole the way the reference stack's retry/spill state machine
+keeps executor faults from surfacing to the job: every ticket the router
+admits is appended to a checksummed append-only log BEFORE the client is
+acked (before ``ServingFleet.submit`` returns its future), and a fresh
+router replays the unacked suffix through normal admission on startup.
+
+On-disk format (memory/integrity.py journal framing)::
+
+    magic "SRJTJNL1" | record*
+    record = u8 kind | u64 seq | u32 len | u32 crc | payload
+
+  * ``PLAN`` (kind 1) — one per plan fingerprint: the pickled plan body,
+    interned exactly like the fleet pipe protocol interns plans (recurring
+    plans cost the log one body, later admits reference the fingerprint).
+  * ``ADMIT`` (kind 2) — one per admitted ticket: tenant, plan
+    fingerprint + interned-body digest (crc32 of the PLAN payload; a
+    digest mismatch at recovery drops the entry rather than replaying a
+    corrupted plan), wire-encoded table, deadline wire snapshot, estimate.
+    ``seq`` is the router's global ticket seq — the dedup key hedged
+    dispatch also relies on.
+  * ``DONE`` (kind 3) — the ticket with that seq settled (completed,
+    failed typed, or shed typed). DONE records dominate ADMITs at
+    recovery; periodic compaction rewrites the journal down to the live
+    (unacked) suffix with the spill tier's tmp + fsync + os.replace
+    discipline.
+
+Durability posture: every append is ``write()`` + ``flush()`` — past the
+kernel boundary, so a SIGKILLed *process* loses nothing (the chaos stage's
+threat model). ``fleet.journal_fsync`` upgrades admits to fsync-per-record
+for power-loss durability at a large throughput cost. Torn tails (crash
+mid-append) recover to the exact clean prefix — scanning stops at the
+first bad crc, mirroring the SRJTSPL1 torn-write posture of never guessing
+past a checksum failure.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..memory.integrity import (journal_record, scan_journal,
+                                write_journal_file)
+from ..utils import config
+
+__all__ = ["AdmissionJournal", "JournalEntry"]
+
+KIND_PLAN = 1
+KIND_ADMIT = 2
+KIND_DONE = 3
+
+
+class JournalEntry:
+    """One recovered unacked admission: everything the router needs to
+    replay the query through normal admission."""
+
+    __slots__ = ("seq", "tenant_id", "plan", "fp", "wire_table", "snap",
+                 "estimate")
+
+    def __init__(self, seq, tenant_id, plan, fp, wire_table, snap,
+                 estimate):
+        self.seq = seq
+        self.tenant_id = tenant_id
+        self.plan = plan
+        self.fp = fp
+        self.wire_table = wire_table
+        self.snap = snap
+        self.estimate = estimate
+
+
+class AdmissionJournal:
+    """Append-only admission log with exact-prefix crash recovery.
+
+    Thread-safe: admits arrive from submitter threads, completions from
+    the fleet's reader threads, compaction from whichever completion
+    crosses the threshold — one lock covers the handle and the live map.
+    """
+
+    def __init__(self, path: str, fsync: Optional[bool] = None,
+                 compact_every: Optional[int] = None):
+        self.path = path
+        self._fsync = (bool(config.get("fleet.journal_fsync"))
+                       if fsync is None else fsync)
+        self._compact_every = (int(config.get("fleet.journal_compact_every"))
+                               if compact_every is None else compact_every)
+        self._lock = threading.Lock()
+        # fp -> (digest, pickled plan body): the interning table
+        self._plans: Dict[str, Tuple[int, bytes]] = {}
+        # seq -> ADMIT payload dict for every unacked admission
+        self._live: Dict[int, Dict[str, Any]] = {}
+        self._fp_freq: Dict[str, int] = {}
+        self._dones_since_compact = 0
+        self._f = None
+        self.recovered_entries = 0       # clean ADMITs found at open
+        self.dropped_torn_bytes = 0      # torn/garbled tail truncated
+        self.dropped_corrupt = 0         # ADMITs whose plan digest mismatched
+        self._recover_and_open()
+
+    # -- startup recovery -------------------------------------------------
+
+    def _recover_and_open(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raw = b""
+        records, valid_len = scan_journal(raw)
+        # runs from __init__ before the journal is shared, but the state
+        # it builds is the same maps the append/compact paths mutate —
+        # hold the lock so every write site shares one guard
+        with self._lock:
+            self.dropped_torn_bytes = max(0, len(raw) - valid_len)
+            admits: Dict[int, Dict[str, Any]] = {}
+            for kind, seq, payload in records:
+                if kind == KIND_PLAN:
+                    fp, body = pickle.loads(payload)
+                    self._plans[fp] = (zlib.crc32(body) & 0xFFFFFFFF, body)
+                elif kind == KIND_ADMIT:
+                    admits[seq] = pickle.loads(payload)
+                elif kind == KIND_DONE:
+                    admits.pop(seq, None)
+            # digest check: an ADMIT referencing an interned plan whose
+            # body does not hash to the recorded digest is dropped, not
+            # replayed
+            for seq in sorted(admits):
+                ent = admits[seq]
+                fp = ent.get("fp")
+                if fp is not None:
+                    have = self._plans.get(fp)
+                    if have is None or have[0] != int(ent.get("digest", -1)):
+                        self.dropped_corrupt += 1
+                        continue
+                self._live[seq] = ent
+                if fp is not None:
+                    self._fp_freq[fp] = self._fp_freq.get(fp, 0) + 1
+            self.recovered_entries = len(self._live)
+            # a torn tail, missing magic, or first open rewrites the clean
+            # prefix atomically so the append handle never extends a
+            # garbled file (valid_len == 0 covers empty/new and bad-magic
+            # files)
+            if valid_len != len(raw) or valid_len == 0:
+                write_journal_file(self.path, records)
+            self._f = open(self.path, "ab")
+
+    def unacked(self) -> List[JournalEntry]:
+        """Recovered admissions with no DONE, oldest first — the replay
+        set. Plans are decoded lazily here (not at scan time) so a
+        journal opened only for appending pays nothing."""
+        out = []
+        with self._lock:
+            live = sorted(self._live.items())
+            plans = dict(self._plans)
+        for seq, ent in live:
+            fp = ent.get("fp")
+            plan = (pickle.loads(plans[fp][1]) if fp is not None
+                    else ent.get("plan"))
+            out.append(JournalEntry(seq, ent["tenant"], plan, fp,
+                                    ent["table"], ent.get("snap"),
+                                    int(ent.get("estimate", 0))))
+        return out
+
+    # -- the write path ---------------------------------------------------
+
+    def append_admit(self, seq: int, tenant_id: str, plan, fp, wire_table,
+                     snap, estimate: int) -> None:
+        """Journal one admission BEFORE the client ack. Interns the plan
+        body on first sight of its fingerprint; the admit record carries
+        the fingerprint + body digest (solo plans ride inline)."""
+        ent: Dict[str, Any] = {"tenant": tenant_id, "fp": fp,
+                               "table": wire_table, "snap": snap,
+                               "estimate": int(estimate)}
+        with self._lock:
+            if self._f is None:
+                return              # closed (drain won the race)
+            frames = b""
+            if fp is not None:
+                have = self._plans.get(fp)
+                if have is None:
+                    body = pickle.dumps(plan, protocol=4)
+                    have = (zlib.crc32(body) & 0xFFFFFFFF, body)
+                    self._plans[fp] = have
+                    frames += journal_record(
+                        KIND_PLAN, seq, pickle.dumps((fp, body), protocol=4))
+                ent["digest"] = have[0]
+            else:
+                ent["plan"] = plan
+            frames += journal_record(KIND_ADMIT, seq,
+                                     pickle.dumps(ent, protocol=4))
+            self._f.write(frames)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._live[seq] = ent
+            if fp is not None:
+                self._fp_freq[fp] = self._fp_freq.get(fp, 0) + 1
+
+    def append_done(self, seq: int) -> None:
+        """Journal a settlement (completion, typed failure, or typed
+        shed); crosses the compaction threshold here. No fsync: losing a
+        DONE to power loss only risks one re-execution, never loss."""
+        with self._lock:
+            if self._f is None:
+                return              # closed (drain won the race)
+            self._f.write(journal_record(KIND_DONE, seq, b""))
+            self._f.flush()
+            ent = self._live.pop(seq, None)
+            if ent is not None and ent.get("fp") is not None:
+                fp = ent["fp"]
+                n = self._fp_freq.get(fp, 0) - 1
+                if n > 0:
+                    self._fp_freq[fp] = n
+                else:
+                    self._fp_freq.pop(fp, None)
+            self._dones_since_compact += 1
+            if (self._compact_every > 0
+                    and self._dones_since_compact >= self._compact_every):
+                self._compact_locked()
+
+    # -- compaction -------------------------------------------------------
+
+    def _compact_locked(self) -> None:
+        records: List[Tuple[int, int, bytes]] = []
+        live_fps = {e["fp"] for e in self._live.values()
+                    if e.get("fp") is not None}
+        for fp in sorted(live_fps):
+            records.append((KIND_PLAN, 0,
+                            pickle.dumps((fp, self._plans[fp][1]),
+                                         protocol=4)))
+        for seq in sorted(self._live):
+            records.append((KIND_ADMIT, seq,
+                            pickle.dumps(self._live[seq], protocol=4)))
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        write_journal_file(self.path, records)
+        # interned bodies for settled fps are gone from disk; forget them
+        # so a later admit of that fp re-interns instead of dangling
+        self._plans = {fp: self._plans[fp] for fp in live_fps}
+        self._f = open(self.path, "ab")
+        self._dones_since_compact = 0
+
+    def compact(self) -> None:
+        """Rewrite the journal down to the unacked suffix (atomic)."""
+        with self._lock:
+            self._compact_locked()
+
+    # -- introspection ----------------------------------------------------
+
+    def fp_frequency(self) -> Dict[str, int]:
+        """Live (unacked) admissions per plan fingerprint — what a
+        respawned replica should re-warm against: the plans actually in
+        flight right now, not a startup-time profile."""
+        with self._lock:
+            return dict(self._fp_freq)
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"path": self.path, "live": len(self._live),
+                    "interned_plans": len(self._plans),
+                    "recovered": self.recovered_entries,
+                    "dropped_torn_bytes": self.dropped_torn_bytes,
+                    "dropped_corrupt": self.dropped_corrupt,
+                    "fsync": self._fsync}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None

@@ -24,6 +24,11 @@ Requests (parent -> replica) are dicts keyed by ``op``:
     must correlate by ``id``.
   * ``{"op": "warm", "id", "plans", "tables"}`` — pre-pay batched-program
     compiles (the bench's warm loop) before the replica takes traffic.
+  * ``{"op": "cancel", "id", "target"}`` — hedged dispatch's cancel-on-
+    first-win token: drop the still-queued query whose submit carried
+    ``id == target``. Fire-and-forget (no reply; the router already
+    settled the ticket); an unknown or already-running target no-ops —
+    its reply is simply ignored router-side.
   * ``{"op": "stats", "id"}`` — metrics snapshot (doubles as a liveness
     probe after respawn).
   * ``None`` — drain sentinel: shed the queue typed, finish in-flight
@@ -155,6 +160,11 @@ class ReplicaServer:
         self._telem_at = 0.0
         self._telem: Optional[Dict[str, Any]] = None
         self._plans: Dict[str, Any] = {}     # interned {fp: plan body}
+        # in-flight submit futures by reply id, for op:cancel — a plain
+        # Future cancels only while queued, so the scheduler's dispatch
+        # loop skips it and rolls its local admission charge back
+        self._inflight: Dict[int, Any] = {}
+        self._inflight_lock = threading.Lock()
         self._out: list = []
         self._out_cv = threading.Condition()
         self._flush_stop = False
@@ -222,6 +232,8 @@ class ReplicaServer:
 
     def _done_cb(self, rid: int):
         def cb(fut):
+            with self._inflight_lock:
+                self._inflight.pop(rid, None)
             try:
                 table = fut.result()
             except BaseException as e:  # noqa: BLE001 — crosses the wire typed
@@ -259,6 +271,8 @@ class ReplicaServer:
         except BaseException as e:  # noqa: BLE001 — crosses the wire typed
             self._send(rid, False, error_to_wire(e))
             return
+        with self._inflight_lock:
+            self._inflight[rid] = fut
         fut.add_done_callback(self._done_cb(rid))
 
     def _op_warm(self, msg: Dict[str, Any]) -> None:
@@ -290,6 +304,17 @@ class ReplicaServer:
         gc.freeze()
         self._send(msg["id"], True, {"warmed": len(plans)})
 
+    def _op_cancel(self, msg: Dict[str, Any]) -> None:
+        """Hedge loser teardown: cancel the queued query whose submit id
+        was ``target``. Future.cancel() succeeds only before a dispatch
+        lane claims it — the scheduler then skips the ticket and rolls
+        its replica-local admission charge back; a query already running
+        finishes normally and its (ignored) reply still goes out."""
+        with self._inflight_lock:
+            fut = self._inflight.get(msg.get("target"))
+        if fut is not None:
+            fut.cancel()
+
     def _op_stats(self, msg: Dict[str, Any]) -> None:
         from ..plan.compile import plan_metrics
         from .sessions import serving_metrics
@@ -302,7 +327,7 @@ class ReplicaServer:
         })
 
     _OPS = {"register": _op_register, "submit": _op_submit,
-            "warm": _op_warm, "stats": _op_stats}
+            "warm": _op_warm, "cancel": _op_cancel, "stats": _op_stats}
 
     # -- loop ------------------------------------------------------------
 

@@ -413,7 +413,7 @@ class ServingFrontend:
                 raise AdmissionRejected(  # srjt: noqa[SRJT017] the frontend is going away; no capacity will return
                     "draining", 0.0, tenant_id,
                     "serving frontend drained during submit") from None
-            return ticket.future
+            return ticket.future  # srjt: noqa[SRJT019] single-process frontend: no journal tier here — durability begins at the fleet router, which journals before its ack
 
     # -- dispatch ------------------------------------------------------------
 
@@ -431,6 +431,14 @@ class ServingFrontend:
             self.admission.note_dispatch(
                 len(group), now - min(t.enqueued_at for t in group))
             for t in group:
+                if t.future.cancelled():
+                    # hedge loser: the fleet router cancelled this copy
+                    # after its twin answered — roll the local admission
+                    # charge back with no outcome, it never ran
+                    serving_metrics.inc("cancelled")
+                    self.registry.release(t.tenant_id, t.estimate_bytes,
+                                          completed=None)
+                    continue
                 if t.expires_at <= now:
                     # expired while queued: its budget is gone (queue
                     # time counts) — fail fast, never dispatch

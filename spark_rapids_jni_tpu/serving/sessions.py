@@ -47,7 +47,7 @@ class ServingMetrics:
 
     _FIELDS = ("submitted", "admitted", "rejected", "completed", "failed",
                "deadline_missed", "expired_in_queue", "shed_expired",
-               "dispatches", "batches", "batched_queries",
+               "cancelled", "dispatches", "batches", "batched_queries",
                "solo_dispatches", "batch_fault_replays", "overflow_replays",
                "compile_misses", "warmup_compiles")
 

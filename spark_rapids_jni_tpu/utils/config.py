@@ -406,6 +406,41 @@ _register("fleet.telemetry_period_s", "SRJT_FLEET_TELEMETRY_PERIOD_S", 0.5,
           "how often the router polls each replica's drain-rate/depth "
           "telemetry to refresh routing weights (responses also "
           "piggyback telemetry, so this is the idle-replica floor)")
+_register("fleet.journal_path", "SRJT_FLEET_JOURNAL_PATH", "", str,
+          "durable admission journal file (serving/journal.py): every "
+          "globally-admitted ticket is appended before the client ack "
+          "and replayed on router start; '' disables journaling")
+_register("fleet.journal_fsync", "SRJT_FLEET_JOURNAL_FSYNC", False,
+          _parse_bool,
+          "fsync the journal on every admit (power-loss durability) "
+          "instead of the default write+flush (process-crash durability "
+          "— the SIGKILLed-router threat model — at full throughput)")
+_register("fleet.journal_compact_every", "SRJT_FLEET_JOURNAL_COMPACT_EVERY",
+          512, int,
+          "completion records between journal compactions (atomic "
+          "rewrite down to the unacked suffix); 0 disables compaction")
+_register("fleet.hedge_enabled", "SRJT_FLEET_HEDGE_ENABLED", True,
+          _parse_bool,
+          "hedged dispatch: when a routed query's reply lags past its "
+          "fingerprint's p95 latency, re-dispatch to the next rendezvous "
+          "choice and keep the first reply (cancel the loser)")
+_register("fleet.hedge_floor_ms", "SRJT_FLEET_HEDGE_FLOOR_MS", 250.0, float,
+          "minimum lag before a hedge may fire — the threshold is "
+          "max(per-fingerprint p95, this floor), so cold fingerprints "
+          "with no latency history still hedge, just conservatively")
+_register("fleet.hedge_budget", "SRJT_FLEET_HEDGE_BUDGET", 16, int,
+          "per-tenant hedge token bucket capacity (0 disables hedging "
+          "for the tenant): hedges spend a token each so a tail-heavy "
+          "tenant cannot amplify an overload storm")
+_register("fleet.hedge_refill_per_s", "SRJT_FLEET_HEDGE_REFILL_PER_S", 4.0,
+          float,
+          "per-tenant hedge token refill rate; capacity + rate x window "
+          "bounds hedges_issued per tenant over any window")
+_register("fleet.restart_drain_timeout_s",
+          "SRJT_FLEET_RESTART_DRAIN_TIMEOUT_S", 30.0, float,
+          "rolling restart: how long to wait for one draining replica's "
+          "in-flight queries to finish before recycling it anyway (their "
+          "tickets requeue onto survivors via the death path)")
 
 
 def get(key: str) -> Any:

@@ -1146,6 +1146,90 @@ def test_srjt018_sanctioned_sites_are_baselined():
 
 
 # ---------------------------------------------------------------------------
+# SRJT019 — admission acked without a durable journal write
+# ---------------------------------------------------------------------------
+
+SRC_019_NO_JOURNAL = """
+    def submit(self, tenant_id, plan, table):
+        reason = self.registry.try_admit(tenant_id, estimate)
+        if reason is not None:
+            raise AdmissionRejected(reason, 0.0, tenant_id, "over budget")
+        ticket = FleetTicket(tenant_id, plan, table)
+        self._dispatch(ticket)
+        return ticket.future
+"""
+
+SRC_019_JOURNALED = """
+    def submit(self, tenant_id, plan, table):
+        reason = self.registry.try_admit(tenant_id, estimate)
+        if reason is not None:
+            raise AdmissionRejected(reason, 0.0, tenant_id, "over budget")
+        ticket = FleetTicket(tenant_id, plan, table)
+        if self._journal is not None:
+            self._journal.append_admit(ticket.seq, tenant_id, plan,
+                                       ticket.fp, ticket.wire_table,
+                                       ticket.snap, estimate)
+        self._dispatch(ticket)
+        return ticket.future
+"""
+
+
+def test_srjt019_admit_acked_without_journal_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt019
+    fs = run(SRC_019_NO_JOURNAL, path="pkg/serving/fleet.py",
+             rules=[rule_srjt019])
+    assert rules_of(fs) == {"SRJT019"}
+    assert "journal" in fs[0].message
+
+
+def test_srjt019_journaled_ack_passes():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt019
+    assert run(SRC_019_JOURNALED, path="pkg/serving/fleet.py",
+               rules=[rule_srjt019]) == []
+
+
+def test_srjt019_scoped_to_serving():
+    # admission outside the serving tier (e.g. the task executor's own
+    # budget gates) has no journal contract
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt019
+    assert run(SRC_019_NO_JOURNAL, path="pkg/parallel/task_executor.py",
+               rules=[rule_srjt019]) == []
+
+
+def test_srjt019_charge_without_future_ack_passes():
+    # a helper that charges but returns no future acks nothing — the
+    # caller owns the ack and carries the obligation
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt019
+    src = """
+        def try_charge(self, tenant_id, estimate):
+            return self.registry.try_admit(tenant_id, estimate)
+    """
+    assert run(src, path="pkg/serving/fleet.py",
+               rules=[rule_srjt019]) == []
+
+
+def test_srjt019_noqa_declares_journalless_tier():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt019
+    src = SRC_019_NO_JOURNAL.replace(
+        "return ticket.future",
+        "return ticket.future  # srjt: noqa[SRJT019] single-process tier")
+    assert run(src, path="pkg/serving/scheduler.py",
+               rules=[rule_srjt019]) == []
+
+
+def test_srjt019_frontend_submit_carries_the_declaration():
+    # the real single-process frontend acks without a journal by design
+    # and must say so in-line rather than ride the baseline
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, "spark_rapids_jni_tpu", "serving",
+                        "scheduler.py")
+    with open(path) as f:
+        src = f.read()
+    assert "noqa[SRJT019]" in src
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -1165,7 +1249,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 18
+    assert len(FILE_RULES) == 19
 
 
 def test_syntax_error_is_reported_not_raised():

@@ -12,6 +12,13 @@ Replica processes are real subprocesses (sandbox.py spawn pattern), so
 spawns are expensive on this 1-core host: the healthy-path tests share
 one module-scoped 2-replica fleet; only the lifecycle tests (breaker,
 all-dead fallback, drain) build their own single-replica fleets.
+
+ISSUE 18 adds the zero-loss layer: requeue-budget exhaustion shedding
+typed with a priced hint, hedged dispatch (issue + cancel-on-first-win
+accounting), rolling restart recycling every replica in place, and the
+durable admission journal replaying unacked work through normal
+admission on router start (torn-tail/compaction details live in
+test_journal.py).
 """
 
 import time
@@ -268,3 +275,160 @@ def test_drain_stops_admission_and_joins():
     # idempotent: a second drain reports already_closed
     again = fl.drain()
     assert again["already_closed"] is True
+
+
+# -- 8. requeue-budget exhaustion sheds typed (ISSUE 18 satellite) -----------
+
+# distinct fingerprint so its first execution compiles in the replica,
+# keeping the queries in flight when the SIGKILL lands
+PLAN_BUDGET = GroupBy(Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(7))),
+                      (0,), ((1, "count"),))
+
+
+def test_requeue_exhausted_sheds_typed():
+    """With the requeue budget at zero, a replica death does NOT surface
+    as a bare WorkerCrashError: the orphaned queries shed typed as
+    AdmissionRejected(reason='requeue_exhausted') with a positive priced
+    retry_after_s, and the budget-spent counter records each one."""
+    with config.override("fleet.requeue_budget", 0):
+        fl = ServingFleet(replicas=1)
+        try:
+            fl.register_tenant("alpha", priority=1, max_in_flight=64)
+            _await(lambda: fl.width() == 1, 30.0, "initial spawn")
+            futs = [fl.submit("alpha", PLAN_BUDGET, make_table(64, 30 + i))
+                    for i in range(3)]
+            assert fl.kill_replica(0)
+            saw = 0
+            for f in futs:
+                with pytest.raises(AdmissionRejected) as exc:
+                    f.result(timeout=180)
+                assert exc.value.reason == "requeue_exhausted"
+                assert exc.value.retry_after_s > 0.0
+                assert exc.value.tenant_id == "alpha"
+                saw += 1
+            assert saw == 3
+            assert fl.counters["requeue_budget_spent"] == 3
+            # the charge rolled back without an outcome: nothing pinned
+            assert fl.registry.snapshot()["alpha"]["in_flight"] == 0
+        finally:
+            fl.drain()
+
+
+# -- 9. hedged dispatch -------------------------------------------------------
+
+# fresh fingerprint: no latency history, so the hedge threshold is the
+# configured floor and the replica-side compile guarantees the lag
+PLAN_HEDGE = GroupBy(Filter(Scan(2), ex.BinOp("lt", ex.Col(0), ex.Lit(3))),
+                     (0,), ((1, "sum"), (1, "count")))
+
+
+def test_hedged_dispatch_issues_and_settles_once(fleet2):
+    """A reply lagging past the hedge floor re-dispatches to the other
+    replica; whichever copy answers first wins, the loser is cancelled,
+    and the hedge is scored exactly once (won + wasted == issued)."""
+    c0 = dict(fleet2.counters)
+    with config.override("fleet.hedge_floor_ms", 10.0):
+        t = make_table(64, 40)
+        got = fleet2.submit("alpha", PLAN_HEDGE, t).result(timeout=180)
+    assert_tables_bit_identical(got, execute_plan(PLAN_HEDGE, t))
+    issued = fleet2.counters["hedges_issued"] - c0["hedges_issued"]
+    won = fleet2.counters["hedges_won"] - c0["hedges_won"]
+    wasted = fleet2.counters["hedges_wasted"] - c0["hedges_wasted"]
+    assert issued == 1          # one hedge per ticket, ever
+    assert won + wasted == issued
+    # exactly-once: the duplicate never double-completed the query
+    assert fleet2.counters["completed"] - c0["completed"] == 1
+    assert fleet2.registry.snapshot()["alpha"]["in_flight"] == 0
+
+
+def test_hedge_budget_zero_disables(fleet2):
+    """An empty token bucket silences hedging entirely."""
+    c0 = fleet2.counters["hedges_issued"]
+    with config.override("fleet.hedge_budget", 0), \
+            config.override("fleet.hedge_refill_per_s", 0.0), \
+            config.override("fleet.hedge_floor_ms", 1.0):
+        fleet2._hedge_tokens.clear()    # drop tokens banked under defaults
+        t = make_table(64, 41)
+        fleet2.submit("alpha", PLAN_HEDGE, t).result(timeout=180)
+        fleet2._hedge_tokens.clear()
+    assert fleet2.counters["hedges_issued"] == c0
+
+
+# -- 10. rolling restart ------------------------------------------------------
+
+
+def test_rolling_restart_recycles_all_replicas(fleet2):
+    """rolling_restart() recycles every live replica one at a time and
+    the fleet keeps answering afterwards — no lost width, no stuck
+    queries, clean report."""
+    recycled_before = fleet2.counters["replicas_recycled"]
+    report = fleet2.rolling_restart()
+    assert report["clean"] is True, report
+    assert sorted(report["recycled"]) == [0, 1]
+    assert report["errors"] == []
+    assert report["width"] == 2
+    assert fleet2.counters["replicas_recycled"] == recycled_before + 2
+    t = make_table(64, 50)
+    got = fleet2.submit("alpha", PLAN_FILTER, t).result(timeout=180)
+    assert_tables_bit_identical(got, execute_plan(PLAN_FILTER, t))
+
+
+# -- 11. journal replay on router start ---------------------------------------
+
+
+def test_journal_replay_through_normal_admission(tmp_path):
+    """A journal left behind by a dead router replays its unacked
+    entries through normal admission on the next router's start: live
+    entries re-run to completion, deadline-expired ones shed typed, and
+    the journal ends empty (zero lost)."""
+    from spark_rapids_jni_tpu.serving.journal import AdmissionJournal
+    from spark_rapids_jni_tpu.serving.replica import table_to_wire
+
+    jpath = str(tmp_path / "admission.jnl")
+    t = make_table(64, 60)
+    j = AdmissionJournal(jpath, compact_every=0)
+    j.append_admit(100, "alpha", PLAN_FILTER, None, table_to_wire(t),
+                   None, 0)
+    j.append_admit(101, "alpha", PLAN_FILTER, None, table_to_wire(t),
+                   (1.0, time.monotonic() - 5.0, "already-dead"), 0)
+    j.close()
+
+    with config.override("fleet.journal_path", jpath):
+        fl = ServingFleet(replicas=1)
+        try:
+            fl.register_tenant("alpha", priority=1, max_in_flight=64)
+            assert fl.journal_stats()["recovered"] == 2
+            out = fl.replay_journal()
+            assert out == {"replayed": 1, "expired": 1, "shed": 0,
+                           "unknown_tenant": 0}
+            assert fl.counters["journal_replayed"] == 1
+            assert fl.counters["journal_expired"] == 1
+            # the replayed incarnation settles and DONEs its new record:
+            # nothing stays live — the zero-loss invariant
+            _await(lambda: fl.journal_stats()["live"] == 0, 180.0,
+                   "replayed entry to settle")
+        finally:
+            fl.drain()
+
+
+def test_journal_replay_unknown_tenant_stays_live(tmp_path):
+    """An unacked entry for a tenant the new router has not (yet)
+    declared is neither run nor DONEd — it stays live for a later
+    replay instead of being silently dropped."""
+    from spark_rapids_jni_tpu.serving.journal import AdmissionJournal
+    from spark_rapids_jni_tpu.serving.replica import table_to_wire
+
+    jpath = str(tmp_path / "admission.jnl")
+    t = make_table(8, 61)
+    j = AdmissionJournal(jpath, compact_every=0)
+    j.append_admit(7, "ghost", PLAN_FILTER, None, table_to_wire(t),
+                   None, 0)
+    j.close()
+    with config.override("fleet.journal_path", jpath):
+        fl = ServingFleet(replicas=1, spawn=False)
+        try:
+            out = fl.replay_journal()
+            assert out["unknown_tenant"] == 1
+            assert fl.journal_stats()["live"] == 1
+        finally:
+            fl.drain()

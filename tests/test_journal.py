@@ -1,0 +1,220 @@
+"""Durable admission-journal tests (ISSUE 18 tentpole + satellite 3).
+
+Pure in-process: the journal is exercised directly against tmp files —
+roundtrip recovery, the exact-prefix torn-tail contract at EVERY byte
+boundary of the last record, interned-plan digest corruption, compaction,
+and the closed-journal no-op. The fleet-integration side (replay through
+normal admission, router SIGKILL) lives in test_fleet.py and the chaos
+lane.
+"""
+
+import pickle
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.memory.integrity import (scan_journal,
+                                                   write_journal_file)
+from spark_rapids_jni_tpu.serving.journal import (KIND_PLAN,
+                                                  AdmissionJournal)
+
+_JREC_HEAD_SIZE = 17        # u8 kind | u64 seq | u32 len | u32 crc
+
+
+class FakePlan:
+    """Stand-in plan body: the journal only pickles it."""
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __eq__(self, other):
+        return isinstance(other, FakePlan) and other.tag == self.tag
+
+    def __hash__(self):
+        return hash(self.tag)
+
+
+def _fill(j):
+    """Three admits (two share an interned fp, one solo) + one DONE:
+    the live set afterwards is seqs {2, 3}."""
+    j.append_admit(1, "alpha", FakePlan("fp-a"), "fp-a",
+                   ("wire", 1), None, 64)
+    j.append_admit(2, "alpha", FakePlan("fp-a"), "fp-a",
+                   ("wire", 2), (5.0, time.monotonic() + 60.0, "q2"), 64)
+    j.append_admit(3, "beta", FakePlan("solo"), None,
+                   ("wire", 3), None, 32)
+    j.append_done(1)
+
+
+def test_roundtrip_recovery(tmp_path):
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0)
+    _fill(j)
+    assert j.live_count() == 2
+    assert j.fp_frequency() == {"fp-a": 1}
+    j.close()
+
+    r = AdmissionJournal(path, compact_every=0)
+    assert r.recovered_entries == 2
+    assert r.dropped_torn_bytes == 0
+    assert r.dropped_corrupt == 0
+    entries = r.unacked()
+    assert [e.seq for e in entries] == [2, 3]
+    assert entries[0].tenant_id == "alpha"
+    assert entries[0].plan == FakePlan("fp-a")      # decoded from intern
+    assert entries[0].fp == "fp-a"
+    assert entries[0].wire_table == ("wire", 2)
+    assert entries[0].snap[0] == 5.0
+    assert entries[0].estimate == 64
+    assert entries[1].plan == FakePlan("solo")      # solo: plan inline
+    assert entries[1].fp is None
+    # settling the survivors empties the live set
+    r.append_done(2)
+    r.append_done(3)
+    assert r.live_count() == 0
+    assert r.fp_frequency() == {}
+    r.close()
+
+
+def test_torn_tail_every_byte_boundary(tmp_path):
+    """Satellite 3: truncate the journal mid-record at EVERY byte
+    boundary of the last record — recovery must return exactly the clean
+    prefix (never a partial or garbled entry), rewrite the file to that
+    prefix, and a second open must see a clean journal."""
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0)
+    _fill(j)
+    j.close()
+    with open(path, "rb") as f:
+        raw = f.read()
+    records, valid_len = scan_journal(raw)
+    assert valid_len == len(raw)
+    # last frame = header + payload of the final record (the DONE for 1)
+    last_start = len(raw) - (_JREC_HEAD_SIZE + len(records[-1][2]))
+    # the prefix without the DONE leaves all three ADMITs live
+    for cut in range(last_start, len(raw)):
+        tpath = str(tmp_path / f"torn_{cut}")
+        with open(tpath, "wb") as f:
+            f.write(raw[:cut])
+        t = AdmissionJournal(tpath, compact_every=0)
+        assert t.dropped_torn_bytes == cut - last_start
+        assert t.recovered_entries == 3, f"cut at byte {cut}"
+        assert sorted(e.seq for e in t.unacked()) == [1, 2, 3]
+        t.close()
+        # the torn suffix was truncated on disk: a reopen is clean
+        with open(tpath, "rb") as f:
+            rewritten = f.read()
+        _, vlen = scan_journal(rewritten)
+        assert vlen == len(rewritten)
+        t2 = AdmissionJournal(tpath, compact_every=0)
+        assert t2.dropped_torn_bytes == 0
+        assert t2.recovered_entries == 3
+        t2.close()
+    # sanity: the full file recovers the DONE too
+    full = AdmissionJournal(path, compact_every=0)
+    assert full.recovered_entries == 2
+    full.close()
+
+
+def test_missing_magic_recovers_empty(tmp_path):
+    path = str(tmp_path / "jnl")
+    with open(path, "wb") as f:
+        f.write(b"not a journal at all")
+    j = AdmissionJournal(path, compact_every=0)
+    assert j.recovered_entries == 0
+    assert j.dropped_torn_bytes == 20
+    j.append_admit(7, "alpha", FakePlan("x"), None, ("wire", 7), None, 8)
+    j.close()
+    r = AdmissionJournal(path, compact_every=0)
+    assert [e.seq for e in r.unacked()] == [7]
+    r.close()
+
+
+def test_corrupt_plan_digest_drops_admit(tmp_path):
+    """An ADMIT whose interned plan body no longer hashes to the
+    recorded digest is dropped at recovery, never replayed."""
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0)
+    j.append_admit(1, "alpha", FakePlan("fp-a"), "fp-a",
+                   ("wire", 1), None, 64)
+    j.append_admit(2, "beta", FakePlan("solo"), None,
+                   ("wire", 2), None, 32)
+    j.close()
+    with open(path, "rb") as f:
+        records, _ = scan_journal(f.read())
+    # swap the interned body for different bytes (valid frame, valid
+    # pickle — only the digest check can catch it)
+    swapped = []
+    for kind, seq, payload in records:
+        if kind == KIND_PLAN:
+            fp, _body = pickle.loads(payload)
+            payload = pickle.dumps((fp, pickle.dumps(FakePlan("evil"))),
+                                   protocol=4)
+        swapped.append((kind, seq, payload))
+    write_journal_file(path, swapped)
+    r = AdmissionJournal(path, compact_every=0)
+    assert r.dropped_corrupt == 1
+    assert r.recovered_entries == 1
+    assert [e.seq for e in r.unacked()] == [2]   # the solo admit survives
+    r.close()
+
+
+def test_compaction_rewrites_to_live_suffix(tmp_path):
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0)
+    for i in range(8):
+        j.append_admit(i, "alpha", FakePlan(f"fp-{i % 2}"), f"fp-{i % 2}",
+                       ("wire", i), None, 16)
+    size_before_dones = j.stats()
+    for i in range(7):
+        j.append_done(i)
+    import os
+    grown = os.path.getsize(path)
+    j.compact()
+    assert os.path.getsize(path) < grown
+    assert j.live_count() == 1
+    # settled fps' interned bodies are forgotten by compaction
+    assert j.stats()["interned_plans"] == 1
+    assert size_before_dones["interned_plans"] == 2
+    j.close()
+    r = AdmissionJournal(path, compact_every=0)
+    assert [e.seq for e in r.unacked()] == [7]
+    assert r.unacked()[0].plan == FakePlan("fp-1")
+    r.close()
+
+
+def test_auto_compaction_threshold(tmp_path):
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=4)
+    for i in range(6):
+        j.append_admit(i, "alpha", FakePlan("fp"), "fp",
+                       ("wire", i), None, 16)
+    for i in range(6):
+        j.append_done(i)            # crosses the threshold at the 4th
+    assert j._dones_since_compact < 4
+    j.close()
+    r = AdmissionJournal(path, compact_every=0)
+    assert r.recovered_entries == 0
+    r.close()
+
+
+def test_closed_journal_appends_are_noops(tmp_path):
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0)
+    j.append_admit(1, "alpha", FakePlan("x"), None, ("wire", 1), None, 8)
+    j.close()
+    # drain won the race: late writers must not throw or extend the file
+    j.append_admit(2, "alpha", FakePlan("y"), None, ("wire", 2), None, 8)
+    j.append_done(1)
+    r = AdmissionJournal(path, compact_every=0)
+    assert [e.seq for e in r.unacked()] == [1]
+    r.close()
+
+
+def test_stats_shape(tmp_path):
+    path = str(tmp_path / "jnl")
+    j = AdmissionJournal(path, compact_every=0, fsync=False)
+    s = j.stats()
+    assert s["path"] == path
+    assert s["live"] == 0 and s["fsync"] is False
+    j.close()
