@@ -68,7 +68,7 @@ CXXFLAGS ?= -std=c++17 -O2 -fPIC -shared -Wall
 VERSION := $(shell $(PY) -c "import re;print(re.search(r'version = \"([^\"]+)\"', open('pyproject.toml').read()).group(1))")
 GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem fleet restart wheel bench plan join dict encode serve shard clean
+.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem oom fleet restart wheel bench plan join dict encode serve shard clean
 
 native:
 	mkdir -p $(NATIVE_DIR)
@@ -157,6 +157,17 @@ fleet:
 restart:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PY) -m benchmarks.bench_fleet \
 	    --restart-only --stage-seconds 20 \
+	    --out auto > /dev/null
+
+# HBM pressure storm: 0/30/100% injected-OOM storms through the fused
+# tpch pipelines (q1/q6/the q5 join DAG, DICT32 + RLE inputs) plus a
+# shrinking-pool stage that makes splitting mandatory, then a
+# multi-tenant serving storm under the same pressure. The exit code IS
+# the verdict: bit-identical at every level, zero untyped failures,
+# oom_splits >= 1 forced, zero cross-tenant propagation, clean drain.
+# Writes the next free OOM_rNN.json.
+oom:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PY) -m benchmarks.bench_oom \
 	    --out auto > /dev/null
 
 soak-mem:

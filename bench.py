@@ -357,6 +357,12 @@ def axis_table():
         ("rle_filter_4m", lambda: _B().bench_rle_filter(1 << 22), 1 << 22),
         ("rle_groupby_4m", lambda: _B().bench_rle_groupby(1 << 22), 1 << 22),
         ("for_filter_4m", lambda: _B().bench_for_filter(1 << 22), 1 << 22),
+        # the memory-pressure axis: the same fused groupby under a
+        # shrinking-pool cap that makes split-and-retry MANDATORY on
+        # every whole-table dispatch; the row carries oom_splits/pieces,
+        # baseline_seconds and pressure_overhead_pct via pop_extra() —
+        # one capture prices the split-dispatch-merge detour on-chip
+        ("plan_oom_pressure_4m", lambda: _B().bench_plan_oom_pressure(1 << 22), 1 << 22),
         # the serving-tier axis (ROADMAP item 3): sustained QPS + tail
         # latency through admission/scheduling/micro-batching; the row
         # carries qps, p50/p95/p99, queue depth, dispatches-per-query and

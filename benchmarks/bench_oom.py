@@ -1,0 +1,418 @@
+"""HBM memory-pressure storm harness -> ``OOM_rNN.json``.
+
+Drives injectionType-6 OOM storms at 0/30/100% pressure plus a
+deterministic shrinking-pool stage through the fused tpch pipelines (q1,
+q6, the q5 join DAG) and encoded inputs (a DICT32 groupby, an RLE key),
+then a multi-tenant serving storm under the same pressure. The artifact's
+``verdict`` is the pass/fail contract (the ``make oom`` exit code):
+
+* ``bit_identical_at_every_level`` — every query at every pressure level
+  returns results bit-identical to its zero-pressure run. Split merges
+  are exact (concat / commuting partial-aggregate merge); plans whose
+  pieces can't merge (the q5 DAG, the RLE input) take the NAMED eager
+  gate — degraded, never approximate.
+* ``shrink_forced_splits`` — the shrinking-pool stage (a standing pool
+  cap between the half- and whole-input envelopes) forces
+  ``oom_splits >= 1``: the ladder's split rung is proven mandatory, not
+  sampled.
+* ``zero_untyped_failures`` — nothing surfaced anywhere in the storm
+  except (at most) typed OOMs; any other exception class fails the lane.
+* ``serving_zero_cross_tenant_propagation`` — under a 30% OOM storm the
+  serving tier completes EVERY query (pressure is recoverable by
+  design: lane demotion + the solo retry ladder), attributes every
+  retry/split to an owning tenant, trues up the admission book, and
+  drains clean. With zero failed queries, cross-tenant propagation is
+  zero by construction.
+
+Storm mechanics: percent-based rules ride a bounded interception budget
+per (query, level) — percent says how likely each fused dispatch is to
+OOM, the budget bounds the demand so a 100% storm still converges (the
+reference's forceRetryOOM(n) semantics); the shrink stage instead stands
+a cap every whole-input dispatch must split under. Rules are installed
+fresh per query so budgets never leak across measurements.
+
+Usage::
+
+    python -m benchmarks.bench_oom --rows 131072 --out auto
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import tpch
+from .bench_serving import next_artifact_path
+
+PRESSURE_LEVELS = (0, 30, 100)
+OOM_BUDGET_PER_QUERY = 3          # bounded demand: storms must converge
+
+
+# -- bit-identity fingerprints ----------------------------------------------
+
+
+def _col_fp(c) -> tuple:
+    # validity None IS an all-true mask (same normalization the test
+    # suite's assert_tables_bit_identical applies)
+    v = (np.ones(c.size, bool) if c.validity is None
+         else np.asarray(c.validity).astype(bool))
+    return (str(c.dtype.id.value), np.asarray(c.data).tobytes(),
+            v.tobytes(), tuple(_col_fp(k) for k in c.children))
+
+
+def table_fp(t) -> tuple:
+    """Exact content fingerprint: data bytes + validity bytes + encoded
+    children, recursively — equality here IS bit-identity."""
+    return tuple(_col_fp(c) for c in t.columns)
+
+
+def result_fp(out) -> tuple:
+    if isinstance(out, int):
+        return ("int", out)
+    return table_fp(out)
+
+
+# -- the storm workload ------------------------------------------------------
+
+
+def _dict_workload(rows: int, seed: int):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.columnar.dictionary import encode_strings
+    from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Scan, col, lit,
+                                           execute_plan)
+    rng = np.random.default_rng(seed)
+    words = ["aa", "bb", "cc", "dd", "ee"]
+    sc = Column.from_pylist([words[i] for i in rng.integers(0, 5, rows)],
+                            dt.STRING)
+    t = Table((encode_strings(sc),
+               Column.from_numpy(rng.integers(0, 1000, rows), dt.INT64)))
+    plan = GroupBy(Filter(Scan(2), col(0) != lit("bb")), (0,),
+                   ((1, "sum"), (1, "count")))
+    return lambda: execute_plan(plan, t), t
+
+
+def _rle_workload(rows: int, seed: int):
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.columnar.encodings import rle_encode
+    from spark_rapids_jni_tpu.plan import GroupBy, Scan, execute_plan
+    rng = np.random.default_rng(seed)
+    run_len = 64
+    keys = Column.from_numpy(
+        np.repeat(rng.integers(0, 7, max(1, rows // run_len)),
+                  run_len)[:rows].astype(np.int64), dt.INT64)
+    t = Table((rle_encode(keys),
+               Column.from_numpy(rng.integers(0, 100, keys.size),
+                                 dt.INT64)))
+    plan = GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count")))
+    return lambda: execute_plan(plan, t), t
+
+
+def build_queries(rows: int, seed: int) -> List[Tuple[str, Callable, Any]]:
+    """(name, thunk, pressure_input_table) triples. The table is what the
+    shrinking-pool stage sizes its cap against (None = skip shrink)."""
+    li = tpch.generate_q1_lineitem(rows, seed)
+    q5 = tpch.generate_q5_tables(rows, seed + 1)
+    dict_run, dict_t = _dict_workload(max(rows // 4, 4096), seed + 2)
+    rle_run, rle_t = _rle_workload(max(rows // 4, 4096), seed + 3)
+    return [
+        ("q1_fused", lambda: tpch.run_q1(li, engine="plan"), li),
+        ("q6_fused", lambda: tpch.run_q6(li, engine="plan"), li),
+        # the join DAG: pieces can't merge (probe rows span the build
+        # side) — pressure takes the named eager gate, still exact
+        ("q5_join_dag", lambda: tpch.run_q5(*q5, engine="plan"), None),
+        ("dict32_groupby", dict_run, dict_t),
+        # RLE run buffers don't split on row boundaries: named eager gate
+        ("rle_groupby", rle_run, None),
+    ]
+
+
+# -- storm plumbing ----------------------------------------------------------
+
+
+def _install(cfg: dict, seed: int):
+    from spark_rapids_jni_tpu.faultinj import install
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="oomstorm_")
+    with os.fdopen(fd, "w") as f:
+        json.dump(cfg, f)
+    install(path, seed=seed)
+    return path
+
+
+def _oom_cfg(percent: int, mode: str = "split",
+             count: int = OOM_BUDGET_PER_QUERY, **extra) -> dict:
+    rule = {"percent": percent, "injectionType": 6,
+            "interceptionCount": count, "oomMode": mode}
+    rule.update(extra)
+    return {"xlaRuntimeFaults": {"plan_execute": rule}}
+
+
+def _run_once(name: str, thunk: Callable) -> Dict[str, Any]:
+    """One pressured query run: plan/fault metric deltas + typed-failure
+    classification. Never raises — untyped failures are the verdict's
+    business, not the harness's."""
+    from spark_rapids_jni_tpu.faultinj.guard import metrics as fault_metrics
+    from spark_rapids_jni_tpu.memory.exceptions import OffHeapOOM, TpuOOM
+    from spark_rapids_jni_tpu.plan import plan_metrics
+    before = plan_metrics.snapshot()
+    fb = fault_metrics.snapshot()
+    t0 = time.perf_counter()
+    row: Dict[str, Any] = {"query": name}
+    try:
+        out = thunk()
+        row["fp"] = result_fp(out)
+        row["completed"] = True
+    except (TpuOOM, OffHeapOOM) as e:
+        row["completed"] = False
+        row["typed_oom"] = type(e).__name__
+    except BaseException as e:  # noqa: BLE001 — the lane's failure signal
+        row["completed"] = False
+        row["untyped_failure"] = f"{type(e).__name__}: {e}"
+    row["seconds"] = round(time.perf_counter() - t0, 4)
+    after = plan_metrics.snapshot()
+    fa = fault_metrics.snapshot()
+    for k, label in (("plan_oom_retries", "oom_retries"),
+                     ("plan_oom_splits", "oom_splits"),
+                     ("plan_oom_pieces", "pieces"),
+                     ("plan_oom_spill_bytes", "spill_bytes"),
+                     ("plan_fallbacks", "eager_fallbacks")):
+        row[label] = after[k] - before[k]
+    row["injected_ooms"] = fa["injected_ooms"] - fb["injected_ooms"]
+    reasons = after.get("plan_fallback_reasons", {})
+    base = before.get("plan_fallback_reasons", {})
+    gate = {r: reasons.get(r, 0) - base.get(r, 0)
+            for r in ("oom-split-unmergeable", "oom-split-degenerate",
+                      "overflow")}
+    row["eager_gates"] = {r: n for r, n in gate.items() if n}
+    return row
+
+
+def run_pressure_levels(queries, seed: int) -> List[Dict[str, Any]]:
+    from spark_rapids_jni_tpu.faultinj import uninstall
+    levels = []
+    baseline_fp: Dict[str, tuple] = {}
+    for pct in PRESSURE_LEVELS:
+        stage = {"pressure_pct": pct, "mode": "split", "queries": []}
+        for qi, (name, thunk, _t) in enumerate(queries):
+            if pct > 0:
+                _install(_oom_cfg(pct), seed=seed + pct + qi)
+            row = _run_once(name, thunk)
+            if pct > 0:
+                uninstall()
+            if pct == 0:
+                baseline_fp[name] = row.pop("fp", None)
+                row["bit_identical"] = True   # the reference itself
+            else:
+                row["bit_identical"] = (
+                    row.pop("fp", None) == baseline_fp[name])
+            stage["queries"].append(row)
+        levels.append(stage)
+    # a second 100% pass exercising the RETRY rung (rollback + same
+    # program) rather than the split rung
+    stage = {"pressure_pct": 100, "mode": "retry", "queries": []}
+    for qi, (name, thunk, _t) in enumerate(queries):
+        _install(_oom_cfg(100, mode="retry"), seed=seed + 200 + qi)
+        row = _run_once(name, thunk)
+        uninstall()
+        row["bit_identical"] = (row.pop("fp", None) == baseline_fp[name])
+        stage["queries"].append(row)
+    levels.append(stage)
+    return levels
+
+
+def run_shrink_stage(queries, seed: int) -> List[Dict[str, Any]]:
+    """The deterministic stage: a standing pool cap at 1.5x the input's
+    device bytes — the whole-input envelope (2x) can never fit, both
+    half envelopes (~1x) always do, so every dispatch MUST split."""
+    from spark_rapids_jni_tpu.faultinj import uninstall
+    rows = []
+    # zero-pressure fingerprints for the shrink-capable queries
+    base = {}
+    for name, thunk, t in queries:
+        if t is None:
+            continue
+        r = _run_once(name, thunk)
+        base[name] = r.pop("fp", None)
+    for name, thunk, t in queries:
+        if t is None:
+            continue
+        cap = int(1.5 * t.device_nbytes())
+        _install(_oom_cfg(0, mode="shrink", poolBytes=cap),
+                 seed=seed + 400)
+        row = _run_once(name, thunk)
+        uninstall()
+        row["pool_cap_bytes"] = cap
+        row["bit_identical"] = (row.pop("fp", None) == base[name])
+        rows.append(row)
+    return rows
+
+
+def run_serving_storm(seed: int, queries_per_tenant: int = 24,
+                      rows: int = 2048) -> Dict[str, Any]:
+    """A 3-tenant storm through the full serving stack under a 30% OOM
+    (split-mode) storm at the fused surface: batched lanes demote, solos
+    ride the executor ladder, every recovery is attributed to a tenant,
+    and the admission book trues up. Zero failed queries == zero
+    cross-tenant propagation (pressure is never a member fault)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Column, Table
+    from spark_rapids_jni_tpu.faultinj import uninstall
+    from spark_rapids_jni_tpu.plan import expr as pex
+    from spark_rapids_jni_tpu.plan.executor import execute_plan
+    from spark_rapids_jni_tpu.plan.nodes import Filter, GroupBy, Scan
+    from spark_rapids_jni_tpu.serving import (ServingFrontend,
+                                              batch_key_for,
+                                              serving_metrics)
+    from spark_rapids_jni_tpu.utils import config
+
+    plan = GroupBy(Filter(Scan(2), pex.BinOp("lt", pex.Col(0), pex.Lit(5))),
+                   (0,), ((1, "sum"), (1, "count")))
+    rng = np.random.default_rng(seed)
+
+    def mk(i):
+        return Table((
+            Column(dt.INT64, rows, data=jnp.asarray(
+                rng.integers(0, 7, rows, dtype=np.int64))),
+            Column(dt.INT64, rows, data=jnp.asarray(
+                rng.integers(0, 1000, rows, dtype=np.int64))),
+        ))
+
+    tenants = ("alpha", "beta", "gamma")
+    tables = [mk(i) for i in range(queries_per_tenant * len(tenants))]
+    want = [result_fp(execute_plan(batch_key_for(plan, t)[0], t))
+            for t in tables]
+
+    serving_metrics.reset()
+    stage: Dict[str, Any] = {"pressure_pct": 30, "mode": "split"}
+    bit_identical = True
+    untyped = 0
+    with config.override("serving.batch_window_ms", 30.0), \
+            ServingFrontend() as fe:
+        for tid in tenants:
+            fe.register_tenant(tid, priority=1)
+        _install(_oom_cfg(30, count=10 * len(tenants)), seed=seed + 500)
+        futs = [fe.submit(tenants[i % len(tenants)], plan, t,
+                          budget_s=120.0)
+                for i, t in enumerate(tables)]
+        failed = 0
+        for f, w in zip(futs, want):
+            try:
+                if result_fp(f.result(timeout=240)) != w:
+                    bit_identical = False
+            except BaseException as e:  # noqa: BLE001 — verdict input
+                failed += 1
+                from spark_rapids_jni_tpu.memory.exceptions import (
+                    OffHeapOOM, TpuOOM)
+                if not isinstance(e, (TpuOOM, OffHeapOOM)):
+                    untyped += 1
+        uninstall()
+        m = serving_metrics.snapshot()
+        by_tenant = {tid: {k: fe.registry.stats_of(tid)[k]
+                           for k in ("completed", "failed", "oom_retries",
+                                     "oom_splits")}
+                     for tid in tenants}
+        book = fe.registry.fp_book_snapshot()
+        verdict = fe.drain()
+    stage.update({
+        "offered": len(tables),
+        "completed": m["completed"],
+        "failed_queries": failed,
+        "cross_tenant_propagation": failed,   # any failure IS propagation
+        "untyped_failures": untyped,
+        "bit_identical": bit_identical,
+        "oom_retries": m["oom_retries"],
+        "oom_splits": m["oom_splits"],
+        "batch_oom_demotions": m["batch_oom_demotions"],
+        "attributed_to_tenants": sum(
+            r["oom_retries"] + r["oom_splits"]
+            for r in by_tenant.values()),
+        "tenants": by_tenant,
+        "fp_book": {fp[:12]: ent for fp, ent in book.items()},
+        "drain_clean": bool(verdict["clean"]),
+    })
+    return stage
+
+
+# -- verdict + entry point ---------------------------------------------------
+
+
+def _all_rows(levels) -> List[Dict[str, Any]]:
+    return [r for stage in levels for r in stage["queries"]]
+
+
+def run_storm(rows: int, seed: int,
+              queries_per_tenant: int = 24) -> Dict[str, Any]:
+    queries = build_queries(rows, seed)
+    levels = run_pressure_levels(queries, seed)
+    shrink = run_shrink_stage(queries, seed)
+    serving = run_serving_storm(seed, queries_per_tenant)
+
+    rows_all = _all_rows(levels) + shrink
+    verdict = {
+        "bit_identical_at_every_level": all(
+            r.get("bit_identical") for r in rows_all),
+        "all_queries_completed": all(
+            r.get("completed") for r in rows_all),
+        "zero_untyped_failures": (
+            not any("untyped_failure" in r for r in rows_all)
+            and serving["untyped_failures"] == 0),
+        "shrink_forced_splits": all(
+            r["oom_splits"] >= 1 for r in shrink),
+        "storm_recoveries_counted": any(
+            r["oom_splits"] + r["oom_retries"] >= 1 for r in rows_all),
+        "serving_zero_failed": serving["failed_queries"] == 0,
+        "serving_zero_cross_tenant_propagation":
+            serving["cross_tenant_propagation"] == 0,
+        "serving_attribution_balanced": (
+            serving["attributed_to_tenants"]
+            == serving["oom_retries"] + serving["oom_splits"]),
+        "serving_drain_clean": serving["drain_clean"],
+    }
+    verdict["ok"] = all(verdict.values())
+    return {
+        "kind": "srjt-oom-storm",
+        "rows": rows,
+        "seed": seed,
+        "pressure_levels": levels,
+        "shrink_stage": shrink,
+        "serving_storm": serving,
+        "verdict": verdict,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="HBM memory-pressure storm harness (OOM_rNN.json)")
+    ap.add_argument("--rows", type=int, default=1 << 17,
+                    help="lineitem rows for the tpch storms")
+    ap.add_argument("--serving-queries", type=int, default=24,
+                    help="queries per tenant in the serving storm")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="write the OOM artifact JSON here "
+                         "('auto' = next free OOM_rNN.json)")
+    args = ap.parse_args(argv)
+
+    res = run_storm(args.rows, args.seed, args.serving_queries)
+    blob = json.dumps(res, indent=2, sort_keys=False)
+    out = (next_artifact_path("OOM") if args.out == "auto" else args.out)
+    if out:
+        with open(out, "w") as f:
+            f.write(blob + "\n")
+        print(f"oom artifact -> {out}", file=sys.stderr)
+    print(blob)
+    return 0 if res["verdict"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -920,6 +920,79 @@ def bench_tpch_q5(rows: int, mesh_devices: int = 0):
     return sec, nbytes
 
 
+def bench_plan_oom_pressure(rows: int):
+    """Fused groupby under a shrinking HBM pool: a standing injector cap
+    at 1.5x the input's device bytes sits between the half-input (~1x)
+    and whole-input (2x) reservation envelopes, so EVERY whole-table
+    dispatch must split — the pressured number prices the full
+    split-dispatch-merge detour (two piece dispatches + exact
+    commuting-partial merge) against the unpressured fused baseline.
+    Row asserts bit-identity between the two; the overhead percentage is
+    the headline column."""
+    import json as _json
+    import tempfile
+
+    from spark_rapids_jni_tpu.columnar import dtype as dt
+    from spark_rapids_jni_tpu.columnar.column import Table
+    from spark_rapids_jni_tpu.faultinj import install, uninstall
+    from spark_rapids_jni_tpu.plan import (GroupBy, Scan, execute_plan,
+                                           plan_metrics)
+    from spark_rapids_jni_tpu.utils.datagen import (
+        ColumnProfile, Dist, generate_column)
+
+    tables = []
+    for s in range(_NVARIANTS):
+        k = generate_column(rows, ColumnProfile(
+            dt.INT64, dist=Dist("geometric", 0, max(2, rows // 100)),
+            cardinality=max(2, rows // 100), avg_run_length=4,
+            null_frequency=None), seed=s)
+        v = generate_column(rows, ColumnProfile(
+            dt.INT64, dist=Dist("uniform", -1000, 1000), cardinality=0,
+            avg_run_length=1, null_frequency=None), seed=100 + s)
+        tables.append(Table((k, v)))
+    plan = GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count")))
+
+    def run(i):
+        out = execute_plan(plan, tables[i % _NVARIANTS])
+        return [c.data for c in out.columns]
+
+    baselines = [run(i) for i in range(_NVARIANTS)]
+    base_sec = _time(run, warmup=_NVARIANTS)
+
+    cap = int(1.5 * max(t.device_nbytes() for t in tables))
+    fd, cfg = tempfile.mkstemp(suffix=".json", prefix="oombench_")
+    with os.fdopen(fd, "w") as f:
+        _json.dump({"xlaRuntimeFaults": {"plan_execute": {
+            "percent": 0, "injectionType": 6, "oomMode": "shrink",
+            "interceptionCount": 0, "poolBytes": cap}}}, f)
+    install(cfg, seed=0)
+    try:
+        before = plan_metrics.snapshot()
+        sec = _with_plan_extra(lambda: _time(run, warmup=_NVARIANTS))
+        after = plan_metrics.snapshot()
+        pressured = [run(i) for i in range(_NVARIANTS)]
+    finally:
+        uninstall()
+    bit_identical = all(
+        all(np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(ba, pa))
+        for ba, pa in zip(baselines, pressured))
+    LAST_EXTRA.update({
+        "engine": "plan",
+        "pool_cap_bytes": cap,
+        "oom_retries": after["plan_oom_retries"] - before["plan_oom_retries"],
+        "oom_splits": after["plan_oom_splits"] - before["plan_oom_splits"],
+        "oom_pieces": after["plan_oom_pieces"] - before["plan_oom_pieces"],
+        "spill_bytes":
+            after["plan_oom_spill_bytes"] - before["plan_oom_spill_bytes"],
+        "baseline_seconds": round(base_sec, 6),
+        "pressure_overhead_pct":
+            round(100.0 * (sec - base_sec) / base_sec, 2) if base_sec else 0.0,
+        "bit_identical": bit_identical,
+    })
+    return sec, rows * 16
+
+
 def bench_get_json_object(rows: int):
     """get_json_object native host tier (SURVEY §7.8 tiering must be
     justified with numbers; ref device kernel: get_json_object.cu)."""

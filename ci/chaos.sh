@@ -181,3 +181,27 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
 # sibling rolling-restart lane (zero-downtime recycle of every replica).
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_fleet \
     --router-kill --stage-seconds 20 --replicas 2 > /dev/null
+
+# stage 14 — HBM memory-pressure storm: injectionType-6 rules fire
+# typed TpuRetryOOM/TpuSplitAndRetryOOM demands inside the fused
+# dispatch surface, driving the full ladder — retry with spill rollback,
+# row-partition split with exact piece merges (concat / commuting
+# partial-aggregate merge, same compiled program per piece), the NAMED
+# eager gates where pieces can't merge (the q5 join DAG, RLE/FOR
+# inputs, float non-count aggs), terminal typed shed — plus lane
+# demotion and tenant attribution in the serving tier, and a watchdog
+# that never counts a split-retrying thread as stalled. First the unit
+# storms (the full ladder, injector composition with hang+crash,
+# serving demotion/true-up), then a short-budget run of the bench
+# harness: 0/30/100% storms through fused q1/q6/q5 + DICT32 + RLE, a
+# shrinking-pool stage where splitting is MANDATORY (the whole-input
+# envelope can never fit), and a 3-tenant serving storm. Pass criteria
+# are the harness's exit code: bit-identical results at EVERY pressure
+# level, zero untyped failures, shrink-forced oom_splits >= 1, zero
+# cross-tenant propagation, clean drain books. `make oom` runs the
+# full-scale lane (writes the next free OOM_rNN.json).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_oom_pressure.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_oom \
+    --rows 65536 --serving-queries 8 > /dev/null

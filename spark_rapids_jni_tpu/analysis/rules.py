@@ -35,6 +35,8 @@ Rule IDs:
            process control outside serving/fleet.py
   SRJT019  serving/* client ack (a future returned after an admission
            charge) not dominated by a durable journal append
+  SRJT020  retry-OOM handler outside memory/retry.py that re-dispatches
+           without invoking the declared rollback funnel
 """
 
 from __future__ import annotations
@@ -1561,6 +1563,83 @@ def rule_srjt019(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT020 — retry-OOM handler without the declared rollback funnel
+# ---------------------------------------------------------------------------
+# The retry-OOM contract (memory/retry.py, ARCHITECTURE.md "Memory
+# pressure"): a ``*RetryOOM`` / ``*SplitAndRetryOOM`` means the pool could
+# not satisfy a demand AS THINGS STAND — re-dispatching work from the
+# handler without first releasing spillable state just replays the same
+# failing demand, now with the retry budget partly spent. Outside
+# memory/retry.py (the protocol's own implementation), a handler that
+# catches the typed OOMs and then calls anything must route through the
+# declared funnel vocabulary first:
+#
+#   * the rollback funnels — ``rollback_all_stores`` (process-wide),
+#     ``spill_all`` / ``spill_to_fit`` / ``rollback_cb`` (per-store),
+#     ``rollback`` / ``_rollback`` (executor-local wrappers);
+#   * the protocol itself — ``with_retry`` (re-entering the ladder) or
+#     ``block_thread_until_ready`` (the BUFN gate);
+#   * the named degradation sink — ``run_eager`` (the ladder's terminal:
+#     the eager interpreter re-derives from source inputs and abandons
+#     the failed fused demand rather than repeating it).
+#
+# Handlers that only absorb or propagate (no calls at all — ``pass``,
+# ``continue``, re-``raise``) are fine: nothing is re-dispatched. A
+# reviewed exception carries ``# srjt: noqa[SRJT020]`` with the reason.
+
+_SRJT020_OOM_SUFFIX = "RetryOOM"
+_SRJT020_OOM_BASES = ("TpuOOM", "OffHeapOOM")
+_SRJT020_FUNNEL = ("rollback_all_stores", "spill_all", "spill_to_fit",
+                   "rollback_cb", "rollback", "_rollback", "_rollback_spill",
+                   "with_retry", "block_thread_until_ready", "run_eager")
+
+
+def _srjt020_catches_oom(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    names = []
+    for sub in ([t.elts] if isinstance(t, ast.Tuple) else [[t]]):
+        for e in sub:
+            dn = _dotted(e)
+            if dn is not None:
+                names.append(dn.split(".")[-1])
+    return any(n.endswith(_SRJT020_OOM_SUFFIX) or n in _SRJT020_OOM_BASES
+               for n in names)
+
+
+def rule_srjt020(tree, rel, lines, ctx) -> List[Finding]:
+    if rel.endswith("memory/retry.py"):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) \
+                or not _srjt020_catches_oom(node):
+            continue
+        calls = [sub for stmt in node.body for sub in ast.walk(stmt)
+                 if isinstance(sub, ast.Call)]
+        if not calls:
+            continue                    # absorb/propagate only: no dispatch
+        leaves = set()
+        for c in calls:
+            dn = _dotted(c.func)
+            if dn is not None:
+                leaves.add(dn.split(".")[-1])
+        if leaves & set(_SRJT020_FUNNEL):
+            continue
+        findings.append(Finding(
+            "SRJT020", rel, node.lineno,
+            "retry-OOM handler re-dispatches without the declared "
+            "rollback funnel — a *RetryOOM means the pool cannot satisfy "
+            "the demand as things stand; call rollback_all_stores / "
+            "spill_all / the store's rollback_cb (or degrade via "
+            "run_eager / re-enter with_retry) before running anything "
+            "else, or carry `# srjt: noqa[SRJT020]` with the reason "
+            "(memory/retry.py owns the protocol itself)"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 from .protocol import project_rule_flow  # noqa: E402  (same shape:
@@ -1571,7 +1650,7 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
               rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018,
-              rule_srjt019)
+              rule_srjt019, rule_srjt020)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races,
                  project_rule_flow)

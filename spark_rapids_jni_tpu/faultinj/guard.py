@@ -211,7 +211,8 @@ class FaultDomainMetrics:
                "workers_lost", "injected_crashes", "crash_detected",
                "worker_respawns", "quarantined_inputs", "breaker_opened",
                "breaker_closed", "breaker_short_circuits", "drains",
-               "batch_solo_replays")
+               "batch_solo_replays", "injected_ooms", "oom_retries",
+               "oom_splits")
 
     def __init__(self):
         self._lock = threading.Lock()

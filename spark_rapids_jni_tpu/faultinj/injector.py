@@ -68,14 +68,14 @@ class InjectedApiError(RuntimeError):
 
 _KNOWN_TYPES = (
     "0=device trap, 1=device assert, 2=substituted api error, "
-    "3=payload bit-flip, 4=delay/hang, 5=worker crash")
+    "3=payload bit-flip, 4=delay/hang, 5=worker crash, 6=oom")
 
 
 class _Rule:
     def __init__(self, name: str, cfg: dict):
         self.percent = float(cfg.get("percent", 0))
         self.injection_type = int(cfg.get("injectionType", 0))
-        if self.injection_type not in (0, 1, 2, 3, 4, 5):
+        if self.injection_type not in (0, 1, 2, 3, 4, 5, 6):
             # an unrecognized type would otherwise be constructed and
             # silently never fire — a chaos config typo must fail loudly
             raise ValueError(
@@ -90,16 +90,33 @@ class _Rule:
         # (SIGABRT, the native-trap analog), "kill" (SIGKILL), or "exit"
         # (os._exit with substituteReturnCode)
         self.crash_mode = str(cfg.get("crashMode", "abort"))
+        # injectionType 6: the retry-OOM protocol's injection surface
+        # (reference: RmmSpark.forceRetryOOM/forceSplitAndRetryOOM).
+        # oomMode "retry" (default) demands rollback+retry, "split"
+        # demands split-and-retry, "shrink" stands a poolBytes cap at the
+        # matched surface so every oversized envelope must split; numOoms
+        # fires that many consecutive OOMs per sampled hit, skipCount
+        # skips that many matched calls before the first
+        self.oom_mode = str(cfg.get("oomMode", "retry"))
+        self.num_ooms = int(cfg.get("numOoms", 1))
+        self.skip_remaining = int(cfg.get("skipCount", 0))
+        self.pool_bytes = int(cfg.get("poolBytes", 0))
+        if self.injection_type == 6 and self.oom_mode not in (
+                "retry", "split", "shrink"):
+            raise ValueError(
+                f"fault config rule {name!r}: unknown oomMode "
+                f"{self.oom_mode!r} (known: retry, split, shrink)")
 
     def maybe_fire(self, api: str, rng: random.Random) -> Optional[float]:
         """Sample one matched call. Types 0-2 raise; type 4 returns the
         delay in seconds for the caller to execute OUTSIDE the injector
         lock (a hang held under the lock would wedge every other thread's
         rule check); None = nothing fired."""
-        if self.injection_type in (3, 5):
-            return None  # payload bit-flips fire via bitflip_rng and
-            # worker crashes via crash_spec — each owns its budget; an
-            # exception checkpoint has no buffer and no worker to kill
+        if self.injection_type in (3, 5, 6):
+            return None  # payload bit-flips fire via bitflip_rng, worker
+            # crashes via crash_spec, OOMs via sample_oom / oom_pool_cap —
+            # each owns its budget; an exception checkpoint has no buffer
+            # and no worker to kill
         if self.count_remaining <= 0:
             return None
         self.count_remaining -= 1
@@ -112,6 +129,23 @@ class _Rule:
         if self.injection_type == 4:
             return -1.0 if self.delay_ms < 0 else self.delay_ms / 1000.0
         raise InjectedApiError(self.substitute, api)
+
+    def sample_oom(self, rng: random.Random) -> Optional[dict]:
+        """injectionType 6 sampling (retry/split modes) for one matched
+        call: honor skipCount, then interceptionCount + percent like
+        every other type. Returns the OOM directive for ``check`` to
+        fire OUTSIDE the lock, or None."""
+        if self.oom_mode == "shrink":
+            return None  # standing cap; consulted via oom_pool_cap
+        if self.skip_remaining > 0:
+            self.skip_remaining -= 1
+            return None
+        if self.count_remaining <= 0:
+            return None
+        self.count_remaining -= 1
+        if rng.uniform(0, 100) >= self.percent:
+            return None
+        return {"mode": self.oom_mode, "num_ooms": max(1, self.num_ooms)}
 
 
 class FaultInjector:
@@ -168,11 +202,18 @@ class FaultInjector:
         """Consult the rules for one API call (may raise, may block on an
         injectionType 4 delay/hang — the block happens outside the lock)."""
         self._maybe_reload()
+        oom = None
         with self._lock:
             rule = self._rules.get(api) or self._rules.get("*")
             if rule is None:
                 return
-            delay_s = rule.maybe_fire(api, self._rng)
+            if rule.injection_type == 6:
+                delay_s = None
+                oom = rule.sample_oom(self._rng)
+            else:
+                delay_s = rule.maybe_fire(api, self._rng)
+        if oom is not None:
+            _fire_oom(api, oom)
         if delay_s is not None:
             from . import watchdog
             watchdog.injected_delay(api, delay_s)
@@ -213,6 +254,21 @@ class FaultInjector:
             if self._rng.uniform(0, 100) >= rule.percent:
                 return None
             return {"mode": rule.crash_mode, "code": rule.substitute or 1}
+
+    def oom_pool_cap(self, api: str) -> Optional[int]:
+        """injectionType 6 shrinking-pool mode: the standing byte cap a
+        matched surface's reservation envelope must fit under, or demand
+        a split (consulted by plan/executor.py before dispatch). NOT
+        sampled — no budget decrement, no percent roll: the pool IS that
+        small for as long as the rule stands, which is what makes splits
+        mandatory rather than probabilistic. None = no cap."""
+        self._maybe_reload()
+        with self._lock:
+            rule = self._rules.get(api) or self._rules.get("*")
+            if (rule is None or rule.injection_type != 6
+                    or rule.oom_mode != "shrink" or rule.pool_bytes <= 0):
+                return None
+            return rule.pool_bytes
 
     def wrap(self, fn, api: str):
         def wrapper(*a, **kw):
@@ -256,11 +312,48 @@ class FaultInjector:
         self._patched.clear()
 
 
+def _fire_oom(api: str, spec: dict) -> None:
+    """Execute one fired injectionType 6 rule (retry/split modes),
+    OUTSIDE the injector lock. When the RmmSpark adaptor is installed
+    and the calling thread is registered, the injection RIDES THE REAL
+    STATE MACHINE (``force_retry_oom``/``force_split_and_retry_oom`` on
+    the current thread id — the next reservation alloc raises through
+    the native BUFN ladder, exactly the reference path). Otherwise the
+    mapped exception is raised synthetically at the checkpoint; both
+    routes land in ``memory.retry.with_retry`` via the fault-domain
+    supervisor's RESOURCE_EXHAUSTED classification."""
+    from ..memory.exceptions import TpuRetryOOM, TpuSplitAndRetryOOM
+    from ..memory.rmm_spark import RmmSpark
+    from .guard import metrics
+    metrics.bump("injected_ooms")
+    want_split = spec["mode"] == "split"
+    if RmmSpark.is_installed():
+        try:
+            tid = RmmSpark.get_current_thread_id()
+            if want_split:
+                RmmSpark.force_split_and_retry_oom(tid, spec["num_ooms"])
+            else:
+                RmmSpark.force_retry_oom(tid, spec["num_ooms"])
+            return  # the next alloc on this thread raises the real OOM
+        except RuntimeError:
+            pass  # thread not registered with the adaptor: fire synthetic
+    if want_split:
+        raise TpuSplitAndRetryOOM(f"injected split-and-retry OOM at {api}")
+    raise TpuRetryOOM(f"injected retry OOM at {api}")
+
+
 _global: Optional[FaultInjector] = None
 
 
 def get_injector() -> Optional[FaultInjector]:
     return _global
+
+
+def oom_pool_cap(api: str) -> Optional[int]:
+    """Module-level convenience for reservation-envelope call sites
+    (plan/executor.py): the standing injected pool cap for ``api``, or
+    None when no injector/shrink rule stands."""
+    return _global.oom_pool_cap(api) if _global is not None else None
 
 
 def install(config_path: Optional[str] = None, seed: int = None) -> FaultInjector:

@@ -83,8 +83,10 @@ __all__ = [
     "derive_timeout",
     "end_dispatch",
     "ensure_deadline",
+    "in_oom_wait",
     "injected_delay",
     "last_bundles",
+    "oom_wait",
     "remaining",
     "replica_id",
     "reset",
@@ -312,6 +314,42 @@ _inflight: Dict[int, _Inflight] = {}
 _ids = itertools.count(1)
 _thread: Optional[threading.Thread] = None
 _bundles: deque = deque(maxlen=16)
+# thread ident -> nesting depth while inside the retry-OOM protocol's
+# blocking sections (memory/retry.py rollback + BUFN gate): the stall scan
+# must never count a legitimately blocked-until-ready thread as wedged
+_oom_waits: Dict[int, int] = {}
+
+
+class oom_wait:
+    """Context manager marking the calling thread as blocked inside the
+    RmmSpark retry-OOM protocol (re-entrant). While marked, the watchdog's
+    stall sweep skips the thread entirely — a BUFN thread waiting at the
+    pool gate is the protocol working, not a hang; its deadline budget is
+    still enforced cooperatively at the next checkpoint after the wait."""
+
+    def __enter__(self) -> "oom_wait":
+        tid = threading.get_ident()
+        with _lock:
+            _oom_waits[tid] = _oom_waits.get(tid, 0) + 1
+        return self
+
+    def __exit__(self, *a) -> bool:
+        tid = threading.get_ident()
+        with _lock:
+            n = _oom_waits.get(tid, 1) - 1
+            if n <= 0:
+                _oom_waits.pop(tid, None)
+            else:
+                _oom_waits[tid] = n
+        return False
+
+
+def in_oom_wait(thread_ident: Optional[int] = None) -> bool:
+    """True while ``thread_ident`` (default: the caller) is inside the
+    retry-OOM protocol's blocking sections."""
+    tid = threading.get_ident() if thread_ident is None else thread_ident
+    with _lock:
+        return _oom_waits.get(tid, 0) > 0
 
 
 def set_lost_handler(handler: Optional[Callable[[], None]]) -> None:
@@ -380,6 +418,7 @@ def reset() -> None:
     with _lock:
         _inflight.clear()
         _bundles.clear()
+        _oom_waits.clear()
     _replica_id = None
 
 
@@ -416,11 +455,17 @@ def _scan() -> None:
     now = time.monotonic()
     with _lock:
         recs = list(_inflight.values())
+        oom_blocked = {t for t, n in _oom_waits.items() if n > 0}
     by_thread: Dict[int, List[_Inflight]] = {}
     for r in recs:
         by_thread.setdefault(r.thread_id, []).append(r)
     lost_after = float(_cfg("watchdog.lost_after_s"))
     for tid, group in by_thread.items():
+        if tid in oom_blocked:
+            # the thread is inside the retry-OOM protocol (rollback or the
+            # BUFN pool gate, memory/retry.py) — blocked-until-ready is the
+            # protocol working, never a stall to escalate
+            continue
         expired = [r for r in group
                    if r.deadline is not None and r.deadline.expired()]
         if not expired:

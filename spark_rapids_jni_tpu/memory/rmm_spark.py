@@ -20,7 +20,7 @@ import contextlib
 import ctypes
 import threading
 import weakref
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from . import native
 from .exceptions import (
@@ -197,6 +197,7 @@ class SparkResourceAdaptor:
             from ..utils import config
             watchdog_period_s = float(config.get("rmm.watchdog_period_s"))
         self._lib = native.load()
+        self.pool_bytes = int(pool_bytes)   # capacity, for pressure ratios
         loc = (log_loc or "").encode()
         self._handle = self._lib.rm_create(pool_bytes, loc)
         if not self._handle:
@@ -629,6 +630,19 @@ class RmmSpark:
     @classmethod
     def pool_used(cls) -> int:
         return cls._adp().pool_used()
+
+    @classmethod
+    def pool_pressure(cls) -> Tuple[int, int]:
+        """(used_bytes, capacity_bytes) of the installed pool, or (0, 0)
+        when ungoverned — the fleet's replica-pressure telemetry input
+        (advisory: routing weights only, never correctness)."""
+        a = cls._adaptor
+        if a is None:
+            return (0, 0)
+        try:
+            return (a.pool_used(), a.pool_bytes)
+        except Exception:
+            return (0, 0)
 
     @classmethod
     def check_and_break_deadlocks(cls) -> None:

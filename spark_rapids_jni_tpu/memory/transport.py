@@ -569,3 +569,18 @@ _STORES: "weakref.WeakSet[SpillStore]" = weakref.WeakSet()
 def spill_state() -> List[Dict[str, Any]]:
     """Summaries of every live SpillStore (watchdog diagnostics bundles)."""
     return [s.state() for s in list(_STORES)]
+
+
+def rollback_all_stores() -> int:
+    """The process-wide rollback funnel for the retry-OOM protocol: spill
+    every table registered in every live SpillStore back to a spillable
+    state, returning total HBM bytes freed. Callers that hold their own
+    store pass ``store.rollback_cb()`` to ``with_retry`` instead; the
+    fused plan executor — which has no task context — rolls back through
+    this funnel so ANY registered state yields under pressure (the
+    GpuRetryOOM contract: everything spillable is released before the
+    same program re-dispatches)."""
+    freed = 0
+    for s in list(_STORES):
+        freed += s.spill_all()
+    return freed

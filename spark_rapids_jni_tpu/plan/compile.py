@@ -58,7 +58,8 @@ class PlanMetrics:
 
     _COUNTERS = ("plan_compiles", "plan_cache_hits", "plan_cache_misses",
                  "plan_executes", "plan_fallbacks", "plan_join_fallbacks",
-                 "plan_overflows")
+                 "plan_overflows", "plan_oom_retries", "plan_oom_splits",
+                 "plan_oom_pieces", "plan_oom_spill_bytes")
     _TIMES = ("compile_s", "execute_s")
 
     def __init__(self):

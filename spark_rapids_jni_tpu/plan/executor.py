@@ -41,15 +41,52 @@ from ..columnar import dtype as dt
 from ..columnar.column import Column, Table
 from ..columnar.table_ops import gather_table, mask_indices_core
 from ..faultinj.guard import guarded_dispatch
+from ..memory.exceptions import TpuSplitAndRetryOOM
 from ..memory.reservation import device_reservation, release_barrier
+from ..memory.retry import with_retry
+from ..utils import config
 from . import expr as ex
 from . import planner as _planner
+from . import split as _split
 from .compile import CompiledPlan, ProgramCache, plan_metrics
 from .interpreter import run_eager
 from .nodes import (Filter, GroupBy, Join, PlanError, PlanNode, Project,
                     Scan, is_dag, linearize, num_inputs, walk)
 
 _default_cache = ProgramCache()
+
+
+class _OverflowSignal(Exception):
+    """Internal: the fused program's device re-check tripped (group
+    budget, join shape) — the output is garbage; recompute eagerly."""
+
+
+def _pool_cap_check(want_bytes: int) -> None:
+    """injectionType 6 "shrink" mode (faultinj/injector.py): a standing
+    injected pool cap at the plan_execute surface ONLY — a reservation
+    envelope that doesn't fit demands a split, so storms can force the
+    ladder's split rung deterministically while the eager fallback (which
+    never takes this surface) still completes."""
+    from ..faultinj import injector as _inj
+    cap = _inj.oom_pool_cap("plan_execute")
+    if cap is not None and want_bytes > cap:
+        raise TpuSplitAndRetryOOM(
+            f"injected shrinking pool: reservation envelope {want_bytes} "
+            f"bytes exceeds the {cap}-byte cap")
+
+
+def _rollback_spill() -> None:
+    """The ladder's spill-rollback rung: release every SpillStore-
+    registered table, then account the retry (plan_oom_retries) and the
+    freed bytes (plan_oom_spill_bytes)."""
+    from ..memory import transport
+    freed = transport.rollback_all_stores()
+    plan_metrics.inc("plan_oom_retries")
+    plan_metrics.inc("plan_oom_spill_bytes", freed)
+
+
+def _oom_budget() -> int:
+    return int(config.get("plan.oom_retry_budget"))
 
 
 def default_cache() -> ProgramCache:
@@ -270,31 +307,43 @@ def _execute_dag(plan: PlanNode, tables: Tuple[Table, ...],
     nbytes = sum(t.device_nbytes() for t in tables)
 
     def run():
+        _pool_cap_check(2 * nbytes)
         with device_reservation(2 * nbytes) as took:
             out = prog.compiled(tuple(tuple(t.columns) for t in tables),
                                 tuple(aux))
             return release_barrier(out, took)
 
-    t0 = time.perf_counter()
-    cols, mask, head = guarded_dispatch("plan_execute", run)
-    head_h = np.asarray(head)           # THE host sync for the query
-    plan_metrics.add_time("execute_s", time.perf_counter() - t0)
-    plan_metrics.inc("plan_executes")
-    live, overflow = int(head_h[0]), bool(head_h[1])
+    def attempt(_arg):
+        t0 = time.perf_counter()
+        cols, mask, head = guarded_dispatch("plan_execute", run)
+        head_h = np.asarray(head)       # THE host sync for the query
+        plan_metrics.add_time("execute_s", time.perf_counter() - t0)
+        plan_metrics.inc("plan_executes")
+        live, overflow = int(head_h[0]), bool(head_h[1])
+        if overflow:
+            raise _OverflowSignal()
+        if mask is None:
+            return Table(tuple(cols))
+        if prog.prefix:
+            return _trim_prefix(cols, live)
+        idx = mask_indices_core(mask, live)
+        return gather_table(Table(tuple(cols)), idx)
 
-    if overflow:
+    try:
+        # retry/rollback re-dispatch the SAME compiled DAG program; a
+        # split demand gates to eager — the probe side's row order spans
+        # the build side, so join pieces can't merge bit-identically
+        return with_retry(attempt, None, rollback=_rollback_spill,
+                          max_retries=_oom_budget())[0]
+    except TpuSplitAndRetryOOM:
+        return run_eager(plan, tables,
+                         fallback_reason="oom-split-unmergeable")
+    except _OverflowSignal:
         # a device re-check failed (group budget, non-dense build key,
         # duplicate-key build, packing range): fused output is garbage —
         # recompute eagerly. Inputs were never donated on this path.
         plan_metrics.inc("plan_overflows")
         return run_eager(plan, tables, fallback_reason="overflow")
-
-    if mask is None:
-        return Table(tuple(cols))
-    if prog.prefix:
-        return _trim_prefix(cols, live)
-    idx = mask_indices_core(mask, live)
-    return gather_table(Table(tuple(cols)), idx)
 
 
 def execute_plan(plan: PlanNode,
@@ -331,35 +380,94 @@ def execute_plan(plan: PlanNode,
     prog: CompiledPlan = cache.get_or_compile(plan, table,
                                               donate=donate_input)
 
-    def run():
-        # peak ≈ input + intermediates the fuser keeps live; 2x input is
-        # the same envelope the eager sort/join brackets use
-        with device_reservation(2 * table.device_nbytes()) as took:
-            out = prog.compiled(tuple(table.columns))
-            return release_barrier(out, took)
+    def _fused_once(pr: CompiledPlan, t: Table) -> Table:
+        def run():
+            # peak ≈ input + intermediates the fuser keeps live; 2x input
+            # is the same envelope the eager sort/join brackets use
+            _pool_cap_check(2 * t.device_nbytes())
+            with device_reservation(2 * t.device_nbytes()) as took:
+                out = pr.compiled(tuple(t.columns))
+                return release_barrier(out, took)
 
-    t0 = time.perf_counter()
-    cols, mask, head = guarded_dispatch("plan_execute", run)
-    head_h = np.asarray(head)           # THE host sync for the query
-    plan_metrics.add_time("execute_s", time.perf_counter() - t0)
-    plan_metrics.inc("plan_executes")
-    live, overflow = int(head_h[0]), bool(head_h[1])
+        t0 = time.perf_counter()
+        cols, mask, head = guarded_dispatch("plan_execute", run)
+        head_h = np.asarray(head)       # THE host sync for the query
+        plan_metrics.add_time("execute_s", time.perf_counter() - t0)
+        plan_metrics.inc("plan_executes")
+        live, overflow = int(head_h[0]), bool(head_h[1])
+        if overflow:
+            raise _OverflowSignal()
+        if mask is None:
+            return Table(tuple(cols))
+        if pr.prefix:
+            return _trim_prefix(cols, live)
+        idx = mask_indices_core(mask, live)
+        return gather_table(Table(tuple(cols)), idx)
 
-    if overflow:
-        # true group count exceeded the static budget: fused output is
-        # truncated garbage — recompute eagerly (data-dependent shapes)
-        plan_metrics.inc("plan_overflows")
-        if donate_input:
+    if donate_input:
+        # donation consumes the input mid-program: a rollback or split
+        # replay could not re-run it, so the donated path stays OUTSIDE
+        # the retry protocol — the guard still classifies, and the OOM
+        # propagates typed to a caller that owns replayable state
+        try:
+            return _fused_once(prog, table)
+        except _OverflowSignal:
+            plan_metrics.inc("plan_overflows")
             raise RuntimeError(
                 "plan group-budget overflow after input donation: the "
                 "input was consumed by the fused program and the eager "
                 "fallback cannot run. Raise plan.max_groups or disable "
                 "donation for this query.")
+
+    # the degradation ladder: retry (same program) → spill-rollback →
+    # split (halved pieces through the shape-bucketed ProgramCache) →
+    # eager (named gate) → typed shed (the OOM propagates)
+    unmergeable = _split.split_unmergeable_reason(plan, table)
+    state = {"spec": None}
+
+    def attempt(item):
+        tag, t = item
+        if tag == "whole":
+            return _fused_once(prog, t)
+        pr = cache.get_or_compile(state["spec"].piece_plan, t,
+                                  donate=False)
+        return _fused_once(pr, t)
+
+    def do_split(item):
+        if state["spec"] is None:
+            state["spec"] = _split.prepare(plan)
+        _tag, t = item
+        pieces = _split.split_table(t)
+        if len(pieces) >= 2:
+            plan_metrics.inc("plan_oom_splits")
+        return [("piece", p) for p in pieces]
+
+    try:
+        results = with_retry(
+            attempt, ("whole", table),
+            split=None if unmergeable is not None else do_split,
+            rollback=_rollback_spill, max_retries=_oom_budget())
+    except TpuSplitAndRetryOOM:
+        if unmergeable is None:
+            raise  # split depth/retry budget exhausted: typed shed
+        # named gate: this plan's pieces can't merge bit-identically
+        return run_eager(plan, table,
+                         fallback_reason="oom-split-unmergeable")
+    except _OverflowSignal:
+        # true group count exceeded the static budget: fused output is
+        # truncated garbage — recompute eagerly (data-dependent shapes)
+        plan_metrics.inc("plan_overflows")
         return run_eager(plan, table, fallback_reason="overflow")
 
-    if mask is None:
-        return Table(tuple(cols))
-    if prog.prefix:
-        return _trim_prefix(cols, live)
-    idx = mask_indices_core(mask, live)
-    return gather_table(Table(tuple(cols)), idx)
+    if state["spec"] is None:
+        return results[0]
+    plan_metrics.inc("plan_oom_pieces", len(results))
+    try:
+        return _split.merge_pieces(state["spec"], results, table.num_rows,
+                                   int(config.get("plan.max_groups")))
+    except _split.SplitMergeOverflow:
+        plan_metrics.inc("plan_overflows")
+        return run_eager(plan, table, fallback_reason="overflow")
+    except _split.SplitMergeError:
+        return run_eager(plan, table,
+                         fallback_reason="oom-split-degenerate")

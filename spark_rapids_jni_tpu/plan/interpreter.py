@@ -38,6 +38,7 @@ from typing import Optional, Sequence, Union
 import jax.numpy as jnp
 import numpy as np
 
+from ..columnar import dtype as dt_mod
 from ..columnar.column import Column, Table
 from ..columnar.dictionary import align_codes, is_dict
 from ..columnar.table_ops import filter_table, gather_table, slice_table
@@ -74,6 +75,20 @@ def _join_eager(node: Join, lt: Table, rt: Table) -> Table:
             lc = enc.decoded_rows(lc)
         if enc.is_encoded(rc):
             rc = enc.decoded_rows(rc)
+        # integral key pairs hash as int64 lanes — the join kernels hash
+        # raw bytes, so an int32 key never matches an int64 key holding
+        # the same value; the fused lowering widens via _key_values and
+        # the eager boundary must agree with it bit-for-bit
+        if (lc.dtype.is_integral and rc.dtype.is_integral
+                and lc.dtype.id is not rc.dtype.id):
+            if lc.dtype.id is not dt_mod.TypeId.INT64:
+                lc = Column(dt_mod.INT64, lc.size,
+                            data=lc.data.astype(jnp.int64),
+                            validity=lc.validity)
+            if rc.dtype.id is not dt_mod.TypeId.INT64:
+                rc = Column(dt_mod.INT64, rc.size,
+                            data=rc.data.astype(jnp.int64),
+                            validity=rc.validity)
         lkeys.append(lc)
         rkeys.append(rc)
     if node.how == "semi":

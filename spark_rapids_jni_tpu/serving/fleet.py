@@ -369,6 +369,7 @@ class ServingFleet:
             "hedges_issued": 0, "hedges_won": 0, "hedges_wasted": 0,
             "journal_replayed": 0, "journal_expired": 0,
             "replicas_recycled": 0, "restart_deferred": 0,
+            "pressure_deprefs": 0,
         }
         # durable admission journal (round 18): appended before every ack
         jpath = str(config.get("fleet.journal_path") or "")
@@ -471,6 +472,17 @@ class ServingFleet:
         rate = float(t.get("drain_rate", 0.0))
         if best_rate > 0 and depth > 0 and rate < 0.25 * best_rate:
             w *= 0.5
+        # memory-pressure de-preference: a replica reporting pool
+        # occupancy at/above fleet.pressure_depref_ratio is about to pay
+        # retry/split tax on every dispatch — halve its weight so new
+        # keys prefer replicas with headroom (0 disables; ungoverned
+        # replicas report pool_bytes=0 and are never de-preferred)
+        cap = int(t.get("pool_bytes", 0))
+        if cap > 0:
+            ratio = float(config.get("fleet.pressure_depref_ratio"))
+            if ratio > 0 and int(t.get("pool_used", 0)) >= ratio * cap:
+                w *= 0.5
+                self._count("pressure_deprefs")
         return w
 
     def _route(self, key: str,

@@ -43,8 +43,9 @@ from ..columnar.column import Column, Table
 from ..columnar.table_ops import gather_table, mask_indices_core
 from ..faultinj import breaker, watchdog
 from ..faultinj.guard import guarded_dispatch, metrics as fault_metrics
+from ..memory.exceptions import OffHeapOOM, TpuOOM
 from ..memory.reservation import device_reservation, release_barrier
-from ..plan.compile import ProgramCache, _shape_key
+from ..plan.compile import ProgramCache, _shape_key, plan_metrics
 from ..plan.executor import (default_cache, execute_plan,
                              resolve_dict_literals, unsupported_reason)
 from ..plan.nodes import (Filter, GroupBy, PlanNode, Project, Scan,
@@ -217,9 +218,13 @@ def _trim_host(cols_h, mask_h, k: int, live: int, prefix: bool) -> Table:
 
 
 class MemberOutcome:
-    """Per-query result of one batched dispatch: a Table or an error."""
+    """Per-query result of one batched dispatch: a Table or an error.
+    ``oom_retries``/``oom_splits`` count the memory-pressure recoveries
+    this member rode through (batch lane demotions plus its own solo
+    retry ladder) — the scheduler attributes them to the owning tenant."""
 
-    __slots__ = ("table", "error", "replayed_solo")
+    __slots__ = ("table", "error", "replayed_solo", "oom_retries",
+                 "oom_splits")
 
     def __init__(self, table: Optional[Table] = None,
                  error: Optional[BaseException] = None,
@@ -227,6 +232,8 @@ class MemberOutcome:
         self.table = table
         self.error = error
         self.replayed_solo = replayed_solo
+        self.oom_retries = 0
+        self.oom_splits = 0
 
 
 class MicroBatcher:
@@ -245,12 +252,23 @@ class MicroBatcher:
         member's future sees this dispatch's outcome)."""
         ctx = (watchdog.Deadline.adopt(snap) if snap is not None
                else watchdog.ensure_deadline("serving:solo"))
+        # the solo executor runs its own retry ladder internally; the
+        # plan-metrics delta attributes its recoveries to this member
+        # (exact single-lane; a concurrent lane's overlap only shifts
+        # attribution between members, never loses a count)
+        before = plan_metrics.snapshot()
         try:
             with ctx:
                 out = execute_plan(plan, table, cache=self._cache)
-            return MemberOutcome(table=out)
+            mo = MemberOutcome(table=out)
         except BaseException as e:  # noqa: BLE001 — routed to the future
-            return MemberOutcome(error=e)
+            mo = MemberOutcome(error=e)
+        after = plan_metrics.snapshot()
+        mo.oom_retries = max(
+            0, after["plan_oom_retries"] - before["plan_oom_retries"])
+        mo.oom_splits = max(
+            0, after["plan_oom_splits"] - before["plan_oom_splits"])
+        return mo
 
     # -- batched path --------------------------------------------------------
 
@@ -341,6 +359,17 @@ class MicroBatcher:
 
                 cols, mask, head = guarded_dispatch(PLAN_SURFACE, run)
                 head_h = np.asarray(head)   # THE host sync for the batch
+        except (TpuOOM, OffHeapOOM) as oom:
+            # memory pressure, not a member fault: the batch lane itself
+            # is too big for the pool right now. Demote to the next
+            # smaller power-of-two lane (halve the member list, each half
+            # re-enters as its own smaller batched dispatch) — terminal
+            # demotion is k == 1, the solo path with its own full retry
+            # ladder. The breaker stays closed: pressure is recoverable
+            # by design and must not shed the surface.
+            from ..memory import transport
+            transport.rollback_all_stores()   # the declared rollback funnel
+            return self._demote(plans, tables, snaps, oom)
         except BaseException as e:  # noqa: BLE001 — isolate per member
             # the whole dispatch failed (POISON storm, crash, stall...):
             # surface health is the breaker's business, member outcomes
@@ -384,6 +413,26 @@ class MicroBatcher:
             if passthrough:
                 out = Table(out.columns[:-1])   # shed the indicator column
             outcomes.append(MemberOutcome(table=out))
+        return outcomes
+
+    def _demote(self, plans, tables, snaps,
+                oom: BaseException) -> List[MemberOutcome]:
+        """OOM lane demotion: halve the member list and run each half as
+        its own (next smaller power-of-two) batched dispatch; a half that
+        OOMs again demotes further, terminally to the solo path. Every
+        member that rode the demoted lane gets one ``oom_splits`` credit
+        (the tenant attribution input); order is preserved so outcomes
+        zip against tickets unchanged."""
+        serving_metrics.inc("batch_oom_demotions")
+        h = (len(plans) + 1) // 2
+        outcomes: List[MemberOutcome] = []
+        for lo, hi in ((0, h), (h, len(plans))):
+            if lo == hi:
+                continue
+            outcomes.extend(self.execute_group(
+                plans[lo:hi], tables[lo:hi], snaps[lo:hi]))
+        for o in outcomes:
+            o.oom_splits += 1
         return outcomes
 
     def _replay_members(self, plans, tables, snaps,

@@ -192,8 +192,13 @@ class ReplicaServer:
             depth = self.frontend.scheduler.depth()
         except Exception:
             rate, depth = 0.0, 0
+        # pool pressure rides along so the router's rendezvous weights
+        # can de-prefer a replica running hot ((0, 0) when ungoverned)
+        from ..memory.rmm_spark import RmmSpark
+        used, cap = RmmSpark.pool_pressure()
         self._telem = {"drain_rate": rate, "depth": depth,
-                       "pid": os.getpid()}
+                       "pid": os.getpid(),
+                       "pool_used": used, "pool_bytes": cap}
         self._telem_at = now
         return self._telem
 

@@ -58,7 +58,7 @@ from ..columnar.column import Table
 from ..faultinj import watchdog
 from ..parallel.task_executor import TaskExecutor
 from ..plan.compile import ProgramCache, plan_metrics
-from ..plan.nodes import PlanNode
+from ..plan.nodes import PlanNode, fingerprint
 from ..utils import config
 from .admission import AdmissionController, AdmissionRejected
 from .microbatch import MicroBatcher, batch_key_for
@@ -373,7 +373,13 @@ class ServingFrontend:
         snapshot rides the ticket so queue time counts against the
         budget and EDF can order by real expiry."""
         serving_metrics.inc("submitted")
-        estimate = 2 * table.device_nbytes()
+        # resolve the plan BEFORE admission so the fingerprint is known:
+        # the admission estimate is the static 2x envelope trued up by
+        # the fingerprint's observed peak and OOM pressure (sessions.py
+        # book) — repeat offenders price honestly at the front door
+        plan, bkey = batch_key_for(plan, table)
+        estimate = self.registry.estimate_for(
+            fingerprint(plan), 2 * table.device_nbytes())
         ctx = (watchdog.Deadline(budget_s, f"serving:{tenant_id}")
                if budget_s else
                watchdog.ensure_deadline(f"serving:{tenant_id}"))
@@ -386,7 +392,6 @@ class ServingFrontend:
                                  self.scheduler.depth(), draining,
                                  tenant_depths=self.scheduler.depths())
             try:
-                plan, bkey = batch_key_for(plan, table)
                 seq = next(self._seq)
                 if bkey is None:
                     bkey = ("solo", seq)   # unsupported input: never groups
@@ -470,7 +475,7 @@ class ServingFrontend:
         total = sum(t.estimate_bytes for t in group) or 1
         shares = [(t.tenant_id, t.estimate_bytes / total) for t in group]
         before = plan_metrics.snapshot()
-        with self.registry.attributed(shares):
+        with self.registry.attributed(shares) as obs:
             outcomes = self._batcher.execute_group(
                 [t.plan for t in group],
                 [t.table for t in group],
@@ -487,7 +492,28 @@ class ServingFrontend:
             serving_metrics.inc("compile_misses", misses)
         self.warmup.note(group[0].plan, group[0].table, len(group))
         now = time.monotonic()
-        for t, out in zip(group, outcomes):
+        for t, out, share in zip(group, outcomes,
+                                 (s for _, s in shares)):
+            # tenant attribution: pressure recoveries this member rode
+            # (lane demotions + its solo retry ladder) land on its OWN
+            # tenant — an OOMing neighbour costs batch-mates latency,
+            # never counters
+            if out.oom_retries:
+                self.registry.count(t.tenant_id, "oom_retries",
+                                    out.oom_retries)
+                serving_metrics.inc("oom_retries", out.oom_retries)
+            if out.oom_splits:
+                self.registry.count(t.tenant_id, "oom_splits",
+                                    out.oom_splits)
+                serving_metrics.inc("oom_splits", out.oom_splits)
+            # admission true-up: observed reservation peak (the member's
+            # estimate share of the dispatch peak) and whether this
+            # fingerprint demanded pressure recovery feed the book the
+            # NEXT submit prices from
+            self.registry.note_fingerprint(
+                fingerprint(t.plan),
+                observed_bytes=int(obs["peak"] * share),
+                oomed=bool(out.oom_retries or out.oom_splits))
             if out.error is not None:
                 self._finish(t, None, out.error,
                              missed=t.expires_at <= now)

@@ -36,7 +36,8 @@ from ..memory.rmm_spark import RmmSpark
 from ..utils import config
 
 _COUNTERS = ("admitted", "rejected", "completed", "failed",
-             "deadline_missed", "faults_isolated")
+             "deadline_missed", "faults_isolated", "oom_retries",
+             "oom_splits")
 
 
 class ServingMetrics:
@@ -49,7 +50,8 @@ class ServingMetrics:
                "deadline_missed", "expired_in_queue", "shed_expired",
                "cancelled", "dispatches", "batches", "batched_queries",
                "solo_dispatches", "batch_fault_replays", "overflow_replays",
-               "compile_misses", "warmup_compiles")
+               "compile_misses", "warmup_compiles", "batch_oom_demotions",
+               "oom_retries", "oom_splits")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -115,7 +117,13 @@ class SessionRegistry:
         self._tenants: Dict[str, Tenant] = {}
         # RmmSpark tid -> [(tenant_id, weight)] while a dispatch runs
         self._thread_shares: Dict[int, List[Tuple[str, float]]] = {}
+        # RmmSpark tid -> mutable {"cur", "peak"} observation cell bound
+        # for the duration of one dispatch (attributed() hands it out)
+        self._thread_obs: Dict[int, Dict[str, int]] = {}
         self._listener_installed = False
+        # plan fingerprint -> [observed_peak_bytes, pressure_multiplier]:
+        # the admission true-up book (estimate_for / note_fingerprint)
+        self._fp_book: Dict[str, List[float]] = {}
 
     # -- registration --------------------------------------------------------
 
@@ -184,6 +192,50 @@ class SessionRegistry:
             if t is not None:
                 t.compile_misses += misses
                 t.compile_s_charged += seconds
+
+    # -- admission true-up book (per plan fingerprint) -----------------------
+    #
+    # The static 2x-input envelope under-prices plans whose working set is
+    # dominated by intermediates (wide GroupBys, stacked batch lanes) —
+    # exactly the plans that OOM under pressure. The book corrects the
+    # estimate from observed truth: ``estimate_for`` returns
+    # max(base, observed_peak) * pressure, where ``pressure`` doubles on
+    # every OOM the fingerprint causes (repeat offenders price honestly
+    # and stop over-admitting) and decays halfway back toward 1.0 on each
+    # clean run (a one-off storm casualty is re-priced fairly within a
+    # few requests).
+
+    _PRESSURE_CAP = 16.0
+
+    def estimate_for(self, fp: str, base_bytes: int) -> int:
+        """Admission estimate for a plan fingerprint: the static envelope
+        trued up by this fingerprint's observed peak and OOM pressure."""
+        with self._lock:
+            ent = self._fp_book.get(fp)
+            if ent is None:
+                return base_bytes
+            return int(max(base_bytes, ent[0]) * ent[1])
+
+    def note_fingerprint(self, fp: str, observed_bytes: int = 0,
+                         oomed: bool = False) -> None:
+        """Record one dispatch's outcome for ``fp``: fold the observed
+        reservation peak into the book; an OOM doubles the pressure
+        multiplier (capped), a clean run decays it toward 1.0."""
+        with self._lock:
+            ent = self._fp_book.setdefault(fp, [0.0, 1.0])
+            if observed_bytes > ent[0]:
+                ent[0] = float(observed_bytes)
+            if oomed:
+                ent[1] = min(self._PRESSURE_CAP, ent[1] * 2.0)
+            else:
+                ent[1] = 1.0 + (ent[1] - 1.0) * 0.5
+                if ent[1] < 1.001:
+                    ent[1] = 1.0
+
+    def fp_book_snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {fp: {"observed_peak_bytes": ent[0], "pressure": ent[1]}
+                    for fp, ent in self._fp_book.items()}
 
     def try_admit(self, tenant_id: str, estimate_bytes: int) -> Optional[str]:
         """Atomically validate the tenant's limits and, on success, take
@@ -268,6 +320,11 @@ class SessionRegistry:
         """RmmSpark listener (called outside the ledger lock): split the
         thread's reservation delta across the tenants bound to it."""
         with self._lock:
+            obs = self._thread_obs.get(tid)
+            if obs is not None:
+                obs["cur"] = max(0, obs["cur"] + delta)
+                if obs["cur"] > obs["peak"]:
+                    obs["peak"] = obs["cur"]
             shares = self._thread_shares.get(tid)
             if not shares:
                 return
@@ -284,15 +341,22 @@ class SessionRegistry:
     def attributed(self, shares: Sequence[Tuple[str, float]]):
         """Bind the calling thread's RmmSpark reservations to ``shares``
         (tenant_id, weight) for the duration of a dispatch. No-op when no
-        adaptor is installed (the estimate ledger still enforces)."""
+        adaptor is installed (the estimate ledger still enforces).
+
+        Yields an observation cell ``{"cur", "peak"}``: the dispatch's
+        net reservation level and its peak, in bytes — the true-up book's
+        ``observed_bytes`` input (zero when ungoverned)."""
+        obs = {"cur": 0, "peak": 0}
         if not RmmSpark.is_installed():
-            yield
+            yield obs
             return
         tid = RmmSpark.get_current_thread_id()
         with self._lock:
             self._thread_shares[tid] = list(shares)
+            self._thread_obs[tid] = obs
         try:
-            yield
+            yield obs
         finally:
             with self._lock:
                 self._thread_shares.pop(tid, None)
+                self._thread_obs.pop(tid, None)

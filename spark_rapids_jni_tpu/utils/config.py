@@ -101,6 +101,23 @@ _register("rmm.pool_bytes", "SRJT_RMM_POOL_BYTES", 0, int,
 _register("rmm.validate_hbm", "SRJT_RMM_VALIDATE_HBM", False, _parse_bool,
           "audit taken reservations against the PJRT allocator's real "
           "bytes_in_use/peak counters (memory/hbm.py report)")
+_register("rmm.max_split_depth", "SRJT_RMM_MAX_SPLIT_DEPTH", 8, int,
+          "retry-OOM protocol: how many times one input may be halved "
+          "under TpuSplitAndRetryOOM before with_retry declares the "
+          "demand unsatisfiable (memory/retry.py). 8 turns a 4M-row scan "
+          "into 16K-row pieces — below that, splitting is not the "
+          "problem the pool has")
+_register("plan.oom_retry_budget", "SRJT_PLAN_OOM_RETRY_BUDGET", 100, int,
+          "retry-OOM protocol at the fused plan_execute surface: total "
+          "rollback/split attempts per execute_plan call before the OOM "
+          "is terminal (passed to with_retry as max_retries)")
+_register("fleet.pressure_depref_ratio", "SRJT_FLEET_PRESSURE_DEPREF", 0.85,
+          float,
+          "fleet router: a replica whose reported pool pressure "
+          "(pool_used/pool_bytes telemetry) is at or above this ratio "
+          "has its rendezvous weight halved — routing stops piling work "
+          "onto a replica already blocking in the BUFN ladder; 0 "
+          "disables the de-preference")
 _register("parquet.chunk_byte_budget", "SRJT_PARQUET_CHUNK_BYTES", 128 << 20,
           int, "row-group batching budget for the chunked reader")
 _register("parquet.decode_workers", "SRJT_PARQUET_DECODE_WORKERS", 0, int,

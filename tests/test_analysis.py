@@ -1230,6 +1230,96 @@ def test_srjt019_frontend_submit_carries_the_declaration():
 
 
 # ---------------------------------------------------------------------------
+# SRJT020 — retry-OOM handler without the declared rollback funnel
+# ---------------------------------------------------------------------------
+
+SRC_020_NO_FUNNEL = """
+    def run_task(self, item):
+        try:
+            return dispatch(item)
+        except TpuRetryOOM:
+            return dispatch(item)
+"""
+
+SRC_020_FUNNELED = """
+    def run_task(self, item):
+        try:
+            return dispatch(item)
+        except TpuRetryOOM:
+            transport.rollback_all_stores()
+            return dispatch(item)
+"""
+
+SRC_020_PROPAGATES = """
+    def run_task(self, item):
+        try:
+            return dispatch(item)
+        except (TpuSplitAndRetryOOM, CpuSplitAndRetryOOM):
+            raise
+"""
+
+SRC_020_EAGER_SINK = """
+    def run_plan(self, plan, table):
+        try:
+            return run_fused(plan, table)
+        except TpuSplitAndRetryOOM:
+            return run_eager(plan, table, fallback_reason="oom")
+"""
+
+
+def test_srjt020_redispatch_without_funnel_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    fs = run(SRC_020_NO_FUNNEL, path="pkg/parallel/worker.py",
+             rules=[rule_srjt020])
+    assert rules_of(fs) == {"SRJT020"}
+    assert "rollback" in fs[0].message
+
+
+def test_srjt020_funneled_handler_passes():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    assert run(SRC_020_FUNNELED, path="pkg/parallel/worker.py",
+               rules=[rule_srjt020]) == []
+
+
+def test_srjt020_propagating_handler_passes():
+    # no calls in the handler: nothing is re-dispatched, the typed OOM
+    # travels to whoever owns the protocol
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    assert run(SRC_020_PROPAGATES, path="pkg/parallel/worker.py",
+               rules=[rule_srjt020]) == []
+
+
+def test_srjt020_eager_degradation_sink_passes():
+    # run_eager is the ladder's named terminal: the failed fused demand
+    # is abandoned, not repeated
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    assert run(SRC_020_EAGER_SINK, path="pkg/plan/executor.py",
+               rules=[rule_srjt020]) == []
+
+
+def test_srjt020_retry_module_owns_the_protocol():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    assert run(SRC_020_NO_FUNNEL, path="pkg/memory/retry.py",
+               rules=[rule_srjt020]) == []
+
+
+def test_srjt020_non_oom_handler_out_of_scope():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    src = SRC_020_NO_FUNNEL.replace("TpuRetryOOM", "ValueError")
+    assert run(src, path="pkg/parallel/worker.py",
+               rules=[rule_srjt020]) == []
+
+
+def test_srjt020_noqa():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt020
+    src = SRC_020_NO_FUNNEL.replace(
+        "except TpuRetryOOM:",
+        "except TpuRetryOOM:  # srjt: noqa[SRJT020] caller rolls back")
+    assert run(src, path="pkg/parallel/worker.py",
+               rules=[rule_srjt020]) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -1249,7 +1339,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 19
+    assert len(FILE_RULES) == 20
 
 
 def test_syntax_error_is_reported_not_raised():

@@ -432,3 +432,40 @@ def test_journal_replay_unknown_tenant_stays_live(tmp_path):
             assert fl.journal_stats()["live"] == 1
         finally:
             fl.drain()
+
+
+# -- 10. memory-pressure routing de-preference --------------------------------
+
+
+def test_pressured_replica_weight_halved_and_counted():
+    """A replica whose piggybacked telemetry reports pool occupancy
+    at/above fleet.pressure_depref_ratio is about to pay retry/split tax
+    on every dispatch: its rendezvous weight halves so new keys prefer
+    replicas with headroom. Ungoverned replicas (pool_bytes=0) and a
+    ratio of 0 disable the de-preference entirely."""
+    fl = ServingFleet(replicas=2, spawn=False)
+    try:
+        hot, cold = fl._handles
+        hot.telemetry = {"drain_rate": 1.0, "depth": 0,
+                         "pool_used": 95, "pool_bytes": 100}
+        cold.telemetry = {"drain_rate": 1.0, "depth": 0,
+                          "pool_used": 10, "pool_bytes": 100}
+        assert fl._weight(cold, 1.0) == 1.0
+        assert fl._weight(hot, 1.0) == 0.5
+        assert fl.counters["pressure_deprefs"] == 1
+        # ratio 0 disables the rung
+        with config.override("fleet.pressure_depref_ratio", 0.0):
+            assert fl._weight(hot, 1.0) == 1.0
+        # an ungoverned replica reports pool_bytes=0: never de-preferred
+        hot.telemetry = {"drain_rate": 1.0, "depth": 0,
+                         "pool_used": 0, "pool_bytes": 0}
+        assert fl._weight(hot, 1.0) == 1.0
+        assert fl.counters["pressure_deprefs"] == 1
+    finally:
+        fl.drain()
+
+
+def test_pool_pressure_ungoverned_is_zero():
+    from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+    assert not RmmSpark.is_installed()
+    assert RmmSpark.pool_pressure() == (0, 0)

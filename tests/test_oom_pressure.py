@@ -1,0 +1,733 @@
+"""Memory-pressure survival: the degradation ladder end to end.
+
+The ladder (ARCHITECTURE.md §Memory pressure) is
+retry → spill-rollback → split → eager → typed shed, and every rung must
+be BIT-IDENTICAL or typed — never approximate, never untyped:
+
+* ``memory.retry.with_retry`` — the protocol core: rollback + re-attempt
+  on ``TpuRetryOOM``, halve-and-retry on ``TpuSplitAndRetryOOM``, depth
+  bounded by ``rmm.max_split_depth``, retry budget chained to the OOM
+  that spent the last attempt.
+* ``plan/executor.py`` — an injected (or shrink-pool-forced) OOM during a
+  fused dispatch re-runs the SAME compiled program after spill rollback,
+  or row-partitions the scan input and merges piece results exactly
+  (concat for Filter/Project chains, commuting partial-aggregate merge
+  for GroupBy); plans whose pieces can't merge bit-identically take the
+  named eager gate instead.
+* serving — an OOMing batched lane demotes to smaller power-of-two lanes
+  (terminally the solo path), retries/splits are attributed to owning
+  tenants, and admission estimates true up per plan fingerprint.
+* watchdog — a thread blocked inside the protocol's rollback/gate
+  sections is the protocol working, never a stall to escalate.
+
+Fault recipes ride injectionType 6 ("oom") rules: retry/split modes fire
+the mapped exception at the ``plan_execute`` checkpoint (no adaptor
+installed under JAX_PLATFORMS=cpu, so the synthetic route), shrink mode
+stands a pool-byte cap that makes splits mandatory rather than sampled.
+"""
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.columnar.encodings import rle_encode
+from spark_rapids_jni_tpu.faultinj import breaker, install, uninstall, watchdog
+from spark_rapids_jni_tpu.memory import transport
+from spark_rapids_jni_tpu.memory.exceptions import (CpuRetryOOM,
+                                                    CpuSplitAndRetryOOM,
+                                                    TpuOOM, TpuRetryOOM,
+                                                    TpuSplitAndRetryOOM)
+from spark_rapids_jni_tpu.memory.retry import with_retry
+from spark_rapids_jni_tpu.memory.rmm_spark import RmmSpark
+from spark_rapids_jni_tpu.parallel.task_executor import TaskExecutor
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Project, Scan, Sort,
+                                       col, execute_plan, fingerprint, lit,
+                                       plan_metrics, run_eager)
+from spark_rapids_jni_tpu.plan import expr as pex
+from spark_rapids_jni_tpu.plan.compile import ProgramCache
+from spark_rapids_jni_tpu.serving import (MicroBatcher, ServingFrontend,
+                                          SessionRegistry, batch_key_for,
+                                          serving_metrics)
+from spark_rapids_jni_tpu.utils import config
+
+N = 4096  # even: equal halves share one shape bucket in the ProgramCache
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    RmmSpark.reset_fault_domain_metrics()
+    serving_metrics.reset()
+    breaker.reset_all()
+    yield
+    uninstall()
+    breaker.reset_all()
+    watchdog.reset()
+    RmmSpark.reset_fault_domain_metrics()
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff():
+    with config.override("faultinj.backoff_base_s", 0.0002), \
+            config.override("faultinj.backoff_max_s", 0.002), \
+            config.override("watchdog.poll_period_s", 0.02):
+        yield
+
+
+# -- fixtures -----------------------------------------------------------------
+
+
+def _table(n=N, seed=7, nulls=True):
+    rng = np.random.default_rng(seed)
+
+    def c(arr, d, null_p=0.0):
+        v = None
+        if nulls and null_p > 0:
+            v = jnp.asarray(rng.random(n) >= null_p)
+        return Column(d, n, data=jnp.asarray(arr), validity=v)
+
+    return Table((
+        c(rng.integers(0, 7, n).astype(np.int32), dt.INT32, 0.1),
+        c(rng.integers(0, 3, n).astype(np.int8), dt.INT8),
+        c(rng.integers(1, 1000, n), dt.INT64, 0.2),
+        c(rng.integers(0, 11, n).astype(np.int32), dt.INT32),
+        c(rng.integers(0, 2500, n).astype(np.int32), dt.INT32),
+    ))
+
+
+def assert_cols_bit_identical(ca: Column, cb: Column, what=""):
+    assert np.array_equal(np.asarray(ca.data), np.asarray(cb.data)), what
+    va = (None if ca.validity is None else np.asarray(ca.validity))
+    vb = (None if cb.validity is None else np.asarray(cb.validity))
+    if va is None or vb is None:
+        only = va if va is not None else vb
+        assert only is None or bool(only.all()), what
+    else:
+        assert np.array_equal(va, vb), what
+    assert len(ca.children) == len(cb.children), what
+    for i, (ka, kb) in enumerate(zip(ca.children, cb.children)):
+        assert_cols_bit_identical(ka, kb, f"{what} child {i}")
+
+
+def assert_tables_bit_identical(a: Table, b: Table):
+    assert a.num_rows == b.num_rows
+    assert a.num_columns == b.num_columns
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        assert_cols_bit_identical(ca, cb, f"col {i}")
+
+
+P_FILTER = Filter(Scan(5), col(4) < lit(1800))
+P_GB = GroupBy(Filter(Scan(5), col(4) < lit(1800)), (0,),
+               ((2, "sum"), (2, "mean"), (2, "count")))
+P_GB_SORT = Sort(GroupBy(Filter(Scan(5), col(4) < lit(1800)), (0,),
+                         ((2, "sum"), (2, "mean"), (2, "count"))), (0,))
+P_SORT_PRE = Sort(Filter(Scan(5), col(4) < lit(1800)), (0,))
+
+
+def write_cfg(tmp_path, cfg):
+    p = tmp_path / "oom_faults.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+def oom_rule(mode, count=1, api="plan_execute", **extra):
+    rule = {"percent": 100, "injectionType": 6,
+            "interceptionCount": count, "oomMode": mode}
+    rule.update(extra)
+    return {"xlaRuntimeFaults": {api: rule}}
+
+
+def fdm():
+    return RmmSpark.get_fault_domain_metrics()
+
+
+# ---------------------------------------------------------------------------
+# with_retry: the protocol core (ungoverned — no adaptor installed)
+# ---------------------------------------------------------------------------
+
+
+def test_with_retry_passthrough():
+    assert not RmmSpark.is_installed()   # the ungoverned route under test
+    assert with_retry(lambda a: a * 2, 21) == [42]
+
+
+def test_retry_rolls_back_then_succeeds():
+    calls = {"attempts": 0, "rollbacks": 0}
+
+    def attempt(a):
+        calls["attempts"] += 1
+        if calls["attempts"] <= 2:
+            raise TpuRetryOOM("injected")
+        return a
+
+    out = with_retry(attempt, "ok",
+                     rollback=lambda: calls.__setitem__(
+                         "rollbacks", calls["rollbacks"] + 1))
+    assert out == ["ok"]
+    assert calls["attempts"] == 3 and calls["rollbacks"] == 2
+
+
+def test_split_preserves_input_order():
+    def attempt(piece):
+        if len(piece) > 2:
+            raise TpuSplitAndRetryOOM("too big")
+        return list(piece)
+
+    def split(piece):
+        h = len(piece) // 2
+        return [piece[:h], piece[h:]]
+
+    out = with_retry(attempt, list(range(8)), split=split)
+    assert [x for piece in out for x in piece] == list(range(8))
+
+
+def test_split_depth_bounded_by_config():
+    def attempt(piece):
+        raise TpuSplitAndRetryOOM("never fits")
+
+    def split(piece):
+        h = max(1, len(piece) // 2)
+        return [piece[:h], piece[h:]]
+
+    with config.override("rmm.max_split_depth", 2):
+        with pytest.raises(TpuSplitAndRetryOOM) as ei:
+            with_retry(attempt, list(range(64)), split=split,
+                       max_retries=50)
+    assert "rmm.max_split_depth" in str(ei.value)
+    assert isinstance(ei.value.__cause__, TpuSplitAndRetryOOM)
+
+
+def test_split_depth_param_beats_config():
+    def attempt(piece):
+        raise TpuSplitAndRetryOOM("never fits")
+
+    with pytest.raises(TpuSplitAndRetryOOM) as ei:
+        with_retry(attempt, [1, 2, 3, 4],
+                   split=lambda p: [p[:2], p[2:]], max_split_depth=0)
+    # depth 0 bound: the FIRST split demand is already terminal
+    assert "rmm.max_split_depth" in str(ei.value) or "depth" in str(ei.value)
+
+
+def test_split_producing_one_piece_is_terminal():
+    def attempt(piece):
+        raise TpuSplitAndRetryOOM("never fits")
+
+    with pytest.raises(TpuSplitAndRetryOOM) as ei:
+        with_retry(attempt, [1], split=lambda p: [p])
+    assert "1 piece" in str(ei.value)
+    assert isinstance(ei.value.__cause__, TpuSplitAndRetryOOM)
+
+
+def test_no_split_callback_propagates_the_demanding_oom():
+    boom = TpuSplitAndRetryOOM("the demand")
+
+    def attempt(a):
+        raise boom
+
+    with pytest.raises(TpuSplitAndRetryOOM) as ei:
+        with_retry(attempt, 1)
+    assert ei.value is boom   # re-raised typed, not wrapped or renewed
+
+
+def test_retry_budget_exhaustion_is_chained():
+    def attempt(a):
+        raise TpuRetryOOM("storm")
+
+    with pytest.raises(TpuRetryOOM) as ei:
+        with_retry(attempt, 1, max_retries=3)
+    assert "gave up after 3 retries" in str(ei.value)
+    assert isinstance(ei.value.__cause__, TpuRetryOOM)
+
+
+def test_cpu_oom_variants_ride_the_same_ladder():
+    state = {"n": 0}
+
+    def attempt(piece):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise CpuRetryOOM("host pool")
+        if state["n"] == 2:
+            raise CpuSplitAndRetryOOM("host pool")
+        return sum(piece)
+
+    out = with_retry(attempt, [1, 2, 3, 4],
+                     split=lambda p: [p[:2], p[2:]],
+                     rollback=lambda: None)
+    assert out == [3, 7]
+
+
+def test_rollback_marks_thread_in_oom_wait():
+    seen = {}
+
+    def attempt(a):
+        if "in_wait" not in seen:
+            raise TpuRetryOOM("once")
+        return a
+
+    def rollback():
+        seen["in_wait"] = watchdog.in_oom_wait()
+
+    assert with_retry(attempt, 5, rollback=rollback) == [5]
+    assert seen["in_wait"] is True
+
+
+# ---------------------------------------------------------------------------
+# fused execution under injected OOMs: retry, split, merge — bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_injected_retry_oom_redispatches_bit_identical(tmp_path):
+    t = _table()
+    want = execute_plan(P_GB, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("retry", count=2)), seed=0)
+    out = execute_plan(P_GB, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    assert_tables_bit_identical(out, want)
+    assert_tables_bit_identical(out, run_eager(P_GB, t))
+    assert after["plan_oom_retries"] - before["plan_oom_retries"] == 2
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 0
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 0
+    assert fdm()["injected_ooms"] == 2
+
+
+def test_injected_split_concat_merge_bit_identical(tmp_path):
+    t = _table()
+    want = execute_plan(P_FILTER, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    out = execute_plan(P_FILTER, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    assert_tables_bit_identical(out, want)
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 1
+    assert after["plan_oom_pieces"] - before["plan_oom_pieces"] == 2
+    # the split run stayed FUSED: pieces + exact merge, no eager fallback
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 0
+
+
+def test_injected_split_groupby_partial_merge_bit_identical(tmp_path):
+    t = _table()
+    want = execute_plan(P_GB_SORT, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    out = execute_plan(P_GB_SORT, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    # sum/mean/count partial states merged across pieces, Sort applied
+    # post-merge: bit-identical to the unsplit fused run AND the oracle
+    assert_tables_bit_identical(out, want)
+    assert_tables_bit_identical(out, run_eager(P_GB_SORT, t))
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 1
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 0
+
+
+def test_split_pieces_reuse_the_compiled_program(tmp_path):
+    """The acceptance criterion: a split re-run rides the already-
+    compiled piece program — the SECOND equal-size piece is a pure
+    ProgramCache hit (equal halves of an even input share one shape
+    bucket), so a storm costs one piece-plan compile, not one per piece."""
+    t = _table()
+    cache = ProgramCache()
+    want = execute_plan(P_GB, t, cache=cache)   # whole program compiled
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    before = plan_metrics.snapshot()
+    out = execute_plan(P_GB, t, cache=cache)
+    after = plan_metrics.snapshot()
+    uninstall()
+    assert_tables_bit_identical(out, want)
+    # whole program: hit. piece 1: the single new compile. piece 2: hit.
+    assert after["plan_cache_misses"] - before["plan_cache_misses"] == 1
+    assert after["plan_cache_hits"] - before["plan_cache_hits"] == 2
+
+
+def test_unmergeable_sort_prefix_gates_to_eager(tmp_path):
+    t = _table()
+    want = run_eager(P_SORT_PRE, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    out = execute_plan(P_SORT_PRE, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    assert_tables_bit_identical(out, want)
+    # pre-GroupBy Sort pieces would interleave: the named eager gate,
+    # never an approximate merge
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 1
+    reasons = after.get("plan_fallback_reasons", {})
+    base = before.get("plan_fallback_reasons", {})
+    assert reasons.get("oom-split-unmergeable", 0) \
+        - base.get("oom-split-unmergeable", 0) == 1
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 0
+
+
+def test_unmergeable_rle_input_gates_to_eager(tmp_path):
+    rng = np.random.default_rng(9)
+    runs = Column.from_numpy(
+        np.repeat(rng.integers(0, 5, 64), 64).astype(np.int64), dt.INT64)
+    t = Table((rle_encode(runs),
+               Column(dt.INT64, runs.size, data=jnp.asarray(
+                   rng.integers(0, 100, runs.size)))))
+    plan = GroupBy(Scan(2), (0,), ((1, "sum"), (1, "count")))
+    want = run_eager(plan, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    out = execute_plan(plan, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    assert_tables_bit_identical(out, want)
+    # run buffers don't split on row boundaries: eager, named
+    delta = (after.get("plan_fallback_reasons", {})
+             .get("oom-split-unmergeable", 0)
+             - before.get("plan_fallback_reasons", {})
+             .get("oom-split-unmergeable", 0))
+    assert delta == 1
+
+
+def test_unmergeable_float_agg_gates_to_eager(tmp_path):
+    rng = np.random.default_rng(11)
+    n = 2048
+    t = Table((
+        Column(dt.INT32, n, data=jnp.asarray(
+            rng.integers(0, 5, n).astype(np.int32))),
+        Column(dt.FLOAT32, n, data=jnp.asarray(
+            rng.random(n).astype(np.float32))),
+    ))
+    plan = GroupBy(Scan(2), (0,), ((1, "sum"),))
+    want = execute_plan(plan, t)
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    out = execute_plan(plan, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    # float sum across pieces is accumulation-order-sensitive: the gate
+    # keeps the answer exact by refusing the merge, not by approximating
+    assert_tables_bit_identical(out, want)
+    delta = (after.get("plan_fallback_reasons", {})
+             .get("oom-split-unmergeable", 0)
+             - before.get("plan_fallback_reasons", {})
+             .get("oom-split-unmergeable", 0))
+    assert delta == 1
+
+
+def test_shrink_pool_forces_mandatory_split(tmp_path):
+    """oomMode "shrink": a standing pool cap between the half-input and
+    whole-input reservation envelopes makes the split rung MANDATORY
+    (not sampled) — the whole dispatch can never fit, both halves can."""
+    t = _table()
+    want = execute_plan(P_GB, t)
+    cap = int(1.5 * t.device_nbytes())
+    before = plan_metrics.snapshot()
+    install(write_cfg(tmp_path, oom_rule("shrink", poolBytes=cap)), seed=0)
+    out = execute_plan(P_GB, t)
+    uninstall()
+    after = plan_metrics.snapshot()
+    assert_tables_bit_identical(out, want)
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 1
+    assert after["plan_oom_pieces"] - before["plan_oom_pieces"] == 2
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 0
+
+
+def test_shrink_pool_exhausted_depth_sheds_typed(tmp_path):
+    """A demand no split can satisfy surfaces as a TYPED OOM once the
+    depth bound is spent — the ladder's last rung, never an untyped
+    crash and never a wrong answer."""
+    t = _table()
+    install(write_cfg(tmp_path, oom_rule("shrink", poolBytes=1)), seed=0)
+    with config.override("rmm.max_split_depth", 1):
+        with pytest.raises(TpuSplitAndRetryOOM):
+            execute_plan(P_FILTER, t)
+    uninstall()
+
+
+def test_eager_path_unaffected_by_pool_cap(tmp_path):
+    """The injected cap stands at the fused plan_execute surface only:
+    an unmergeable plan under a 100% shrink storm still completes via
+    the eager gate — degraded, bit-identical, never failed."""
+    t = _table()
+    want = run_eager(P_SORT_PRE, t)
+    install(write_cfg(tmp_path, oom_rule("shrink", poolBytes=1)), seed=0)
+    out = execute_plan(P_SORT_PRE, t)
+    uninstall()
+    assert_tables_bit_identical(out, want)
+
+
+# ---------------------------------------------------------------------------
+# chaos: OOM x hang x crash through one TaskExecutor
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_oom_hang_crash_chaos_storm_drains_clean(tmp_path):
+    """Three fault classes at once through one executor: injected OOMs
+    at the fused-plan surface (absorbed by the in-executor retry
+    ladder), a watchdog-cancelled hang at parse_uri (task replay), and
+    a real sandbox worker death at parquet decode (respawn + replay).
+    Everything lands bit-identical and the drain verdict is clean."""
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    from spark_rapids_jni_tpu.faultinj import sandbox
+    from spark_rapids_jni_tpu.ops.parse_uri import parse_uri_to_host
+    from spark_rapids_jni_tpu.parquet import read_parquet
+
+    rng = np.random.default_rng(13)
+    path = str(tmp_path / "chaos.parquet")
+    pq.write_table(pa.table({"v": pa.array(
+        rng.integers(-10**9, 10**9, 4000), pa.int64())}), path,
+        write_page_checksum=True, compression="snappy")
+    want_pq = pq.read_table(path).column("v").to_pylist()
+    urls = Column.from_pylist(
+        [f"https://host{i}.example.com:80{i % 10}/p/{i}?q={i}"
+         for i in range(64)], dt.STRING)
+    want_hosts = parse_uri_to_host(urls).to_pylist()
+    t = _table()
+    want_plan = execute_plan(P_GB, t)
+
+    cfg = {"xlaRuntimeFaults": {
+        "plan_execute": {"percent": 100, "injectionType": 6,
+                         "interceptionCount": 2, "oomMode": "split"},
+        "parse_uri": {"percent": 100, "injectionType": 4,
+                      "interceptionCount": 1, "delayMs": -1},
+        "parquet_page_decode": {"percent": 100, "injectionType": 5,
+                                "interceptionCount": 1,
+                                "crashMode": "abort"},
+    }}
+    sandbox.reset_quarantine()
+    install(write_cfg(tmp_path, cfg), seed=0)
+    try:
+        before = plan_metrics.snapshot()
+        with config.override("sandbox.enabled", True), \
+                config.override("task.budget_s", 0.5), \
+                config.override("task.retry_budget", 8), \
+                config.override("task.degrade_after", 0), \
+                TaskExecutor() as tex:
+            f_plan = tex.submit(1, execute_plan, P_GB, t)
+            f_uri = tex.submit(2, parse_uri_to_host, urls)
+            f_pq = tex.submit(3, read_parquet, path)
+            assert_tables_bit_identical(f_plan.result(timeout=120),
+                                        want_plan)
+            assert f_uri.result(timeout=120).to_pylist() == want_hosts
+            assert f_pq.result(timeout=120)[0].to_pylist() == want_pq
+            verdict = tex.drain()
+        after = plan_metrics.snapshot()
+        m = fdm()
+        assert verdict["clean"]
+        assert verdict["stragglers"] == []
+        assert m["injected_ooms"] == 2
+        assert m["injected_crashes"] == 1
+        # the OOMs were absorbed INSIDE the fused executor's ladder — the
+        # task never saw them, only the hang and the crash replayed
+        assert after["plan_oom_splits"] - before["plan_oom_splits"] >= 1
+    finally:
+        sandbox.shutdown_all()
+        sandbox.reset_quarantine()
+
+
+def test_watchdog_never_stalls_a_thread_in_oom_rollback(tmp_path,
+                                                        monkeypatch):
+    """A rollback far slower than the task budget, sampled from INSIDE
+    the protocol's blocking section: the stall sweep must have skipped
+    this thread on every poll (oom_wait marking), even though its
+    deadline is already expired while it blocks."""
+    t = _table()
+    want = execute_plan(P_FILTER, t)
+    observed = {}
+    real = transport.rollback_all_stores
+
+    def slow_rollback():
+        time.sleep(0.5)   # ~25 watchdog polls past the 0.2s budget
+        observed["in_wait"] = watchdog.in_oom_wait()
+        observed["stalls_mid_wait"] = fdm()["stall_detected"]
+        return real()
+
+    monkeypatch.setattr(transport, "rollback_all_stores", slow_rollback)
+    install(write_cfg(tmp_path, oom_rule("retry", count=1)), seed=0)
+    with config.override("task.budget_s", 0.2), \
+            config.override("task.retry_budget", 8), \
+            TaskExecutor() as tex:
+        out = tex.submit(1, execute_plan, P_FILTER, t).result(timeout=60)
+    uninstall()
+    assert_tables_bit_identical(out, want)
+    assert observed["in_wait"] is True
+    assert observed["stalls_mid_wait"] == 0
+    assert fdm()["workers_lost"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serving: lane demotion, tenant attribution, admission true-up
+# ---------------------------------------------------------------------------
+
+
+def make_stable(n, seed):
+    rng = np.random.default_rng(seed)
+    a = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 7, n, dtype=np.int64)))
+    b = Column(dt.INT64, n, data=jnp.asarray(
+        rng.integers(0, 1000, n, dtype=np.int64)))
+    return Table((a, b))
+
+
+S_PLAN = GroupBy(Filter(Scan(2), pex.BinOp("lt", pex.Col(0), pex.Lit(5))),
+                 (0,), ((1, "sum"), (1, "count")))
+
+
+def _group(plan, tables):
+    plans, keys = [], []
+    for t in tables:
+        p, k = batch_key_for(plan, t)
+        plans.append(p)
+        keys.append(k)
+    assert all(k == keys[0] and k is not None for k in keys), keys
+    return plans
+
+
+def test_batch_oom_demotes_to_smaller_lanes_bit_identical(tmp_path):
+    tables = [make_stable(800, 30 + s) for s in range(4)]
+    plans = _group(S_PLAN, tables)
+    want = [execute_plan(p, t) for p, t in zip(plans, tables)]
+    install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+    outs = MicroBatcher().execute_group(plans, tables, [None] * 4)
+    uninstall()
+    for o, w in zip(outs, want):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, w)
+        assert o.oom_splits == 1   # one demoted lane ridden by everyone
+    m = serving_metrics.snapshot()
+    assert m["batch_oom_demotions"] == 1
+    # pressure is NOT a member fault: no solo fault replays, and the
+    # halves re-entered as smaller BATCHED lanes (demotion, not scatter)
+    assert m["batch_fault_replays"] == 0
+    assert fdm()["batch_solo_replays"] == 0
+    assert m["batches"] == 2
+
+
+def test_terminal_demotion_reaches_solo_retry_ladder(tmp_path):
+    tables = [make_stable(700, 60), make_stable(700, 61)]
+    plans = _group(S_PLAN, tables)
+    want = [execute_plan(p, t) for p, t in zip(plans, tables)]
+    # OOM #1 fails the k=2 lane (demote to solo); OOM #2 lands inside
+    # the first solo's own executor ladder (rollback + re-dispatch)
+    install(write_cfg(tmp_path, oom_rule("retry", count=2)), seed=0)
+    outs = MicroBatcher().execute_group(plans, tables, [None, None])
+    uninstall()
+    for o, w in zip(outs, want):
+        assert o.error is None
+        assert_tables_bit_identical(o.table, w)
+    assert [o.oom_splits for o in outs] == [1, 1]
+    assert outs[0].oom_retries == 1       # the solo-ladder recovery,
+    assert outs[1].oom_retries == 0       # attributed to its member only
+    m = serving_metrics.snapshot()
+    assert m["batch_oom_demotions"] == 1
+    assert m["solo_dispatches"] == 2
+
+
+def test_admission_estimate_true_up_book():
+    reg = SessionRegistry()
+    fp = "plan-fp-1"
+    assert reg.estimate_for(fp, 1000) == 1000        # unknown: base
+    reg.note_fingerprint(fp, observed_bytes=5000)
+    assert reg.estimate_for(fp, 1000) == 5000        # observed peak wins
+    assert reg.estimate_for(fp, 9000) == 9000        # larger base wins
+    reg.note_fingerprint(fp, oomed=True)
+    assert reg.estimate_for(fp, 1000) == 10000       # pressure doubles
+    reg.note_fingerprint(fp, oomed=True)
+    assert reg.estimate_for(fp, 1000) == 20000
+    reg.note_fingerprint(fp)                         # clean run: decay
+    assert reg.estimate_for(fp, 1000) == 12500       # 4.0 -> 2.5
+    for _ in range(20):
+        reg.note_fingerprint(fp)
+    assert reg.estimate_for(fp, 1000) == 5000        # snapped back to 1.0
+    for _ in range(10):
+        reg.note_fingerprint(fp, oomed=True)
+    assert reg.estimate_for(fp, 1000) == 5000 * 16   # pressure capped
+    snap = reg.fp_book_snapshot()
+    assert snap[fp]["observed_peak_bytes"] == 5000.0
+    assert snap[fp]["pressure"] == 16.0
+
+
+def test_frontend_storm_attributes_oom_to_tenants(tmp_path):
+    tables = [make_stable(800, 40 + s) for s in range(6)]
+    baselines = [execute_plan(batch_key_for(S_PLAN, t)[0], t)
+                 for t in tables]
+    with config.override("serving.batch_window_ms", 250.0), \
+            ServingFrontend() as fe:
+        fe.register_tenant("alpha", priority=1)
+        fe.register_tenant("beta", priority=3)
+        install(write_cfg(tmp_path, oom_rule("split", count=1)), seed=0)
+        futs = [fe.submit("alpha" if i % 2 else "beta", S_PLAN, t,
+                          budget_s=60.0)
+                for i, t in enumerate(tables)]
+        for f, want in zip(futs, baselines):
+            assert_tables_bit_identical(f.result(timeout=120), want)
+        uninstall()
+        m = serving_metrics.snapshot()
+        recovered = m["oom_splits"] + m["oom_retries"]
+        assert m["completed"] == 6 and m["failed"] == 0
+        assert recovered >= 1   # the storm was absorbed, not shed...
+        by_tenant = sum(
+            fe.registry.stats_of(tid)["oom_splits"]
+            + fe.registry.stats_of(tid)["oom_retries"]
+            for tid in ("alpha", "beta"))
+        assert by_tenant == recovered   # ...and attributed to its owners
+        # the admission book trued up: the OOMing fingerprint now carries
+        # pressure, so its next admission is priced above the base charge
+        book = fe.registry.fp_book_snapshot()
+        assert any(ent["pressure"] > 1.0 for ent in book.values())
+        v = fe.drain()
+    assert v["clean"]
+
+
+# -- 6. the DAG eager gate is exact -----------------------------------------
+
+
+def test_q5_dag_split_oom_gates_to_eager_bit_identical(tmp_path):
+    """A split demand against the q5 join DAG takes the named eager gate
+    (probe rows span the build side — pieces can't merge) and the eager
+    result is bit-identical to the fused program. Regression for the
+    interpreter hashing raw key lanes: supplier's int32 nation key vs
+    nation's int64 key never matched until the eager join boundary
+    widened integral key pairs to int64 like the fused _key_values lane."""
+    from benchmarks import tpch
+
+    tabs = tpch.generate_q5_tables(4096, 11)
+    baseline = tpch.run_q5(*tabs, engine="plan")
+
+    install(write_cfg(tmp_path, oom_rule("split")), seed=0)
+    before = plan_metrics.snapshot()
+    out = tpch.run_q5(*tabs, engine="plan")
+    after = plan_metrics.snapshot()
+    uninstall()
+
+    assert_tables_bit_identical(out, baseline)
+    assert after["plan_fallbacks"] - before["plan_fallbacks"] == 1
+    reasons = after["plan_fallback_reasons"]
+    base = before["plan_fallback_reasons"]
+    assert (reasons.get("oom-split-unmergeable", 0)
+            - base.get("oom-split-unmergeable", 0)) == 1
+    assert after["plan_oom_splits"] - before["plan_oom_splits"] == 0
+
+
+def test_eager_join_widens_mismatched_integral_keys():
+    """inner-join parity when the two sides' key dtypes differ: the
+    interpreter must widen both lanes to int64 before hashing (raw-byte
+    hashing would silently match nothing)."""
+    from spark_rapids_jni_tpu.plan import Join, Scan
+    from spark_rapids_jni_tpu.plan.interpreter import run_eager
+
+    left = Table((
+        Column.from_numpy(np.arange(100, dtype=np.int32), dt.INT32),
+        Column.from_numpy(np.arange(100, dtype=np.int64) * 3, dt.INT64),
+    ))
+    right = Table((
+        Column.from_numpy(np.arange(0, 200, 2, dtype=np.int64), dt.INT64),
+        Column.from_numpy(np.arange(100, dtype=np.int64) + 7, dt.INT64),
+    ))
+    out = run_eager(Join(Scan(2, input_index=0), Scan(2, input_index=1),
+                         (0,), (0,)), [left, right])
+    assert out.num_rows == 50  # every even key matches
+    keys = np.asarray(out.columns[0].data)
+    assert np.array_equal(np.sort(keys), np.arange(0, 100, 2))
