@@ -35,6 +35,10 @@
 #                    load; writes the SOAK_rNN.json fairness artifact)
 #   make soak-mem  - CI-shaped Monte-Carlo memory-pressure soak
 #                    (ci/fuzz-test.sh; the pre-ISSUE-15 `make soak`)
+#   make fuzz      - differential torture lane (~2 min): tier-1 fuzz
+#                    slice + a fixed-seed CLI sweep through every engine
+#                    lane against the eager reference (bit-identity or
+#                    NAMED gate; storms absorbed or typed)
 #   make wheel     - wheel with the prebuilt native libs bundled
 #   make bench     - microbenchmark suite on the default backend
 #   make plan      - whole-plan compilation lane (fused-vs-eager
@@ -68,7 +72,7 @@ CXXFLAGS ?= -std=c++17 -O2 -fPIC -shared -Wall
 VERSION := $(shell $(PY) -c "import re;print(re.search(r'version = \"([^\"]+)\"', open('pyproject.toml').read()).group(1))")
 GIT_SHA := $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem oom fleet restart wheel bench plan join dict encode serve shard clean
+.PHONY: native test lint race flow chaos corrupt hang crash sanitize soak soak-mem oom fleet restart fuzz wheel bench plan join dict encode serve shard clean
 
 native:
 	mkdir -p $(NATIVE_DIR)
@@ -172,6 +176,24 @@ oom:
 
 soak-mem:
 	bash ci/fuzz-test.sh
+
+# differential torture lane (~2 min): the tier-1 fuzz slice (generator
+# determinism, oracle window, committed-corpus replay, both seeded
+# mutations caught + shrunk, a composed storm) then a fixed-seed CLI
+# sweep through the full lane matrix. The outer timeout is part of the
+# contract; the CLI's exit code IS the verdict (zero divergences, zero
+# undeclared fallbacks, typed-or-absorbed storms). Deterministic: same
+# seeds every run — the scale sweep is `--points 2000 --storm-points
+# 300 --mutations --out auto` (FUZZ_rNN.json).
+fuzz:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m pytest tests/test_fuzz.py -q \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	timeout -k 10 600 env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m spark_rapids_jni_tpu.fuzz --points 40 --storm-points 8 \
+	    --out "" > /dev/null
 
 wheel: native
 	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist .

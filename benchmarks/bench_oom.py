@@ -383,6 +383,10 @@ def run_storm(rows: int, seed: int,
         "kind": "srjt-oom-storm",
         "rows": rows,
         "seed": seed,
+        # every faultinj install in this harness seeds the injector's
+        # numpy sample stream from offsets of this base — the artifact
+        # plus this value replays the exact fault sequence
+        "injector_seed_base": seed,
         "pressure_levels": levels,
         "shrink_stage": shrink,
         "serving_storm": serving,

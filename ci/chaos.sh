@@ -205,3 +205,26 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     -p no:cacheprovider -p no:xdist -p no:randomly
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m benchmarks.bench_oom \
     --rows 65536 --serving-queries 8 > /dev/null
+
+# stage 15 — differential torture under composed storms: the fuzz
+# harness (spark_rapids_jni_tpu/fuzz/) generates seed-deterministic
+# (plan, tables) points over the full type/encoding lattice and runs
+# each through EVERY applicable engine lane — fused, sharded d∈{2,4,8},
+# batched, forced-split — against the eager reference; then re-runs
+# survivors under composed injectionType 1-6 storms with the protocol
+# witness installed, and seeds both deliberate engine mutations
+# (fuzz/mutations.py), catches them, and shrinks the repros. Pass
+# criteria are the CLI's exit code: ZERO bit-identity divergences, ZERO
+# lane crashes, ZERO undeclared fallbacks (every fallback-metrics delta
+# names a reason from plan/interpreter.FALLBACK_REASONS), every storm
+# absorbed or TYPED with balanced witness books, both mutations caught
+# and minimized to <=8 rows / <=3 plan nodes (fail mutated, pass on
+# main), and every committed tests/fuzz_corpus/ repro still dead. This
+# stage is the short CI-budget cut writing the next free FUZZ_rNN.json;
+# the committed FUZZ_r01.json is the 2000-point/300-storm scale run
+# (`make fuzz` docs the invocation). The outer timeout is part of the
+# contract: a wedged lane or un-cancelled storm fails the lane loudly.
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m spark_rapids_jni_tpu.fuzz --points 120 --storm-points 25 \
+    --mutations --out auto > /dev/null

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # srjt-lint lane: block-on-new-findings static analysis.
 #
-# Runs the AST rule catalog (SRJT001-018), the srjt-race lock/shared-state
+# Runs the AST rule catalog (SRJT001-021), the srjt-race lock/shared-state
 # engine (SRJTR01-03 — interprocedural lock-order inversions, locks held
 # across blocking operations, unguarded multi-thread writes), the
 # srjt-flow exception-flow/typestate engine (SRJTF01-05 — untyped

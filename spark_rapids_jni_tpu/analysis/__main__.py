@@ -46,7 +46,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_jni_tpu.analysis",
         description="srjt-lint: TPU-invariant static analysis "
-                    "(AST rules SRJT001-018, race rules SRJTR01-03, "
+                    "(AST rules SRJT001-021, race rules SRJTR01-03, "
                     "flow/protocol rules SRJTF01-05, "
                     "jaxpr audit SRJTX01-05)")
     ap.add_argument("paths", nargs="*",

@@ -1592,7 +1592,8 @@ _SRJT020_OOM_SUFFIX = "RetryOOM"
 _SRJT020_OOM_BASES = ("TpuOOM", "OffHeapOOM")
 _SRJT020_FUNNEL = ("rollback_all_stores", "spill_all", "spill_to_fit",
                    "rollback_cb", "rollback", "_rollback", "_rollback_spill",
-                   "with_retry", "block_thread_until_ready", "run_eager")
+                   "with_retry", "block_thread_until_ready", "run_eager",
+                   "_eager_fallback")  # the guarded run_eager forwarder
 
 
 def _srjt020_catches_oom(handler: ast.ExceptHandler) -> bool:
@@ -1640,6 +1641,81 @@ def rule_srjt020(tree, rel, lines, ctx) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# SRJT021 — engine fallback without a reason from the declared catalog
+# ---------------------------------------------------------------------------
+# Every engine-selection site that degrades to the eager interpreter
+# must label itself: ``run_eager(plan, table, fallback_reason=<literal
+# from the catalog>)``. A bare ``run_eager(plan, table)`` is an
+# UNDECLARED fallback — it bumps no metrics, the fuzz oracle's
+# undeclared-fallback check can't see it, and "why did this query go
+# eager?" becomes unanswerable in production. The catalog below is a
+# HARDCODED mirror of ``plan/interpreter.FALLBACK_REASONS`` (this module
+# must stay importable in pure-AST mode — SRJT_LINT_NO_JAXPR=1 — so it
+# cannot import the jax-backed interpreter); tests/test_analysis.py
+# cross-checks the two stay equal. plan/interpreter.py itself is exempt
+# (it OWNS run_eager); oracle/reference calls — tests comparing lanes,
+# the split rung's sanctioned suffix replay — carry
+# ``# srjt: noqa[SRJT021]`` with the reason.
+
+_SRJT021_CATALOG = frozenset({
+    "unsupported-input", "planner-unsupported", "overflow",
+    "oom-split-unmergeable", "oom-split-degenerate",
+})
+
+
+def rule_srjt021(tree, rel, lines, ctx) -> List[Finding]:
+    if rel.endswith("plan/interpreter.py"):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func)
+        # _eager_fallback (plan/executor.py) is the guarded forwarder to
+        # run_eager — its call sites are engine-selection sites too and
+        # carry the reason in the same positional/keyword slot
+        if dn is None or dn.split(".")[-1] not in ("run_eager",
+                                                   "_eager_fallback"):
+            continue
+        reason = None
+        has_reason = False
+        if len(node.args) >= 3:
+            reason, has_reason = node.args[2], True
+        for kw in node.keywords:
+            if kw.arg == "fallback_reason":
+                reason, has_reason = kw.value, True
+        if not has_reason or (isinstance(reason, ast.Constant)
+                              and reason.value is None):
+            findings.append(Finding(
+                "SRJT021", rel, node.lineno,
+                "bare run_eager at an engine-selection site — every "
+                "fallback must attach fallback_reason=<literal from "
+                "plan/interpreter.FALLBACK_REASONS> so metrics, the "
+                "fuzz oracle and production triage can name it; oracle/"
+                "reference calls carry `# srjt: noqa[SRJT021]` with the "
+                "reason"))
+            continue
+        if not (isinstance(reason, ast.Constant)
+                and isinstance(reason.value, str)):
+            findings.append(Finding(
+                "SRJT021", rel, node.lineno,
+                "run_eager fallback_reason must be a STRING LITERAL "
+                "from the declared catalog — a computed reason defeats "
+                "the static catalog check and can smuggle an undeclared "
+                "slug past review"))
+            continue
+        if reason.value not in _SRJT021_CATALOG:
+            findings.append(Finding(
+                "SRJT021", rel, node.lineno,
+                f"run_eager fallback reason {reason.value!r} is not in "
+                f"the declared catalog "
+                f"({', '.join(sorted(_SRJT021_CATALOG))}) — add it to "
+                f"plan/interpreter.FALLBACK_REASONS AND the SRJT021 "
+                f"mirror (tests cross-check them) before using it"))
+    return findings
+
+
 from .locks import project_rule_races  # noqa: E402  (cycle-free: locks
 # imports only core+callgraph, neither imports rules at module load)
 from .protocol import project_rule_flow  # noqa: E402  (same shape:
@@ -1650,7 +1726,7 @@ FILE_RULES = (rule_srjt001, rule_srjt002, rule_srjt003, rule_srjt004,
               rule_srjt008_counters, rule_srjt009, rule_srjt010,
               rule_srjt011, rule_srjt012, rule_srjt013, rule_srjt014,
               rule_srjt015, rule_srjt016, rule_srjt017, rule_srjt018,
-              rule_srjt019, rule_srjt020)
+              rule_srjt019, rule_srjt020, rule_srjt021)
 PROJECT_RULES = (project_rule_srjt008_spans, project_rule_srjt001_interproc,
                  project_rule_srjt007_interproc, project_rule_races,
                  project_rule_flow)

@@ -41,12 +41,42 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import threading
 import time
+import warnings
 from typing import Dict, Optional
 
+import numpy as np
+
 _SECTION_KEYS = ("xlaRuntimeFaults", "cudaRuntimeFaults", "cudaDriverFaults")
+
+
+class SeededRng:
+    """The injector's single replayable sample stream.
+
+    One ``numpy.random.Generator`` drives EVERY rule draw — percent
+    rolls (types 0/1/2/4 via ``maybe_fire``, type 6 via ``sample_oom``
+    after its skipCount/numOoms bookkeeping, type 5 via ``crash_spec``)
+    and the bit-flip buffer/bit picks consumers make through
+    ``bitflip_rng`` — so one integer replays a whole storm. ``.seed``
+    is always a concrete logged value: chaos/fuzz verdict artifacts
+    record it, and replaying with the same config + seed reproduces the
+    exact fault sequence. Exposes only the draw methods rule sampling
+    and the integrity hooks actually use."""
+
+    def __init__(self, seed: Optional[int] = None):
+        if seed is None:
+            # no seed requested: draw fresh entropy, but KEEP it — an
+            # unlogged stream would make a storm verdict unreplayable
+            seed = int(np.random.SeedSequence().entropy) % (1 << 63)
+        self.seed = int(seed)
+        self._g = np.random.default_rng(self.seed)
+
+    def uniform(self, lo: float, hi: float) -> float:
+        return float(self._g.uniform(lo, hi))
+
+    def randrange(self, n: int) -> int:
+        return int(self._g.integers(0, n))
 
 
 class DeviceTrapError(RuntimeError):
@@ -107,7 +137,7 @@ class _Rule:
                 f"fault config rule {name!r}: unknown oomMode "
                 f"{self.oom_mode!r} (known: retry, split, shrink)")
 
-    def maybe_fire(self, api: str, rng: random.Random) -> Optional[float]:
+    def maybe_fire(self, api: str, rng: SeededRng) -> Optional[float]:
         """Sample one matched call. Types 0-2 raise; type 4 returns the
         delay in seconds for the caller to execute OUTSIDE the injector
         lock (a hang held under the lock would wedge every other thread's
@@ -130,7 +160,7 @@ class _Rule:
             return -1.0 if self.delay_ms < 0 else self.delay_ms / 1000.0
         raise InjectedApiError(self.substitute, api)
 
-    def sample_oom(self, rng: random.Random) -> Optional[dict]:
+    def sample_oom(self, rng: SeededRng) -> Optional[dict]:
         """injectionType 6 sampling (retry/split modes) for one matched
         call: honor skipCount, then interceptionCount + percent like
         every other type. Returns the OOM directive for ``check`` to
@@ -152,15 +182,21 @@ class FaultInjector:
     def __init__(self, config_path: Optional[str] = None, seed: int = None):
         from ..utils import config as _config
         self._path = config_path or _config.get("faultinj.config") or None
-        self._rng = random.Random(seed)
+        self._rng = SeededRng(seed)
         self._lock = threading.Lock()
         self._rules: Dict[str, _Rule] = {}
         self._dynamic = False
         self._mtime = 0.0
         self._last_check = 0.0
         self._patched = []
+        self._warned_conflicts = False
         if self._path:
             self._load()
+
+    @property
+    def seed(self) -> int:
+        """The sample stream's seed — verdict artifacts log this."""
+        return self._rng.seed
 
     # -- config ---------------------------------------------------------
 
@@ -171,9 +207,29 @@ class FaultInjector:
         except (OSError, json.JSONDecodeError):
             return
         rules: Dict[str, _Rule] = {}
+        conflicts = []
         for section in _SECTION_KEYS:
             for name, rule_cfg in (cfg.get(section) or {}).items():
+                if name in rules:
+                    # overlapping rules (same surface declared in two
+                    # sections): DECLARATION ORDER WINS — the first
+                    # section (xlaRuntimeFaults > cudaRuntimeFaults >
+                    # cudaDriverFaults) keeps the surface; a silent
+                    # last-wins overwrite made storm composition depend
+                    # on section spelling
+                    conflicts.append(f"{name!r} (kept the "
+                                     f"earlier-declared rule)")
+                    continue
                 rules[name] = _Rule(name, rule_cfg)
+        warn = False
+        if conflicts:
+            with self._lock:
+                warn = not self._warned_conflicts
+                self._warned_conflicts = True
+        if warn:                     # once per injector, outside the lock
+            warnings.warn(
+                "fault config declares overlapping rules across sections: "
+                + ", ".join(conflicts), RuntimeWarning, stacklevel=2)
         with self._lock:
             self._rules = rules
             self._dynamic = bool(cfg.get("dynamic", False))
@@ -218,7 +274,7 @@ class FaultInjector:
             from . import watchdog
             watchdog.injected_delay(api, delay_s)
 
-    def bitflip_rng(self, api: str) -> Optional[random.Random]:
+    def bitflip_rng(self, api: str) -> Optional[SeededRng]:
         """injectionType 3 sampling for one payload-bearing call: when a
         bit-flip rule targets ``api`` (or ``*``) and its budget + percent
         roll fire, return the injector's RNG for the caller to pick the

@@ -1,0 +1,31 @@
+"""Differential torture harness (ISSUE 20).
+
+A deterministic, seed-replayable fuzz subsystem for the engine's
+load-bearing invariant: every execution lane — eager, fused solo,
+sharded, batched, force-split — is *bit-identical*, and every lane that
+declines a query declines with a NAMED gate reason.
+
+    gen.py       random tables over the full type/encoding lattice and
+                 random plans over the IR, both derived from one integer
+                 seed (``SEED: fuzz-v1 point=<n>`` replays the point)
+    oracle.py    the lane table: run one (plan, tables) point through
+                 every applicable lane, assert byte-exact equality of
+                 values+validity+dictionaries, and assert every
+                 inapplicable lane names its gate
+    storms.py    composed injectionType 1-6 fault storms over surviving
+                 points: same results, zero untyped failures, balanced
+                 protocol-witness books at drain
+    shrink.py    greedy minimization (rows -> columns -> plan nodes ->
+                 storm rules) of a failing case
+    corpus.py    serialized minimized cases under tests/fuzz_corpus/,
+                 replayed forever by tier-1
+    mutations.py deliberately seeded engine bugs the shrink demo runs
+                 against (the harness must catch, shrink, and repro them)
+
+CLI: ``python -m spark_rapids_jni_tpu.fuzz --points N --storm-points M``
+writes the FUZZ_rNN.json verdict artifact (see ci/chaos.sh stage 15 and
+``make fuzz``).
+"""
+
+from .gen import gen_point, point_seed_line  # noqa: F401
+from .oracle import check_point, run_reference  # noqa: F401

@@ -1,0 +1,215 @@
+"""Case (de)serialization + the minimized-repro corpus.
+
+A **case dict** is the JSON form of one fuzz point: column recipes
+(fuzz/gen.py spec format), a plan tree, and optionally a storm config.
+The corpus directory (``tests/fuzz_corpus/``) holds minimized failing
+cases the shrinker produced; tier-1 (tests/test_fuzz.py) replays every
+one through the full oracle lane matrix forever, so a bug class that
+once escaped stays covered after its fix.
+
+Corpus entry extra fields:
+    ``note``       what the case minimized from (mutation name / storm)
+    ``seed_line``  the one-line ``SEED:`` replay token
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..plan import expr as ex
+from ..plan.nodes import (Filter, GroupBy, Join, Limit, PlanNode, Project,
+                          Scan, Sort)
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "..", "..",
+                          "tests", "fuzz_corpus")
+
+
+# ---------------------------------------------------------------------------
+# expression <-> dict
+# ---------------------------------------------------------------------------
+
+def expr_to_dict(e: ex.Expr) -> dict:
+    if isinstance(e, ex.Col):
+        return {"e": "col", "i": e.index}
+    if isinstance(e, ex.Lit):
+        kind = ("bool" if isinstance(e.value, bool)
+                else "str" if isinstance(e.value, str) else "int")
+        return {"e": "lit", "k": kind, "v": e.value}
+    if isinstance(e, ex.Cast64):
+        return {"e": "cast64", "o": expr_to_dict(e.operand)}
+    if isinstance(e, ex.Not):
+        return {"e": "not", "o": expr_to_dict(e.operand)}
+    if isinstance(e, ex.BinOp):
+        return {"e": "bin", "op": e.op, "l": expr_to_dict(e.left),
+                "r": expr_to_dict(e.right)}
+    raise TypeError(f"not a plan expression: {e!r}")
+
+
+def expr_from_dict(d: dict) -> ex.Expr:
+    k = d["e"]
+    if k == "col":
+        return ex.Col(int(d["i"]))
+    if k == "lit":
+        v = d["v"]
+        if d["k"] == "bool":
+            v = bool(v)
+        elif d["k"] == "int":
+            v = int(v)
+        return ex.Lit(v)
+    if k == "cast64":
+        return ex.Cast64(expr_from_dict(d["o"]))
+    if k == "not":
+        return ex.Not(expr_from_dict(d["o"]))
+    if k == "bin":
+        return ex.BinOp(d["op"], expr_from_dict(d["l"]),
+                        expr_from_dict(d["r"]))
+    raise ValueError(f"unknown expression tag {k!r}")
+
+
+# ---------------------------------------------------------------------------
+# plan <-> dict
+# ---------------------------------------------------------------------------
+
+def plan_to_dict(plan: PlanNode) -> dict:
+    if isinstance(plan, Scan):
+        return {"node": "scan", "ncols": plan.ncols,
+                "input": plan.input_index}
+    if isinstance(plan, Filter):
+        return {"node": "filter", "pred": expr_to_dict(plan.predicate),
+                "child": plan_to_dict(plan.child)}
+    if isinstance(plan, Project):
+        return {"node": "project",
+                "exprs": [expr_to_dict(e) for e in plan.exprs],
+                "child": plan_to_dict(plan.child)}
+    if isinstance(plan, GroupBy):
+        return {"node": "groupby", "keys": list(plan.keys),
+                "aggs": [[i, op] for i, op in plan.aggs],
+                "child": plan_to_dict(plan.child)}
+    if isinstance(plan, Sort):
+        return {"node": "sort", "keys": list(plan.keys),
+                "asc": None if plan.ascending is None
+                else list(plan.ascending),
+                "nf": None if plan.nulls_first is None
+                else list(plan.nulls_first),
+                "child": plan_to_dict(plan.child)}
+    if isinstance(plan, Limit):
+        return {"node": "limit", "count": plan.count,
+                "child": plan_to_dict(plan.child)}
+    if isinstance(plan, Join):
+        return {"node": "join", "how": plan.how,
+                "lon": list(plan.left_on), "ron": list(plan.right_on),
+                "left": plan_to_dict(plan.left),
+                "right": plan_to_dict(plan.right)}
+    raise TypeError(f"unknown plan node {type(plan).__name__}")
+
+
+def plan_from_dict(d: dict) -> PlanNode:
+    k = d["node"]
+    if k == "scan":
+        return Scan(int(d["ncols"]), input_index=int(d.get("input", 0)))
+    if k == "filter":
+        return Filter(plan_from_dict(d["child"]),
+                      expr_from_dict(d["pred"]))
+    if k == "project":
+        return Project(plan_from_dict(d["child"]),
+                       tuple(expr_from_dict(e) for e in d["exprs"]))
+    if k == "groupby":
+        return GroupBy(plan_from_dict(d["child"]), tuple(d["keys"]),
+                       tuple((int(i), str(op)) for i, op in d["aggs"]))
+    if k == "sort":
+        return Sort(plan_from_dict(d["child"]), tuple(d["keys"]),
+                    None if d.get("asc") is None else tuple(d["asc"]),
+                    None if d.get("nf") is None else tuple(d["nf"]))
+    if k == "limit":
+        return Limit(plan_from_dict(d["child"]), int(d["count"]))
+    if k == "join":
+        return Join(plan_from_dict(d["left"]), plan_from_dict(d["right"]),
+                    tuple(d["lon"]), tuple(d["ron"]), str(d["how"]))
+    raise ValueError(f"unknown plan node tag {k!r}")
+
+
+# ---------------------------------------------------------------------------
+# corpus persistence
+# ---------------------------------------------------------------------------
+
+def corpus_dir() -> str:
+    return os.path.normpath(CORPUS_DIR)
+
+
+def list_cases(directory: Optional[str] = None) -> List[str]:
+    d = directory or corpus_dir()
+    if not os.path.isdir(d):
+        return []
+    return sorted(os.path.join(d, f) for f in os.listdir(d)
+                  if f.endswith(".json"))
+
+
+def load_case(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_case(case: dict, name: str,
+              directory: Optional[str] = None) -> str:
+    d = directory or corpus_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(case, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def case_point(case: dict):
+    """(plan, tables) rebuilt from a case dict."""
+    from .gen import build_tables
+    return plan_from_dict(case["plan"]), build_tables(case["tables"])
+
+
+_REPRO_TEMPLATE = '''"""Standalone repro for the minimized fuzz case ``{name}.json``.
+
+{note}
+Replay the original hunt with ``{seed_line}``; this test replays the
+MINIMIZED case through the full oracle lane matrix and fails on any
+divergence, lane crash, or undeclared fallback — the bug class this
+case minimized from stays dead.
+
+Generated by the fuzz harness (spark_rapids_jni_tpu/fuzz/corpus.py).
+"""
+
+import json
+import os
+
+
+def test_repro_{ident}():
+    from spark_rapids_jni_tpu.fuzz.corpus import case_point
+    from spark_rapids_jni_tpu.fuzz.oracle import check_point
+
+    path = os.path.join(os.path.dirname(__file__), "{name}.json")
+    with open(path) as f:
+        case = json.load(f)
+    plan, tables = case_point(case)
+    v = check_point(plan, tables)
+    assert v["ok"], (v["divergences"], v["failures"],
+                     v["undeclared_fallbacks"])
+'''
+
+
+def write_repro_test(case: dict, name: str,
+                     directory: Optional[str] = None) -> str:
+    """Emit a self-contained pytest module next to the saved case, so a
+    single repro runs as ``pytest tests/fuzz_corpus/test_<name>.py``
+    without the rest of the harness."""
+    d = directory or corpus_dir()
+    os.makedirs(d, exist_ok=True)
+    ident = name.replace("-", "_")
+    src = _REPRO_TEMPLATE.format(
+        name=name, ident=ident,
+        note=case.get("note", "minimized fuzz failure."),
+        seed_line=case.get("seed_line", "(no seed line recorded)"))
+    path = os.path.join(d, f"test_{ident}.py")
+    with open(path, "w") as f:
+        f.write(src)
+    return path

@@ -1,0 +1,510 @@
+"""Seed-deterministic generators over the type/encoding/plan lattice.
+
+One integer seed fully determines one *point* — a (plan, tables) pair —
+via ``gen_point(seed)``. The generator materializes every random draw
+into an explicit, JSON-serializable **case dict** first (column value
+lists, plan structure, stats), then builds device objects from it:
+replay, shrinking, and the corpus all operate on the case dict, never on
+the RNG stream, so a minimized case stays replayable after the
+generator's distributions change.
+
+Table lattice: INT64/INT32/BOOL8/FLOAT64 at null densities
+0/sparse/dense/all, DICT32 (per-column dictionaries — pairs are
+cross-dictionary by construction), RLE runs, FOR at varied bit widths,
+empty and 1-row tables, adversarial key distributions (all-duplicate,
+dense-ascending) and advisory ``ColumnStats`` that may LIE (the planner
+re-checks claimed properties on device; a lie must cost a named
+fallback, never a wrong answer).
+
+Plan lattice: Scan/Filter/Project/GroupBy/Sort/Limit chains and
+Join DAGs over two inputs, with expression trees respecting the
+null-strict typing rules of plan/expr.py (int64 arithmetic only, FLOAT64
+as bare passthrough, DICT32 in eq/ne against string literals, Limit only
+after a prefix-compacting node).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar import encodings as enc
+from ..columnar.column import Column, ColumnStats, Table
+from ..columnar.dictionary import encode_strings
+from ..plan import expr as ex
+from ..plan.nodes import (Filter, GroupBy, Join, Limit, PlanNode, Project,
+                          Scan, Sort, walk)
+
+GEN_VERSION = "fuzz-v2"  # v2: predicate generation gates on
+# predicate_sources (no more comparisons anchored on dict/float
+# columns) — the seed->case mapping changed, so v1 SEED lines do not
+# replay under v2
+
+# row counts cover the degenerate ends (empty, 1-row) and a few sizes
+# that straddle piece/shard boundaries
+_ROW_COUNTS = (0, 1, 2, 3, 5, 8, 13, 24, 48, 64)
+_NULL_DENSITIES = (0.0, 0.0, 0.1, 0.5, 0.9, 1.0)
+
+_VOCAB = ("alpha", "beta", "gamma", "delta", "", "epsilon", "zeta")
+_FLOAT_SPECIALS = (float("nan"), float("inf"), float("-inf"), -0.0, 0.0,
+                   1.5, -2.25, 1e-300, 1e300, 3.14159)
+_INT_LITS = (-3, -1, 0, 1, 2, 3, 5, 100)
+
+
+def point_seed_line(seed: int) -> str:
+    """The one-line replay token for a generated point."""
+    return f"SEED: {GEN_VERSION} point={seed}"
+
+
+# ---------------------------------------------------------------------------
+# column spec generation (spec = JSON-serializable recipe)
+# ---------------------------------------------------------------------------
+
+def _int_values(rng: np.random.Generator, n: int, dist: str) -> List[int]:
+    if n == 0:
+        return []
+    if dist == "smallcard":
+        return [int(v) for v in rng.integers(0, 5, n)]
+    if dist == "alldup":
+        v = int(rng.integers(-5, 100))
+        return [v] * n
+    if dist == "dense":
+        lo = int(rng.integers(-3, 10))
+        return [lo + i for i in range(n)]
+    return [int(v) for v in rng.integers(-1000, 1001, n)]
+
+
+def _apply_nulls(rng: np.random.Generator, values: list,
+                 density: float) -> list:
+    if density <= 0.0:
+        return values
+    mask = rng.random(len(values)) < density
+    return [None if m else v for v, m in zip(values, mask)]
+
+
+def _maybe_stats(rng: np.random.Generator, values: List[Optional[int]]
+                 ) -> Optional[dict]:
+    """None, honest, or LYING advisory stats for a plain int column."""
+    roll = rng.random()
+    if roll < 0.5:
+        return None
+    arr = np.asarray([0 if v is None else v for v in values],
+                     dtype=np.int64)
+    honest = ColumnStats.from_numpy(arr)
+    if roll < 0.85 or arr.size == 0:
+        return {"lo": honest.lo, "hi": honest.hi, "unique": honest.unique,
+                "dense": honest.ascending_dense, "lie": False}
+    # lying stats: claim a dense-ascending unique key (or a too-narrow
+    # span) regardless of the data — planner-visible, device-re-checked
+    kind = int(rng.integers(0, 2))
+    if kind == 0:
+        return {"lo": int(arr.min()), "hi": int(arr.min()) + arr.size - 1,
+                "unique": True, "dense": True, "lie": True}
+    return {"lo": 0, "hi": 1, "unique": honest.unique,
+            "dense": honest.ascending_dense, "lie": True}
+
+
+def gen_colspec(rng: np.random.Generator, n: int,
+                force_kind: Optional[str] = None) -> dict:
+    """One column recipe. Kinds: plain int64/int32, bool8, float64,
+    dict (strings), rle (int64 runs), for (int32/int64 packed)."""
+    kinds = ("i64", "i64", "i32", "bool", "f64", "dict", "rle", "for")
+    kind = force_kind or kinds[int(rng.integers(0, len(kinds)))]
+    density = _NULL_DENSITIES[int(rng.integers(0, len(_NULL_DENSITIES)))]
+
+    if kind in ("i64", "i32"):
+        dist = ("smallcard", "wide", "alldup", "dense")[
+            int(rng.integers(0, 4))]
+        values = _apply_nulls(rng, _int_values(rng, n, dist), density)
+        return {"enc": "plain", "dtype": "int64" if kind == "i64"
+                else "int32", "values": values,
+                "stats": _maybe_stats(rng, values)}
+    if kind == "bool":
+        values = _apply_nulls(
+            rng, [bool(v) for v in rng.integers(0, 2, n)], density)
+        return {"enc": "plain", "dtype": "bool8", "values": values,
+                "stats": None}
+    if kind == "f64":
+        vals = []
+        for _ in range(n):
+            if rng.random() < 0.3:
+                vals.append(_FLOAT_SPECIALS[
+                    int(rng.integers(0, len(_FLOAT_SPECIALS)))])
+            else:
+                vals.append(float(rng.normal(0, 100)))
+        vals = _apply_nulls(rng, vals, density)
+        bits = [None if v is None
+                else int(np.float64(v).view(np.uint64)) for v in vals]
+        return {"enc": "plain", "dtype": "float64", "bits": bits,
+                "stats": None}
+    if kind == "dict":
+        values = _apply_nulls(
+            rng, [_VOCAB[int(i)] for i in rng.integers(0, len(_VOCAB), n)],
+            density)
+        return {"enc": "dict", "dtype": "string", "values": values,
+                "stats": None}
+    if kind == "rle":
+        # runny data: few distinct values, long-ish runs
+        vals: List[Optional[int]] = []
+        while len(vals) < n:
+            run = int(rng.integers(1, 6))
+            v = int(rng.integers(0, 4))
+            vals.extend([v] * run)
+        values = _apply_nulls(rng, vals[:n], density)
+        return {"enc": "rle", "dtype": "int64", "values": values,
+                "stats": None}
+    # FOR: narrow-span ints at a random packed width
+    base = int(rng.integers(-50, 1000))
+    span = int(rng.integers(1, 30))
+    values = _apply_nulls(
+        rng, [base + int(v) for v in rng.integers(0, span + 1, n)], density)
+    return {"enc": "for", "dtype": "int64" if rng.random() < 0.5
+            else "int32", "values": values,
+            "pad": int(rng.integers(0, 4)), "stats": None}
+
+
+def gen_tablespec(rng: np.random.Generator,
+                  n_rows: Optional[int] = None) -> List[dict]:
+    if n_rows is None:
+        n_rows = _ROW_COUNTS[int(rng.integers(0, len(_ROW_COUNTS)))]
+    ncols = int(rng.integers(2, 6))
+    # always at least one plain-int column so keys/predicates exist
+    specs = [gen_colspec(rng, n_rows, force_kind="i64")]
+    for _ in range(ncols - 1):
+        specs.append(gen_colspec(rng, n_rows))
+    order = rng.permutation(ncols)
+    return [specs[int(i)] for i in order]
+
+
+# ---------------------------------------------------------------------------
+# spec -> device objects
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"int64": dt.INT64, "int32": dt.INT32, "bool8": dt.BOOL8,
+           "float64": dt.FLOAT64, "string": dt.STRING}
+
+
+def build_column(spec: dict) -> Column:
+    dtype = _DTYPES[spec["dtype"]]
+    if spec["dtype"] == "float64":
+        bits = spec["bits"]
+        arr = np.asarray([0 if b is None else b for b in bits],
+                         dtype=np.uint64)
+        valid = np.asarray([b is not None for b in bits], dtype=bool)
+        col = Column.from_numpy(arr.view(np.float64), dt.FLOAT64,
+                                validity=None if valid.all() else valid)
+    else:
+        col = Column.from_pylist(spec["values"], dtype)
+    if spec["enc"] == "dict":
+        col = encode_strings(col)
+    elif spec["enc"] == "rle":
+        col = enc.rle_encode(col)
+    elif spec["enc"] == "for":
+        plain = col
+        probe = enc.for_encode(plain)          # width=None => minimal
+        width = min(32, probe.dtype.scale + int(spec.get("pad", 0)))
+        col = enc.for_encode(plain, width=width)
+    st = spec.get("stats")
+    if st is not None:
+        col = col.with_stats(ColumnStats(
+            lo=st["lo"], hi=st["hi"], unique=bool(st["unique"]),
+            ascending_dense=bool(st["dense"])))
+    return col
+
+
+def build_tables(table_specs: Sequence[Sequence[dict]]) -> List[Table]:
+    return [Table(tuple(build_column(s) for s in specs))
+            for specs in table_specs]
+
+
+def col_tag(spec: dict) -> dict:
+    """Capability tag for plan generation: kind + encodedness."""
+    if spec["enc"] == "dict":
+        return {"kind": "dict", "enc": False}
+    if spec["dtype"] == "float64":
+        return {"kind": "float", "enc": False}
+    if spec["dtype"] == "bool8":
+        return {"kind": "bool", "enc": spec["enc"] != "plain"}
+    return {"kind": "int", "enc": spec["enc"] != "plain"}
+
+
+# ---------------------------------------------------------------------------
+# expression generation (respects plan/expr.py typing)
+# ---------------------------------------------------------------------------
+
+def _int_cols(tags) -> List[int]:
+    # BOOL8 is intlike in plan expressions; encoded ints evaluate in
+    # run/code space — all legal arithmetic operands
+    return [i for i, t in enumerate(tags) if t["kind"] in ("int", "bool")]
+
+
+def gen_int_expr(rng: np.random.Generator, tags, depth: int = 0) -> ex.Expr:
+    ints = _int_cols(tags)
+    if depth >= 2 or rng.random() < 0.4:
+        if ints and rng.random() < 0.75:
+            return ex.col(ints[int(rng.integers(0, len(ints)))])
+        return ex.lit(_INT_LITS[int(rng.integers(0, len(_INT_LITS)))])
+    if rng.random() < 0.15:
+        return ex.Cast64(gen_int_expr(rng, tags, depth + 1))
+    op = ("add", "sub", "mul")[int(rng.integers(0, 3))]
+    return ex.BinOp(op, gen_int_expr(rng, tags, depth + 1),
+                    gen_int_expr(rng, tags, depth + 1))
+
+
+def predicate_sources(tags) -> bool:
+    """True when a column-anchored boolean predicate exists over this
+    schema: an int/bool comparison operand or a dictionary column for
+    equality. A schema of only float columns has neither (plan
+    comparisons are integer/bool-typed), so callers skip Filter."""
+    return any(t["kind"] in ("int", "bool", "dict") for t in tags)
+
+
+def _has_col(e: ex.Expr) -> bool:
+    if isinstance(e, ex.Col):
+        return True
+    if isinstance(e, ex.BinOp):
+        return _has_col(e.left) or _has_col(e.right)
+    if isinstance(e, (ex.Not, ex.Cast64)):
+        return _has_col(e.operand)
+    return False
+
+
+def gen_bool_expr(rng: np.random.Generator, tags,
+                  depth: int = 0) -> ex.Expr:
+    dicts = [i for i, t in enumerate(tags) if t["kind"] == "dict"]
+    bools = [i for i, t in enumerate(tags)
+             if t["kind"] == "bool" and not t["enc"]]
+    roll = rng.random()
+    if depth < 2 and roll < 0.2:
+        op = "and" if rng.random() < 0.5 else "or"
+        return ex.BinOp(op, gen_bool_expr(rng, tags, depth + 1),
+                        gen_bool_expr(rng, tags, depth + 1))
+    if depth < 2 and roll < 0.3:
+        return ex.Not(gen_bool_expr(rng, tags, depth + 1))
+    if dicts and roll < 0.45:
+        i = dicts[int(rng.integers(0, len(dicts)))]
+        word = _VOCAB[int(rng.integers(0, len(_VOCAB)))]
+        op = "eq" if rng.random() < 0.5 else "ne"
+        return ex.BinOp(op, ex.col(i), ex.Lit(word))
+    if bools and roll < 0.55:
+        return ex.col(bools[int(rng.integers(0, len(bools)))])
+    ints = _int_cols(tags)
+    if not ints:
+        # no int/bool operand is visible (a narrow Project can leave
+        # only dict/float columns); dictionary equality is the one
+        # remaining column-anchored predicate — callers gate Filter
+        # generation on predicate_sources(), so dicts is non-empty here
+        i = dicts[int(rng.integers(0, len(dicts)))]
+        word = _VOCAB[int(rng.integers(0, len(_VOCAB)))]
+        op = "eq" if rng.random() < 0.5 else "ne"
+        return ex.BinOp(op, ex.col(i), ex.Lit(word))
+    cmp = ("lt", "le", "gt", "ge", "eq", "ne")[int(rng.integers(0, 6))]
+    left = gen_int_expr(rng, tags, depth + 1)
+    right = gen_int_expr(rng, tags, depth + 1)
+    e = ex.BinOp(cmp, left, right)
+    if not _has_col(e):
+        e = ex.BinOp(cmp, ex.col(ints[int(rng.integers(0, len(ints)))]),
+                     right)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# plan generation
+# ---------------------------------------------------------------------------
+
+def _expr_tag(e: ex.Expr, tags) -> dict:
+    if isinstance(e, ex.Col):
+        return dict(tags[e.index])
+    if isinstance(e, ex.BinOp) and e.op in ("lt", "le", "gt", "ge", "eq",
+                                            "ne", "and", "or"):
+        return {"kind": "bool", "enc": False}
+    if isinstance(e, ex.Not):
+        return {"kind": "bool", "enc": False}
+    if isinstance(e, ex.Lit) and isinstance(e.value, bool):
+        return {"kind": "bool", "enc": False}
+    return {"kind": "int", "enc": False}
+
+
+def _gen_project(rng, node, tags):
+    n = int(rng.integers(1, 5))
+    exprs, out_tags = [], []
+    for _ in range(n):
+        roll = rng.random()
+        if roll < 0.5:
+            i = int(rng.integers(0, len(tags)))
+            e = ex.col(i)                      # passthrough, any kind
+        elif roll < 0.8 or not predicate_sources(tags):
+            e = gen_int_expr(rng, tags)
+        else:
+            e = gen_bool_expr(rng, tags)
+        exprs.append(e)
+        out_tags.append(_expr_tag(e, tags))
+    return Project(node, tuple(exprs)), out_tags
+
+
+def _key_cols(tags) -> List[int]:
+    """GroupBy/Sort/Join key candidates: plain int/bool/dict columns."""
+    return [i for i, t in enumerate(tags)
+            if not t["enc"] and t["kind"] in ("int", "bool", "dict")]
+
+
+def _agg_cols(tags) -> List[int]:
+    return [i for i, t in enumerate(tags)
+            if not t["enc"] and t["kind"] in ("int", "float")]
+
+
+def _gen_groupby(rng, node, tags):
+    keys = _key_cols(tags)
+    vals = _agg_cols(tags)
+    if not keys or not vals:
+        return None
+    nk = 1 if len(keys) == 1 or rng.random() < 0.7 else 2
+    kidx = [int(i) for i in rng.choice(len(keys), nk, replace=False)]
+    gkeys = tuple(keys[i] for i in kidx)
+    aggs = []
+    for _ in range(int(rng.integers(1, 4))):
+        i = vals[int(rng.integers(0, len(vals)))]
+        op = ("sum", "mean", "min", "max", "count")[int(rng.integers(0, 5))]
+        aggs.append((i, op))
+    out_tags = [dict(tags[i]) for i in gkeys]
+    for i, op in aggs:
+        if op == "count":
+            out_tags.append({"kind": "int", "enc": False})
+        elif op == "mean":
+            out_tags.append({"kind": "float", "enc": False})
+        elif op == "sum":
+            out_tags.append(dict(tags[i]) if tags[i]["kind"] == "float"
+                            else {"kind": "int", "enc": False})
+        else:
+            out_tags.append(dict(tags[i]))
+    return GroupBy(node, gkeys, tuple(aggs)), out_tags
+
+
+def _gen_sort(rng, node, tags):
+    keys = _key_cols(tags)
+    if not keys:
+        return None
+    nk = 1 if len(keys) == 1 or rng.random() < 0.7 else 2
+    kidx = [int(i) for i in rng.choice(len(keys), nk, replace=False)]
+    skeys = tuple(keys[i] for i in kidx)
+    asc = nf = None
+    if rng.random() < 0.4:
+        asc = tuple(bool(rng.random() < 0.5) for _ in skeys)
+    if rng.random() < 0.3:
+        nf = tuple(bool(rng.random() < 0.5) for _ in skeys)
+    return Sort(node, skeys, asc, nf)
+
+
+def _gen_linear(rng, tags, input_index=0, allow_suffix=True):
+    """Scan -> [Filter|Project]{0,2} (-> GroupBy -> Sort -> Limit when
+    ``allow_suffix``). Returns (plan, output tags)."""
+    node: PlanNode = Scan(len(tags), input_index=input_index)
+    for _ in range(int(rng.integers(0, 3))):
+        if rng.random() < 0.5 and predicate_sources(tags):
+            node = Filter(node, gen_bool_expr(rng, tags))
+        else:
+            node, tags = _gen_project(rng, node, tags)
+    if not allow_suffix:
+        return node, tags
+    compacted = False
+    if rng.random() < 0.45:
+        g = _gen_groupby(rng, node, tags)
+        if g is not None:
+            node, tags = g
+            compacted = True
+    if rng.random() < 0.45:
+        s = _gen_sort(rng, node, tags)
+        if s is not None:
+            node = s
+            compacted = True
+    if compacted and rng.random() < 0.35:
+        node = Limit(node, int(rng.integers(0, 9)))
+    if isinstance(node, Scan):
+        # guarantee at least one operator per plan
+        node = Filter(node, gen_bool_expr(rng, tags))
+    return node, tags
+
+
+def _gen_join(rng, ltags, rtags):
+    left, ltags = _gen_linear(rng, ltags, 0, allow_suffix=False)
+    right, rtags = _gen_linear(rng, rtags, 1, allow_suffix=False)
+    lint = [i for i, t in enumerate(ltags)
+            if not t["enc"] and t["kind"] == "int"]
+    rint = [i for i, t in enumerate(rtags)
+            if not t["enc"] and t["kind"] == "int"]
+    ldict = [i for i, t in enumerate(ltags) if t["kind"] == "dict"]
+    rdict = [i for i, t in enumerate(rtags) if t["kind"] == "dict"]
+    pairs = []
+    if ldict and rdict and rng.random() < 0.3:
+        pairs.append((ldict[int(rng.integers(0, len(ldict)))],
+                      rdict[int(rng.integers(0, len(rdict)))]))
+    elif lint and rint:
+        pairs.append((lint[int(rng.integers(0, len(lint)))],
+                      rint[int(rng.integers(0, len(rint)))]))
+        if len(lint) > 1 and len(rint) > 1 and rng.random() < 0.25:
+            li = [i for i in lint if i != pairs[0][0]]
+            ri = [i for i in rint if i != pairs[0][1]]
+            pairs.append((li[int(rng.integers(0, len(li)))],
+                          ri[int(rng.integers(0, len(ri)))]))
+    else:
+        return None
+    how = ("inner", "left", "semi", "anti")[int(rng.integers(0, 4))]
+    node = Join(left, right, tuple(p[0] for p in pairs),
+                tuple(p[1] for p in pairs), how)
+    tags = ltags if how in ("semi", "anti") else ltags + rtags
+    # optional DAG suffix
+    if rng.random() < 0.35 and predicate_sources(tags):
+        node = Filter(node, gen_bool_expr(rng, tags))
+    elif rng.random() < 0.3:
+        g = _gen_groupby(rng, node, tags)
+        if g is not None:
+            node, tags = g
+    return node, tags
+
+
+# ---------------------------------------------------------------------------
+# point = (tables, plan) from one seed
+# ---------------------------------------------------------------------------
+
+def gen_case(seed: int) -> dict:
+    """The JSON-serializable case dict for one seed."""
+    from .corpus import plan_to_dict
+    rng = np.random.default_rng(seed)
+    want_join = rng.random() < 0.3
+    if want_join:
+        specs = [gen_tablespec(rng), gen_tablespec(rng)]
+        tags = [[col_tag(s) for s in t] for t in specs]
+        j = _gen_join(rng, list(tags[0]), list(tags[1]))
+        if j is not None:
+            plan, _ = j
+            return {"version": GEN_VERSION, "seed": seed,
+                    "tables": specs, "plan": plan_to_dict(plan)}
+    specs = [gen_tablespec(rng)]
+    tags = [col_tag(s) for s in specs[0]]
+    plan, _ = _gen_linear(rng, list(tags))
+    return {"version": GEN_VERSION, "seed": seed,
+            "tables": specs, "plan": plan_to_dict(plan)}
+
+
+def gen_point(seed: int) -> Tuple[PlanNode, List[Table], dict]:
+    """(plan, tables, case dict) for one seed — the replayable point."""
+    from .corpus import plan_from_dict
+    case = gen_case(seed)
+    return (plan_from_dict(case["plan"]), build_tables(case["tables"]),
+            case)
+
+
+def case_stats(case: dict) -> dict:
+    """Small structural summary for artifact accounting."""
+    from .corpus import plan_from_dict
+    plan = plan_from_dict(case["plan"])
+    return {
+        "rows": [sum(1 for _ in t[0].get("values", t[0].get("bits", [])))
+                 if t else 0 for t in case["tables"]],
+        "nodes": len(walk(plan)),
+        "dag": any(isinstance(n, Join) for n in walk(plan)),
+        "encodings": sorted({s["enc"] for t in case["tables"]
+                             for s in t}),
+    }

@@ -1,0 +1,82 @@
+"""Deliberately seeded engine bugs — the shrink demo's test subjects.
+
+Each mutation is a context manager that monkeypatches ONE module
+boundary with a classic off-by-one, runs the harness against the broken
+engine, and restores the original on exit. They exist to demonstrate
+the detect → shrink → repro loop end-to-end: the oracle must catch each
+mutation, the shrinker must minimize the catching case to a few rows
+and plan nodes, and the committed ``SEED:`` repro must FAIL with the
+mutation active and PASS on main. Nothing here ships in a query path —
+the CLI's ``--mutations`` stage and tests/test_fuzz.py are the only
+callers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..columnar.column import Table
+from ..columnar.table_ops import slice_table
+from ..plan import interpreter as _interp
+from ..plan import split as _split
+from ..plan.nodes import Limit
+
+MUTATIONS = ("split-overlap", "eager-limit-off-by-one")
+
+
+@contextlib.contextmanager
+def mutation_split_overlap():
+    """plan/split.py:split_table halves at ``n // 2`` — the mutation
+    starts the second piece one row EARLY, so the boundary row rides in
+    both pieces and every split-lane aggregate double-counts it."""
+    orig = _split.split_table
+
+    def overlapping(table: Table):
+        n = table.num_rows
+        if n < 2:
+            return [table]
+        h = n // 2
+        a = Table(tuple(_split._slice_rows(c, 0, h)
+                        for c in table.columns))
+        b = Table(tuple(_split._slice_rows(c, h - 1, n)
+                        for c in table.columns))
+        return [a, b]
+
+    _split.split_table = overlapping
+    try:
+        yield
+    finally:
+        _split.split_table = orig
+
+
+@contextlib.contextmanager
+def mutation_eager_limit_off_by_one():
+    """plan/interpreter.py eager Limit keeps ``count`` rows — the
+    mutation keeps ``count + 1``, so the eager REFERENCE disagrees with
+    every fused/sharded/batched lane whenever Limit actually truncates."""
+    orig = _interp._run
+
+    def run_limit_long(node, tables):
+        if isinstance(node, Limit):
+            t = run_limit_long(node.child, tables)
+            return slice_table(t, 0, min(node.count + 1, t.num_rows))
+        return orig(node, tables)
+
+    _interp._run = run_limit_long
+    try:
+        yield
+    finally:
+        _interp._run = orig
+
+
+@contextlib.contextmanager
+def apply_mutation(name: str):
+    if name == "split-overlap":
+        with mutation_split_overlap():
+            yield
+    elif name == "eager-limit-off-by-one":
+        with mutation_eager_limit_off_by_one():
+            yield
+    else:
+        raise ValueError(f"unknown mutation {name!r} "
+                         f"(known: {', '.join(MUTATIONS)})")

@@ -1,0 +1,336 @@
+"""Cross-engine bit-identity oracle: the lane table.
+
+One generated (plan, tables) point runs through every *applicable*
+engine lane and every lane's result must be byte-exact equal to the
+eager reference — values, validity, and (when both lanes keep the
+encoding) dictionaries. A lane that does not apply must decline with a
+NAMED gate reason drawn from the engines' own gate functions; an
+undeclared fallback (a lane that silently re-routed without naming a
+reason from the ``FALLBACK_REASONS`` catalog) is a failure, not a skip.
+
+Lane table (every future engine lane registers here):
+
+    eager     run_eager — THE reference semantics; always applicable
+    fused     execute_plan (self-gating: internal fallbacks must be
+              named; the oracle checks the metrics delta)
+    sharded{2,4,8}  execute_plan_sharded on a d-device sub-mesh;
+              gates: unsupported_reason + sharding_unsupported_reason
+    batched   MicroBatcher.execute_group of the point twice (one padded
+              dispatch); gates: DAG (linear-only batch keys) +
+              unsupported_reason
+    split     plan/split.py prepare/split_table/merge_pieces forced
+              unconditionally (the OOM ladder's split rung without the
+              OOM); gate: split_unmergeable_reason
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtype as dt
+from ..columnar import encodings as enc
+from ..columnar.column import Column, Table
+from ..columnar import dictionary as dct
+from ..plan import split as _split
+from ..plan.compile import plan_metrics
+from ..plan.executor import (execute_plan, resolve_dict_literals,
+                             _resolve_dag_literals, unsupported_reason)
+from ..plan.interpreter import FALLBACK_REASONS, run_eager
+from ..plan.nodes import PlanNode, is_dag, walk
+from ..plan.sharded_executor import execute_plan_sharded
+from ..plan.sharding import sharding_unsupported_reason
+from ..utils import config
+
+# gate reasons the ORACLE's lane table declares itself (for lanes whose
+# inapplicability is structural rather than an engine-gate function)
+GATE_DAG_BATCH = ("plan is a DAG (Join) — batch keys are "
+                  "linear-pipeline-only")
+
+SHARD_COUNTS = (2, 4, 8)
+
+LANES = ("fused", "sharded2", "sharded4", "sharded8", "batched", "split")
+
+
+def drop_compile_caches() -> None:
+    """Release every cached compiled executable (jit + the plan cache).
+
+    Long sweeps compile a fresh XLA program per point per lane; the
+    loaded executables each hold mmap'd code pages, and a multi-thousand
+    point run exhausts ``vm.max_map_count`` (LLVM JIT then segfaults
+    mid-allocation). Harness loops call this periodically — correctness
+    is unaffected, the next point just recompiles.
+    """
+    import jax
+
+    from ..plan.executor import _default_cache
+
+    _default_cache.clear()   # AOT executables pinned by ProgramCache
+    jax.clear_caches()       # jit/pjit tracing + executable caches
+
+
+def _resolved(plan: PlanNode, tables: List[Table]) -> PlanNode:
+    """Dictionary-literal resolution, shared by every lane (pure and
+    deterministic — execute_plan re-resolving is a no-op)."""
+    if is_dag(plan) or len(tables) > 1:
+        return _resolve_dag_literals(plan, tuple(tables))
+    return resolve_dict_literals(plan, tables[0])
+
+
+def run_reference(plan: PlanNode, tables: List[Table]) -> Table:
+    """The eager reference result (lane "eager")."""
+    plan = _resolved(plan, tables)
+    if len(tables) == 1:
+        return run_eager(plan, tables[0])  # srjt: noqa[SRJT021] — the oracle's reference lane, not a fallback
+    return run_eager(plan, tables)  # srjt: noqa[SRJT021] — the oracle's reference lane, not a fallback
+
+
+# ---------------------------------------------------------------------------
+# byte-exact comparison
+# ---------------------------------------------------------------------------
+
+def _valid(c: Column) -> np.ndarray:
+    if c.validity is None:
+        return np.ones(c.size, dtype=bool)
+    return np.asarray(c.validity).astype(bool)
+
+
+def _col_mismatch(i: int, a: Column, b: Column) -> Optional[str]:
+    """Byte-exact compare of two MATERIALIZED (plain/STRING) columns."""
+    if a.dtype.id is not b.dtype.id:
+        return f"col {i}: dtype {a.dtype.id.value} != {b.dtype.id.value}"
+    if not np.array_equal(_valid(a), _valid(b)):
+        return f"col {i}: validity differs"
+    da = None if a.data is None else np.asarray(a.data)
+    db = None if b.data is None else np.asarray(b.data)
+    if (da is None) != (db is None) or (
+            da is not None and not np.array_equal(da, db)):
+        return f"col {i}: data bytes differ"
+    oa = None if a.offsets is None else np.asarray(a.offsets)
+    ob = None if b.offsets is None else np.asarray(b.offsets)
+    if (oa is None) != (ob is None) or (
+            oa is not None and not np.array_equal(oa, ob)):
+        return f"col {i}: offsets differ"
+    return None
+
+
+def _dict_mismatch(i: int, a: Column, b: Column) -> Optional[str]:
+    """When BOTH lanes kept DICT32: codes and dictionary entries must be
+    byte-exact too (the dictionaries part of the invariant)."""
+    if not np.array_equal(np.asarray(a.data), np.asarray(b.data)):
+        return f"col {i}: dictionary codes differ"
+    va, vb = dct.dict_values(a), dct.dict_values(b)
+    if _col_mismatch(i, va, vb) is not None:
+        return f"col {i}: dictionary entries differ"
+    return None
+
+
+def tables_mismatch(a: Table, b: Table) -> Optional[str]:
+    """None when ``a`` and ``b`` are byte-exact equal (values + validity
+    + dictionaries); else a one-line description of the first mismatch.
+
+    Representation is normalized the way the repo's own bit-identity
+    suites do: RLE/FOR decode to rows first (lanes decode at different
+    declared boundaries), and DICT32 materializes for the value compare
+    — but when both sides kept DICT32, codes+entries must ALSO match
+    byte-exact."""
+    if a.num_rows != b.num_rows:
+        return f"row count {a.num_rows} != {b.num_rows}"
+    if a.num_columns != b.num_columns:
+        return f"column count {a.num_columns} != {b.num_columns}"
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        if enc.is_encoded(ca):
+            ca = enc.decoded_rows(ca)  # srjt: noqa[SRJT016] — oracle compare boundary, not execution
+        if enc.is_encoded(cb):
+            cb = enc.decoded_rows(cb)  # srjt: noqa[SRJT016] — oracle compare boundary, not execution
+        if ca.dtype.id is dt.TypeId.DICT32 \
+                and cb.dtype.id is dt.TypeId.DICT32:
+            m = _dict_mismatch(i, ca, cb)
+            if m is not None:
+                return m
+            continue
+        if ca.dtype.id is dt.TypeId.DICT32:
+            ca = dct.materialize(ca)
+        if cb.dtype.id is dt.TypeId.DICT32:
+            cb = dct.materialize(cb)
+        m = _col_mismatch(i, ca, cb)
+        if m is not None:
+            return m
+    return None
+
+
+# ---------------------------------------------------------------------------
+# lane gates + lane runs
+# ---------------------------------------------------------------------------
+
+def lane_gate(lane: str, plan: PlanNode,
+              tables: List[Table]) -> Optional[str]:
+    """The NAMED reason this lane does not apply to this point, or None
+    when the lane must run and match. Reasons come from the engines' own
+    gate functions wherever one exists."""
+    t0 = tables[0]
+    if lane == "fused":
+        return None                      # self-gating (named fallbacks)
+    if lane.startswith("sharded"):
+        r = sharding_unsupported_reason(plan, t0)
+        if r is not None:
+            return r
+        return unsupported_reason(plan, t0)
+    if lane == "batched":
+        if is_dag(plan) or len(tables) > 1:
+            return GATE_DAG_BATCH
+        from ..serving.microbatch import batching_unsupported_reason
+        return batching_unsupported_reason(plan, t0)
+    if lane == "split":
+        if len(tables) > 1:
+            return _split.split_unmergeable_reason(plan, t0) \
+                or "plan is a DAG (Join) — the probe side's row order " \
+                   "spans the build side; pieces don't merge"
+        return _split.split_unmergeable_reason(plan, t0)
+    raise ValueError(f"unknown lane {lane!r}")
+
+
+def _run_split_lane(plan: PlanNode, table: Table) -> Tuple[str, Optional[Table]]:
+    """Force the OOM ladder's split rung without the OOM: halve, run the
+    piece plan per piece (eager — the merge math is what's under test),
+    merge exactly. Degenerate merges decline with the ladder's own
+    named reasons."""
+    spec = _split.prepare(plan)
+    pieces = _split.split_table(table)
+    results = [run_eager(spec.piece_plan, p)  # srjt: noqa[SRJT021] — oracle piece replay, not a fallback
+               for p in pieces]
+    try:
+        merged = _split.merge_pieces(spec, results, table.num_rows,
+                                     int(config.get("plan.max_groups")))
+        return "ok", merged
+    except _split.SplitMergeOverflow:
+        return "declined:overflow", None
+    except _split.SplitMergeError:
+        return "declined:oom-split-degenerate", None
+
+
+def _run_lane(lane: str, plan: PlanNode,
+              tables: List[Table]) -> Tuple[str, Optional[Table]]:
+    """("ok"|"declined:<reason>", table-or-None). Raises only on a
+    genuine lane crash (which the caller records as a failure)."""
+    t0 = tables[0]
+    if lane == "fused":
+        out = execute_plan(plan, t0 if len(tables) == 1 else tables)
+        return "ok", out
+    if lane.startswith("sharded"):
+        d = int(lane[len("sharded"):])
+        return "ok", execute_plan_sharded(plan, t0, devices=d)
+    if lane == "batched":
+        from ..serving.microbatch import MicroBatcher
+        outcomes = MicroBatcher().execute_group(
+            [plan, plan], [t0, t0], [None, None])
+        for o in outcomes:
+            if o.error is not None:
+                raise o.error
+        m = tables_mismatch(outcomes[0].table, outcomes[1].table)
+        if m is not None:
+            raise AssertionError(f"batched members disagree: {m}")
+        return "ok", outcomes[0].table
+    if lane == "split":
+        return _run_split_lane(plan, t0)
+    raise ValueError(f"unknown lane {lane!r}")
+
+
+# ---------------------------------------------------------------------------
+# the point check
+# ---------------------------------------------------------------------------
+
+def check_point(plan: PlanNode, tables: List[Table]) -> dict:
+    """Run one point through the whole lane table.
+
+    Returns a verdict dict:
+        ok                    everything held
+        divergences           [{"lane", "mismatch"}]
+        failures              [{"lane", "error"}] — lane crashes
+        undeclared_fallbacks  [{"lane", "detail"}]
+        lanes                 {lane: "ok" | "declined:<gate>"}
+        fallback_reasons      merged per-reason metric deltas
+    """
+    plan = _resolved(plan, tables)
+    verdict = {"ok": True, "divergences": [], "failures": [],
+               "undeclared_fallbacks": [], "lanes": {},
+               "fallback_reasons": {}}
+    try:
+        ref = run_reference(plan, tables)
+    except Exception as e:  # noqa: BLE001 — recorded, point fails
+        verdict["ok"] = False
+        verdict["failures"].append({"lane": "eager",
+                                    "error": f"{type(e).__name__}: {e}"})
+        return verdict
+
+    for lane in LANES:
+        gate = lane_gate(lane, plan, tables)
+        if gate is not None:
+            if not isinstance(gate, str) or not gate.strip():
+                verdict["ok"] = False
+                verdict["undeclared_fallbacks"].append(
+                    {"lane": lane, "detail": "gate declined without a "
+                                             "named reason"})
+                continue
+            verdict["lanes"][lane] = f"declined:{gate}"
+            continue
+
+        before = plan_metrics.snapshot()
+        try:
+            status, out = _run_lane(lane, plan, tables)
+        except Exception as e:  # noqa: BLE001 — recorded, point fails
+            verdict["ok"] = False
+            verdict["failures"].append(
+                {"lane": lane, "error": f"{type(e).__name__}: {e}"})
+            continue
+        after = plan_metrics.snapshot()
+
+        # undeclared-fallback check: every fallback the lane took must
+        # carry a catalog reason, and executor lanes must have either
+        # dispatched fused or declared a fallback
+        d_reasons = {}
+        for k, v in after["plan_fallback_reasons"].items():
+            dv = v - before["plan_fallback_reasons"].get(k, 0)
+            if dv:
+                d_reasons[k] = dv
+                verdict["fallback_reasons"][k] = \
+                    verdict["fallback_reasons"].get(k, 0) + dv
+        bad = [k for k in d_reasons if k not in FALLBACK_REASONS]
+        if bad:
+            verdict["ok"] = False
+            verdict["undeclared_fallbacks"].append(
+                {"lane": lane, "detail": f"reasons outside the declared "
+                                         f"catalog: {bad}"})
+        if lane in ("fused", "sharded2", "sharded4", "sharded8"):
+            d_exec = after["plan_executes"] - before["plan_executes"]
+            d_fall = after["plan_fallbacks"] - before["plan_fallbacks"]
+            if d_exec == 0 and d_fall == 0:
+                verdict["ok"] = False
+                verdict["undeclared_fallbacks"].append(
+                    {"lane": lane,
+                     "detail": "no fused dispatch and no declared "
+                               "fallback — where did the result come "
+                               "from?"})
+
+        if status.startswith("declined:"):
+            verdict["lanes"][lane] = status
+            continue
+        verdict["lanes"][lane] = "ok"
+        m = tables_mismatch(ref, out)
+        if m is not None:
+            verdict["ok"] = False
+            verdict["divergences"].append({"lane": lane, "mismatch": m})
+    return verdict
+
+
+def check_seed(seed: int) -> dict:
+    """Generate + check one point from its seed (the replay entry)."""
+    from .gen import gen_point, point_seed_line
+    plan, tables, case = gen_point(seed)
+    v = check_point(plan, tables)
+    v["seed"] = seed
+    v["seed_line"] = point_seed_line(seed)
+    v["dag"] = is_dag(plan)
+    v["nodes"] = len(walk(plan))
+    return v

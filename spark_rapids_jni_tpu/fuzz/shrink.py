@@ -1,0 +1,252 @@
+"""Greedy case minimization: rows -> columns -> plan nodes -> storm rules.
+
+The shrinker works on the JSON case dict (fuzz/corpus.py format), never
+on live device objects, so every intermediate is serializable and the
+final minimum drops straight into ``tests/fuzz_corpus/``. The loop is a
+classic greedy fixpoint: propose candidates largest-cut-first, accept a
+candidate iff the caller's ``failing`` predicate still holds (a
+predicate CRASH counts as not-failing — shrinking must preserve the
+original failure, not wander into new ones), repeat until no candidate
+is accepted.
+
+Candidate order:
+
+1. **rows** — drop the back half, the front half, then single rows;
+2. **columns** — drop an unreferenced column of a linear plan,
+   remapping ``Col`` indices in the scan-space prefix (everything up to
+   and including the first Project/GroupBy; later nodes address the
+   redefined schema, which keeps its arity);
+3. **plan nodes** — drop the root operator, splice out any interior
+   schema-preserving Filter/Sort/Limit;
+4. **storm rules** — drop composed fault rules one at a time.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Iterator, List, Optional
+
+Failing = Callable[[dict], bool]
+
+
+# ---------------------------------------------------------------------------
+# expression-dict / plan-dict helpers (corpus JSON format)
+# ---------------------------------------------------------------------------
+
+def _expr_cols(ed: dict) -> set:
+    if ed["e"] == "col":
+        return {ed["i"]}
+    if ed["e"] in ("cast64", "not"):
+        return _expr_cols(ed["o"])
+    if ed["e"] == "bin":
+        return _expr_cols(ed["l"]) | _expr_cols(ed["r"])
+    return set()
+
+
+def _expr_remap(ed: dict, dropped: int) -> dict:
+    if ed["e"] == "col":
+        i = ed["i"]
+        return {"e": "col", "i": i - 1 if i > dropped else i}
+    if ed["e"] in ("cast64", "not"):
+        return {**ed, "o": _expr_remap(ed["o"], dropped)}
+    if ed["e"] == "bin":
+        return {**ed, "l": _expr_remap(ed["l"], dropped),
+                "r": _expr_remap(ed["r"], dropped)}
+    return ed
+
+
+def _chain(pd: dict) -> Optional[List[dict]]:
+    """Root-to-scan node list for a LINEAR plan dict; None for DAGs."""
+    out = []
+    while True:
+        out.append(pd)
+        if pd["node"] == "scan":
+            return out
+        if pd["node"] == "join":
+            return None
+        pd = pd["child"]
+
+
+def _rebuild(chain: List[dict]) -> dict:
+    """Re-link a root-to-scan chain (nodes carry stale 'child' links)."""
+    node = chain[-1]
+    for d in reversed(chain[:-1]):
+        node = {**d, "child": node}
+    return node
+
+
+def _scan_space_refs(chain: List[dict]) -> set:
+    """Scan-space column indices the plan references: every node up to
+    and including the first schema-redefining one (Project/GroupBy)."""
+    refs: set = set()
+    for d in reversed(chain[:-1]):          # scan-adjacent first
+        if d["node"] == "filter":
+            refs |= _expr_cols(d["pred"])
+        elif d["node"] == "sort":
+            refs |= set(d["keys"])
+        elif d["node"] == "project":
+            for e in d["exprs"]:
+                refs |= _expr_cols(e)
+            break
+        elif d["node"] == "groupby":
+            refs |= set(d["keys"]) | {i for i, _op in d["aggs"]}
+            break
+    return refs
+
+
+def _drop_scan_column(chain: List[dict], j: int) -> dict:
+    """Plan dict with scan column ``j`` removed: Scan narrows, Col
+    indices in the scan-space prefix shift down past ``j``."""
+    new = [dict(d) for d in chain]
+    new[-1] = {**new[-1], "ncols": new[-1]["ncols"] - 1}
+    for k in range(len(new) - 2, -1, -1):   # scan-adjacent first
+        d = new[k]
+        if d["node"] == "filter":
+            d["pred"] = _expr_remap(d["pred"], j)
+        elif d["node"] == "sort":
+            d["keys"] = [i - 1 if i > j else i for i in d["keys"]]
+        elif d["node"] == "project":
+            d["exprs"] = [_expr_remap(e, j) for e in d["exprs"]]
+            break
+        elif d["node"] == "groupby":
+            d["keys"] = [i - 1 if i > j else i for i in d["keys"]]
+            d["aggs"] = [[i - 1 if i > j else i, op]
+                         for i, op in d["aggs"]]
+            break
+    return _rebuild(new)
+
+
+def _splice_sites(pd: dict, path=()) -> Iterator[tuple]:
+    """(path, node) pairs for every schema-preserving interior node."""
+    if pd["node"] in ("filter", "sort", "limit"):
+        yield path, pd
+    for key in ("child", "left", "right"):
+        if key in pd:
+            yield from _splice_sites(pd[key], path + (key,))
+
+
+def _splice_out(pd: dict, path: tuple) -> dict:
+    if not path:
+        return pd["child"]
+    head = dict(pd)
+    head[path[0]] = _splice_out(pd[path[0]], path[1:])
+    return head
+
+
+def _case_rows(case: dict, k: int) -> int:
+    specs = case["tables"][k]
+    s = specs[0]
+    return len(s["bits"] if s["dtype"] == "float64" else s["values"])
+
+
+def _keep_rows(case: dict, k: int, keep: List[int]) -> dict:
+    c = copy.deepcopy(case)
+    for s in c["tables"][k]:
+        key = "bits" if s["dtype"] == "float64" else "values"
+        s[key] = [s[key][i] for i in keep]
+    return c
+
+
+# ---------------------------------------------------------------------------
+# candidate streams
+# ---------------------------------------------------------------------------
+
+def _row_candidates(case: dict) -> Iterator[dict]:
+    for k in range(len(case["tables"])):
+        n = _case_rows(case, k)
+        if n >= 2:
+            yield _keep_rows(case, k, list(range(n // 2)))       # back half
+            yield _keep_rows(case, k, list(range(n // 2, n)))    # front half
+        if 1 <= n <= 16:
+            for i in range(n):
+                yield _keep_rows(case, k, [r for r in range(n) if r != i])
+
+
+def _column_candidates(case: dict) -> Iterator[dict]:
+    chain = _chain(case["plan"])
+    if chain is None or len(case["tables"]) != 1:
+        return
+    specs = case["tables"][0]
+    if len(specs) <= 1:
+        return
+    refs = _scan_space_refs(chain)
+    for j in range(len(specs) - 1, -1, -1):
+        if j in refs:
+            continue
+        c = copy.deepcopy(case)
+        del c["tables"][0][j]
+        c["plan"] = _drop_scan_column(chain, j)
+        yield c
+
+
+def _plan_candidates(case: dict) -> Iterator[dict]:
+    pd = case["plan"]
+    if pd["node"] in ("filter", "project", "sort", "limit"):
+        yield {**copy.deepcopy(case), "plan": copy.deepcopy(pd["child"])}
+    for path, _node in _splice_sites(pd):
+        if not path:
+            continue                       # root drop already yielded
+        yield {**copy.deepcopy(case),
+               "plan": _splice_out(copy.deepcopy(pd), path)}
+
+
+def _storm_candidates(case: dict) -> Iterator[dict]:
+    storm = case.get("storm")
+    if not storm:
+        return
+    for section in list(storm):
+        for name in list(storm[section]):
+            c = copy.deepcopy(case)
+            del c["storm"][section][name]
+            if not c["storm"][section]:
+                del c["storm"][section]
+            yield c
+
+
+_STAGES = (_row_candidates, _column_candidates, _plan_candidates,
+           _storm_candidates)
+
+
+# ---------------------------------------------------------------------------
+# the greedy loop
+# ---------------------------------------------------------------------------
+
+def _still_fails(failing: Failing, case: dict) -> bool:
+    try:
+        return bool(failing(case))
+    except Exception:  # noqa: BLE001 — a new crash is a DIFFERENT bug
+        return False
+
+
+def shrink_case(case: dict, failing: Failing,
+                max_steps: int = 400) -> dict:
+    """Greedy fixpoint minimization of ``case`` under ``failing``."""
+    cur = case
+    steps = 0
+    improved = True
+    while improved and steps < max_steps:
+        improved = False
+        for stage in _STAGES:
+            for cand in stage(cur):
+                steps += 1
+                if steps >= max_steps:
+                    return cur
+                if _still_fails(failing, cand):
+                    cur = cand
+                    improved = True
+                    break
+            if improved:
+                break
+    return cur
+
+
+def shrink_summary(case: dict) -> dict:
+    from .corpus import plan_from_dict
+    from ..plan.nodes import walk
+    return {
+        "rows": [_case_rows(case, k) for k in range(len(case["tables"]))],
+        "cols": [len(t) for t in case["tables"]],
+        "nodes": len(walk(plan_from_dict(case["plan"]))),
+        "storm_rules": sum(len(sec) for sec in
+                           (case.get("storm") or {}).values()),
+    }

@@ -1,0 +1,207 @@
+"""Chaos composition: re-run surviving fuzz points under fault storms.
+
+A **storm** is a randomly composed faultinj config — 1–4 rules drawn
+over the plan path's guarded surfaces with random injectionType 1–6
+payloads (device traps, device asserts, substituted API errors, payload
+bit-flips, worker crashes, delay storms, retry/split OOMs), random
+percent, and bounded interception budgets. A point that passed the
+bit-identity oracle fault-free is re-run under the storm and must end in
+exactly one of two states:
+
+* the SAME byte-exact result — the supervision stack absorbed the storm
+  (retries, poison redispatch, OOM rollback/split-and-retry); or
+* a TYPED failure from the declared surface (``TYPED_FAILURES``) — the
+  storm outlasted the budgets and the failure speaks a protocol.
+
+Anything else — a wrong answer, a bare RuntimeError, a leak — fails the
+point. After every point the protocol-witness books (admission/dispatch/
+reservation/sandbox/replica pairs) must be balanced: a storm may abort a
+query but may not strand an acquire.
+
+Storm seeds are replayable: ``SEED: fuzz-v1 point=<p> storm=<s>``
+rebuilds both the point and the storm config, and the storm seed is
+ALSO the injector's RNG seed (satellite: every chaos verdict records
+it), so the rule sampling itself replays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..analysis import protocol_witness
+from ..faultinj import install as inj_install, uninstall as inj_uninstall
+from ..faultinj.guard import FaultStormError, ProgramPoisonedError
+from ..faultinj.watchdog import DeadlineExceededError, StallCancelledError
+from ..memory.exceptions import OffHeapOOM, TpuOOM
+from ..plan.executor import execute_plan
+from ..utils import config
+from .gen import GEN_VERSION, gen_point
+from .oracle import _resolved, run_reference, tables_mismatch
+
+# the failure surface a storm is ALLOWED to produce — every class here
+# names a protocol (retry exhaustion, poison containment, the RmmSpark
+# OOM ladder, watchdog cancellation); TpuOOM/OffHeapOOM cover their
+# Retry/SplitAndRetry subclasses
+TYPED_FAILURES = (FaultStormError, ProgramPoisonedError, TpuOOM,
+                  OffHeapOOM, DeadlineExceededError, StallCancelledError)
+
+# surfaces a storm rule may target: the fused-plan dispatch boundary,
+# the wildcard (every guarded surface), and two op surfaces that are
+# structurally quiet on the plan path — composition noise that must
+# never change a verdict
+_SURFACES = ("plan_execute", "*", "sort_order", "hash.murmur3")
+
+# injectionType weights: transient errors and OOMs are the interesting
+# absorb-or-typed-fail cases, so they repeat
+_TYPES = (1, 2, 2, 3, 4, 5, 6, 6)
+
+_SECTIONS = ("xlaRuntimeFaults", "cudaRuntimeFaults", "cudaDriverFaults")
+
+
+def storm_seed_line(point_seed: int, storm_seed: int) -> str:
+    return f"SEED: {GEN_VERSION} point={point_seed} storm={storm_seed}"
+
+
+def _rule(rng: np.random.Generator) -> dict:
+    t = int(rng.choice(_TYPES))
+    r = {"percent": int(rng.choice((25, 50, 100))),
+         "injectionType": t,
+         "interceptionCount": int(rng.integers(1, 7))}
+    if t == 2:
+        r["substituteReturnCode"] = int(rng.choice((700, 715, 999)))
+    if t == 4:
+        # strictly positive delays only — a negative delay is a hang
+        # until watchdog cancel, which needs a deadline the bare fused
+        # lane doesn't carry
+        r["delayMs"] = int(rng.choice((1, 2, 5)))
+    if t == 5:
+        r["crashMode"] = str(rng.choice(("abort", "kill", "exit")))
+    if t == 6:
+        r["oomMode"] = str(rng.choice(("retry", "split")))
+        r["numOoms"] = int(rng.integers(1, 3))
+        r["skipCount"] = int(rng.integers(0, 3))
+    return r
+
+
+def gen_storm(storm_seed: int) -> dict:
+    """One composed storm config from its seed: 1–4 rules, each on a
+    distinct surface, each in a random config section."""
+    rng = np.random.default_rng(np.uint64(storm_seed) + np.uint64(0x5707))
+    nrules = int(rng.integers(1, 5))
+    names = list(rng.choice(len(_SURFACES), size=min(nrules, len(_SURFACES)),
+                            replace=False))
+    cfg: dict = {}
+    for idx in names:
+        section = _SECTIONS[int(rng.integers(0, len(_SECTIONS)))]
+        cfg.setdefault(section, {})[_SURFACES[int(idx)]] = _rule(rng)
+    return cfg
+
+
+def storm_types(cfg: dict) -> List[int]:
+    return sorted({r["injectionType"] for sec in cfg.values()
+                   for r in sec.values()})
+
+
+def run_storm_point(point_seed: int, storm_seed: int,
+                    witness: bool = True) -> dict:
+    """One (point, storm) trial. Returns a verdict dict:
+        status            "ok" | "typed:<ClassName>"
+        diverged          result ran but bytes differed (failure)
+        untyped           non-allowlisted exception string (failure)
+        witness_unbalanced  stranded pairs at drain (failure; {} = clean)
+        injector_seed     the RNG seed the injector sampled rules with
+    """
+    plan, tables, _case = gen_point(point_seed)
+    plan = _resolved(plan, tables)
+    ref = run_reference(plan, tables)
+    cfg = gen_storm(storm_seed)
+
+    verdict = {"point_seed": point_seed, "storm_seed": storm_seed,
+               "seed_line": storm_seed_line(point_seed, storm_seed),
+               "injector_seed": storm_seed,
+               "types": storm_types(cfg), "status": None,
+               "diverged": None, "untyped": None,
+               "witness_unbalanced": {}}
+
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="fuzz-storm-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(cfg, f)
+        if witness:
+            protocol_witness.reset()
+            protocol_witness.install()
+        inj_install(path, seed=storm_seed)
+        try:
+            with config.override("faultinj.backoff_base_s", 0.0002), \
+                    config.override("faultinj.backoff_max_s", 0.002):
+                arg = tables[0] if len(tables) == 1 else tables
+                out = execute_plan(plan, arg)
+            m = tables_mismatch(ref, out)
+            if m is None:
+                verdict["status"] = "ok"
+            else:
+                verdict["status"] = "diverged"
+                verdict["diverged"] = m
+        except TYPED_FAILURES as e:
+            verdict["status"] = f"typed:{type(e).__name__}"
+        except Exception as e:  # noqa: BLE001 — the untyped bucket IS the check
+            verdict["status"] = "untyped"
+            verdict["untyped"] = f"{type(e).__name__}: {e}"
+        finally:
+            inj_uninstall()
+        if witness:
+            verdict["witness_unbalanced"] = dict(
+                protocol_witness.unbalanced(asserted_only=True))
+    finally:
+        if witness:
+            protocol_witness.uninstall()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return verdict
+
+
+def storm_ok(verdict: dict) -> bool:
+    return (verdict["status"] is not None
+            and (verdict["status"] == "ok"
+                 or verdict["status"].startswith("typed:"))
+            and not verdict["witness_unbalanced"])
+
+
+def run_storm_batch(point_seeds: List[int], storm_seed_base: int,
+                    log=None) -> dict:
+    """Storm every point; returns the aggregate book for the artifact."""
+    book = {"points": 0, "absorbed": 0, "typed_failures": {},
+            "untyped_failures": [], "diverged": [],
+            "witness_unbalanced": [], "types_seen": set(),
+            "storm_seed_base": storm_seed_base}
+    for i, ps in enumerate(point_seeds):
+        v = run_storm_point(ps, storm_seed_base + i)
+        book["points"] += 1
+        book["types_seen"].update(v["types"])
+        if v["status"] == "ok":
+            book["absorbed"] += 1
+        elif v["status"].startswith("typed:"):
+            k = v["status"][len("typed:"):]
+            book["typed_failures"][k] = book["typed_failures"].get(k, 0) + 1
+        elif v["status"] == "diverged":
+            book["diverged"].append(v["seed_line"] + " — " + v["diverged"])
+        else:
+            book["untyped_failures"].append(
+                v["seed_line"] + " — " + (v["untyped"] or "?"))
+        if v["witness_unbalanced"]:
+            book["witness_unbalanced"].append(
+                v["seed_line"] + " — " + repr(v["witness_unbalanced"]))
+        if (i + 1) % 50 == 0:
+            if log is not None:
+                log(f"storms: {i + 1}/{len(point_seeds)}")
+            from .oracle import drop_compile_caches
+            drop_compile_caches()  # bound executable mappings (see oracle)
+    book["types_seen"] = sorted(book["types_seen"])
+    return book

@@ -53,11 +53,54 @@ from .nodes import (Filter, GroupBy, Join, Limit, PlanError, PlanNode,
 
 TableOrTables = Union[Table, Sequence[Table]]
 
+# The declared fallback-reason catalog. Every engine-selection site that
+# degrades to the eager interpreter must label itself with one of these
+# slugs — the per-reason metrics map, the fuzz oracle's undeclared-
+# fallback check, and the SRJT021 lint rule all key on this set, so a
+# new fallback path is added HERE first (and documented at its site).
+FALLBACK_REASONS = frozenset({
+    "unsupported-input",       # executor gate: empty/non-fixed-width/
+                               # decimal/encoded-DAG inputs
+    "planner-unsupported",     # planner strategy gate on a DAG plan
+    "overflow",                # device re-check tripped (group budget,
+                               # join shape, packing range, merge)
+    "oom-split-unmergeable",   # split demanded but pieces can't merge
+                               # bit-identically (named split gate)
+    "oom-split-degenerate",    # split merge hit a degenerate input
+                               # (every piece aggregated to zero groups)
+})
+
 
 def _as_tables(table: TableOrTables) -> tuple:
     if isinstance(table, Table):
         return (table,)
     return tuple(table)
+
+
+def _null_padding(c: Column, n: int) -> Column:
+    """``n`` all-null rows shaped like ``c`` — the LEFT-join miss columns
+    when the build side has 0 rows (nothing to gather from; a left join
+    still keeps every probe row). Encoded payloads come out PLAIN, the
+    same shape gather's decode-on-reorder boundary would produce."""
+    from ..columnar import encodings as enc
+    if enc.is_encoded(c):
+        d = enc.logical_dtype(c)
+        return Column(d, n, data=jnp.zeros((n,), d.jnp_dtype),
+                      validity=jnp.zeros((n,), bool))
+    if c.offsets is not None:
+        return Column(c.dtype, n,
+                      data=(None if c.data is None
+                            else jnp.zeros((0,), jnp.uint8)),
+                      validity=jnp.zeros((n,), bool),
+                      offsets=jnp.zeros((n + 1,), jnp.int32),
+                      children=c.children)
+    if c.data is None:  # STRUCT
+        return Column(c.dtype, n, validity=jnp.zeros((n,), bool),
+                      children=tuple(_null_padding(k, n)
+                                     for k in c.children))
+    shape = (n,) + c.data.shape[1:]
+    return Column(c.dtype, n, data=jnp.zeros(shape, c.data.dtype),
+                  validity=jnp.zeros((n,), bool), children=c.children)
 
 
 def _join_eager(node: Join, lt: Table, rt: Table) -> Table:
@@ -119,20 +162,18 @@ def _join_eager(node: Join, lt: Table, rt: Table) -> Table:
     n = int(found.shape[0])
     safe = jnp.asarray(np.maximum(r_idx, 0))
     for c in rt.columns:
+        if rt.num_rows == 0:
+            # 0-row build: every probe row is a miss and there is
+            # nothing to gather from — synthesize the all-null columns
+            out.append(_null_padding(c, n))
+            continue
         if c.offsets is not None or c.data is None:
             # variable-width/struct payloads keep the plain gather path
             # (no fused counterpart to stay bit-identical with)
-            g = gather(c, safe if rt.num_rows else jnp.asarray(r_idx))
+            g = gather(c, safe)
             v = found if g.validity is None else (g.validity & found)
             out.append(Column(g.dtype, g.size, data=g.data, validity=v,
                               offsets=g.offsets, children=g.children))
-            continue
-        if rt.num_rows == 0:
-            shape = (n,) + c.data.shape[1:]
-            out.append(Column(c.dtype, n,
-                              data=jnp.zeros(shape, c.data.dtype),
-                              validity=jnp.zeros((n,), bool),
-                              children=c.children))
             continue
         g = gather(c, safe)
         f = found.reshape(found.shape + (1,) * (g.data.ndim - 1))
@@ -178,8 +219,15 @@ def run_eager(plan: PlanNode, table: TableOrTables,
     sequence of tables (DAG plans; ``Scan.input_index`` selects).
 
     ``fallback_reason`` labels this run as a fused-path fallback and
-    bumps the plan metrics; oracle/reference callers omit it."""
+    bumps the plan metrics; oracle/reference callers omit it. A reason
+    outside the declared ``FALLBACK_REASONS`` catalog is a programming
+    error — an undeclared fallback — and raises."""
     if fallback_reason is not None:
+        if fallback_reason not in FALLBACK_REASONS:
+            raise PlanError(
+                f"undeclared fallback reason {fallback_reason!r} — add it "
+                f"to plan/interpreter.FALLBACK_REASONS (and the SRJT021 "
+                f"catalog) before using it at an engine-selection site")
         plan_metrics.inc("plan_fallbacks")
         plan_metrics.inc_fallback_reason(fallback_reason)
         if any(isinstance(n, Join) for n in walk(plan)):

@@ -65,7 +65,7 @@ def _execute_on_mesh(plan: PlanNode, table: Table, mesh,
 
     if overflow:
         plan_metrics.inc("plan_overflows")
-        return run_eager(plan, table, fallback_reason="overflow")
+        return _eager_fallback(plan, table, "overflow")
 
     cols = sharding.rebuild_outputs(prog.replicated, prog.out_cols,
                                     out_leaves, table)
@@ -90,7 +90,7 @@ def execute_plan_sharded(plan: PlanNode, table: Table,
     plan = resolve_dict_literals(plan, table)
     reason = unsupported_reason(plan, table)
     if reason is not None:
-        return run_eager(plan, table, fallback_reason="unsupported-input")
+        return _eager_fallback(plan, table, "unsupported-input")
     if mesh is None:
         mesh = cluster.get_mesh(devices)
     if (int(mesh.devices.size) == 1

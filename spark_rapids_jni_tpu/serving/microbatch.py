@@ -57,13 +57,31 @@ from .admission import PLAN_SURFACE
 from .sessions import serving_metrics
 
 
+def batching_unsupported_reason(plan: PlanNode,
+                                table: Table) -> Optional[str]:
+    """The NAMED reason this query cannot micro-batch, or None. The
+    batching gate is the executor gate plus one of its own: RLE/FOR
+    columns can't pad to the row bucket (``_pad_table`` appends zero
+    ROWS, but run/packed buffers aren't row-addressable — found by the
+    fuzz oracle's batched lane, which asserts this gate stays named)."""
+    r = unsupported_reason(plan, table)
+    if r is not None:
+        return r
+    for i, c in enumerate(table.columns):
+        if c.dtype.id in (dt.TypeId.RLE, dt.TypeId.FOR32,
+                          dt.TypeId.FOR64):
+            return (f"column {i} is {c.dtype.id.value}-encoded — run/"
+                    f"packed buffers don't pad to bucket rows")
+    return None
+
+
 def batch_key_for(plan: PlanNode, table: Table
                   ) -> Tuple[PlanNode, Optional[Tuple]]:
     """(resolved plan, batching key) — key is None when the query cannot
-    batch (unsupported input: the caller routes it solo, where
-    execute_plan takes its eager fallback)."""
+    batch (``batching_unsupported_reason``: the caller routes it solo,
+    where execute_plan takes its eager fallback)."""
     plan = resolve_dict_literals(plan, table)
-    if unsupported_reason(plan, table) is not None:
+    if batching_unsupported_reason(plan, table) is not None:
         return plan, None
     bucket = bucket_size(table.num_rows)
     sig = tuple(ent[:2] + (bucket,) + ent[3:]

@@ -1320,6 +1320,121 @@ def test_srjt020_noqa():
 
 
 # ---------------------------------------------------------------------------
+# SRJT021 — engine fallback without a reason from the declared catalog
+# ---------------------------------------------------------------------------
+
+SRC_021_BARE = """
+    def degrade(plan, table):
+        return run_eager(plan, table)
+"""
+
+SRC_021_DECLARED = """
+    def degrade(plan, table):
+        return run_eager(plan, table, fallback_reason="overflow")
+"""
+
+SRC_021_COMPUTED = """
+    def degrade(plan, table, why):
+        return run_eager(plan, table, fallback_reason=why)
+"""
+
+SRC_021_OFF_CATALOG = """
+    def degrade(plan, table):
+        return run_eager(plan, table, fallback_reason="vibes")
+"""
+
+
+def test_srjt021_bare_run_eager_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    fs = run(SRC_021_BARE, path="pkg/plan/executor.py",
+             rules=[rule_srjt021])
+    assert rules_of(fs) == {"SRJT021"}
+    assert "bare run_eager" in fs[0].message
+
+
+def test_srjt021_explicit_none_is_still_bare():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    src = SRC_021_DECLARED.replace('"overflow"', "None")
+    fs = run(src, path="pkg/plan/executor.py", rules=[rule_srjt021])
+    assert rules_of(fs) == {"SRJT021"}
+    assert "bare run_eager" in fs[0].message
+
+
+def test_srjt021_catalog_literal_passes():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    assert run(SRC_021_DECLARED, path="pkg/plan/executor.py",
+               rules=[rule_srjt021]) == []
+
+
+def test_srjt021_positional_reason_counts():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    src = SRC_021_DECLARED.replace('fallback_reason="overflow"',
+                                   '"overflow"')
+    assert run(src, path="pkg/plan/executor.py",
+               rules=[rule_srjt021]) == []
+
+
+def test_srjt021_computed_reason_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    fs = run(SRC_021_COMPUTED, path="pkg/plan/executor.py",
+             rules=[rule_srjt021])
+    assert rules_of(fs) == {"SRJT021"}
+    assert "STRING LITERAL" in fs[0].message
+
+
+def test_srjt021_off_catalog_literal_flagged():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    fs = run(SRC_021_OFF_CATALOG, path="pkg/plan/executor.py",
+             rules=[rule_srjt021])
+    assert rules_of(fs) == {"SRJT021"}
+    assert "'vibes'" in fs[0].message
+    assert "FALLBACK_REASONS" in fs[0].message
+
+
+def test_srjt021_interpreter_owns_run_eager():
+    # the defining module is exempt — it IS run_eager, not a caller
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    assert run(SRC_021_BARE, path="pkg/plan/interpreter.py",
+               rules=[rule_srjt021]) == []
+
+
+def test_srjt021_noqa_names_the_oracle_boundary():
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    src = SRC_021_BARE.replace(
+        "run_eager(plan, table)",
+        "run_eager(plan, table)  # srjt: noqa[SRJT021] — oracle lane")
+    assert run(src, path="pkg/plan/executor.py",
+               rules=[rule_srjt021]) == []
+
+
+def test_srjt021_covers_the_guarded_forwarder():
+    # plan/executor._eager_fallback is the guarded route to run_eager;
+    # its call sites are engine-selection sites and carry the reason in
+    # the same slot, so the rule enforces them identically
+    from spark_rapids_jni_tpu.analysis.rules import rule_srjt021
+    ok = """
+    def route(plan, t):
+        return _eager_fallback(plan, t, "unsupported-input")
+"""
+    assert run(ok, path="pkg/plan/executor.py", rules=[rule_srjt021]) == []
+    off = """
+    def route(plan, t):
+        return _eager_fallback(plan, t, "vibes")
+"""
+    fs = run(off, path="pkg/plan/executor.py", rules=[rule_srjt021])
+    assert rules_of(fs) == {"SRJT021"}
+    assert "'vibes'" in fs[0].message
+
+
+def test_srjt021_catalog_mirrors_interpreter():
+    # the rule's catalog is a hardcoded mirror (pure-AST mode cannot
+    # import the jax-backed interpreter); they must never drift
+    from spark_rapids_jni_tpu.analysis.rules import _SRJT021_CATALOG
+    from spark_rapids_jni_tpu.plan.interpreter import FALLBACK_REASONS
+    assert _SRJT021_CATALOG == FALLBACK_REASONS
+
+
+# ---------------------------------------------------------------------------
 # suppression / engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -1339,7 +1454,7 @@ def test_rule_disabled_means_no_finding():
     # catalog; conversely an explicit reduced catalog must not flag
     other_rules = [r for r in FILE_RULES if r is not rule_srjt001]
     assert run(SRC_001, rules=other_rules) == []
-    assert len(FILE_RULES) == 20
+    assert len(FILE_RULES) == 21
 
 
 def test_syntax_error_is_reported_not_raised():

@@ -142,6 +142,42 @@ def test_fused_join_empty_build_side():
                                     run_eager(plan, tabs))
 
 
+def test_left_join_zero_row_build_table_all_null_payload():
+    """A 0-row build INPUT table (not a runtime-filtered one): LEFT
+    keeps every probe row with all-null right payload across plain,
+    string, dict and RLE payload columns — the miss columns are
+    synthesized, there is nothing to gather from. Regression: fuzz seed
+    1556 crashed the eager interpreter here with a non-empty jnp.take
+    from an empty axis."""
+    from spark_rapids_jni_tpu.columnar import encodings as enc
+    rng = np.random.default_rng(3)
+    probe = Table((
+        _c(rng.integers(0, 10, 8), dt.INT64),
+        _c(rng.integers(0, 5, 8).astype(np.int32), dt.INT32),
+    ))
+    build = Table((
+        _c(np.zeros(0, np.int64), dt.INT64),
+        Column.from_pylist([], dt.STRING),
+        encode_strings(Column.from_pylist([], dt.STRING)),
+        enc.rle_encode(Column.from_pylist([], dt.INT64)),
+    ))
+    plan = Join(Scan(2, input_index=0), Scan(4, input_index=1),
+                (0,), (0,), "left")
+    out = run_eager(plan, (probe, build))
+    assert out.num_rows == 8
+    assert len(out.columns) == 6
+    for c in out.columns[2:]:
+        assert c.validity is not None
+        assert not bool(np.asarray(c.validity).any())
+    for how, nrows in (("inner", 0), ("semi", 0), ("anti", 8)):
+        p = Join(Scan(2, input_index=0), Scan(4, input_index=1),
+                 (0,), (0,), how)
+        assert run_eager(p, (probe, build)).num_rows == nrows
+    # the executor's empty-input gate routes to the same eager path
+    out2 = execute_plan(plan, (probe, build), cache=ProgramCache())
+    assert_tables_bit_identical(out, out2)
+
+
 def test_fused_join_downstream_groupby_sort():
     # the q3/q5 shape in miniature: filter -> join -> project -> groupby
     tabs = _probe_build(seed=14, null_keys=True, dense=True)

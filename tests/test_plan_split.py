@@ -1,0 +1,138 @@
+"""Degenerate-merge tests for the forced-split path (plan/split.py).
+
+The OOM ladder's split rung halves the input, runs the piece plan per
+piece, and merges exactly. These tests drive the split machinery
+DIRECTLY (prepare/split_table/merge_pieces — no OOM required) at the
+degenerate ends the fuzz harness's split lane walks: pieces whose rows
+are entirely filtered away, empty-piece concatenation, and partial-mean
+merges where one piece contributes zero live rows.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import dtype as dt
+from spark_rapids_jni_tpu.columnar.column import Column, Table
+from spark_rapids_jni_tpu.plan import split as _split
+from spark_rapids_jni_tpu.plan import (Filter, GroupBy, Scan, Sort, col,
+                                       lit, execute_plan)
+from spark_rapids_jni_tpu.plan.interpreter import run_eager
+from spark_rapids_jni_tpu.utils import config
+
+
+def assert_tables_bit_identical(a: Table, b: Table):
+    assert a.num_rows == b.num_rows
+    assert a.num_columns == b.num_columns
+    for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+        da, db = np.asarray(ca.data), np.asarray(cb.data)
+        assert da.dtype == db.dtype, f"col {i} dtype"
+        assert np.array_equal(da, db), f"col {i} data"
+        va = (np.ones(a.num_rows, bool) if ca.validity is None
+              else np.asarray(ca.validity))
+        vb = (np.ones(b.num_rows, bool) if cb.validity is None
+              else np.asarray(cb.validity))
+        assert np.array_equal(va, vb), f"col {i} validity"
+
+
+def _force_split(plan, table):
+    """The split rung without the OOM: halve, run pieces, merge exact."""
+    spec = _split.prepare(plan)
+    pieces = _split.split_table(table)
+    results = [run_eager(spec.piece_plan, p) for p in pieces]
+    return _split.merge_pieces(spec, results, table.num_rows,
+                               int(config.get("plan.max_groups")))
+
+
+def _table(keys, vals):
+    return Table((Column.from_pylist(keys, dt.INT64),
+                  Column.from_pylist(vals, dt.INT64)))
+
+
+def test_concat_merge_with_one_empty_piece():
+    """Filter kills EVERY row of the second half: the concat merge sees
+    an empty piece and must still equal the unsplit answer bit-for-bit
+    (zero-row columns concatenate, they don't crash or shift)."""
+    # first half < 100, second half >= 100; predicate keeps < 100
+    keys = [1, 2, 3, 4, 500, 600, 700, 800]
+    vals = [10, 20, 30, 40, 50, 60, 70, 80]
+    t = _table(keys, vals)
+    plan = Filter(Scan(2), col(0) < lit(100))
+    out = _force_split(plan, t)
+    assert_tables_bit_identical(out, run_eager(plan, t))
+    assert out.num_rows == 4
+
+
+def test_concat_merge_with_all_pieces_empty():
+    """Every row of every piece filtered: the merged result is the same
+    0-row table the unsplit plan produces — empty is a RESULT for
+    row-preserving plans, not an error."""
+    t = _table([1, 2, 3, 4], [9, 9, 9, 9])
+    plan = Filter(Scan(2), col(0) > lit(1000))
+    out = _force_split(plan, t)
+    assert out.num_rows == 0
+    assert_tables_bit_identical(out, run_eager(plan, t))
+
+
+def test_groupby_merge_all_pieces_zero_groups_is_typed():
+    """GroupBy merge where EVERY piece aggregated to zero groups: the
+    named degenerate ('every piece aggregated to zero groups'), the
+    reason the executor's oom-split-degenerate gate exists."""
+    t = _table([1, 2, 3, 4], [9, 9, 9, 9])
+    plan = GroupBy(Filter(Scan(2), col(0) > lit(1000)), (0,),
+                   ((1, "sum"),))
+    spec = _split.prepare(plan)
+    pieces = _split.split_table(t)
+    results = [run_eager(spec.piece_plan, p) for p in pieces]
+    assert all(r.num_rows == 0 for r in results)
+    with pytest.raises(_split.SplitMergeError,
+                       match="zero groups"):
+        _split.merge_pieces(spec, results, t.num_rows,
+                            int(config.get("plan.max_groups")))
+
+
+def test_groupby_mean_merge_with_one_zero_live_row_piece():
+    """Partial-mean merge where one piece contributes NOTHING: the
+    global sum/count division must still reproduce the solo f64 bits
+    (count rides along; the dead piece's zero partials are dropped by
+    the zero-row filter, not averaged in)."""
+    # second half entirely filtered out -> its piece aggregates to
+    # zero groups and is discarded; the first half carries all state
+    keys = [1, 1, 2, 2, 900, 900, 900, 900]
+    vals = [3, 4, 10, 21, 5, 5, 5, 5]
+    t = _table(keys, vals)
+    plan = GroupBy(Filter(Scan(2), col(0) < lit(100)), (0,),
+                   ((1, "mean"), (1, "count"), (1, "sum")))
+    out = _force_split(plan, t)
+    solo = run_eager(plan, t)
+    assert_tables_bit_identical(out, solo)
+    # and the fused unsplit program agrees too (three-way identity)
+    assert_tables_bit_identical(out, execute_plan(plan, t))
+    means = np.asarray(out.columns[1].data).view(np.float64)
+    live = sorted(means[: out.num_rows].tolist())
+    assert live == [3.5, 15.5]
+
+
+def test_groupby_mean_merge_zero_live_rows_in_straddling_piece():
+    """A group that exists ONLY in one piece, next to a group that
+    straddles both: merged mean bits must match solo exactly for both
+    (partial sums and counts re-divide globally, never re-average)."""
+    keys = [1, 1, 1, 2, 1, 2, 2, 2]
+    vals = [1, 2, 3, 100, 6, 101, 102, 97]
+    t = _table(keys, vals)
+    plan = Sort(GroupBy(Scan(2), (0,), ((1, "mean"), (1, "count"))), (0,))
+    out = _force_split(plan, t)
+    assert_tables_bit_identical(out, run_eager(plan, t))
+    means = np.asarray(out.columns[1].data).view(np.float64)
+    assert means.tolist() == [3.0, 100.0]
+
+
+def test_split_single_row_input_yields_one_piece():
+    """n < 2 can't halve: split_table returns the input whole and the
+    merge is the identity — with_retry turns this into a typed OOM at
+    the ladder, but the machinery itself must not divide by zero."""
+    t = _table([7], [42])
+    plan = Filter(Scan(2), col(0) > lit(0))
+    pieces = _split.split_table(t)
+    assert len(pieces) == 1
+    out = _force_split(plan, t)
+    assert_tables_bit_identical(out, run_eager(plan, t))
